@@ -1,0 +1,30 @@
+"""deepspeed_tpu — a TPU-native large-model training & inference framework.
+
+Capability parity with DeepSpeed (reference: ``deepspeed/__init__.py``), designed
+TPU-first: named device meshes + XLA collectives instead of NCCL process groups,
+sharding specs instead of runtime partitioning hooks, jit-compiled train steps
+instead of engine-orchestrated streams, Pallas kernels instead of CUDA.
+
+Public entry points (reference parity):
+- :func:`initialize` — config + model → (engine, optimizer, dataloader, scheduler)
+  (reference ``deepspeed/__init__.py:80``)
+- :func:`init_inference` — inference engine (reference :313)
+- ``comm`` — collectives API (reference ``deepspeed/comm``)
+"""
+
+__version__ = "0.1.0"
+
+from . import comm  # noqa: F401
+from .runtime.config import DeepSpeedTPUConfig, parse_config  # noqa: F401
+
+
+def initialize(*args, **kwargs):
+    from .runtime.engine import initialize as _init
+
+    return _init(*args, **kwargs)
+
+
+def init_inference(*args, **kwargs):
+    from .inference.engine import init_inference as _init
+
+    return _init(*args, **kwargs)

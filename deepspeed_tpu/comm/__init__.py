@@ -1,0 +1,14 @@
+from .comm import (all_gather, all_reduce, all_to_all, axis_index, axis_size, barrier,
+                   broadcast, broadcast_host, configure, get_rank, get_telemetry,
+                   get_world_size, init_distributed, is_initialized, ppermute,
+                   reduce_scatter, ring_shift)
+from .mesh import (BATCH_AXES, MESH_AXES, ZERO_AXES, MeshManager, get_mesh, init_mesh,
+                   set_mesh)
+
+__all__ = [
+    "all_gather", "all_reduce", "all_to_all", "axis_index", "axis_size", "barrier",
+    "broadcast", "broadcast_host", "configure", "get_rank", "get_telemetry",
+    "get_world_size", "init_distributed", "is_initialized", "ppermute",
+    "reduce_scatter", "ring_shift", "BATCH_AXES", "MESH_AXES", "ZERO_AXES",
+    "MeshManager", "get_mesh", "init_mesh", "set_mesh",
+]

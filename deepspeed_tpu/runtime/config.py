@@ -1,0 +1,434 @@
+"""DeepSpeed-compatible typed configuration.
+
+Capability parity with the reference's ``runtime/config.py`` (``DeepSpeedConfig``
+at :651) and its pydantic sub-configs (e.g. ZeRO config ``runtime/zero/config.py:95``):
+a JSON/dict config tree with the same key names, plus the batch-size resolution
+invariant ``train_batch_size == micro_batch * gradient_accumulation_steps * dp_world``.
+
+TPU-first differences:
+- ``mesh``: explicit named-axis mesh shape (data/fsdp/tensor/pipe/seq/expert) —
+  replaces the reference's process-group plumbing (``utils/groups.py``).
+- ZeRO stages select *sharding specs* (see ``runtime/zero/sharding.py``), not
+  runtime hook machinery.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Union
+
+from ..utils.logging import logger
+from .config_utils import ConfigModel, register_config_model
+from . import constants as C
+
+
+@register_config_model
+@dataclass
+class FP16Config(ConfigModel):
+    """Reference: ``runtime/fp16`` config block (``runtime/config.py`` fp16 keys)."""
+    enabled: bool = False
+    auto_cast: bool = False
+    loss_scale: float = 0.0  # 0 → dynamic
+    initial_scale_power: int = 16
+    loss_scale_window: int = 1000
+    hysteresis: int = 2
+    consecutive_hysteresis: bool = False
+    min_loss_scale: float = 1.0
+
+    @property
+    def dynamic_loss_scale(self) -> bool:
+        return self.loss_scale == 0
+
+
+@register_config_model
+@dataclass
+class BF16Config(ConfigModel):
+    enabled: bool = False
+
+
+@register_config_model
+@dataclass
+class OffloadDeviceConfig(ConfigModel):
+    """Reference: ``runtime/zero/offload_config.py:21/:52``."""
+    device: str = C.OFFLOAD_NONE  # none | cpu | nvme
+    nvme_path: Optional[str] = None
+    pin_memory: bool = False
+    buffer_count: int = 5
+    buffer_size: int = 100_000_000
+    ratio: float = 1.0
+    max_in_cpu: int = 1_000_000_000
+
+
+@register_config_model
+@dataclass
+class ZeroConfig(ConfigModel):
+    """Reference: ``runtime/zero/config.py:95-376``. Stage semantics:
+
+    0: plain DP (grad psum over data axis)
+    1: optimizer states sharded over the fsdp axis
+    2: + gradients reduce-scattered over fsdp
+    3: + parameters sharded over fsdp, gathered on use (XLA SPMD schedules the
+       all-gathers; replaces the IPG bucket/stream machinery of the reference)
+    """
+    stage: int = 0
+    overlap_comm: bool = True          # XLA latency-hiding scheduler: always on
+    contiguous_gradients: bool = True  # XLA owns layout; accepted for compat
+    reduce_bucket_size: int = 500_000_000
+    allgather_bucket_size: int = 500_000_000
+    reduce_scatter: bool = True
+    round_robin_gradients: bool = False
+    offload_param: OffloadDeviceConfig = field(default_factory=OffloadDeviceConfig)
+    offload_optimizer: OffloadDeviceConfig = field(default_factory=OffloadDeviceConfig)
+    sub_group_size: int = 1_000_000_000
+    stage3_max_live_parameters: int = 1_000_000_000
+    stage3_max_reuse_distance: int = 1_000_000_000
+    stage3_prefetch_bucket_size: int = 50_000_000
+    stage3_param_persistence_threshold: int = 100_000
+    stage3_gather_16bit_weights_on_model_save: bool = False
+    zero_quantized_weights: bool = False     # ZeRO++ qwZ
+    zero_quantized_gradients: bool = False   # ZeRO++ qgZ
+    zero_hpz_partition_size: int = 1         # ZeRO++ hpZ (hierarchical partition)
+    mics_shard_size: int = -1                # MiCS sub-axis shard size
+    mics_hierarchical_params_gather: bool = False
+    ignore_unused_parameters: bool = True
+    elastic_checkpoint: bool = False
+
+
+@register_config_model
+@dataclass
+class OptimizerConfig(ConfigModel):
+    type: str = "adamw"
+    params: Dict[str, Any] = field(default_factory=dict)
+
+
+@register_config_model
+@dataclass
+class SchedulerConfig(ConfigModel):
+    type: Optional[str] = None
+    params: Dict[str, Any] = field(default_factory=dict)
+
+
+@register_config_model
+@dataclass
+class MeshConfig(ConfigModel):
+    """TPU-native replacement for mpu/topology/process-groups: the named device
+    mesh. Sizes of 1 mean the axis is unused. ``data`` defaults to "fill the
+    remaining devices". fsdp is folded with data for ZeRO sharding (the ZeRO
+    partition group == the data-parallel group, as in the reference)."""
+    data: int = -1        # -1 → infer (devices / product(other axes))
+    tensor: int = 1
+    pipe: int = 1
+    seq: int = 1
+    expert: int = 1
+
+    def axis_sizes(self, n_devices: int) -> Dict[str, int]:
+        fixed = self.tensor * self.pipe * self.seq * self.expert
+        data = self.data
+        if data == -1:
+            if n_devices % fixed != 0:
+                raise ValueError(
+                    f"device count {n_devices} not divisible by "
+                    f"tensor*pipe*seq*expert={fixed}")
+            data = n_devices // fixed
+        total = data * fixed
+        if total != n_devices:
+            raise ValueError(
+                f"mesh data={data} expert={self.expert} pipe={self.pipe} "
+                f"seq={self.seq} tensor={self.tensor} = {total} != device count {n_devices}")
+        return {"data": data, "expert": self.expert, "pipe": self.pipe,
+                "seq": self.seq, "tensor": self.tensor}
+
+
+@register_config_model
+@dataclass
+class TensorParallelConfig(ConfigModel):
+    """Reference: ``autotp_size`` training config (``runtime/tensor_parallel/``)."""
+    autotp_size: int = 1
+    tp_overlap_comm: bool = False
+
+
+@register_config_model
+@dataclass
+class ActivationCheckpointingConfig(ConfigModel):
+    """Reference: ``runtime/activation_checkpointing/checkpointing.py`` flags.
+    On TPU these select a ``jax.checkpoint`` (remat) policy."""
+    partition_activations: bool = False
+    cpu_checkpointing: bool = False   # → offload remat residuals to host memory
+    contiguous_memory_optimization: bool = False
+    number_checkpoints: Optional[int] = None
+    synchronize_checkpoint_boundary: bool = False
+    profile: bool = False
+    policy: str = "none"  # none | full | dots_saveable | offload
+
+
+@register_config_model
+@dataclass
+class FlopsProfilerConfig(ConfigModel):
+    enabled: bool = False
+    profile_step: int = 1
+    module_depth: int = -1
+    top_modules: int = 1
+    detailed: bool = True
+    output_file: Optional[str] = None
+
+
+@register_config_model
+@dataclass
+class CommsLoggerConfig(ConfigModel):
+    enabled: bool = False
+    verbose: bool = False
+    prof_all: bool = True
+    debug: bool = False
+    prof_ops: List[str] = field(default_factory=list)
+
+
+@register_config_model
+@dataclass
+class MonitorBackendConfig(ConfigModel):
+    enabled: bool = False
+    output_path: str = ""
+    job_name: str = "DeepSpeedTPUJob"
+    # wandb extras
+    team: Optional[str] = None
+    group: Optional[str] = None
+    project: Optional[str] = None
+
+
+@register_config_model
+@dataclass
+class PipelineConfig(ConfigModel):
+    stages: int = 1
+    partition_method: str = "parameters"  # parameters | uniform | type:regex
+    activation_checkpoint_interval: int = 0
+    pipe_schedule: str = "1f1b"           # 1f1b | gpipe | inference
+
+
+@register_config_model
+@dataclass
+class MoEConfig(ConfigModel):
+    enabled: bool = False
+    expert_parallel_size: int = 1
+    num_experts: int = 1
+    top_k: int = 1
+    capacity_factor: float = 1.0
+    eval_capacity_factor: float = 1.0
+    min_capacity: int = 4
+    drop_tokens: bool = True
+    use_rts: bool = True          # random token selection
+    aux_loss_coef: float = 0.01
+
+
+@register_config_model
+@dataclass
+class CheckpointConfig(ConfigModel):
+    """Reference: checkpoint-engine selection + options (``runtime/engine.py:1287``)."""
+    engine: str = "default"  # default | async | fast
+    use_node_local_storage: bool = False
+    parallel_write_pipeline: bool = False
+    tag_validation: str = "Warn"  # Warn | Ignore | Fail
+    load_universal: bool = False
+    writer_buffer_mb: int = 64
+
+
+@register_config_model
+@dataclass
+class AIOConfig(ConfigModel):
+    """Reference: ``runtime/swap_tensor/aio_config.py``."""
+    block_size: int = 1048576
+    queue_depth: int = 8
+    thread_count: int = 1
+    single_submit: bool = False
+    overlap_events: bool = True
+
+
+@dataclass
+class DeepSpeedTPUConfig:
+    """The full config tree. Built by :func:`parse_config`."""
+
+    # batch sizes (resolved; see _resolve_batch_size)
+    train_batch_size: int = 0
+    train_micro_batch_size_per_gpu: int = 0
+    gradient_accumulation_steps: int = 0
+
+    optimizer: OptimizerConfig = field(default_factory=OptimizerConfig)
+    scheduler: SchedulerConfig = field(default_factory=SchedulerConfig)
+    fp16: FP16Config = field(default_factory=FP16Config)
+    bf16: BF16Config = field(default_factory=BF16Config)
+    zero_config: ZeroConfig = field(default_factory=ZeroConfig)
+    mesh: MeshConfig = field(default_factory=MeshConfig)
+    tensor_parallel: TensorParallelConfig = field(default_factory=TensorParallelConfig)
+    pipeline: PipelineConfig = field(default_factory=PipelineConfig)
+    moe: MoEConfig = field(default_factory=MoEConfig)
+    activation_checkpointing: ActivationCheckpointingConfig = field(
+        default_factory=ActivationCheckpointingConfig)
+    flops_profiler: FlopsProfilerConfig = field(default_factory=FlopsProfilerConfig)
+    comms_logger: CommsLoggerConfig = field(default_factory=CommsLoggerConfig)
+    tensorboard: MonitorBackendConfig = field(default_factory=MonitorBackendConfig)
+    wandb: MonitorBackendConfig = field(default_factory=MonitorBackendConfig)
+    csv_monitor: MonitorBackendConfig = field(default_factory=MonitorBackendConfig)
+    checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
+    aio: AIOConfig = field(default_factory=AIOConfig)
+
+    gradient_clipping: float = 0.0
+    prescale_gradients: bool = False
+    gradient_predivide_factor: float = 1.0
+    steps_per_print: int = 10
+    wall_clock_breakdown: bool = False
+    memory_breakdown: bool = False
+    sequence_parallel_size: int = 1
+    seed: int = 42
+    communication_data_type: Optional[str] = None
+    gradient_accumulation_dtype: Optional[str] = None
+    data_efficiency: Dict[str, Any] = field(default_factory=dict)
+    compression_training: Dict[str, Any] = field(default_factory=dict)
+    elasticity: Dict[str, Any] = field(default_factory=dict)
+    autotuning: Dict[str, Any] = field(default_factory=dict)
+    raw: Dict[str, Any] = field(default_factory=dict)
+
+    # -- derived --
+    @property
+    def zero_enabled(self) -> bool:
+        return self.zero_config.stage > 0
+
+    @property
+    def compute_dtype(self) -> str:
+        if self.fp16.enabled:
+            return "float16"
+        if self.bf16.enabled:
+            return "bfloat16"
+        return "float32"
+
+    @property
+    def loss_scale_enabled(self) -> bool:
+        return self.fp16.enabled
+
+    def print_config(self) -> None:
+        logger.info(json.dumps(_dictify(self), indent=2, default=str))
+
+
+def _dictify(cfg: DeepSpeedTPUConfig) -> Dict[str, Any]:
+    out = {}
+    for k, v in cfg.__dict__.items():
+        if k == "raw":
+            continue
+        out[k] = v.to_dict() if isinstance(v, ConfigModel) else v
+    return out
+
+
+_SUBCONFIG_KEYS = {
+    "optimizer": OptimizerConfig,
+    "scheduler": SchedulerConfig,
+    "fp16": FP16Config,
+    "bf16": BF16Config,
+    "bfloat16": BF16Config,  # alias used by the reference
+    "zero_optimization": ZeroConfig,
+    "mesh": MeshConfig,
+    "tensor_parallel": TensorParallelConfig,
+    "pipeline": PipelineConfig,
+    "moe": MoEConfig,
+    "activation_checkpointing": ActivationCheckpointingConfig,
+    "flops_profiler": FlopsProfilerConfig,
+    "comms_logger": CommsLoggerConfig,
+    "tensorboard": MonitorBackendConfig,
+    "wandb": MonitorBackendConfig,
+    "csv_monitor": MonitorBackendConfig,
+    "checkpoint": CheckpointConfig,
+    "aio": AIOConfig,
+}
+
+_ATTR_FOR_KEY = {"zero_optimization": "zero_config", "bfloat16": "bf16"}
+
+_SCALAR_KEYS = [
+    "gradient_clipping", "prescale_gradients", "gradient_predivide_factor",
+    "steps_per_print", "wall_clock_breakdown", "memory_breakdown",
+    "sequence_parallel_size", "seed", "communication_data_type",
+    "gradient_accumulation_dtype",
+]
+
+_DICT_KEYS = ["data_efficiency", "compression_training", "elasticity", "autotuning"]
+
+# keys accepted but intentionally inert on TPU (GPU-runtime specific); kept so
+# reference configs parse cleanly
+_IGNORED_KEYS = {
+    "amp", "zero_allow_untested_optimizer", "zero_force_ds_cpu_optimizer",
+    "dump_state", "sparse_gradients", "checkpoint_tag_validation", "dataloader_drop_last",
+    "use_data_before_expert_parallel_", "hybrid_engine", "data_types", "compile",
+}
+
+
+def parse_config(config: Union[str, Dict[str, Any], None],
+                 world_size: int = 1,
+                 dp_world_size: Optional[int] = None) -> DeepSpeedTPUConfig:
+    """JSON path / dict → :class:`DeepSpeedTPUConfig` with batch math resolved.
+
+    ``dp_world_size`` is the size of the data-parallel axis (batch replication
+    degree); defaults to ``world_size`` (pure DP).
+    """
+    if config is None:
+        config = {}
+    if isinstance(config, str):
+        with open(config) as f:
+            config = json.load(f)
+    if not isinstance(config, dict):
+        raise TypeError(f"config must be a dict or JSON path, got {type(config)}")
+
+    cfg = DeepSpeedTPUConfig(raw=dict(config))
+    for key, value in config.items():
+        if key in _SUBCONFIG_KEYS:
+            attr = _ATTR_FOR_KEY.get(key, key)
+            setattr(cfg, attr, _SUBCONFIG_KEYS[key].from_dict(value))
+        elif key in _SCALAR_KEYS:
+            setattr(cfg, key, value)
+        elif key in _DICT_KEYS:
+            setattr(cfg, key, dict(value))
+        elif key in (C.TRAIN_BATCH_SIZE, C.TRAIN_MICRO_BATCH_SIZE_PER_GPU,
+                     C.GRADIENT_ACCUMULATION_STEPS):
+            setattr(cfg, key, int(value))
+        elif key in _IGNORED_KEYS:
+            logger.debug(f"config key '{key}' accepted but inert on TPU")
+        else:
+            logger.warning(f"Unknown top-level config key '{key}' (ignored)")
+
+    if cfg.fp16.enabled and cfg.bf16.enabled:
+        raise ValueError("fp16 and bf16 cannot both be enabled")
+
+    dp = dp_world_size if dp_world_size is not None else world_size
+    _resolve_batch_size(cfg, dp)
+    return cfg
+
+
+def _resolve_batch_size(cfg: DeepSpeedTPUConfig, dp_world_size: int) -> None:
+    """Reference semantics (``runtime/config.py`` batch assertions):
+    train_batch == micro_batch * gas * dp_world_size; any missing values are
+    derived, all-missing defaults to micro=1, gas=1."""
+    tb, mb, gas = (cfg.train_batch_size, cfg.train_micro_batch_size_per_gpu,
+                   cfg.gradient_accumulation_steps)
+    if tb and mb and gas:
+        if tb != mb * gas * dp_world_size:
+            raise ValueError(
+                f"train_batch_size {tb} != micro_batch {mb} * gas {gas} * dp {dp_world_size}")
+    elif tb and mb:
+        if tb % (mb * dp_world_size) != 0:
+            raise ValueError(f"train_batch_size {tb} not divisible by micro*dp")
+        gas = tb // (mb * dp_world_size)
+    elif tb and gas:
+        if tb % (gas * dp_world_size) != 0:
+            raise ValueError(f"train_batch_size {tb} not divisible by gas*dp")
+        mb = tb // (gas * dp_world_size)
+    elif mb and gas:
+        tb = mb * gas * dp_world_size
+    elif tb:
+        mb = tb // dp_world_size
+        gas = 1
+        if mb * dp_world_size != tb:
+            raise ValueError(f"train_batch_size {tb} not divisible by dp {dp_world_size}")
+    elif mb:
+        gas = 1
+        tb = mb * dp_world_size
+    else:
+        mb, gas = 1, 1
+        tb = dp_world_size
+    cfg.train_batch_size = tb
+    cfg.train_micro_batch_size_per_gpu = mb
+    cfg.gradient_accumulation_steps = gas

@@ -1,0 +1,68 @@
+"""Rank-filtered logging.
+
+Capability parity with the reference's ``deepspeed/utils/logging.py`` (``log_dist``,
+``logger``): rank-0-by-default logging that works in multi-host JAX jobs, where
+"rank" is ``jax.process_index()`` rather than a torch.distributed rank.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+from typing import Iterable, Optional
+
+_LOGGER_NAME = "deepspeed_tpu"
+
+log_levels = {
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warning": logging.WARNING,
+    "error": logging.ERROR,
+    "critical": logging.CRITICAL,
+}
+
+
+def _create_logger(name: str = _LOGGER_NAME, level: int = logging.INFO) -> logging.Logger:
+    lg = logging.getLogger(name)
+    lg.setLevel(level)
+    lg.propagate = False
+    if not lg.handlers:
+        handler = logging.StreamHandler(stream=sys.stdout)
+        handler.setFormatter(
+            logging.Formatter("[%(asctime)s] [%(levelname)s] [%(name)s] %(message)s",
+                              datefmt="%Y-%m-%d %H:%M:%S"))
+        lg.addHandler(handler)
+    env_level = os.environ.get("DSTPU_LOG_LEVEL")
+    if env_level:
+        lg.setLevel(log_levels.get(env_level.lower(), logging.INFO))
+    return lg
+
+
+logger = _create_logger()
+
+
+def _process_index() -> int:
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:  # jax not initialised yet / single process
+        return 0
+
+
+def log_dist(message: str, ranks: Optional[Iterable[int]] = None, level: int = logging.INFO) -> None:
+    """Log ``message`` only on the given process indices (default: rank 0).
+
+    ``ranks=[-1]`` logs on every process.
+    """
+    my_rank = _process_index()
+    ranks = list(ranks) if ranks is not None else [0]
+    if -1 in ranks or my_rank in ranks:
+        logger.log(level, f"[Rank {my_rank}] {message}")
+
+
+def warning_once(message: str, _seen=set()) -> None:  # noqa: B006 - intentional cache
+    if message not in _seen:
+        _seen.add(message)
+        logger.warning(message)

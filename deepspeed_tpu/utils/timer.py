@@ -1,0 +1,161 @@
+"""Wall-clock and throughput timers.
+
+Capability parity with the reference's ``deepspeed/utils/timer.py``
+(``SynchronizedWallClockTimer`` at :44, ``ThroughputTimer`` at :199). On TPU,
+"synchronized" means blocking on the async JAX dispatch queue
+(``jax.block_until_ready`` / ``device.synchronize_all_activity``) instead of CUDA
+events.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from .logging import logger
+
+FORWARD_MICRO_TIMER = "fwd_microstep"
+FORWARD_GLOBAL_TIMER = "fwd"
+BACKWARD_MICRO_TIMER = "bwd_microstep"
+BACKWARD_GLOBAL_TIMER = "bwd"
+STEP_MICRO_TIMER = "step_microstep"
+STEP_GLOBAL_TIMER = "step"
+TRAIN_BATCH_TIMER = "train_batch"
+
+
+def _sync_device() -> None:
+    """Drain the async dispatch queue so host wall-clock brackets device work."""
+    try:
+        import jax
+
+        # effective and cheap: blocks until all in-flight computations finish
+        for d in jax.local_devices():
+            try:
+                d.synchronize_all_activity()
+            except Exception:
+                pass
+    except Exception:
+        pass
+
+
+class _Timer:
+    def __init__(self, name: str):
+        self.name = name
+        self.started = False
+        self._start = 0.0
+        self._elapsed = 0.0  # seconds, accumulated since last reset
+        self._records: List[float] = []
+
+    def start(self, sync: bool = False) -> None:
+        if self.started:
+            return
+        if sync:
+            _sync_device()
+        self._start = time.perf_counter()
+        self.started = True
+
+    def stop(self, sync: bool = True, record: bool = True) -> None:
+        if not self.started:
+            return
+        if sync:
+            _sync_device()
+        dt = time.perf_counter() - self._start
+        self._elapsed += dt
+        if record:
+            self._records.append(dt)
+        self.started = False
+
+    def reset(self) -> None:
+        self.started = False
+        self._elapsed = 0.0
+
+    def elapsed(self, reset: bool = True) -> float:
+        """Elapsed seconds since last reset (stops nothing)."""
+        value = self._elapsed
+        if self.started:
+            value += time.perf_counter() - self._start
+        if reset:
+            self._elapsed = 0.0
+        return value
+
+    def mean(self) -> float:
+        return sum(self._records) / len(self._records) if self._records else 0.0
+
+
+class SynchronizedWallClockTimer:
+    """Named timers synchronized against device completion.
+
+    Mirrors the reference API: ``timers(name).start()/stop()``, ``timers.log(names)``.
+    """
+
+    def __init__(self):
+        self.timers: "OrderedDict[str, _Timer]" = OrderedDict()
+
+    def __call__(self, name: str) -> _Timer:
+        if name not in self.timers:
+            self.timers[name] = _Timer(name)
+        return self.timers[name]
+
+    def has(self, name: str) -> bool:
+        return name in self.timers
+
+    def log(self, names: Optional[List[str]] = None, normalizer: float = 1.0,
+            reset: bool = True, memory_breakdown: bool = False, ranks=None) -> None:
+        names = names if names is not None else list(self.timers)
+        parts = []
+        for name in names:
+            if name in self.timers:
+                ms = self.timers[name].elapsed(reset=reset) * 1000.0 / normalizer
+                parts.append(f"{name}: {ms:.2f}ms")
+        if parts:
+            logger.info("time (ms) | " + " | ".join(parts))
+
+    def get_mean(self, names: List[str], normalizer: float = 1.0) -> Dict[str, float]:
+        return {n: self.timers[n].mean() * 1000.0 / normalizer for n in names if n in self.timers}
+
+
+@dataclass
+class ThroughputTimer:
+    """Samples/sec + TFLOPS tracking (reference ``utils/timer.py:199``)."""
+
+    batch_size: int = 1
+    start_step: int = 2  # skip compile/warmup steps
+    steps_per_output: int = 0
+    monitor_memory: bool = False
+    logging_fn: Optional[object] = None
+
+    total_elapsed: float = 0.0
+    step_count: int = 0
+    _start: float = field(default=0.0, repr=False)
+    _started: bool = field(default=False, repr=False)
+
+    def start(self) -> None:
+        self._start = time.perf_counter()
+        self._started = True
+
+    def stop(self, global_step: bool = True, report_speed: bool = True) -> None:
+        if not self._started:
+            return
+        self._started = False
+        self.step_count += 1
+        if self.step_count > self.start_step:
+            _sync_device()
+            self.total_elapsed += time.perf_counter() - self._start
+            if (report_speed and self.steps_per_output
+                    and self.step_count % self.steps_per_output == 0):
+                logger.info(
+                    f"step={self.step_count}, samples/sec={self.avg_samples_per_sec():.2f}")
+
+    def avg_samples_per_sec(self) -> float:
+        counted = self.step_count - self.start_step
+        if counted <= 0 or self.total_elapsed == 0:
+            return 0.0
+        return counted * self.batch_size / self.total_elapsed
+
+    def avg_step_time(self) -> float:
+        counted = self.step_count - self.start_step
+        if counted <= 0:
+            return 0.0
+        return self.total_elapsed / counted

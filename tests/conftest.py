@@ -1,0 +1,39 @@
+"""Test harness: run everything on an 8-device virtual CPU mesh.
+
+Mirrors the reference's in-process distributed harness idea
+(``tests/unit/common.py DistributedTest``: world_size-N workers on one host, no
+real cluster) — on JAX this is one process with
+``--xla_force_host_platform_device_count=8`` so shardings/collectives compile
+and execute exactly as they would across 8 real chips.
+"""
+
+import os
+
+# Must be set before jax is imported anywhere.
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = _flags + " --xla_force_host_platform_device_count=8"
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+import pytest  # noqa: E402
+
+# The axon sitecustomize sets jax_platforms programmatically, which overrides
+# the env var — force CPU back on for the virtual 8-device test mesh.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_threefry_partitionable", True)
+
+
+@pytest.fixture(autouse=True)
+def _reset_global_mesh():
+    yield
+    from deepspeed_tpu.comm import mesh as mesh_mod
+
+    mesh_mod._global_mesh = None
+
+
+@pytest.fixture
+def devices8():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs

@@ -1,0 +1,104 @@
+"""Mesh + collective tests over the 8-device virtual CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu import comm
+from deepspeed_tpu.comm import MeshManager, init_mesh
+
+
+def test_mesh_creation(devices8):
+    mm = init_mesh({"data": 4, "tensor": 2})
+    assert mm.world_size == 8
+    assert mm.dp_world_size == 4
+    assert mm.tp_world_size == 2
+    assert mm.zero_world_size == 4
+
+
+def test_mesh_bad_sizes(devices8):
+    with pytest.raises(ValueError):
+        MeshManager.create({"data": 3, "tensor": 2})
+
+
+def test_all_reduce_psum(devices8):
+    mm = init_mesh({"data": 8})
+
+    def f(x):
+        return comm.all_reduce(x, "data")
+
+    x = jnp.arange(8.0).reshape(8, 1)
+    out = jax.jit(shard_map(f, mesh=mm.mesh, in_specs=P("data"), out_specs=P("data")))(x)
+    np.testing.assert_allclose(np.asarray(out), np.full((8, 1), 28.0))
+
+
+def test_all_gather_and_reduce_scatter(devices8):
+    mm = init_mesh({"data": 8})
+    x = jnp.arange(16.0).reshape(16, 1)
+
+    def gather(x):
+        return comm.all_gather(x, "data")
+
+    out = jax.jit(shard_map(gather, mesh=mm.mesh, in_specs=P("data"), out_specs=P(),
+                            check_vma=False))(x)
+    np.testing.assert_allclose(np.asarray(out), np.arange(16.0).reshape(16, 1))
+
+    def rs(x):
+        return comm.reduce_scatter(x, "data")
+
+    out2 = jax.jit(shard_map(rs, mesh=mm.mesh, in_specs=P(), out_specs=P("data")))(
+        jnp.ones((16, 1)))
+    np.testing.assert_allclose(np.asarray(out2), np.full((16, 1), 8.0))
+
+
+def test_all_to_all_ulysses_shape(devices8):
+    """The Ulysses primitive: [seq/P, heads] <-> [seq, heads/P]."""
+    mm = init_mesh({"data": 1, "seq": 8})
+    seq, heads, dim = 16, 8, 4
+    x = jnp.arange(seq * heads * dim, dtype=jnp.float32).reshape(seq, heads, dim)
+
+    def a2a(x):  # x: [seq/8, heads, dim] -> [seq, heads/8, dim]
+        return comm.all_to_all(x, "seq", split_axis=1, concat_axis=0)
+
+    out = jax.jit(shard_map(a2a, mesh=mm.mesh, in_specs=P("seq"), out_specs=P(None, "seq")))(x)
+    assert out.shape == (seq, heads, dim)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x))  # pure relayout
+
+
+def test_ring_shift(devices8):
+    mm = init_mesh({"data": 8})
+
+    def f(x):
+        return comm.ring_shift(x, "data", 8, shift=1)
+
+    x = jnp.arange(8.0).reshape(8, 1)
+    out = jax.jit(shard_map(f, mesh=mm.mesh, in_specs=P("data"), out_specs=P("data")))(x)
+    np.testing.assert_allclose(np.asarray(out).ravel(),
+                               np.roll(np.arange(8.0), 1))
+
+
+def test_telemetry_records_traced_ops(devices8):
+    mm = init_mesh({"data": 8})
+    comm.configure(enabled=True)
+    try:
+        def f(x):
+            return comm.all_reduce(x, "data")
+
+        jax.jit(shard_map(f, mesh=mm.mesh, in_specs=P("data"), out_specs=P("data")))(
+            jnp.ones((8, 4)))
+        summary = comm.get_telemetry().summary()
+        assert "all_reduce_sum" in summary
+        assert summary["all_reduce_sum"]["count"] >= 1
+    finally:
+        comm.configure(enabled=False)
+        comm.get_telemetry().reset()
+
+
+def test_batch_sharding_spec(devices8):
+    mm = init_mesh({"data": 2, "expert": 2, "seq": 2, "tensor": 1})
+    assert mm.dp_world_size == 4
+    s = mm.batch_sharding(extra_seq_axis=True)
+    assert s.spec == P(("data", "expert"), "seq")
