@@ -176,7 +176,7 @@ def init_distributed(dist_backend: str = "xla",
     if _initialized:
         return
     env_procs = os.environ.get("DSTPU_NUM_PROCESSES")
-    if coordinator_address is None and env_procs is None:
+    if coordinator_address is None and env_procs is None and num_processes is None:
         _initialized = True  # single-process / TPU-native bootstrap
         log_dist("init_distributed: single-process or TPU-native rendezvous")
         return

@@ -1,0 +1,7 @@
+from .attention import attention
+from .norms import layer_norm, rms_norm
+from .registry import available_backends, get_op, register, set_backend
+from .rotary import apply_rotary, rope_frequencies
+
+__all__ = ["attention", "layer_norm", "rms_norm", "available_backends", "get_op",
+           "register", "set_backend", "apply_rotary", "rope_frequencies"]

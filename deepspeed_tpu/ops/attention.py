@@ -1,0 +1,66 @@
+"""Attention ops.
+
+Reference parity: the fused softmax/attention CUDA kernels in
+``csrc/transformer`` and the flash-attention integrations used by
+``deepspeed/sequence`` / inference v2 ragged attention. Here:
+
+- ``xla`` backend: straightforward softmax attention (fp32 accumulation,
+  causal masking, GQA) — XLA fuses this well at moderate sequence lengths.
+- ``pallas`` backend (``ops/pallas/flash_attention.py``): blockwise
+  flash attention for long sequences, registered lazily on import.
+
+All shapes are [batch, seq, heads, head_dim]; K/V may have fewer heads (GQA) —
+they are broadcast to the query head count.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from .registry import op, register
+
+NEG_INF = -1e30
+
+
+def repeat_kv(k: jnp.ndarray, num_q_heads: int) -> jnp.ndarray:
+    kv_heads = k.shape[-2]
+    if kv_heads == num_q_heads:
+        return k
+    assert num_q_heads % kv_heads == 0
+    return jnp.repeat(k, num_q_heads // kv_heads, axis=-2)
+
+
+@register("attention", backend="xla")
+def attention_xla(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
+                  causal: bool = True, scale: Optional[float] = None,
+                  mask: Optional[jnp.ndarray] = None,
+                  q_offset: int = 0) -> jnp.ndarray:
+    """mask: optional [batch, 1|heads, q_len, kv_len] additive or boolean mask.
+    ``q_offset``: absolute position of q[0] within the kv sequence (decode /
+    chunked long-seq paths)."""
+    q_len, num_heads = q.shape[-3], q.shape[-2]
+    kv_len = k.shape[-3]
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    k = repeat_kv(k, num_heads)
+    v = repeat_kv(v, num_heads)
+    logits = jnp.einsum("...qhd,...khd->...hqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        q_pos = jnp.arange(q_len)[:, None] + q_offset
+        kv_pos = jnp.arange(kv_len)[None, :]
+        causal_mask = q_pos >= kv_pos  # True = attend
+        logits = jnp.where(causal_mask, logits, NEG_INF)
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            logits = jnp.where(mask, logits, NEG_INF)
+        else:
+            logits = logits + mask.astype(jnp.float32)
+    probs = jnp.exp(logits - jnp.max(logits, axis=-1, keepdims=True))
+    probs = probs / jnp.sum(probs, axis=-1, keepdims=True)
+    out = jnp.einsum("...hqk,...khd->...qhd", probs.astype(v.dtype), v)
+    return out.astype(q.dtype)
+
+
+attention = op("attention")

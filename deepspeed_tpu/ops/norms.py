@@ -1,0 +1,45 @@
+"""Normalization ops.
+
+Reference parity: the CUDA layer-norm / rms-norm kernels in
+``csrc/transformer/inference/csrc/{layer_norm,rms_norm}.cu`` (bound via
+``ops/transformer/inference/op_binding/``). On TPU the XLA fusion of these is
+already near-roofline; a Pallas variant exists for the fused
+residual-add+norm pattern (see ``ops/pallas/norms.py``).
+
+All implementations compute in fp32 and cast back to the input dtype —
+matching the reference kernels' accumulation behavior.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import op, register
+
+
+@register("rms_norm", backend="xla")
+def rms_norm_xla(x: jnp.ndarray, weight: jnp.ndarray, eps: float = 1e-6) -> jnp.ndarray:
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    y = xf * jax.lax.rsqrt(var + eps)
+    return (y * weight.astype(jnp.float32)).astype(dtype)
+
+
+@register("layer_norm", backend="xla")
+def layer_norm_xla(x: jnp.ndarray, weight: jnp.ndarray, bias: jnp.ndarray,
+                   eps: float = 1e-5) -> jnp.ndarray:
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    y = (xf - mean) / jnp.sqrt(var + eps)
+    y = y * weight.astype(jnp.float32)
+    if bias is not None:
+        y = y + bias.astype(jnp.float32)
+    return y.astype(dtype)
+
+
+rms_norm = op("rms_norm")
+layer_norm = op("layer_norm")

@@ -1,0 +1,90 @@
+"""Op registry — the TPU analog of the reference's OpBuilder system.
+
+The reference's ``op_builder/builder.py`` (``OpBuilder.load()`` :116,526,545)
+JIT-compiles CUDA/C++ extensions on demand, with per-vendor fallbacks. On TPU
+the same role is: each logical op (attention, rms_norm, rotary, quantize,
+optimizer updates, ...) has one or more *implementations* — a pure-XLA
+reference implementation (always available, differentiable, any backend) and
+optionally a Pallas kernel (TPU) or a C++ XLA custom call. Selection order:
+explicit override > pallas-on-TPU > xla.
+
+Usage::
+
+    @register("rms_norm", backend="xla")
+    def rms_norm_xla(x, weight, eps): ...
+
+    rms_norm = get_op("rms_norm")   # resolved at call site
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Any, Callable, Dict, Optional
+
+import jax
+
+from ..utils.logging import logger
+
+_REGISTRY: Dict[str, Dict[str, Callable]] = {}
+_OVERRIDES: Dict[str, str] = {}
+
+_PREFERENCE = ("native", "pallas", "xla")
+
+
+def register(name: str, backend: str = "xla") -> Callable[[Callable], Callable]:
+    def deco(fn: Callable) -> Callable:
+        _REGISTRY.setdefault(name, {})[backend] = fn
+        return fn
+
+    return deco
+
+
+def set_backend(name: str, backend: Optional[str]) -> None:
+    """Force a specific implementation (None clears the override)."""
+    if backend is None:
+        _OVERRIDES.pop(name, None)
+    else:
+        _OVERRIDES[name] = backend
+
+
+def _platform() -> str:
+    try:
+        return jax.default_backend()
+    except Exception:
+        return "cpu"
+
+
+def available_backends(name: str) -> Dict[str, Callable]:
+    return dict(_REGISTRY.get(name, {}))
+
+
+def get_op(name: str) -> Callable:
+    impls = _REGISTRY.get(name)
+    if not impls:
+        raise KeyError(f"no implementations registered for op '{name}'")
+    override = _OVERRIDES.get(name) or os.environ.get(f"DSTPU_OP_{name.upper()}")
+    if override:
+        if override not in impls:
+            raise KeyError(f"op '{name}' has no '{override}' implementation "
+                           f"(available: {list(impls)})")
+        return impls[override]
+    on_tpu = _platform() == "tpu"
+    for backend in _PREFERENCE:
+        if backend in impls:
+            if backend in ("pallas", "native") and not on_tpu:
+                continue
+            return impls[backend]
+    # fall back to anything (e.g. pallas-in-interpret-mode registered as such)
+    return next(iter(impls.values()))
+
+
+def op(name: str) -> Callable:
+    """Late-binding callable: resolves the implementation at each call."""
+
+    @functools.wraps(get_op)
+    def dispatch(*args, **kwargs):
+        return get_op(name)(*args, **kwargs)
+
+    dispatch.__name__ = name
+    return dispatch

@@ -1,0 +1,45 @@
+"""Rotary position embedding.
+
+Reference parity: ``csrc/transformer/inference/csrc/apply_rotary_pos_emb.cu``
+(bound through ``ops/transformer/inference/op_binding/rotary``). Pure-XLA here;
+the elementwise rotation fuses into the surrounding matmuls on TPU.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import op, register
+
+
+def rope_frequencies(head_dim: int, max_len: int, theta: float = 10000.0,
+                     dtype=jnp.float32):
+    """Precompute cos/sin tables [max_len, head_dim/2]."""
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    t = jnp.arange(max_len, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv_freq)
+    return jnp.cos(freqs).astype(dtype), jnp.sin(freqs).astype(dtype)
+
+
+@register("rotary_embed", backend="xla")
+def apply_rotary_xla(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray,
+                     positions: jnp.ndarray = None) -> jnp.ndarray:
+    """x: [..., seq, heads, head_dim]; cos/sin: [max_len, head_dim/2];
+    positions: [..., seq] integer positions (defaults to arange)."""
+    seq = x.shape[-3]
+    if positions is None:
+        c = cos[:seq]
+        s = sin[:seq]
+        # broadcast over leading batch dims and the heads dim
+        c = c[:, None, :]
+        s = s[:, None, :]
+    else:
+        c = cos[positions][..., :, None, :]
+        s = sin[positions][..., :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out1 = x1 * c - x2 * s
+    out2 = x2 * c + x1 * s
+    return jnp.concatenate([out1, out2], axis=-1).astype(x.dtype)
+
+
+apply_rotary = op("rotary_embed")

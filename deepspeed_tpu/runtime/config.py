@@ -19,7 +19,7 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Union
 
 from ..utils.logging import logger
-from .config_utils import ConfigModel, register_config_model
+from .config_utils import ConfigModel, is_auto, register_config_model
 from . import constants as C
 
 
@@ -359,7 +359,8 @@ _IGNORED_KEYS = {
 
 def parse_config(config: Union[str, Dict[str, Any], None],
                  world_size: int = 1,
-                 dp_world_size: Optional[int] = None) -> DeepSpeedTPUConfig:
+                 dp_world_size: Optional[int] = None,
+                 resolve_batch: bool = True) -> DeepSpeedTPUConfig:
     """JSON path / dict → :class:`DeepSpeedTPUConfig` with batch math resolved.
 
     ``dp_world_size`` is the size of the data-parallel axis (batch replication
@@ -384,7 +385,9 @@ def parse_config(config: Union[str, Dict[str, Any], None],
             setattr(cfg, key, dict(value))
         elif key in (C.TRAIN_BATCH_SIZE, C.TRAIN_MICRO_BATCH_SIZE_PER_GPU,
                      C.GRADIENT_ACCUMULATION_STEPS):
-            setattr(cfg, key, int(value))
+            # reference configs may carry the "auto" sentinel (resolved by
+            # integrations like HF) — treat as unset here
+            setattr(cfg, key, 0 if is_auto(value) else int(value))
         elif key in _IGNORED_KEYS:
             logger.debug(f"config key '{key}' accepted but inert on TPU")
         else:
@@ -394,7 +397,8 @@ def parse_config(config: Union[str, Dict[str, Any], None],
         raise ValueError("fp16 and bf16 cannot both be enabled")
 
     dp = dp_world_size if dp_world_size is not None else world_size
-    _resolve_batch_size(cfg, dp)
+    if resolve_batch:
+        _resolve_batch_size(cfg, dp)
     return cfg
 
 

@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import dataclasses
 from dataclasses import dataclass, fields
-from typing import Any, Dict, Optional, Type, TypeVar
+from typing import Any, ClassVar, Dict, Optional, Type, TypeVar
 
 from ..utils.logging import logger
 
@@ -28,20 +28,20 @@ class ConfigModel:
     """Base class: construct from a dict, tolerating unknown keys (warn) and
     recursively constructing nested ConfigModel fields.
 
-    Subclasses may define ``_deprecated = {"old_key": "new_key"}`` for key migration.
+    Subclasses may define a class attribute ``_DEPRECATED = {"old_key":
+    "new_key"}`` for key migration.
     """
 
-    _deprecated: Dict[str, str] = dataclasses.field(default_factory=dict, repr=False)
+    _DEPRECATED: ClassVar[Dict[str, str]] = {}
 
     @classmethod
     def from_dict(cls: Type[T], d: Optional[Dict[str, Any]]) -> T:
         d = dict(d or {})
-        deprecated = getattr(cls, "_DEPRECATED", {})
-        for old, new in deprecated.items():
+        for old, new in cls._DEPRECATED.items():
             if old in d:
                 logger.warning(f"Config key '{old}' is deprecated; use '{new}'")
                 d.setdefault(new, d.pop(old))
-        known = {f.name: f for f in fields(cls) if f.name != "_deprecated"}
+        known = {f.name: f for f in fields(cls)}
         kwargs = {}
         for key, value in d.items():
             if key not in known:
@@ -57,8 +57,6 @@ class ConfigModel:
     def to_dict(self) -> Dict[str, Any]:
         out = {}
         for f in fields(self):
-            if f.name == "_deprecated":
-                continue
             v = getattr(self, f.name)
             out[f.name] = v.to_dict() if isinstance(v, ConfigModel) else v
         return out

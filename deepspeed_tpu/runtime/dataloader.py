@@ -1,0 +1,82 @@
+"""Data loading — reference parity with ``runtime/dataloader.py``
+(``DeepSpeedDataLoader``: DistributedSampler + curriculum hooks).
+
+On TPU under SPMD, every process feeds *global* batches (each host supplies its
+addressable shard); for the single-controller case this loader batches a
+dataset/iterable and leaves device placement to the engine's batch sharding.
+Curriculum/data-efficiency integration plugs in via ``batch_transform``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, Iterator, Optional
+
+import numpy as np
+
+
+class DeepSpeedTPUDataLoader:
+    def __init__(self, dataset: Iterable, batch_size: int,
+                 mesh_mgr=None, shuffle: bool = True, seed: int = 0,
+                 drop_last: bool = True,
+                 collate_fn: Optional[Callable] = None,
+                 batch_transform: Optional[Callable] = None):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.mesh_mgr = mesh_mgr
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.collate_fn = collate_fn or _default_collate
+        self.batch_transform = batch_transform
+        self._epoch = 0
+
+    def __len__(self) -> int:
+        try:
+            n = len(self.dataset)  # type: ignore[arg-type]
+        except TypeError:
+            raise TypeError("dataset has no __len__; iterate directly")
+        return n // self.batch_size if self.drop_last else -(-n // self.batch_size)
+
+    def set_epoch(self, epoch: int) -> None:
+        self._epoch = epoch
+
+    def __iter__(self) -> Iterator[Any]:
+        try:
+            n = len(self.dataset)  # type: ignore[arg-type]
+            indexable = True
+        except TypeError:
+            indexable = False
+
+        if indexable:
+            order = np.arange(n)
+            if self.shuffle:
+                rng = np.random.default_rng(self.seed + self._epoch)
+                rng.shuffle(order)
+            for start in range(0, n - self.batch_size + 1 if self.drop_last else n,
+                               self.batch_size):
+                idx = order[start:start + self.batch_size]
+                items = [self.dataset[int(i)] for i in idx]
+                batch = self.collate_fn(items)
+                if self.batch_transform:
+                    batch = self.batch_transform(batch)
+                yield batch
+        else:
+            buf = []
+            for item in self.dataset:
+                buf.append(item)
+                if len(buf) == self.batch_size:
+                    batch = self.collate_fn(buf)
+                    if self.batch_transform:
+                        batch = self.batch_transform(batch)
+                    yield batch
+                    buf = []
+            if buf and not self.drop_last:
+                yield self.collate_fn(buf)
+
+
+def _default_collate(items):
+    """Stack dict-of-arrays or arrays along a new leading batch dim."""
+    first = items[0]
+    if isinstance(first, dict):
+        return {k: np.stack([np.asarray(it[k]) for it in items]) for k in first}
+    return np.stack([np.asarray(it) for it in items])

@@ -1,0 +1,503 @@
+"""Training engine: config → sharded, jit-compiled train step.
+
+Capability parity with the reference's ``DeepSpeedEngine``
+(``runtime/engine.py:208``) and ``deepspeed.initialize``
+(``deepspeed/__init__.py:80``) — redesigned TPU-first:
+
+- the reference orchestrates forward/backward/step at Python runtime with
+  hooks, bucketed allreduce streams and loss-scale bookkeeping; here the whole
+  micro-step loop (GAS accumulation, loss scaling, overflow skip, grad
+  clipping, optimizer update, LR schedule) is ONE jit-compiled function with
+  donated buffers — XLA overlaps the ZeRO collectives it implies with compute;
+- ZeRO stages are sharding specs from ``runtime/partitioning.py`` — no
+  partitioning code in the hot path at all;
+- ``forward()/backward()/step()`` are provided as API-parity shims over the
+  compiled step (they stage micro-batches and execute at the GAS boundary).
+
+The engine still owns the runtime-side concerns that XLA cannot: dataloading,
+checkpoint save/load, monitoring, timers, elasticity.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Iterable, List, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..comm import comm as dist
+from ..comm.mesh import BATCH_AXES, MeshManager, init_mesh
+from ..ops.optimizers import Optimizer, get_optimizer
+from ..utils.logging import log_dist, logger
+from ..utils.timer import ThroughputTimer
+from .config import DeepSpeedTPUConfig, parse_config
+from .lr_schedules import LRScheduler, Schedule, constant, get_schedule
+from .partitioning import Partitioner, shapes_of
+from .precision import (LossScaleState, PrecisionPolicy, grads_finite,
+                        make_loss_scaler, scale_loss, unscale_grads,
+                        update_loss_scale)
+
+
+# --------------------------------------------------------------------------- #
+# model description — what the engine needs from a user model
+# --------------------------------------------------------------------------- #
+@dataclass
+class ModelSpec:
+    """The JAX-native counterpart of passing an ``nn.Module`` to
+    ``deepspeed.initialize``: a pure loss function over a param pytree, plus
+    optional init / logical-sharding metadata."""
+
+    loss_fn: Callable[..., Tuple[jnp.ndarray, Dict[str, Any]]]
+    init_fn: Optional[Callable[[jax.Array], Any]] = None
+    params: Optional[Any] = None
+    logical_axes: Optional[Any] = None
+    apply_fn: Optional[Callable[..., Any]] = None
+    name: str = "model"
+
+    def materialize(self, rng: jax.Array):
+        if self.params is not None:
+            return self.params
+        if self.init_fn is None:
+            raise ValueError("ModelSpec needs params or init_fn")
+        return self.init_fn(rng)
+
+
+class TrainState(NamedTuple):
+    """The full jit-carried state (a pytree)."""
+
+    step: jnp.ndarray
+    params: Any            # fp32 master params
+    opt_state: Any
+    loss_scale: LossScaleState
+    skipped_steps: jnp.ndarray
+
+
+class StepOutput(NamedTuple):
+    loss: jnp.ndarray
+    grad_norm: jnp.ndarray
+    lr: jnp.ndarray
+    loss_scale: jnp.ndarray
+    overflow: jnp.ndarray
+    aux: Dict[str, Any]
+
+
+def _global_norm(tree) -> jnp.ndarray:
+    leaves = [jnp.sum(jnp.square(g.astype(jnp.float32))) for g in jax.tree.leaves(tree)]
+    return jnp.sqrt(jnp.sum(jnp.stack(leaves)))
+
+
+class DeepSpeedTPUEngine:
+    """See module docstring. Construct via :func:`initialize`."""
+
+    def __init__(self, model: ModelSpec, config: DeepSpeedTPUConfig,
+                 mesh_mgr: MeshManager, optimizer: Optional[Optimizer] = None,
+                 lr_schedule: Optional[Schedule] = None,
+                 training_data: Optional[Iterable] = None,
+                 rng: Optional[jax.Array] = None):
+        self.model = model
+        self.config = config
+        self.mesh_mgr = mesh_mgr
+        self.global_steps = 0
+        self.skipped_steps = 0
+        self.micro_steps = 0
+        self._staged_batches: List[Any] = []
+        self._staged_loss: Optional[jnp.ndarray] = None
+        self.training_dataloader = None
+
+        # --- precision ---
+        self.precision = PrecisionPolicy.from_config(config)
+
+        # --- optimizer + schedule (reference _configure_optimizer :1597) ---
+        if optimizer is None:
+            opt_params = dict(config.optimizer.params)
+            optimizer = get_optimizer(config.optimizer.type or "adamw", **opt_params)
+        self.optimizer = optimizer
+        self.base_lr = float(optimizer.hyperparams.get("lr", 1.0)) or 1.0
+        if lr_schedule is None:
+            lr_schedule = get_schedule(config.scheduler.type, config.scheduler.params,
+                                       base_lr=self.base_lr)
+        self.lr_schedule = lr_schedule
+        self.lr_scheduler = LRScheduler(lr_schedule)
+
+        # --- params + sharding ---
+        rng = rng if rng is not None else jax.random.PRNGKey(config.seed)
+        params = model.materialize(rng)
+        params = jax.tree.map(
+            lambda p: p.astype(self.precision.param_dtype)
+            if jnp.issubdtype(p.dtype, jnp.floating) else p, params)
+
+        self.partitioner = Partitioner(
+            mesh_mgr, zero_stage=config.zero_config.stage,
+            tensor_parallel=mesh_mgr.tp_world_size > 1)
+        shapes = shapes_of(params)
+        if model.logical_axes is not None:
+            param_specs = self.partitioner.param_specs(model.logical_axes, shapes)
+            opt_specs = self.partitioner.opt_state_specs(model.logical_axes, shapes)
+        else:
+            # no metadata: replicate params (ZeRO still shards opt state over
+            # the largest divisible dim of each leaf)
+            generic_axes = jax.tree.map(lambda s: tuple([None] * len(s)), shapes,
+                                        is_leaf=lambda x: isinstance(x, tuple))
+            param_specs = self.partitioner.param_specs(generic_axes, shapes)
+            opt_specs = self.partitioner.opt_state_specs(generic_axes, shapes)
+        self.param_specs = param_specs
+        self.opt_param_specs = opt_specs
+
+        with mesh_mgr.activate():
+            params = jax.jit(
+                lambda p: p,
+                out_shardings=self.partitioner.shardings(param_specs))(params)
+            opt_state = self._init_opt_state(params)
+        loss_scale = make_loss_scaler(config.fp16)
+        self.state = TrainState(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            opt_state=opt_state,
+            loss_scale=loss_scale,
+            skipped_steps=jnp.zeros((), jnp.int32),
+        )
+
+        # --- compiled steps ---
+        self._train_step = None
+        self._grad_step = None
+        self._apply_step = None
+
+        # --- dataloader ---
+        if training_data is not None:
+            self.training_dataloader = self.deepspeed_io(training_data)
+
+        self.tput_timer = ThroughputTimer(
+            batch_size=self.train_batch_size(),
+            steps_per_output=config.steps_per_print)
+        log_dist(
+            f"engine ready: zero_stage={config.zero_config.stage} "
+            f"dtype={config.compute_dtype} mesh={dict(mesh_mgr.mesh.shape)} "
+            f"micro_batch={self.train_micro_batch_size_per_gpu()} "
+            f"gas={self.gradient_accumulation_steps()}")
+
+    # ------------------------------------------------------------------ #
+    # reference property accessors (engine.py:770-1252 parity, abridged)
+    # ------------------------------------------------------------------ #
+    def train_batch_size(self) -> int:
+        return self.config.train_batch_size
+
+    def train_micro_batch_size_per_gpu(self) -> int:
+        return self.config.train_micro_batch_size_per_gpu
+
+    def gradient_accumulation_steps(self) -> int:
+        return self.config.gradient_accumulation_steps
+
+    def zero_optimization_stage(self) -> int:
+        return self.config.zero_config.stage
+
+    def get_lr(self) -> List[float]:
+        return [float(self.lr_schedule(jnp.asarray(self.global_steps, jnp.float32)))]
+
+    def get_global_grad_norm(self) -> float:
+        return getattr(self, "_last_grad_norm", 0.0)
+
+    @property
+    def loss_scale(self) -> float:
+        return float(self.state.loss_scale.scale)
+
+    # ------------------------------------------------------------------ #
+    # opt state init (sharded)
+    # ------------------------------------------------------------------ #
+    def _init_opt_state(self, params):
+        opt_shapes = jax.eval_shape(self.optimizer.init, params)
+        # optimizer state leaves mirror param structure inside (mu/nu/...).
+        # We shard any leaf whose shape matches a param leaf's shape with that
+        # param's opt-state spec; scalars stay replicated.
+        param_leaves = jax.tree.leaves(params)
+        spec_leaves = jax.tree.leaves(self.opt_param_specs,
+                                      is_leaf=lambda x: isinstance(x, P))
+        shape_to_spec = {}
+        for pl, sp in zip(param_leaves, spec_leaves):
+            shape_to_spec.setdefault(tuple(pl.shape), sp)
+
+        def leaf_spec(l):
+            return shape_to_spec.get(tuple(l.shape), P())
+
+        opt_specs = jax.tree.map(leaf_spec, opt_shapes)
+        self.opt_state_specs = opt_specs
+        shardings = jax.tree.map(lambda s: NamedSharding(self.mesh_mgr.mesh, s), opt_specs,
+                                 is_leaf=lambda x: isinstance(x, P))
+        return jax.jit(self.optimizer.init, out_shardings=shardings)(params)
+
+    # ------------------------------------------------------------------ #
+    # the compiled train step
+    # ------------------------------------------------------------------ #
+    def _loss(self, params, batch):
+        compute_params = self.precision.cast_to_compute(params)
+        out = self.model.loss_fn(compute_params, batch)
+        if isinstance(out, tuple):
+            loss, aux = out
+        else:
+            loss, aux = out, {}
+        return loss.astype(jnp.float32), aux
+
+    def _grads_one_micro(self, params, batch, loss_scale):
+        def scaled_loss(p):
+            loss, aux = self._loss(p, batch)
+            return scale_loss(loss, loss_scale), (loss, aux)
+
+        grads, (loss, aux) = jax.grad(scaled_loss, has_aux=True)(params)
+        return grads, loss, aux
+
+    def _accumulate(self, params, batch, loss_scale):
+        """GAS micro-batch loop under lax.scan; batch leading dim = gas."""
+        gas = self.gradient_accumulation_steps()
+        if gas == 1:
+            grads, loss, aux = self._grads_one_micro(params, batch, loss_scale)
+            return grads, loss, aux
+
+        def body(carry, micro):
+            acc = carry
+            grads, loss, aux = self._grads_one_micro(params, micro, loss_scale)
+            acc = jax.tree.map(lambda a, g: a + g.astype(jnp.float32), acc, grads)
+            return acc, loss
+
+        zeros = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
+        acc, losses = jax.lax.scan(body, zeros, batch)
+        grads = jax.tree.map(lambda g: g / gas, acc)
+        return grads, jnp.mean(losses), {}
+
+    def _apply_update(self, state: TrainState, grads, loss) -> Tuple[TrainState, StepOutput]:
+        cfg = self.config
+        finite = grads_finite(grads)
+        grads = unscale_grads(grads, state.loss_scale)
+
+        grad_norm = _global_norm(grads)
+        if cfg.gradient_clipping and cfg.gradient_clipping > 0:
+            clip_coef = jnp.minimum(1.0, cfg.gradient_clipping / (grad_norm + 1e-6))
+            grads = jax.tree.map(lambda g: g * clip_coef, grads)
+
+        lr_t = self.lr_schedule(state.step.astype(jnp.float32))
+        lr_scale = lr_t / self.base_lr
+
+        new_params, new_opt = self.optimizer.update(state.params, grads,
+                                                    state.opt_state, lr_scale=lr_scale)
+        # overflow → skip update (reference: FP16 optimizer skip + scale cut)
+        new_params = jax.tree.map(
+            lambda n, o: jnp.where(finite, n, o), new_params, state.params)
+        new_opt = jax.tree.map(
+            lambda n, o: jnp.where(finite, n, o) if n.shape == o.shape else n,
+            new_opt, state.opt_state)
+        new_scale = update_loss_scale(state.loss_scale, finite)
+        new_state = TrainState(
+            step=state.step + jnp.where(finite, 1, 0).astype(jnp.int32),
+            params=new_params,
+            opt_state=new_opt,
+            loss_scale=new_scale,
+            skipped_steps=state.skipped_steps + jnp.where(finite, 0, 1).astype(jnp.int32),
+        )
+        out = StepOutput(loss=loss, grad_norm=grad_norm, lr=lr_t,
+                         loss_scale=new_scale.scale,
+                         overflow=jnp.logical_not(finite), aux={})
+        return new_state, out
+
+    def _build_train_step(self):
+        def step_fn(state: TrainState, batch):
+            grads, loss, _aux = self._accumulate(state.params, batch, state.loss_scale)
+            return self._apply_update(state, grads, loss)
+
+        with self.mesh_mgr.activate():
+            self._train_step = jax.jit(step_fn, donate_argnums=(0,))
+        return self._train_step
+
+    # ------------------------------------------------------------------ #
+    # public API — train_batch (PipelineEngine.train_batch parity)
+    # ------------------------------------------------------------------ #
+    def _shard_batch(self, batch, with_gas_dim: bool):
+        """Reshape global batch [B, ...] → [gas, micro, ...] and place with
+        batch sharding over (data, expert) [+ seq on dim 2 when SP active]."""
+        gas = self.gradient_accumulation_steps()
+        sp = self.mesh_mgr.sp_world_size
+
+        def reshape(x):
+            x = jnp.asarray(x)
+            if with_gas_dim and gas > 1:
+                b = x.shape[0]
+                if b % gas != 0:
+                    raise ValueError(f"batch dim {b} not divisible by gas={gas}")
+                x = x.reshape((gas, b // gas) + x.shape[1:])
+            return x
+
+        batch = jax.tree.map(reshape, batch)
+
+        def spec_for(x):
+            batch_dim_index = 1 if (with_gas_dim and gas > 1) else 0
+            entries = [None] * x.ndim
+            if x.ndim > batch_dim_index:
+                entries[batch_dim_index] = BATCH_AXES
+            seq_dim = batch_dim_index + 1
+            # shard the sequence dim for Ulysses SP only when it divides evenly
+            # (token arrays often carry a +1 label column)
+            if sp > 1 and x.ndim > seq_dim and x.shape[seq_dim] % sp == 0:
+                entries[seq_dim] = "seq"
+            return NamedSharding(self.mesh_mgr.mesh, P(*entries))
+
+        return jax.tree.map(lambda x: jax.device_put(x, spec_for(x)), batch)
+
+    def train_batch(self, batch) -> StepOutput:
+        """One full optimizer step from one global batch (all GAS micro-batches
+        stacked in the leading dim)."""
+        if self._train_step is None:
+            self._build_train_step()
+        self.tput_timer.start()
+        batch = self._shard_batch(batch, with_gas_dim=True)
+        self.state, out = self._train_step(self.state, batch)
+        self.global_steps += 1
+        self._last_grad_norm = out.grad_norm
+        self.lr_scheduler.last_step = self.global_steps
+        self.tput_timer.stop()
+        if self.config.steps_per_print and \
+                self.global_steps % self.config.steps_per_print == 0:
+            log_dist(f"step={self.global_steps} loss={float(out.loss):.4f} "
+                     f"lr={float(out.lr):.3e} gnorm={float(out.grad_norm):.3f} "
+                     f"scale={float(out.loss_scale):.0f}")
+        return out
+
+    # ------------------------------------------------------------------ #
+    # forward/backward/step shims (DeepSpeedEngine API parity)
+    # ------------------------------------------------------------------ #
+    def forward(self, batch):
+        """Compute loss for one micro-batch (staging it for backward)."""
+        if self._grad_step is None:
+            with self.mesh_mgr.activate():
+                self._grad_step = jax.jit(
+                    lambda params, b, ls: self._grads_one_micro(params, b, ls))
+        self._staged_batches.append(self._shard_batch(batch, with_gas_dim=False))
+        grads, loss, aux = self._grad_step(self.state.params,
+                                           self._staged_batches[-1],
+                                           self.state.loss_scale)
+        self._last_micro = (grads, loss)
+        return loss
+
+    def backward(self, loss=None):
+        """Accumulate the staged micro-batch's grads (already computed in
+        forward — JAX computes loss+grads together)."""
+        grads, loss_val = self._last_micro
+        if getattr(self, "_pending_grads", None) is None:
+            self._pending_grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
+            self._pending_loss = loss_val
+            self._pending_count = 1
+        else:
+            self._pending_grads = jax.tree.map(
+                lambda a, g: a + g.astype(jnp.float32), self._pending_grads, grads)
+            self._pending_loss = self._pending_loss + loss_val
+            self._pending_count += 1
+        self.micro_steps += 1
+        return loss_val
+
+    def is_gradient_accumulation_boundary(self) -> bool:
+        return getattr(self, "_pending_count", 0) >= self.gradient_accumulation_steps()
+
+    def step(self):
+        """Apply the optimizer step at the GAS boundary (no-op otherwise,
+        matching reference semantics)."""
+        if not self.is_gradient_accumulation_boundary():
+            return None
+        if self._apply_step is None:
+            with self.mesh_mgr.activate():
+                self._apply_step = jax.jit(
+                    lambda state, grads, loss: self._apply_update(state, grads, loss),
+                    donate_argnums=(0,))
+        n = self._pending_count
+        grads = jax.tree.map(lambda g: g / n, self._pending_grads)
+        loss = self._pending_loss / n
+        self.state, out = self._apply_step(self.state, grads, loss)
+        self._pending_grads = None
+        self._pending_loss = None
+        self._pending_count = 0
+        self._staged_batches.clear()
+        self.global_steps += 1
+        self._last_grad_norm = out.grad_norm
+        return out
+
+    # ------------------------------------------------------------------ #
+    # eval / inference forward
+    # ------------------------------------------------------------------ #
+    def eval_batch(self, batch):
+        if not hasattr(self, "_eval_step") or self._eval_step is None:
+            with self.mesh_mgr.activate():
+                self._eval_step = jax.jit(lambda p, b: self._loss(p, b)[0])
+        batch = self._shard_batch(batch, with_gas_dim=False)
+        return self._eval_step(self.state.params, batch)
+
+    def __call__(self, batch):
+        return self.forward(batch)
+
+    # ------------------------------------------------------------------ #
+    # dataloader (deepspeed_io parity, runtime/engine.py:2147)
+    # ------------------------------------------------------------------ #
+    def deepspeed_io(self, dataset, batch_size: Optional[int] = None):
+        from .dataloader import DeepSpeedTPUDataLoader
+
+        return DeepSpeedTPUDataLoader(
+            dataset,
+            batch_size=batch_size or self.train_batch_size(),
+            mesh_mgr=self.mesh_mgr)
+
+    # ------------------------------------------------------------------ #
+    # checkpointing (full impl in runtime/checkpoint/)
+    # ------------------------------------------------------------------ #
+    def save_checkpoint(self, save_dir: str, tag: Optional[str] = None,
+                        client_state: Optional[dict] = None, **kw):
+        from .checkpoint.saver import save_checkpoint as _save
+
+        return _save(self, save_dir, tag=tag, client_state=client_state or {})
+
+    def load_checkpoint(self, load_dir: str, tag: Optional[str] = None, **kw):
+        from .checkpoint.saver import load_checkpoint as _load
+
+        return _load(self, load_dir, tag=tag)
+
+
+# --------------------------------------------------------------------------- #
+# initialize() — reference deepspeed/__init__.py:80
+# --------------------------------------------------------------------------- #
+def initialize(args=None, model: Optional[ModelSpec] = None, optimizer=None,
+               model_parameters=None, training_data=None, lr_scheduler=None,
+               config=None, config_params=None, mesh_mgr: Optional[MeshManager] = None,
+               rng: Optional[jax.Array] = None, dist_init_required: bool = True,
+               **kwargs):
+    """Returns ``(engine, optimizer, training_dataloader, lr_scheduler)`` —
+    the reference's 4-tuple."""
+    if config is None:
+        config = config_params
+    if config is None and args is not None:
+        config = getattr(args, "deepspeed_config", None)
+    if model is None:
+        raise ValueError("model (ModelSpec) is required")
+
+    if dist_init_required:
+        dist.init_distributed()
+
+    n_devices = len(jax.devices())
+    # resolve mesh first so batch math can use the true dp size
+    pre = parse_config(config, world_size=n_devices, resolve_batch=False)
+    axis_sizes = pre.mesh.axis_sizes(n_devices) if pre.raw.get("mesh") else None
+    if axis_sizes is None:
+        sizes = {"tensor": pre.tensor_parallel.autotp_size or 1,
+                 "pipe": pre.pipeline.stages or 1,
+                 "seq": pre.sequence_parallel_size or 1,
+                 "expert": pre.moe.expert_parallel_size or 1}
+        fixed = int(np.prod(list(sizes.values())))
+        if n_devices % fixed != 0:
+            raise ValueError(f"device count {n_devices} not divisible by {sizes}")
+        sizes["data"] = n_devices // fixed
+        axis_sizes = sizes
+    if mesh_mgr is None:
+        mesh_mgr = init_mesh(axis_sizes)
+    dp = int(axis_sizes.get("data", 1)) * int(axis_sizes.get("expert", 1))
+    cfg = parse_config(config, world_size=n_devices, dp_world_size=dp)
+
+    engine = DeepSpeedTPUEngine(model=model, config=cfg, mesh_mgr=mesh_mgr,
+                                optimizer=optimizer, lr_schedule=lr_scheduler,
+                                training_data=training_data, rng=rng)
+    return engine, engine.optimizer, engine.training_dataloader, engine.lr_scheduler

@@ -1,0 +1,169 @@
+"""LR schedules — reference parity with ``runtime/lr_schedules.py``
+(LRRangeTest, OneCycle, WarmupLR, WarmupDecayLR, WarmupCosineLR, :19-24).
+
+TPU-first shape: a schedule is a pure function ``step -> lr_scale`` (traced
+inside the jit step), wrapped in a small object exposing the reference's
+``step()/get_lr()`` interface for API compatibility. The schedule returns the
+absolute LR; the optimizer's base ``lr`` is multiplied by
+``lr / base_lr`` internally via ``lr_scale``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict, Optional
+
+import jax.numpy as jnp
+
+Schedule = Callable[[jnp.ndarray], jnp.ndarray]
+
+LR_RANGE_TEST = "LRRangeTest"
+ONE_CYCLE = "OneCycle"
+WARMUP_LR = "WarmupLR"
+WARMUP_DECAY_LR = "WarmupDecayLR"
+WARMUP_COSINE_LR = "WarmupCosineLR"
+
+
+def _warmup(step, warmup_num_steps, warmup_type="log"):
+    step = jnp.asarray(step, jnp.float32)
+    w = max(int(warmup_num_steps), 1)
+    frac = jnp.clip(step / w, 0.0, 1.0)
+    if warmup_type == "log":
+        # reference WarmupLR: log-spaced interpolation min→max
+        return jnp.where(step >= w, 1.0, jnp.log1p(step) / math.log1p(w))
+    return frac
+
+
+def warmup_lr(warmup_min_lr: float = 0.0, warmup_max_lr: float = 1e-3,
+              warmup_num_steps: int = 1000, warmup_type: str = "log") -> Schedule:
+    def sched(step):
+        f = _warmup(step, warmup_num_steps, warmup_type)
+        return warmup_min_lr + f * (warmup_max_lr - warmup_min_lr)
+
+    return sched
+
+
+def warmup_decay_lr(total_num_steps: int, warmup_min_lr: float = 0.0,
+                    warmup_max_lr: float = 1e-3, warmup_num_steps: int = 1000,
+                    warmup_type: str = "log") -> Schedule:
+    base = warmup_lr(warmup_min_lr, warmup_max_lr, warmup_num_steps, warmup_type)
+
+    def sched(step):
+        step = jnp.asarray(step, jnp.float32)
+        decay = jnp.clip(
+            (total_num_steps - step) / jnp.maximum(total_num_steps - warmup_num_steps, 1),
+            0.0, 1.0)
+        return jnp.where(step < warmup_num_steps, base(step), warmup_max_lr * decay)
+
+    return sched
+
+
+def warmup_cosine_lr(total_num_steps: int, warmup_num_steps: int = 1000,
+                     warmup_min_ratio: float = 0.0, cos_min_ratio: float = 0.0001,
+                     warmup_max_lr: float = 1e-3, **_) -> Schedule:
+    def sched(step):
+        step = jnp.asarray(step, jnp.float32)
+        wfrac = jnp.clip(step / jnp.maximum(warmup_num_steps, 1), 0.0, 1.0)
+        warm = warmup_min_ratio + wfrac * (1 - warmup_min_ratio)
+        progress = jnp.clip((step - warmup_num_steps)
+                            / jnp.maximum(total_num_steps - warmup_num_steps, 1), 0.0, 1.0)
+        cos = cos_min_ratio + (1 - cos_min_ratio) * 0.5 * (1 + jnp.cos(math.pi * progress))
+        ratio = jnp.where(step < warmup_num_steps, warm, cos)
+        return warmup_max_lr * ratio
+
+    return sched
+
+
+def one_cycle(cycle_min_lr: float, cycle_max_lr: float,
+              cycle_first_step_size: int = 2000,
+              cycle_second_step_size: Optional[int] = None,
+              decay_step_size: int = 0, decay_lr_rate: float = 0.0, **_) -> Schedule:
+    second = cycle_second_step_size if cycle_second_step_size is not None \
+        else cycle_first_step_size
+
+    def sched(step):
+        step = jnp.asarray(step, jnp.float32)
+        total_cycle = cycle_first_step_size + second
+        up = jnp.clip(step / cycle_first_step_size, 0.0, 1.0)
+        down = jnp.clip((step - cycle_first_step_size) / jnp.maximum(second, 1), 0.0, 1.0)
+        in_cycle = cycle_min_lr + (cycle_max_lr - cycle_min_lr) * jnp.where(
+            step <= cycle_first_step_size, up, 1.0 - down)
+        if decay_step_size > 0:
+            decay_steps = jnp.maximum(step - total_cycle, 0.0) / decay_step_size
+            decayed = cycle_min_lr / (1.0 + decay_lr_rate * decay_steps)
+            return jnp.where(step > total_cycle, decayed, in_cycle)
+        return jnp.where(step > total_cycle, cycle_min_lr, in_cycle)
+
+    return sched
+
+
+def lr_range_test(lr_range_test_min_lr: float = 1e-3,
+                  lr_range_test_step_size: int = 2000,
+                  lr_range_test_step_rate: float = 1.0,
+                  lr_range_test_staircase: bool = False, **_) -> Schedule:
+    def sched(step):
+        step = jnp.asarray(step, jnp.float32)
+        interval = step / lr_range_test_step_size
+        if lr_range_test_staircase:
+            interval = jnp.floor(interval)
+        return lr_range_test_min_lr * (1.0 + interval * lr_range_test_step_rate)
+
+    return sched
+
+
+def constant(lr: float) -> Schedule:
+    def sched(step):
+        return jnp.full_like(jnp.asarray(step, jnp.float32), lr)
+
+    return sched
+
+
+_FACTORY: Dict[str, Callable[..., Schedule]] = {
+    WARMUP_LR.lower(): warmup_lr,
+    WARMUP_DECAY_LR.lower(): warmup_decay_lr,
+    WARMUP_COSINE_LR.lower(): warmup_cosine_lr,
+    ONE_CYCLE.lower(): one_cycle,
+    LR_RANGE_TEST.lower(): lr_range_test,
+}
+
+
+def get_schedule(type_name: Optional[str], params: Dict[str, Any],
+                 base_lr: float) -> Schedule:
+    """Build from a DeepSpeed-style scheduler config block. ``None`` → constant
+    base LR."""
+    if not type_name:
+        return constant(base_lr)
+    key = type_name.lower()
+    if key not in _FACTORY:
+        raise ValueError(f"unknown scheduler '{type_name}' (known: {sorted(_FACTORY)})")
+    import inspect
+
+    fn = _FACTORY[key]
+    accepted = set(inspect.signature(fn).parameters)
+    has_kwargs = any(p.kind == inspect.Parameter.VAR_KEYWORD
+                     for p in inspect.signature(fn).parameters.values())
+    kwargs = {k: v for k, v in params.items() if has_kwargs or k in accepted}
+    return fn(**kwargs)
+
+
+class LRScheduler:
+    """Reference-compatible stateful wrapper (``lr_scheduler.step()/get_lr()``)."""
+
+    def __init__(self, schedule: Schedule):
+        self.schedule = schedule
+        self.last_step = 0
+
+    def step(self, increment: int = 1) -> None:
+        self.last_step += increment
+
+    def get_lr(self):
+        return [float(self.schedule(jnp.asarray(self.last_step)))]
+
+    def get_last_lr(self):
+        return self.get_lr()
+
+    def state_dict(self):
+        return {"last_step": self.last_step}
+
+    def load_state_dict(self, sd):
+        self.last_step = sd["last_step"]

@@ -1,0 +1,106 @@
+"""Mixed precision: dtype policy + dynamic loss scaling.
+
+Reference parity:
+- ``runtime/fp16/loss_scaler.py`` (``DynamicLossScaler`` :187, ``LossScaler``
+  :163): loss scale doubling every ``scale_window`` good steps, halving on
+  overflow with hysteresis.
+- ``runtime/bf16_optimizer.py``: fp32 master weights for bf16 compute without
+  loss scaling.
+
+TPU-first difference: the scaler is a *pytree state threaded through the
+jit-compiled step*, and overflow handling is a ``jnp.where`` skip (no Python
+branching, no cross-device overflow allreduce — the grads are already global
+under SPMD so an ``isfinite`` reduction is free).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class PrecisionPolicy:
+    """Which dtypes to use where. Params (and optimizer state) stay fp32 —
+    master weights; compute casts per-step."""
+
+    param_dtype: Any = jnp.float32
+    compute_dtype: Any = jnp.float32
+    output_dtype: Any = jnp.float32
+
+    @classmethod
+    def from_config(cls, cfg) -> "PrecisionPolicy":
+        if cfg.fp16.enabled:
+            return cls(jnp.float32, jnp.float16, jnp.float32)
+        if cfg.bf16.enabled:
+            return cls(jnp.float32, jnp.bfloat16, jnp.float32)
+        return cls()
+
+    def cast_to_compute(self, tree):
+        return jax.tree.map(
+            lambda x: x.astype(self.compute_dtype)
+            if jnp.issubdtype(x.dtype, jnp.floating) else x, tree)
+
+
+class LossScaleState(NamedTuple):
+    """Dynamic loss scaler state (a jit-compatible pytree)."""
+
+    scale: jnp.ndarray          # f32 scalar
+    good_steps: jnp.ndarray     # i32 consecutive overflow-free steps
+    growth_interval: jnp.ndarray  # i32 (static in practice)
+    backoff: jnp.ndarray        # f32 multiplicative backoff (0.5)
+    growth: jnp.ndarray         # f32 growth factor (2.0)
+    min_scale: jnp.ndarray      # f32
+    enabled: jnp.ndarray        # bool — False for bf16/fp32 (scale pinned to 1)
+
+
+def make_loss_scaler(cfg_fp16) -> LossScaleState:
+    """Build from an ``FP16Config``; static scale if ``loss_scale`` > 0."""
+    enabled = bool(cfg_fp16.enabled)
+    dynamic = enabled and cfg_fp16.dynamic_loss_scale
+    init = (2.0 ** cfg_fp16.initial_scale_power) if dynamic else (
+        cfg_fp16.loss_scale if enabled and cfg_fp16.loss_scale else 1.0)
+    return LossScaleState(
+        scale=jnp.asarray(init, jnp.float32),
+        good_steps=jnp.asarray(0, jnp.int32),
+        growth_interval=jnp.asarray(cfg_fp16.loss_scale_window, jnp.int32),
+        backoff=jnp.asarray(0.5, jnp.float32),
+        growth=jnp.asarray(2.0, jnp.float32),
+        min_scale=jnp.asarray(cfg_fp16.min_loss_scale, jnp.float32),
+        enabled=jnp.asarray(dynamic, jnp.bool_),
+    )
+
+
+def scale_loss(loss: jnp.ndarray, state: LossScaleState) -> jnp.ndarray:
+    return loss * state.scale.astype(loss.dtype)
+
+
+def grads_finite(grads) -> jnp.ndarray:
+    leaves = jax.tree.leaves(grads)
+    finite = jnp.asarray(True)
+    for leaf in leaves:
+        finite = jnp.logical_and(finite, jnp.all(jnp.isfinite(leaf)))
+    return finite
+
+
+def unscale_grads(grads, state: LossScaleState):
+    inv = 1.0 / state.scale
+    return jax.tree.map(lambda g: (g.astype(jnp.float32) * inv), grads)
+
+
+def update_loss_scale(state: LossScaleState, finite: jnp.ndarray) -> LossScaleState:
+    """Pure-functional DynamicLossScaler.update_scale (reference
+    ``loss_scaler.py:230``): halve on overflow, double after ``growth_interval``
+    consecutive good steps."""
+    grown = state.good_steps + 1 >= state.growth_interval
+    new_scale = jnp.where(
+        finite,
+        jnp.where(grown, state.scale * state.growth, state.scale),
+        jnp.maximum(state.scale * state.backoff, state.min_scale))
+    new_good = jnp.where(finite, jnp.where(grown, 0, state.good_steps + 1), 0)
+    new_scale = jnp.where(state.enabled, new_scale, state.scale)
+    new_good = jnp.where(state.enabled, new_good, state.good_steps)
+    return state._replace(scale=new_scale, good_steps=new_good.astype(jnp.int32))

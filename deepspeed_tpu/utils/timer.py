@@ -14,7 +14,7 @@ from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
-from .logging import logger
+from .logging import log_dist, logger
 
 FORWARD_MICRO_TIMER = "fwd_microstep"
 FORWARD_GLOBAL_TIMER = "fwd"
@@ -70,6 +70,7 @@ class _Timer:
     def reset(self) -> None:
         self.started = False
         self._elapsed = 0.0
+        self._records.clear()
 
     def elapsed(self, reset: bool = True) -> float:
         """Elapsed seconds since last reset (stops nothing)."""
@@ -78,6 +79,7 @@ class _Timer:
             value += time.perf_counter() - self._start
         if reset:
             self._elapsed = 0.0
+            self._records.clear()
         return value
 
     def mean(self) -> float:
@@ -110,7 +112,7 @@ class SynchronizedWallClockTimer:
                 ms = self.timers[name].elapsed(reset=reset) * 1000.0 / normalizer
                 parts.append(f"{name}: {ms:.2f}ms")
         if parts:
-            logger.info("time (ms) | " + " | ".join(parts))
+            log_dist("time (ms) | " + " | ".join(parts), ranks=ranks)
 
     def get_mean(self, names: List[str], normalizer: float = 1.0) -> Dict[str, float]:
         return {n: self.timers[n].mean() * 1000.0 / normalizer for n in names if n in self.timers}

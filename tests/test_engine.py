@@ -1,0 +1,186 @@
+"""End-to-end engine tests — the walking skeleton (reference model:
+``tests/unit/v1/zero/test_zero.py`` correctness-across-stages classes and
+``tests/unit/runtime/test_ds_initialize.py``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu as dst
+from deepspeed_tpu.models import llama
+
+
+def _data(cfg, batch, seqlen=32, seed=0):
+    tokens = jax.random.randint(jax.random.PRNGKey(seed), (batch, seqlen + 1),
+                                0, cfg.vocab_size)
+    return {"tokens": np.asarray(tokens)}
+
+
+def _train(config, n_steps=6, mcfg=None, seed=0, compute_dtype=jnp.float32):
+    mcfg = mcfg or llama.LlamaConfig.tiny()
+    spec = llama.model_spec(mcfg, compute_dtype=compute_dtype)
+    engine, opt, _, sched = dst.initialize(model=spec, config=config,
+                                           rng=jax.random.PRNGKey(seed))
+    losses = []
+    for i in range(n_steps):
+        out = engine.train_batch(_data(mcfg, engine.train_batch_size(), seed=i))
+        losses.append(float(out.loss))
+    return engine, losses
+
+
+@pytest.mark.parametrize("zero_stage", [0, 1, 2, 3])
+def test_zero_stages_train_and_converge(devices8, zero_stage):
+    config = {
+        "train_batch_size": 8,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": zero_stage},
+        "gradient_clipping": 1.0,
+        "steps_per_print": 0,
+    }
+    engine, losses = _train(config, n_steps=8)
+    assert losses[-1] < losses[0], losses
+    assert engine.global_steps == 8
+
+
+def test_zero_stages_match_each_other(devices8):
+    """ZeRO is rearranged arithmetic — all stages must produce the same loss
+    trajectory (reference asserts parity vs unpartitioned baselines)."""
+    base = {
+        "train_batch_size": 8,
+        "optimizer": {"type": "adamw", "params": {"lr": 5e-3}},
+        "steps_per_print": 0,
+    }
+    trajs = {}
+    for stage in [0, 3]:
+        cfg = dict(base, zero_optimization={"stage": stage})
+        _, losses = _train(cfg, n_steps=4, seed=7)
+        trajs[stage] = losses
+    np.testing.assert_allclose(trajs[0], trajs[3], rtol=2e-4, atol=2e-5)
+
+
+def test_gradient_accumulation_equivalence(devices8):
+    """gas=2 with half micro-batch == gas=1 full batch (same global batch)."""
+    common = {"optimizer": {"type": "sgd", "params": {"lr": 1e-2}},
+              "steps_per_print": 0}
+    cfg_a = dict(common, train_batch_size=16, gradient_accumulation_steps=1)
+    cfg_b = dict(common, train_batch_size=16, gradient_accumulation_steps=2)
+    _, la = _train(cfg_a, n_steps=3, seed=3)
+    _, lb = _train(cfg_b, n_steps=3, seed=3)
+    np.testing.assert_allclose(la, lb, rtol=1e-5, atol=1e-6)
+
+
+def test_fp16_loss_scaling_and_overflow_skip(devices8):
+    config = {
+        "train_batch_size": 8,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "fp16": {"enabled": True, "initial_scale_power": 4, "loss_scale_window": 2},
+        "steps_per_print": 0,
+    }
+    mcfg = llama.LlamaConfig.tiny()
+    spec = llama.model_spec(mcfg, compute_dtype=jnp.float16)
+    engine, _, _, _ = dst.initialize(model=spec, config=config)
+    assert engine.loss_scale == 2.0 ** 4
+    out = engine.train_batch(_data(mcfg, 8))
+    assert not bool(out.overflow)
+    # scale grows after loss_scale_window good steps
+    engine.train_batch(_data(mcfg, 8, seed=1))
+    assert engine.loss_scale >= 2.0 ** 4
+
+
+def test_bf16_training(devices8):
+    config = {
+        "train_batch_size": 8,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 2},
+        "steps_per_print": 0,
+    }
+    engine, losses = _train(config, n_steps=6, compute_dtype=jnp.bfloat16)
+    assert losses[-1] < losses[0]
+    # master params stay fp32
+    assert engine.state.params["embed"].dtype == jnp.float32
+
+
+def test_scheduler_integration(devices8):
+    config = {
+        "train_batch_size": 8,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+        "scheduler": {"type": "WarmupLR",
+                      "params": {"warmup_min_lr": 0.0, "warmup_max_lr": 1e-2,
+                                 "warmup_num_steps": 10}},
+        "steps_per_print": 0,
+    }
+    engine, _ = _train(config, n_steps=3)
+    lr = engine.get_lr()[0]
+    assert 0 < lr < 1e-2  # still warming up
+
+
+def test_forward_backward_step_shims(devices8):
+    """torch-style micro-batch loop must match train_batch results."""
+    config = {
+        "train_batch_size": 16,
+        "gradient_accumulation_steps": 2,
+        "optimizer": {"type": "sgd", "params": {"lr": 1e-2}},
+        "steps_per_print": 0,
+    }
+    mcfg = llama.LlamaConfig.tiny()
+    spec = llama.model_spec(mcfg, compute_dtype=jnp.float32)
+    engine, _, _, _ = dst.initialize(model=spec, config=config,
+                                     rng=jax.random.PRNGKey(0))
+    batch = _data(mcfg, 16)
+    micro = {k: v.reshape(2, 8, *v.shape[1:]) for k, v in batch.items()}
+
+    loss0 = engine.forward({k: v[0] for k, v in micro.items()})
+    engine.backward()
+    assert engine.step() is None  # not at boundary yet
+    engine.forward({k: v[1] for k, v in micro.items()})
+    engine.backward()
+    out = engine.step()
+    assert out is not None
+    assert engine.global_steps == 1
+
+    # compare against train_batch path from identical init
+    engine2, _, _, _ = dst.initialize(model=spec, config=config,
+                                      rng=jax.random.PRNGKey(0))
+    out2 = engine2.train_batch(batch)
+    np.testing.assert_allclose(float(out.loss), float(out2.loss), rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(engine.state.params["final_norm"]),
+        np.asarray(engine2.state.params["final_norm"]), rtol=1e-5, atol=1e-7)
+
+
+def test_zero3_params_are_sharded(devices8):
+    config = {
+        "train_batch_size": 8,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 3},
+        "steps_per_print": 0,
+    }
+    mcfg = llama.LlamaConfig.tiny()
+    spec = llama.model_spec(mcfg, compute_dtype=jnp.float32)
+    engine, _, _, _ = dst.initialize(model=spec, config=config)
+    wq = engine.state.params["layers"]["wq"]
+    # sharded over the 8-way data axis: each device holds 1/8
+    assert len(wq.sharding.device_set) == 8
+    local = wq.addressable_shards[0].data.size
+    assert local == wq.size // 8
+    # optimizer state sharded the same way
+    mu = engine.state.opt_state.mu["layers"]["wq"]
+    assert mu.addressable_shards[0].data.size == mu.size // 8
+
+
+def test_zero1_opt_state_sharded_params_replicated(devices8):
+    config = {
+        "train_batch_size": 8,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 1},
+        "steps_per_print": 0,
+    }
+    mcfg = llama.LlamaConfig.tiny()
+    spec = llama.model_spec(mcfg, compute_dtype=jnp.float32)
+    engine, _, _, _ = dst.initialize(model=spec, config=config)
+    wq = engine.state.params["layers"]["wq"]
+    assert wq.addressable_shards[0].data.size == wq.size  # replicated
+    mu = engine.state.opt_state.mu["layers"]["wq"]
+    assert mu.addressable_shards[0].data.size == mu.size // 8  # sharded

@@ -1,0 +1,65 @@
+"""LR schedule tests (reference model: ``tests/unit/runtime/test_lr_schedulers.py``)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime import lr_schedules as S
+
+
+def _vals(sched, steps):
+    return [float(sched(jnp.asarray(float(s)))) for s in steps]
+
+
+def test_warmup_lr_reaches_max():
+    sched = S.warmup_lr(warmup_min_lr=0.0, warmup_max_lr=1e-2, warmup_num_steps=10)
+    v = _vals(sched, [0, 5, 10, 100])
+    assert v[0] < v[1] < v[2]
+    assert abs(v[2] - 1e-2) < 1e-9
+    assert abs(v[3] - 1e-2) < 1e-9
+
+
+def test_warmup_decay_hits_zero():
+    sched = S.warmup_decay_lr(total_num_steps=100, warmup_max_lr=1e-2,
+                              warmup_num_steps=10)
+    v = _vals(sched, [10, 50, 100, 200])
+    assert v[0] > v[1] > v[2]
+    assert v[2] == pytest.approx(0.0, abs=1e-9)
+    assert v[3] == pytest.approx(0.0, abs=1e-9)
+
+
+def test_warmup_cosine():
+    sched = S.warmup_cosine_lr(total_num_steps=100, warmup_num_steps=10,
+                               warmup_max_lr=1.0, cos_min_ratio=0.1)
+    v = _vals(sched, [0, 10, 55, 100])
+    assert v[1] == pytest.approx(1.0, rel=1e-5)
+    assert 0.1 < v[2] < 1.0
+    assert v[3] == pytest.approx(0.1, rel=1e-3)
+
+
+def test_one_cycle_shape():
+    sched = S.one_cycle(cycle_min_lr=0.1, cycle_max_lr=1.0,
+                        cycle_first_step_size=10)
+    v = _vals(sched, [0, 5, 10, 15, 20, 30])
+    assert v[0] == pytest.approx(0.1, rel=1e-5)
+    assert v[2] == pytest.approx(1.0, rel=1e-5)
+    assert v[4] == pytest.approx(0.1, rel=1e-5)
+    assert v[5] == pytest.approx(0.1, rel=1e-5)
+
+
+def test_lr_range_test_grows():
+    sched = S.lr_range_test(lr_range_test_min_lr=1e-4,
+                            lr_range_test_step_size=10,
+                            lr_range_test_step_rate=1.0)
+    v = _vals(sched, [0, 10, 20])
+    assert v[0] < v[1] < v[2]
+
+
+def test_factory_from_config():
+    sched = S.get_schedule("WarmupLR", {"warmup_max_lr": 1e-3, "warmup_num_steps": 5},
+                           base_lr=1e-3)
+    assert float(sched(jnp.asarray(100.0))) == pytest.approx(1e-3)
+    with pytest.raises(ValueError):
+        S.get_schedule("NopeLR", {}, 1e-3)
+    const = S.get_schedule(None, {}, 3e-4)
+    assert float(const(jnp.asarray(7.0))) == pytest.approx(3e-4)

@@ -1,0 +1,91 @@
+"""Partitioner tests: ZeRO stages as sharding specs, TP rules."""
+
+import jax
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.comm import init_mesh
+from deepspeed_tpu.models import llama
+from deepspeed_tpu.runtime.partitioning import Partitioner, shapes_of
+
+
+def _make(cfg=None):
+    cfg = cfg or llama.LlamaConfig.tiny()
+    params = llama.init(cfg, jax.random.PRNGKey(0))
+    return cfg, params, llama.param_logical_axes(cfg), shapes_of(params)
+
+
+def test_tp_rules(devices8):
+    mm = init_mesh({"data": 4, "tensor": 2})
+    cfg, params, axes, shapes = _make()
+    part = Partitioner(mm, zero_stage=0)
+    specs = part.param_specs(axes, shapes)
+    assert specs["layers"]["wq"] == P(None, None, "tensor")
+    assert specs["layers"]["wo"] == P(None, "tensor", None)
+    assert specs["layers"]["w_down"] == P(None, "tensor", None)
+    assert specs["embed"] == P("tensor", None)
+    assert specs["final_norm"] == P(None)
+
+
+def test_zero3_param_sharding(devices8):
+    mm = init_mesh({"data": 4, "tensor": 2})
+    cfg, params, axes, shapes = _make()
+    part = Partitioner(mm, zero_stage=3)
+    specs = part.param_specs(axes, shapes)
+    # wq [L=2, h=64, heads*hd=64]: heads dim on tensor, embed dim on zero axes
+    assert specs["layers"]["wq"] == P(None, ("data",), "tensor")
+    # norm [L, h]: h=64 divisible by 4 → sharded over data
+    assert specs["layers"]["attn_norm"] == P(None, ("data",))
+
+
+def test_zero_stage_progression(devices8):
+    mm = init_mesh({"data": 8})
+    cfg, params, axes, shapes = _make()
+    for stage, (p_sharded, g_sharded, o_sharded) in {
+        0: (False, False, False),
+        1: (False, False, True),
+        2: (False, True, True),
+        3: (True, True, True),
+    }.items():
+        part = Partitioner(mm, zero_stage=stage)
+        ps = part.param_specs(axes, shapes)["layers"]["wq"]
+        gs = part.grad_specs(axes, shapes)["layers"]["wq"]
+        os_ = part.opt_state_specs(axes, shapes)["layers"]["wq"]
+        assert (ps != P(None, None, None)) == p_sharded, (stage, ps)
+        assert (gs != P(None, None, None)) == g_sharded, (stage, gs)
+        assert (os_ != P(None, None, None)) == o_sharded, (stage, os_)
+
+
+def test_no_tensor_axis_drops_tp_rules(devices8):
+    mm = init_mesh({"data": 8})
+    cfg, params, axes, shapes = _make()
+    part = Partitioner(mm, zero_stage=0)
+    specs = part.param_specs(axes, shapes)
+    flat = jax.tree.leaves(specs, is_leaf=lambda x: isinstance(x, P))
+    assert all(s == P(*[None] * len(s)) for s in flat)
+
+
+def test_indivisible_dim_stays_replicated(devices8):
+    mm = init_mesh({"data": 8})
+    # hidden 60 not divisible by 8 → params stay replicated at stage 3
+    cfg = llama.LlamaConfig.tiny(hidden_size=60, num_heads=4, num_kv_heads=2,
+                                 intermediate_size=120)
+    params = llama.init(cfg, jax.random.PRNGKey(0))
+    axes, shapes = llama.param_logical_axes(cfg), shapes_of(params)
+    part = Partitioner(mm, zero_stage=3)
+    specs = part.param_specs(axes, shapes)
+    assert specs["final_norm"] == P(None)
+
+
+def test_sharded_placement_end_to_end(devices8):
+    """Params actually land distributed: per-device memory is 1/8."""
+    mm = init_mesh({"data": 8})
+    cfg, params, axes, shapes = _make()
+    part = Partitioner(mm, zero_stage=3)
+    shardings = part.shardings(part.param_specs(axes, shapes))
+    placed = jax.tree.map(jax.device_put, params, shardings)
+    wq = placed["layers"]["wq"]
+    assert len(wq.sharding.device_set) == 8
+    shard_shape = wq.addressable_shards[0].data.shape
+    assert shard_shape[1] == wq.shape[1] // 8
+    np.testing.assert_allclose(np.asarray(wq), np.asarray(params["layers"]["wq"]))
