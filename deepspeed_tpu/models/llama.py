@@ -51,6 +51,8 @@ class LlamaConfig:
     tie_embeddings: bool = False
     remat: bool = False          # jax.checkpoint each block
     remat_policy: str = "none"   # none | full | dots
+    attention_impl: str = "auto"  # auto | xla | ulysses | ring
+    use_pipeline: bool = True    # use the pipe mesh axis when present
 
     @property
     def head_size(self) -> int:
@@ -134,9 +136,35 @@ def param_logical_axes(cfg: LlamaConfig) -> Params:
     return axes
 
 
+def _resolve_attention(cfg: LlamaConfig, in_pipeline: bool = False):
+    """Pick the attention path: explicit config wins; 'auto' uses Ulysses when
+    the mesh has a seq axis. Ring/Ulysses cannot nest inside the pipeline's
+    manual 'pipe' region (nested shard_map / sharding constraints over other
+    axes), so that combination is rejected explicitly."""
+    impl = cfg.attention_impl
+    if in_pipeline and impl in ("ring", "ulysses"):
+        raise ValueError(
+            f"attention_impl='{impl}' cannot run inside pipeline parallelism; "
+            "use attention_impl='auto'/'xla' with the pipe axis, or drop the "
+            "pipe axis to use sequence parallelism")
+    if impl == "ring":
+        from ..sequence.ring import ring_attention_spmd
+
+        return ring_attention_spmd
+    if impl == "ulysses" or (impl == "auto" and not in_pipeline):
+        from ..comm.mesh import get_mesh
+
+        if get_mesh().sp_world_size > 1:
+            from ..sequence.layer import ulysses_attention
+
+            return ulysses_attention
+    return attention
+
+
 def _block(cfg: LlamaConfig, x: jnp.ndarray, layer: Params,
            cos: jnp.ndarray, sin: jnp.ndarray,
-           positions: Optional[jnp.ndarray]) -> jnp.ndarray:
+           positions: Optional[jnp.ndarray],
+           attn_fn=attention) -> jnp.ndarray:
     """One transformer block. x: [batch, seq, hidden] (compute dtype)."""
     b, s, h = x.shape
     nh, nkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_size
@@ -147,7 +175,7 @@ def _block(cfg: LlamaConfig, x: jnp.ndarray, layer: Params,
     v = (y @ layer["wv"]).reshape(b, s, nkv, hd)
     q = apply_rotary(q, cos, sin, positions)
     k = apply_rotary(k, cos, sin, positions)
-    attn_out = attention(q, k, v, causal=True)
+    attn_out = attn_fn(q, k, v, causal=True)
     x = x + attn_out.reshape(b, s, nh * hd) @ layer["wo"]
 
     y = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
@@ -173,17 +201,33 @@ def apply(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray, *,
                           if jnp.issubdtype(p.dtype, jnp.floating) else p,
                           params["layers"])
 
-    block = partial(_block, cfg)
+    pipe_stages = 1
+    if cfg.use_pipeline:
+        try:
+            from ..comm.mesh import get_mesh
+
+            pipe_stages = get_mesh().pp_world_size
+        except Exception:
+            pipe_stages = 1
+
+    attn_fn = _resolve_attention(cfg, in_pipeline=pipe_stages > 1)
+    block = partial(_block, cfg, attn_fn=attn_fn)
     if cfg.remat:
         policy = None
         if cfg.remat_policy == "dots":
             policy = jax.checkpoint_policies.checkpoint_dots
         block = jax.checkpoint(block, policy=policy)
 
-    def scan_body(x, layer):
-        return block(x, layer, cos, sin, positions), None
+    if pipe_stages > 1:
+        from ..runtime.pipe import pipeline_apply
 
-    x, _ = lax.scan(scan_body, x, layers)
+        x = pipeline_apply(lambda layer, h: block(h, layer, cos, sin, positions),
+                           layers, x)
+    else:
+        def scan_body(x, layer):
+            return block(x, layer, cos, sin, positions), None
+
+        x, _ = lax.scan(scan_body, x, layers)
     x = rms_norm(x, params["final_norm"].astype(compute_dtype), cfg.rms_norm_eps)
     head = params.get("lm_head")
     if head is None:
@@ -204,6 +248,7 @@ def model_spec(cfg: LlamaConfig, compute_dtype=jnp.bfloat16):
         apply_fn=lambda params, tokens, **kw: apply(cfg, params, tokens,
                                                     compute_dtype=compute_dtype, **kw),
         logical_axes=param_logical_axes(cfg),
+        pipeline_capable=cfg.use_pipeline,
     )
 
 

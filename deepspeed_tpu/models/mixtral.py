@@ -1,0 +1,167 @@
+"""Mixtral-family model: Llama backbone with MoE FFN (expert parallel).
+
+Reference parity: the reference serves mixtral via
+``inference/v2/model_implementations/mixtral`` and trains MoE via
+``deepspeed/moe`` — this is the training+inference model family for MoE here.
+Stacked-layer ``lax.scan`` like ``models/llama.py``; each block's FFN is the
+expert bank with top-k routing; the load-balancing aux loss accumulates
+through the scan and is added to the LM loss.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..moe.layer import MoELayer, init_moe_ffn, moe_ffn_logical_axes
+from ..ops.attention import attention
+from ..ops.norms import rms_norm
+from ..ops.rotary import apply_rotary, rope_frequencies
+from . import llama as llama_mod
+
+Params = Dict[str, Any]
+
+
+@dataclass(frozen=True)
+class MixtralConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 14336
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 8
+    num_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    min_capacity: int = 4
+    drop_tokens: bool = True
+    aux_loss_coef: float = 0.01
+    max_seq_len: int = 4096
+    rope_theta: float = 1000000.0
+    rms_norm_eps: float = 1e-5
+    remat: bool = False
+
+    @property
+    def head_size(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @classmethod
+    def tiny(cls, **kw) -> "MixtralConfig":
+        base = dict(vocab_size=256, hidden_size=64, intermediate_size=128,
+                    num_layers=2, num_heads=4, num_kv_heads=2, num_experts=4,
+                    top_k=2, max_seq_len=128, rope_theta=10000.0)
+        base.update(kw)
+        return cls(**base)
+
+
+def init(cfg: MixtralConfig, rng: jax.Array, dtype=jnp.float32) -> Params:
+    h, hd = cfg.hidden_size, cfg.head_size
+    L, nh, nkv, v = cfg.num_layers, cfg.num_heads, cfg.num_kv_heads, cfg.vocab_size
+    keys = jax.random.split(rng, 8)
+
+    def normal(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32) * fan_in ** -0.5).astype(dtype)
+
+    moe = jax.vmap(lambda k: init_moe_ffn(k, cfg.num_experts, h,
+                                          cfg.intermediate_size, dtype))(
+        jax.random.split(keys[5], L))
+    return {
+        "embed": normal(keys[0], (v, h), h),
+        "layers": {
+            "attn_norm": jnp.ones((L, h), dtype),
+            "wq": normal(keys[1], (L, h, nh * hd), h),
+            "wk": normal(keys[2], (L, h, nkv * hd), h),
+            "wv": normal(keys[3], (L, h, nkv * hd), h),
+            "wo": normal(keys[4], (L, nh * hd, h), nh * hd),
+            "mlp_norm": jnp.ones((L, h), dtype),
+            "moe": moe,   # leaves: [L, E, ...] / router [L, H, E]
+        },
+        "final_norm": jnp.ones((h,), dtype),
+        "lm_head": normal(keys[6], (h, v), h),
+    }
+
+
+def param_logical_axes(cfg: MixtralConfig) -> Params:
+    moe_axes = {k: ("layers",) + tuple(v) for k, v in moe_ffn_logical_axes().items()}
+    return {
+        "embed": ("vocab", "embed"),
+        "layers": {
+            "attn_norm": ("layers", "embed"),
+            "wq": ("layers", "embed", "heads"),
+            "wk": ("layers", "embed", "kv_heads"),
+            "wv": ("layers", "embed", "kv_heads"),
+            "wo": ("layers", "heads", "embed"),
+            "mlp_norm": ("layers", "embed"),
+            "moe": moe_axes,
+        },
+        "final_norm": ("embed",),
+        "lm_head": ("embed", "vocab"),
+    }
+
+
+def apply(cfg: MixtralConfig, params: Params, tokens: jnp.ndarray, *,
+          compute_dtype=jnp.bfloat16) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Forward → (logits [b, s, vocab] fp32, total_aux_loss)."""
+    x = params["embed"][tokens].astype(compute_dtype)
+    cos, sin = rope_frequencies(cfg.head_size, cfg.max_seq_len, cfg.rope_theta)
+    moe_layer = MoELayer(cfg.num_experts, cfg.top_k, cfg.capacity_factor,
+                         cfg.min_capacity, cfg.drop_tokens)
+
+    layers = jax.tree.map(lambda p: p.astype(compute_dtype)
+                          if jnp.issubdtype(p.dtype, jnp.floating) else p,
+                          params["layers"])
+
+    def block(x, layer):
+        b, s, h = x.shape
+        nh, nkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_size
+        y = rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps)
+        q = apply_rotary((y @ layer["wq"]).reshape(b, s, nh, hd), cos, sin)
+        k = apply_rotary((y @ layer["wk"]).reshape(b, s, nkv, hd), cos, sin)
+        v = (y @ layer["wv"]).reshape(b, s, nkv, hd)
+        x = x + attention(q, k, v, causal=True).reshape(b, s, nh * hd) @ layer["wo"]
+        y = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
+        ffn_out, aux = moe_layer(layer["moe"], y)
+        return x + ffn_out, aux
+
+    if cfg.remat:
+        block = jax.checkpoint(block)
+
+    def scan_body(x, layer):
+        x, aux = block(x, layer)
+        return x, aux
+
+    x, aux_losses = lax.scan(scan_body, x, layers)
+    x = rms_norm(x, params["final_norm"].astype(compute_dtype), cfg.rms_norm_eps)
+    logits = x @ params["lm_head"].astype(compute_dtype)
+    return logits.astype(jnp.float32), jnp.sum(aux_losses)
+
+
+def loss_fn(cfg: MixtralConfig, params: Params, batch: Dict[str, jnp.ndarray], *,
+            compute_dtype=jnp.bfloat16):
+    tokens = batch["tokens"]
+    inputs, labels = tokens[:, :-1], tokens[:, 1:]
+    logits, aux = apply(cfg, params, inputs, compute_dtype=compute_dtype)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    lm_loss = -jnp.mean(jnp.take_along_axis(logp, labels[..., None], axis=-1))
+    loss = lm_loss + cfg.aux_loss_coef * aux
+    return loss, {"loss": loss, "lm_loss": lm_loss, "aux_loss": aux}
+
+
+def model_spec(cfg: MixtralConfig, compute_dtype=jnp.bfloat16):
+    from ..runtime.engine import ModelSpec
+
+    return ModelSpec(
+        name="mixtral",
+        init_fn=lambda rng: init(cfg, rng),
+        loss_fn=lambda params, batch: loss_fn(cfg, params, batch,
+                                              compute_dtype=compute_dtype),
+        apply_fn=lambda params, tokens, **kw: apply(cfg, params, tokens,
+                                                    compute_dtype=compute_dtype)[0],
+        logical_axes=param_logical_axes(cfg),
+        pipeline_capable=False,   # MoE model runs plain scan (no pipeline path yet)
+    )

@@ -1,0 +1,107 @@
+"""MoE layer: gate → dispatch → sharded experts → combine.
+
+Reference parity: ``deepspeed/moe/layer.py`` (``MoE`` :17) + ``MOELayer``
+(``sharded_moe.py:537``) + ``Experts`` (``moe/experts.py``): the expert FFNs
+live on separate ranks (expert parallelism); dispatch/combine travel through
+all-to-all. Expert parameters get their own "expert group" treatment in the
+reference's grad reduction (``runtime/engine.py:3088-3130``) — here that falls
+out of sharding: expert params are sharded over the 'expert' mesh axis, so
+their gradients reduce only within their replica group automatically.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..comm.mesh import get_mesh
+from .sharded_moe import GatingOutput, top_k_gating
+
+Params = Dict[str, Any]
+
+
+def init_moe_ffn(rng: jax.Array, n_experts: int, hidden: int, intermediate: int,
+                 dtype=jnp.float32) -> Params:
+    """Expert SwiGLU FFN bank [E, ...] + router [H, E]."""
+    ks = jax.random.split(rng, 4)
+
+    def normal(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32) * fan_in ** -0.5).astype(dtype)
+
+    return {
+        "router": normal(ks[0], (hidden, n_experts), hidden),
+        "w_gate": normal(ks[1], (n_experts, hidden, intermediate), hidden),
+        "w_up": normal(ks[2], (n_experts, hidden, intermediate), hidden),
+        "w_down": normal(ks[3], (n_experts, intermediate, hidden), intermediate),
+    }
+
+
+def moe_ffn_logical_axes() -> Params:
+    return {
+        "router": ("embed", None),
+        "w_gate": ("expert", "embed", "mlp"),
+        "w_up": ("expert", "embed", "mlp"),
+        "w_down": ("expert", "mlp", "embed"),
+    }
+
+
+def _expert_constraint(x):
+    """Shard the leading expert dim over the 'expert' mesh axis (the a2a)."""
+    mm = get_mesh()
+    if mm.ep_world_size <= 1:
+        return x
+    spec = P(*(["expert"] + [None] * (x.ndim - 1)))
+    return lax.with_sharding_constraint(x, NamedSharding(mm.mesh, spec))
+
+
+class MoELayer:
+    """Functional MoE FFN. Call with params from :func:`init_moe_ffn`.
+
+    Returns (output, aux_loss). Use inside a transformer block in place of the
+    dense FFN; add ``aux_loss_coef * aux_loss`` to the training loss.
+    """
+
+    def __init__(self, n_experts: int, top_k: int = 2,
+                 capacity_factor: float = 1.25, min_capacity: int = 4,
+                 drop_tokens: bool = True):
+        self.n_experts = n_experts
+        self.top_k = top_k
+        self.capacity_factor = capacity_factor
+        self.min_capacity = min_capacity
+        self.drop_tokens = drop_tokens
+
+    def __call__(self, params: Params, x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """x: [batch, seq, hidden] → ([batch, seq, hidden], aux_loss)."""
+        b, s, h = x.shape
+        tokens = x.reshape(b * s, h)
+        logits = tokens @ params["router"].astype(tokens.dtype)
+        gating: GatingOutput = top_k_gating(
+            logits, self.top_k, capacity_factor=self.capacity_factor,
+            min_capacity=self.min_capacity, drop_tokens=self.drop_tokens)
+
+        # dispatch: [T, E, C] × [T, H] → [E, C, H], then expert-shard (a2a)
+        expert_in = jnp.einsum("tec,th->ech",
+                               gating.dispatch_mask.astype(tokens.dtype), tokens)
+        expert_in = _expert_constraint(expert_in)
+
+        # expert FFN bank, vmapped over E (each expert's compute lands on its
+        # own 'expert' shard)
+        def ffn(w_gate, w_up, w_down, xe):
+            g = jax.nn.silu(xe @ w_gate)
+            u = xe @ w_up
+            return (g * u) @ w_down
+
+        expert_out = jax.vmap(ffn)(params["w_gate"].astype(tokens.dtype),
+                                   params["w_up"].astype(tokens.dtype),
+                                   params["w_down"].astype(tokens.dtype),
+                                   expert_in)
+        expert_out = _expert_constraint(expert_out)
+
+        # combine: [T, E, C] × [E, C, H] → [T, H]  (a2a back)
+        out = jnp.einsum("tec,ech->th",
+                         gating.combine_weights.astype(tokens.dtype), expert_out)
+        return out.reshape(b, s, h), gating.aux_loss

@@ -1,0 +1,84 @@
+"""Top-k gating + einsum dispatch for MoE — expert parallelism.
+
+Reference parity: ``deepspeed/moe/sharded_moe.py`` (``TopKGate`` :453,
+``top1gating`` :184, ``top2gating`` :291, ``topkgating`` :375, ``MOELayer``
+:537): softmax gate → top-k expert choice → capacity-bounded position
+assignment → einsum dispatch → all-to-all → experts → all-to-all → combine,
+plus the load-balancing auxiliary loss.
+
+TPU-first: dispatch/combine are dense one-hot einsums (MXU-friendly, static
+shapes); the all-to-all is a sharding-constraint flip on the expert dimension
+(XLA lowers it to an ICI a2a over the 'expert' mesh axis). Capacity is static:
+``ceil(k * tokens * capacity_factor / n_experts)``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class GatingOutput(NamedTuple):
+    combine_weights: jnp.ndarray   # [tokens, experts, capacity] f32
+    dispatch_mask: jnp.ndarray     # [tokens, experts, capacity] bool
+    aux_loss: jnp.ndarray          # scalar load-balancing loss
+    router_probs: jnp.ndarray      # [tokens, experts]
+
+
+def compute_capacity(tokens: int, n_experts: int, k: int,
+                     capacity_factor: float, min_capacity: int = 4) -> int:
+    cap = int(math.ceil(k * tokens * capacity_factor / n_experts))
+    return max(cap, min_capacity)
+
+
+def top_k_gating(logits: jnp.ndarray, k: int = 1, *,
+                 capacity_factor: float = 1.0, min_capacity: int = 4,
+                 drop_tokens: bool = True) -> GatingOutput:
+    """logits: [tokens, experts]. Implements the reference's top1/top2/topk
+    gating family as one k-generic routine (drop policy = capacity truncation)."""
+    tokens, n_experts = logits.shape
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+
+    # top-k expert choice per token
+    topk_probs, topk_idx = jax.lax.top_k(probs, k)          # [T, k]
+    # renormalize the selected gates (reference top2: gates /= denom)
+    denom = jnp.sum(topk_probs, axis=-1, keepdims=True)
+    topk_gates = topk_probs / jnp.maximum(denom, 1e-9)
+
+    capacity = compute_capacity(tokens, n_experts, k, capacity_factor, min_capacity)
+    if not drop_tokens:
+        capacity = max(capacity, tokens)  # no-drop: every token fits
+
+    # position of each (token, choice) within its expert: priority by token
+    # order within each k-level, k-levels interleaved (reference: top1 first)
+    combine = jnp.zeros((tokens, n_experts, capacity), jnp.float32)
+    prior_count = jnp.zeros((n_experts,), jnp.int32)
+    for level in range(k):
+        idx = topk_idx[:, level]                              # [T]
+        onehot = jax.nn.one_hot(idx, n_experts, dtype=jnp.int32)  # [T, E]
+        pos_in_level = jnp.cumsum(onehot, axis=0) - onehot        # [T, E]
+        pos = pos_in_level + prior_count[None, :]                 # global position
+        pos_tok = jnp.sum(pos * onehot, axis=-1)                  # [T]
+        keep = pos_tok < capacity
+        gate = topk_gates[:, level] * keep
+        combine = combine + (
+            gate[:, None, None]
+            * jax.nn.one_hot(idx, n_experts, dtype=jnp.float32)[:, :, None]
+            * jax.nn.one_hot(jnp.where(keep, pos_tok, 0), capacity,
+                             dtype=jnp.float32)[:, None, :]
+            * keep[:, None, None])
+        prior_count = prior_count + jnp.sum(onehot, axis=0)
+
+    dispatch = combine > 0
+
+    # load-balancing aux loss (reference top1gating l_aux): E * Σ_e f_e · P_e
+    top1_onehot = jax.nn.one_hot(topk_idx[:, 0], n_experts, dtype=jnp.float32)
+    me = jnp.mean(probs, axis=0)            # mean router prob per expert
+    ce = jnp.mean(top1_onehot, axis=0)      # fraction of tokens per expert
+    aux_loss = jnp.sum(me * ce) * n_experts
+
+    return GatingOutput(combine_weights=combine, dispatch_mask=dispatch,
+                        aux_loss=aux_loss, router_probs=probs)

@@ -57,6 +57,10 @@ class ModelSpec:
     logical_axes: Optional[Any] = None
     apply_fn: Optional[Callable[..., Any]] = None
     name: str = "model"
+    # whether the model routes its stacked layers through pipeline_apply when
+    # the mesh has a pipe axis — keeps the partitioner's 'layers'->'pipe' rule
+    # in sync with the model's actual execution path
+    pipeline_capable: bool = True
 
     def materialize(self, rng: jax.Array):
         if self.params is not None:
@@ -132,7 +136,8 @@ class DeepSpeedTPUEngine:
 
         self.partitioner = Partitioner(
             mesh_mgr, zero_stage=config.zero_config.stage,
-            tensor_parallel=mesh_mgr.tp_world_size > 1)
+            tensor_parallel=mesh_mgr.tp_world_size > 1,
+            pipeline_layers=model.pipeline_capable)
         shapes = shapes_of(params)
         if model.logical_axes is not None:
             param_specs = self.partitioner.param_specs(model.logical_axes, shapes)

@@ -53,13 +53,26 @@ def logical_to_spec(axes: Tuple[Optional[str], ...],
 
 
 def _add_zero_axes(spec: P, axes: Tuple[Optional[str], ...], shape: Tuple[int, ...],
-                   zero_size: int, zero_axes: Sequence[str]) -> P:
+                   axis_sizes: Dict[str, int], zero_axes: Sequence[str]) -> P:
     """Shard one currently-unsharded dim over the ZeRO axes. Prefers the
     largest divisible non-'layers' dim (keeps lax.scan over layers clean);
-    falls back to 'layers' if it is the only divisible dim."""
+    falls back to 'layers' if it is the only divisible dim.
+
+    Mesh axes already used by the spec (e.g. 'expert' on expert-bank params)
+    are excluded — the ZeRO group of an expert param is the data axes only,
+    mirroring the reference's expert-data-parallel groups
+    (``utils/groups.py:240-495``). Divisibility is checked against the product
+    of the *remaining* axes."""
+    entries = list(spec)
+    used = set()
+    for e in entries:
+        for a in (e if isinstance(e, tuple) else (e,)):
+            if a is not None:
+                used.add(a)
+    zero_axes = tuple(a for a in zero_axes if a not in used)
+    zero_size = int(np.prod([axis_sizes[a] for a in zero_axes])) if zero_axes else 1
     if zero_size <= 1:
         return spec
-    entries = list(spec)
     candidates = []
     for i, (rule, logical) in enumerate(zip(entries, axes)):
         if rule is not None or i >= len(shape):
@@ -83,13 +96,19 @@ class Partitioner:
     def __init__(self, mesh_mgr: MeshManager, zero_stage: int = 0,
                  rules: Optional[Dict[str, Any]] = None,
                  zero_axes: Sequence[str] = ZERO_AXES,
-                 tensor_parallel: bool = True):
+                 tensor_parallel: bool = True,
+                 pipeline_layers: bool = True):
         self.mm = mesh_mgr
         self.zero_stage = zero_stage
         self.zero_axes = tuple(a for a in zero_axes if mesh_mgr.axis_size(a) > 1)
+        self.axis_sizes = {a: mesh_mgr.axis_size(a) for a in self.zero_axes}
         self.zero_size = int(np.prod([mesh_mgr.axis_size(a) for a in self.zero_axes])) \
             if self.zero_axes else 1
         self.rules = dict(DEFAULT_RULES)
+        if mesh_mgr.pp_world_size > 1 and pipeline_layers:
+            # stacked layer dim lives on the pipe axis (stage-local params);
+            # only when the model actually executes via pipeline_apply
+            self.rules["layers"] = "pipe"
         if rules:
             self.rules.update(rules)
         if not tensor_parallel or mesh_mgr.tp_world_size == 1:
@@ -103,7 +122,7 @@ class Partitioner:
             spec = logical_to_spec(tuple(axes), self.rules)
             if shard_extra:
                 spec = _add_zero_axes(spec, tuple(axes), tuple(shape),
-                                      self.zero_size, self.zero_axes)
+                                      self.axis_sizes, self.zero_axes)
             return spec
 
         return jax.tree.map(one, logical_axes, shapes,

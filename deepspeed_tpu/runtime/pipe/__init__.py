@@ -1,0 +1,3 @@
+from .module import pipeline_apply
+
+__all__ = ["pipeline_apply"]

@@ -1,0 +1,127 @@
+"""Pipeline parallelism — collective GPipe over the 'pipe' mesh axis.
+
+Reference parity: ``runtime/pipe/`` — ``PipelineModule`` (``module.py:86``)
+partitions a LayerSpec list across stages; ``PipelineEngine``
+(``engine.py:60``) executes instruction schedules (``schedule.py``:
+LoadMicroBatch/ForwardPass/SendActivation/RecvActivation/...) with p2p
+send/recv between adjacent ranks (``p2p.py``).
+
+TPU-first: there is no instruction interpreter or p2p runtime. The schedule is
+*compiled*: all stages run the same SPMD program under ``shard_map`` over the
+'pipe' axis; activations move between stages with ``lax.ppermute`` (neighbor
+ICI transfers); microbatches stream through a rotating buffer for
+``M + S - 1`` ticks (GPipe); autodiff through the loop yields the backward
+schedule automatically, with ppermute transposing to the reverse permute —
+the reference's SendGrad/RecvGrad instructions fall out of AD.
+
+Layer assignment: stacked layer params [L, ...] reshape to [S, L/S, ...] and
+shard the leading dim over 'pipe' — the reference's ``partition_method=
+"uniform"``. (Parameter-count balancing is meaningless here because stacked
+layers are homogeneous by construction.)
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ...comm.mesh import get_mesh
+from ...utils.logging import logger
+
+
+def _stage_params(layers: Any, stages: int) -> Any:
+    """[L, ...] → [S, L/S, ...] on every leaf."""
+
+    def reshape(x):
+        L = x.shape[0]
+        if L % stages != 0:
+            raise ValueError(f"num_layers {L} not divisible by pipeline stages {stages}")
+        return x.reshape((stages, L // stages) + x.shape[1:])
+
+    return jax.tree.map(reshape, layers)
+
+
+def pipeline_apply(block_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
+                   layers: Any, x: jnp.ndarray, *,
+                   num_micro: Optional[int] = None,
+                   pipe_axis: str = "pipe") -> jnp.ndarray:
+    """Run stacked layers over the pipeline mesh axis.
+
+    block_fn(layer_params, x) -> x : ONE layer's computation (unstacked).
+    layers: pytree with leading layer dim [L, ...].
+    x: [B, ...] activations entering layer 0.
+    num_micro: microbatches (default = pipe size; B must divide).
+
+    Falls back to a plain lax.scan when the mesh has no pipe axis.
+    """
+    mm = get_mesh()
+    S = mm.axis_size(pipe_axis)
+    if S <= 1:
+        def scan_body(h, layer):
+            return block_fn(layer, h), None
+
+        out, _ = lax.scan(scan_body, x, layers)
+        return out
+
+    M = num_micro or S
+    B = x.shape[0]
+    if B % M != 0:
+        raise ValueError(f"batch {B} not divisible by num_micro {M}")
+    micro = x.reshape((M, B // M) + x.shape[1:])
+    staged = _stage_params(layers, S)
+
+    fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+
+    def stage_fn(stage_layers, h):
+        """L/S layers on this stage."""
+
+        def scan_body(h, layer):
+            return block_fn(layer, h), None
+
+        out, _ = lax.scan(scan_body, h, stage_layers)
+        return out
+
+    def pipelined(staged_layers, micro_local):
+        """Inside shard_map over 'pipe': staged_layers are THIS stage's layer
+        params [1, L/S, ...]; micro_local: all microbatches (replicated)."""
+        stage = lax.axis_index(pipe_axis)
+        my_layers = jax.tree.map(lambda l: l[0], staged_layers)
+        mb_shape = micro_local.shape[1:]
+        state = jnp.zeros(mb_shape, micro_local.dtype)   # rotating buffer
+        outputs = jnp.zeros_like(micro_local)            # filled at last stage
+
+        def tick(t, carry):
+            state, outputs = carry
+            # stage 0 injects microbatch t (clamped index keeps it static-safe)
+            inject = micro_local[jnp.clip(t, 0, M - 1)]
+            h = jnp.where(stage == 0, inject, state)
+            out = stage_fn(my_layers, h)
+            # last stage records its finished microbatch m = t - (S-1)
+            m = t - (S - 1)
+            is_done = jnp.logical_and(stage == S - 1, m >= 0)
+            outputs = lax.dynamic_update_index_in_dim(
+                outputs,
+                jnp.where(is_done, out, lax.dynamic_index_in_dim(
+                    outputs, jnp.clip(m, 0, M - 1), 0, keepdims=False)),
+                jnp.clip(m, 0, M - 1), 0)
+            state = lax.ppermute(out, pipe_axis, fwd_perm)
+            return state, outputs
+
+        state, outputs = lax.fori_loop(0, M + S - 1, tick, (state, outputs))
+        # non-last stages hold zeros; psum over 'pipe' broadcasts the results
+        return lax.psum(outputs, pipe_axis)
+
+    # Manual ONLY over 'pipe' (axis_names): data/tensor/seq/expert stay under
+    # the automatic partitioner, so TP-sharded layer weights remain sharded
+    # inside each stage and the batch keeps its dp sharding.
+    out = jax.shard_map(
+        pipelined, mesh=mm.mesh, axis_names={pipe_axis},
+        in_specs=(jax.tree.map(lambda _: P(pipe_axis), staged), P()),
+        out_specs=P(), check_vma=False)(staged, micro)
+    return out.reshape((B,) + out.shape[2:])

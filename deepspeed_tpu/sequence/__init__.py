@@ -1,0 +1,4 @@
+from .layer import DistributedAttention, ulysses_attention
+from .ring import ring_attention
+
+__all__ = ["DistributedAttention", "ulysses_attention", "ring_attention"]

@@ -1,0 +1,95 @@
+"""Ulysses sequence parallelism — all-to-all attention.
+
+Reference parity: ``deepspeed/sequence/layer.py`` (``_SeqAllToAll`` :277,
+``DistributedAttention`` :331, ``single_all_to_all`` :221): shard the sequence
+across ranks; before attention, all-to-all trades seq-sharding for
+head-sharding (each rank sees the FULL sequence for ``heads/sp`` heads), run
+full attention locally, all-to-all back. Activation memory O(S/P); two
+all-to-alls per attention call.
+
+TPU-first: under jit/SPMD the all-to-all is expressed as a *sharding
+constraint flip* — activations enter sharded ``[B, S/sp, H, D]`` and we
+constrain the attention inputs to ``[B, S, H/sp, D]``; XLA inserts the
+all-to-all over ICI (this is exactly the reference's a2a, scheduled by the
+compiler). An explicit ``shard_map`` variant is provided for manual control
+and for the uneven-head case.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..comm import comm as dist
+from ..comm.mesh import BATCH_AXES, get_mesh
+from ..ops.attention import attention as default_attention
+
+
+def _constraint(x, spec: P):
+    mesh = get_mesh().mesh
+    return lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
+def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
+                      inner: Optional[Callable] = None,
+                      seq_axis: str = "seq", **kwargs) -> jnp.ndarray:
+    """SPMD Ulysses: q/k/v [batch, seq, heads, dim] logically seq-sharded;
+    constrain to head-sharded for the inner (full-sequence) attention, then
+    constrain the output back to seq-sharded.
+
+    When the mesh has no seq axis (sp=1) this is a no-op wrapper around the
+    inner attention.
+    """
+    inner = inner or default_attention
+    mm = get_mesh()
+    if mm.axis_size(seq_axis) <= 1:
+        return inner(q, k, v, **kwargs)
+
+    n_heads = q.shape[-2]
+    sp = mm.axis_size(seq_axis)
+    if n_heads % sp != 0:
+        # uneven heads (reference supports via padding, layer.py:111):
+        # fall back to gathering the sequence instead
+        out_spec = P(BATCH_AXES, seq_axis)
+        q = _constraint(q, P(BATCH_AXES))
+        k = _constraint(k, P(BATCH_AXES))
+        v = _constraint(v, P(BATCH_AXES))
+        out = inner(q, k, v, **kwargs)
+        return _constraint(out, out_spec)
+
+    head_sharded = P(BATCH_AXES, None, seq_axis, None)   # [B, S, H/sp, D]
+    seq_sharded = P(BATCH_AXES, seq_axis, None, None)    # [B, S/sp, H, D]
+    q = _constraint(q, head_sharded)
+    k = _constraint(k, head_sharded)
+    v = _constraint(v, head_sharded)
+    out = inner(q, k, v, **kwargs)   # full attention on H/sp heads
+    return _constraint(out, seq_sharded)
+
+
+class DistributedAttention:
+    """Reference-shaped wrapper (``DistributedAttention(local_attn, group)``).
+    ``scatter_idx``/``gather_idx`` are accepted for API parity; the SPMD
+    implementation always scatters heads / gathers sequence."""
+
+    def __init__(self, local_attention: Optional[Callable] = None,
+                 sequence_process_group: Optional[str] = "seq",
+                 scatter_idx: int = 2, gather_idx: int = 1):
+        self.local_attn = local_attention or default_attention
+        self.seq_axis = sequence_process_group if isinstance(
+            sequence_process_group, str) else "seq"
+
+    def __call__(self, query, key, value, *args, **kwargs):
+        return ulysses_attention(query, key, value, inner=self.local_attn,
+                                 seq_axis=self.seq_axis, **kwargs)
+
+
+def all_to_all_shard_map(x: jnp.ndarray, *, seq_axis: str = "seq",
+                         scatter_dim: int = 2, gather_dim: int = 1) -> jnp.ndarray:
+    """Explicit single all-to-all (reference ``single_all_to_all``) for use
+    inside ``shard_map`` regions: scatter ``scatter_dim`` across the axis,
+    gather ``gather_dim``."""
+    return dist.all_to_all(x, seq_axis, split_axis=scatter_dim, concat_axis=gather_dim)

@@ -1,0 +1,119 @@
+"""Ring (blockwise) context parallelism — the ICI-native long-context path.
+
+The reference has NO ring attention (its long-context stack is Ulysses a2a +
+FPDT chunking + ALST tiling — SURVEY.md §5.7); on TPU the ICI torus makes a
+ring the idiomatic *additional* option, so this framework provides it
+first-class: KV blocks rotate around the 'seq' axis via ``ppermute`` while
+each rank keeps its query block, with flash-style online-softmax rescaling
+across blocks (the same rescaling FPDT implements for its chunked pipeline,
+``deepspeed/sequence/fpdt_layer.py`` — cited for capability parity).
+
+Memory: O(S/P) activations per chip, no S×S materialization. Comm: P-1
+point-to-point KV block transfers per attention, all riding neighbor ICI
+links (vs. Ulysses' global a2a) — the better choice when heads < sp or for
+very long sequences.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..comm import comm as dist
+from ..comm.mesh import BATCH_AXES, get_mesh
+from ..ops.attention import repeat_kv
+
+NEG_INF = -1e30
+
+
+def _block_attn_update(q, k, v, m, l, acc, *, scale, mask):
+    """One flash-attention block update with online softmax stats.
+
+    q: [B, Sq, H, D]; k/v: [B, Skv, H, D]; m/l: [B, H, Sq]; acc: [B, Sq, H, D];
+    mask: [Sq, Skv] boolean (True = attend) or None.
+    """
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if mask is not None:
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    m_blk = jnp.max(s, axis=-1)                     # [B, H, Sq]
+    m_new = jnp.maximum(m, m_blk)
+    # guard fully-masked rows (m_new == NEG_INF): keep stats unchanged
+    alive = m_new > NEG_INF / 2
+    corr = jnp.where(alive, jnp.exp(m - m_new), 1.0)
+    p = jnp.exp(s - m_new[..., None])
+    p = jnp.where(alive[..., None], p, 0.0)
+    l_new = l * corr + jnp.sum(p, axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+                    preferred_element_type=jnp.float32)
+    acc_new = acc * corr.transpose(0, 2, 1)[..., None] + pv
+    m = jnp.where(alive, m_new, m)
+    return m, l_new, acc_new
+
+
+def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
+                   axis: str = "seq", axis_size: Optional[int] = None,
+                   causal: bool = True, scale: Optional[float] = None) -> jnp.ndarray:
+    """Call INSIDE shard_map over ``axis``. q/k/v: local blocks [B, S/P, H, D]
+    (kv may have fewer heads — GQA). Returns local output block."""
+    p_size = axis_size if axis_size is not None else dist.axis_size(axis)
+    my = lax.axis_index(axis)
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    k = repeat_kv(k, q.shape[-2])
+    v = repeat_kv(v, q.shape[-2])
+
+    b, sq, h, d = q.shape
+    qf = q.astype(jnp.float32)
+    m0 = jnp.full((b, h, sq), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, sq), jnp.float32)
+    acc0 = jnp.zeros((b, sq, h, d), jnp.float32)
+
+    row = jnp.arange(sq)[:, None]
+    col = jnp.arange(k.shape[1])[None, :]
+    fwd_perm = [(i, (i + 1) % p_size) for i in range(p_size)]
+
+    def body(t, carry):
+        m, l, acc, kt, vt = carry
+        src = (my - t) % p_size          # owner of the kv block now held
+        if causal:
+            # block-level causal: attend fully if src < my, diagonal if ==
+            full = src < my
+            diag = src == my
+            block_mask = jnp.where(diag, row >= col,
+                                   jnp.broadcast_to(full, (sq, k.shape[1])))
+        else:
+            block_mask = None
+        m, l, acc = _block_attn_update(qf, kt.astype(jnp.float32), vt,
+                                       m, l, acc, scale=scale, mask=block_mask)
+        kt = lax.ppermute(kt, axis, fwd_perm)
+        vt = lax.ppermute(vt, axis, fwd_perm)
+        return m, l, acc, kt, vt
+
+    m, l, acc, _, _ = lax.fori_loop(0, p_size, body, (m0, l0, acc0, k, v))
+    l = jnp.maximum(l, 1e-20)
+    out = acc / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention_spmd(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
+                        seq_axis: str = "seq", causal: bool = True,
+                        scale: Optional[float] = None) -> jnp.ndarray:
+    """jit-level wrapper: q/k/v are GLOBAL [B, S, H, D] arrays (seq-sharded or
+    not); runs ring attention under shard_map over the mesh seq axis."""
+    mm = get_mesh()
+    sp = mm.axis_size(seq_axis)
+    if sp <= 1:
+        from ..ops.attention import attention
+
+        return attention(q, k, v, causal=causal, scale=scale)
+
+    spec = P(BATCH_AXES, seq_axis, None, None)
+    fn = partial(ring_attention, axis=seq_axis, axis_size=sp, causal=causal,
+                 scale=scale)
+    return jax.shard_map(fn, mesh=mm.mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, check_vma=False)(q, k, v)
