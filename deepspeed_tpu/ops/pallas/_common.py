@@ -1,0 +1,19 @@
+"""Shared helpers for the Pallas kernel tier."""
+
+from __future__ import annotations
+
+import jax
+
+
+def interpret() -> bool:
+    """Run kernels through the Pallas interpreter off-TPU (tests select the
+    pallas backend explicitly on the CPU mesh)."""
+    return jax.default_backend() != "tpu"
+
+
+def row_block(n_rows: int) -> int:
+    """Largest power-of-two row-block (≤256) that divides n_rows."""
+    for b in (256, 128, 64, 32, 16, 8):
+        if n_rows % b == 0:
+            return b
+    return 1

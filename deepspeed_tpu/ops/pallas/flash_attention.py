@@ -1,0 +1,362 @@
+"""Blockwise (flash) attention Pallas kernel, forward + backward.
+
+Reference parity: the reference leans on external flash-attention CUDA kernels
+for its long-sequence paths (``deepspeed/sequence/fpdt_layer.py`` imports
+``flash_attn_func``; inference v2 ragged attention wraps blocked flash
+attention kernels). This is the TPU-native equivalent: an online-softmax
+blockwise attention kernel that never materializes the [Sq, Skv] score matrix
+in HBM, tiled for the MXU (128-lane blocks), with a flash-style backward pass
+(recompute scores per block from the saved logsumexp).
+
+Layout is [batch, seq, heads, head_dim] at the API boundary (matching
+``ops.attention``); kernels run on [batch*heads, seq, head_dim].
+
+Grid design (forward): (BH, num_q_blocks, num_kv_blocks) with the kv loop as
+the innermost (sequential on TPU) dimension; running max / sum / accumulator
+live in VMEM scratch that persists across kv steps. Backward uses two kernels:
+one accumulating dQ over kv blocks, one accumulating dK/dV over q blocks.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pltpu is importable on CPU builds too, but guard anyway
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+from ._common import interpret as _interpret
+
+NEG_INF = -1e30
+
+# lse/delta are stored lane-replicated as [..., 128] fp32 — the Mosaic-friendly
+# layout (matches the official JAX TPU flash-attention kernels); costs 128x the
+# minimal HBM for these small per-row stats in exchange for layout-change-free
+# VMEM reads in the backward kernels.
+
+
+def _block(n: int, pref: int = 128) -> int:
+    return min(pref, max(8, 1 << (n - 1).bit_length())) if n < pref else pref
+
+
+def _pad_to(x: jnp.ndarray, axis: int, mult: int) -> jnp.ndarray:
+    n = x.shape[axis]
+    pad = (-n) % mult
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+# --------------------------------------------------------------------------- #
+# forward
+# --------------------------------------------------------------------------- #
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
+                scale, causal, bq, bkv, kv_len, q_offset, nkv):
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    qi = pl.program_id(1)
+    # causal: kv blocks strictly above the diagonal band contribute nothing —
+    # skip their compute entirely (the reference's flash kernels do the same).
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)          # [bq, d]
+        k = k_ref[0].astype(jnp.float32)          # [bkv, d]
+        v = v_ref[0].astype(jnp.float32)          # [bkv, d]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+
+        q_idx = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 0) + q_offset
+        kv_idx = ki * bkv + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 1)
+        mask = kv_idx < kv_len
+        if causal:
+            mask = jnp.logical_and(mask, kv_idx <= q_idx)
+        s = jnp.where(mask, s, NEG_INF)
+
+        m_prev = m_scr[...]                       # [bq, 128] (lane-replicated)
+        l_prev = l_scr[...]
+        m_curr = jnp.max(s, axis=1, keepdims=True)            # [bq, 1]
+        m_new = jnp.maximum(m_prev, jnp.broadcast_to(m_curr, m_prev.shape))
+        alpha = jnp.exp(m_prev - m_new)                        # [bq, 128]
+        p = jnp.exp(s - m_new[:, :1])                          # [bq, bkv]
+        l_new = l_prev * alpha + jnp.broadcast_to(
+            jnp.sum(p, axis=1, keepdims=True), l_prev.shape)
+        acc_scr[...] = acc_scr[...] * alpha[:, :1] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        m_scr[...] = m_new
+        l_scr[...] = l_new
+
+    if causal:
+        pl.when(ki * bkv <= qi * bq + (bq - 1) + q_offset)(_compute)
+    else:
+        _compute()
+
+    @pl.when(ki == nkv - 1)
+    def _finish():
+        l = l_scr[...]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_scr[...] / l_safe[:, :1]).astype(o_ref.dtype)
+        lse_ref[0] = m_scr[...] + jnp.log(l_safe)
+
+
+def _flash_fwd(q, k, v, *, causal, scale, q_offset):
+    """q/k/v: [BH, S, d] → (o [BH, Sq, d], lse [BH, Sq, 128])."""
+    bh, sq, d = q.shape
+    kv_len = k.shape[1]
+    bq = _block(sq)
+    bkv = _block(kv_len)
+    qp = _pad_to(q, 1, bq)
+    kp = _pad_to(k, 1, bkv)
+    vp = _pad_to(v, 1, bkv)
+    nq = qp.shape[1] // bq
+    nkv = kp.shape[1] // bkv
+
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, bq=bq, bkv=bkv,
+        kv_len=kv_len, q_offset=q_offset, nkv=nkv)
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=(bh, nq, nkv),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bkv, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bkv, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, 128), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, qp.shape[1], d), q.dtype),
+            jax.ShapeDtypeStruct((bh, qp.shape[1], 128), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, d), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(qp, kp, vp)
+    return o[:, :sq], lse[:, :sq]
+
+
+# --------------------------------------------------------------------------- #
+# backward
+# --------------------------------------------------------------------------- #
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   dq_scr, *, scale, causal, bq, bkv, kv_len, q_offset, nkv):
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    qi = pl.program_id(1)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, :1]                   # [bq, 1]
+        delta = delta_ref[0][:, :1]
+
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        q_idx = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 0) + q_offset
+        kv_idx = ki * bkv + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 1)
+        mask = kv_idx < kv_len
+        if causal:
+            mask = jnp.logical_and(mask, kv_idx <= q_idx)
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)            # [bq, bkv]
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dq_scr[...] += jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
+                                           preferred_element_type=jnp.float32)
+
+    if causal:
+        pl.when(ki * bkv <= qi * bq + (bq - 1) + q_offset)(_compute)
+    else:
+        _compute()
+
+    @pl.when(ki == nkv - 1)
+    def _finish():
+        dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_scr, dv_scr, *, scale, causal, bq, bkv,
+                    kv_len, q_offset, nq):
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    ki = pl.program_id(1)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, :1]
+        delta = delta_ref[0][:, :1]
+
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        q_idx = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 0) + q_offset
+        kv_idx = ki * bkv + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 1)
+        mask = kv_idx < kv_len
+        if causal:
+            mask = jnp.logical_and(mask, kv_idx <= q_idx)
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dv_scr[...] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                                           preferred_element_type=jnp.float32)
+        dk_scr[...] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                           preferred_element_type=jnp.float32)
+
+    if causal:
+        pl.when(ki * bkv <= qi * bq + (bq - 1) + q_offset)(_compute)
+    else:
+        _compute()
+
+    @pl.when(qi == nq - 1)
+    def _finish():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _flash_bwd(q, k, v, o, lse, do, *, causal, scale, q_offset):
+    bh, sq, d = q.shape
+    kv_len = k.shape[1]
+    bq = _block(sq)
+    bkv = _block(kv_len)
+    qp = _pad_to(q, 1, bq)
+    kp = _pad_to(k, 1, bkv)
+    vp = _pad_to(v, 1, bkv)
+    dop = _pad_to(do, 1, bq)
+    nq = qp.shape[1] // bq
+    nkv = kp.shape[1] // bkv
+
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    delta = jnp.broadcast_to(delta[..., None], delta.shape + (128,))
+    delta = _pad_to(delta, 1, bq)
+    lsep = _pad_to(lse, 1, bq)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal, bq=bq,
+                          bkv=bkv, kv_len=kv_len, q_offset=q_offset, nkv=nkv),
+        grid=(bh, nq, nkv),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bkv, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bkv, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, 128), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, 128), lambda b, i, j: (b, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, qp.shape[1], d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        interpret=_interpret(),
+    )(qp, kp, vp, dop, lsep, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal, bq=bq,
+                          bkv=bkv, kv_len=kv_len, q_offset=q_offset, nq=nq),
+        grid=(bh, nkv, nq),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, bkv, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bkv, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, bq, 128), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, bq, 128), lambda b, j, i: (b, i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bkv, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bkv, d), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, kp.shape[1], d), k.dtype),
+            jax.ShapeDtypeStruct((bh, kp.shape[1], d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bkv, d), jnp.float32),
+            pltpu.VMEM((bkv, d), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(qp, kp, vp, dop, lsep, delta)
+    return dq[:, :sq], dk[:, :kv_len], dv[:, :kv_len]
+
+
+# --------------------------------------------------------------------------- #
+# differentiable wrapper ([BH, S, d] layout)
+# --------------------------------------------------------------------------- #
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash(q, k, v, causal, scale, q_offset):
+    o, _ = _flash_fwd(q, k, v, causal=causal, scale=scale, q_offset=q_offset)
+    return o
+
+
+def _flash_vjp_fwd(q, k, v, causal, scale, q_offset):
+    o, lse = _flash_fwd(q, k, v, causal=causal, scale=scale, q_offset=q_offset)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_vjp_bwd(causal, scale, q_offset, res, do):
+    q, k, v, o, lse = res
+    dq, dk, dv = _flash_bwd(q, k, v, o, lse, do, causal=causal, scale=scale,
+                            q_offset=q_offset)
+    return dq, dk, dv
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
+                    causal: bool = True, scale: Optional[float] = None,
+                    mask: Optional[jnp.ndarray] = None,
+                    q_offset: int = 0) -> jnp.ndarray:
+    """Drop-in for ``ops.attention.attention_xla``: [B, S, H, D] layout, GQA
+    K/V broadcast, fp32 accumulation. Arbitrary additive masks fall back to
+    the XLA implementation (the kernel handles causal + length masking)."""
+    if mask is not None:
+        from ..attention import attention_xla
+
+        return attention_xla(q, k, v, causal=causal, scale=scale, mask=mask,
+                             q_offset=q_offset)
+    from ..attention import repeat_kv
+
+    b, sq, h, d = q.shape
+    k = repeat_kv(k, h)
+    v = repeat_kv(v, h)
+    kv_len = k.shape[1]
+    scale = scale if scale is not None else d ** -0.5
+
+    def to_bh(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
+
+    o = _flash(to_bh(q), to_bh(k), to_bh(v), causal, float(scale), int(q_offset))
+    return o.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+
+
+from ..registry import register  # noqa: E402
+
+register("attention", backend="pallas")(flash_attention)

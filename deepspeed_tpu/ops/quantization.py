@@ -1,0 +1,40 @@
+"""Quantization ops — XLA reference implementations (always available).
+
+Reference parity: ``csrc/quantization/quantize.cu`` and the
+``deepspeed/ops/quantizer`` binding: symmetric per-group int8 with fp32
+scales (scale = max|x| / 127 per group). The Pallas kernel tier registers
+faster TPU implementations under the same op names
+(``ops/pallas/quantize.py``); these XLA versions are the guaranteed fallback
+on any backend. Quantized-collective compositions (ZeRO++-style qwZ/qgZ)
+build on these ops in ``deepspeed_tpu/comm``.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import op, register
+
+
+@register("quantize_int8", backend="xla")
+def quantize_int8_xla(x: jnp.ndarray, group_size: int = 2048):
+    """x: any shape with size % group_size == 0 →
+    (int8 values same shape, fp32 scales [n_groups])."""
+    shape = x.shape
+    x2 = x.reshape(-1, group_size).astype(jnp.float32)
+    amax = jnp.max(jnp.abs(x2), axis=-1, keepdims=True)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(x2 / scale), -127, 127).astype(jnp.int8)
+    return q.reshape(shape), scale[:, 0]
+
+
+@register("dequantize_int8", backend="xla")
+def dequantize_int8_xla(q: jnp.ndarray, scales: jnp.ndarray,
+                        group_size: int = 2048, dtype=jnp.float32):
+    shape = q.shape
+    q2 = q.reshape(-1, group_size).astype(jnp.float32)
+    return (q2 * scales[:, None]).astype(dtype).reshape(shape)
+
+
+quantize_int8 = op("quantize_int8")
+dequantize_int8 = op("dequantize_int8")
