@@ -213,10 +213,14 @@ def apply(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray, *,
     attn_fn = _resolve_attention(cfg, in_pipeline=pipe_stages > 1)
     block = partial(_block, cfg, attn_fn=attn_fn)
     if cfg.remat:
-        policy = None
-        if cfg.remat_policy == "dots":
-            policy = jax.checkpoint_policies.checkpoint_dots
-        block = jax.checkpoint(block, policy=policy)
+        # route through the shared remat-policy registry
+        # (runtime/activation_checkpointing) so the config knob and the model
+        # agree on policy names
+        from ..runtime.activation_checkpointing import checkpointing as ac
+
+        name = {"none": "full", "full": "full",
+                "dots": "dots_saveable"}.get(cfg.remat_policy, cfg.remat_policy)
+        block = jax.checkpoint(block, policy=ac.get_policy(name))
 
     if pipe_stages > 1:
         from ..runtime.pipe import pipeline_apply

@@ -1,0 +1,8 @@
+from .checkpointing import (CheckpointFunction, checkpoint, configure,
+                            get_policy, is_configured, model_parallel_cuda_manual_seed,
+                            reset)
+
+__all__ = [
+    "CheckpointFunction", "checkpoint", "configure", "get_policy",
+    "is_configured", "model_parallel_cuda_manual_seed", "reset",
+]

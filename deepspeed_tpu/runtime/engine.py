@@ -175,6 +175,15 @@ class DeepSpeedTPUEngine:
         if training_data is not None:
             self.training_dataloader = self.deepspeed_io(training_data)
 
+        # make the config's remat policy the process-wide default for
+        # activation_checkpointing.checkpoint() (reference engine wires
+        # checkpointing.configure at init, runtime/engine.py:395-408 region)
+        if config.activation_checkpointing.policy != "none" or \
+                config.activation_checkpointing.cpu_checkpointing:
+            from .activation_checkpointing import checkpointing as _ac
+
+            _ac.configure(deepspeed_config=config)
+
         self.tput_timer = ThroughputTimer(
             batch_size=self.train_batch_size(),
             steps_per_output=config.steps_per_print)
@@ -461,6 +470,21 @@ class DeepSpeedTPUEngine:
         from .checkpoint.saver import load_checkpoint as _load
 
         return _load(self, load_dir, tag=tag)
+
+    # ------------------------------------------------------------------ #
+    # state offload (reference runtime/engine.py:4533 offload_states)
+    # ------------------------------------------------------------------ #
+    def offload_states(self, include=None, device: str = "cpu",
+                       pin_memory: bool = True, non_blocking: bool = False):
+        from .offload_states import offload_engine_states
+
+        offload_engine_states(self, include=include, device=device,
+                              pin_memory=pin_memory, non_blocking=non_blocking)
+
+    def reload_states(self, non_blocking: bool = False):
+        from .offload_states import reload_engine_states
+
+        reload_engine_states(self, non_blocking=non_blocking)
 
 
 # --------------------------------------------------------------------------- #

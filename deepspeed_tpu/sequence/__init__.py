@@ -1,4 +1,12 @@
 from .layer import DistributedAttention, ulysses_attention
 from .ring import ring_attention
+from .fpdt import FPDT_Attention, fpdt_attention, fpdt_ffn, fpdt_logits_loss
+from .tiled import (TiledFusedLogitsLoss, TiledMLP, sequence_tiled_compute,
+                    tiled_fused_logits_loss, tiled_mlp)
 
-__all__ = ["DistributedAttention", "ulysses_attention", "ring_attention"]
+__all__ = [
+    "DistributedAttention", "ulysses_attention", "ring_attention",
+    "FPDT_Attention", "fpdt_attention", "fpdt_ffn", "fpdt_logits_loss",
+    "TiledFusedLogitsLoss", "TiledMLP", "sequence_tiled_compute",
+    "tiled_fused_logits_loss", "tiled_mlp",
+]

@@ -1,0 +1,133 @@
+"""FPDT — Fully Pipelined Distributed Transformer (chunked long-sequence path).
+
+Capability parity with the reference's Ulysses-Offload
+(``deepspeed/sequence/fpdt_layer.py``: ``_FPDTGPUOffloadingAttentionImpl_``
+:511, ``FPDT_Attention`` :972, ``FPDT_FFN`` :1057, ``FPDT_LogitsLoss`` :1138,
+``SequenceChunk`` :463): split an extreme-length sequence into chunks, stream
+chunks through attention with online-softmax rescaling across chunks, and keep
+only the live chunk's activations in accelerator memory — the reference
+double-buffers KV chunks between GPU and host to reach 2M tokens on 4×A100.
+
+TPU-first redesign: the chunk pipeline is a ``lax.scan`` over query chunks
+with an inner masked pass over KV chunks (flash-style online softmax, shared
+with ring attention's block update) — XLA keeps one chunk's working set live.
+Host residency of the non-live KV chunks is expressed with the remat
+*offload* policy (residuals stream to ``pinned_host`` between forward and
+backward) rather than hand-rolled double buffering — see
+``runtime/activation_checkpointing``. FFN and logits-loss chunking reuse the
+ALST tiled compute (``sequence/tiled.py``), which the reference also does
+conceptually (both are position-wise tilings).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops.attention import repeat_kv
+from .ring import NEG_INF, _block_attn_update
+from .tiled import tiled_fused_logits_loss, tiled_mlp
+
+
+def fpdt_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
+                   chunks: int = 4, causal: bool = True,
+                   scale: Optional[float] = None,
+                   offload: bool = False) -> jnp.ndarray:
+    """Chunked causal attention with online softmax across KV chunks.
+
+    q/k/v: [B, S, H, D] (kv may be GQA-narrow). Peak live score tensor is
+    [B, H, S/chunks, S/chunks] instead of [B, H, S, S]. With ``offload=True``
+    the per-chunk bodies run under the host-offload remat policy.
+    """
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    k = repeat_kv(k, q.shape[-2])
+    v = repeat_kv(v, q.shape[-2])
+    B, S, H, D = q.shape
+    assert S % chunks == 0, f"seq {S} % chunks {chunks} != 0"
+    c = S // chunks
+
+    q_t = q.reshape(B, chunks, c, H, D).transpose(1, 0, 2, 3, 4)
+    k_t = k.reshape(B, chunks, c, H, D).transpose(1, 0, 2, 3, 4)
+    v_t = v.reshape(B, chunks, c, H, D).transpose(1, 0, 2, 3, 4)
+
+    row = jnp.arange(c)[:, None]
+    col = jnp.arange(c)[None, :]
+
+    def q_chunk_attn(qi, q_blk):
+        """Attend query chunk qi over all (≤qi if causal) KV chunks."""
+        qf = q_blk.astype(jnp.float32)
+        m0 = jnp.full((B, H, c), NEG_INF, jnp.float32)
+        l0 = jnp.zeros((B, H, c), jnp.float32)
+        acc0 = jnp.zeros((B, c, H, D), jnp.float32)
+
+        def kv_body(carry, blk):
+            kj_idx, k_blk, v_blk = blk
+
+            def update(carry):
+                m, l, acc = carry
+                if causal:
+                    # full block if kj < qi, diagonal if ==
+                    diag = kj_idx == qi
+                    mask = jnp.where(diag, row >= col,
+                                     jnp.ones((c, c), bool))
+                else:
+                    mask = None
+                return _block_attn_update(qf, k_blk.astype(jnp.float32),
+                                          v_blk, m, l, acc,
+                                          scale=scale, mask=mask)
+
+            if causal:
+                # strictly-future KV blocks contribute nothing — skip their
+                # matmuls at runtime (shapes stay static under lax.cond)
+                carry = lax.cond(kj_idx <= qi, update, lambda carry: carry,
+                                 carry)
+            else:
+                carry = update(carry)
+            return carry, None
+
+        (m, l, acc), _ = lax.scan(
+            kv_body, (m0, l0, acc0),
+            (jnp.arange(chunks), k_t, v_t))
+        l = jnp.maximum(l, 1e-20)
+        return (acc / l.transpose(0, 2, 1)[..., None]).astype(q.dtype)
+
+    if offload:
+        from ..runtime.activation_checkpointing import checkpointing as ac
+
+        q_chunk_attn = jax.checkpoint(q_chunk_attn,
+                                      policy=ac.get_policy("offload"))
+    else:
+        q_chunk_attn = jax.checkpoint(q_chunk_attn)
+
+    def outer(carry, blk):
+        qi, q_blk = blk
+        return carry, q_chunk_attn(qi, q_blk)
+
+    _, out_t = lax.scan(outer, None, (jnp.arange(chunks), q_t))
+    return out_t.transpose(1, 0, 2, 3, 4).reshape(B, S, H, D)
+
+
+# name-parity wrappers matching the reference's module names --------------- #
+class FPDT_Attention:
+    """Reference ``FPDT_Attention`` (fpdt_layer.py:972)."""
+
+    def __init__(self, chunks: int = 4, causal: bool = True,
+                 offload: bool = True):
+        self.chunks, self.causal, self.offload = chunks, causal, offload
+
+    def __call__(self, q, k, v, **kw):
+        return fpdt_attention(q, k, v, chunks=self.chunks, causal=self.causal,
+                              offload=self.offload, **kw)
+
+
+def fpdt_ffn(mlp_fn, params, x, *, chunks: int = 4):
+    """Reference ``FPDT_FFN`` (fpdt_layer.py:1057) — chunked FFN == tiled MLP."""
+    return tiled_mlp(mlp_fn, params, x, shards=chunks)
+
+
+def fpdt_logits_loss(hidden, unembed, labels, *, chunks: int = 8, **kw):
+    """Reference ``FPDT_LogitsLoss`` (fpdt_layer.py:1138)."""
+    return tiled_fused_logits_loss(hidden, unembed, labels, shards=chunks, **kw)
