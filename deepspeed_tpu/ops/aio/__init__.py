@@ -1,0 +1,3 @@
+from .handle import AIOHandle, aio_available
+
+__all__ = ["AIOHandle", "aio_available"]

@@ -1,0 +1,154 @@
+"""Python handle over the native async file I/O engine.
+
+Capability parity with the reference's ``aio_handle``
+(``csrc/aio/py_lib/py_ds_aio.cpp:22``): sync ``read``/``write``, async
+``pread``/``pwrite`` against numpy buffers, ``wait()`` to drain. Backed by
+the thread-pooled C++ engine in ``csrc/aio.cpp``; a pure-Python
+ThreadPoolExecutor fallback keeps the API available without a toolchain.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import List, Optional
+
+import numpy as np
+
+from ..op_builder import AsyncIOBuilder
+
+_voidp = ctypes.c_void_p
+_charp = ctypes.c_char_p
+
+
+def _lib():
+    lib = AsyncIOBuilder().load()
+    if lib is not None and not getattr(lib, "_ds_typed", False):
+        lib.ds_aio_create.restype = _voidp
+        lib.ds_aio_create.argtypes = [ctypes.c_int64, ctypes.c_int]
+        lib.ds_aio_destroy.argtypes = [_voidp]
+        for fn in (lib.ds_aio_pread, lib.ds_aio_pwrite):
+            fn.argtypes = [_voidp, _charp, _voidp, ctypes.c_int64,
+                           ctypes.c_int64]
+        lib.ds_aio_wait.argtypes = [_voidp]
+        lib.ds_aio_wait.restype = ctypes.c_int64
+        for fn in (lib.ds_aio_read_sync, lib.ds_aio_write_sync):
+            fn.argtypes = [_voidp, _charp, _voidp, ctypes.c_int64]
+            fn.restype = ctypes.c_int64
+        lib.ds_aio_file_size.argtypes = [_charp]
+        lib.ds_aio_file_size.restype = ctypes.c_int64
+        lib._ds_typed = True
+    return lib
+
+
+def aio_available() -> bool:
+    return _lib() is not None
+
+
+class AIOHandle:
+    """``aio_handle(block_size, queue_depth, single_submit,
+    overlap_events, num_threads)``-equivalent. queue_depth/single_submit/
+    overlap_events are accepted for config parity; the thread-pool engine
+    subsumes them."""
+
+    def __init__(self, block_size: int = 1 << 20, queue_depth: int = 8,
+                 single_submit: bool = False, overlap_events: bool = True,
+                 num_threads: int = 4):
+        self.block_size = block_size
+        self.num_threads = num_threads
+        self._lib = _lib()
+        self._h = None
+        self._pool: Optional[ThreadPoolExecutor] = None
+        self._futures: List[Future] = []
+        if self._lib is not None:
+            self._h = self._lib.ds_aio_create(block_size, num_threads)
+        else:
+            self._pool = ThreadPoolExecutor(max_workers=num_threads)
+
+    # -- async ---------------------------------------------------------- #
+    def pread(self, buffer: np.ndarray, filename: str, offset: int = 0):
+        assert buffer.flags.c_contiguous
+        if self._h is not None:
+            self._lib.ds_aio_pread(self._h, filename.encode(),
+                                   buffer.ctypes.data_as(_voidp),
+                                   buffer.nbytes, offset)
+        else:
+            self._futures.append(
+                self._pool.submit(self._py_read, buffer, filename, offset))
+
+    def pwrite(self, buffer: np.ndarray, filename: str, offset: int = 0):
+        assert buffer.flags.c_contiguous
+        if self._h is not None:
+            self._lib.ds_aio_pwrite(self._h, filename.encode(),
+                                    buffer.ctypes.data_as(_voidp),
+                                    buffer.nbytes, offset)
+        else:
+            self._futures.append(
+                self._pool.submit(self._py_write, buffer, filename, offset))
+
+    def wait(self) -> int:
+        """Drain; returns number of failed requests (0 on success)."""
+        if self._h is not None:
+            return int(self._lib.ds_aio_wait(self._h))
+        errs = 0
+        for f in self._futures:
+            try:
+                f.result()
+            except OSError:
+                errs += 1
+        self._futures.clear()
+        return errs
+
+    # -- sync ----------------------------------------------------------- #
+    def read(self, buffer: np.ndarray, filename: str) -> int:
+        self.pread(buffer, filename)
+        return self.wait()
+
+    def write(self, buffer: np.ndarray, filename: str) -> int:
+        self.pwrite(buffer, filename)
+        return self.wait()
+
+    # -- misc ----------------------------------------------------------- #
+    @staticmethod
+    def _py_read(buffer: np.ndarray, filename: str, offset: int):
+        with open(filename, "rb") as f:
+            f.seek(offset)
+            data = f.read(buffer.nbytes)
+        if len(data) < buffer.nbytes:
+            raise IOError(f"short read from {filename}: "
+                          f"{len(data)}/{buffer.nbytes} bytes")
+        buffer.view(np.uint8).reshape(-1)[:] = np.frombuffer(data, np.uint8)
+
+    @staticmethod
+    def _py_write(buffer: np.ndarray, filename: str, offset: int):
+        fd = os.open(filename, os.O_WRONLY | os.O_CREAT, 0o644)
+        try:
+            data = buffer.tobytes()
+            written = 0
+            while written < len(data):
+                n = os.pwrite(fd, data[written:], offset + written)
+                if n <= 0:
+                    raise IOError(f"short write to {filename}")
+                written += n
+        finally:
+            os.close(fd)
+
+    def file_size(self, filename: str) -> int:
+        if self._lib is not None:
+            return int(self._lib.ds_aio_file_size(filename.encode()))
+        return os.path.getsize(filename)
+
+    def close(self):
+        if self._h is not None:
+            self._lib.ds_aio_destroy(self._h)
+            self._h = None
+        if self._pool is not None:
+            self._pool.shutdown()
+            self._pool = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

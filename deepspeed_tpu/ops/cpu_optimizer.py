@@ -1,0 +1,195 @@
+"""Host (CPU) optimizer steps over offloaded states.
+
+Capability parity with the reference's ``DeepSpeedCPUAdam``
+(``deepspeed/ops/adam/cpu_adam.py``), ``DeepSpeedCPUAdagrad`` and
+``DeepSpeedCPULion``: when optimizer states are offloaded to host memory,
+the update runs on the host CPU via the SIMD C++ kernels in
+``csrc/cpu_optimizer.cpp`` (numpy fallback if the native lib is
+unavailable). States are numpy float32 arrays; the TPU engine hands over
+host-resident grads and receives updated params to stream back.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..utils.logging import logger
+from .op_builder import CPUOptimizerBuilder
+
+_f32p = ctypes.POINTER(ctypes.c_float)
+_u16p = ctypes.POINTER(ctypes.c_uint16)
+
+
+def _lib():
+    lib = CPUOptimizerBuilder().load()
+    if lib is not None and not getattr(lib, "_ds_typed", False):
+        lib.ds_adam_step.argtypes = [_f32p, _f32p, _f32p, _f32p,
+                                     ctypes.c_int64, ctypes.c_float,
+                                     ctypes.c_float, ctypes.c_float,
+                                     ctypes.c_float, ctypes.c_float,
+                                     ctypes.c_int, ctypes.c_int, ctypes.c_int]
+        lib.ds_adagrad_step.argtypes = [_f32p, _f32p, _f32p, ctypes.c_int64,
+                                        ctypes.c_float, ctypes.c_float,
+                                        ctypes.c_float]
+        lib.ds_lion_step.argtypes = [_f32p, _f32p, _f32p, ctypes.c_int64,
+                                     ctypes.c_float, ctypes.c_float,
+                                     ctypes.c_float, ctypes.c_float]
+        lib.ds_sgd_step.argtypes = [_f32p, _f32p, _f32p, ctypes.c_int64,
+                                    ctypes.c_float, ctypes.c_float,
+                                    ctypes.c_float]
+        lib.ds_bf16_to_fp32.argtypes = [_u16p, _f32p, ctypes.c_int64]
+        lib.ds_fp32_to_bf16.argtypes = [_f32p, _u16p, ctypes.c_int64]
+        lib._ds_typed = True
+    return lib
+
+
+def _ptr(a: np.ndarray):
+    return a.ctypes.data_as(_f32p)
+
+
+def _check(a: np.ndarray, name: str):
+    if a.dtype != np.float32 or not a.flags.c_contiguous:
+        raise TypeError(f"{name} must be contiguous float32, got "
+                        f"{a.dtype}/{a.flags.c_contiguous}")
+
+
+class DeepSpeedCPUAdam:
+    """Adam/AdamW over host-resident numpy state.
+
+    Reference: ``ops/adam/cpu_adam.py DeepSpeedCPUAdam`` (AVX kernel in
+    ``csrc/includes/cpu_adam.h:24``). ``params`` is a list of numpy arrays
+    updated in place; exp_avg/exp_avg_sq are managed internally.
+    """
+
+    def __init__(self, params: List[np.ndarray], lr: float = 1e-3,
+                 betas=(0.9, 0.999), eps: float = 1e-8,
+                 weight_decay: float = 0.0, adamw_mode: bool = True,
+                 bias_correction: bool = True):
+        self.params = params
+        for i, p in enumerate(params):
+            _check(p, f"param[{i}]")
+        self.lr, self.betas, self.eps = lr, betas, eps
+        self.weight_decay, self.adamw_mode = weight_decay, adamw_mode
+        self.bias_correction = bias_correction
+        self.step_count = 0
+        self.exp_avg = [np.zeros_like(p) for p in params]
+        self.exp_avg_sq = [np.zeros_like(p) for p in params]
+        self._native = _lib()
+        if self._native is None:
+            logger.warning("DeepSpeedCPUAdam: using numpy fallback")
+
+    def step(self, grads: List[np.ndarray], lr: Optional[float] = None):
+        lr = self.lr if lr is None else lr
+        self.step_count += 1
+        b1, b2 = self.betas
+        for p, g, m, v in zip(self.params, grads, self.exp_avg,
+                              self.exp_avg_sq):
+            _check(g, "grad")
+            if self._native is not None:
+                self._native.ds_adam_step(
+                    _ptr(p), _ptr(g), _ptr(m), _ptr(v), p.size,
+                    lr, b1, b2, self.eps, self.weight_decay,
+                    self.step_count, int(self.adamw_mode),
+                    int(self.bias_correction))
+            else:
+                grad = g if self.adamw_mode else g + self.weight_decay * p
+                m[:] = b1 * m + (1 - b1) * grad
+                v[:] = b2 * v + (1 - b2) * grad * grad
+                bc1 = 1 - b1 ** self.step_count if self.bias_correction else 1
+                bc2 = 1 - b2 ** self.step_count if self.bias_correction else 1
+                denom = np.sqrt(v) / np.sqrt(bc2) + self.eps
+                decay = lr * self.weight_decay * p if self.adamw_mode else 0.0
+                p -= (lr / bc1) * (m / denom) + decay
+
+    def state_dict(self) -> Dict[str, Any]:
+        return {"step": self.step_count, "exp_avg": self.exp_avg,
+                "exp_avg_sq": self.exp_avg_sq}
+
+    def load_state_dict(self, sd: Dict[str, Any]):
+        self.step_count = sd["step"]
+        self.exp_avg = [np.ascontiguousarray(a, np.float32)
+                        for a in sd["exp_avg"]]
+        self.exp_avg_sq = [np.ascontiguousarray(a, np.float32)
+                           for a in sd["exp_avg_sq"]]
+
+
+class DeepSpeedCPUAdagrad:
+    """Reference: ``ops/adagrad/cpu_adagrad.py``."""
+
+    def __init__(self, params: List[np.ndarray], lr: float = 1e-2,
+                 eps: float = 1e-10, weight_decay: float = 0.0):
+        self.params, self.lr, self.eps = params, lr, eps
+        self.weight_decay = weight_decay
+        for i, p in enumerate(params):
+            _check(p, f"param[{i}]")
+        self.sq_sum = [np.zeros_like(p) for p in params]
+        self._native = _lib()
+
+    def step(self, grads: List[np.ndarray], lr: Optional[float] = None):
+        lr = self.lr if lr is None else lr
+        for p, g, h in zip(self.params, grads, self.sq_sum):
+            _check(g, "grad")
+            if self._native is not None:
+                self._native.ds_adagrad_step(_ptr(p), _ptr(g), _ptr(h),
+                                             p.size, lr, self.eps,
+                                             self.weight_decay)
+            else:
+                grad = g + self.weight_decay * p
+                h += grad * grad
+                p -= lr * grad / (np.sqrt(h) + self.eps)
+
+
+class DeepSpeedCPULion:
+    """Reference: ``ops/lion/cpu_lion.py``."""
+
+    def __init__(self, params: List[np.ndarray], lr: float = 1e-4,
+                 betas=(0.9, 0.99), weight_decay: float = 0.0):
+        self.params, self.lr, self.betas = params, lr, betas
+        self.weight_decay = weight_decay
+        for i, p in enumerate(params):
+            _check(p, f"param[{i}]")
+        self.exp_avg = [np.zeros_like(p) for p in params]
+        self._native = _lib()
+
+    def step(self, grads: List[np.ndarray], lr: Optional[float] = None):
+        lr = self.lr if lr is None else lr
+        b1, b2 = self.betas
+        for p, g, m in zip(self.params, grads, self.exp_avg):
+            _check(g, "grad")
+            if self._native is not None:
+                self._native.ds_lion_step(_ptr(p), _ptr(g), _ptr(m), p.size,
+                                          lr, b1, b2, self.weight_decay)
+            else:
+                c = b1 * m + (1 - b1) * g
+                p -= lr * (np.sign(c) + self.weight_decay * p)
+                m[:] = b2 * m + (1 - b2) * g
+
+
+def bf16_to_fp32(src: np.ndarray) -> np.ndarray:
+    """Native-accelerated bf16(uint16 view) -> fp32 (csrc/utils parity)."""
+    lib = _lib()
+    src = np.ascontiguousarray(src)
+    if src.dtype != np.uint16:
+        src = src.view(np.uint16)
+    out = np.empty(src.shape, np.float32)
+    if lib is not None:
+        lib.ds_bf16_to_fp32(src.ctypes.data_as(_u16p), _ptr(out), src.size)
+    else:
+        out[:] = (src.astype(np.uint32) << 16).view(np.float32)
+    return out
+
+
+def fp32_to_bf16(src: np.ndarray) -> np.ndarray:
+    lib = _lib()
+    src = np.ascontiguousarray(src, np.float32)
+    out = np.empty(src.shape, np.uint16)
+    if lib is not None:
+        lib.ds_fp32_to_bf16(_ptr(src), out.ctypes.data_as(_u16p), src.size)
+    else:
+        bits = src.view(np.uint32)
+        rounding = 0x7FFF + ((bits >> 16) & 1)
+        out[:] = ((bits + rounding) >> 16).astype(np.uint16)
+    return out

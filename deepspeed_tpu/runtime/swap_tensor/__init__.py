@@ -1,0 +1,5 @@
+from .swapper import (AsyncTensorSwapper, PartitionedOptimizerSwapper,
+                      SwappedTensorMeta)
+
+__all__ = ["AsyncTensorSwapper", "PartitionedOptimizerSwapper",
+           "SwappedTensorMeta"]

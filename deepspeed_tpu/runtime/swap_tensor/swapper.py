@@ -1,0 +1,155 @@
+"""NVMe tensor swapping — the ZeRO-Infinity disk tier.
+
+Capability parity with the reference's ``runtime/swap_tensor/`` stack
+(``AsyncPartitionedParameterSwapper`` ``partitioned_param_swapper.py:37``,
+``PartitionedOptimizerSwapper`` ``partitioned_optimizer_swapper.py:27``,
+``PipelinedOptimizerSwapper`` ``pipelined_optimizer_swapper.py:52``): spill
+state tensors to fast local storage and stream them back ahead of use, so the
+trainable model size is bounded by disk, not HBM+RAM.
+
+TPU-first shape: swapping operates on *pytrees* (the opt_state / param trees
+the jit step consumes), not on hooked torch tensors. Leaves are written
+through the async C++ aio engine (``csrc/aio.cpp``), and reads for the next
+step can be issued early (``start_swap_in``) to overlap disk I/O with the
+TPU step — the same overlap the reference gets from its aio thread pool.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ...ops.aio import AIOHandle
+from ...utils.logging import log_dist, logger
+
+
+@dataclass
+class SwappedTensorMeta:
+    path: str
+    shape: Tuple[int, ...]
+    dtype: str
+
+    def nbytes(self) -> int:
+        return int(np.prod(self.shape, dtype=np.int64) *
+                   np.dtype(self.dtype).itemsize) if self.shape else \
+            np.dtype(self.dtype).itemsize
+
+
+def _leaf_name(path) -> str:
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        elif hasattr(p, "name"):
+            parts.append(str(p.name))
+        else:
+            parts.append(str(p))
+    return "_".join(parts) or "leaf"
+
+
+class AsyncTensorSwapper:
+    """Low-level named-buffer swapper (reference ``AsyncTensorSwapper`` in
+    ``partitioned_optimizer_swapper.py``)."""
+
+    def __init__(self, swap_dir: str, aio_handle: Optional[AIOHandle] = None,
+                 block_size: int = 1 << 20, num_threads: int = 4):
+        self.swap_dir = os.path.abspath(swap_dir)
+        os.makedirs(self.swap_dir, exist_ok=True)
+        self.aio = aio_handle or AIOHandle(block_size=block_size,
+                                           num_threads=num_threads)
+        self._pending_bufs: List[Tuple[np.ndarray, SwappedTensorMeta]] = []
+
+    def _path(self, name: str) -> str:
+        return os.path.join(self.swap_dir, f"{name}.swp")
+
+    def swap_out(self, name: str, array: np.ndarray) -> SwappedTensorMeta:
+        array = np.ascontiguousarray(array)
+        meta = SwappedTensorMeta(self._path(name), tuple(array.shape),
+                                 str(array.dtype))
+        self.aio.pwrite(array, meta.path)
+        # keep the buffer alive until wait(); numpy owns it, the caller's
+        # reference does — the handle only sees the raw pointer
+        self._pending_bufs.append((array, meta))
+        return meta
+
+    def start_swap_in(self, meta: SwappedTensorMeta) -> np.ndarray:
+        buf = np.empty(meta.shape, np.dtype(meta.dtype))
+        self.aio.pread(buf, meta.path)
+        return buf
+
+    def wait(self) -> None:
+        errs = self.aio.wait()
+        self._pending_bufs.clear()
+        if errs:
+            raise IOError(f"{errs} swap I/O requests failed under "
+                          f"{self.swap_dir}")
+
+    def remove(self, meta: SwappedTensorMeta) -> None:
+        try:
+            os.remove(meta.path)
+        except FileNotFoundError:
+            pass
+
+
+class PartitionedOptimizerSwapper:
+    """Pytree-level optimizer-state swapper (reference
+    ``PartitionedOptimizerSwapper`` ``partitioned_optimizer_swapper.py:27`` +
+    pipelined variant :52 — the overlap comes from issuing ``start_swap_in``
+    before the consuming step and ``wait()`` just in time).
+    """
+
+    def __init__(self, swap_dir: str, **kw):
+        self.swapper = AsyncTensorSwapper(swap_dir, **kw)
+        self._metas: Optional[Any] = None        # pytree of SwappedTensorMeta
+        self._inflight: Optional[Any] = None     # pytree of filling buffers
+
+    @property
+    def swapped_out(self) -> bool:
+        return self._metas is not None
+
+    def swap_out_optimizer(self, opt_state: Any) -> Any:
+        """Write every array leaf to disk; returns the meta tree. The caller
+        should drop its reference to the live tree afterwards."""
+        flat, treedef = jax.tree_util.tree_flatten_with_path(opt_state)
+        metas = []
+        for i, (path, leaf) in enumerate(flat):
+            arr = np.asarray(leaf)
+            # leading index guarantees uniqueness (joined path names can
+            # collide, e.g. ('a','b_c') vs ('a_b','c'))
+            metas.append(self.swapper.swap_out(
+                f"{i:05d}_{_leaf_name(path)}", arr))
+        self.swapper.wait()
+        self._metas = jax.tree_util.tree_unflatten(treedef, metas)
+        log_dist(f"swapped {len(metas)} optimizer tensors -> "
+                 f"{self.swapper.swap_dir}")
+        return self._metas
+
+    def start_swap_in(self) -> None:
+        """Issue async reads for all leaves (call while the TPU computes)."""
+        assert self._metas is not None, "nothing swapped out"
+        self._inflight = jax.tree.map(
+            self.swapper.start_swap_in, self._metas,
+            is_leaf=lambda x: isinstance(x, SwappedTensorMeta))
+
+    def swap_in_optimizer(self, device_put: bool = True) -> Any:
+        """Drain reads, return the restored tree (optionally on device)."""
+        if self._inflight is None:
+            self.start_swap_in()
+        self.swapper.wait()
+        tree = self._inflight
+        self._inflight = None
+        if device_put:
+            tree = jax.tree.map(jax.device_put, tree)
+        return tree
+
+    def purge(self) -> None:
+        if self._metas is not None:
+            jax.tree.map(self.swapper.remove, self._metas,
+                         is_leaf=lambda x: isinstance(x, SwappedTensorMeta))
+            self._metas = None

@@ -92,7 +92,12 @@ def fpdt_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
             kv_body, (m0, l0, acc0),
             (jnp.arange(chunks), k_t, v_t))
         l = jnp.maximum(l, 1e-20)
-        return (acc / l.transpose(0, 2, 1)[..., None]).astype(q.dtype)
+        out = (acc / l.transpose(0, 2, 1)[..., None]).astype(q.dtype)
+        # tag the chunk output so the host-offload remat policy (which
+        # matches names in CHECKPOINT_NAMES) actually parks it in pinned_host
+        from jax.ad_checkpoint import checkpoint_name
+
+        return checkpoint_name(out, "block_out")
 
     if offload:
         from ..runtime.activation_checkpointing import checkpointing as ac

@@ -1,0 +1,193 @@
+"""Native C++ tier: SIMD CPU optimizers, async file I/O, NVMe swapper.
+
+Mirrors the reference's kernel unit tests (``tests/unit/ops/adam/test_cpu_adam.py``,
+``tests/unit/ops/aio/test_aio.py``): native results compared against a numpy
+reference implementation; I/O round-trips verified byte-exact.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.aio import AIOHandle, aio_available
+from deepspeed_tpu.ops.cpu_optimizer import (DeepSpeedCPUAdagrad,
+                                             DeepSpeedCPUAdam,
+                                             DeepSpeedCPULion, bf16_to_fp32,
+                                             fp32_to_bf16)
+from deepspeed_tpu.ops.op_builder import ALL_OPS, op_report
+
+
+class TestOpBuilder:
+    def test_report(self):
+        rep = op_report()
+        assert set(rep) == {"cpu_optimizer", "aio"}
+
+    def test_native_builds(self):
+        # the image has g++; the native path must actually build here
+        for name, b in ALL_OPS.items():
+            assert b.load() is not None, f"{name} failed to build"
+
+
+def _numpy_adamw(p, g, m, v, step, lr, b1, b2, eps, wd):
+    """torch.optim.AdamW semantics: decoupled decay scaled by lr alone."""
+    m = b1 * m + (1 - b1) * g
+    v = b2 * v + (1 - b2) * g * g
+    bc1 = 1 - b1 ** step
+    bc2 = 1 - b2 ** step
+    denom = np.sqrt(v) / np.sqrt(bc2) + eps
+    p = p - (lr / bc1) * (m / denom) - lr * wd * p
+    return p, m, v
+
+
+class TestCPUAdam:
+    def test_matches_numpy_reference(self):
+        rng = np.random.RandomState(0)
+        p0 = rng.randn(1000).astype(np.float32)
+        p = p0.copy()
+        opt = DeepSpeedCPUAdam([p], lr=1e-2, weight_decay=0.01)
+
+        p_ref, m_ref, v_ref = p0.copy(), np.zeros_like(p0), np.zeros_like(p0)
+        for step in range(1, 6):
+            g = rng.randn(1000).astype(np.float32)
+            opt.step([g])
+            p_ref, m_ref, v_ref = _numpy_adamw(
+                p_ref, g, m_ref, v_ref, step, 1e-2, 0.9, 0.999, 1e-8, 0.01)
+        np.testing.assert_allclose(p, p_ref, rtol=1e-4, atol=1e-6)
+        np.testing.assert_allclose(opt.exp_avg[0], m_ref, rtol=1e-4, atol=1e-6)
+
+    def test_adam_mode_l2(self):
+        rng = np.random.RandomState(1)
+        p = rng.randn(64).astype(np.float32)
+        p_copy = p.copy()
+        g = rng.randn(64).astype(np.float32)
+        opt = DeepSpeedCPUAdam([p], lr=1e-2, weight_decay=0.1,
+                               adamw_mode=False)
+        opt.step([g])
+        # L2 mode folds decay into the gradient
+        grad = g + 0.1 * p_copy
+        m = 0.1 * grad
+        v = 0.001 * grad * grad
+        denom = np.sqrt(v) / np.sqrt(1 - 0.999) + 1e-8
+        expect = p_copy - (1e-2 / (1 - 0.9)) * (m / denom)
+        np.testing.assert_allclose(p, expect, rtol=1e-4, atol=1e-6)
+
+    def test_state_dict_roundtrip(self):
+        p = np.zeros(8, np.float32)
+        opt = DeepSpeedCPUAdam([p])
+        opt.step([np.ones(8, np.float32)])
+        sd = opt.state_dict()
+        opt2 = DeepSpeedCPUAdam([p.copy()])
+        opt2.load_state_dict(sd)
+        assert opt2.step_count == 1
+        np.testing.assert_array_equal(opt2.exp_avg[0], opt.exp_avg[0])
+
+    def test_rejects_non_float32(self):
+        with pytest.raises(TypeError):
+            DeepSpeedCPUAdam([np.zeros(4, np.float64)])
+
+
+class TestCPULionAdagrad:
+    def test_lion_sign_update(self):
+        p = np.zeros(16, np.float32)
+        g = np.ones(16, np.float32)
+        opt = DeepSpeedCPULion([p], lr=0.1, betas=(0.9, 0.99))
+        opt.step([g])
+        np.testing.assert_allclose(p, -0.1 * np.ones(16), rtol=1e-6)
+
+    def test_adagrad(self):
+        p = np.ones(16, np.float32)
+        g = np.full(16, 2.0, np.float32)
+        opt = DeepSpeedCPUAdagrad([p], lr=0.5, eps=0.0)
+        opt.step([g])
+        np.testing.assert_allclose(p, 1.0 - 0.5, rtol=1e-5)  # g/|g| = 1
+
+
+class TestBF16Cast:
+    def test_roundtrip(self):
+        x = np.random.RandomState(0).randn(257).astype(np.float32)
+        bf = fp32_to_bf16(x)
+        back = bf16_to_fp32(bf)
+        np.testing.assert_allclose(back, x, rtol=1e-2, atol=1e-2)
+
+    def test_exact_values(self):
+        x = np.array([1.0, -2.0, 0.5, 0.0], np.float32)
+        np.testing.assert_array_equal(bf16_to_fp32(fp32_to_bf16(x)), x)
+
+
+class TestAIO:
+    def test_native_available(self):
+        assert aio_available()
+
+    def test_sync_roundtrip(self, tmp_path):
+        h = AIOHandle(block_size=1024, num_threads=2)
+        data = np.random.RandomState(0).bytes(10_000)
+        buf = np.frombuffer(data, np.uint8).copy()
+        f = str(tmp_path / "blob.bin")
+        assert h.write(buf, f) == 0
+        out = np.zeros_like(buf)
+        assert h.read(out, f) == 0
+        np.testing.assert_array_equal(out, buf)
+        assert h.file_size(f) == buf.nbytes
+        h.close()
+
+    def test_async_many(self, tmp_path):
+        h = AIOHandle(block_size=4096, num_threads=4)
+        bufs = [np.random.RandomState(i).randn(5000).astype(np.float32)
+                for i in range(8)]
+        for i, b in enumerate(bufs):
+            h.pwrite(b, str(tmp_path / f"t{i}.bin"))
+        assert h.wait() == 0
+        outs = [np.empty_like(b) for b in bufs]
+        for i, o in enumerate(outs):
+            h.pread(o, str(tmp_path / f"t{i}.bin"))
+        assert h.wait() == 0
+        for b, o in zip(bufs, outs):
+            np.testing.assert_array_equal(b, o)
+        h.close()
+
+    def test_offset_io(self, tmp_path):
+        h = AIOHandle(num_threads=1)
+        f = str(tmp_path / "off.bin")
+        full = np.arange(100, dtype=np.float32)
+        assert h.write(full, f) == 0
+        part = np.empty(10, np.float32)
+        h.pread(part, f, offset=40)  # floats 10..19
+        assert h.wait() == 0
+        np.testing.assert_array_equal(part, np.arange(10, 20, dtype=np.float32))
+        h.close()
+
+    def test_read_error_reported(self, tmp_path):
+        h = AIOHandle(num_threads=1)
+        buf = np.zeros(16, np.uint8)
+        h.pread(buf, str(tmp_path / "missing.bin"))
+        assert h.wait() > 0
+        h.close()
+
+
+class TestOptimizerSwapper:
+    def test_pytree_roundtrip(self, tmp_path):
+        import jax.numpy as jnp
+
+        from deepspeed_tpu.runtime.swap_tensor import \
+            PartitionedOptimizerSwapper
+
+        opt_state = {
+            "mu": {"w": jnp.arange(12.0).reshape(3, 4),
+                   "b": jnp.ones((4,))},
+            "nu": {"w": jnp.full((3, 4), 2.0), "b": jnp.zeros((4,))},
+            "count": jnp.array(7, jnp.int32),
+        }
+        sw = PartitionedOptimizerSwapper(str(tmp_path / "swap"))
+        sw.swap_out_optimizer(opt_state)
+        assert sw.swapped_out
+        sw.start_swap_in()
+        restored = sw.swap_in_optimizer()
+        for a, b in zip(jax.tree.leaves(opt_state),
+                        jax.tree.leaves(restored)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        sw.purge()
+        assert not sw.swapped_out
+
+
+import jax  # noqa: E402  (used in TestOptimizerSwapper)
