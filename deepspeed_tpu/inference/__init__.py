@@ -1,0 +1,5 @@
+from .config import InferenceConfig, RaggedConfig, TPConfig  # noqa: F401
+from .engine import InferenceEngine, ModelFamily, init_inference  # noqa: F401
+from .engine_v2 import InferenceEngineV2, build_engine_v2  # noqa: F401
+from .ragged import BlockedAllocator, SequenceDescriptor, StateManager  # noqa: F401
+from .sampling import SamplingParams, sample  # noqa: F401

@@ -1,0 +1,54 @@
+"""Inference configuration.
+
+Reference parity: ``DeepSpeedInferenceConfig`` (``inference/config.py``) and the
+v2 ``RaggedInferenceEngineConfig`` (``inference/v2/config_v2.py``). Kernel-
+injection / CUDA-graph knobs become their TPU meanings: kernel selection is the
+op-registry backend choice (Pallas vs XLA), and graph capture is jit caching —
+always on, so ``enable_cuda_graph`` is accepted and ignored.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+
+@dataclass
+class TPConfig:
+    """Tensor-parallel sub-config (reference ``DeepSpeedTPConfig``)."""
+
+    tp_size: int = 1
+
+
+@dataclass
+class RaggedConfig:
+    """v2 state-manager sub-config (reference ``DSStateManagerConfig``)."""
+
+    max_tracked_sequences: int = 64      # concurrent sequence slots
+    max_ragged_batch_size: int = 64      # decode batch per step
+    memory_config_blocks: int = 512      # KV blocks in the pool
+    block_size: int = 128                # tokens per KV block
+
+
+@dataclass
+class InferenceConfig:
+    dtype: str = "bfloat16"
+    tensor_parallel: TPConfig = field(default_factory=TPConfig)
+    max_out_tokens: int = 1024           # dense KV-cache length budget
+    min_out_tokens: int = 1
+    replace_with_kernel_inject: bool = False  # prefer Pallas kernels when True
+    enable_cuda_graph: bool = False      # accepted for parity; jit caches anyway
+    max_batch_size: int = 8
+    prefill_bucket: int = 64             # pad prompts to a multiple of this
+    ragged: RaggedConfig = field(default_factory=RaggedConfig)
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "InferenceConfig":
+        d = dict(d or {})
+        tp = d.pop("tensor_parallel", {})
+        if isinstance(tp, int):
+            tp = {"tp_size": tp}
+        ragged = d.pop("ragged", {})
+        known = {k: v for k, v in d.items() if k in cls.__dataclass_fields__}
+        return cls(tensor_parallel=TPConfig(**tp), ragged=RaggedConfig(**ragged),
+                   **known)

@@ -1,0 +1,114 @@
+"""Ragged / continuous batching runtime: blocked KV cache + sequence manager.
+
+Reference parity: ``inference/v2/ragged`` — ``BlockedAllocator``
+(``blocked_allocator.py``), ``BlockedKVCache`` (``kv_cache.py``),
+``DSSequenceDescriptor``/``DSStateManager`` (``ragged_manager.py``),
+``RaggedBatchWrapper`` (``ragged_wrapper.py``). TPU-first redesign: instead of
+host/device shadow buffers and CUDA atom builders, the device state is a pair
+of fixed-shape block pool arrays plus fixed-width block tables — every decode
+step is the SAME compiled program regardless of which sequences are live, so
+XLA graph caching plays the role of the reference's persistent kernel launch.
+
+Block 0 is reserved as the trash block: padded/invalid writes land there.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+class BlockedAllocator:
+    """Free-list allocator over a fixed pool of KV blocks (reference
+    ``inference/v2/ragged/blocked_allocator.py``). Block 0 is never handed
+    out — it is the trash block for masked writes."""
+
+    def __init__(self, num_blocks: int):
+        if num_blocks < 2:
+            raise ValueError("need at least 2 blocks (block 0 is reserved)")
+        self.num_blocks = num_blocks
+        self._free: List[int] = list(range(num_blocks - 1, 0, -1))
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    def allocate(self, n: int) -> List[int]:
+        if n > len(self._free):
+            raise MemoryError(f"KV pool exhausted: want {n}, have {len(self._free)}")
+        out = [self._free.pop() for _ in range(n)]
+        return out
+
+    def free(self, blocks: List[int]) -> None:
+        for b in blocks:
+            if b == 0:
+                raise ValueError("block 0 is reserved")
+            self._free.append(b)
+
+
+@dataclasses.dataclass
+class SequenceDescriptor:
+    """Host-side state for one tracked sequence (reference
+    ``DSSequenceDescriptor`` ``ragged_manager.py``)."""
+
+    uid: int
+    slot: int                      # decode-batch slot index
+    blocks: List[int] = dataclasses.field(default_factory=list)
+    seen_tokens: int = 0           # tokens already in the KV cache
+    last_token: int = 0
+    generated: List[int] = dataclasses.field(default_factory=list)
+    finished: bool = False
+
+
+class StateManager:
+    """Tracks live sequences, their slots and block tables (reference
+    ``DSStateManager``). Purely host-side; device state lives in the engine."""
+
+    def __init__(self, max_sequences: int, num_blocks: int, block_size: int,
+                 max_blocks_per_seq: int):
+        self.block_size = block_size
+        self.max_sequences = max_sequences
+        self.max_blocks_per_seq = max_blocks_per_seq
+        self.allocator = BlockedAllocator(num_blocks)
+        self.seqs: Dict[int, SequenceDescriptor] = {}
+        self._free_slots: List[int] = list(range(max_sequences - 1, -1, -1))
+
+    @property
+    def free_slots(self) -> int:
+        return len(self._free_slots)
+
+    def can_admit(self, prompt_len: int) -> bool:
+        need = (prompt_len + self.block_size - 1) // self.block_size + 1
+        return bool(self._free_slots) and self.allocator.free_blocks >= need
+
+    def admit(self, uid: int, prompt_len: int) -> SequenceDescriptor:
+        if uid in self.seqs:
+            raise ValueError(f"uid {uid} already tracked")
+        need = (prompt_len + self.block_size - 1) // self.block_size + 1
+        slot = self._free_slots.pop()
+        desc = SequenceDescriptor(uid=uid, slot=slot,
+                                  blocks=self.allocator.allocate(need))
+        self.seqs[uid] = desc
+        return desc
+
+    def extend(self, desc: SequenceDescriptor) -> None:
+        """Ensure the block table covers one more token."""
+        cap = len(desc.blocks) * self.block_size
+        if desc.seen_tokens + 1 > cap:
+            desc.blocks.extend(self.allocator.allocate(1))
+        if len(desc.blocks) > self.max_blocks_per_seq:
+            raise MemoryError(f"sequence {desc.uid} exceeds max_blocks_per_seq")
+
+    def retire(self, uid: int) -> SequenceDescriptor:
+        desc = self.seqs.pop(uid)
+        self.allocator.free(desc.blocks)
+        self._free_slots.append(desc.slot)
+        return desc
+
+    def block_table(self, desc: SequenceDescriptor) -> np.ndarray:
+        """Fixed-width table; unused entries point at the trash block 0."""
+        t = np.zeros((self.max_blocks_per_seq,), np.int32)
+        t[:len(desc.blocks)] = desc.blocks
+        return t

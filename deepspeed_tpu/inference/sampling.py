@@ -1,0 +1,43 @@
+"""Token sampling — greedy / temperature / top-k / top-p, jit-safe.
+
+Reference parity: the sampling the reference delegates to HF ``generate``;
+v2 exposes logits and lets the client sample. Here sampling is a pure function
+so it fuses into the decode step.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+class SamplingParams(NamedTuple):
+    temperature: float = 1.0
+    top_k: int = 0          # 0 = disabled
+    top_p: float = 1.0      # 1.0 = disabled
+    greedy: bool = False
+
+
+def sample(rng: jax.Array, logits: jnp.ndarray,
+           params: SamplingParams = SamplingParams()) -> jnp.ndarray:
+    """logits [..., vocab] → token ids [...]. Static sampling params."""
+    if params.greedy or params.temperature == 0.0:
+        return jnp.argmax(logits, axis=-1)
+    logits = logits / jnp.maximum(params.temperature, 1e-6)
+    if params.top_k > 0:
+        k = min(params.top_k, logits.shape[-1])
+        kth = jnp.sort(logits, axis=-1)[..., -k][..., None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if params.top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep the smallest set with cumulative prob >= top_p (always keep #1);
+        # the cutoff is the SMALLEST kept logit
+        keep = cum - probs < params.top_p
+        cutoff = jnp.min(jnp.where(keep, sorted_logits, jnp.inf), axis=-1,
+                         keepdims=True)
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return jax.random.categorical(rng, logits, axis=-1)

@@ -240,6 +240,197 @@ def apply(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray, *,
     return logits.astype(jnp.float32)
 
 
+# --------------------------------------------------------------------------- #
+# KV-cached inference path (reference: inference v1 fused-module decode and
+# v2 ``inference/v2/model_implementations/llama_v2`` — here a pure function
+# over a stacked cache pytree, scanned per layer)
+# --------------------------------------------------------------------------- #
+def init_cache(cfg: LlamaConfig, batch_size: int, max_len: int,
+               dtype=jnp.bfloat16) -> Params:
+    """Dense KV cache: [layers, batch, max_len, kv_heads, head_dim]."""
+    L, nkv, hd = cfg.num_layers, cfg.num_kv_heads, cfg.head_size
+    shape = (L, batch_size, max_len, nkv, hd)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def cache_logical_axes(cfg: LlamaConfig) -> Params:
+    spec = ("layers", None, None, "kv_heads", None)
+    return {"k": spec, "v": spec}
+
+
+def _write_cache(cache: jnp.ndarray, new: jnp.ndarray,
+                 starts: jnp.ndarray) -> jnp.ndarray:
+    """Scatter new K/V rows into the cache at per-sequence offsets.
+    cache [b, S, nkv, hd], new [b, t, nkv, hd], starts [b]."""
+    def one(c, n, s):
+        return lax.dynamic_update_slice(c, n.astype(c.dtype), (s, 0, 0))
+
+    return jax.vmap(one)(cache, new, starts)
+
+
+def _block_cached(cfg: LlamaConfig, x: jnp.ndarray, layer: Params,
+                  k_cache: jnp.ndarray, v_cache: jnp.ndarray,
+                  cache_len: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray,
+                  positions: jnp.ndarray):
+    """One block with KV-cache read/write. x: [b, t, h]; cache_len: [b]."""
+    b, t, h = x.shape
+    nh, nkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_size
+    S = k_cache.shape[1]
+
+    y = rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps)
+    q = (y @ layer["wq"]).reshape(b, t, nh, hd)
+    k = (y @ layer["wk"]).reshape(b, t, nkv, hd)
+    v = (y @ layer["wv"]).reshape(b, t, nkv, hd)
+    q = apply_rotary(q, cos, sin, positions)
+    k = apply_rotary(k, cos, sin, positions)
+    k_cache = _write_cache(k_cache, k, cache_len)
+    v_cache = _write_cache(v_cache, v, cache_len)
+
+    # attend over the cache: kv slot j is visible to query i (absolute
+    # position cache_len + i) iff j <= cache_len + i
+    kv_pos = jnp.arange(S)[None, None, None, :]
+    q_abs = cache_len[:, None, None, None] + jnp.arange(t)[None, None, :, None]
+    mask = kv_pos <= q_abs  # [b, 1, t, S]
+    attn_out = attention(q, k_cache, v_cache, causal=False, mask=mask)
+    x = x + attn_out.reshape(b, t, nh * hd) @ layer["wo"]
+
+    y = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
+    gate = jax.nn.silu(y @ layer["w_gate"])
+    up = y @ layer["w_up"]
+    x = x + (gate * up) @ layer["w_down"]
+    return x, k_cache, v_cache
+
+
+def apply_cached(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,
+                 cache: Params, cache_len: jnp.ndarray, *,
+                 compute_dtype=jnp.bfloat16) -> Tuple[jnp.ndarray, Params]:
+    """Forward with KV cache (prefill when cache_len==0, decode otherwise).
+
+    tokens [b, t]; cache_len [b] — number of valid cache slots per sequence.
+    Returns (logits [b, t, vocab] fp32, updated cache)."""
+    if cache_len.ndim == 0:
+        cache_len = jnp.broadcast_to(cache_len, (tokens.shape[0],))
+    x = params["embed"][tokens].astype(compute_dtype)
+    cos, sin = rope_frequencies(cfg.head_size, cfg.max_seq_len, cfg.rope_theta)
+    positions = cache_len[:, None] + jnp.arange(tokens.shape[1])[None, :]
+
+    layers = jax.tree.map(lambda p: p.astype(compute_dtype)
+                          if jnp.issubdtype(p.dtype, jnp.floating) else p,
+                          params["layers"])
+
+    def scan_body(x, scanned):
+        layer, k_c, v_c = scanned
+        x, k_c, v_c = _block_cached(cfg, x, layer, k_c, v_c, cache_len,
+                                    cos, sin, positions)
+        return x, (k_c, v_c)
+
+    x, (new_k, new_v) = lax.scan(scan_body, x, (layers, cache["k"], cache["v"]))
+    x = rms_norm(x, params["final_norm"].astype(compute_dtype), cfg.rms_norm_eps)
+    head = params.get("lm_head")
+    if head is None:
+        head = params["embed"].T
+    logits = x @ head.astype(compute_dtype)
+    return logits.astype(jnp.float32), {"k": new_k, "v": new_v}
+
+
+# --------------------------------------------------------------------------- #
+# Paged (blocked) KV-cache path — reference: inference v2 blocked attention
+# over ``BlockedKVCache`` (``inference/v2/ragged/kv_cache.py``) and the ragged
+# decode kernels. Block tables are fixed-width; block 0 is the trash block.
+# --------------------------------------------------------------------------- #
+def init_paged_cache(cfg: LlamaConfig, num_blocks: int, block_size: int,
+                     dtype=jnp.bfloat16) -> Params:
+    L, nkv, hd = cfg.num_layers, cfg.num_kv_heads, cfg.head_size
+    shape = (L, num_blocks, block_size, nkv, hd)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def paged_cache_logical_axes(cfg: LlamaConfig) -> Params:
+    spec = ("layers", None, None, "kv_heads", None)
+    return {"k": spec, "v": spec}
+
+
+def _block_paged(cfg: LlamaConfig, x: jnp.ndarray, layer: Params,
+                 k_cache: jnp.ndarray, v_cache: jnp.ndarray,
+                 block_tables: jnp.ndarray, context_lens: jnp.ndarray,
+                 valid: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray,
+                 positions: jnp.ndarray):
+    """One block over the paged cache. x [B, t, h]; block_tables
+    [B, max_blocks]; context_lens [B]; valid [B, t] (False → write to trash)."""
+    b, t, h = x.shape
+    nh, nkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_size
+    bs = k_cache.shape[1]
+    max_blocks = block_tables.shape[1]
+
+    y = rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps)
+    q = (y @ layer["wq"]).reshape(b, t, nh, hd)
+    k = (y @ layer["wk"]).reshape(b, t, nkv, hd)
+    v = (y @ layer["wv"]).reshape(b, t, nkv, hd)
+    q = apply_rotary(q, cos, sin, positions)
+    k = apply_rotary(k, cos, sin, positions)
+
+    # scatter new K/V into blocks: token j of seq i lands at abs position
+    # context_lens[i]+j → (block_tables[i, p // bs], p % bs); invalid → trash
+    abs_pos = positions  # [b, t]
+    blk_idx = jnp.take_along_axis(block_tables, abs_pos // bs, axis=1)
+    blk_idx = jnp.where(valid, blk_idx, 0)
+    off = abs_pos % bs
+    k_cache = k_cache.at[blk_idx, off].set(k.astype(k_cache.dtype))
+    v_cache = v_cache.at[blk_idx, off].set(v.astype(v_cache.dtype))
+
+    # gather the sequence's blocks → dense [b, S, nkv, hd] view for attention
+    S = max_blocks * bs
+    kg = k_cache[block_tables].reshape(b, S, nkv, hd)
+    vg = v_cache[block_tables].reshape(b, S, nkv, hd)
+    kv_pos = jnp.arange(S)[None, None, None, :]
+    q_abs = abs_pos[:, None, :, None]
+    mask = kv_pos <= q_abs
+    attn_out = attention(q, kg, vg, causal=False, mask=mask)
+    x = x + attn_out.reshape(b, t, nh * hd) @ layer["wo"]
+
+    y = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
+    gate = jax.nn.silu(y @ layer["w_gate"])
+    up = y @ layer["w_up"]
+    x = x + (gate * up) @ layer["w_down"]
+    return x, k_cache, v_cache
+
+
+def apply_paged(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,
+                cache: Params, block_tables: jnp.ndarray,
+                context_lens: jnp.ndarray, *,
+                valid: Optional[jnp.ndarray] = None,
+                compute_dtype=jnp.bfloat16) -> Tuple[jnp.ndarray, Params]:
+    """Ragged forward over the paged cache (prefill chunks or decode steps).
+
+    tokens [B, t]; context_lens [B] tokens already cached per sequence;
+    block_tables [B, max_blocks] into the shared pool; valid [B, t] marks
+    real (non-pad) tokens. Returns (logits [B, t, vocab] fp32, cache)."""
+    b, t = tokens.shape
+    if valid is None:
+        valid = jnp.ones((b, t), bool)
+    x = params["embed"][tokens].astype(compute_dtype)
+    cos, sin = rope_frequencies(cfg.head_size, cfg.max_seq_len, cfg.rope_theta)
+    positions = context_lens[:, None] + jnp.arange(t)[None, :]
+
+    layers = jax.tree.map(lambda p: p.astype(compute_dtype)
+                          if jnp.issubdtype(p.dtype, jnp.floating) else p,
+                          params["layers"])
+
+    def scan_body(x, scanned):
+        layer, k_c, v_c = scanned
+        x, k_c, v_c = _block_paged(cfg, x, layer, k_c, v_c, block_tables,
+                                   context_lens, valid, cos, sin, positions)
+        return x, (k_c, v_c)
+
+    x, (new_k, new_v) = lax.scan(scan_body, x, (layers, cache["k"], cache["v"]))
+    x = rms_norm(x, params["final_norm"].astype(compute_dtype), cfg.rms_norm_eps)
+    head = params.get("lm_head")
+    if head is None:
+        head = params["embed"].T
+    logits = x @ head.astype(compute_dtype)
+    return logits.astype(jnp.float32), {"k": new_k, "v": new_v}
+
+
 def model_spec(cfg: LlamaConfig, compute_dtype=jnp.bfloat16):
     """Build the engine-facing ModelSpec for this config."""
     from ..runtime.engine import ModelSpec
