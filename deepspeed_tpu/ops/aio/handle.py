@@ -106,6 +106,11 @@ class AIOHandle:
         return self.wait()
 
     def write(self, buffer: np.ndarray, filename: str) -> int:
+        # whole-file semantics: truncate first so a smaller rewrite over an
+        # existing file leaves no stale tail (pwrite keeps positional
+        # semantics and does NOT truncate)
+        with open(filename, "wb"):
+            pass
         self.pwrite(buffer, filename)
         return self.wait()
 

@@ -1,0 +1,7 @@
+from .engines import (CheckpointEngine, DecoupledCheckpointEngine,  # noqa: F401
+                      FastCheckpointEngine, SyncCheckpointEngine,
+                      get_checkpoint_engine)
+from .saver import load_checkpoint, save_checkpoint  # noqa: F401
+from .universal import ds_to_universal, load_universal, save_universal  # noqa: F401
+from .zero_to_fp32 import (convert_checkpoint_to_fp32_state_dict,  # noqa: F401
+                           get_fp32_state_dict_from_checkpoint)

@@ -1,13 +1,13 @@
-"""Checkpoint save/load via Orbax (reference: ``runtime/engine.py
-save_checkpoint :3746 / load_checkpoint :3398`` + checkpoint-engine abstraction
-``runtime/checkpoint_engine/``).
+"""Checkpoint save/load (reference: ``runtime/engine.py save_checkpoint :3746 /
+load_checkpoint :3398`` + checkpoint-engine selection ``:1287``).
 
-Format: per-tag directory containing the full TrainState (params fp32 master,
-optimizer state, loss scaler, counters) saved with Orbax — sharding-aware, so
-ZeRO-sharded state saves/restores in parallel from every host, and can be
-resharded on load (the universal-checkpoint property falls out of Orbax's
-``restore_args``: a checkpoint written on one mesh loads onto another).
-A ``latest`` tag file mirrors the reference's bookkeeping.
+Format: per-tag directory with the full TrainState (fp32 master params,
+optimizer state, loss scaler, counters) written by the configured
+:class:`CheckpointEngine` (sync orbax / fast single-file / async decoupled),
+plus ``meta.json`` and a ``latest`` tag file. Sharded state saves/restores in
+parallel from every host and can be resharded on load — a checkpoint written
+on one mesh/ZeRO stage loads onto another (the universal-checkpoint property;
+the explicit fragment format lives in ``universal.py``).
 """
 
 from __future__ import annotations
@@ -20,22 +20,46 @@ import jax
 import numpy as np
 
 from ...utils.logging import log_dist, logger
+from .engines import (CheckpointEngine, FastCheckpointEngine,
+                      SyncCheckpointEngine, get_checkpoint_engine)
 
 
-def _ocp():
-    import orbax.checkpoint as ocp
+def resolve_tag(load_dir: str, tag: Optional[str]) -> str:
+    if tag is not None:
+        return tag
+    latest = os.path.join(load_dir, "latest")
+    if not os.path.exists(latest):
+        raise FileNotFoundError(f"no 'latest' file under {load_dir}")
+    with open(latest) as f:
+        return f.read().strip()
 
-    return ocp
+
+def read_state_tree(tag_dir: str) -> Dict[str, Any]:
+    """Load the raw state pytree from a tag dir, auto-detecting the writer
+    (orbax dir vs fast single-file)."""
+    state_path = os.path.join(tag_dir, "state")
+    if os.path.exists(os.path.join(state_path, "state.bin")):
+        return FastCheckpointEngine().load(state_path)
+    return SyncCheckpointEngine().load(state_path)
+
+
+def _engine_for(engine) -> CheckpointEngine:
+    ce = getattr(engine, "checkpoint_engine", None)
+    if ce is None:
+        cfg = engine.config.checkpoint
+        ce = get_checkpoint_engine(cfg.engine,
+                                   writer_buffer_mb=cfg.writer_buffer_mb)
+        engine.checkpoint_engine = ce
+    return ce
 
 
 def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
                     client_state: Optional[Dict[str, Any]] = None) -> str:
-    ocp = _ocp()
+    ce = _engine_for(engine)
     tag = tag or f"global_step{engine.global_steps}"
     path = os.path.abspath(os.path.join(save_dir, tag))
-    os.makedirs(save_dir, exist_ok=True)
+    os.makedirs(path, exist_ok=True)
 
-    ckptr = ocp.StandardCheckpointer()
     state_dict = {
         "params": engine.state.params,
         "opt_state": engine.state.opt_state,
@@ -43,8 +67,7 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
         "step": engine.state.step,
         "skipped_steps": engine.state.skipped_steps,
     }
-    ckptr.save(os.path.join(path, "state"), state_dict, force=True)
-    ckptr.wait_until_finished()
+    ce.save(state_dict, os.path.join(path, "state"))
 
     meta = {
         "global_steps": engine.global_steps,
@@ -52,6 +75,7 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
         "lr_scheduler": engine.lr_scheduler.state_dict(),
         "client_state": client_state or {},
         "config": engine.config.raw,
+        "checkpoint_engine": ce.name,
         "framework_version": "0.1.0",
     }
     if jax.process_index() == 0:
@@ -59,22 +83,39 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
             json.dump(meta, f, indent=2, default=str)
         with open(os.path.join(save_dir, "latest"), "w") as f:
             f.write(tag)
-    log_dist(f"saved checkpoint {path}")
+    log_dist(f"saved checkpoint {path} (engine={ce.name})")
     return path
 
 
-def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None):
-    ocp = _ocp()
-    if tag is None:
-        latest = os.path.join(load_dir, "latest")
-        if not os.path.exists(latest):
-            logger.warning(f"no 'latest' file under {load_dir}")
-            return None, {}
-        with open(latest) as f:
-            tag = f.read().strip()
+def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
+                    load_universal: Optional[bool] = None, **kw):
+    ce = _engine_for(engine)
+    try:
+        tag = resolve_tag(load_dir, tag)
+    except FileNotFoundError:
+        logger.warning(f"no 'latest' file under {load_dir}")
+        return None, {}
     path = os.path.abspath(os.path.join(load_dir, tag))
 
-    ckptr = ocp.StandardCheckpointer()
+    if load_universal is None:
+        load_universal = engine.config.checkpoint.load_universal
+    if load_universal:
+        from .universal import UNIVERSAL_DIR, load_universal as _load_uni
+
+        params, opt_state, umeta = _load_uni(
+            os.path.join(path, UNIVERSAL_DIR), engine.state.params,
+            engine.state.opt_state)
+        engine.state = engine.state._replace(
+            params=params,
+            opt_state=opt_state if opt_state is not None else engine.state.opt_state,
+            step=jnp_step(engine, umeta.get("global_steps", 0)))
+        engine.global_steps = int(umeta.get("global_steps", 0))
+        engine.micro_steps = int(umeta.get("micro_steps", 0))
+        if "lr_scheduler" in umeta:
+            engine.lr_scheduler.load_state_dict(umeta["lr_scheduler"])
+        log_dist(f"loaded UNIVERSAL checkpoint {path} at step {engine.global_steps}")
+        return path, umeta.get("client_state", {})
+
     template = {
         "params": engine.state.params,
         "opt_state": engine.state.opt_state,
@@ -84,25 +125,36 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None):
     }
     # restore with the CURRENT shardings — topology-independent resume: the
     # checkpoint may have been written on a different mesh/ZeRO stage
-    abstract = jax.tree.map(
-        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
-        if hasattr(x, "sharding") else x, template)
-    restored = ckptr.restore(os.path.join(path, "state"), abstract)
+    restored = ce.load(os.path.join(path, "state"), template)
 
+    # scalars (step/loss-scale) must be replicated over the CURRENT mesh —
+    # a single-device committed scalar would conflict with sharded params
+    rep = engine.mesh_mgr.replicated()
+    small = lambda x: jax.device_put(np.asarray(x), rep)  # noqa: E731
     engine.state = engine.state._replace(
         params=restored["params"], opt_state=restored["opt_state"],
         loss_scale=jax.tree.unflatten(jax.tree.structure(engine.state.loss_scale),
-                                      jax.tree.leaves(restored["loss_scale"])),
-        step=restored["step"], skipped_steps=restored["skipped_steps"])
+                                      [small(l) for l in
+                                       jax.tree.leaves(restored["loss_scale"])]),
+        step=small(restored["step"]),
+        skipped_steps=small(restored["skipped_steps"]))
 
     meta_path = os.path.join(path, "meta.json")
     client_state: Dict[str, Any] = {}
     if os.path.exists(meta_path):
         with open(meta_path) as f:
             meta = json.load(f)
-        engine.global_steps = meta.get("global_steps", int(restored["step"]))
+        engine.global_steps = meta.get("global_steps", int(np.asarray(restored["step"])))
         engine.micro_steps = meta.get("micro_steps", 0)
         engine.lr_scheduler.load_state_dict(meta.get("lr_scheduler", {"last_step": 0}))
         client_state = meta.get("client_state", {})
     log_dist(f"loaded checkpoint {path} at step {engine.global_steps}")
     return path, client_state
+
+
+def jnp_step(engine, step: int):
+    import jax.numpy as jnp
+
+    like = engine.state.step
+    return jax.device_put(jnp.asarray(step, like.dtype), like.sharding) \
+        if hasattr(like, "sharding") else jnp.asarray(step)

@@ -1,0 +1,146 @@
+"""Universal checkpoint: topology-independent per-parameter fp32 fragments.
+
+Reference parity: ``deepspeed/checkpoint/ds_to_universal.py`` (extract zero
+shards → merge tp slices → atomic universal dir) and the runtime loader
+``universal_checkpoint.py:99 load_hp_checkpoint_state``. The reference needs
+an offline merge step because each rank writes its own partition file; here
+sharded state is already saved globally (orbax gathers), so "conversion" is a
+re-serialization into the explicit universal layout:
+
+    <out>/universal/
+        meta.json                          (step, counters, param index)
+        param/<dotted.path>/fp32.npy       (full fp32 parameter)
+        optim/<dotted.path>/<state>.npy    (full fp32 optimizer-state leaf)
+
+Any (mesh, ZeRO stage, TP/PP/SP degree) can load these fragments — placement
+onto the current topology is a ``jax.device_put`` with the current shardings.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import shutil
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ...utils.logging import log_dist, logger
+from ...utils.tree import path_to_str
+
+UNIVERSAL_DIR = "universal"
+
+
+def _path_str(path) -> str:
+    """KeyPath → dotted string ('layers.wq', 'opt.0.mu.embed', ...)."""
+    return path_to_str(path, ".") or "_root"
+
+
+def _safe(name: str) -> str:
+    return re.sub(r"[^A-Za-z0-9_.\-]", "_", name)
+
+
+def _dump_tree(tree: Any, root: str) -> Dict[str, Dict]:
+    index: Dict[str, Dict] = {}
+    flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+    for path, leaf in flat:
+        name = _safe(_path_str(path))
+        d = os.path.join(root, name)
+        os.makedirs(d, exist_ok=True)
+        arr = np.asarray(jax.device_get(leaf))
+        save = arr.astype(np.float32) if np.issubdtype(arr.dtype, np.floating) else arr
+        np.save(os.path.join(d, "fp32.npy"), save)
+        index[name] = {"shape": list(arr.shape), "dtype": str(arr.dtype)}
+    return index
+
+
+def _load_tree_like(template: Any, root: str, *, place: bool = True) -> Any:
+    flat, treedef = jax.tree_util.tree_flatten_with_path(template)
+    leaves = []
+    for path, leaf in flat:
+        name = _safe(_path_str(path))
+        fn = os.path.join(root, name, "fp32.npy")
+        if not os.path.exists(fn):
+            raise FileNotFoundError(f"universal checkpoint missing fragment {name}")
+        arr = np.load(fn)
+        dtype = getattr(leaf, "dtype", arr.dtype)
+        arr = arr.astype(dtype)
+        if arr.shape != tuple(getattr(leaf, "shape", arr.shape)):
+            raise ValueError(f"fragment {name}: shape {arr.shape} != "
+                             f"expected {leaf.shape}")
+        if place and hasattr(leaf, "sharding"):
+            leaves.append(jax.device_put(arr, leaf.sharding))
+        else:
+            leaves.append(arr)
+    return jax.tree.unflatten(treedef, leaves)
+
+
+def save_universal(state, out_dir: str, *, meta: Optional[Dict] = None) -> str:
+    """Write a TrainState (or any {'params':..., 'opt_state':...} mapping) as a
+    universal checkpoint. Atomic: writes to a temp dir then renames."""
+    params = state.params if hasattr(state, "params") else state["params"]
+    opt_state = state.opt_state if hasattr(state, "opt_state") else state.get("opt_state")
+    final = os.path.join(out_dir, UNIVERSAL_DIR)
+    tmp = final + ".tmp"
+    if os.path.exists(tmp):
+        shutil.rmtree(tmp)
+    os.makedirs(tmp, exist_ok=True)
+    index = {"param": _dump_tree(params, os.path.join(tmp, "param"))}
+    if opt_state is not None:
+        index["optim"] = _dump_tree(opt_state, os.path.join(tmp, "optim"))
+    info = dict(meta or {})
+    info["index"] = index
+    with open(os.path.join(tmp, "meta.json"), "w") as f:
+        json.dump(info, f, indent=2, default=str)
+    if os.path.exists(final):
+        shutil.rmtree(final)
+    os.replace(tmp, final)
+    log_dist(f"wrote universal checkpoint {final} "
+             f"({len(index['param'])} params)")
+    return final
+
+
+def load_universal(universal_dir: str, params_template: Any,
+                   opt_state_template: Any = None,
+                   *, place: bool = True) -> Tuple[Any, Any, Dict]:
+    """Map fp32 fragments onto the CURRENT topology (reference
+    ``universal_checkpoint.py:99``): templates supply shapes/dtypes/shardings;
+    fragments are cast and device_put accordingly."""
+    root = universal_dir
+    if os.path.basename(root) != UNIVERSAL_DIR and \
+            os.path.isdir(os.path.join(root, UNIVERSAL_DIR)):
+        root = os.path.join(root, UNIVERSAL_DIR)
+    params = _load_tree_like(params_template, os.path.join(root, "param"),
+                             place=place)
+    opt_state = None
+    if opt_state_template is not None and os.path.isdir(os.path.join(root, "optim")):
+        opt_state = _load_tree_like(opt_state_template,
+                                    os.path.join(root, "optim"), place=place)
+    meta: Dict = {}
+    mp = os.path.join(root, "meta.json")
+    if os.path.exists(mp):
+        with open(mp) as f:
+            meta = json.load(f)
+    return params, opt_state, meta
+
+
+def ds_to_universal(ckpt_dir: str, tag: Optional[str] = None,
+                    out_dir: Optional[str] = None) -> str:
+    """Offline converter (reference ``ds_to_universal.py`` CLI): engine
+    checkpoint → universal fragments."""
+    from .saver import read_state_tree, resolve_tag
+
+    tag = resolve_tag(ckpt_dir, tag)
+    state = read_state_tree(os.path.join(ckpt_dir, tag))
+    meta_path = os.path.join(ckpt_dir, tag, "meta.json")
+    meta = {}
+    if os.path.exists(meta_path):
+        with open(meta_path) as f:
+            meta = {k: v for k, v in json.load(f).items()
+                    if k in ("global_steps", "micro_steps", "lr_scheduler")}
+    return save_universal(
+        type("S", (), {"params": state["params"],
+                       "opt_state": state.get("opt_state")})(),
+        out_dir or os.path.join(ckpt_dir, tag), meta=meta)

@@ -1,0 +1,67 @@
+"""Offline consolidation of a (possibly ZeRO-sharded) checkpoint into a single
+fp32 state dict — reference ``deepspeed/utils/zero_to_fp32.py`` (the recovery
+script the reference copies into every checkpoint dir, ``engine.py:4181``).
+
+On TPU the shards were already gathered at save time, so consolidation is
+flatten + cast + single-file write. Output: ``.npz`` with dotted-path keys
+(loadable anywhere numpy exists — no framework dependency), mirroring the
+reference's ``pytorch_model.bin`` consolidation.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+from ...utils.logging import log_dist
+from .universal import _path_str, _safe
+
+
+def get_fp32_state_dict_from_checkpoint(ckpt_dir: str,
+                                        tag: Optional[str] = None
+                                        ) -> Dict[str, np.ndarray]:
+    """Reference ``get_fp32_state_dict_from_zero_checkpoint``."""
+    from .saver import read_state_tree, resolve_tag
+
+    tag = resolve_tag(ckpt_dir, tag)
+    state = read_state_tree(os.path.join(ckpt_dir, tag))
+    out: Dict[str, np.ndarray] = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(state["params"])[0]:
+        arr = np.asarray(jax.device_get(leaf))
+        if np.issubdtype(arr.dtype, np.floating):
+            arr = arr.astype(np.float32)
+        out[_safe(_path_str(path))] = arr
+    return out
+
+
+def convert_checkpoint_to_fp32_state_dict(ckpt_dir: str, output_file: str,
+                                          tag: Optional[str] = None) -> str:
+    """Reference ``convert_zero_checkpoint_to_fp32_state_dict`` CLI entry."""
+    sd = get_fp32_state_dict_from_checkpoint(ckpt_dir, tag)
+    os.makedirs(os.path.dirname(os.path.abspath(output_file)), exist_ok=True)
+    np.savez(output_file if output_file.endswith(".npz")
+             else output_file + ".npz", **sd)
+    total = sum(v.size for v in sd.values())
+    log_dist(f"consolidated {len(sd)} tensors ({total/1e6:.1f}M elements) "
+             f"→ {output_file}")
+    return output_file
+
+
+def main(argv=None):
+    import argparse
+
+    p = argparse.ArgumentParser(
+        description="Consolidate a deepspeed_tpu checkpoint to one fp32 .npz")
+    p.add_argument("checkpoint_dir")
+    p.add_argument("output_file")
+    p.add_argument("--tag", default=None)
+    args = p.parse_args(argv)
+    convert_checkpoint_to_fp32_state_dict(args.checkpoint_dir,
+                                          args.output_file, args.tag)
+
+
+if __name__ == "__main__":
+    main()

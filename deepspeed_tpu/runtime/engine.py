@@ -187,6 +187,15 @@ class DeepSpeedTPUEngine:
         self.tput_timer = ThroughputTimer(
             batch_size=self.train_batch_size(),
             steps_per_output=config.steps_per_print)
+
+        # --- monitoring + flops profiler (reference MonitorMaster :293,
+        # flops_profiler engine hooks :2278,:2850) ---
+        from ..monitor import MonitorMaster
+
+        self.monitor = MonitorMaster(config)
+        from ..profiling import FlopsProfiler
+
+        self.flops_profiler = FlopsProfiler(config.flops_profiler, engine=self)
         log_dist(
             f"engine ready: zero_stage={config.zero_config.stage} "
             f"dtype={config.compute_dtype} mesh={dict(mesh_mgr.mesh.shape)} "
@@ -369,6 +378,7 @@ class DeepSpeedTPUEngine:
         self._last_grad_norm = out.grad_norm
         self.lr_scheduler.last_step = self.global_steps
         self.tput_timer.stop()
+        self._write_monitor_events(out)
         if self.config.steps_per_print and \
                 self.global_steps % self.config.steps_per_print == 0:
             log_dist(f"step={self.global_steps} loss={float(out.loss):.4f} "
@@ -431,7 +441,29 @@ class DeepSpeedTPUEngine:
         self._staged_batches.clear()
         self.global_steps += 1
         self._last_grad_norm = out.grad_norm
+        # commit any in-flight async checkpoint at the boundary (reference
+        # decoupled-engine commit, runtime/engine.py:2797)
+        ce = getattr(self, "checkpoint_engine", None)
+        if ce is not None and getattr(ce, "_pending", None):
+            ce.wait_all()
+        self._write_monitor_events(out)
         return out
+
+    def _write_monitor_events(self, out) -> None:
+        """Train/Samples/* scalars per step (reference engine.py:2825-2847)."""
+        mon = getattr(self, "monitor", None)
+        if mon is None or not mon.enabled:
+            return
+        events = [("Train/Samples/train_loss", float(out.loss),
+                   self.global_steps),
+                  ("Train/Samples/lr", float(out.lr), self.global_steps)]
+        if self.config.fp16.enabled:
+            events.append(("Train/Samples/loss_scale", float(out.loss_scale),
+                           self.global_steps))
+        if out.grad_norm is not None:
+            events.append(("Train/Samples/grad_norm", float(out.grad_norm),
+                           self.global_steps))
+        mon.write_events(events)
 
     # ------------------------------------------------------------------ #
     # eval / inference forward

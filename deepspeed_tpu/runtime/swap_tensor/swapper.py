@@ -40,17 +40,9 @@ class SwappedTensorMeta:
 
 
 def _leaf_name(path) -> str:
-    parts = []
-    for p in path:
-        if hasattr(p, "key"):
-            parts.append(str(p.key))
-        elif hasattr(p, "idx"):
-            parts.append(str(p.idx))
-        elif hasattr(p, "name"):
-            parts.append(str(p.name))
-        else:
-            parts.append(str(p))
-    return "_".join(parts) or "leaf"
+    from ...utils.tree import path_to_str
+
+    return path_to_str(path, "_") or "leaf"
 
 
 class AsyncTensorSwapper:

@@ -1,0 +1,18 @@
+"""Pytree helpers shared across subsystems."""
+
+from __future__ import annotations
+
+
+def path_to_str(path, sep: str = ".") -> str:
+    """jax KeyPath → joined string ('layers.wq', 'opt.0.mu.embed', ...)."""
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        elif hasattr(p, "name"):
+            parts.append(str(p.name))
+        else:
+            parts.append(str(p))
+    return sep.join(parts)
