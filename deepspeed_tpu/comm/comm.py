@@ -176,16 +176,23 @@ def init_distributed(dist_backend: str = "xla",
     if _initialized:
         return
     env_procs = os.environ.get("DSTPU_NUM_PROCESSES")
+    if coordinator_address is None:
+        coordinator_address = os.environ.get("DSTPU_COORDINATOR")
     if coordinator_address is None and env_procs is None and num_processes is None:
         _initialized = True  # single-process / TPU-native bootstrap
         log_dist("init_distributed: single-process or TPU-native rendezvous")
         return
     try:
+        if process_id is None:
+            # launcher env first; SLURM rank as fallback (SlurmRunner cannot
+            # export a per-rank id through one srun command line)
+            pid = os.environ.get("DSTPU_PROCESS_ID",
+                                 os.environ.get("SLURM_PROCID", 0))
+            process_id = int(pid)
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes or int(env_procs or 1),
-            process_id=process_id if process_id is not None
-            else int(os.environ.get("DSTPU_PROCESS_ID", 0)))
+            process_id=process_id)
         _initialized = True
         log_dist(f"init_distributed: {jax.process_count()} processes")
     except Exception as e:  # already initialised by the launcher

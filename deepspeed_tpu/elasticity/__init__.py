@@ -1,0 +1,2 @@
+from .elasticity import (compute_elastic_config, ElasticityError,  # noqa: F401
+                         get_compatible_chip_counts)

@@ -1,0 +1,128 @@
+"""Elastic batch configuration.
+
+Reference parity: ``deepspeed/elasticity/elasticity.py:233
+compute_elastic_config`` (+ candidate-batch algorithms v0.1 :83 / v0.2 :126)
+— given a maximum acceptable global batch size and a set of micro-batch
+candidates, enumerate the chip counts at which the job can run with an
+IDENTICAL effective batch, so a restarted job at a different scale keeps its
+training schedule. The reference's torch-elastic agent becomes: resume from a
+(universal) checkpoint on the new mesh; this module supplies the math, the
+checkpoint layer supplies the state portability.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+LATEST_ELASTICITY_VERSION = 0.2
+
+
+class ElasticityError(Exception):
+    pass
+
+
+def _candidate_batch_sizes(base_list: Sequence[int], max_batch: int) -> List[int]:
+    """All attainable global batch sizes: multiples of each micro-batch
+    candidate up to max (reference v0.1 ``get_candidate_batch_sizes``)."""
+    out = set()
+    for mb in base_list:
+        b = mb
+        while b <= max_batch:
+            out.add(b)
+            b += mb
+    return sorted(out)
+
+
+def _valid_chip_counts(batch: int, micro_batches: Sequence[int],
+                      min_chips: int, max_chips: int,
+                      prefer_larger: bool) -> List[Tuple[int, int, int]]:
+    """(chips, micro_batch, gas) triples with chips*mb*gas == batch."""
+    out = []
+    for mb in micro_batches:
+        if batch % mb:
+            continue
+        total_steps = batch // mb  # chips × gas
+        for chips in range(min_chips, max_chips + 1):
+            if total_steps % chips == 0:
+                out.append((chips, mb, total_steps // chips))
+    out.sort(key=lambda t: (t[0], t[1] if not prefer_larger else -t[1]))
+    return out
+
+
+def get_compatible_chip_counts(micro_batches: Sequence[int], max_batch: int,
+                               min_chips: int = 1, max_chips: int = 1024,
+                               prefer_larger: bool = True) -> Dict[int, List[Tuple[int, int, int]]]:
+    """batch size → feasible (chips, micro_batch, gas) list."""
+    result = {}
+    for b in _candidate_batch_sizes(micro_batches, max_batch):
+        triples = _valid_chip_counts(b, micro_batches, min_chips, max_chips,
+                                     prefer_larger)
+        if triples:
+            result[b] = triples
+    return result
+
+
+@dataclasses.dataclass
+class ElasticConfig:
+    global_batch_size: int
+    micro_batch_size: int
+    gradient_accumulation_steps: int
+    chips: int
+    compatible_chip_counts: List[int]
+
+
+def compute_elastic_config(elastic_config: Dict, target_chips: Optional[int] = None,
+                           return_microbatch: bool = False):
+    """Reference ``compute_elastic_config`` (``elasticity.py:233``): pick the
+    best (global batch, micro batch, gas) for ``target_chips`` under the
+    user's elastic constraints dict:
+
+        {"enabled": true, "max_train_batch_size": N,
+         "micro_batch_sizes": [...], "min_gpus": a, "max_gpus": b,
+         "prefer_larger_batch": true, "version": 0.2}
+    """
+    if not elastic_config.get("enabled", False):
+        raise ElasticityError("elasticity not enabled in config")
+    version = float(elastic_config.get("version", LATEST_ELASTICITY_VERSION))
+    if version > LATEST_ELASTICITY_VERSION:
+        raise ElasticityError(f"unsupported elasticity version {version}")
+    max_batch = int(elastic_config["max_train_batch_size"])
+    micro_batches = [int(m) for m in elastic_config["micro_batch_sizes"]]
+    if not micro_batches or any(m <= 0 for m in micro_batches):
+        raise ElasticityError(f"bad micro_batch_sizes {micro_batches}")
+    min_chips = int(elastic_config.get("min_gpus",
+                                       elastic_config.get("min_chips", 1)))
+    max_chips = int(elastic_config.get("max_gpus",
+                                       elastic_config.get("max_chips", 1024)))
+    prefer_larger = bool(elastic_config.get("prefer_larger_batch", True))
+
+    table = get_compatible_chip_counts(micro_batches, max_batch, min_chips,
+                                       max_chips, prefer_larger)
+    if not table:
+        raise ElasticityError("no feasible elastic configuration")
+
+    # choose the batch size compatible with the MOST chip counts, largest
+    # batch breaking ties (v0.2 behavior)
+    def score(b):
+        chips = {t[0] for t in table[b]}
+        return (len(chips), b if prefer_larger else -b)
+
+    best_batch = max(table, key=score)
+    triples = table[best_batch]
+    compatible = sorted({t[0] for t in triples})
+    if target_chips is None:
+        target_chips = compatible[-1]  # default to the largest feasible scale
+    match = [t for t in triples if t[0] == target_chips]
+    if not match:
+        raise ElasticityError(
+            f"{target_chips} chips incompatible with batch {best_batch}; "
+            f"compatible counts: {compatible}")
+    # triples are sorted so match[0] respects prefer_larger_batch
+    chips, mb, gas = match[0]
+    cfg = ElasticConfig(global_batch_size=best_batch, micro_batch_size=mb,
+                        gradient_accumulation_steps=gas, chips=chips,
+                        compatible_chip_counts=compatible)
+    if return_microbatch:
+        return cfg.global_batch_size, cfg.micro_batch_size, cfg
+    return cfg.global_batch_size, cfg

@@ -1,0 +1,88 @@
+"""Environment/compatibility report — reference ``deepspeed/env_report.py``
+(``bin/ds_report``). Prints the JAX/TPU stack, device inventory, op-registry
+backends (Pallas vs XLA fallback) and native-extension build status."""
+
+from __future__ import annotations
+
+import importlib
+import os
+import sys
+
+GREEN_OK, RED_NO = "[OKAY]", "[NO]"
+
+
+def _version(mod: str) -> str:
+    try:
+        m = importlib.import_module(mod)
+        return getattr(m, "__version__", "unknown")
+    except Exception:
+        return "not installed"
+
+
+def collect() -> dict:
+    import jax
+
+    report = {
+        "python": sys.version.split()[0],
+        "jax": _version("jax"),
+        "jaxlib": _version("jaxlib"),
+        "flax": _version("flax"),
+        "optax": _version("optax"),
+        "orbax": _version("orbax.checkpoint"),
+        "numpy": _version("numpy"),
+        "deepspeed_tpu": _version("deepspeed_tpu"),
+        "backend": jax.default_backend(),
+        "devices": [str(d) for d in jax.devices()],
+        "device_kind": getattr(jax.devices()[0], "device_kind", "?"),
+        "process_count": jax.process_count(),
+    }
+    # op registry: which ops have a kernel backend vs XLA-only
+    try:
+        from deepspeed_tpu.ops.registry import _REGISTRY
+
+        report["ops"] = {name: sorted(backends)
+                         for name, backends in _REGISTRY.items()}
+    except Exception:
+        report["ops"] = {}
+    # native extensions
+    natives = {}
+    try:
+        from deepspeed_tpu.ops.cpu_optimizer import _lib
+
+        natives["cpu_optimizer"] = _lib() is not None
+    except Exception:
+        natives["cpu_optimizer"] = False
+    try:
+        from deepspeed_tpu.ops.aio.handle import aio_available
+
+        natives["aio"] = bool(aio_available())
+    except Exception:
+        natives["aio"] = False
+    report["native"] = natives
+    return report
+
+
+def main(argv=None) -> int:
+    r = collect()
+    print("-" * 62)
+    print("deepspeed_tpu environment report (ds_report parity)")
+    print("-" * 62)
+    for k in ("python", "jax", "jaxlib", "flax", "optax", "orbax", "numpy",
+              "deepspeed_tpu"):
+        print(f"{k:>16}: {r[k]}")
+    print(f"{'backend':>16}: {r['backend']} ({r['device_kind']}) "
+          f"x{len(r['devices'])} devices, {r['process_count']} process(es)")
+    print("-" * 62)
+    print("op registry (kernel backends per op):")
+    for name, backends in sorted(r.get("ops", {}).items()):
+        tag = GREEN_OK if any(b != "xla" for b in backends) else "[xla-only]"
+        print(f"  {name:<28} {','.join(backends):<24} {tag}")
+    print("native extensions:")
+    for name, ok in r["native"].items():
+        print(f"  {name:<28} {GREEN_OK if ok else RED_NO}")
+    print("-" * 62)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

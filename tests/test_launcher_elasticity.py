@@ -1,0 +1,131 @@
+"""Launcher + elasticity + env-report tests (reference model:
+``tests/unit/launcher``, ``tests/unit/elasticity``)."""
+
+import subprocess
+import sys
+
+import pytest
+
+from deepspeed_tpu.elasticity import (ElasticityError, compute_elastic_config,
+                                      get_compatible_chip_counts)
+from deepspeed_tpu.env_report import collect
+from deepspeed_tpu.launcher.runner import (LocalRunner, PDSHRunner,
+                                           build_commands, decode_world_info,
+                                           encode_world_info,
+                                           parse_hostfile,
+                                           parse_inclusion_exclusion,
+                                           parse_args)
+
+
+def test_parse_hostfile():
+    hosts = parse_hostfile("""
+    # comment
+    worker-0 slots=4
+    worker-1 slots=8   # trailing
+    worker-2
+    """)
+    assert hosts == {"worker-0": 4, "worker-1": 8, "worker-2": 1}
+    with pytest.raises(ValueError):
+        parse_hostfile("a slots=2\na slots=4")
+
+
+def test_include_exclude_filters():
+    hosts = {"w0": 4, "w1": 4, "w2": 4}
+    assert parse_inclusion_exclusion(hosts, "w0@w2", "") == {"w0": 4, "w2": 4}
+    assert parse_inclusion_exclusion(hosts, "", "w1") == {"w0": 4, "w2": 4}
+    assert parse_inclusion_exclusion(hosts, "w0:0,1", "") == {"w0": 2}
+    with pytest.raises(ValueError):
+        parse_inclusion_exclusion(hosts, "w0", "w1")
+    with pytest.raises(ValueError):
+        parse_inclusion_exclusion(hosts, "nope", "")
+
+
+def test_world_info_roundtrip():
+    hosts = {"a": 4, "b": 8}
+    assert decode_world_info(encode_world_info(hosts)) == hosts
+
+
+def test_local_runner_cmds(tmp_path):
+    hf = tmp_path / "hostfile"
+    hf.write_text("localhost slots=1\n")
+    args = parse_args(["-H", str(hf), "train.py", "--lr", "0.1"])
+    runner, cmds = build_commands(args)
+    assert isinstance(runner, LocalRunner)
+    assert cmds == [[sys.executable, "train.py", "--lr", "0.1"]]
+
+
+def test_pdsh_runner_cmds(tmp_path):
+    hf = tmp_path / "hostfile"
+    hf.write_text("w0 slots=4\nw1 slots=4\n")
+    args = parse_args(["-H", str(hf), "--launcher", "pdsh", "train.py"])
+    # build the command lines directly (ssh may be absent in the image)
+    runner = PDSHRunner(args, parse_hostfile(hf.read_text()))
+    cmds = runner.get_cmd()
+    assert len(cmds) == 2
+    assert cmds[0][0] == "ssh" and "DSTPU_PROCESS_ID=0" in cmds[0][-1]
+    assert "DSTPU_PROCESS_ID=1" in cmds[1][-1]
+    assert "DSTPU_COORDINATOR=w0:8476" in cmds[1][-1]
+
+
+def test_elastic_config_v02():
+    ec = {"enabled": True, "max_train_batch_size": 10000,
+          "micro_batch_sizes": [8, 12, 16, 17], "min_gpus": 32,
+          "max_gpus": 1500, "prefer_larger_batch": True}
+    batch, cfg = compute_elastic_config(ec)
+    assert batch <= 10000
+    assert len(cfg.compatible_chip_counts) > 1
+    # effective batch identical at a specific scale
+    batch2, mb, cfg2 = compute_elastic_config(ec, target_chips=64,
+                                              return_microbatch=True)
+    assert batch2 == batch
+    assert mb * cfg2.gradient_accumulation_steps * 64 == batch
+
+
+def test_elastic_default_target_consistent_with_explicit():
+    """Regression: no-target selection must agree with target_chips= at the
+    same scale (micro-batch preference must not flip)."""
+    ec = {"enabled": True, "max_train_batch_size": 512,
+          "micro_batch_sizes": [4, 8], "min_gpus": 2, "max_gpus": 16,
+          "prefer_larger_batch": True}
+    batch, cfg = compute_elastic_config(ec)
+    batch2, mb2, cfg2 = compute_elastic_config(ec, target_chips=cfg.chips,
+                                               return_microbatch=True)
+    assert (batch, cfg.micro_batch_size, cfg.gradient_accumulation_steps) == \
+        (batch2, mb2, cfg2.gradient_accumulation_steps)
+
+
+def test_elastic_config_errors():
+    with pytest.raises(ElasticityError):
+        compute_elastic_config({"enabled": False})
+    with pytest.raises(ElasticityError):
+        compute_elastic_config({"enabled": True, "max_train_batch_size": 4,
+                                "micro_batch_sizes": [0], "version": 0.2})
+    ec = {"enabled": True, "max_train_batch_size": 64,
+          "micro_batch_sizes": [8], "min_gpus": 1, "max_gpus": 8}
+    with pytest.raises(ElasticityError):
+        compute_elastic_config(ec, target_chips=7)
+
+
+def test_compatible_chip_counts_exact_batch():
+    table = get_compatible_chip_counts([2, 4], max_batch=16, min_chips=1,
+                                       max_chips=8)
+    assert all(chips * mb * gas == b
+               for b, triples in table.items()
+               for chips, mb, gas in triples)
+
+
+def test_env_report_collect():
+    r = collect()
+    assert r["backend"] == "cpu"
+    assert len(r["devices"]) == 8
+    assert "attention" in r["ops"]
+
+
+def test_ds_report_cli_runs():
+    out = subprocess.run([sys.executable, "-m", "deepspeed_tpu.env_report"],
+                         capture_output=True, text=True, timeout=120,
+                         env={"PATH": "/usr/bin:/bin", "HOME": "/root",
+                              "JAX_PLATFORMS": "cpu",
+                              "PYTHONPATH": "/root/repo"})
+    assert out.returncode == 0, out.stderr
+    assert "deepspeed_tpu environment report" in out.stdout
