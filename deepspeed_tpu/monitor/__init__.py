@@ -1,0 +1,1 @@
+from .monitor import MonitorMaster, get_monitor  # noqa: F401

@@ -1,0 +1,164 @@
+"""Metrics monitoring — fan-out to TensorBoard / WandB / CSV backends.
+
+Reference parity: ``deepspeed/monitor/monitor.py:30 MonitorMaster`` with
+``tensorboard.py``, ``wandb.py``, ``csv_monitor.py`` (Comet omitted — no SDK
+in image; the backend registry accepts third-party writers). Each backend is
+config-gated and degrades to disabled with a warning when its library is
+missing. Events are ``(name, value, step)`` tuples, written by rank 0 only
+(``jax.process_index() == 0``), matching the reference's rank-0 gating.
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+from typing import Any, List, Optional, Sequence, Tuple
+
+import jax
+
+from ..utils.logging import logger
+
+Event = Tuple[str, float, int]
+
+
+class MonitorBackend:
+    name = "base"
+
+    def __init__(self, cfg):
+        self.cfg = cfg
+        self.enabled = bool(getattr(cfg, "enabled", False))
+
+    def write_events(self, events: Sequence[Event]) -> None:
+        raise NotImplementedError
+
+    def flush(self) -> None:
+        pass
+
+
+class TensorBoardMonitor(MonitorBackend):
+    """Reference ``monitor/tensorboard.py``. Uses torch's SummaryWriter (cpu
+    torch is in-image); falls back to tensorboardX if present."""
+
+    name = "tensorboard"
+
+    def __init__(self, cfg):
+        super().__init__(cfg)
+        self.writer = None
+        if not self.enabled:
+            return
+        try:
+            from torch.utils.tensorboard import SummaryWriter
+
+            path = os.path.join(cfg.output_path or "runs", cfg.job_name)
+            self.writer = SummaryWriter(log_dir=path)
+        except Exception as e:
+            logger.warning(f"tensorboard monitor disabled: {e}")
+            self.enabled = False
+
+    def write_events(self, events: Sequence[Event]) -> None:
+        if not self.writer:
+            return
+        for name, value, step in events:
+            self.writer.add_scalar(name, float(value), int(step))
+
+    def flush(self) -> None:
+        if self.writer:
+            self.writer.flush()
+
+
+class WandbMonitor(MonitorBackend):
+    """Reference ``monitor/wandb.py``; requires the wandb SDK."""
+
+    name = "wandb"
+
+    def __init__(self, cfg):
+        super().__init__(cfg)
+        self.run = None
+        if not self.enabled:
+            return
+        try:
+            import wandb
+
+            self.run = wandb.init(project=cfg.project or cfg.job_name,
+                                  entity=cfg.team, group=cfg.group,
+                                  dir=cfg.output_path or None)
+            self._wandb = wandb
+        except Exception as e:
+            logger.warning(f"wandb monitor disabled: {e}")
+            self.enabled = False
+
+    def write_events(self, events: Sequence[Event]) -> None:
+        if not self.run:
+            return
+        for name, value, step in events:
+            self._wandb.log({name: float(value)}, step=int(step))
+
+
+class CSVMonitor(MonitorBackend):
+    """Reference ``monitor/csv_monitor.py`` — one CSV per metric name."""
+
+    name = "csv"
+
+    def __init__(self, cfg):
+        super().__init__(cfg)
+        self._files = {}
+        if self.enabled:
+            self.root = os.path.join(cfg.output_path or "csv_monitor",
+                                     cfg.job_name)
+            os.makedirs(self.root, exist_ok=True)
+
+    def _writer(self, name: str):
+        if name not in self._files:
+            fn = os.path.join(self.root, name.replace("/", "_") + ".csv")
+            new = not os.path.exists(fn)
+            f = open(fn, "a", newline="")
+            w = csv.writer(f)
+            if new:
+                w.writerow(["step", name])
+            self._files[name] = (f, w)
+        return self._files[name]
+
+    def write_events(self, events: Sequence[Event]) -> None:
+        if not self.enabled:
+            return
+        for name, value, step in events:
+            f, w = self._writer(name)
+            w.writerow([int(step), float(value)])
+
+    def flush(self) -> None:
+        for f, _ in self._files.values():
+            f.flush()
+
+
+class MonitorMaster(MonitorBackend):
+    """Fans every event out to all enabled backends (reference
+    ``monitor.py:30``)."""
+
+    name = "master"
+
+    def __init__(self, monitor_config):
+        self.backends: List[MonitorBackend] = []
+        cfg = monitor_config
+        self.enabled = False
+        if jax.process_index() != 0:
+            return
+        for cls, sub in ((TensorBoardMonitor, getattr(cfg, "tensorboard", None)),
+                         (WandbMonitor, getattr(cfg, "wandb", None)),
+                         (CSVMonitor, getattr(cfg, "csv_monitor", None))):
+            if sub is not None and getattr(sub, "enabled", False):
+                b = cls(sub)
+                if b.enabled:
+                    self.backends.append(b)
+        self.enabled = bool(self.backends)
+
+    def write_events(self, events: Sequence[Event]) -> None:
+        for b in self.backends:
+            b.write_events(events)
+
+    def flush(self) -> None:
+        for b in self.backends:
+            b.flush()
+
+
+def get_monitor(config) -> MonitorMaster:
+    return MonitorMaster(config)

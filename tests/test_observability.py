@@ -1,0 +1,123 @@
+"""Monitor / flops-profiler / comms-logger tests (reference model:
+``tests/unit/monitor``, ``tests/unit/profiling``)."""
+
+import glob
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu as dst
+from deepspeed_tpu.models import llama
+from deepspeed_tpu.monitor.monitor import CSVMonitor, MonitorMaster
+from deepspeed_tpu.profiling import FlopsProfiler, get_model_profile
+from deepspeed_tpu.profiling.flops_profiler import profile_jaxpr
+
+
+def test_csv_monitor_writes(tmp_path):
+    class Cfg:
+        enabled = True
+        output_path = str(tmp_path)
+        job_name = "job"
+
+    mon = CSVMonitor(Cfg())
+    mon.write_events([("Train/loss", 1.5, 1), ("Train/loss", 1.2, 2),
+                      ("Train/lr", 0.1, 1)])
+    mon.flush()
+    files = sorted(glob.glob(str(tmp_path / "job" / "*.csv")))
+    assert len(files) == 2
+    loss_file = [f for f in files if "loss" in f][0]
+    lines = open(loss_file).read().strip().splitlines()
+    assert lines[0].startswith("step,") and len(lines) == 3
+
+
+def test_monitor_master_through_engine(devices8, tmp_path):
+    cfg = llama.LlamaConfig.tiny()
+    spec = llama.model_spec(cfg, compute_dtype=jnp.float32)
+    config = {
+        "train_batch_size": 8,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+        "csv_monitor": {"enabled": True, "output_path": str(tmp_path),
+                        "job_name": "engine_run"},
+        "steps_per_print": 0,
+    }
+    engine, *_ = dst.initialize(model=spec, config=config)
+    assert engine.monitor.enabled
+    tokens = np.random.randint(0, cfg.vocab_size, (8, 33)).astype(np.int32)
+    for _ in range(2):
+        engine.train_batch({"tokens": tokens})
+    engine.monitor.flush()
+    files = glob.glob(str(tmp_path / "engine_run" / "*.csv"))
+    names = {os.path.basename(f) for f in files}
+    assert "Train_Samples_train_loss.csv" in names
+    assert "Train_Samples_lr.csv" in names
+
+
+def test_monitor_disabled_by_default(devices8):
+    cfg = llama.LlamaConfig.tiny()
+    spec = llama.model_spec(cfg, compute_dtype=jnp.float32)
+    engine, *_ = dst.initialize(model=spec, config={
+        "train_batch_size": 8,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+        "steps_per_print": 0})
+    assert not engine.monitor.enabled
+
+
+def test_get_model_profile_matmul():
+    a = jnp.ones((128, 256), jnp.float32)
+    b = jnp.ones((256, 64), jnp.float32)
+    prof = get_model_profile(lambda x, y: x @ y, (a, b), as_string=True)
+    # 2*M*N*K = 2*128*64*256 = 4.19e6; XLA may fold but order must match
+    assert prof["flops"] == pytest.approx(2 * 128 * 64 * 256, rel=0.5)
+    assert prof["latency_s"] > 0
+    assert "TFLOPS" in prof["summary"]
+
+
+def test_profile_jaxpr_counts_dots_and_scan():
+    def f(x, w):
+        def body(h, _):
+            return h @ w, None
+
+        h, _ = jax.lax.scan(body, x, None, length=4)
+        return h
+
+    x = jnp.ones((8, 16))
+    w = jnp.ones((16, 16))
+    tally = profile_jaxpr(f, x, w)
+    # 4 scan iterations × 2*8*16*16
+    assert tally["dot_general"] == pytest.approx(4 * 2 * 8 * 16 * 16)
+    assert tally["total"] >= tally["dot_general"]
+
+
+def test_flops_profiler_engine_hooks(devices8):
+    cfg = llama.LlamaConfig.tiny()
+    spec = llama.model_spec(cfg, compute_dtype=jnp.float32)
+    engine, *_ = dst.initialize(model=spec, config={
+        "train_batch_size": 8,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+        "flops_profiler": {"enabled": True, "profile_step": 1},
+        "steps_per_print": 0})
+    assert engine.flops_profiler.enabled
+    engine.flops_profiler.start_profile()
+    tokens = np.random.randint(0, cfg.vocab_size, (8, 33)).astype(np.int32)
+    engine.train_batch({"tokens": tokens})
+    prof = engine.flops_profiler.stop_profile(flops=1e9,
+                                              peak_flops_per_chip=1e12)
+    assert prof["params"] == cfg.num_params
+    assert prof["latency_s"] > 0 and 0 < prof["mfu"]
+
+
+def test_comms_telemetry():
+    from deepspeed_tpu.comm import comm as dist
+
+    dist.configure(enabled=True)
+    tel = dist.get_telemetry()
+    tel.reset()
+    x = jnp.ones((4, 4))
+    tel.record("all_reduce", "data", x)
+    tel.record("all_reduce", "data", x)
+    s = tel.summary()
+    assert s["all_reduce"]["count"] == 2
+    dist.configure(enabled=False)
