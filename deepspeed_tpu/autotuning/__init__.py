@@ -1,0 +1,2 @@
+from .autotuner import Autotuner, TrialResult  # noqa: F401
+from .tuner import GridSearchTuner, ModelBasedTuner, RandomTuner  # noqa: F401

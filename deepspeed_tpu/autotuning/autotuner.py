@@ -1,0 +1,183 @@
+"""Autotuner: memory-model pruning + trial runs over sharding/micro-batch
+configurations.
+
+Reference parity: ``deepspeed/autotuning/autotuner.py:42`` — profiles the
+model (param/activation memory, ``autotuning_profile_model_info``), prunes the
+ZeRO-stage search space with a memory model, then runs grid/random/model-based
+tuners over (micro_batch, GAS, zero_stage) with each trial a real short run.
+TPU-first: a "trial" is N ``train_batch`` steps of a freshly-initialized
+engine on the CURRENT devices (jit caching makes repeat trials cheap), the
+memory model counts HBM bytes per chip under each ZeRO stage's sharding specs,
+and the search adds TPU-specific knobs (remat policy) to the space.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import json
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from ..utils.logging import log_dist, logger
+from .tuner import GridSearchTuner, ModelBasedTuner, RandomTuner
+
+TUNERS = {"gridsearch": GridSearchTuner, "random": RandomTuner,
+          "model_based": ModelBasedTuner}
+
+
+@dataclasses.dataclass
+class TrialResult:
+    config: Dict[str, Any]
+    samples_per_sec: float
+    step_time_s: float
+    error: Optional[str] = None
+
+
+def estimate_memory_per_chip(num_params: int, zero_stage: int, n_chips: int,
+                             micro_batch: int, seq_len: int, hidden: int,
+                             num_layers: int, remat: bool = False,
+                             optimizer_factor: int = 2,
+                             compute_bytes: int = 2) -> int:
+    """HBM bytes/chip under a ZeRO stage (reference memory model
+    ``autotuning/utils.py`` + ZeRO stage arithmetic):
+
+    - master params fp32 + optimizer states (Adam: 2 slots fp32)
+    - compute-dtype param copy (bf16) at use time
+    - gradients fp32
+    - activations ≈ micro_batch × seq × hidden × layers × compute_bytes
+      (× ~4 ops/layer without remat, ×1 with remat — scan keeps one block)
+    """
+    fp32 = 4
+    opt = num_params * fp32 * optimizer_factor
+    master = num_params * fp32
+    grads = num_params * fp32
+    if zero_stage >= 1:
+        opt //= n_chips
+    if zero_stage >= 2:
+        grads //= n_chips
+    live_params = num_params * compute_bytes
+    if zero_stage >= 3:
+        master //= n_chips
+        live_params //= max(1, n_chips // 2)  # gathered layer-by-layer
+    act_factor = 1 if remat else 4
+    acts = micro_batch * seq_len * hidden * num_layers * compute_bytes * act_factor
+    return int(master + opt + grads + live_params + acts)
+
+
+DEFAULT_MICRO_BATCHES = (1, 2, 4, 8, 16)
+DEFAULT_STAGES = (0, 1, 2, 3)
+
+
+class Autotuner:
+    """Find the fastest feasible (zero_stage, micro_batch, gas, remat) for a
+    model + target global batch on the current devices."""
+
+    def __init__(self, model_spec, base_config: Dict[str, Any], *,
+                 model_info: Optional[Dict[str, int]] = None,
+                 hbm_bytes_per_chip: Optional[int] = None,
+                 trial_steps: int = 3,
+                 tuner_type: str = "model_based",
+                 micro_batches: Sequence[int] = DEFAULT_MICRO_BATCHES,
+                 zero_stages: Sequence[int] = DEFAULT_STAGES,
+                 remat_options: Sequence[bool] = (False,)):
+        self.model_spec = model_spec
+        self.base_config = dict(base_config)
+        self.trial_steps = trial_steps
+        self.tuner_type = tuner_type
+        self.n_chips = len(jax.devices())
+        self.hbm = hbm_bytes_per_chip or self._detect_hbm()
+        self.model_info = model_info or {}
+        self.micro_batches = micro_batches
+        self.zero_stages = zero_stages
+        self.remat_options = remat_options
+        self.results: List[TrialResult] = []
+
+    def _detect_hbm(self) -> int:
+        d = jax.devices()[0]
+        stats = getattr(d, "memory_stats", lambda: None)()
+        if stats and "bytes_limit" in stats:
+            return int(stats["bytes_limit"])
+        return 16 << 30  # v5e-class default
+
+    # ------------------------------------------------------------------ #
+    def build_space(self) -> List[Dict[str, Any]]:
+        """Enumerate + memory-prune (reference prunes ZeRO stages whose
+        estimated requirement exceeds available memory)."""
+        gbs = int(self.base_config.get("train_batch_size", 8))
+        info = self.model_info
+        space = []
+        for mb, stage, remat in itertools.product(self.micro_batches,
+                                                  self.zero_stages,
+                                                  self.remat_options):
+            dp = self.n_chips  # trials run data-parallel over local chips
+            if gbs % (mb * dp) != 0:
+                continue
+            if info.get("num_params"):
+                est = estimate_memory_per_chip(
+                    info["num_params"], stage, self.n_chips, mb,
+                    info.get("seq_len", 2048), info.get("hidden_size", 4096),
+                    info.get("num_layers", 32), remat=remat)
+                if est > self.hbm:
+                    continue
+            space.append({"zero_stage": stage, "micro_batch": mb,
+                          "gas": gbs // (mb * dp), "remat": remat})
+        return space
+
+    def _trial_config(self, point: Dict[str, Any]) -> Dict[str, Any]:
+        cfg = json.loads(json.dumps(self.base_config))  # deep copy
+        cfg["train_micro_batch_size_per_gpu"] = point["micro_batch"]
+        cfg["gradient_accumulation_steps"] = point["gas"]
+        cfg.pop("train_batch_size", None)
+        cfg.setdefault("zero_optimization", {})["stage"] = point["zero_stage"]
+        cfg.setdefault("activation_checkpointing", {})["policy"] = \
+            "full" if point["remat"] else "none"
+        cfg["steps_per_print"] = 0
+        return cfg
+
+    def run_trial(self, point: Dict[str, Any],
+                  data_fn: Callable[[int], Any]) -> TrialResult:
+        import deepspeed_tpu as dst
+        from ..comm.mesh import set_mesh
+
+        cfg = self._trial_config(point)
+        try:
+            set_mesh(None)  # each trial builds its mesh fresh
+            engine, *_ = dst.initialize(model=self.model_spec, config=cfg)
+            batch = data_fn(engine.train_batch_size())
+            engine.train_batch(batch)  # compile + warmup
+            t0 = time.perf_counter()
+            for _ in range(self.trial_steps):
+                out = engine.train_batch(batch)
+            jax.block_until_ready(out.loss)
+            dt = (time.perf_counter() - t0) / self.trial_steps
+            res = TrialResult(point, engine.train_batch_size() / dt, dt)
+        except Exception as e:  # OOM / bad config — score 0, keep tuning
+            logger.warning(f"autotuning trial {point} failed: {e}")
+            res = TrialResult(point, 0.0, float("inf"), error=str(e))
+        self.results.append(res)
+        log_dist(f"autotuning trial {point}: "
+                 f"{res.samples_per_sec:.2f} samples/s")
+        return res
+
+    def tune(self, data_fn: Callable[[int], Any],
+             max_trials: Optional[int] = None) -> TrialResult:
+        space = self.build_space()
+        if not space:
+            raise ValueError("autotuning space is empty after memory pruning")
+        tuner = TUNERS[self.tuner_type](
+            space, lambda p: self.run_trial(p, data_fn).samples_per_sec)
+        best_cfg, best_metric = tuner.tune(max_trials)
+        best = next(r for r in self.results
+                    if r.config == best_cfg and r.samples_per_sec == best_metric)
+        log_dist(f"autotuning best: {best.config} "
+                 f"({best.samples_per_sec:.2f} samples/s over "
+                 f"{len(self.results)} trials)")
+        return best
+
+    def best_ds_config(self) -> Dict[str, Any]:
+        best = max(self.results, key=lambda r: r.samples_per_sec)
+        return self._trial_config(best.config)

@@ -1,0 +1,101 @@
+"""Search strategies over the tuning space.
+
+Reference parity: ``deepspeed/autotuning/tuner/`` — ``GridSearchTuner`` /
+``RandomTuner`` (``index_based_tuner.py:27/:11``) and ``ModelBasedTuner`` with
+``XGBoostCostModel`` (``model_based_tuner.py:19``, ``cost_model.py:14``).
+The model-based tuner here fits a least-squares cost model over one-hot
+encoded config features (numpy only — no xgboost in image), exploring
+highest-predicted-throughput configs first after a random warmup.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+Config = Dict[str, Any]
+
+
+class BaseTuner:
+    def __init__(self, space: Sequence[Config], metric_fn: Callable[[Config], float]):
+        self.space = list(space)
+        self.metric_fn = metric_fn
+        self.records: List[Tuple[Config, float]] = []
+
+    @property
+    def best(self) -> Optional[Tuple[Config, float]]:
+        return max(self.records, key=lambda r: r[1]) if self.records else None
+
+    def _measure(self, cfg: Config) -> float:
+        m = self.metric_fn(cfg)
+        self.records.append((cfg, m))
+        return m
+
+    def tune(self, max_trials: Optional[int] = None) -> Tuple[Config, float]:
+        raise NotImplementedError
+
+
+class GridSearchTuner(BaseTuner):
+    def tune(self, max_trials: Optional[int] = None) -> Tuple[Config, float]:
+        for cfg in self.space[:max_trials]:
+            self._measure(cfg)
+        return self.best
+
+
+class RandomTuner(BaseTuner):
+    def __init__(self, space, metric_fn, seed: int = 0):
+        super().__init__(space, metric_fn)
+        self.rng = random.Random(seed)
+
+    def tune(self, max_trials: Optional[int] = None) -> Tuple[Config, float]:
+        n = min(max_trials or len(self.space), len(self.space))
+        for cfg in self.rng.sample(self.space, n):
+            self._measure(cfg)
+        return self.best
+
+
+class ModelBasedTuner(BaseTuner):
+    """Random warmup → least-squares surrogate → greedy exploration."""
+
+    def __init__(self, space, metric_fn, seed: int = 0, warmup: int = 3):
+        super().__init__(space, metric_fn)
+        self.rng = random.Random(seed)
+        self.warmup = warmup
+        # one-hot feature map over every (key, value) seen in the space
+        keys = sorted({(k, repr(v)) for cfg in self.space for k, v in cfg.items()})
+        self._feat_index = {kv: i for i, kv in enumerate(keys)}
+
+    def _features(self, cfg: Config) -> np.ndarray:
+        x = np.zeros((len(self._feat_index) + 1,))
+        x[-1] = 1.0  # bias
+        for k, v in cfg.items():
+            i = self._feat_index.get((k, repr(v)))
+            if i is not None:
+                x[i] = 1.0
+        return x
+
+    def _predict(self) -> Optional[np.ndarray]:
+        if len(self.records) < 2:
+            return None
+        X = np.stack([self._features(c) for c, _ in self.records])
+        y = np.asarray([m for _, m in self.records])
+        w, *_ = np.linalg.lstsq(X, y, rcond=None)
+        return np.stack([self._features(c) for c in self.space]) @ w
+
+    def tune(self, max_trials: Optional[int] = None) -> Tuple[Config, float]:
+        n = min(max_trials or len(self.space), len(self.space))
+        tried = set()
+        order = self.rng.sample(range(len(self.space)), len(self.space))
+        for trial in range(n):
+            if trial < self.warmup:
+                idx = next(i for i in order if i not in tried)
+            else:
+                pred = self._predict()
+                cand = sorted(range(len(self.space)),
+                              key=lambda i: -(pred[i] if pred is not None else 0))
+                idx = next(i for i in cand if i not in tried)
+            tried.add(idx)
+            self._measure(self.space[idx])
+        return self.best

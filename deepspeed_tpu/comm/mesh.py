@@ -131,6 +131,7 @@ def get_mesh() -> MeshManager:
     return _global_mesh
 
 
-def set_mesh(mm: MeshManager) -> None:
+def set_mesh(mm: Optional[MeshManager]) -> None:
+    """Install (or with None, reset) the process-global mesh."""
     global _global_mesh
     _global_mesh = mm
