@@ -1,0 +1,4 @@
+from .compress import (fake_quantize, init_compression,  # noqa: F401
+                       layer_reduction, magnitude_prune, head_prune,
+                       row_prune, quantize_weights_ptq)
+from .scheduler import CompressionScheduler  # noqa: F401

@@ -1,0 +1,168 @@
+"""Model compression: layer reduction, quantization (QAT + PTQ), pruning.
+
+Reference parity: ``deepspeed/compression/`` — ``compress.py init_compression``,
+method constants (``constants.py``: layer_reduction :27, weight_quantize
+:43-55, activation_quantization, sparse/row/head/channel pruning) and the
+in-module ``basic_layer.py`` QAT wrappers. TPU-first redesign: the reference
+swaps nn.Modules for compressed variants; here every method is a **pure
+transform over the param pytree** (layers live in a stacked [L, ...] dim, so
+layer reduction is an index-select; pruning is a mask tree; quantization is a
+straight-through fake-quant applied to params before the forward) — the model
+function is untouched, which keeps every method jit/ZeRO/TP-compatible.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils.logging import log_dist
+
+Params = Any
+
+
+# --------------------------------------------------------------------------- #
+# quantization
+# --------------------------------------------------------------------------- #
+def fake_quantize(x: jnp.ndarray, bits: int = 8, symmetric: bool = True,
+                  per_channel: bool = False) -> jnp.ndarray:
+    """Straight-through fake quantization (QAT forward; reference
+    ``basic_layer.py`` Quantizer): quantize→dequantize with gradients passing
+    through unchanged."""
+    axis = tuple(range(x.ndim - 1)) if per_channel else None
+    if symmetric:
+        amax = jnp.max(jnp.abs(x), axis=axis, keepdims=True)
+        scale = jnp.maximum(amax, 1e-8) / (2 ** (bits - 1) - 1)
+        q = jnp.clip(jnp.round(x / scale), -(2 ** (bits - 1)), 2 ** (bits - 1) - 1)
+        deq = q * scale
+    else:
+        lo = jnp.min(x, axis=axis, keepdims=True)
+        hi = jnp.max(x, axis=axis, keepdims=True)
+        scale = jnp.maximum(hi - lo, 1e-8) / (2 ** bits - 1)
+        q = jnp.round((x - lo) / scale)
+        deq = q * scale + lo
+    return x + jax.lax.stop_gradient(deq - x)
+
+
+def quantize_weights_ptq(params: Params, bits: int = 8,
+                         predicate: Optional[Callable] = None) -> Params:
+    """Post-training quantize→dequantize of matching weight leaves."""
+    def one(path, p):
+        if not (hasattr(p, "dtype") and jnp.issubdtype(p.dtype, jnp.floating)):
+            return p
+        if p.ndim < 2:
+            return p
+        if predicate is not None and not predicate(path, p):
+            return p
+        return fake_quantize(p, bits=bits, per_channel=True)
+
+    return jax.tree_util.tree_map_with_path(one, params)
+
+
+# --------------------------------------------------------------------------- #
+# layer reduction (reference constants.py:27 LAYER_REDUCTION)
+# --------------------------------------------------------------------------- #
+def layer_reduction(params: Params, keep_layers: Sequence[int],
+                    layers_key: str = "layers") -> Params:
+    """Keep a subset of transformer layers — with the stacked [L, ...] layout
+    this is one index-select per leaf (the reference re-maps module names
+    teacher→student)."""
+    idx = jnp.asarray(list(keep_layers), jnp.int32)
+    out = dict(params)
+    out[layers_key] = jax.tree.map(lambda p: p[idx], params[layers_key])
+    return out
+
+
+# --------------------------------------------------------------------------- #
+# pruning (reference: sparse/row/head pruning)
+# --------------------------------------------------------------------------- #
+def magnitude_prune(params: Params, sparsity: float,
+                    predicate: Optional[Callable] = None) -> Tuple[Params, Params]:
+    """Unstructured magnitude pruning → (pruned params, mask tree).
+    Masks are re-applied after each optimizer step by the scheduler."""
+    def one(path, p):
+        if not (hasattr(p, "ndim") and p.ndim >= 2) or \
+                (predicate is not None and not predicate(path, p)):
+            return jnp.ones_like(p, dtype=bool)
+        k = int(np.prod(p.shape) * (1 - sparsity))
+        thresh = jnp.sort(jnp.abs(p).reshape(-1))[-max(k, 1)]
+        return jnp.abs(p) >= thresh
+
+    masks = jax.tree_util.tree_map_with_path(one, params)
+    pruned = jax.tree.map(lambda p, m: p * m.astype(p.dtype), params, masks)
+    return pruned, masks
+
+
+def row_prune(w: jnp.ndarray, sparsity: float) -> jnp.ndarray:
+    """Structured row pruning: zero the lowest-L2 rows (reference row_pruning)."""
+    norms = jnp.linalg.norm(w.reshape(w.shape[0], -1), axis=1)
+    k = max(1, int(w.shape[0] * (1 - sparsity)))
+    thresh = jnp.sort(norms)[-k]
+    mask = (norms >= thresh).astype(w.dtype)
+    return w * mask.reshape((-1,) + (1,) * (w.ndim - 1))
+
+
+def head_prune(w: jnp.ndarray, num_heads: int, sparsity: float) -> jnp.ndarray:
+    """Attention-head pruning on a [..., embed, heads*head_dim] projection."""
+    *lead, e, hd_total = w.shape
+    hd = hd_total // num_heads
+    wh = w.reshape(*lead, e, num_heads, hd)
+    norms = jnp.sqrt(jnp.sum(wh.astype(jnp.float32) ** 2,
+                             axis=tuple(range(len(lead))) + (len(lead),) + (len(lead) + 2,)))
+    k = max(1, int(num_heads * (1 - sparsity)))
+    thresh = jnp.sort(norms)[-k]
+    mask = (norms >= thresh).astype(w.dtype)
+    return (wh * mask.reshape((1,) * (len(lead) + 1) + (num_heads, 1))).reshape(w.shape)
+
+
+# --------------------------------------------------------------------------- #
+# init_compression (reference compress.py)
+# --------------------------------------------------------------------------- #
+@dataclasses.dataclass
+class CompressionPlan:
+    weight_quant_bits: Optional[int] = None
+    weight_quant_start_step: int = 0
+    activation_quant_bits: Optional[int] = None
+    activation_quant_start_step: int = 0
+    sparsity: Optional[float] = None
+    sparsity_start_step: int = 0
+    keep_layers: Optional[List[int]] = None
+
+    @classmethod
+    def from_config(cls, cfg: Dict) -> "CompressionPlan":
+        plan = cls()
+        wq = cfg.get("weight_quantization", {})
+        if wq.get("enabled"):
+            plan.weight_quant_bits = int(wq.get("bits", 8))
+            plan.weight_quant_start_step = int(wq.get("schedule_offset", 0))
+        aq = cfg.get("activation_quantization", {})
+        if aq.get("enabled"):
+            plan.activation_quant_bits = int(aq.get("bits", 8))
+            plan.activation_quant_start_step = int(aq.get("schedule_offset", 0))
+        sp = cfg.get("sparse_pruning", {})
+        if sp.get("enabled"):
+            # config schema: dense_ratio = fraction KEPT (reference
+            # compression/constants.py) — sparsity is the fraction pruned
+            plan.sparsity = 1.0 - float(sp.get("dense_ratio", 0.5))
+            plan.sparsity_start_step = int(sp.get("schedule_offset", 0))
+        lr_ = cfg.get("layer_reduction", {})
+        if lr_.get("enabled"):
+            plan.keep_layers = [int(i) for i in lr_["keep_number_layer"]] \
+                if isinstance(lr_.get("keep_number_layer"), (list, tuple)) \
+                else list(range(int(lr_["keep_number_layer"])))
+        return plan
+
+
+def init_compression(params: Params, compression_config: Dict,
+                     ) -> Tuple[Params, "CompressionPlan"]:
+    """Apply construction-time methods (layer reduction) and return the plan
+    for training-time methods (QAT/pruning, driven by the scheduler)."""
+    plan = CompressionPlan.from_config(compression_config or {})
+    if plan.keep_layers is not None:
+        params = layer_reduction(params, plan.keep_layers)
+        log_dist(f"compression: layer reduction → {len(plan.keep_layers)} layers")
+    return params, plan

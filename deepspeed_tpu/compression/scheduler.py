@@ -1,0 +1,56 @@
+"""Compression scheduler — steps compression methods with training.
+
+Reference parity: ``deepspeed/compression/scheduler.py`` (engine hook
+``runtime/engine.py:2264,2746``): each method activates at its
+``schedule_offset`` step. Here the scheduler owns the mask tree and the QAT
+switch and exposes ``transform(params, step)`` — a jit-friendly param
+transform the engine (or user loop) applies before/after the step.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..utils.logging import log_dist
+from .compress import CompressionPlan, fake_quantize, magnitude_prune
+
+
+class CompressionScheduler:
+    def __init__(self, plan: CompressionPlan):
+        self.plan = plan
+        self.masks: Optional[Any] = None
+        self._announced = set()
+
+    def _announce(self, what: str, step: int) -> None:
+        if what not in self._announced:
+            log_dist(f"compression: {what} active from step {step}")
+            self._announced.add(what)
+
+    def transform(self, params, step: int):
+        """Apply active methods to the param tree (outside jit; each branch
+        is itself jit-compatible)."""
+        p = self.plan
+        if p.sparsity is not None and step >= p.sparsity_start_step:
+            self._announce("sparse_pruning", step)
+            if self.masks is None:
+                params, self.masks = magnitude_prune(params, p.sparsity)
+            else:
+                params = jax.tree.map(
+                    lambda x, m: x * m.astype(x.dtype), params, self.masks)
+        if p.weight_quant_bits and step >= p.weight_quant_start_step:
+            self._announce("weight_quantization(QAT)", step)
+            params = jax.tree.map(
+                lambda x: fake_quantize(x, p.weight_quant_bits, per_channel=True)
+                if hasattr(x, "ndim") and x.ndim >= 2 and
+                jnp.issubdtype(x.dtype, jnp.floating) else x, params)
+        return params
+
+    def quantize_activation(self, x, step: int):
+        p = self.plan
+        if p.activation_quant_bits and step >= p.activation_quant_start_step:
+            self._announce("activation_quantization", step)
+            return fake_quantize(x, p.activation_quant_bits, symmetric=False)
+        return x

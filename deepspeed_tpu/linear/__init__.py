@@ -1,0 +1,4 @@
+from .optimized_linear import (LoRAConfig, QuantizationConfig,  # noqa: F401
+                               QuantizedParameter, apply_lora_linear,
+                               init_lora_linear, lora_trainable_mask,
+                               merge_lora)

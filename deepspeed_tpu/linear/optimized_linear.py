@@ -1,0 +1,121 @@
+"""LoRA optimized linear + quantized frozen base weights.
+
+Reference parity: ``deepspeed/linear/optimized_linear.py:76
+LoRAOptimizedLinear`` (base-weight-sharded LoRA linear) and
+``linear/quantization.py:18 QuantizedParameter`` (int8 storage, dequant on
+use). TPU-first redesign: a functional param-tree layer —
+
+- the frozen base weight is stored int8 (``QuantizedParameter``) and/or
+  sharded over the ZeRO axes via its logical axes like any other param;
+- LoRA factors are ordinary trainable leaves; ``lora_trainable_mask`` gives
+  the optimizer the frozen/trainable split (the reference freezes via
+  requires_grad);
+- ``merge_lora`` folds trained factors back into the dense weight.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass
+class LoRAConfig:
+    """Reference ``deepspeed/linear/config.py`` LoRAConfig."""
+
+    lora_r: int = 64
+    lora_alpha: float = 16.0
+    base_weight_sharding: int = 1  # kept for parity; sharding comes from axes
+
+
+@dataclasses.dataclass
+class QuantizationConfig:
+    q_bits: int = 8
+    group_size: int = 512
+
+
+class QuantizedParameter(NamedTuple):
+    """int8 (grouped, symmetric) storage of a frozen weight."""
+
+    q: jnp.ndarray       # int8 [..., n]
+    scale: jnp.ndarray   # f32 per group
+    group_size: int
+    shape: tuple
+
+    @classmethod
+    def quantize(cls, w: jnp.ndarray,
+                 cfg: Optional[QuantizationConfig] = None) -> "QuantizedParameter":
+        cfg = cfg or QuantizationConfig()
+        flat = w.astype(jnp.float32).reshape(-1)
+        pad = (-flat.size) % cfg.group_size
+        flat = jnp.pad(flat, (0, pad))
+        groups = flat.reshape(-1, cfg.group_size)
+        scale = jnp.maximum(jnp.max(jnp.abs(groups), axis=1, keepdims=True),
+                            1e-8) / 127.0
+        q = jnp.clip(jnp.round(groups / scale), -128, 127).astype(jnp.int8)
+        return cls(q=q, scale=scale, group_size=cfg.group_size, shape=w.shape)
+
+    def dequantized(self, dtype=jnp.bfloat16) -> jnp.ndarray:
+        flat = (self.q.astype(jnp.float32) * self.scale).reshape(-1)
+        n = 1
+        for d in self.shape:
+            n *= d
+        return flat[:n].reshape(self.shape).astype(dtype)
+
+
+def init_lora_linear(rng: jax.Array, in_features: int, out_features: int, *,
+                     base_weight: Optional[jnp.ndarray] = None,
+                     lora_config: Optional[LoRAConfig] = None,
+                     quantization: Optional[QuantizationConfig] = None,
+                     dtype=jnp.float32) -> Dict[str, Any]:
+    """Build the param subtree for one LoRA linear. ``lora_b`` starts at zero
+    so the layer is exactly the base at init (standard LoRA)."""
+    cfg = lora_config or LoRAConfig()
+    ka, kw = jax.random.split(rng)
+    if base_weight is None:
+        base_weight = jax.random.normal(kw, (in_features, out_features),
+                                        jnp.float32) * (in_features ** -0.5)
+    base = QuantizedParameter.quantize(base_weight, quantization) \
+        if quantization is not None else base_weight.astype(dtype)
+    return {
+        "base": base,
+        "lora_a": (jax.random.normal(ka, (in_features, cfg.lora_r), jnp.float32)
+                   * (in_features ** -0.5)).astype(dtype),
+        "lora_b": jnp.zeros((cfg.lora_r, out_features), dtype),
+    }
+
+
+def apply_lora_linear(params: Dict[str, Any], x: jnp.ndarray,
+                      lora_config: Optional[LoRAConfig] = None) -> jnp.ndarray:
+    cfg = lora_config or LoRAConfig()
+    base = params["base"]
+    w = base.dequantized(x.dtype) if isinstance(base, QuantizedParameter) \
+        else base.astype(x.dtype)
+    w = jax.lax.stop_gradient(w)  # frozen base
+    scaling = cfg.lora_alpha / cfg.lora_r
+    return x @ w + ((x @ params["lora_a"].astype(x.dtype))
+                    @ params["lora_b"].astype(x.dtype)) * scaling
+
+
+def merge_lora(params: Dict[str, Any],
+               lora_config: Optional[LoRAConfig] = None) -> jnp.ndarray:
+    """Fold the trained factors into a dense weight for serving."""
+    cfg = lora_config or LoRAConfig()
+    base = params["base"]
+    w = base.dequantized(jnp.float32) if isinstance(base, QuantizedParameter) \
+        else base.astype(jnp.float32)
+    return w + (params["lora_a"].astype(jnp.float32)
+                @ params["lora_b"].astype(jnp.float32)) * (cfg.lora_alpha / cfg.lora_r)
+
+
+def lora_trainable_mask(params: Any) -> Any:
+    """True for trainable (lora_*) leaves, False for frozen base — feed to a
+    masked optimizer (reference freezes base via requires_grad=False)."""
+    def one(path, leaf):
+        keys = [getattr(p, "key", getattr(p, "name", "")) for p in path]
+        return any(str(k).startswith("lora_") for k in keys)
+
+    return jax.tree_util.tree_map_with_path(one, params)

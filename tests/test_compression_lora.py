@@ -1,0 +1,163 @@
+"""Compression + LoRA tests (reference model: ``tests/unit/compression``,
+``tests/unit/linear``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.compression import (CompressionScheduler, fake_quantize,
+                                       head_prune, init_compression,
+                                       layer_reduction, magnitude_prune,
+                                       quantize_weights_ptq, row_prune)
+from deepspeed_tpu.compression.compress import CompressionPlan
+from deepspeed_tpu.linear import (LoRAConfig, QuantizationConfig,
+                                  QuantizedParameter, apply_lora_linear,
+                                  init_lora_linear, lora_trainable_mask,
+                                  merge_lora)
+from deepspeed_tpu.models import llama
+
+
+def test_fake_quantize_ste_gradient():
+    x = jnp.linspace(-1, 1, 32).reshape(4, 8)
+    q = fake_quantize(x, bits=4)
+    assert q.shape == x.shape
+    assert float(jnp.max(jnp.abs(q - x))) < 0.2  # coarse but close
+    # straight-through: gradient of sum(fake_quant(x)) is all-ones
+    g = jax.grad(lambda x: fake_quantize(x, bits=4).sum())(x)
+    np.testing.assert_allclose(np.asarray(g), 1.0)
+
+
+def test_fake_quantize_levels():
+    x = jax.random.normal(jax.random.PRNGKey(0), (256,))
+    q = fake_quantize(x, bits=8)
+    assert len(np.unique(np.asarray(q))) <= 256
+
+
+def test_layer_reduction_stacked():
+    cfg = llama.LlamaConfig.tiny(num_layers=4)
+    params = llama.init(cfg, jax.random.PRNGKey(0))
+    small = layer_reduction(params, [0, 2])
+    assert small["layers"]["wq"].shape[0] == 2
+    np.testing.assert_array_equal(np.asarray(small["layers"]["wq"][1]),
+                                  np.asarray(params["layers"]["wq"][2]))
+    # reduced model still runs
+    scfg = llama.LlamaConfig.tiny(num_layers=2)
+    logits = llama.apply(scfg, small, jnp.zeros((1, 8), jnp.int32))
+    assert logits.shape == (1, 8, cfg.vocab_size)
+
+
+def test_magnitude_prune_sparsity():
+    params = {"w": jax.random.normal(jax.random.PRNGKey(0), (64, 64)),
+              "b": jnp.ones((64,))}
+    pruned, masks = magnitude_prune(params, sparsity=0.75)
+    frac = float(jnp.mean((pruned["w"] == 0)))
+    assert 0.70 < frac < 0.80
+    assert bool(jnp.all(masks["b"]))  # 1-D leaves untouched
+
+
+def test_row_and_head_prune():
+    w = jnp.asarray(np.random.RandomState(0).randn(8, 16).astype(np.float32))
+    rp = row_prune(w, sparsity=0.5)
+    zero_rows = int(jnp.sum(jnp.all(rp == 0, axis=1)))
+    assert zero_rows == 4
+    hw = jnp.asarray(np.random.RandomState(1).randn(16, 4 * 8).astype(np.float32))
+    hp = head_prune(hw, num_heads=4, sparsity=0.5)
+    heads = hp.reshape(16, 4, 8)
+    zero_heads = int(jnp.sum(jnp.all(jnp.abs(heads) < 1e-9, axis=(0, 2))))
+    assert zero_heads == 2
+
+
+def test_init_compression_and_scheduler():
+    cfg = llama.LlamaConfig.tiny(num_layers=4)
+    params = llama.init(cfg, jax.random.PRNGKey(0))
+    comp_cfg = {
+        "layer_reduction": {"enabled": True, "keep_number_layer": 2},
+        "weight_quantization": {"enabled": True, "bits": 8,
+                                "schedule_offset": 5},
+        "sparse_pruning": {"enabled": True, "dense_ratio": 0.5,
+                           "schedule_offset": 0},
+    }
+    params, plan = init_compression(params, comp_cfg)
+    assert params["layers"]["wq"].shape[0] == 2
+    sched = CompressionScheduler(plan)
+    p1 = sched.transform(params, step=1)   # pruning active, QAT not yet
+    assert float(jnp.mean(p1["layers"]["wq"] == 0)) > 0.4
+    p6 = sched.transform(params, step=6)   # both active
+    assert float(jnp.mean(p6["layers"]["wq"] == 0)) > 0.4
+
+
+def test_dense_ratio_is_fraction_kept():
+    """Regression: dense_ratio=0.9 means KEEP 90% (prune 10%), per the
+    reference config schema — not the inverse."""
+    params = {"w": jax.random.normal(jax.random.PRNGKey(0), (64, 64))}
+    _, plan = init_compression(params, {
+        "sparse_pruning": {"enabled": True, "dense_ratio": 0.9,
+                           "schedule_offset": 0}})
+    sched = CompressionScheduler(plan)
+    out = sched.transform(params, step=1)
+    frac_zero = float(jnp.mean(out["w"] == 0))
+    assert frac_zero < 0.15, frac_zero
+
+
+def test_activation_quant_respects_schedule_offset():
+    _, plan = init_compression({}, {
+        "activation_quantization": {"enabled": True, "bits": 8,
+                                    "schedule_offset": 10}})
+    sched = CompressionScheduler(plan)
+    x = jax.random.normal(jax.random.PRNGKey(0), (32,))
+    np.testing.assert_array_equal(np.asarray(sched.quantize_activation(x, 5)),
+                                  np.asarray(x))  # warmup: untouched
+    assert not np.array_equal(np.asarray(sched.quantize_activation(x, 10)),
+                              np.asarray(x))
+
+
+def test_ptq_quantize_weights():
+    params = {"w": jax.random.normal(jax.random.PRNGKey(0), (32, 32)),
+              "scale": jnp.ones((32,))}
+    q = quantize_weights_ptq(params, bits=8)
+    assert not np.array_equal(np.asarray(q["w"]), np.asarray(params["w"]))
+    np.testing.assert_allclose(np.asarray(q["w"]), np.asarray(params["w"]),
+                               atol=0.05)
+    np.testing.assert_array_equal(np.asarray(q["scale"]),
+                                  np.asarray(params["scale"]))
+
+
+def test_quantized_parameter_roundtrip():
+    w = jax.random.normal(jax.random.PRNGKey(3), (128, 64)) * 0.1
+    qp = QuantizedParameter.quantize(w, QuantizationConfig(group_size=256))
+    assert qp.q.dtype == jnp.int8
+    deq = qp.dequantized(jnp.float32)
+    assert deq.shape == w.shape
+    np.testing.assert_allclose(np.asarray(deq), np.asarray(w), atol=2e-3)
+
+
+def test_lora_linear_init_and_train():
+    rng = jax.random.PRNGKey(0)
+    cfg = LoRAConfig(lora_r=8, lora_alpha=16)
+    p = init_lora_linear(rng, 32, 16, lora_config=cfg)
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 32))
+    # at init: exactly the base projection (lora_b == 0)
+    np.testing.assert_allclose(np.asarray(apply_lora_linear(p, x, cfg)),
+                               np.asarray(x @ p["base"]), rtol=1e-6)
+    # gradients flow ONLY to lora factors
+    g = jax.grad(lambda p: apply_lora_linear(p, x, cfg).sum())(p)
+    assert float(jnp.abs(g["base"]).max()) == 0.0
+    # at init lora_b==0, so d/d(lora_a) is 0 and d/d(lora_b) is not
+    assert float(jnp.abs(g["lora_b"]).max()) > 0.0
+    mask = lora_trainable_mask(p)
+    assert mask == {"base": False, "lora_a": True, "lora_b": True}
+
+
+def test_lora_quantized_base_and_merge():
+    rng = jax.random.PRNGKey(0)
+    cfg = LoRAConfig(lora_r=4, lora_alpha=4)
+    base = jax.random.normal(jax.random.PRNGKey(5), (16, 8)) * 0.1
+    p = init_lora_linear(rng, 16, 8, base_weight=base, lora_config=cfg,
+                         quantization=QuantizationConfig(group_size=64))
+    assert isinstance(p["base"], QuantizedParameter)
+    p = dict(p, lora_b=jax.random.normal(jax.random.PRNGKey(6), (4, 8)) * 0.1)
+    x = jax.random.normal(jax.random.PRNGKey(7), (2, 16))
+    merged = merge_lora(p, cfg)
+    np.testing.assert_allclose(np.asarray(apply_lora_linear(p, x, cfg)),
+                               np.asarray(x @ merged), atol=1e-3)
