@@ -1,0 +1,5 @@
+from .curriculum_scheduler import CurriculumScheduler  # noqa: F401
+from .data_sampler import CurriculumDataSampler, DataAnalyzer  # noqa: F401
+from .progressive_layer_drop import ProgressiveLayerDrop  # noqa: F401
+from .random_ltd import RandomLTDScheduler, random_ltd_layer  # noqa: F401
+from .variable_batch import VariableBatchSchedule  # noqa: F401

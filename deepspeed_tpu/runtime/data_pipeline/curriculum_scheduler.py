@@ -1,0 +1,69 @@
+"""Curriculum learning scheduler.
+
+Reference parity: ``runtime/data_pipeline/curriculum_scheduler.py`` —
+difficulty (typically sequence length) ramps with the step count under
+``fixed_linear`` / ``fixed_root`` / ``fixed_discrete`` schedules. The engine
+truncates each batch to the current difficulty before sharding — a free perf
+win on TPU because shorter padded shapes compile to their own cached jit
+programs per bucket.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional
+
+from ...utils.logging import log_dist
+
+
+class CurriculumScheduler:
+    def __init__(self, config: Dict):
+        self.enabled = bool(config.get("enabled", False))
+        self.min_difficulty = int(config.get("min_difficulty", 8))
+        self.max_difficulty = int(config.get("max_difficulty", 1024))
+        self.schedule_type = config.get("schedule_type", "fixed_linear")
+        sc = config.get("schedule_config", {})
+        self.total_steps = int(sc.get("total_curriculum_step", 10000))
+        self.difficulty_step = int(sc.get("difficulty_step", 8))
+        self.root_degree = int(sc.get("root_degree", 2))
+        self.difficulties: List[int] = [int(d) for d in sc.get("difficulty", [])]
+        self.max_steps: List[int] = [int(s) for s in sc.get("max_step", [])]
+        self.current_difficulty = self.min_difficulty
+
+    def get_difficulty(self, global_steps: int) -> int:
+        if not self.enabled:
+            return self.max_difficulty
+        t = min(max(global_steps, 0), self.total_steps)
+        if self.schedule_type == "fixed_linear":
+            frac = t / self.total_steps
+        elif self.schedule_type == "fixed_root":
+            frac = (t / self.total_steps) ** (1.0 / self.root_degree)
+        elif self.schedule_type == "fixed_discrete":
+            d = self.difficulties[0] if self.difficulties else self.min_difficulty
+            for diff, until in zip(self.difficulties, self.max_steps + [10 ** 12]):
+                d = diff
+                if global_steps <= until:
+                    break
+            return min(d, self.max_difficulty)
+        else:
+            raise ValueError(f"unknown curriculum schedule {self.schedule_type}")
+        d = self.min_difficulty + frac * (self.max_difficulty - self.min_difficulty)
+        # round to difficulty_step granularity (stable jit bucket shapes)
+        d = int(d // self.difficulty_step * self.difficulty_step)
+        return max(self.min_difficulty, min(d, self.max_difficulty))
+
+    def update_difficulty(self, global_steps: int) -> int:
+        new = self.get_difficulty(global_steps)
+        if new != self.current_difficulty:
+            log_dist(f"curriculum: difficulty {self.current_difficulty} → {new} "
+                     f"at step {global_steps}")
+            self.current_difficulty = new
+        return new
+
+    def truncate(self, batch: Dict, global_steps: int) -> Dict:
+        """Clip token-like [b, s] entries to the current difficulty."""
+        d = self.update_difficulty(global_steps)
+        out = {}
+        for k, v in batch.items():
+            out[k] = v[:, :d + 1] if getattr(v, "ndim", 0) >= 2 else v
+        return out

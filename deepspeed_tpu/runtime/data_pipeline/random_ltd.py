@@ -1,0 +1,70 @@
+"""Random layerwise token dropping (random-LTD).
+
+Reference parity: ``runtime/data_pipeline/data_routing/basic_layer.py:14
+RandomLayerTokenDrop`` + scheduler (``data_routing/scheduler.py``) + CUDA
+``token_sort``/``gather_scatter`` kernels (``csrc/random_ltd``). TPU-first:
+token selection is a uniform random permutation prefix (static keep count →
+static shapes under jit), gather/scatter are ``jnp.take``/``.at[].set`` —
+XLA lowers these to efficient dynamic-slice/scatter on TPU, no custom kernel
+needed. The scheduler ramps the kept-token count linearly, matching the
+reference's seq-length schedule.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ...utils.logging import log_dist
+
+
+def random_ltd_layer(layer_fn: Callable, x: jnp.ndarray, rng: jax.Array,
+                     keep_tokens: int) -> jnp.ndarray:
+    """Run ``layer_fn`` on a random subset of tokens; passthrough the rest.
+
+    x: [batch, seq, hidden]; keep_tokens must be static under jit. The kept
+    subset keeps its original order (sorted indices) so causal attention
+    inside ``layer_fn`` stays meaningful (reference sorts sampled indices
+    with token_sort.cu)."""
+    b, s, h = x.shape
+    if keep_tokens >= s:
+        return layer_fn(x)
+    perm = jax.vmap(lambda k: jax.random.permutation(k, s))(
+        jax.random.split(rng, b))
+    idx = jnp.sort(perm[:, :keep_tokens], axis=1)           # [b, keep]
+    sub = jnp.take_along_axis(x, idx[:, :, None], axis=1)   # gather
+    out = layer_fn(sub)
+    return jnp.asarray(x).at[jnp.arange(b)[:, None], idx].set(out)  # scatter
+
+
+class RandomLTDScheduler:
+    """Ramps kept tokens from ``start`` to full seq over ``total_steps``
+    (reference ``data_routing/scheduler.py`` linear schedule)."""
+
+    def __init__(self, config: Dict):
+        self.enabled = bool(config.get("enabled", False))
+        sched = config.get("random_ltd_schedule", {})
+        self.start = int(sched.get("min_value", 128))
+        self.max_value = int(sched.get("max_value", 2048))
+        self.step_size = int(sched.get("schedule_config", {}).get("seq_per_step", 16))
+        self.total_steps = int(sched.get("schedule_config", {})
+                               .get("require_steps", 10000))
+        self.current = self.start
+
+    def keep_tokens(self, global_steps: int, seq_len: int) -> int:
+        if not self.enabled:
+            return seq_len
+        frac = min(max(global_steps, 0), self.total_steps) / self.total_steps
+        k = self.start + frac * (self.max_value - self.start)
+        k = int(k // self.step_size * self.step_size)
+        return max(self.start, min(k, seq_len))
+
+    def update(self, global_steps: int, seq_len: int) -> int:
+        new = self.keep_tokens(global_steps, seq_len)
+        if new != self.current:
+            log_dist(f"random-ltd: keep {self.current} → {new} tokens "
+                     f"at step {global_steps}")
+            self.current = new
+        return new

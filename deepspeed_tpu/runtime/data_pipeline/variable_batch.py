@@ -1,0 +1,49 @@
+"""Variable batch size + LR scaling schedule.
+
+Reference parity: ``runtime/data_pipeline/variable_batch_size_and_lr.py`` —
+ramp the global batch over training and scale LR with it (linear or sqrt
+scaling rule). Batch sizes snap to multiples of (micro_batch × dp) so every
+size maps to a whole number of accumulation steps and a cached jit program.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Tuple
+
+
+class VariableBatchSchedule:
+    def __init__(self, base_batch_size: int, max_batch_size: int,
+                 ramp_steps: int, base_lr: float,
+                 lr_scaling: str = "linear", increment: int = 0):
+        self.base = int(base_batch_size)
+        self.max = int(max_batch_size)
+        self.ramp_steps = max(1, int(ramp_steps))
+        self.base_lr = float(base_lr)
+        self.lr_scaling = lr_scaling
+        self.increment = int(increment) or self.base
+
+    def batch_size(self, step: int) -> int:
+        frac = min(max(step, 0), self.ramp_steps) / self.ramp_steps
+        b = self.base + frac * (self.max - self.base)
+        b = int(b // self.increment * self.increment)
+        return max(self.base, min(b, self.max))
+
+    def lr(self, step: int) -> float:
+        """LR scaled with the batch (linear or sqrt rule)."""
+        ratio = self.batch_size(step) / self.base
+        if self.lr_scaling == "linear":
+            return self.base_lr * ratio
+        if self.lr_scaling == "sqrt":
+            return self.base_lr * math.sqrt(ratio)
+        return self.base_lr
+
+    def schedule(self, total_steps: int) -> List[Tuple[int, int, float]]:
+        """(step, batch, lr) at every change point — for logging/planning."""
+        out, last = [], None
+        for s in range(total_steps):
+            b = self.batch_size(s)
+            if b != last:
+                out.append((s, b, self.lr(s)))
+                last = b
+        return out

@@ -1,0 +1,144 @@
+"""Data-efficiency tests: curriculum, random-LTD, PLD, variable batch,
+sampler (reference model: ``tests/unit/runtime/test_data_efficiency.py``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.data_pipeline import (CurriculumDataSampler,
+                                                 CurriculumScheduler,
+                                                 DataAnalyzer,
+                                                 ProgressiveLayerDrop,
+                                                 RandomLTDScheduler,
+                                                 VariableBatchSchedule,
+                                                 random_ltd_layer)
+
+
+def test_curriculum_linear_schedule():
+    cs = CurriculumScheduler({
+        "enabled": True, "min_difficulty": 8, "max_difficulty": 64,
+        "schedule_type": "fixed_linear",
+        "schedule_config": {"total_curriculum_step": 100, "difficulty_step": 8}})
+    assert cs.get_difficulty(0) == 8
+    assert cs.get_difficulty(100) == 64
+    assert cs.get_difficulty(50) == 32
+    assert cs.get_difficulty(50) % 8 == 0
+    assert cs.get_difficulty(10 ** 9) == 64
+
+
+def test_curriculum_root_and_discrete():
+    root = CurriculumScheduler({
+        "enabled": True, "min_difficulty": 0, "max_difficulty": 100,
+        "schedule_type": "fixed_root",
+        "schedule_config": {"total_curriculum_step": 100, "difficulty_step": 1,
+                            "root_degree": 2}})
+    assert root.get_difficulty(25) == 50  # sqrt(0.25) = 0.5
+    disc = CurriculumScheduler({
+        "enabled": True, "min_difficulty": 8, "max_difficulty": 64,
+        "schedule_type": "fixed_discrete",
+        "schedule_config": {"difficulty": [8, 32, 64],
+                            "max_step": [10, 20]}})
+    assert disc.get_difficulty(5) == 8
+    assert disc.get_difficulty(15) == 32
+    assert disc.get_difficulty(50) == 64
+
+
+def test_curriculum_truncates_batch():
+    cs = CurriculumScheduler({
+        "enabled": True, "min_difficulty": 4, "max_difficulty": 32,
+        "schedule_type": "fixed_linear",
+        "schedule_config": {"total_curriculum_step": 10, "difficulty_step": 4}})
+    batch = {"tokens": np.zeros((2, 33), np.int32), "meta": np.zeros((2,))}
+    out = cs.truncate(batch, global_steps=0)
+    assert out["tokens"].shape == (2, 5)  # difficulty 4 (+1 for labels)
+    assert out["meta"].shape == (2,)
+
+
+def test_random_ltd_layer_subset_semantics():
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 16, 4).astype(np.float32))
+    marker = lambda t: t + 100.0  # noqa: E731
+    out = random_ltd_layer(marker, x, jax.random.PRNGKey(0), keep_tokens=6)
+    changed = np.isclose(np.asarray(out - x), 100.0).all(axis=-1)
+    assert (changed.sum(axis=1) == 6).all()      # exactly 6 tokens processed
+    untouched = ~changed
+    np.testing.assert_array_equal(np.asarray(out)[untouched],
+                                  np.asarray(x)[untouched])
+    # keep >= seq → full passthrough to layer
+    full = random_ltd_layer(marker, x, jax.random.PRNGKey(0), keep_tokens=16)
+    np.testing.assert_allclose(np.asarray(full), np.asarray(x) + 100.0)
+
+
+def test_random_ltd_scheduler_ramp():
+    s = RandomLTDScheduler({
+        "enabled": True,
+        "random_ltd_schedule": {"min_value": 16, "max_value": 128,
+                                "schedule_config": {"seq_per_step": 16,
+                                                    "require_steps": 100}}})
+    assert s.keep_tokens(0, 128) == 16
+    assert s.keep_tokens(100, 128) == 128
+    assert s.keep_tokens(50, 128) == 64
+    assert s.keep_tokens(100, 64) == 64  # capped at seq
+
+
+def test_pld_theta_schedule():
+    pld = ProgressiveLayerDrop(theta=0.5, gamma=0.01)
+    assert pld.get_theta(0) == pytest.approx(1.0)
+    assert pld.get_theta(10 ** 6) == pytest.approx(0.5)
+    probs = pld.layer_keep_probs(num_layers=4, global_step=10 ** 6)
+    # deeper layers drop more; last layer keeps with prob theta
+    assert float(probs[0]) > float(probs[-1])
+    assert float(probs[-1]) == pytest.approx(0.5)
+    sd = pld.state_dict()
+    pld2 = ProgressiveLayerDrop()
+    pld2.load_state_dict(sd)
+    assert pld2.theta == 0.5
+
+
+def test_pld_apply_block():
+    pld = ProgressiveLayerDrop(theta=0.0, gamma=1.0)
+    x = jnp.ones((2, 3))
+    block = lambda t, p: t * 2  # noqa: E731
+    # keep_prob=1 → block applied; keep_prob=0 → identity
+    out_keep = pld.apply_scan_block(block, x, None, jax.random.PRNGKey(0),
+                                    jnp.asarray(1.0))
+    out_skip = pld.apply_scan_block(block, x, None, jax.random.PRNGKey(0),
+                                    jnp.asarray(0.0))
+    np.testing.assert_allclose(np.asarray(out_keep), 2.0)
+    np.testing.assert_allclose(np.asarray(out_skip), 1.0)
+
+
+def test_variable_batch_and_lr():
+    vb = VariableBatchSchedule(base_batch_size=32, max_batch_size=128,
+                               ramp_steps=100, base_lr=1e-3,
+                               lr_scaling="linear", increment=32)
+    assert vb.batch_size(0) == 32
+    assert vb.batch_size(100) == 128
+    assert vb.lr(100) == pytest.approx(4e-3)
+    sqrt = VariableBatchSchedule(32, 128, 100, 1e-3, lr_scaling="sqrt",
+                                 increment=32)
+    assert sqrt.lr(100) == pytest.approx(2e-3)
+    sched = vb.schedule(101)
+    assert sched[0][1] == 32 and sched[-1][1] == 128
+    assert all(b % 32 == 0 for _, b, _ in sched)
+
+
+def test_data_analyzer_and_curriculum_sampler():
+    data = [list(range(n)) for n in [3, 10, 5, 40, 7, 2, 30, 18]]
+    an = DataAnalyzer(data, {"seqlen": len})
+    metrics = an.run_map()
+    np.testing.assert_array_equal(metrics["seqlen"],
+                                  [3, 10, 5, 40, 7, 2, 30, 18])
+    order = an.index_by_difficulty("seqlen")
+    assert list(metrics["seqlen"][order]) == sorted(metrics["seqlen"])
+
+    cs = CurriculumScheduler({
+        "enabled": True, "min_difficulty": 5, "max_difficulty": 40,
+        "schedule_type": "fixed_linear",
+        "schedule_config": {"total_curriculum_step": 10, "difficulty_step": 1}})
+    sampler = CurriculumDataSampler(metrics["seqlen"], batch_size=2,
+                                    scheduler=cs, seed=0)
+    early = sampler.sample_batch(global_step=0)
+    assert all(metrics["seqlen"][i] <= 5 for i in early)
+    late = sampler.sample_batch(global_step=10)
+    assert len(late) == 2  # everything eligible at the end
