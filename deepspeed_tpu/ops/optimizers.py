@@ -331,6 +331,21 @@ _FACTORY: Dict[str, Callable[..., Optimizer]] = {
     "muon": muon,
 }
 
+
+def _register_onebit():
+    """Lazy registration — at import time .onebit itself imports this module,
+    so registering here at module scope would be a circular import."""
+    if "onebitadam" in _FACTORY:
+        return
+    from .onebit import onebit_adam, onebit_lamb, zero_one_adam
+
+    _FACTORY.update({
+        "onebitadam": onebit_adam,
+        "onebitlamb": onebit_lamb,
+        "zerooneadam": zero_one_adam,
+        "01adam": zero_one_adam,
+    })
+
 _PARAM_ALIASES = {
     "learning_rate": "lr",
     "beta1": None, "beta2": None,  # handled via betas
@@ -340,6 +355,7 @@ _PARAM_ALIASES = {
 
 
 def get_optimizer(name: str, **params) -> Optimizer:
+    _register_onebit()
     key = name.lower().replace("_", "")
     if key not in _FACTORY:
         raise ValueError(f"unknown optimizer '{name}' (known: {sorted(_FACTORY)})")
