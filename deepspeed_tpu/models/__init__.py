@@ -1,1 +1,1 @@
-from . import llama  # noqa: F401
+from . import bert, gpt, llama, mixtral  # noqa: F401

@@ -1,0 +1,187 @@
+"""BERT-family encoder (bidirectional attention, learned positions + token
+types, post-LN blocks, MLM head).
+
+Reference parity: the reference's oldest supported family — kernel injection
+policy ``module_inject/containers/bert.py`` and the fused training
+``DeepSpeedTransformerLayer`` (``csrc/transformer``) were built for BERT.
+Same TPU-first structure as the other families: stacked layers + ``lax.scan``,
+logical axes, op-registry norms/attention (bidirectional: ``causal=False``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops.attention import attention
+from ..ops.norms import layer_norm
+
+Params = Dict[str, Any]
+
+
+@dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    intermediate_size: int = 3072
+    num_layers: int = 12
+    num_heads: int = 12
+    max_seq_len: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+    remat: bool = False
+
+    @property
+    def head_size(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @classmethod
+    def tiny(cls, **kw) -> "BertConfig":
+        base = dict(vocab_size=256, hidden_size=64, intermediate_size=128,
+                    num_layers=2, num_heads=4, max_seq_len=64,
+                    type_vocab_size=2)
+        base.update(kw)
+        return cls(**base)
+
+    @classmethod
+    def bert_base(cls) -> "BertConfig":
+        return cls()
+
+
+def init(cfg: BertConfig, rng: jax.Array, dtype=jnp.float32) -> Params:
+    h, i, v, L = (cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size,
+                  cfg.num_layers)
+    keys = jax.random.split(rng, 8)
+
+    def normal(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32)
+                * (fan_in ** -0.5)).astype(dtype)
+
+    return {
+        "embed": normal(keys[0], (v, h), h),
+        "pos_embed": normal(keys[1], (cfg.max_seq_len, h), h),
+        "type_embed": normal(keys[2], (cfg.type_vocab_size, h), h),
+        "embed_ln_scale": jnp.ones((h,), dtype),
+        "embed_ln_bias": jnp.zeros((h,), dtype),
+        "layers": {
+            "wqkv": normal(keys[3], (L, h, 3 * h), h),
+            "bqkv": jnp.zeros((L, 3 * h), dtype),
+            "wo": normal(keys[4], (L, h, h), h),
+            "bo": jnp.zeros((L, h), dtype),
+            "attn_ln_scale": jnp.ones((L, h), dtype),
+            "attn_ln_bias": jnp.zeros((L, h), dtype),
+            "w_up": normal(keys[5], (L, h, i), h),
+            "b_up": jnp.zeros((L, i), dtype),
+            "w_down": normal(keys[6], (L, i, h), i),
+            "b_down": jnp.zeros((L, h), dtype),
+            "mlp_ln_scale": jnp.ones((L, h), dtype),
+            "mlp_ln_bias": jnp.zeros((L, h), dtype),
+        },
+        "pooler_w": normal(keys[7], (h, h), h),
+        "pooler_b": jnp.zeros((h,), dtype),
+    }
+
+
+def param_logical_axes(cfg: BertConfig) -> Params:
+    return {
+        "embed": ("vocab", "embed"),
+        "pos_embed": (None, "embed"),
+        "type_embed": (None, "embed"),
+        "embed_ln_scale": ("embed",), "embed_ln_bias": ("embed",),
+        "layers": {
+            "wqkv": ("layers", "embed", "heads"), "bqkv": ("layers", "heads"),
+            "wo": ("layers", "heads", "embed"), "bo": ("layers", "embed"),
+            "attn_ln_scale": ("layers", "embed"),
+            "attn_ln_bias": ("layers", "embed"),
+            "w_up": ("layers", "embed", "mlp"), "b_up": ("layers", "mlp"),
+            "w_down": ("layers", "mlp", "embed"), "b_down": ("layers", "embed"),
+            "mlp_ln_scale": ("layers", "embed"),
+            "mlp_ln_bias": ("layers", "embed"),
+        },
+        "pooler_w": ("embed", "embed"), "pooler_b": ("embed",),
+    }
+
+
+def _block(cfg: BertConfig, x: jnp.ndarray, layer: Params,
+           mask: Optional[jnp.ndarray]) -> jnp.ndarray:
+    """Post-LN encoder block. mask: [b, 1, 1, s] boolean (True = attend)."""
+    b, s, h = x.shape
+    nh, hd = cfg.num_heads, cfg.head_size
+    eps = cfg.layer_norm_eps
+    qkv = x @ layer["wqkv"] + layer["bqkv"]
+    q, k, v = [t.reshape(b, s, nh, hd) for t in jnp.split(qkv, 3, axis=-1)]
+    a = attention(q, k, v, causal=False, mask=mask)
+    a = a.reshape(b, s, nh * hd) @ layer["wo"] + layer["bo"]
+    x = layer_norm(x + a, layer["attn_ln_scale"], layer["attn_ln_bias"], eps)
+    m = jax.nn.gelu(x @ layer["w_up"] + layer["b_up"]) @ layer["w_down"] \
+        + layer["b_down"]
+    return layer_norm(x + m, layer["mlp_ln_scale"], layer["mlp_ln_bias"], eps)
+
+
+def apply(cfg: BertConfig, params: Params, tokens: jnp.ndarray, *,
+          token_types: Optional[jnp.ndarray] = None,
+          attention_mask: Optional[jnp.ndarray] = None,
+          compute_dtype=jnp.bfloat16) -> Dict[str, jnp.ndarray]:
+    """→ {"hidden": [b,s,h], "pooled": [b,h], "mlm_logits": [b,s,vocab]}."""
+    b, s = tokens.shape
+    if token_types is None:
+        token_types = jnp.zeros_like(tokens)
+    x = (params["embed"][tokens] + params["pos_embed"][jnp.arange(s)][None]
+         + params["type_embed"][token_types])
+    x = layer_norm(x, params["embed_ln_scale"], params["embed_ln_bias"],
+                   cfg.layer_norm_eps).astype(compute_dtype)
+    mask = None
+    if attention_mask is not None:
+        mask = attention_mask[:, None, None, :].astype(bool)
+    layers = jax.tree.map(lambda p: p.astype(compute_dtype)
+                          if jnp.issubdtype(p.dtype, jnp.floating) else p,
+                          params["layers"])
+    block = partial(_block, cfg)
+    if cfg.remat:
+        block = jax.checkpoint(block)
+
+    def scan_body(x, layer):
+        return block(x, layer, mask), None
+
+    x, _ = lax.scan(scan_body, x, layers)
+    pooled = jnp.tanh(x[:, 0] @ params["pooler_w"].astype(compute_dtype)
+                      + params["pooler_b"].astype(compute_dtype))
+    mlm = (x @ params["embed"].T.astype(compute_dtype)).astype(jnp.float32)
+    return {"hidden": x, "pooled": pooled, "mlm_logits": mlm}
+
+
+def loss_fn(cfg: BertConfig, params: Params, batch: Dict[str, jnp.ndarray], *,
+            compute_dtype=jnp.bfloat16):
+    """Masked-LM loss: labels -100 = unmasked (ignored)."""
+    out = apply(cfg, params, batch["tokens"],
+                token_types=batch.get("token_types"),
+                attention_mask=batch.get("attention_mask"),
+                compute_dtype=compute_dtype)
+    labels = batch["labels"]
+    valid = labels != -100
+    safe = jnp.where(valid, labels, 0)
+    logp = jax.nn.log_softmax(out["mlm_logits"], axis=-1)
+    tok_loss = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+    loss = jnp.where(valid, tok_loss, 0.0).sum() / jnp.maximum(valid.sum(), 1)
+    return loss, {"loss": loss}
+
+
+def model_spec(cfg: BertConfig, compute_dtype=jnp.bfloat16):
+    from ..runtime.engine import ModelSpec
+
+    return ModelSpec(
+        name="bert",
+        init_fn=lambda rng: init(cfg, rng),
+        loss_fn=lambda params, batch: loss_fn(cfg, params, batch,
+                                              compute_dtype=compute_dtype),
+        apply_fn=lambda params, tokens, **kw: apply(cfg, params, tokens,
+                                                    compute_dtype=compute_dtype,
+                                                    **kw),
+        logical_axes=param_logical_axes(cfg),
+        pipeline_capable=False,
+    )

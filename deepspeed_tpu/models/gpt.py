@@ -1,0 +1,266 @@
+"""GPT-2/OPT-family model (learned positions, LayerNorm, GELU MLP, MHA).
+
+Reference parity: the reference injects kernels into these HF families via
+``module_inject/containers/{gpt2,gptneo,opt,bloom}.py`` and serves OPT in
+inference v2 (``inference/v2/model_implementations/opt``). Same TPU-first
+shape as ``models/llama.py``: stacked layers under ``lax.scan``, logical axis
+names for the shared partitioner, op-registry norms/attention, KV-cached
+decode path for the inference engines.
+
+Covers GPT-2, OPT (pre-LN), and with ``post_ln=True`` the original
+post-LN ordering (BLOOM-style alibi is not modeled)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops.attention import attention
+from ..ops.norms import layer_norm
+
+Params = Dict[str, Any]
+
+
+@dataclass(frozen=True)
+class GPTConfig:
+    vocab_size: int = 50257
+    hidden_size: int = 768
+    intermediate_size: int = 3072
+    num_layers: int = 12
+    num_heads: int = 12
+    max_seq_len: int = 1024
+    layer_norm_eps: float = 1e-5
+    tie_embeddings: bool = True
+    post_ln: bool = False     # True = original transformer/BLOOM ordering
+    remat: bool = False
+
+    @property
+    def head_size(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @property
+    def num_params(self) -> int:
+        h, i, v, L, s = (self.hidden_size, self.intermediate_size,
+                         self.vocab_size, self.num_layers, self.max_seq_len)
+        # weights 4h²+2hi; biases bqkv 3h + bo h + b_up i + b_down h; LN 4h
+        block = 4 * h * h + 2 * h * i + 9 * h + i
+        embed = v * h * (1 if self.tie_embeddings else 2) + s * h
+        return L * block + embed + 2 * h
+
+    @classmethod
+    def tiny(cls, **kw) -> "GPTConfig":
+        base = dict(vocab_size=256, hidden_size=64, intermediate_size=128,
+                    num_layers=2, num_heads=4, max_seq_len=128)
+        base.update(kw)
+        return cls(**base)
+
+    @classmethod
+    def gpt2_small(cls) -> "GPTConfig":
+        return cls()
+
+    @classmethod
+    def opt_1_3b(cls) -> "GPTConfig":
+        return cls(vocab_size=50272, hidden_size=2048, intermediate_size=8192,
+                   num_layers=24, num_heads=32, max_seq_len=2048)
+
+
+def init(cfg: GPTConfig, rng: jax.Array, dtype=jnp.float32) -> Params:
+    h, i, v, L = (cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size,
+                  cfg.num_layers)
+    keys = jax.random.split(rng, 8)
+
+    def normal(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32)
+                * (fan_in ** -0.5)).astype(dtype)
+
+    params: Params = {
+        "embed": normal(keys[0], (v, h), h),
+        "pos_embed": normal(keys[1], (cfg.max_seq_len, h), h),
+        "layers": {
+            "ln1_scale": jnp.ones((L, h), dtype),
+            "ln1_bias": jnp.zeros((L, h), dtype),
+            "wqkv": normal(keys[2], (L, h, 3 * h), h),
+            "bqkv": jnp.zeros((L, 3 * h), dtype),
+            "wo": normal(keys[3], (L, h, h), h),
+            "bo": jnp.zeros((L, h), dtype),
+            "ln2_scale": jnp.ones((L, h), dtype),
+            "ln2_bias": jnp.zeros((L, h), dtype),
+            "w_up": normal(keys[4], (L, h, i), h),
+            "b_up": jnp.zeros((L, i), dtype),
+            "w_down": normal(keys[5], (L, i, h), i),
+            "b_down": jnp.zeros((L, h), dtype),
+        },
+        "final_ln_scale": jnp.ones((h,), dtype),
+        "final_ln_bias": jnp.zeros((h,), dtype),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = normal(keys[6], (h, v), h)
+    return params
+
+
+def param_logical_axes(cfg: GPTConfig) -> Params:
+    axes = {
+        "embed": ("vocab", "embed"),
+        "pos_embed": (None, "embed"),
+        "layers": {
+            "ln1_scale": ("layers", "embed"), "ln1_bias": ("layers", "embed"),
+            "wqkv": ("layers", "embed", "heads"), "bqkv": ("layers", "heads"),
+            "wo": ("layers", "heads", "embed"), "bo": ("layers", "embed"),
+            "ln2_scale": ("layers", "embed"), "ln2_bias": ("layers", "embed"),
+            "w_up": ("layers", "embed", "mlp"), "b_up": ("layers", "mlp"),
+            "w_down": ("layers", "mlp", "embed"), "b_down": ("layers", "embed"),
+        },
+        "final_ln_scale": ("embed",),
+        "final_ln_bias": ("embed",),
+    }
+    if not cfg.tie_embeddings:
+        axes["lm_head"] = ("embed", "vocab")
+    return axes
+
+
+def _attn(cfg: GPTConfig, x: jnp.ndarray, layer: Params,
+          kv: Optional[Tuple] = None, cache_len: Optional[jnp.ndarray] = None):
+    """QKV projection + (cached) attention. Returns (out, (k, v))."""
+    b, t, h = x.shape
+    nh, hd = cfg.num_heads, cfg.head_size
+    qkv = x @ layer["wqkv"] + layer["bqkv"]
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(b, t, nh, hd)
+    k = k.reshape(b, t, nh, hd)
+    v = v.reshape(b, t, nh, hd)
+    if kv is None:
+        out = attention(q, k, v, causal=True)
+    else:
+        k_cache, v_cache = kv
+        S = k_cache.shape[1]
+
+        def write(c, n, s):
+            return lax.dynamic_update_slice(c, n.astype(c.dtype), (s, 0, 0))
+
+        k_cache = jax.vmap(write)(k_cache, k, cache_len)
+        v_cache = jax.vmap(write)(v_cache, v, cache_len)
+        kv_pos = jnp.arange(S)[None, None, None, :]
+        q_abs = (cache_len[:, None] + jnp.arange(t)[None, :])[:, None, :, None]
+        out = attention(q, k_cache, v_cache, causal=False,
+                        mask=kv_pos <= q_abs)
+        k, v = k_cache, v_cache
+    return out.reshape(b, t, nh * hd) @ layer["wo"] + layer["bo"], (k, v)
+
+
+def _block(cfg: GPTConfig, x, layer, kv=None, cache_len=None):
+    eps = cfg.layer_norm_eps
+    if cfg.post_ln:
+        a, kv = _attn(cfg, x, layer, kv, cache_len)
+        x = layer_norm(x + a, layer["ln1_scale"], layer["ln1_bias"], eps)
+        m = jax.nn.gelu(x @ layer["w_up"] + layer["b_up"]) @ layer["w_down"] \
+            + layer["b_down"]
+        x = layer_norm(x + m, layer["ln2_scale"], layer["ln2_bias"], eps)
+    else:  # pre-LN (GPT-2/OPT)
+        y = layer_norm(x, layer["ln1_scale"], layer["ln1_bias"], eps)
+        a, kv = _attn(cfg, y, layer, kv, cache_len)
+        x = x + a
+        y = layer_norm(x, layer["ln2_scale"], layer["ln2_bias"], eps)
+        x = x + jax.nn.gelu(y @ layer["w_up"] + layer["b_up"]) @ layer["w_down"] \
+            + layer["b_down"]
+    return x, kv
+
+
+def _cast_layers(params: Params, dtype) -> Params:
+    return jax.tree.map(lambda p: p.astype(dtype)
+                        if jnp.issubdtype(p.dtype, jnp.floating) else p,
+                        params["layers"])
+
+
+def _head(cfg: GPTConfig, params: Params, x: jnp.ndarray,
+          compute_dtype) -> jnp.ndarray:
+    x = layer_norm(x, params["final_ln_scale"].astype(compute_dtype),
+                   params["final_ln_bias"].astype(compute_dtype),
+                   cfg.layer_norm_eps)
+    head = params.get("lm_head")
+    if head is None:
+        head = params["embed"].T
+    return (x @ head.astype(compute_dtype)).astype(jnp.float32)
+
+
+def apply(cfg: GPTConfig, params: Params, tokens: jnp.ndarray, *,
+          positions: Optional[jnp.ndarray] = None,
+          compute_dtype=jnp.bfloat16) -> jnp.ndarray:
+    b, t = tokens.shape
+    if positions is None:
+        positions = jnp.arange(t)[None, :]
+    x = (params["embed"][tokens] + params["pos_embed"][positions]) \
+        .astype(compute_dtype)
+    layers = _cast_layers(params, compute_dtype)
+    block = partial(_block, cfg)
+    if cfg.remat:
+        block = jax.checkpoint(block)
+
+    def scan_body(x, layer):
+        x, _ = block(x, layer)
+        return x, None
+
+    x, _ = lax.scan(scan_body, x, layers)
+    return _head(cfg, params, x, compute_dtype)
+
+
+# --- KV-cached inference path (engine ModelFamily protocol) ---------------- #
+def init_cache(cfg: GPTConfig, batch_size: int, max_len: int,
+               dtype=jnp.bfloat16) -> Params:
+    shape = (cfg.num_layers, batch_size, max_len, cfg.num_heads, cfg.head_size)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def cache_logical_axes(cfg: GPTConfig) -> Params:
+    spec = ("layers", None, None, "heads", None)
+    return {"k": spec, "v": spec}
+
+
+def apply_cached(cfg: GPTConfig, params: Params, tokens: jnp.ndarray,
+                 cache: Params, cache_len: jnp.ndarray, *,
+                 compute_dtype=jnp.bfloat16) -> Tuple[jnp.ndarray, Params]:
+    if cache_len.ndim == 0:
+        cache_len = jnp.broadcast_to(cache_len, (tokens.shape[0],))
+    positions = jnp.minimum(cache_len[:, None] + jnp.arange(tokens.shape[1]),
+                            cfg.max_seq_len - 1)
+    x = (params["embed"][tokens] + params["pos_embed"][positions]) \
+        .astype(compute_dtype)
+    layers = _cast_layers(params, compute_dtype)
+
+    def scan_body(x, scanned):
+        layer, k_c, v_c = scanned
+        x, (k_c, v_c) = _block(cfg, x, layer, (k_c, v_c), cache_len)
+        return x, (k_c, v_c)
+
+    x, (nk, nv) = lax.scan(scan_body, x, (layers, cache["k"], cache["v"]))
+    return _head(cfg, params, x, compute_dtype), {"k": nk, "v": nv}
+
+
+def loss_fn(cfg: GPTConfig, params: Params, batch: Dict[str, jnp.ndarray], *,
+            compute_dtype=jnp.bfloat16):
+    tokens = batch["tokens"]
+    inputs, labels = tokens[:, :-1], tokens[:, 1:]
+    logits = apply(cfg, params, inputs, compute_dtype=compute_dtype)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    loss = -jnp.mean(jnp.take_along_axis(logp, labels[..., None], axis=-1))
+    return loss, {"loss": loss}
+
+
+def model_spec(cfg: GPTConfig, compute_dtype=jnp.bfloat16):
+    from ..runtime.engine import ModelSpec
+
+    return ModelSpec(
+        name="gpt",
+        init_fn=lambda rng: init(cfg, rng),
+        loss_fn=lambda params, batch: loss_fn(cfg, params, batch,
+                                              compute_dtype=compute_dtype),
+        apply_fn=lambda params, tokens, **kw: apply(cfg, params, tokens,
+                                                    compute_dtype=compute_dtype,
+                                                    **kw),
+        logical_axes=param_logical_axes(cfg),
+        pipeline_capable=False,
+    )

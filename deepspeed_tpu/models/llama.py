@@ -81,6 +81,28 @@ class LlamaConfig:
         return cls(vocab_size=128256, hidden_size=4096, intermediate_size=14336,
                    num_layers=32, num_heads=32, num_kv_heads=8, max_seq_len=8192)
 
+    @classmethod
+    def mistral_7b(cls) -> "LlamaConfig":
+        """Mistral-7B dense config (the reference serves mistral via
+        ``inference/v2/model_implementations/mistral``)."""
+        return cls(vocab_size=32000, hidden_size=4096, intermediate_size=14336,
+                   num_layers=32, num_heads=32, num_kv_heads=8,
+                   max_seq_len=8192, rope_theta=10000.0)
+
+    @classmethod
+    def qwen2_7b(cls) -> "LlamaConfig":
+        """Qwen2-7B dense config (reference ``.../qwen_v2``)."""
+        return cls(vocab_size=152064, hidden_size=3584, intermediate_size=18944,
+                   num_layers=28, num_heads=28, num_kv_heads=4,
+                   max_seq_len=32768, rope_theta=1000000.0)
+
+    @classmethod
+    def phi3_mini(cls) -> "LlamaConfig":
+        """Phi-3-mini dense config (reference ``.../phi3``)."""
+        return cls(vocab_size=32064, hidden_size=3072, intermediate_size=8192,
+                   num_layers=32, num_heads=32, num_kv_heads=32,
+                   max_seq_len=4096, rope_theta=10000.0)
+
 
 def init(cfg: LlamaConfig, rng: jax.Array, dtype=jnp.float32) -> Params:
     """Initialize the stacked param pytree."""
