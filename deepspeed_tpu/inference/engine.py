@@ -89,13 +89,20 @@ class InferenceEngine:
         # --- shard params over 'tensor' (AutoTP equivalent) ---
         self.partitioner = Partitioner(mesh_mgr, zero_stage=0)
         axes = family.param_logical_axes(family.cfg)
-        cast = jax.tree.map(
-            lambda p: p.astype(self.dtype)
-            if jnp.issubdtype(jnp.asarray(p).dtype, jnp.floating) else jnp.asarray(p),
-            params)
-        specs = self.partitioner.param_specs(axes, jax.tree.map(jnp.shape, cast))
+        specs = self.partitioner.param_specs(axes, jax.tree.map(jnp.shape, params))
         self.param_shardings = self.partitioner.shardings(specs)
-        self.params = jax.device_put(cast, self.param_shardings)
+        abstract = all(isinstance(l, jax.ShapeDtypeStruct)
+                       for l in jax.tree.leaves(params))
+        if abstract:
+            # caller supplies real weights later (hybrid engine sync path) —
+            # avoids a host round-trip + throwaway HBM copy at construction
+            self.params = None
+        else:
+            cast = jax.tree.map(
+                lambda p: p.astype(self.dtype)
+                if jnp.issubdtype(jnp.asarray(p).dtype, jnp.floating)
+                else jnp.asarray(p), params)
+            self.params = jax.device_put(cast, self.param_shardings)
         log_dist(f"init_inference: {family.name} sharded over "
                  f"tensor={mesh_mgr.tp_world_size} (dtype={self.dtype})")
 

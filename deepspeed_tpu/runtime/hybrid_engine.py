@@ -1,0 +1,115 @@
+"""Hybrid engine: train + generate in one engine (RLHF).
+
+Reference parity: ``runtime/hybrid_engine.py:40 DeepSpeedHybridEngine`` — for
+RLHF loops it flips a ZeRO-3-sharded training model into inference-kernel mode
+for rollouts and back, juggling gathered/partitioned weights and inference
+containers at Python runtime.
+
+TPU-first redesign: "flipping modes" is a sharding change, so it is ONE
+jit-compiled reshard — fp32 fsdp-sharded master params → bf16 TP-sharded
+inference params (XLA emits the all-gathers; compiled once, reused every
+rollout). The KV-cached generation path then runs on the shared inference
+engine. Staleness is tracked by the train step counter, so weights re-gather
+only after an actual update.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..inference.config import InferenceConfig
+from ..inference.engine import InferenceEngine, ModelFamily
+from ..utils.logging import log_dist
+from .engine import DeepSpeedTPUEngine
+
+
+class DeepSpeedHybridEngine:
+    """Wrap a training engine with a weight-shared inference path.
+
+    Usage (RLHF actor):
+        hybrid = DeepSpeedHybridEngine(train_engine, llama, cfg)
+        ids = hybrid.generate(prompts, max_new_tokens=64)   # rollout
+        train_engine.train_batch(ppo_batch)                 # update
+        ids = hybrid.generate(prompts, ...)                 # auto re-gathers
+    """
+
+    def __init__(self, engine: DeepSpeedTPUEngine, model_module, model_cfg,
+                 inference_config: Optional[Dict] = None):
+        self.engine = engine
+        self.family = ModelFamily.from_module(model_module, model_cfg)
+        inf_cfg = InferenceConfig.from_dict(inference_config or {})
+        # inference shares the training mesh: TP axis if present, else
+        # replicated-params generation over the data axis. Abstract params —
+        # real weights arrive via the jitted reshard at first generate()
+        # (no host round-trip, no throwaway HBM copy).
+        abstract = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+            engine.state.params)
+        self._inference = InferenceEngine(self.family, abstract, inf_cfg,
+                                          mesh_mgr=engine.mesh_mgr)
+        self._reshard = None
+        self._synced_at = -1
+        self._in_train = True
+        log_dist("hybrid engine: inference path attached "
+                 f"(tp={engine.mesh_mgr.tp_world_size})")
+
+    # ------------------------------------------------------------------ #
+    def _build_reshard(self):
+        shardings = self._inference.param_shardings
+        dtype = self._inference.dtype
+
+        def cast(p):
+            return jax.tree.map(
+                lambda x: x.astype(dtype)
+                if jnp.issubdtype(x.dtype, jnp.floating) else x, p)
+
+        with self.engine.mesh_mgr.activate():
+            return jax.jit(cast, out_shardings=shardings)
+
+    def _sync_inference_params(self) -> None:
+        """Re-gather train params into the inference layout if stale
+        (reference: gathered-weight refresh before each rollout batch)."""
+        if self._synced_at == self.engine.global_steps:
+            return
+        if self._reshard is None:
+            self._reshard = self._build_reshard()
+        self._inference.params = self._reshard(self.engine.state.params)
+        self._synced_at = self.engine.global_steps
+        log_dist(f"hybrid engine: weights synced at step {self._synced_at}")
+
+    # ------------------------------------------------------------------ #
+    def generate(self, prompts, **kwargs):
+        """Rollout with the CURRENT training weights."""
+        self._in_train = False
+        self._sync_inference_params()
+        return self._inference.generate(prompts, **kwargs)
+
+    def forward(self, tokens):
+        """Inference-mode scoring forward (e.g. logprobs for PPO)."""
+        self._sync_inference_params()
+        return self._inference.forward(tokens)
+
+    # --- training passthrough (reference keeps one engine API) --------- #
+    def train_batch(self, batch):
+        self._in_train = True
+        return self.engine.train_batch(batch)
+
+    def backward(self, loss=None):
+        return self.engine.backward(loss)
+
+    def step(self):
+        return self.engine.step()
+
+    def eval(self):
+        self._in_train = False
+        return self
+
+    def train(self):
+        self._in_train = True
+        return self
+
+    def __getattr__(self, name):
+        return getattr(self.engine, name)

@@ -1,0 +1,82 @@
+"""Hybrid (train+generate) engine tests (reference model:
+``tests/unit/hybrid_engine``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu as dst
+from deepspeed_tpu.models import llama
+from deepspeed_tpu.runtime.hybrid_engine import DeepSpeedHybridEngine
+
+
+@pytest.fixture
+def trained(devices8):
+    cfg = llama.LlamaConfig.tiny()
+    spec = llama.model_spec(cfg, compute_dtype=jnp.float32)
+    engine, *_ = dst.initialize(model=spec, config={
+        "train_batch_size": 8,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": 3}, "steps_per_print": 0})
+    return engine, cfg
+
+
+def _batch(cfg, seed=0):
+    t = np.random.RandomState(seed).randint(0, cfg.vocab_size, (8, 33))
+    return {"tokens": t.astype(np.int32)}
+
+
+def test_hybrid_generate_train_generate(trained):
+    engine, cfg = trained
+    hybrid = DeepSpeedHybridEngine(engine, llama, cfg,
+                                   {"dtype": "float32", "prefill_bucket": 16})
+    prompts = np.array([[5, 7, 11]], np.int32)
+    out0 = hybrid.generate(prompts, max_new_tokens=4)
+    assert out0.shape == (1, 4)
+
+    # rollout reflects CURRENT (zero-3-sharded) weights: compare to a fresh
+    # inference engine on the gathered params
+    from deepspeed_tpu.inference.engine import InferenceEngine, ModelFamily
+    from deepspeed_tpu.inference.config import InferenceConfig
+
+    ref_eng = InferenceEngine(ModelFamily.from_module(llama, cfg),
+                              jax.device_get(engine.state.params),
+                              InferenceConfig.from_dict(
+                                  {"dtype": "float32", "prefill_bucket": 16}),
+                              mesh_mgr=engine.mesh_mgr)
+    np.testing.assert_array_equal(out0,
+                                  ref_eng.generate(prompts, max_new_tokens=4))
+
+    # train → weights change → generation auto re-syncs and changes
+    for i in range(3):
+        hybrid.train_batch(_batch(cfg, seed=i))
+    out1 = hybrid.generate(prompts, max_new_tokens=4)
+    ref_eng.params = jax.device_put(
+        jax.tree.map(lambda x: x.astype(jnp.float32),
+                     jax.device_get(engine.state.params)),
+        ref_eng.param_shardings)
+    np.testing.assert_array_equal(out1,
+                                  ref_eng.generate(prompts, max_new_tokens=4))
+
+
+def test_hybrid_sync_only_after_update(trained):
+    engine, cfg = trained
+    hybrid = DeepSpeedHybridEngine(engine, llama, cfg, {"dtype": "float32"})
+    hybrid.generate(np.array([[1, 2]], np.int32), max_new_tokens=2)
+    first_sync = hybrid._synced_at
+    hybrid.generate(np.array([[1, 2]], np.int32), max_new_tokens=2)
+    assert hybrid._synced_at == first_sync  # no re-gather without a step
+    hybrid.train_batch(_batch(cfg))
+    hybrid.generate(np.array([[1, 2]], np.int32), max_new_tokens=2)
+    assert hybrid._synced_at == first_sync + 1
+
+
+def test_hybrid_scoring_forward(trained):
+    engine, cfg = trained
+    hybrid = DeepSpeedHybridEngine(engine, llama, cfg, {"dtype": "float32"})
+    logits = hybrid.forward(np.array([[1, 2, 3]], np.int32))
+    assert logits.shape == (1, 3, cfg.vocab_size)
+    # passthrough of engine attrs
+    assert hybrid.global_steps == engine.global_steps
+    assert hybrid.train_batch_size() == 8
