@@ -1,0 +1,184 @@
+"""HF checkpoint import: transformers state dicts → stacked param pytrees.
+
+Reference parity: the reference consumes HF models directly (``deepspeed.
+initialize(model=hf_model)``, ``init_inference`` checkpoint loading
+``inference/engine.py:303-471``) and reshards TP-degree-changing checkpoints
+via ``SDLoaderFactory``/``MegatronSDLoader`` (``runtime/state_dict_factory.py:
+21,190``). Here a user brings HF weights to the TPU framework by converting
+once into the stacked [L, ...] pytree layout; resharding to any topology is
+then the checkpoint layer's job (orbax/universal).
+
+Supported families: Llama/Mistral/Qwen2-dense (→ ``models/llama``), GPT-2
+(→ ``models/gpt``). Accepts a live ``transformers`` model, a state-dict
+mapping, or a local checkpoint directory (no network access is assumed).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Any, Dict, Mapping, Optional
+
+import numpy as np
+
+from ..utils.logging import log_dist
+
+Params = Dict[str, Any]
+
+
+def _to_numpy(t) -> np.ndarray:
+    if isinstance(t, np.ndarray):
+        return t
+    try:  # torch tensor
+        return t.detach().cpu().float().numpy()
+    except AttributeError:
+        return np.asarray(t)
+
+
+def _normalize_state_dict(src) -> Dict[str, np.ndarray]:
+    """Accept a transformers model, an nn.Module, or a mapping."""
+    if hasattr(src, "state_dict") and callable(src.state_dict):
+        src = src.state_dict()
+    if not isinstance(src, Mapping):
+        raise TypeError(f"cannot read weights from {type(src)}")
+    return {k: _to_numpy(v) for k, v in src.items()}
+
+
+def _stack(sd: Dict[str, np.ndarray], pattern: str, num_layers: int,
+           transpose: bool = False) -> np.ndarray:
+    """Collect per-layer tensors 'prefix.{i}.suffix' into one [L, ...] array."""
+    mats = []
+    for i in range(num_layers):
+        key = pattern.format(i=i)
+        if key not in sd:
+            raise KeyError(f"missing weight {key}")
+        m = sd[key]
+        mats.append(m.T if transpose else m)
+    return np.stack(mats)
+
+
+def llama_config_from_hf(hf_config) -> "Any":
+    """Map a transformers LlamaConfig/MistralConfig/Qwen2Config."""
+    from .llama import LlamaConfig
+
+    return LlamaConfig(
+        vocab_size=hf_config.vocab_size,
+        hidden_size=hf_config.hidden_size,
+        intermediate_size=hf_config.intermediate_size,
+        num_layers=hf_config.num_hidden_layers,
+        num_heads=hf_config.num_attention_heads,
+        num_kv_heads=getattr(hf_config, "num_key_value_heads",
+                             hf_config.num_attention_heads),
+        max_seq_len=getattr(hf_config, "max_position_embeddings", 4096),
+        rope_theta=float(getattr(hf_config, "rope_theta", 10000.0)),
+        rms_norm_eps=float(getattr(hf_config, "rms_norm_eps", 1e-5)),
+        tie_embeddings=bool(getattr(hf_config, "tie_word_embeddings", False)),
+    )
+
+
+def llama_params_from_hf(src, cfg=None) -> Params:
+    """HF LlamaForCausalLM (or compatible) weights → ``models/llama`` pytree.
+    HF nn.Linear stores [out, in]; our layout is [in, out] → transpose."""
+    sd = _normalize_state_dict(src)
+    pfx = "model." if any(k.startswith("model.") for k in sd) else ""
+    L = cfg.num_layers if cfg is not None else \
+        1 + max(int(m.group(1)) for k in sd
+                if (m := re.match(rf"{re.escape(pfx)}layers\.(\d+)\.", k)))
+    lay = pfx + "layers.{i}."
+    params: Params = {
+        "embed": sd[pfx + "embed_tokens.weight"],
+        "layers": {
+            "attn_norm": _stack(sd, lay + "input_layernorm.weight", L),
+            "wq": _stack(sd, lay + "self_attn.q_proj.weight", L, transpose=True),
+            "wk": _stack(sd, lay + "self_attn.k_proj.weight", L, transpose=True),
+            "wv": _stack(sd, lay + "self_attn.v_proj.weight", L, transpose=True),
+            "wo": _stack(sd, lay + "self_attn.o_proj.weight", L, transpose=True),
+            "mlp_norm": _stack(sd, lay + "post_attention_layernorm.weight", L),
+            "w_gate": _stack(sd, lay + "mlp.gate_proj.weight", L, transpose=True),
+            "w_up": _stack(sd, lay + "mlp.up_proj.weight", L, transpose=True),
+            "w_down": _stack(sd, lay + "mlp.down_proj.weight", L, transpose=True),
+        },
+        "final_norm": sd[pfx + "norm.weight"],
+    }
+    if "lm_head.weight" in sd:
+        params["lm_head"] = sd["lm_head.weight"].T
+    log_dist(f"imported HF llama-family weights: {L} layers, "
+             f"vocab {params['embed'].shape[0]}")
+    return params
+
+
+def gpt2_config_from_hf(hf_config) -> "Any":
+    from .gpt import GPTConfig
+
+    return GPTConfig(
+        vocab_size=hf_config.vocab_size,
+        hidden_size=hf_config.n_embd,
+        intermediate_size=getattr(hf_config, "n_inner", None) or 4 * hf_config.n_embd,
+        num_layers=hf_config.n_layer,
+        num_heads=hf_config.n_head,
+        max_seq_len=hf_config.n_positions,
+        layer_norm_eps=float(getattr(hf_config, "layer_norm_epsilon", 1e-5)),
+        tie_embeddings=True,
+    )
+
+
+def gpt2_params_from_hf(src, cfg=None) -> Params:
+    """HF GPT2LMHeadModel weights → ``models/gpt`` pytree. GPT-2 Conv1D
+    already stores [in, out] — no transpose."""
+    sd = _normalize_state_dict(src)
+    pfx = "transformer." if any(k.startswith("transformer.") for k in sd) else ""
+    L = cfg.num_layers if cfg is not None else \
+        1 + max(int(m.group(1)) for k in sd
+                if (m := re.match(rf"{re.escape(pfx)}h\.(\d+)\.", k)))
+    lay = pfx + "h.{i}."
+    params: Params = {
+        "embed": sd[pfx + "wte.weight"],
+        "pos_embed": sd[pfx + "wpe.weight"],
+        "layers": {
+            "ln1_scale": _stack(sd, lay + "ln_1.weight", L),
+            "ln1_bias": _stack(sd, lay + "ln_1.bias", L),
+            "wqkv": _stack(sd, lay + "attn.c_attn.weight", L),
+            "bqkv": _stack(sd, lay + "attn.c_attn.bias", L),
+            "wo": _stack(sd, lay + "attn.c_proj.weight", L),
+            "bo": _stack(sd, lay + "attn.c_proj.bias", L),
+            "ln2_scale": _stack(sd, lay + "ln_2.weight", L),
+            "ln2_bias": _stack(sd, lay + "ln_2.bias", L),
+            "w_up": _stack(sd, lay + "mlp.c_fc.weight", L),
+            "b_up": _stack(sd, lay + "mlp.c_fc.bias", L),
+            "w_down": _stack(sd, lay + "mlp.c_proj.weight", L),
+            "b_down": _stack(sd, lay + "mlp.c_proj.bias", L),
+        },
+        "final_ln_scale": sd[pfx + "ln_f.weight"],
+        "final_ln_bias": sd[pfx + "ln_f.bias"],
+    }
+    log_dist(f"imported HF gpt2-family weights: {L} layers")
+    return params
+
+
+_FAMILIES = {
+    "llama": (llama_config_from_hf, llama_params_from_hf),
+    "mistral": (llama_config_from_hf, llama_params_from_hf),
+    "qwen2": (llama_config_from_hf, llama_params_from_hf),
+    "gpt2": (gpt2_config_from_hf, gpt2_params_from_hf),
+}
+
+
+def from_hf(model, family: Optional[str] = None):
+    """One-stop conversion: (our_config, our_params) from a transformers
+    model instance. Family is sniffed from ``model.config.model_type``."""
+    family = family or getattr(model.config, "model_type", None)
+    if family not in _FAMILIES:
+        raise ValueError(f"unsupported HF family '{family}' "
+                         f"(supported: {sorted(_FAMILIES)})")
+    cfg_fn, params_fn = _FAMILIES[family]
+    cfg = cfg_fn(model.config)
+    return cfg, params_fn(model, cfg)
+
+
+def load_hf_checkpoint(path: str, family: Optional[str] = None):
+    """Load a LOCAL HF checkpoint directory (no network) and convert."""
+    import transformers
+
+    model = transformers.AutoModelForCausalLM.from_pretrained(
+        path, local_files_only=True, torch_dtype="float32")
+    return from_hf(model, family)
