@@ -1,0 +1,179 @@
+"""ZenFlow: stall-free host-offload optimizer.
+
+Reference parity: ``runtime/zenflow/zenflow_stage_1_and_2.py:47
+ZenFlowZeroOptimizer`` + ``ops/adam/zenflow_cpu_adam.py`` — gradients are
+split by importance: the top-k most important columns update on the
+accelerator in the critical path, while the bulk of the optimizer state lives
+on the CPU and updates asynchronously, overlapped with the next training
+steps (bounded staleness), eliminating >85% of the GPU stall of classic
+ZeRO-Offload.
+
+TPU-first redesign:
+- importance = per-leaf gradient norm share, refreshed every
+  ``select_interval`` steps (reference's top-k channel selection);
+- the HOT subtree updates inside the jit step on TPU (donated buffers);
+- the COLD subtree's grads stream to host (one async D2H per step) and a
+  worker thread runs the SIMD C++ ``DeepSpeedCPUAdam``
+  (``csrc/cpu_optimizer.cpp``); refreshed weights upload every
+  ``update_interval`` steps — the bounded-staleness window the reference
+  calls ``zenflow_overlap``.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.cpu_optimizer import DeepSpeedCPUAdam
+from ..ops.optimizers import Optimizer, get_optimizer
+from ..utils.logging import log_dist, logger
+from ..utils.tree import path_to_str
+
+
+class ZenFlowOptimizer:
+    """Split hot/cold optimizer over a param pytree.
+
+    Usage::
+
+        zf = ZenFlowOptimizer(params, hot_fraction=0.1, lr=1e-3)
+        for batch in data:
+            grads = grad_fn(zf.params, batch)
+            zf.step(grads)          # hot: on-device now; cold: async host
+        zf.finalize()               # drain the host worker
+    """
+
+    def __init__(self, params: Any, *, lr: float = 1e-3,
+                 betas: Tuple[float, float] = (0.9, 0.999),
+                 weight_decay: float = 0.0,
+                 hot_fraction: float = 0.1,
+                 select_interval: int = 50,
+                 update_interval: int = 4,
+                 device_optimizer: str = "adamw"):
+        self.lr = lr
+        self.update_interval = max(1, update_interval)
+        self.select_interval = max(1, select_interval)
+        self.hot_fraction = hot_fraction
+        self.step_count = 0
+
+        leaves, self.treedef = jax.tree_util.tree_flatten(params)
+        self.paths = [path_to_str(p, ".") for p, _ in
+                      jax.tree_util.tree_flatten_with_path(params)[0]]
+        self.leaves: List[Any] = [jnp.asarray(l, jnp.float32) for l in leaves]
+        self.n = len(self.leaves)
+
+        self.hot_idx = self._select_hot(None)
+        self.device_opt: Optimizer = get_optimizer(
+            device_optimizer, lr=lr, betas=betas, weight_decay=weight_decay)
+        self._rebuild_partitions(betas, weight_decay)
+
+        self._q: "queue.Queue" = queue.Queue()
+        self._results: "queue.Queue" = queue.Queue()
+        self._worker = threading.Thread(target=self._cpu_loop, daemon=True,
+                                        name="zenflow-cpu-adam")
+        self._worker.start()
+        self._inflight = 0
+        log_dist(f"ZenFlow: {len(self.hot_idx)}/{self.n} hot leaves, "
+                 f"update_interval={self.update_interval}")
+
+    # ------------------------------------------------------------------ #
+    def _select_hot(self, grads: Optional[List[Any]]) -> List[int]:
+        """Top-k leaves by gradient-norm share (param-size share at init)."""
+        k = max(1, int(self.n * self.hot_fraction))
+        if grads is None:
+            scores = [float(np.prod(l.shape)) for l in self.leaves]  # small=hot
+        else:
+            scores = [-float(jnp.linalg.norm(g)) /
+                      max(float(np.prod(g.shape)) ** 0.5, 1.0) for g in grads]
+        order = sorted(range(self.n), key=lambda i: scores[i])
+        return sorted(order[:k])
+
+    def _rebuild_partitions(self, betas=(0.9, 0.999), weight_decay=0.0):
+        self._betas, self._wd = betas, weight_decay
+        self.cold_idx = [i for i in range(self.n) if i not in set(self.hot_idx)]
+        hot_params = {str(i): self.leaves[i] for i in self.hot_idx}
+        self._hot_state = self.device_opt.init(hot_params)
+        # cold master copies live on host, updated in place by CPU Adam —
+        # MUST be real copies: np.asarray of a CPU jax array can be a
+        # zero-copy view, and the worker writes in place
+        self._cold_host = [np.array(self.leaves[i], np.float32, copy=True)
+                           for i in self.cold_idx]
+        self._cpu_adam = DeepSpeedCPUAdam(self._cold_host, lr=self.lr,
+                                          betas=betas,
+                                          weight_decay=weight_decay)
+
+    # ------------------------------------------------------------------ #
+    @property
+    def params(self) -> Any:
+        return jax.tree_util.tree_unflatten(self.treedef, self.leaves)
+
+    def _cpu_loop(self):
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            grads, lr = item
+            try:
+                self._cpu_adam.step(grads, lr=lr)
+                self._results.put(True)
+            except Exception as e:  # surfaced on next step()/finalize()
+                self._results.put(e)
+
+    def _drain(self, block: bool = False):
+        while self._inflight and (block or not self._results.empty()):
+            r = self._results.get()
+            self._inflight -= 1
+            if isinstance(r, Exception):
+                raise r
+
+    # ------------------------------------------------------------------ #
+    def step(self, grads: Any, lr: Optional[float] = None) -> None:
+        """One optimizer step. Hot leaves update on device immediately; cold
+        gradients are queued for the async host update."""
+        lr = self.lr if lr is None else lr
+        g_leaves = jax.tree_util.tree_flatten(grads)[0]
+        self.step_count += 1
+
+        # ---- hot path (on device, blocking — tiny fraction of params) ----
+        hot_params = {str(i): self.leaves[i] for i in self.hot_idx}
+        hot_grads = {str(i): g_leaves[i] for i in self.hot_idx}
+        new_hot, self._hot_state = self.device_opt.update(
+            hot_params, hot_grads, self._hot_state, lr_scale=lr / self.lr)
+        for i in self.hot_idx:
+            self.leaves[i] = new_hot[str(i)]
+
+        # ---- cold path (async host) ----
+        self._drain()  # raise worker errors early, free queue slots
+        cold = [np.array(g_leaves[i], np.float32, copy=True)
+                for i in self.cold_idx]  # D2H copy (owned by the worker)
+        self._q.put((cold, lr))
+        self._inflight += 1
+
+        # bounded staleness: pull refreshed cold weights periodically
+        if self.step_count % self.update_interval == 0:
+            self._drain(block=True)
+            for slot, i in enumerate(self.cold_idx):
+                self.leaves[i] = jnp.array(self._cold_host[slot])
+
+        # periodic importance re-selection (reference select_interval)
+        if self.step_count % self.select_interval == 0:
+            self._drain(block=True)
+            for slot, i in enumerate(self.cold_idx):
+                self.leaves[i] = jnp.array(self._cold_host[slot])
+            self.hot_idx = self._select_hot(g_leaves)
+            self._rebuild_partitions(self._betas, self._wd)
+
+    def finalize(self) -> Any:
+        """Drain async updates and return the final params."""
+        self._drain(block=True)
+        for slot, i in enumerate(self.cold_idx):
+            self.leaves[i] = jnp.array(self._cold_host[slot])
+        return self.params
+
+    def close(self):
+        self._q.put(None)
+        self._worker.join(timeout=5)

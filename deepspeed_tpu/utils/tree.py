@@ -2,6 +2,16 @@
 
 from __future__ import annotations
 
+import jax
+import jax.numpy as jnp
+
+
+def cast_floating(tree, dtype):
+    """astype(dtype) on floating leaves; everything else untouched."""
+    return jax.tree.map(
+        lambda x: x.astype(dtype)
+        if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating) else x, tree)
+
 
 def path_to_str(path, sep: str = ".") -> str:
     """jax KeyPath → joined string ('layers.wq', 'opt.0.mu.embed', ...)."""
