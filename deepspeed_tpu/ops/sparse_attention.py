@@ -1,0 +1,83 @@
+"""Block-sparse attention with configurable layouts.
+
+Reference parity: ``deepspeed/ops/sparse_attention`` (triton-era
+BigBird/Longformer-style block-sparse attention; ``csrc/sparse_attention``).
+TPU-first: the layout is a static [q_blocks, kv_blocks] boolean matrix baked
+into the jit program as an additive mask — XLA prunes fully-masked blocks of
+the fused attention when it tiles, and the Pallas flash kernel path can skip
+them outright. Layout builders mirror the reference's config families:
+``fixed`` (local + global strided), ``sliding_window``, ``bigbird``
+(window + global + random).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .attention import attention
+
+
+def sliding_window_layout(num_blocks: int, window_blocks: int = 3,
+                          causal: bool = True) -> np.ndarray:
+    lay = np.zeros((num_blocks, num_blocks), bool)
+    for i in range(num_blocks):
+        lo = max(0, i - window_blocks + 1)
+        hi = i + 1 if causal else min(num_blocks, i + window_blocks)
+        lay[i, lo:hi] = True
+    return lay
+
+
+def fixed_layout(num_blocks: int, local_blocks: int = 4, stride: int = 4,
+                 causal: bool = True) -> np.ndarray:
+    """Reference 'fixed' sparsity: local chunks + every stride-th block."""
+    lay = np.zeros((num_blocks, num_blocks), bool)
+    for i in range(num_blocks):
+        chunk = i // local_blocks
+        lay[i, chunk * local_blocks:(chunk + 1) * local_blocks] = True
+        lay[i, ::stride] = True
+    if causal:
+        lay &= np.tril(np.ones((num_blocks, num_blocks), bool))
+    else:
+        lay |= lay.T
+    return lay
+
+
+def bigbird_layout(num_blocks: int, window_blocks: int = 3,
+                   global_blocks: int = 1, random_blocks: int = 2,
+                   seed: int = 0, causal: bool = False) -> np.ndarray:
+    lay = sliding_window_layout(num_blocks, window_blocks, causal=causal)
+    lay[:, :global_blocks] = True
+    lay[:global_blocks, :] = True
+    rs = np.random.RandomState(seed)
+    for i in range(num_blocks):
+        lay[i, rs.choice(num_blocks, size=min(random_blocks, num_blocks),
+                         replace=False)] = True
+    if causal:
+        lay &= np.tril(np.ones((num_blocks, num_blocks), bool))
+    return lay
+
+
+def blocksparse_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                          layout: np.ndarray, block_size: int,
+                          causal: bool = True,
+                          scale: Optional[float] = None) -> jnp.ndarray:
+    """q/k/v: [batch, seq, heads, head_dim]; layout [q_blocks, kv_blocks]
+    (static). Tokens attend iff their blocks are connected AND (optionally)
+    causally ordered."""
+    s = q.shape[1]
+    if s % block_size:
+        raise ValueError(f"seq {s} not divisible by block {block_size}")
+    nb = s // block_size
+    if layout.shape != (nb, nb):
+        raise ValueError(f"layout {layout.shape} != ({nb},{nb})")
+    block_mask = jnp.asarray(layout)
+    token_mask = jnp.repeat(jnp.repeat(block_mask, block_size, 0),
+                            block_size, 1)  # [s, s]
+    if causal:
+        token_mask = token_mask & jnp.tril(jnp.ones((s, s), bool))
+    return attention(q, k, v, causal=False,
+                     mask=token_mask[None, None], scale=scale)

@@ -1,0 +1,64 @@
+"""MoQ: Mixture-of-Quantization training quantizer with precision switching.
+
+Reference parity: ``runtime/quantize.py:14 Quantizer`` and
+``runtime/weight_quantizer.py:11 WeightQuantization`` — during training the
+weight precision steps down from ``start_bits`` toward ``target_bits`` every
+``q_period`` steps; optionally the period stretches for layers with large
+Hessian eigenvalues (more sensitive → quantize later). Quantization itself is
+the shared straight-through fake-quant (``compression/compress.py``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..compression.compress import fake_quantize
+from ..utils.logging import log_dist
+
+
+class MoQQuantizer:
+    def __init__(self, q_start_bits: int = 16, q_target_bits: int = 8,
+                 q_period: int = 100, q_rounding: str = "nearest",
+                 q_type: str = "symmetric", eigenvalue_aware: bool = False):
+        self.start_bits = q_start_bits
+        self.target_bits = q_target_bits
+        self.q_period = max(1, q_period)
+        self.symmetric = q_type == "symmetric"
+        self.eigenvalue_aware = eigenvalue_aware
+        self._announced: set = set()
+
+    def bits_at(self, step: int, eigenvalue_scale: float = 1.0) -> int:
+        """Precision schedule: one bit down per (period × scale)."""
+        period = self.q_period * max(eigenvalue_scale, 1e-6)
+        drop = int(step / period)
+        return max(self.target_bits, self.start_bits - drop)
+
+    def quantize(self, params: Any, step: int,
+                 eigenvalues: Optional[Dict[str, float]] = None) -> Any:
+        """Fake-quantize matrix leaves at the scheduled precision. With
+        ``eigenvalues`` (per-top-level-key), sensitive blocks keep more bits
+        (period scales with eigenvalue / median)."""
+        evs = eigenvalues or {}
+        med = sorted(evs.values())[len(evs) // 2] if evs else 1.0
+
+        def one_subtree(key, sub):
+            scale = (evs.get(key, med) / med) if (self.eigenvalue_aware and evs) \
+                else 1.0
+            bits = self.bits_at(step, scale)
+            if bits >= self.start_bits:
+                return sub
+            if (key, bits) not in self._announced:
+                log_dist(f"MoQ: '{key}' → {bits} bits at step {step}")
+                self._announced.add((key, bits))
+            return jax.tree.map(
+                lambda x: fake_quantize(x, bits, symmetric=self.symmetric,
+                                        per_channel=True)
+                if hasattr(x, "ndim") and x.ndim >= 2 and
+                jnp.issubdtype(x.dtype, jnp.floating) else x, sub)
+
+        if isinstance(params, dict):
+            return {k: one_subtree(k, v) for k, v in params.items()}
+        return one_subtree("_root", params)

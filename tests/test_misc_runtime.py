@@ -1,0 +1,114 @@
+"""Eigenvalue / MoQ / TiledLinear / block-sparse attention tests (reference
+model: ``tests/unit/ops/sparse_attention``, MoQ tests under inference)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.sparse_attention import (bigbird_layout,
+                                                blocksparse_attention,
+                                                fixed_layout,
+                                                sliding_window_layout)
+from deepspeed_tpu.runtime.eigenvalue import Eigenvalue
+from deepspeed_tpu.runtime.quantize import MoQQuantizer
+from deepspeed_tpu.runtime.tiling import tiled_linear
+
+
+def test_eigenvalue_quadratic_exact():
+    """Hessian of x^T A x is 2A — power iteration must find 2*lambda_max."""
+    rs = np.random.RandomState(0)
+    m = rs.randn(6, 6).astype(np.float32)
+    A = m @ m.T  # PSD
+    lam_max = float(np.linalg.eigvalsh(A).max())
+
+    def loss(p):
+        x = p["x"]
+        return x @ jnp.asarray(A) @ x
+
+    eig = Eigenvalue(max_iterations=200, tol=1e-4, stability=0.0)
+    est, vec = eig.compute_eigenvalue(loss, {"x": jnp.zeros((6,))})
+    assert est == pytest.approx(2 * lam_max, rel=1e-2)
+
+
+def test_eigenvalue_per_layer():
+    def loss(p):
+        return 3.0 * jnp.sum(p["a"] ** 2) + 0.5 * jnp.sum(p["b"] ** 2)
+
+    eig = Eigenvalue(max_iterations=100, tol=1e-4, stability=0.0)
+    evs = eig.compute_layer_eigenvalues(
+        loss, {"a": jnp.ones((4,)), "b": jnp.ones((4,))})
+    assert evs["a"] == pytest.approx(6.0, rel=1e-2)   # 2*3
+    assert evs["b"] == pytest.approx(1.0, rel=1e-2)   # 2*0.5
+
+
+def test_moq_precision_schedule():
+    q = MoQQuantizer(q_start_bits=16, q_target_bits=8, q_period=10)
+    assert q.bits_at(0) == 16
+    assert q.bits_at(10) == 15
+    assert q.bits_at(79) == 9
+    assert q.bits_at(10 ** 6) == 8  # floors at target
+
+
+def test_moq_quantize_eigenvalue_aware():
+    q = MoQQuantizer(q_start_bits=16, q_target_bits=4, q_period=10,
+                     eigenvalue_aware=True)
+    params = {"sensitive": {"w": jax.random.normal(jax.random.PRNGKey(0), (16, 16))},
+              "robust": {"w": jax.random.normal(jax.random.PRNGKey(1), (16, 16))}}
+    evs = {"sensitive": 10.0, "robust": 1.0}
+    out = q.quantize(params, step=40, eigenvalues=evs)
+    # robust quantized harder (more distinct error) than sensitive
+    err_s = float(jnp.abs(out["sensitive"]["w"] - params["sensitive"]["w"]).max())
+    err_r = float(jnp.abs(out["robust"]["w"] - params["robust"]["w"]).max())
+    assert err_r > err_s
+
+
+def test_tiled_linear_matches_dense():
+    x = jax.random.normal(jax.random.PRNGKey(0), (4, 24))
+    w = jax.random.normal(jax.random.PRNGKey(1), (24, 32))
+    b = jax.random.normal(jax.random.PRNGKey(2), (32,))
+    ref = x @ w + b
+    for in_s, out_s in [(1, 1), (2, 4), (3, 1), (6, 8)]:
+        got = tiled_linear(x, w, b, in_splits=in_s, out_splits=out_s)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+    with pytest.raises(ValueError):
+        tiled_linear(x, w, None, in_splits=5)
+
+
+def test_layout_builders():
+    sw = sliding_window_layout(8, window_blocks=2, causal=True)
+    assert sw[5, 4] and sw[5, 5] and not sw[5, 3] and not sw[5, 6]
+    fx = fixed_layout(8, local_blocks=2, stride=4, causal=True)
+    assert fx[7, 0] and fx[7, 4] and fx[7, 6]  # strided + local
+    assert not fx.any(axis=1).min() == 0       # every row attends somewhere
+    bb = bigbird_layout(8, window_blocks=1, global_blocks=1, random_blocks=1)
+    assert bb[:, 0].all() and bb[0, :].all()   # global block
+
+
+def test_blocksparse_full_layout_matches_dense():
+    q = jax.random.normal(jax.random.PRNGKey(0), (2, 32, 4, 16))
+    k = jax.random.normal(jax.random.PRNGKey(1), (2, 32, 4, 16))
+    v = jax.random.normal(jax.random.PRNGKey(2), (2, 32, 4, 16))
+    from deepspeed_tpu.ops.attention import attention
+
+    full = np.ones((4, 4), bool)
+    got = blocksparse_attention(q, k, v, full, block_size=8, causal=True)
+    ref = attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_blocksparse_restricts_attention():
+    """With a diagonal-only layout, tokens cannot see earlier blocks."""
+    q = jax.random.normal(jax.random.PRNGKey(0), (1, 16, 2, 8))
+    k = jax.random.normal(jax.random.PRNGKey(1), (1, 16, 2, 8))
+    v = jax.random.normal(jax.random.PRNGKey(2), (1, 16, 2, 8))
+    diag = np.eye(2, dtype=bool)
+    out = blocksparse_attention(q, k, v, diag, block_size=8, causal=True)
+    # second block must be independent of first block's K/V
+    k2 = k.at[:, :8].set(0.0)
+    v2 = v.at[:, :8].set(0.0)
+    out2 = blocksparse_attention(q, k2, v2, diag, block_size=8, causal=True)
+    np.testing.assert_allclose(np.asarray(out[:, 8:]), np.asarray(out2[:, 8:]),
+                               rtol=1e-5)
