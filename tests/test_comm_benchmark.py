@@ -1,0 +1,29 @@
+"""Collective benchmark CLI tests (reference model: ds_bench smoke)."""
+
+import jax
+import numpy as np
+import pytest
+
+from deepspeed_tpu.comm.benchmark import bench_collective, sweep
+
+
+@pytest.mark.parametrize("op", ["all_reduce", "all_gather", "reduce_scatter",
+                                "all_to_all"])
+def test_bench_collective_runs(devices8, op):
+    r = bench_collective(op, 1 << 12, trials=2, warmup=1)
+    assert r["world"] == 8
+    assert r["latency_us"] > 0
+    assert r["busbw_GBps"] > 0
+    assert r["bytes"] >= (1 << 12) - 64  # divisibility rounding only
+
+
+def test_sweep_shapes(devices8):
+    rows = sweep(ops=["all_reduce"], sizes=[1 << 10, 1 << 14], trials=1,
+                 warmup=0)
+    assert len(rows) == 2
+    assert rows[1]["bytes"] > rows[0]["bytes"]
+
+
+def test_unknown_op_raises(devices8):
+    with pytest.raises(ValueError):
+        bench_collective("gather_all", 1024)
