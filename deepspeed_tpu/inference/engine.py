@@ -98,11 +98,11 @@ class InferenceEngine:
             # avoids a host round-trip + throwaway HBM copy at construction
             self.params = None
         else:
-            cast = jax.tree.map(
-                lambda p: p.astype(self.dtype)
-                if jnp.issubdtype(jnp.asarray(p).dtype, jnp.floating)
-                else jnp.asarray(p), params)
-            self.params = jax.device_put(cast, self.param_shardings)
+            from ..utils.tree import cast_floating
+
+            self.params = jax.device_put(
+                cast_floating(jax.tree.map(jnp.asarray, params), self.dtype),
+                self.param_shardings)
         log_dist(f"init_inference: {family.name} sharded over "
                  f"tensor={mesh_mgr.tp_world_size} (dtype={self.dtype})")
 
@@ -114,8 +114,15 @@ class InferenceEngine:
     def module(self):
         return self.family
 
+    def _require_params(self):
+        if self.params is None:
+            raise RuntimeError(
+                "inference engine was built with abstract params (shapes "
+                "only) — assign real weights to engine.params before use")
+
     def forward(self, tokens) -> jnp.ndarray:
         """Full no-cache forward → logits (scoring / perplexity path)."""
+        self._require_params()
         return self._forward(self.params, jnp.asarray(tokens))
 
     __call__ = forward
@@ -155,6 +162,7 @@ class InferenceEngine:
                  ) -> np.ndarray:
         """prompts: [batch, t] int array (right-padded); returns
         [batch, max_new_tokens] generated ids (post-EOS positions hold EOS)."""
+        self._require_params()
         prompts = np.asarray(prompts, np.int32)
         b, t = prompts.shape
         if prompt_lengths is None:

@@ -51,46 +51,49 @@ class DeepSpeedHybridEngine:
         self._inference = InferenceEngine(self.family, abstract, inf_cfg,
                                           mesh_mgr=engine.mesh_mgr)
         self._reshard = None
-        self._synced_at = -1
+        self._synced_state = None
         self._in_train = True
         log_dist("hybrid engine: inference path attached "
                  f"(tp={engine.mesh_mgr.tp_world_size})")
 
     # ------------------------------------------------------------------ #
     def _build_reshard(self):
+        from ..utils.tree import cast_floating
+
         shardings = self._inference.param_shardings
         dtype = self._inference.dtype
-
-        def cast(p):
-            return jax.tree.map(
-                lambda x: x.astype(dtype)
-                if jnp.issubdtype(x.dtype, jnp.floating) else x, p)
-
         with self.engine.mesh_mgr.activate():
-            return jax.jit(cast, out_shardings=shardings)
+            return jax.jit(lambda p: cast_floating(p, dtype),
+                           out_shardings=shardings)
 
     def _sync_inference_params(self) -> None:
         """Re-gather train params into the inference layout if stale
-        (reference: gathered-weight refresh before each rollout batch)."""
-        if self._synced_at == self.engine.global_steps:
+        (reference: gathered-weight refresh before each rollout batch).
+        Staleness = state-object identity: the engine replaces ``state``
+        on every optimizer step AND on checkpoint load."""
+        if self._synced_state is self.engine.state:
             return
         if self._reshard is None:
             self._reshard = self._build_reshard()
         self._inference.params = self._reshard(self.engine.state.params)
-        self._synced_at = self.engine.global_steps
-        log_dist(f"hybrid engine: weights synced at step {self._synced_at}")
+        self._synced_state = self.engine.state
+        log_dist(f"hybrid engine: weights synced at step "
+                 f"{self.engine.global_steps}")
 
     # ------------------------------------------------------------------ #
     def generate(self, prompts, **kwargs):
         """Rollout with the CURRENT training weights."""
-        self._in_train = False
         self._sync_inference_params()
         return self._inference.generate(prompts, **kwargs)
 
-    def forward(self, tokens):
-        """Inference-mode scoring forward (e.g. logprobs for PPO)."""
+    def forward(self, batch):
+        """Mode-dependent (reference hybrid flips containers): train mode →
+        the training engine's micro-batch forward (stages grads for
+        backward); eval mode → inference-kernel scoring forward."""
+        if self._in_train:
+            return self.engine.forward(batch)
         self._sync_inference_params()
-        return self._inference.forward(tokens)
+        return self._inference.forward(batch)
 
     # --- training passthrough (reference keeps one engine API) --------- #
     def train_batch(self, batch):
@@ -112,4 +115,6 @@ class DeepSpeedHybridEngine:
         return self
 
     def __getattr__(self, name):
+        if name == "engine":  # avoid recursion on half-built instances
+            raise AttributeError(name)
         return getattr(self.engine, name)

@@ -60,23 +60,49 @@ def test_hybrid_generate_train_generate(trained):
                                   ref_eng.generate(prompts, max_new_tokens=4))
 
 
-def test_hybrid_sync_only_after_update(trained):
+def test_hybrid_sync_only_after_state_change(trained, tmp_path):
     engine, cfg = trained
     hybrid = DeepSpeedHybridEngine(engine, llama, cfg, {"dtype": "float32"})
     hybrid.generate(np.array([[1, 2]], np.int32), max_new_tokens=2)
-    first_sync = hybrid._synced_at
+    first_sync = hybrid._synced_state
     hybrid.generate(np.array([[1, 2]], np.int32), max_new_tokens=2)
-    assert hybrid._synced_at == first_sync  # no re-gather without a step
+    assert hybrid._synced_state is first_sync  # no re-gather without a step
     hybrid.train_batch(_batch(cfg))
     hybrid.generate(np.array([[1, 2]], np.int32), max_new_tokens=2)
-    assert hybrid._synced_at == first_sync + 1
+    assert hybrid._synced_state is not first_sync
+    # checkpoint load also replaces state → re-sync even at the same step
+    engine.save_checkpoint(str(tmp_path), tag="h")
+    loaded_sync = hybrid._synced_state
+    engine.load_checkpoint(str(tmp_path), tag="h")
+    hybrid.generate(np.array([[1, 2]], np.int32), max_new_tokens=2)
+    assert hybrid._synced_state is not loaded_sync
 
 
 def test_hybrid_scoring_forward(trained):
     engine, cfg = trained
     hybrid = DeepSpeedHybridEngine(engine, llama, cfg, {"dtype": "float32"})
-    logits = hybrid.forward(np.array([[1, 2, 3]], np.int32))
+    logits = hybrid.eval().forward(np.array([[1, 2, 3]], np.int32))
     assert logits.shape == (1, 3, cfg.vocab_size)
     # passthrough of engine attrs
     assert hybrid.global_steps == engine.global_steps
     assert hybrid.train_batch_size() == 8
+
+
+def test_hybrid_train_mode_forward_backward_step(trained):
+    """Train-mode forward routes to the TRAINING engine (stages grads)."""
+    engine, cfg = trained
+    hybrid = DeepSpeedHybridEngine(engine, llama, cfg, {"dtype": "float32"})
+    hybrid.train()
+    loss = hybrid.forward(_batch(cfg))
+    assert np.isfinite(float(loss))
+    hybrid.backward()
+    out = hybrid.step()
+    assert out is not None and np.isfinite(float(out.loss))
+
+
+def test_hybrid_getattr_no_recursion():
+    import pickle
+
+    obj = DeepSpeedHybridEngine.__new__(DeepSpeedHybridEngine)
+    with pytest.raises(AttributeError):
+        obj.anything  # half-built instance must not recurse
