@@ -28,3 +28,34 @@ def init_inference(*args, **kwargs):
     from .inference.engine import init_inference as _init
 
     return _init(*args, **kwargs)
+
+
+def tp_model_init(*args, **kwargs):
+    from .runtime.zero_init import tp_model_init as _init
+
+    return _init(*args, **kwargs)
+
+
+class _ZeroNamespace:
+    """``deepspeed_tpu.zero`` — reference ``deepspeed.zero`` namespace."""
+
+    @property
+    def Init(self):
+        from .runtime.zero_init import Init
+
+        return Init
+
+    @property
+    def GatheredParameters(self):
+        from .runtime.zero_init import GatheredParameters
+
+        return GatheredParameters
+
+    @property
+    def materialize_sharded(self):
+        from .runtime.zero_init import materialize_sharded
+
+        return materialize_sharded
+
+
+zero = _ZeroNamespace()
