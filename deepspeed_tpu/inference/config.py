@@ -31,6 +31,15 @@ class RaggedConfig:
 
 
 @dataclass
+class QuantConfig:
+    """Weight quantization for inference (reference
+    ``inference/quantization`` INT4/INT8 + ``GroupQuantizer``)."""
+
+    enabled: bool = False
+    bits: int = 8
+
+
+@dataclass
 class InferenceConfig:
     dtype: str = "bfloat16"
     tensor_parallel: TPConfig = field(default_factory=TPConfig)
@@ -41,6 +50,7 @@ class InferenceConfig:
     max_batch_size: int = 8
     prefill_bucket: int = 64             # pad prompts to a multiple of this
     ragged: RaggedConfig = field(default_factory=RaggedConfig)
+    quant: QuantConfig = field(default_factory=QuantConfig)
 
     @classmethod
     def from_dict(cls, d: Optional[Dict[str, Any]]) -> "InferenceConfig":
@@ -49,6 +59,7 @@ class InferenceConfig:
         if isinstance(tp, int):
             tp = {"tp_size": tp}
         ragged = d.pop("ragged", {})
+        quant = d.pop("quant", {})
         known = {k: v for k, v in d.items() if k in cls.__dataclass_fields__}
         return cls(tensor_parallel=TPConfig(**tp), ragged=RaggedConfig(**ragged),
-                   **known)
+                   quant=QuantConfig(**quant), **known)

@@ -47,7 +47,12 @@ class ModelFamily:
 
     @classmethod
     def from_module(cls, module, cfg) -> "ModelFamily":
-        return cls(cfg=cfg, apply_fn=module.apply,
+        def apply_logits(*a, **kw):
+            out = module.apply(*a, **kw)
+            # MoE families return (logits, aux_loss); inference wants logits
+            return out[0] if isinstance(out, tuple) else out
+
+        return cls(cfg=cfg, apply_fn=apply_logits,
                    apply_cached=module.apply_cached,
                    init_cache=module.init_cache,
                    param_logical_axes=module.param_logical_axes,
@@ -93,10 +98,20 @@ class InferenceEngine:
         self.param_shardings = self.partitioner.shardings(specs)
         abstract = all(isinstance(l, jax.ShapeDtypeStruct)
                        for l in jax.tree.leaves(params))
+        self._quantized = self.config.quant.enabled
         if abstract:
             # caller supplies real weights later (hybrid engine sync path) —
             # avoids a host round-trip + throwaway HBM copy at construction
             self.params = None
+        elif self._quantized:
+            # weight-only quantization (reference inference/quantization
+            # INT8/INT4): weights REST in HBM as int8 + per-row fp scales;
+            # dequantization happens inside the jitted step (XLA fuses it
+            # into the consuming matmul, so the full-precision copy is
+            # transient per-use)
+            qtree, qshardings = self._quantize_params(
+                jax.tree.map(jnp.asarray, params))
+            self.params = jax.device_put(qtree, qshardings)
         else:
             from ..utils.tree import cast_floating
 
@@ -107,7 +122,49 @@ class InferenceEngine:
                  f"tensor={mesh_mgr.tp_world_size} (dtype={self.dtype})")
 
         self._forward = jax.jit(
-            lambda p, t: family.apply_fn(family.cfg, p, t))
+            lambda p, t: family.apply_fn(family.cfg, self._dq(p), t))
+
+    # ------------------------------------------------------------------ #
+    # weight-only quantization (int8 at rest, dequantize-on-use)
+    # ------------------------------------------------------------------ #
+    @staticmethod
+    def _is_qleaf(x) -> bool:
+        return isinstance(x, dict) and set(x) == {"q", "scale"}
+
+    def _quantize_params(self, params):
+        """≥2-D float leaves → {'q': int8 (same shape), 'scale': per-row fp32}
+        so the original leaf's sharding spec still applies to 'q'."""
+        bits = self.config.quant.bits
+        qmax = 2 ** (bits - 1) - 1
+        flat, treedef = jax.tree_util.tree_flatten(params)
+        sflat = jax.tree_util.tree_flatten(self.param_shardings)[0]
+        rep = self.mesh_mgr.replicated()
+        qleaves, qshard = [], []
+        for leaf, sh in zip(flat, sflat):
+            if hasattr(leaf, "ndim") and leaf.ndim >= 2 and \
+                    jnp.issubdtype(leaf.dtype, jnp.floating):
+                scale = jnp.maximum(jnp.max(jnp.abs(leaf), axis=-1,
+                                            keepdims=True), 1e-8) / qmax
+                q = jnp.clip(jnp.round(leaf / scale), -qmax - 1, qmax) \
+                    .astype(jnp.int8)
+                qleaves.append({"q": q, "scale": scale.astype(jnp.float32)})
+                qshard.append({"q": sh, "scale": rep})
+            else:
+                qleaves.append(leaf.astype(self.dtype)
+                               if jnp.issubdtype(leaf.dtype, jnp.floating)
+                               else leaf)
+                qshard.append(sh)
+        return (jax.tree_util.tree_unflatten(treedef, qleaves),
+                jax.tree_util.tree_unflatten(treedef, qshard))
+
+    def _dq(self, params):
+        """Dequantize inside jit (no-op when quantization is off)."""
+        if not self._quantized:
+            return params
+        return jax.tree.map(
+            lambda x: (x["q"].astype(self.dtype) *
+                       x["scale"].astype(self.dtype)) if self._is_qleaf(x) else x,
+            params, is_leaf=self._is_qleaf)
 
     # ------------------------------------------------------------------ #
     @property
@@ -137,7 +194,8 @@ class InferenceEngine:
 
         def prefill(params, tokens, lengths, rng):
             cache = fam.init_cache(fam.cfg, batch, max_len)
-            logits, cache = fam.apply_cached(fam.cfg, params, tokens, cache,
+            logits, cache = fam.apply_cached(fam.cfg, self._dq(params), tokens,
+                                             cache,
                                              jnp.zeros((batch,), jnp.int32))
             # last valid logit per sequence
             last = jnp.take_along_axis(
@@ -146,8 +204,8 @@ class InferenceEngine:
             return tok.astype(jnp.int32), cache
 
         def decode(params, tok, cache, cache_len, rng):
-            logits, cache = fam.apply_cached(fam.cfg, params, tok[:, None],
-                                             cache, cache_len)
+            logits, cache = fam.apply_cached(fam.cfg, self._dq(params),
+                                             tok[:, None], cache, cache_len)
             nxt = sample(rng, logits[:, 0], params_s)
             return nxt.astype(jnp.int32), cache
 

@@ -67,7 +67,7 @@ class InferenceEngineV2(InferenceEngine):
 
             def prefill(params, cache, tokens, length, table, rng):
                 valid = jnp.arange(pad_t)[None, :] < length
-                logits, cache = ap(fam.cfg, params, tokens[None, :], cache,
+                logits, cache = ap(fam.cfg, self._dq(params), tokens[None, :], cache,
                                    table[None, :], jnp.zeros((1,), jnp.int32),
                                    valid=valid)
                 last = jnp.take_along_axis(
@@ -84,7 +84,7 @@ class InferenceEngineV2(InferenceEngine):
 
             def decode(params, cache, tokens, lens, tables, active, rng):
                 # inactive slots write to the trash block (valid=False)
-                logits, cache = ap(fam.cfg, params, tokens[:, None], cache,
+                logits, cache = ap(fam.cfg, self._dq(params), tokens[:, None], cache,
                                    tables, lens, valid=active[:, None])
                 nxt = sample(rng, logits[:, 0], sp)
                 return nxt.astype(jnp.int32), cache

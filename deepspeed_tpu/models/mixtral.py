@@ -141,6 +141,65 @@ def apply(cfg: MixtralConfig, params: Params, tokens: jnp.ndarray, *,
     return logits.astype(jnp.float32), jnp.sum(aux_losses)
 
 
+# --- KV-cached inference path (MoE decode; reference
+# ``inference/v2/model_implementations/mixtral``) ------------------------- #
+def init_cache(cfg: MixtralConfig, batch_size: int, max_len: int,
+               dtype=jnp.bfloat16) -> Params:
+    shape = (cfg.num_layers, batch_size, max_len, cfg.num_kv_heads,
+             cfg.head_size)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def cache_logical_axes(cfg: MixtralConfig) -> Params:
+    spec = ("layers", None, None, "kv_heads", None)
+    return {"k": spec, "v": spec}
+
+
+def apply_cached(cfg: MixtralConfig, params: Params, tokens: jnp.ndarray,
+                 cache: Params, cache_len: jnp.ndarray, *,
+                 compute_dtype=jnp.bfloat16) -> Tuple[jnp.ndarray, Params]:
+    """Prefill/decode with KV cache; MoE routing runs per new token (aux loss
+    is discarded at inference)."""
+    if cache_len.ndim == 0:
+        cache_len = jnp.broadcast_to(cache_len, (tokens.shape[0],))
+    b, t = tokens.shape
+    nh, nkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_size
+    x = params["embed"][tokens].astype(compute_dtype)
+    cos, sin = rope_frequencies(cfg.head_size, cfg.max_seq_len, cfg.rope_theta)
+    positions = cache_len[:, None] + jnp.arange(t)[None, :]
+    # inference never drops tokens: a dropped decode token would silently
+    # corrupt the completion (reference v2 mixtral routes without capacity)
+    moe_layer = MoELayer(cfg.num_experts, cfg.top_k, cfg.capacity_factor,
+                         cfg.min_capacity, drop_tokens=False)
+    layers = jax.tree.map(lambda p: p.astype(compute_dtype)
+                          if jnp.issubdtype(p.dtype, jnp.floating) else p,
+                          params["layers"])
+
+    def scan_body(x, scanned):
+        layer, k_c, v_c = scanned
+        y = rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps)
+        q = apply_rotary((y @ layer["wq"]).reshape(b, t, nh, hd), cos, sin,
+                         positions)
+        k = apply_rotary((y @ layer["wk"]).reshape(b, t, nkv, hd), cos, sin,
+                         positions)
+        v = (y @ layer["wv"]).reshape(b, t, nkv, hd)
+        k_c = llama_mod._write_cache(k_c, k, cache_len)
+        v_c = llama_mod._write_cache(v_c, v, cache_len)
+        S = k_c.shape[1]
+        kv_pos = jnp.arange(S)[None, None, None, :]
+        q_abs = positions[:, None, :, None]
+        attn = attention(q, k_c, v_c, causal=False, mask=kv_pos <= q_abs)
+        x = x + attn.reshape(b, t, nh * hd) @ layer["wo"]
+        y = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
+        ffn_out, _aux = moe_layer(layer["moe"], y)
+        return x + ffn_out, (k_c, v_c)
+
+    x, (nk, nv) = lax.scan(scan_body, x, (layers, cache["k"], cache["v"]))
+    x = rms_norm(x, params["final_norm"].astype(compute_dtype), cfg.rms_norm_eps)
+    logits = x @ params["lm_head"].astype(compute_dtype)
+    return logits.astype(jnp.float32), {"k": nk, "v": nv}
+
+
 def loss_fn(cfg: MixtralConfig, params: Params, batch: Dict[str, jnp.ndarray], *,
             compute_dtype=jnp.bfloat16):
     tokens = batch["tokens"]

@@ -1,0 +1,203 @@
+"""Parity-surface tests: zero.Init, tp_model_init, Domino, SuperOffload,
+MoE inference, quantized inference, curriculum-in-engine (reference model:
+``tests/unit/runtime/zero/test_zero_context*.py``, ``tests/unit/moe``)."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+import deepspeed_tpu as dst
+from deepspeed_tpu.comm import mesh as mesh_lib
+from deepspeed_tpu.models import llama, mixtral
+from deepspeed_tpu.runtime.domino import (column_parallel_linear, domino_block,
+                                          row_parallel_linear)
+from deepspeed_tpu.runtime.superoffload import SuperOffloadOptimizer
+from deepspeed_tpu.runtime.zero_init import (GatheredParameters, Init,
+                                             materialize_sharded, on_device)
+
+
+def test_zero_init_materializes_sharded(devices8):
+    mesh_lib.set_mesh(None)
+    mm = mesh_lib.init_mesh({"data": 8})
+    cfg = llama.LlamaConfig.tiny()
+    with dst.zero.Init(config_dict_or_path={"train_batch_size": 8,
+                                            "zero_optimization": {"stage": 3}}) as zi:
+        params = zi.materialize(lambda r: llama.init(cfg, r),
+                                jax.random.PRNGKey(0),
+                                llama.param_logical_axes(cfg))
+    # stage-3: large leaves sharded over the zero axes
+    wq = params["layers"]["wq"]
+    assert len(wq.sharding.device_set) == 8
+    assert "data" in str(wq.sharding.spec)
+    # identical values to direct init (same rng → same weights)
+    direct = llama.init(cfg, jax.random.PRNGKey(0))
+    np.testing.assert_allclose(np.asarray(wq), np.asarray(direct["layers"]["wq"]),
+                               rtol=1e-6)
+
+
+def test_on_device_abstract_and_gathered(devices8):
+    cfg = llama.LlamaConfig.tiny()
+    abstract = on_device(lambda r: llama.init(cfg, r), jax.random.PRNGKey(0))
+    assert all(isinstance(l, jax.ShapeDtypeStruct)
+               for l in jax.tree.leaves(abstract))
+    mesh_lib.set_mesh(None)
+    mesh_lib.init_mesh({"data": 8})
+    params = materialize_sharded(lambda r: llama.init(cfg, r),
+                                 jax.random.PRNGKey(0),
+                                 llama.param_logical_axes(cfg), zero_stage=3)
+    with GatheredParameters(params) as full:
+        assert isinstance(full["embed"], np.ndarray)
+        assert full["embed"].shape == (cfg.vocab_size, cfg.hidden_size)
+
+
+def test_tp_model_init(devices8):
+    mesh_lib.set_mesh(None)
+    cfg = llama.LlamaConfig.tiny()
+    spec = llama.model_spec(cfg, compute_dtype=jnp.float32)
+    params = dst.tp_model_init(spec, tp_size=2)
+    assert "tensor" in str(params["layers"]["wq"].sharding.spec)
+
+
+def test_domino_parallel_linears(devices8):
+    from jax.experimental.shard_map import shard_map
+
+    mesh = Mesh(np.asarray(jax.devices()).reshape(4, 2), ("data", "tensor"))
+    x = jax.random.normal(jax.random.PRNGKey(0), (8, 16))
+    w1 = jax.random.normal(jax.random.PRNGKey(1), (16, 32)) * 0.1
+    w2 = jax.random.normal(jax.random.PRNGKey(2), (32, 16)) * 0.1
+    ref = jax.nn.relu(x @ w1) @ w2
+
+    @functools.partial(shard_map, mesh=mesh,
+                       in_specs=(P("data"), P(None, "tensor"), P("tensor")),
+                       out_specs=P("data"))
+    def tp_mlp(xs, w1s, w2s):
+        h = jax.nn.relu(column_parallel_linear(xs, w1s))
+        return row_parallel_linear(h, w2s, axis="tensor")
+
+    got = tp_mlp(x, w1, w2)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_domino_block_chunking():
+    x = jnp.arange(24.0).reshape(6, 4)
+    out = domino_block(lambda c: c * 2, x, num_chunks=2)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x) * 2)
+    with pytest.raises(ValueError):
+        domino_block(lambda c: c, x, num_chunks=4)
+
+
+def test_superoffload_speculative_and_rollback():
+    target = jnp.asarray(np.random.RandomState(0).randn(32).astype(np.float32))
+    params = {"w": jnp.zeros((32,))}
+    so = SuperOffloadOptimizer(params, lr=0.05, clip_norm=1e9)  # no clipping
+    for _ in range(50):
+        p = so.params()
+        g = jax.tree.map(lambda w, t: 2 * (w - t), p, {"w": target})
+        so.step(g)
+    final = so.params()
+    assert float(jnp.sum((final["w"] - target) ** 2)) < \
+        0.1 * float(jnp.sum(target ** 2))
+    # rollback must restore params AND moments: a rolled-back+replayed
+    # sequence is identical to never having taken the bad step
+    so._drain(block=True)
+    m_before = so.cpu_adam.exp_avg[0].copy()
+    v_before = so.cpu_adam.exp_avg_sq[0].copy()
+    p_before = np.asarray(so.params()["w"]).copy()
+    step_before = so.cpu_adam.step_count
+    so.step({"w": jnp.ones((32,)) * 100})          # speculative bad step
+    so.rollback_and_replay({"w": jnp.zeros((32,))})  # corrected grads
+    # reference: apply the zero-grad step directly from the same start
+    ref = SuperOffloadOptimizer({"w": jnp.asarray(p_before)}, lr=0.05,
+                                clip_norm=1e9)
+    ref.cpu_adam.exp_avg = [m_before.copy()]
+    ref.cpu_adam.exp_avg_sq = [v_before.copy()]
+    ref.cpu_adam.step_count = step_before
+    ref.cpu_adam.step([np.zeros((32,), np.float32)])
+    np.testing.assert_allclose(np.asarray(so.params()["w"]), ref.host[0],
+                               rtol=1e-6)
+    np.testing.assert_allclose(so.cpu_adam.exp_avg[0], ref.cpu_adam.exp_avg[0],
+                               rtol=1e-6)
+    so.close()
+    ref.close()
+
+
+def test_superoffload_rollback_requires_snapshot():
+    so = SuperOffloadOptimizer({"w": jnp.zeros((4,))}, lr=0.1, clip_norm=1.0)
+    with pytest.raises(RuntimeError, match="snapshot"):
+        so.rollback_and_replay({"w": jnp.zeros((4,))})
+    so.close()
+
+
+def test_mixtral_cached_matches_full(devices8):
+    cfg = mixtral.MixtralConfig.tiny(drop_tokens=False)
+    params = mixtral.init(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 9), 0, cfg.vocab_size)
+    full, _aux = mixtral.apply(cfg, params, tokens, compute_dtype=jnp.float32)
+    cache = mixtral.init_cache(cfg, 2, 16, dtype=jnp.float32)
+    logits, cache = mixtral.apply_cached(cfg, params, tokens, cache,
+                                         jnp.zeros((2,), jnp.int32),
+                                         compute_dtype=jnp.float32)
+    np.testing.assert_allclose(np.asarray(full[:, -1]),
+                               np.asarray(logits[:, -1]), rtol=2e-3, atol=2e-3)
+
+
+def test_mixtral_generation_via_engine(devices8):
+    cfg = mixtral.MixtralConfig.tiny(drop_tokens=False)
+    params = mixtral.init(cfg, jax.random.PRNGKey(0))
+    mesh_lib.set_mesh(None)
+    eng = dst.init_inference(mixtral, model_cfg=cfg, params=params,
+                             config={"dtype": "float32", "prefill_bucket": 16})
+    out = eng.generate(np.array([[3, 1, 4]], np.int32), max_new_tokens=3)
+    assert out.shape == (1, 3)
+    logits = eng.forward(np.array([[3, 1, 4]], np.int32))
+    assert logits.shape == (1, 3, cfg.vocab_size)
+
+
+def test_quantized_inference(devices8):
+    cfg = llama.LlamaConfig.tiny()
+    params = llama.init(cfg, jax.random.PRNGKey(0))
+    mesh_lib.set_mesh(None)
+    ref = dst.init_inference(llama, model_cfg=cfg, params=params,
+                             config={"dtype": "float32"})
+    mesh_lib.set_mesh(None)
+    q8 = dst.init_inference(llama, model_cfg=cfg, params=params,
+                            config={"dtype": "float32",
+                                    "quant": {"enabled": True, "bits": 8}})
+    prompts = np.array([[5, 7, 11]], np.int32)
+    lr = ref.forward(prompts)
+    lq = q8.forward(prompts)
+    # int8 weights ≈ close logits, not identical
+    assert not np.array_equal(np.asarray(lr), np.asarray(lq))
+    np.testing.assert_allclose(np.asarray(lq), np.asarray(lr), atol=0.5)
+    # the weights REST as int8 in device memory (real footprint saving)
+    assert q8.params["layers"]["wq"]["q"].dtype == jnp.int8
+    assert q8.params["layers"]["wq"]["scale"].dtype == jnp.float32
+    # generation works through the dequant-on-use path
+    out = q8.generate(prompts, max_new_tokens=3)
+    assert out.shape == (1, 3)
+
+
+def test_curriculum_in_engine(devices8):
+    mesh_lib.set_mesh(None)
+    cfg = llama.LlamaConfig.tiny()
+    spec = llama.model_spec(cfg, compute_dtype=jnp.float32)
+    engine, *_ = dst.initialize(model=spec, config={
+        "train_batch_size": 8,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+        "data_efficiency": {"data_sampling": {"curriculum_learning": {
+            "enabled": True, "min_difficulty": 8, "max_difficulty": 32,
+            "schedule_type": "fixed_linear",
+            "schedule_config": {"total_curriculum_step": 4,
+                                "difficulty_step": 8}}}},
+        "steps_per_print": 0})
+    assert engine.curriculum_scheduler is not None
+    for i in range(5):
+        t = np.random.randint(0, cfg.vocab_size, (8, 33)).astype(np.int32)
+        out = engine.train_batch({"tokens": t})
+    assert engine.curriculum_scheduler.current_difficulty == 32
+    assert np.isfinite(float(out.loss))
