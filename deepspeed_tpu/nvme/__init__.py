@@ -1,0 +1,1 @@
+from .sweep import io_sweep, main  # noqa: F401

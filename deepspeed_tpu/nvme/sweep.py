@@ -1,0 +1,95 @@
+"""NVMe/disk I/O performance sweep — `dstpu_nvme_tune` / `dstpu_io`.
+
+Reference parity: ``deepspeed/nvme`` (``ds_nvme_tune``: sweep block_size ×
+queue_depth × threads and report read/write GB/s) and ``ds_io`` (one-shot
+benchmark). Drives the same C++ async engine (``csrc/aio.cpp``) the swap
+tier uses, so the tuned numbers transfer directly to ZeRO-Infinity-style
+offload configs."""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..ops.aio.handle import AIOHandle
+
+
+def _drop_cache(path: str) -> None:
+    """Evict the file from the page cache so reads hit the device."""
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.posix_fadvise(fd, 0, 0, os.POSIX_FADV_DONTNEED)
+    finally:
+        os.close(fd)
+
+
+def _bench_one(path: str, nbytes: int, block_size: int, num_threads: int,
+               trials: int = 3) -> Dict[str, float]:
+    buf = np.random.randint(0, 255, nbytes, np.uint8)
+    out = np.empty_like(buf)
+    h = AIOHandle(block_size=block_size, num_threads=num_threads)
+    wt = []
+    rt = []
+    for _ in range(trials):
+        # write timing includes fsync so the page cache can't absorb it
+        t0 = time.perf_counter()
+        assert h.write(buf, path) == 0
+        fd = os.open(path, os.O_WRONLY)
+        os.fsync(fd)
+        os.close(fd)
+        wt.append(time.perf_counter() - t0)
+        _drop_cache(path)  # reads must come from the device, not RAM
+        t0 = time.perf_counter()
+        assert h.read(out, path) == 0
+        rt.append(time.perf_counter() - t0)
+    assert (out == buf).all()
+    return {"write_GBps": nbytes / min(wt) / 1e9,
+            "read_GBps": nbytes / min(rt) / 1e9}
+
+
+def io_sweep(directory: Optional[str] = None, nbytes: int = 64 << 20,
+             block_sizes=(256 << 10, 1 << 20, 8 << 20),
+             thread_counts=(1, 4, 8), trials: int = 3) -> List[Dict]:
+    """Sweep → list of result rows, best configuration last."""
+    directory = directory or tempfile.gettempdir()
+    path = os.path.join(directory, "dstpu_io_sweep.bin")
+    rows = []
+    try:
+        for bs in block_sizes:
+            for nt in thread_counts:
+                r = _bench_one(path, nbytes, bs, nt, trials)
+                rows.append({"block_size": bs, "threads": nt,
+                             **{k: round(v, 3) for k, v in r.items()}})
+    finally:
+        if os.path.exists(path):
+            os.remove(path)
+    rows.sort(key=lambda r: r["read_GBps"] + r["write_GBps"])
+    return rows
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(prog="dstpu_nvme_tune",
+                                description="disk I/O sweep for the aio engine")
+    p.add_argument("--dir", default=None, help="target directory (NVMe mount)")
+    p.add_argument("--mb", type=int, default=64)
+    p.add_argument("--trials", type=int, default=3)
+    args = p.parse_args(argv)
+    rows = io_sweep(args.dir, args.mb << 20, trials=args.trials)
+    for r in rows:
+        print(json.dumps(r))
+    best = rows[-1]
+    print(json.dumps({"best": best}))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -196,6 +196,17 @@ class DeepSpeedTPUEngine:
         from ..profiling import FlopsProfiler
 
         self.flops_profiler = FlopsProfiler(config.flops_profiler, engine=self)
+
+        # --- curriculum learning (reference engine hooks :395-408 wire the
+        # curriculum scheduler into the forward prologue) ---
+        self.curriculum_scheduler = None
+        cl = (config.data_efficiency or {}).get("data_sampling", {}) \
+            .get("curriculum_learning", config.data_efficiency.get(
+                "curriculum_learning", {})) if config.data_efficiency else {}
+        if cl.get("enabled"):
+            from .data_pipeline import CurriculumScheduler
+
+            self.curriculum_scheduler = CurriculumScheduler(cl)
         log_dist(
             f"engine ready: zero_stage={config.zero_config.stage} "
             f"dtype={config.compute_dtype} mesh={dict(mesh_mgr.mesh.shape)} "
@@ -372,6 +383,9 @@ class DeepSpeedTPUEngine:
         if self._train_step is None:
             self._build_train_step()
         self.tput_timer.start()
+        if self.curriculum_scheduler is not None:
+            # difficulty = seq length; each bucket is its own cached jit
+            batch = self.curriculum_scheduler.truncate(batch, self.global_steps)
         batch = self._shard_batch(batch, with_gas_dim=True)
         self.state, out = self._train_step(self.state, batch)
         self.global_steps += 1

@@ -1,0 +1,38 @@
+"""Memory introspection — reference ``see_memory_usage``
+(``runtime/utils.py``) and the ``memory_breakdown`` config."""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+
+from .logging import log_dist
+
+
+def memory_stats(device: Optional[jax.Device] = None) -> Dict[str, float]:
+    """Device memory stats in GB (empty dict on backends without stats)."""
+    dev = device or jax.devices()[0]
+    stats = getattr(dev, "memory_stats", lambda: None)()
+    if not stats:
+        return {}
+    gb = 1 << 30
+    return {
+        "in_use_GB": stats.get("bytes_in_use", 0) / gb,
+        "peak_GB": stats.get("peak_bytes_in_use", 0) / gb,
+        "limit_GB": stats.get("bytes_limit", 0) / gb,
+        "reserved_GB": stats.get("bytes_reserved", 0) / gb,
+    }
+
+
+def see_memory_usage(message: str, force: bool = False) -> Dict[str, float]:
+    """Log current/peak device memory (reference ``see_memory_usage``)."""
+    s = memory_stats()
+    if s:
+        log_dist(f"{message} | MA {s['in_use_GB']:.2f} GB  "
+                 f"Max_MA {s['peak_GB']:.2f} GB  "
+                 f"limit {s['limit_GB']:.2f} GB")
+    else:
+        log_dist(f"{message} | (no device memory stats on "
+                 f"{jax.default_backend()})")
+    return s

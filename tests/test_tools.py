@@ -1,0 +1,23 @@
+"""Tool-tier tests: memory introspection, NVMe sweep (reference model:
+``tests/unit/ops/aio``, ds_nvme_tune smoke)."""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.nvme.sweep import io_sweep
+from deepspeed_tpu.utils.memory import memory_stats, see_memory_usage
+
+
+def test_see_memory_usage_runs():
+    s = see_memory_usage("unit-test probe")
+    assert isinstance(s, dict)  # CPU backend may return {}
+
+
+def test_io_sweep_roundtrip(tmp_path):
+    rows = io_sweep(str(tmp_path), nbytes=1 << 20, block_sizes=(256 << 10,),
+                    thread_counts=(1, 2), trials=1)
+    assert len(rows) == 2
+    assert all(r["read_GBps"] > 0 and r["write_GBps"] > 0 for r in rows)
+    # sorted ascending by combined bandwidth
+    assert rows[-1]["read_GBps"] + rows[-1]["write_GBps"] >= \
+        rows[0]["read_GBps"] + rows[0]["write_GBps"]
