@@ -1,14 +1,16 @@
 from .comm import (all_gather, all_reduce, all_to_all, axis_index, axis_size, barrier,
-                   broadcast, broadcast_host, configure, get_rank, get_telemetry,
-                   get_world_size, init_distributed, is_initialized, ppermute,
-                   reduce_scatter, ring_shift)
+                   broadcast, broadcast_host, configure, gather, get_rank,
+                   get_telemetry, get_world_size, inference_all_reduce,
+                   init_distributed, is_initialized, monitored_barrier, ppermute,
+                   reduce_scatter, ring_shift, scatter, send_recv)
 from .mesh import (BATCH_AXES, MESH_AXES, ZERO_AXES, MeshManager, get_mesh, init_mesh,
                    set_mesh)
 
 __all__ = [
     "all_gather", "all_reduce", "all_to_all", "axis_index", "axis_size", "barrier",
-    "broadcast", "broadcast_host", "configure", "get_rank", "get_telemetry",
-    "get_world_size", "init_distributed", "is_initialized", "ppermute",
-    "reduce_scatter", "ring_shift", "BATCH_AXES", "MESH_AXES", "ZERO_AXES",
+    "broadcast", "broadcast_host", "configure", "gather", "get_rank",
+    "get_telemetry", "get_world_size", "inference_all_reduce", "init_distributed",
+    "is_initialized", "monitored_barrier", "ppermute", "reduce_scatter",
+    "ring_shift", "scatter", "send_recv", "BATCH_AXES", "MESH_AXES", "ZERO_AXES",
     "MeshManager", "get_mesh", "init_mesh", "set_mesh",
 ]

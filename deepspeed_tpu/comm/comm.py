@@ -144,6 +144,71 @@ def broadcast(x, axis: AxisName, src_index: int = 0):
     return full[src_index]
 
 
+def send_recv(x, axis: AxisName, src: int, dst: int):
+    """Single point-to-point transfer (reference ``dist.send/recv``): every
+    member passes its value; the ``dst`` member receives ``src``'s value,
+    all others receive zeros (collective semantics of p2p under SPMD)."""
+    _telemetry.record("send_recv", axis, x)
+    return lax.ppermute(x, axis, perm=[(src, dst)])
+
+
+def gather(x, axis: AxisName, dst: int = 0):
+    """Gather all shards to the ``dst`` member, zeros elsewhere (reference
+    ``dist.gather``). Under SPMD every member computes the gather; masking
+    keeps only the root's copy live so XLA can DCE the rest."""
+    _telemetry.record("gather", axis, x)
+    full = lax.all_gather(x, axis, axis=0, tiled=False)
+    keep = lax.axis_index(axis) == dst
+    return jnp.where(keep, full, jnp.zeros_like(full))
+
+
+def scatter(x, axis: AxisName, src: int = 0):
+    """Scatter the ``src`` member's leading-dim chunks over the axis
+    (reference ``dist.scatter``). x: [axis_size, ...] on src."""
+    _telemetry.record("scatter", axis, x)
+    from_src = broadcast(x, axis, src_index=src)
+    return from_src[lax.axis_index(axis)]
+
+
+def inference_all_reduce(x, axis: AxisName = "tensor"):
+    """TP-forward allreduce (reference ``dist.inference_all_reduce`` — same
+    wire op, separate name so comm logs can distinguish serving traffic)."""
+    _telemetry.record("inference_all_reduce", axis, x)
+    return lax.psum(x, axis)
+
+
+def monitored_barrier(name: str = "dstpu_barrier", timeout: Optional[float] = None):
+    """Reference ``dist.monitored_barrier``: a barrier that DETECTS stragglers
+    — raises within ``timeout`` seconds if the barrier does not complete
+    (e.g. a dead host), instead of hanging forever."""
+    import threading as _threading
+    import time as _time
+
+    t0 = _time.perf_counter()
+    if timeout is None:
+        barrier(name)
+        return _time.perf_counter() - t0
+    err: list = []
+    done = _threading.Event()
+
+    def _run():
+        try:
+            barrier(name)
+        except Exception as e:  # surfaced below
+            err.append(e)
+        finally:
+            done.set()
+
+    t = _threading.Thread(target=_run, daemon=True, name=f"barrier:{name}")
+    t.start()
+    if not done.wait(timeout):
+        raise RuntimeError(f"monitored_barrier '{name}' timed out after "
+                           f"{timeout}s — straggler or dead process")
+    if err:
+        raise err[0]
+    return _time.perf_counter() - t0
+
+
 def axis_index(axis: AxisName):
     return lax.axis_index(axis)
 

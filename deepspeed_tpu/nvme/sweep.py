@@ -20,7 +20,10 @@ from ..ops.aio.handle import AIOHandle
 
 
 def _drop_cache(path: str) -> None:
-    """Evict the file from the page cache so reads hit the device."""
+    """Evict the file from the page cache so reads hit the device (no-op on
+    platforms without posix_fadvise — results there measure the cache)."""
+    if not hasattr(os, "posix_fadvise"):
+        return
     fd = os.open(path, os.O_RDONLY)
     try:
         os.posix_fadvise(fd, 0, 0, os.POSIX_FADV_DONTNEED)
@@ -38,16 +41,19 @@ def _bench_one(path: str, nbytes: int, block_size: int, num_threads: int,
     for _ in range(trials):
         # write timing includes fsync so the page cache can't absorb it
         t0 = time.perf_counter()
-        assert h.write(buf, path) == 0
+        if h.write(buf, path) != 0:
+            raise RuntimeError(f"aio write to {path} reported failures")
         fd = os.open(path, os.O_WRONLY)
         os.fsync(fd)
         os.close(fd)
         wt.append(time.perf_counter() - t0)
         _drop_cache(path)  # reads must come from the device, not RAM
         t0 = time.perf_counter()
-        assert h.read(out, path) == 0
+        if h.read(out, path) != 0:
+            raise RuntimeError(f"aio read from {path} reported failures")
         rt.append(time.perf_counter() - t0)
-    assert (out == buf).all()
+    if not (out == buf).all():
+        raise RuntimeError("readback verification failed — corrupted I/O path")
     return {"write_GBps": nbytes / min(wt) / 1e9,
             "read_GBps": nbytes / min(rt) / 1e9}
 
@@ -57,7 +63,9 @@ def io_sweep(directory: Optional[str] = None, nbytes: int = 64 << 20,
              thread_counts=(1, 4, 8), trials: int = 3) -> List[Dict]:
     """Sweep → list of result rows, best configuration last."""
     directory = directory or tempfile.gettempdir()
-    path = os.path.join(directory, "dstpu_io_sweep.bin")
+    fd, path = tempfile.mkstemp(prefix="dstpu_io_sweep_", suffix=".bin",
+                                dir=directory)
+    os.close(fd)
     rows = []
     try:
         for bs in block_sizes:

@@ -20,6 +20,7 @@ checkpoint save/load, monitoring, timers, elasticity.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Iterable, List, NamedTuple, Optional, Tuple
@@ -200,9 +201,9 @@ class DeepSpeedTPUEngine:
         # --- curriculum learning (reference engine hooks :395-408 wire the
         # curriculum scheduler into the forward prologue) ---
         self.curriculum_scheduler = None
-        cl = (config.data_efficiency or {}).get("data_sampling", {}) \
-            .get("curriculum_learning", config.data_efficiency.get(
-                "curriculum_learning", {})) if config.data_efficiency else {}
+        de = config.data_efficiency or {}
+        cl = de.get("data_sampling", {}).get("curriculum_learning") or \
+            de.get("curriculum_learning", {})
         if cl.get("enabled"):
             from .data_pipeline import CurriculumScheduler
 
@@ -491,6 +492,48 @@ class DeepSpeedTPUEngine:
 
     def __call__(self, batch):
         return self.forward(batch)
+
+    # ------------------------------------------------------------------ #
+    # compile / no_sync (reference engine.compile :4444, no_sync :2518)
+    # ------------------------------------------------------------------ #
+    def compile(self, example_batch=None, backend: Optional[str] = None,
+                **kw) -> "DeepSpeedTPUEngine":
+        """Reference ``engine.compile()`` enables torch.compile + DeepCompile
+        graph passes; here the train step is ALREADY one compiled XLA program,
+        so compile() AOT-lowers it for the example batch shape (warms the
+        cache so the first train_batch doesn't pay compile latency) and logs
+        the compiler's cost analysis."""
+        if self._train_step is None:
+            self._build_train_step()
+        if example_batch is not None:
+            if self.curriculum_scheduler is not None:
+                # warm the shape train_batch will actually run first
+                example_batch = self.curriculum_scheduler.truncate(
+                    example_batch, self.global_steps)
+            batch = self._shard_batch(example_batch, with_gas_dim=True)
+            lowered = self._train_step.lower(self.state, batch)
+            compiled = lowered.compile()
+            cost = compiled.cost_analysis() or {}
+            if isinstance(cost, (list, tuple)):
+                cost = cost[0] if cost else {}
+            log_dist(f"engine.compile: AOT-compiled train step "
+                     f"(flops={cost.get('flops', 0):.3e}, "
+                     f"bytes={cost.get('bytes accessed', 0):.3e})")
+        self._is_compiled = True
+        return self
+
+    @property
+    def is_compiled(self) -> bool:
+        return getattr(self, "_is_compiled", False) or self._train_step is not None
+
+    @contextlib.contextmanager
+    def no_sync(self):
+        """Reference ``no_sync`` (:2518) disables grad allreduce between
+        accumulation steps. Here accumulation is already local —
+        forward/backward stage grads without collectives, which only fire in
+        the fused step at the boundary — so this is a semantic no-op provided
+        for API parity."""
+        yield
 
     # ------------------------------------------------------------------ #
     # dataloader (deepspeed_io parity, runtime/engine.py:2147)

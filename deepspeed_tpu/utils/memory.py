@@ -26,8 +26,14 @@ def memory_stats(device: Optional[jax.Device] = None) -> Dict[str, float]:
 
 
 def see_memory_usage(message: str, force: bool = False) -> Dict[str, float]:
-    """Log current/peak device memory (reference ``see_memory_usage``)."""
+    """Log current/peak device memory (reference ``see_memory_usage``):
+    silent unless ``force=True`` (or DSTPU_MEMORY_BREAKDOWN=1), matching the
+    reference's default-off behavior so per-step call sites don't spam."""
+    import os
+
     s = memory_stats()
+    if not (force or os.environ.get("DSTPU_MEMORY_BREAKDOWN")):
+        return s
     if s:
         log_dist(f"{message} | MA {s['in_use_GB']:.2f} GB  "
                  f"Max_MA {s['peak_GB']:.2f} GB  "

@@ -184,3 +184,23 @@ def test_zero1_opt_state_sharded_params_replicated(devices8):
     assert wq.addressable_shards[0].data.size == wq.size  # replicated
     mu = engine.state.opt_state.mu["layers"]["wq"]
     assert mu.addressable_shards[0].data.size == mu.size // 8  # sharded
+
+
+def test_engine_compile_and_no_sync(devices8):
+    """engine.compile() AOT-warms the train step (reference engine.compile
+    :4444); no_sync() is the API-parity context (accumulation is local)."""
+    config = {
+        "train_batch_size": 8,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+        "steps_per_print": 0,
+    }
+    mcfg = llama.LlamaConfig.tiny()
+    spec = llama.model_spec(mcfg, compute_dtype=jnp.float32)
+    engine, _, _, _ = dst.initialize(model=spec, config=config)
+    batch = _data(mcfg, 8)
+    engine.compile(example_batch=batch)
+    assert engine.is_compiled
+    with engine.no_sync():
+        out = engine.train_batch(batch)
+    assert np.isfinite(float(out.loss))
+    assert engine.global_steps == 1
