@@ -1,4 +1,6 @@
 from .compress import (fake_quantize, init_compression,  # noqa: F401
                        layer_reduction, magnitude_prune, head_prune,
                        row_prune, quantize_weights_ptq)
+from .distillation import (distillation_loss, hidden_state_loss,  # noqa: F401
+                           make_distill_loss_fn)
 from .scheduler import CompressionScheduler  # noqa: F401

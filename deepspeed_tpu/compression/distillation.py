@@ -1,0 +1,72 @@
+"""Knowledge distillation for compression-aware training.
+
+Reference parity: the distillation leg of ``deepspeed/compression``
+(``compress.py`` student init via layer reduction + the KD loss the
+compression tutorial pairs it with, staged by ``scheduler.py``). The student
+comes from :func:`compression.layer_reduction`; this module supplies the loss:
+soft-target KL at temperature T mixed with the hard-label loss, plus an
+optional hidden-state matching term — all pure functions that jit into the
+student's train step.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def distillation_loss(student_logits: jnp.ndarray,
+                      teacher_logits: jnp.ndarray,
+                      labels: Optional[jnp.ndarray] = None,
+                      *, temperature: float = 2.0,
+                      alpha: float = 0.5) -> Dict[str, jnp.ndarray]:
+    """loss = alpha·hard_CE + (1-alpha)·T²·KL(student_T || teacher_T).
+
+    logits [..., vocab]; labels [...] with -100 = ignore. Returns dict with
+    'loss', 'kd_loss', 'hard_loss'."""
+    t = temperature
+    s_log = jax.nn.log_softmax(student_logits.astype(jnp.float32) / t, axis=-1)
+    t_prob = jax.nn.softmax(teacher_logits.astype(jnp.float32) / t, axis=-1)
+    kd = jnp.sum(t_prob * (jnp.log(jnp.maximum(t_prob, 1e-10)) - s_log),
+                 axis=-1)
+    kd_loss = jnp.mean(kd) * (t * t)
+
+    hard_loss = jnp.asarray(0.0)
+    if labels is not None:
+        valid = labels != -100
+        safe = jnp.where(valid, labels, 0)
+        logp = jax.nn.log_softmax(student_logits.astype(jnp.float32), axis=-1)
+        tok = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+        hard_loss = jnp.where(valid, tok, 0.0).sum() / \
+            jnp.maximum(valid.sum(), 1)
+    loss = alpha * hard_loss + (1.0 - alpha) * kd_loss
+    return {"loss": loss, "kd_loss": kd_loss, "hard_loss": hard_loss}
+
+
+def hidden_state_loss(student_h: jnp.ndarray, teacher_h: jnp.ndarray,
+                      projection: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """MSE between (projected) student and teacher hidden states — the
+    layer-matching term used when the student is narrower."""
+    s = student_h if projection is None else student_h @ projection
+    return jnp.mean(jnp.square(s.astype(jnp.float32) -
+                               teacher_h.astype(jnp.float32)))
+
+
+def make_distill_loss_fn(student_apply, teacher_apply, teacher_params,
+                         *, temperature: float = 2.0, alpha: float = 0.5):
+    """Wrap a student apply into an engine-compatible loss_fn. The teacher's
+    params ride as a closure constant (frozen; stop_gradient)."""
+    frozen_teacher = jax.tree.map(jax.lax.stop_gradient, teacher_params)
+
+    def loss_fn(params, batch):
+        tokens = batch["tokens"]
+        inputs, labels = tokens[:, :-1], tokens[:, 1:]
+        s_logits = student_apply(params, inputs)
+        t_logits = teacher_apply(frozen_teacher, inputs)
+        out = distillation_loss(s_logits, t_logits, labels,
+                                temperature=temperature, alpha=alpha)
+        return out["loss"], out
+
+    return loss_fn

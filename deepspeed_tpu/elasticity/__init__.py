@@ -1,2 +1,3 @@
+from .elastic_agent import elastic_train_config, run_elastic  # noqa: F401
 from .elasticity import (compute_elastic_config, ElasticityError,  # noqa: F401
                          get_compatible_chip_counts)

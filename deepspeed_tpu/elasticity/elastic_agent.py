@@ -1,0 +1,65 @@
+"""Elastic training agent: resume-at-different-scale orchestration.
+
+Reference parity: ``deepspeed/elasticity/elastic_agent.py:32 DSElasticAgent``
+(torch-elastic rendezvous; worker failure → re-rendezvous → restart from
+checkpoint). On TPU there is no in-job rendezvous to subclass — scale changes
+arrive as a NEW set of hosts/chips (the resource manager restarts the job),
+so the agent's work is the RESUME protocol:
+
+1. at startup, read the elastic config and the current chip count;
+2. pick the (micro_batch, gas) the elastic math assigns to this scale —
+   the GLOBAL batch is invariant across restarts (``compute_elastic_config``);
+3. load the latest (universal) checkpoint onto the new topology.
+
+``run_elastic`` packages those steps around ``deepspeed_tpu.initialize``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+
+from ..utils.logging import log_dist
+from .elasticity import compute_elastic_config
+
+
+def elastic_train_config(base_config: Dict[str, Any],
+                         n_chips: Optional[int] = None) -> Dict[str, Any]:
+    """Resolve a config's ``elasticity`` block against the CURRENT chip
+    count → concrete micro-batch/GAS entries (invariant global batch)."""
+    ec = base_config.get("elasticity", {})
+    if not ec.get("enabled"):
+        return dict(base_config)
+    n_chips = n_chips if n_chips is not None else len(jax.devices())
+    batch, mb, cfg = compute_elastic_config(ec, target_chips=n_chips,
+                                            return_microbatch=True)
+    out = dict(base_config)
+    out.pop("train_batch_size", None)
+    out["train_micro_batch_size_per_gpu"] = mb
+    out["gradient_accumulation_steps"] = cfg.gradient_accumulation_steps
+    log_dist(f"elastic: {n_chips} chips → global batch {batch} "
+             f"(micro {mb} × gas {cfg.gradient_accumulation_steps} × "
+             f"dp {n_chips})")
+    return out
+
+
+def run_elastic(model_spec, base_config: Dict[str, Any],
+                checkpoint_dir: Optional[str] = None,
+                n_chips: Optional[int] = None, **init_kw) -> Tuple[Any, ...]:
+    """Bring up an engine at the current scale and resume state if a
+    checkpoint exists (reference: elastic agent restart path)."""
+    import deepspeed_tpu as dst
+
+    config = elastic_train_config(base_config, n_chips)
+    engine, opt, loader, sched = dst.initialize(model=model_spec,
+                                                config=config, **init_kw)
+    if checkpoint_dir is not None:
+        try:
+            path, _ = engine.load_checkpoint(checkpoint_dir)
+            if path:
+                log_dist(f"elastic resume from {path} at step "
+                         f"{engine.global_steps}")
+        except FileNotFoundError:
+            log_dist("elastic: no checkpoint yet — fresh start")
+    return engine, opt, loader, sched

@@ -103,21 +103,28 @@ def compute_elastic_config(elastic_config: Dict, target_chips: Optional[int] = N
         raise ElasticityError("no feasible elastic configuration")
 
     # choose the batch size compatible with the MOST chip counts, largest
-    # batch breaking ties (v0.2 behavior)
+    # batch breaking ties (v0.2 behavior); with a target scale, only batches
+    # runnable at that scale are candidates (reference: final batch resolved
+    # for the current world size)
     def score(b):
         chips = {t[0] for t in table[b]}
         return (len(chips), b if prefer_larger else -b)
 
-    best_batch = max(table, key=score)
-    triples = table[best_batch]
+    candidates = table
+    if target_chips is not None:
+        candidates = {b: t for b, t in table.items()
+                      if any(x[0] == target_chips for x in t)}
+        if not candidates:
+            all_chips = sorted({t[0] for ts in table.values() for t in ts})
+            raise ElasticityError(
+                f"{target_chips} chips incompatible with every candidate "
+                f"batch; feasible counts: {all_chips}")
+    best_batch = max(candidates, key=score)
+    triples = candidates[best_batch]
     compatible = sorted({t[0] for t in triples})
     if target_chips is None:
         target_chips = compatible[-1]  # default to the largest feasible scale
     match = [t for t in triples if t[0] == target_chips]
-    if not match:
-        raise ElasticityError(
-            f"{target_chips} chips incompatible with batch {best_batch}; "
-            f"compatible counts: {compatible}")
     # triples are sorted so match[0] respects prefer_larger_batch
     chips, mb, gas = match[0]
     cfg = ElasticConfig(global_batch_size=best_batch, micro_batch_size=mb,

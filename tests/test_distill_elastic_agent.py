@@ -1,0 +1,119 @@
+"""Distillation + elastic-agent tests (reference model: compression KD
+tutorial flow; ``tests/unit/elasticity``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu as dst
+from deepspeed_tpu.compression import (distillation_loss, hidden_state_loss,
+                                       layer_reduction, make_distill_loss_fn)
+from deepspeed_tpu.elasticity import elastic_train_config, run_elastic
+from deepspeed_tpu.models import llama
+
+
+def test_distillation_loss_identical_teacher_student():
+    logits = jax.random.normal(jax.random.PRNGKey(0), (2, 5, 32))
+    labels = jax.random.randint(jax.random.PRNGKey(1), (2, 5), 0, 32)
+    out = distillation_loss(logits, logits, labels, alpha=0.5)
+    assert float(out["kd_loss"]) == pytest.approx(0.0, abs=1e-5)
+    assert float(out["hard_loss"]) > 0
+    # KD increases as student diverges from teacher
+    far = distillation_loss(logits + 3.0 * jax.random.normal(
+        jax.random.PRNGKey(2), logits.shape), logits, labels)
+    assert float(far["kd_loss"]) > 0.01
+
+
+def test_distillation_gradients_ignore_teacher():
+    teacher = jax.random.normal(jax.random.PRNGKey(0), (1, 3, 16))
+
+    def loss(s):
+        return distillation_loss(s, teacher, None, alpha=0.0)["loss"]
+
+    g = jax.grad(loss)(jnp.zeros((1, 3, 16)))
+    assert np.isfinite(np.asarray(g)).all()
+    assert float(jnp.abs(g).max()) > 0
+
+
+def test_hidden_state_loss_projection():
+    s = jnp.ones((2, 4, 8))
+    t = jnp.ones((2, 4, 16))
+    proj = jnp.ones((8, 16)) / 8
+    assert float(hidden_state_loss(s, t, proj)) == pytest.approx(0.0)
+
+
+def test_kd_student_trains_toward_teacher(devices8):
+    """Layer-reduced student + KD loss through the REAL engine."""
+    tcfg = llama.LlamaConfig.tiny(num_layers=2)
+    scfg = llama.LlamaConfig.tiny(num_layers=1)
+    teacher_params = llama.init(tcfg, jax.random.PRNGKey(0))
+    student_init = layer_reduction(teacher_params, [0])
+
+    s_apply = lambda p, t: llama.apply(scfg, p, t, compute_dtype=jnp.float32)  # noqa: E731
+    t_apply = lambda p, t: llama.apply(tcfg, p, t, compute_dtype=jnp.float32)  # noqa: E731
+    loss_fn = make_distill_loss_fn(s_apply, t_apply, teacher_params,
+                                   temperature=2.0, alpha=0.5)
+    from deepspeed_tpu.runtime.engine import ModelSpec
+
+    spec = ModelSpec(loss_fn=loss_fn, params=student_init, name="kd_student",
+                     pipeline_capable=False)
+    engine, *_ = dst.initialize(model=spec, config={
+        "train_batch_size": 8,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+        "steps_per_print": 0})
+    losses = []
+    for i in range(5):
+        t = np.random.RandomState(i).randint(0, tcfg.vocab_size, (8, 17))
+        losses.append(float(engine.train_batch(
+            {"tokens": t.astype(np.int32)}).loss))
+    assert losses[-1] < losses[0]
+
+
+def test_elastic_train_config_resolution(devices8):
+    base = {
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "elasticity": {"enabled": True, "max_train_batch_size": 64,
+                       "micro_batch_sizes": [1, 2, 4], "min_gpus": 1,
+                       "max_gpus": 64},
+    }
+    cfg = elastic_train_config(base, n_chips=8)
+    assert "train_batch_size" not in cfg
+    mb = cfg["train_micro_batch_size_per_gpu"]
+    gas = cfg["gradient_accumulation_steps"]
+    assert mb in (1, 2, 4) and mb * gas * 8 <= 64
+    # same GLOBAL batch at a different scale
+    cfg2 = elastic_train_config(base, n_chips=4)
+    assert mb * gas * 8 == cfg2["train_micro_batch_size_per_gpu"] * \
+        cfg2["gradient_accumulation_steps"] * 4
+    # disabled elasticity passes through untouched
+    assert elastic_train_config({"train_batch_size": 8}) == \
+        {"train_batch_size": 8}
+
+
+def test_run_elastic_resume_roundtrip(devices8, tmp_path):
+    from deepspeed_tpu.comm import mesh as mesh_lib
+
+    cfg = llama.LlamaConfig.tiny()
+    spec = llama.model_spec(cfg, compute_dtype=jnp.float32)
+    base = {
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+        "elasticity": {"enabled": True, "max_train_batch_size": 32,
+                       "micro_batch_sizes": [1, 2], "min_gpus": 1,
+                       "max_gpus": 16},
+        "steps_per_print": 0,
+    }
+    mesh_lib.set_mesh(None)
+    engine, *_ = run_elastic(spec, base, checkpoint_dir=str(tmp_path))
+    t = np.random.RandomState(0).randint(0, cfg.vocab_size, (engine.train_batch_size(), 17))
+    engine.train_batch({"tokens": t.astype(np.int32)})
+    engine.save_checkpoint(str(tmp_path))
+    ref = jax.device_get(engine.state.params["final_norm"])
+
+    # "restart" at the same host scale: fresh engine resumes the state
+    mesh_lib.set_mesh(None)
+    engine2, *_ = run_elastic(spec, base, checkpoint_dir=str(tmp_path),
+                              rng=jax.random.PRNGKey(9))
+    assert engine2.global_steps == 1
+    np.testing.assert_allclose(
+        np.asarray(engine2.state.params["final_norm"]), ref, rtol=1e-6)
