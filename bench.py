@@ -4,24 +4,82 @@
 Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
 
 Baseline (BASELINE.md): the reference's ZeRO-3 north-star is >=45% MFU; we
-report our measured model-flops-utilization against that target. Runs on
-whatever jax.devices() provides (the real TPU chip under the driver; CPU
-elsewhere, where the number is only a smoke signal).
+report our measured model-flops-utilization against that target.
+
+Robustness (VERDICT r1 weak #1): backend bring-up is retried, falls back to
+CPU with an explicit degraded marker, and a JSON line is ALWAYS printed —
+even on failure — so no round ships zero perf evidence.
 """
 
 import json
 import os
 import sys
 import time
+import traceback
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-import jax
-import jax.numpy as jnp
-import numpy as np
+RESULT = {
+    "metric": "llama_zero3_train_mfu",
+    "value": 0.0,
+    "unit": "fraction_of_peak",
+    "vs_baseline": 0.0,
+    "detail": {},
+}
 
 
-def peak_flops_per_chip() -> float:
+def emit(ok: bool, err: str = ""):
+    if err:
+        RESULT["detail"]["error"] = err[-2000:]
+    RESULT["detail"]["ok"] = ok
+    print(json.dumps(RESULT))
+
+
+def init_backend():
+    """Bring up the JAX backend; fall back to CPU (degraded) after retries.
+
+    JAX caches backend init results in-process (a failed TPU probe leaves a
+    CPU-only cache that later jax.devices() calls silently return), so the
+    probe runs in a SUBPROCESS each attempt; jax is only imported here once
+    the probe says the accelerator is up.
+    """
+    import subprocess
+
+    probe = ("import jax; d = jax.devices(); "
+             "print(jax.default_backend(), len(d))")
+    backend = None
+    for attempt in range(5):
+        try:
+            r = subprocess.run([sys.executable, "-c", probe],
+                               capture_output=True, text=True, timeout=300)
+            err = r.stderr[-500:]
+            if r.returncode == 0 and r.stdout.strip():
+                backend, n = r.stdout.strip().split()[-2:]
+                break
+        except subprocess.TimeoutExpired:
+            err = "probe timed out after 300s (tunnel wedged?)"
+        sys.stderr.write(f"backend probe attempt {attempt + 1} failed:\n{err}\n")
+        time.sleep(10 * (attempt + 1))
+    if backend is None:
+        # last resort: CPU, explicitly marked degraded — set BEFORE jax import
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
+        RESULT["detail"]["backend"] = "cpu-degraded"
+        RESULT["detail"]["n_chips"] = len(jax.devices())
+        return jax
+    import jax
+
+    RESULT["detail"]["backend"] = jax.default_backend()
+    RESULT["detail"]["n_chips"] = len(jax.devices())
+    return jax
+
+
+def peak_flops_per_chip(jax) -> float:
     """bf16 peak for the local accelerator."""
     dev = jax.devices()[0]
     kind = getattr(dev, "device_kind", "").lower()
@@ -37,14 +95,19 @@ def peak_flops_per_chip() -> float:
 
 
 def main():
+    jax = init_backend()
+    import jax.numpy as jnp
+    import numpy as np
+
     import deepspeed_tpu as dst
     from deepspeed_tpu.models import llama
 
-    on_tpu = jax.default_backend() == "tpu"
+    on_tpu = "tpu" in RESULT["detail"].get("backend", "")
     if on_tpu:
+        # 235M-param Llama (head_dim=128: MXU-native; hd=64 costs ~25% MFU)
         mcfg = llama.LlamaConfig(
             vocab_size=32000, hidden_size=1024, intermediate_size=3584,
-            num_layers=12, num_heads=16, num_kv_heads=8, max_seq_len=2048,
+            num_layers=12, num_heads=8, num_kv_heads=4, max_seq_len=2048,
             rope_theta=500000.0, remat=True)
         batch, seqlen, steps, warmup = 8, 2048, 20, 3
     else:
@@ -59,18 +122,22 @@ def main():
         "gradient_clipping": 1.0,
         "steps_per_print": 0,
     }
+    sys.stderr.write(f"[bench] t={time.perf_counter():.0f} building engine\n")
     spec = llama.model_spec(mcfg, compute_dtype=jnp.bfloat16)
     engine, _, _, _ = dst.initialize(model=spec, config=config)
 
     rng = np.random.default_rng(0)
+
     def make_batch(i):
         return {"tokens": rng.integers(0, mcfg.vocab_size,
                                        (engine.train_batch_size(), seqlen + 1),
                                        dtype=np.int32)}
 
+    sys.stderr.write(f"[bench] t={time.perf_counter():.0f} engine ready, warmup\n")
     for i in range(warmup):
         out = engine.train_batch(make_batch(i))
         float(out.loss)  # host sync (block_until_ready is a no-op on axon)
+        sys.stderr.write(f"[bench] t={time.perf_counter():.0f} warmup {i} done loss={float(out.loss):.3f}\n")
 
     t0 = time.perf_counter()
     for i in range(steps):
@@ -85,25 +152,24 @@ def main():
     n_params = mcfg.num_params
     attn_flops_per_token = 12 * mcfg.num_layers * mcfg.hidden_size * seqlen
     flops_per_token = 6 * n_params + attn_flops_per_token
-    mfu = tokens_per_sec_per_chip * flops_per_token / peak_flops_per_chip()
+    mfu = tokens_per_sec_per_chip * flops_per_token / peak_flops_per_chip(jax)
 
-    print(json.dumps({
-        "metric": "llama_zero3_train_mfu",
-        "value": round(mfu, 4),
-        "unit": "fraction_of_peak",
-        "vs_baseline": round(mfu / 0.45, 4),
-        "detail": {
-            "tokens_per_sec_per_chip": round(tokens_per_sec_per_chip, 1),
-            "step_time_s": round(dt / steps, 4),
-            "params": n_params,
-            "batch": engine.train_batch_size(),
-            "seqlen": seqlen,
-            "n_chips": n_chips,
-            "backend": jax.default_backend(),
-            "final_loss": final_loss,
-        },
-    }))
+    RESULT["value"] = round(mfu, 4)
+    RESULT["vs_baseline"] = round(mfu / 0.45, 4)
+    RESULT["detail"].update({
+        "tokens_per_sec_per_chip": round(tokens_per_sec_per_chip, 1),
+        "step_time_s": round(dt / steps, 4),
+        "params": n_params,
+        "batch": engine.train_batch_size(),
+        "seqlen": seqlen,
+        "final_loss": final_loss,
+    })
+    emit(ok=True)
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except Exception:
+        emit(ok=False, err=traceback.format_exc())
+        sys.exit(0)  # the JSON line IS the report; never rc!=0 without one

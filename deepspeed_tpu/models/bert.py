@@ -19,6 +19,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..ops.attention import attention
+from ..ops.embedding import embedding_lookup
 from ..ops.norms import layer_norm
 
 Params = Dict[str, Any]
@@ -131,7 +132,9 @@ def apply(cfg: BertConfig, params: Params, tokens: jnp.ndarray, *,
     b, s = tokens.shape
     if token_types is None:
         token_types = jnp.zeros_like(tokens)
-    x = (params["embed"][tokens] + params["pos_embed"][jnp.arange(s)][None]
+    # embeddings + LN deliberately fp32 (BERT embed-LN precision); the cast
+    # to compute dtype happens after the norm below
+    x = (embedding_lookup(params["embed"], tokens, jnp.float32) + params["pos_embed"][jnp.arange(s)][None]
          + params["type_embed"][token_types])
     x = layer_norm(x, params["embed_ln_scale"], params["embed_ln_bias"],
                    cfg.layer_norm_eps).astype(compute_dtype)

@@ -21,6 +21,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..ops.attention import attention
+from ..ops.embedding import embedding_lookup
 from ..ops.norms import layer_norm
 
 Params = Dict[str, Any]
@@ -193,7 +194,7 @@ def apply(cfg: GPTConfig, params: Params, tokens: jnp.ndarray, *,
     b, t = tokens.shape
     if positions is None:
         positions = jnp.arange(t)[None, :]
-    x = (params["embed"][tokens] + params["pos_embed"][positions]) \
+    x = (embedding_lookup(params["embed"], tokens, compute_dtype) + params["pos_embed"][positions].astype(compute_dtype)) \
         .astype(compute_dtype)
     layers = _cast_layers(params, compute_dtype)
     block = partial(_block, cfg)
@@ -227,7 +228,7 @@ def apply_cached(cfg: GPTConfig, params: Params, tokens: jnp.ndarray,
         cache_len = jnp.broadcast_to(cache_len, (tokens.shape[0],))
     positions = jnp.minimum(cache_len[:, None] + jnp.arange(tokens.shape[1]),
                             cfg.max_seq_len - 1)
-    x = (params["embed"][tokens] + params["pos_embed"][positions]) \
+    x = (embedding_lookup(params["embed"], tokens, compute_dtype) + params["pos_embed"][positions].astype(compute_dtype)) \
         .astype(compute_dtype)
     layers = _cast_layers(params, compute_dtype)
 

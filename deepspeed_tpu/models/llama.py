@@ -30,6 +30,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..ops.attention import attention
+from ..ops.embedding import embedding_lookup
 from ..ops.norms import rms_norm
 from ..ops.rotary import apply_rotary, rope_frequencies
 
@@ -49,6 +50,7 @@ class LlamaConfig:
     rope_theta: float = 500000.0
     rms_norm_eps: float = 1e-5
     tie_embeddings: bool = False
+    attention_bias: bool = False  # QKV biases (Qwen2; HF attention_bias flag)
     remat: bool = False          # jax.checkpoint each block
     remat_policy: str = "none"   # none | full | dots
     attention_impl: str = "auto"  # auto | xla | ulysses | ring
@@ -129,6 +131,10 @@ def init(cfg: LlamaConfig, rng: jax.Array, dtype=jnp.float32) -> Params:
         },
         "final_norm": jnp.ones((h,), dtype),
     }
+    if cfg.attention_bias:
+        params["layers"]["bq"] = jnp.zeros((L, nh * hd), dtype)
+        params["layers"]["bk"] = jnp.zeros((L, nkv * hd), dtype)
+        params["layers"]["bv"] = jnp.zeros((L, nkv * hd), dtype)
     if not cfg.tie_embeddings:
         params["lm_head"] = normal(jax.random.fold_in(rng, 99), (h, v), h)
     return params
@@ -153,6 +159,10 @@ def param_logical_axes(cfg: LlamaConfig) -> Params:
         },
         "final_norm": ("embed",),
     }
+    if cfg.attention_bias:
+        axes["layers"]["bq"] = ("layers", "heads")
+        axes["layers"]["bk"] = ("layers", "kv_heads")
+        axes["layers"]["bv"] = ("layers", "kv_heads")
     if not cfg.tie_embeddings:
         axes["lm_head"] = ("embed", "vocab")
     return axes
@@ -183,6 +193,20 @@ def _resolve_attention(cfg: LlamaConfig, in_pipeline: bool = False):
     return attention
 
 
+def _qkv_proj(cfg: LlamaConfig, y: jnp.ndarray, layer: Params):
+    """QKV projections with optional biases (Qwen2 — the reference's qwen_v2
+    container maps q/k/v biases explicitly)."""
+    b, s, _ = y.shape
+    nh, nkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_size
+    q, k, v = y @ layer["wq"], y @ layer["wk"], y @ layer["wv"]
+    if "bq" in layer:
+        q = q + layer["bq"]
+        k = k + layer["bk"]
+        v = v + layer["bv"]
+    return (q.reshape(b, s, nh, hd), k.reshape(b, s, nkv, hd),
+            v.reshape(b, s, nkv, hd))
+
+
 def _block(cfg: LlamaConfig, x: jnp.ndarray, layer: Params,
            cos: jnp.ndarray, sin: jnp.ndarray,
            positions: Optional[jnp.ndarray],
@@ -192,9 +216,7 @@ def _block(cfg: LlamaConfig, x: jnp.ndarray, layer: Params,
     nh, nkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_size
 
     y = rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps)
-    q = (y @ layer["wq"]).reshape(b, s, nh, hd)
-    k = (y @ layer["wk"]).reshape(b, s, nkv, hd)
-    v = (y @ layer["wv"]).reshape(b, s, nkv, hd)
+    q, k, v = _qkv_proj(cfg, y, layer)
     q = apply_rotary(q, cos, sin, positions)
     k = apply_rotary(k, cos, sin, positions)
     attn_out = attn_fn(q, k, v, causal=True)
@@ -216,7 +238,7 @@ def apply(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray, *,
     ``cfg.remat`` each block is wrapped in ``jax.checkpoint`` so the backward
     pass rematerializes activations (the reference's
     ``runtime/activation_checkpointing``)."""
-    x = params["embed"][tokens].astype(compute_dtype)
+    x = embedding_lookup(params["embed"], tokens, compute_dtype)
     cos, sin = rope_frequencies(cfg.head_size, cfg.max_seq_len, cfg.rope_theta)
 
     layers = jax.tree.map(lambda p: p.astype(compute_dtype)
@@ -300,9 +322,7 @@ def _block_cached(cfg: LlamaConfig, x: jnp.ndarray, layer: Params,
     S = k_cache.shape[1]
 
     y = rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps)
-    q = (y @ layer["wq"]).reshape(b, t, nh, hd)
-    k = (y @ layer["wk"]).reshape(b, t, nkv, hd)
-    v = (y @ layer["wv"]).reshape(b, t, nkv, hd)
+    q, k, v = _qkv_proj(cfg, y, layer)
     q = apply_rotary(q, cos, sin, positions)
     k = apply_rotary(k, cos, sin, positions)
     k_cache = _write_cache(k_cache, k, cache_len)
@@ -332,7 +352,7 @@ def apply_cached(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,
     Returns (logits [b, t, vocab] fp32, updated cache)."""
     if cache_len.ndim == 0:
         cache_len = jnp.broadcast_to(cache_len, (tokens.shape[0],))
-    x = params["embed"][tokens].astype(compute_dtype)
+    x = embedding_lookup(params["embed"], tokens, compute_dtype)
     cos, sin = rope_frequencies(cfg.head_size, cfg.max_seq_len, cfg.rope_theta)
     positions = cache_len[:, None] + jnp.arange(tokens.shape[1])[None, :]
 
@@ -385,9 +405,7 @@ def _block_paged(cfg: LlamaConfig, x: jnp.ndarray, layer: Params,
     max_blocks = block_tables.shape[1]
 
     y = rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps)
-    q = (y @ layer["wq"]).reshape(b, t, nh, hd)
-    k = (y @ layer["wk"]).reshape(b, t, nkv, hd)
-    v = (y @ layer["wv"]).reshape(b, t, nkv, hd)
+    q, k, v = _qkv_proj(cfg, y, layer)
     q = apply_rotary(q, cos, sin, positions)
     k = apply_rotary(k, cos, sin, positions)
 
@@ -430,7 +448,7 @@ def apply_paged(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,
     b, t = tokens.shape
     if valid is None:
         valid = jnp.ones((b, t), bool)
-    x = params["embed"][tokens].astype(compute_dtype)
+    x = embedding_lookup(params["embed"], tokens, compute_dtype)
     cos, sin = rope_frequencies(cfg.head_size, cfg.max_seq_len, cfg.rope_theta)
     positions = context_lens[:, None] + jnp.arange(t)[None, :]
 

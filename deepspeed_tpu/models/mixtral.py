@@ -20,6 +20,7 @@ from jax import lax
 
 from ..moe.layer import MoELayer, init_moe_ffn, moe_ffn_logical_axes
 from ..ops.attention import attention
+from ..ops.embedding import embedding_lookup
 from ..ops.norms import rms_norm
 from ..ops.rotary import apply_rotary, rope_frequencies
 from . import llama as llama_mod
@@ -107,7 +108,7 @@ def param_logical_axes(cfg: MixtralConfig) -> Params:
 def apply(cfg: MixtralConfig, params: Params, tokens: jnp.ndarray, *,
           compute_dtype=jnp.bfloat16) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Forward → (logits [b, s, vocab] fp32, total_aux_loss)."""
-    x = params["embed"][tokens].astype(compute_dtype)
+    x = embedding_lookup(params["embed"], tokens, compute_dtype)
     cos, sin = rope_frequencies(cfg.head_size, cfg.max_seq_len, cfg.rope_theta)
     moe_layer = MoELayer(cfg.num_experts, cfg.top_k, cfg.capacity_factor,
                          cfg.min_capacity, cfg.drop_tokens)
@@ -164,7 +165,7 @@ def apply_cached(cfg: MixtralConfig, params: Params, tokens: jnp.ndarray,
         cache_len = jnp.broadcast_to(cache_len, (tokens.shape[0],))
     b, t = tokens.shape
     nh, nkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_size
-    x = params["embed"][tokens].astype(compute_dtype)
+    x = embedding_lookup(params["embed"], tokens, compute_dtype)
     cos, sin = rope_frequencies(cfg.head_size, cfg.max_seq_len, cfg.rope_theta)
     positions = cache_len[:, None] + jnp.arange(t)[None, :]
     # inference never drops tokens: a dropped decode token would silently

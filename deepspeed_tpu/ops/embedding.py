@@ -1,0 +1,42 @@
+"""Embedding lookup that stays efficient under vocab (tensor-axis) sharding.
+
+A plain gather from a vocab-sharded table forces XLA SPMD into "involuntary
+full rematerialization": it replicates the whole table on every device before
+gathering (spmd_partitioner.cc warning). The TPU-idiomatic fix is to express
+the lookup as a one-hot matmul when the vocab dim is sharded — each device
+contracts its vocab shard and the partial results psum over the tensor axis,
+riding the MXU instead of the replicate-then-gather path.
+
+Reference analog: Megatron/DeepSpeed VocabParallelEmbedding (masked local
+lookup + allreduce); here the mask/allreduce falls out of the sharded
+contraction. Cited for parity: ``module_inject/layers.py:581`` (LinearAllreduce
+— same partial-sum-then-reduce shape).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _vocab_sharded() -> bool:
+    try:
+        from ..comm.mesh import get_mesh
+
+        return get_mesh().tp_world_size > 1
+    except Exception:
+        return False
+
+
+def embedding_lookup(table: jnp.ndarray, tokens: jnp.ndarray,
+                     compute_dtype=jnp.bfloat16) -> jnp.ndarray:
+    """tokens [...] int32 → embeddings [..., hidden] in compute dtype.
+
+    Gather on a single-axis table; one-hot matmul when the table's vocab dim
+    is sharded over the tensor axis (avoids SPMD full-table replication).
+    """
+    if not _vocab_sharded():
+        return table[tokens].astype(compute_dtype)
+    v = table.shape[0]
+    onehot = jax.nn.one_hot(tokens, v, dtype=compute_dtype)
+    return onehot @ table.astype(compute_dtype)

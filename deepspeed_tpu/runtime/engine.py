@@ -141,22 +141,32 @@ class DeepSpeedTPUEngine:
             pipeline_layers=model.pipeline_capable)
         shapes = shapes_of(params)
         if model.logical_axes is not None:
-            param_specs = self.partitioner.param_specs(model.logical_axes, shapes)
-            opt_specs = self.partitioner.opt_state_specs(model.logical_axes, shapes)
+            axes = model.logical_axes
         else:
-            # no metadata: replicate params (ZeRO still shards opt state over
-            # the largest divisible dim of each leaf)
-            generic_axes = jax.tree.map(lambda s: tuple([None] * len(s)), shapes,
-                                        is_leaf=lambda x: isinstance(x, tuple))
-            param_specs = self.partitioner.param_specs(generic_axes, shapes)
-            opt_specs = self.partitioner.opt_state_specs(generic_axes, shapes)
+            # no metadata: replicate params (ZeRO still shards masters/opt
+            # state over the largest divisible dim of each leaf)
+            axes = jax.tree.map(lambda s: tuple([None] * len(s)), shapes,
+                                is_leaf=lambda x: isinstance(x, tuple))
+        # compute-time specs (TP always; +ZeRO at stage 3 — gather-on-use)
+        param_specs = self.partitioner.param_specs(axes, shapes)
+        # gradient specs: reduce-scattered from stage 2 (reference
+        # stage_1_and_2.py:126 grad partitioning)
+        grad_specs = self.partitioner.grad_specs(axes, shapes)
+        # fp32 master + optimizer-state specs: sharded from stage 1
+        # (reference bf16_optimizer.py:36 sharded fp32 masters)
+        opt_specs = self.partitioner.opt_state_specs(axes, shapes)
         self.param_specs = param_specs
+        self.grad_specs = grad_specs
         self.opt_param_specs = opt_specs
+        self._param_shardings = self.partitioner.shardings(param_specs)
+        self._grad_shardings = self.partitioner.shardings(grad_specs)
+        self._master_shardings = self.partitioner.shardings(opt_specs)
 
         with mesh_mgr.activate():
+            # masters live ZeRO-sharded from stage 1 up; the bf16 compute copy
+            # is gathered per step in _loss (cast + sharding constraint)
             params = jax.jit(
-                lambda p: p,
-                out_shardings=self.partitioner.shardings(param_specs))(params)
+                lambda p: p, out_shardings=self._master_shardings)(params)
             opt_state = self._init_opt_state(params)
         loss_scale = make_loss_scaler(config.fp16)
         self.state = TrainState(
@@ -268,6 +278,13 @@ class DeepSpeedTPUEngine:
     # ------------------------------------------------------------------ #
     def _loss(self, params, batch):
         compute_params = self.precision.cast_to_compute(params)
+        # ZeRO stages 1/2: masters are sharded over the ZeRO axes but compute
+        # wants the TP-only layout — this constraint makes XLA all-gather the
+        # low-precision copy (the reference's post-step allgather of updated
+        # partitions, stage_1_and_2.py:2223, moved to gather-on-compute-cast).
+        # At stage 3 the constraint keeps params sharded; XLA gathers at use.
+        compute_params = jax.lax.with_sharding_constraint(
+            compute_params, self._param_shardings)
         out = self.model.loss_fn(compute_params, batch)
         if isinstance(out, tuple):
             loss, aux = out
@@ -283,25 +300,39 @@ class DeepSpeedTPUEngine:
         grads, (loss, aux) = jax.grad(scaled_loss, has_aux=True)(params)
         return grads, loss, aux
 
+    def _constrain_grads(self, grads):
+        """Apply the stage's gradient sharding (reduce-scatter from stage 2 —
+        reference stage_1_and_2.py:126): XLA fuses the implied psum over the
+        data axes with this placement into a reduce-scatter."""
+        return jax.lax.with_sharding_constraint(grads, self._grad_shardings)
+
     def _accumulate(self, params, batch, loss_scale):
         """GAS micro-batch loop under lax.scan; batch leading dim = gas."""
         gas = self.gradient_accumulation_steps()
         if gas == 1:
             grads, loss, aux = self._grads_one_micro(params, batch, loss_scale)
-            return grads, loss, aux
+            return self._constrain_grads(grads), loss, aux
 
         def body(carry, micro):
             acc = carry
             grads, loss, aux = self._grads_one_micro(params, micro, loss_scale)
             acc = jax.tree.map(lambda a, g: a + g.astype(jnp.float32), acc, grads)
-            return acc, loss
+            # keep the accumulator in the stage's grad layout between micros
+            # (stage>=2: sharded — the API-parity path stays O(params/N))
+            return self._constrain_grads(acc), (loss, aux)
 
-        zeros = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
-        acc, losses = jax.lax.scan(body, zeros, batch)
+        zeros = self._constrain_grads(
+            jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params))
+        acc, (losses, auxes) = jax.lax.scan(body, zeros, batch)
         grads = jax.tree.map(lambda g: g / gas, acc)
-        return grads, jnp.mean(losses), {}
+        # aux: mean over micros for floats, last value otherwise (counts etc.)
+        aux = jax.tree.map(
+            lambda a: jnp.mean(a, axis=0) if jnp.issubdtype(a.dtype, jnp.inexact)
+            else a[-1], auxes)
+        return grads, jnp.mean(losses), aux
 
-    def _apply_update(self, state: TrainState, grads, loss) -> Tuple[TrainState, StepOutput]:
+    def _apply_update(self, state: TrainState, grads, loss,
+                      aux=None) -> Tuple[TrainState, StepOutput]:
         cfg = self.config
         finite = grads_finite(grads)
         grads = unscale_grads(grads, state.loss_scale)
@@ -316,6 +347,9 @@ class DeepSpeedTPUEngine:
 
         new_params, new_opt = self.optimizer.update(state.params, grads,
                                                     state.opt_state, lr_scale=lr_scale)
+        # masters keep their ZeRO-sharded layout across the update
+        new_params = jax.lax.with_sharding_constraint(
+            new_params, self._master_shardings)
         # overflow → skip update (reference: FP16 optimizer skip + scale cut)
         new_params = jax.tree.map(
             lambda n, o: jnp.where(finite, n, o), new_params, state.params)
@@ -332,13 +366,13 @@ class DeepSpeedTPUEngine:
         )
         out = StepOutput(loss=loss, grad_norm=grad_norm, lr=lr_t,
                          loss_scale=new_scale.scale,
-                         overflow=jnp.logical_not(finite), aux={})
+                         overflow=jnp.logical_not(finite), aux=aux or {})
         return new_state, out
 
     def _build_train_step(self):
         def step_fn(state: TrainState, batch):
-            grads, loss, _aux = self._accumulate(state.params, batch, state.loss_scale)
-            return self._apply_update(state, grads, loss)
+            grads, loss, aux = self._accumulate(state.params, batch, state.loss_scale)
+            return self._apply_update(state, grads, loss, aux)
 
         with self.mesh_mgr.activate():
             self._train_step = jax.jit(step_fn, donate_argnums=(0,))
@@ -407,9 +441,15 @@ class DeepSpeedTPUEngine:
     def forward(self, batch):
         """Compute loss for one micro-batch (staging it for backward)."""
         if self._grad_step is None:
+            def one_micro(params, b, ls):
+                grads, loss, aux = self._grads_one_micro(params, b, ls)
+                # staged grads live in the stage's (possibly sharded) layout —
+                # the API-parity path must not hold replicated fp32 grads
+                return self._constrain_grads(
+                    jax.tree.map(lambda g: g.astype(jnp.float32), grads)), loss, aux
+
             with self.mesh_mgr.activate():
-                self._grad_step = jax.jit(
-                    lambda params, b, ls: self._grads_one_micro(params, b, ls))
+                self._grad_step = jax.jit(one_micro)
         self._staged_batches.append(self._shard_batch(batch, with_gas_dim=False))
         grads, loss, aux = self._grad_step(self.state.params,
                                            self._staged_batches[-1],

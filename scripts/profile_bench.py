@@ -1,0 +1,143 @@
+#!/usr/bin/env python
+"""Profile the headline bench step to find where time goes.
+
+Times several variants of the train step on the real chip:
+  - full train step (as bench.py)
+  - remat off
+  - forward only / forward+loss
+  - attention impl variants
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _sync(out):
+    # block_until_ready is a no-op under the axon tunnel; a scalar device_get
+    # drains the dispatch queue for real.
+    leaf = jax.tree.leaves(out)[0]
+    float(jnp.sum(leaf.astype(jnp.float32)).ravel()[0] if leaf.ndim else leaf)
+
+
+def timeit(fn, *args, steps=10, warmup=2):
+    for _ in range(warmup):
+        out = fn(*args)
+    _sync(out)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = fn(*args)
+    _sync(out)
+    return (time.perf_counter() - t0) / steps
+
+
+def main():
+    from deepspeed_tpu.models import llama
+
+    remat = os.environ.get("REMAT", "1") == "1"
+    policy = os.environ.get("REMAT_POLICY", "none")
+    batch = int(os.environ.get("BATCH", "8"))
+    seqlen = int(os.environ.get("SEQLEN", "2048"))
+    hidden = int(os.environ.get("HIDDEN", "1024"))
+    layers = int(os.environ.get("LAYERS", "12"))
+    inter = int(os.environ.get("INTER", str(hidden * 7 // 2)))
+    heads = hidden // 64
+
+    mcfg = llama.LlamaConfig(
+        vocab_size=32000, hidden_size=hidden, intermediate_size=inter,
+        num_layers=layers, num_heads=heads, num_kv_heads=heads // 2,
+        max_seq_len=seqlen, rope_theta=500000.0, remat=remat,
+        remat_policy=policy)
+
+    params = llama.init(mcfg, jax.random.PRNGKey(0))
+    params = jax.tree.map(lambda p: p.astype(jnp.float32), params)
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, 32000, (batch, seqlen + 1), dtype=np.int32))
+
+    n_params = mcfg.num_params
+    flops_fwd = 2 * n_params + 4 * mcfg.num_layers * mcfg.hidden_size * seqlen
+    flops_token = 6 * n_params + 12 * mcfg.num_layers * mcfg.hidden_size * seqlen
+    peak = 197e12
+    ntok = batch * seqlen
+
+    # forward only
+    fwd = jax.jit(lambda p, t: llama.apply(mcfg, p, t[:, :-1]))
+    dt = timeit(fwd, params, tokens)
+    print(f"forward-only: {dt*1e3:8.1f} ms  mfu_fwd={ntok*flops_fwd/dt/peak:.3f}")
+
+    # loss fwd
+    lossf = jax.jit(lambda p, t: llama.loss_fn(mcfg, p, {"tokens": t})[0])
+    dt = timeit(lossf, params, tokens)
+    print(f"fwd+loss:     {dt*1e3:8.1f} ms  mfu_fwd={ntok*flops_fwd/dt/peak:.3f}")
+
+    # grad step
+    gradf = jax.jit(lambda p, t: jax.grad(
+        lambda pp: llama.loss_fn(mcfg, pp, {"tokens": t})[0])(p))
+    dt = timeit(gradf, params, tokens)
+    print(f"fwd+bwd:      {dt*1e3:8.1f} ms  mfu={ntok*flops_token/dt/peak:.3f}")
+
+
+def components():
+    """Component-level timings: matmul ceiling, attention impls, mlp."""
+    from deepspeed_tpu.ops.attention import attention
+    from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+
+    batch = int(os.environ.get("BATCH", "8"))
+    seqlen = int(os.environ.get("SEQLEN", "2048"))
+    hidden = int(os.environ.get("HIDDEN", "1024"))
+    heads = hidden // 64
+    peak = 197e12
+    key = jax.random.PRNGKey(0)
+
+    # pure matmul ceiling at model shapes
+    M = batch * seqlen
+    for K, N in [(hidden, hidden), (hidden, 4 * hidden), (4096, 4096)]:
+        a = jax.random.normal(key, (M, K), jnp.bfloat16)
+        b = jax.random.normal(key, (K, N), jnp.bfloat16)
+        f = jax.jit(lambda a, b: a @ b)
+        dt = timeit(f, a, b, steps=20)
+        print(f"matmul [{M}x{K}]@[{K}x{N}]: {dt*1e3:7.2f} ms  mfu={2*M*K*N/dt/peak:.3f}")
+
+    # attention at bench shapes
+    q = jax.random.normal(key, (batch, seqlen, heads, 64), jnp.bfloat16)
+    kv = jax.random.normal(key, (batch, seqlen, heads // 2, 64), jnp.bfloat16)
+    attn_flops = 4 * batch * seqlen * seqlen * heads * 64 / 2  # causal half
+    for name, fn in [("flash", lambda q, k, v: flash_attention(q, k, v, causal=True)),
+                     ("auto", lambda q, k, v: attention(q, k, v, causal=True))]:
+        f = jax.jit(fn)
+        try:
+            dt = timeit(f, q, kv, kv, steps=20)
+            print(f"attn[{name}]: {dt*1e3:7.2f} ms  mfu={attn_flops/dt/peak:.3f}")
+        except Exception as e:
+            print(f"attn[{name}]: FAIL {type(e).__name__}: {e}")
+
+    # embedding + loss head at bench shapes
+    emb = jax.random.normal(key, (32000, hidden), jnp.float32)
+    toks = jnp.zeros((batch, seqlen), jnp.int32)
+    f = jax.jit(lambda e, t: e[t].astype(jnp.bfloat16))
+    dt = timeit(f, emb, toks, steps=20)
+    print(f"embed gather: {dt*1e3:7.2f} ms")
+
+    x = jax.random.normal(key, (batch, seqlen, hidden), jnp.bfloat16)
+    head = jax.random.normal(key, (hidden, 32000), jnp.float32)
+    labels = jnp.zeros((batch, seqlen), jnp.int32)
+
+    def head_loss(x, head, labels):
+        logits = (x @ head.astype(jnp.bfloat16)).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return -jnp.take_along_axis(logp, labels[..., None], axis=-1).mean()
+
+    f = jax.jit(head_loss)
+    dt = timeit(f, x, head, labels, steps=20)
+    print(f"head+loss fp32 softmax: {dt*1e3:7.2f} ms  (matmul share mfu={2*M*hidden*32000/dt/peak:.3f})")
+
+
+if __name__ == "__main__":
+    main()
+    components()

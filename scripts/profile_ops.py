@@ -1,0 +1,94 @@
+#!/usr/bin/env python
+"""Amortized op microbenchmarks: chain N iterations inside one jit so the
+per-dispatch tunnel overhead doesn't pollute the numbers."""
+
+import os
+import sys
+import time
+from functools import partial
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+PEAK = 197e12
+REPS = 20
+
+
+def chain_bench(op, args, flops, steps=5, warmup=2):
+    """op(*args) -> out; runs REPS data-dependent iterations inside one jit."""
+
+    def chained(*args):
+        def body(carry, _):
+            out = op(*args[:-1], carry)
+            # fold output back into the last arg slot (same shape assumed)
+            return out, ()
+
+        out, _ = lax.scan(body, args[-1], None, length=REPS)
+        return out
+
+    f = jax.jit(chained)
+    for _ in range(warmup):
+        out = f(*args)
+    float(jnp.sum(out.astype(jnp.float32)))
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = f(*args)
+    float(jnp.sum(out.astype(jnp.float32)))
+    dt = (time.perf_counter() - t0) / (steps * REPS)
+    return dt, flops / dt / PEAK
+
+
+def main():
+    key = jax.random.PRNGKey(0)
+    batch, seqlen, hidden = 8, 2048, 1024
+    heads = hidden // 64
+    M = batch * seqlen
+
+    # matmul ceiling: out shape must match chained arg; use square-ish
+    for K, N in [(1024, 1024), (2048, 2048), (4096, 4096), (8192, 8192)]:
+        a = jax.random.normal(key, (M, K), jnp.bfloat16)
+        b = jax.random.normal(key, (K, N), jnp.bfloat16)
+        # chain on `a` only if N == K
+        if N == K:
+            dt, mfu = chain_bench(lambda b, a: (a @ b)[:, :K], (b, a), 2 * M * K * N)
+            print(f"matmul [{M}x{K}]@[{K}x{N}]: {dt*1e3:7.3f} ms  mfu={mfu:.3f}")
+
+    # attention: chain on q (same shape as out)
+    from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+
+    for s in (1024, 2048, 4096):
+        q = jax.random.normal(key, (batch, s, heads, 64), jnp.bfloat16)
+        kv = jax.random.normal(key, (batch, s, heads // 2, 64), jnp.bfloat16)
+        attn_flops = 4 * batch * s * s * heads * 64 / 2
+
+        def attn_op(k, v, q):
+            return flash_attention(q, k, v, causal=True).reshape(q.shape)
+
+        dt, mfu = chain_bench(attn_op, (kv, kv, q), attn_flops)
+        print(f"flash[s={s}]: {dt*1e3:7.3f} ms  mfu={mfu:.3f}")
+
+    # xla attention reference
+    def xla_attn(k, v, q):
+        b, s, nh, hd = q.shape
+        nkv = k.shape[2]
+        qr = q.reshape(b, s, nkv, nh // nkv, hd)
+        logits = jnp.einsum("bskgh,btkh->bkgst", qr, k) / (hd ** 0.5)
+        mask = jnp.tril(jnp.ones((s, s), bool))
+        logits = jnp.where(mask[None, None, None], logits.astype(jnp.float32), -1e30)
+        probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+        out = jnp.einsum("bkgst,btkh->bskgh", probs, v)
+        return out.reshape(q.shape)
+
+    s = 2048
+    q = jax.random.normal(key, (batch, s, heads, 64), jnp.bfloat16)
+    kv = jax.random.normal(key, (batch, s, heads // 2, 64), jnp.bfloat16)
+    attn_flops = 4 * batch * s * s * heads * 64 / 2
+    dt, mfu = chain_bench(xla_attn, (kv, kv, q), attn_flops)
+    print(f"xla_attn[s={s}]: {dt*1e3:7.3f} ms  mfu={mfu:.3f}")
+
+
+if __name__ == "__main__":
+    main()

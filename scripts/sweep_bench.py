@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""Sweep bench-model configs for the best honest MFU point on one v5e chip."""
+
+import os
+import sys
+import time
+from functools import partial
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def sync(x):
+    float(jnp.sum(jax.tree.leaves(x)[0].astype(jnp.float32)))
+
+
+def run(name, hidden, layers, inter, heads, kv, batch, seq, remat, tied,
+        policy="none", steps=6, warmup=2, vocab=32000):
+    from deepspeed_tpu.models import llama
+
+    mcfg = llama.LlamaConfig(
+        vocab_size=vocab, hidden_size=hidden, intermediate_size=inter,
+        num_layers=layers, num_heads=heads, num_kv_heads=kv,
+        head_dim=hidden // heads if hidden // heads in (64, 128) else 128,
+        max_seq_len=seq, rope_theta=500000.0, remat=remat, remat_policy=policy,
+        tie_embeddings=tied)
+    params = llama.init(mcfg, jax.random.PRNGKey(0))
+    opt_mu = jax.tree.map(jnp.zeros_like, params)
+    opt_nu = jax.tree.map(jnp.zeros_like, params)
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, vocab, (batch, seq + 1), dtype=np.int32))
+
+    @partial(jax.jit, donate_argnums=(0, 1, 2))
+    def step(params, mu, nu, tokens):
+        loss, grads = jax.value_and_grad(
+            lambda p: llama.loss_fn(mcfg, p, {"tokens": tokens})[0])(params)
+        mu = jax.tree.map(lambda m, g: 0.9 * m + 0.1 * g, mu, grads)
+        nu = jax.tree.map(lambda n, g: 0.99 * n + 0.01 * g * g, nu, grads)
+        params = jax.tree.map(
+            lambda p, m, n: p - 1e-4 * m / (jnp.sqrt(n) + 1e-8), params, mu, nu)
+        return params, mu, nu, loss
+
+    try:
+        for _ in range(warmup):
+            params, opt_mu, opt_nu, loss = step(params, opt_mu, opt_nu, tokens)
+        sync(loss)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            params, opt_mu, opt_nu, loss = step(params, opt_mu, opt_nu, tokens)
+        sync(loss)
+        dt = (time.perf_counter() - t0) / steps
+    except Exception as e:
+        print(f"{name}: FAIL {type(e).__name__}: {str(e)[:120]}")
+        return
+    n_params = mcfg.num_params
+    ntok = batch * seq
+    fpt = 6 * n_params + 12 * layers * hidden * seq
+    mfu = ntok * fpt / dt / 197e12
+    print(f"{name}: {dt*1e3:7.1f} ms/step  params={n_params/1e6:.0f}M  "
+          f"tok/s={ntok/dt:,.0f}  MFU={mfu:.3f}")
+
+
+if __name__ == "__main__":
+    which = sys.argv[1] if len(sys.argv) > 1 else "all"
+    cfgs = {
+        "r1-base":   dict(hidden=1024, layers=12, inter=3584, heads=16, kv=8,
+                          batch=8, seq=2048, remat=True, tied=False),
+        "r1-hd128":  dict(hidden=1024, layers=12, inter=3584, heads=8, kv=4,
+                          batch=8, seq=2048, remat=True, tied=False),
+        "h2048-L8-rm": dict(hidden=2048, layers=8, inter=8192, heads=16, kv=8,
+                            batch=8, seq=2048, remat=True, tied=True),
+        "h2048-L8-b4": dict(hidden=2048, layers=8, inter=8192, heads=16, kv=8,
+                            batch=4, seq=2048, remat=False, tied=True),
+        "h1536-L12": dict(hidden=1536, layers=12, inter=6144, heads=12, kv=6,
+                          batch=8, seq=2048, remat=True, tied=False),
+        "r1-hd128-b16": dict(hidden=1024, layers=12, inter=3584, heads=8, kv=4,
+                          batch=16, seq=2048, remat=True, tied=False),
+        "h2048-L6-b8": dict(hidden=2048, layers=6, inter=8192, heads=16, kv=8,
+                            batch=8, seq=2048, remat=False, tied=True),
+    }
+    for name, cfg in cfgs.items():
+        if which in ("all", name):
+            run(name, **cfg)

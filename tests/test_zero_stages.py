@@ -1,0 +1,124 @@
+"""ZeRO stage semantics: per-stage memory actually shrinks, losses match.
+
+The reference's core ZeRO test pattern (``tests/unit/v1/zero/test_zero.py:95``)
+trains the same model replicated vs each stage and asserts equivalent loss
+trajectories. Round-1 review found stages 1/2 were cosmetic (grad_specs dead,
+masters replicated) — these tests pin the real semantics:
+
+- state bytes/device: stage 0 (replicated masters+opt) > stages 1/2/3 (sharded)
+- transient bytes: stage 2 (reduce-scattered grad accumulator) < stage 1
+  (replicated accumulator) with gas > 1
+- loss trajectories across stages 0/1/2/3 match a replicated fp32 run.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu as dst
+from deepspeed_tpu.models import llama
+
+
+MCFG = llama.LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=128,
+                         num_layers=2, num_heads=4, num_kv_heads=2,
+                         max_seq_len=64, rope_theta=10000.0, use_pipeline=False)
+
+
+def _make_engine(stage, gas=1, batch=16):
+    config = {
+        "train_batch_size": batch,
+        "gradient_accumulation_steps": gas,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": stage},
+        "gradient_clipping": 1.0,
+        "steps_per_print": 0,
+    }
+    spec = llama.model_spec(MCFG, compute_dtype=jnp.float32)
+    engine, _, _, _ = dst.initialize(model=spec, config=config)
+    return engine
+
+
+def _device0_state_bytes(engine):
+    """Bytes of the persistent train state resident on device 0."""
+    total = 0
+    for leaf in jax.tree.leaves((engine.state.params, engine.state.opt_state)):
+        if not hasattr(leaf, "addressable_shards"):
+            continue
+        for shard in leaf.addressable_shards:
+            if shard.device == jax.devices()[0]:
+                total += shard.data.nbytes
+    return total
+
+
+def _batch(step, batch=16, seq=32):
+    rng = np.random.default_rng(1000 + step)
+    return {"tokens": rng.integers(0, MCFG.vocab_size, (batch, seq + 1),
+                                   dtype=np.int32)}
+
+
+def test_state_bytes_shrink_with_stage(devices8):
+    """Masters+opt state: replicated at stage 0, sharded from stage 1
+    (reference bf16_optimizer.py:36 / stage_1_and_2.py:126)."""
+    sizes = {}
+    for stage in (0, 1, 2, 3):
+        engine = _make_engine(stage)
+        sizes[stage] = _device0_state_bytes(engine)
+    # stage 0 replicates everything; stages 1+ shard masters + opt state over
+    # the 8 data devices → near-1/8 the bytes (small norm leaves may stay
+    # replicated, so allow slack)
+    assert sizes[1] < sizes[0] / 4, sizes
+    assert sizes[2] <= sizes[1], sizes
+    assert sizes[3] <= sizes[2], sizes
+
+
+def test_grad_accumulator_sharded_at_stage2(devices8):
+    """With gas>1 the fp32 grad accumulator is a live buffer across the scan:
+    replicated at stage 1, reduce-scattered (1/8) at stage 2."""
+    temps = {}
+    for stage in (1, 2):
+        engine = _make_engine(stage, gas=4, batch=32)
+        engine._build_train_step()
+        batch = engine._shard_batch(_batch(0, batch=32), with_gas_dim=True)
+        compiled = engine._train_step.lower(engine.state, batch).compile()
+        mem = compiled.memory_analysis()
+        temps[stage] = mem.temp_size_in_bytes
+    assert temps[2] < temps[1], temps
+
+
+def test_loss_equivalence_across_stages(devices8):
+    """10-step loss trajectory at each stage matches the replicated run."""
+    trajectories = {}
+    for stage in (0, 1, 2, 3):
+        engine = _make_engine(stage)
+        losses = []
+        for step in range(10):
+            out = engine.train_batch(_batch(step))
+            losses.append(float(out.loss))
+        trajectories[stage] = losses
+    base = np.asarray(trajectories[0])
+    assert base[-1] < base[0], "baseline did not train"
+    for stage in (1, 2, 3):
+        np.testing.assert_allclose(trajectories[stage], base, rtol=2e-4,
+                                   atol=2e-4)
+
+
+def test_loss_equivalence_with_gas(devices8):
+    """Same, with gradient accumulation (gas=2) at stages 0 and 2."""
+    trajectories = {}
+    for stage in (0, 2):
+        engine = _make_engine(stage, gas=2)
+        losses = []
+        for step in range(6):
+            out = engine.train_batch(_batch(step))
+            losses.append(float(out.loss))
+        trajectories[stage] = losses
+    np.testing.assert_allclose(trajectories[2], trajectories[0], rtol=2e-4,
+                               atol=2e-4)
+
+
+def test_aux_preserved_with_gas(devices8):
+    """r1 weak #7: _accumulate dropped aux when gas>1."""
+    engine = _make_engine(0, gas=2)
+    out = engine.train_batch(_batch(0))
+    assert "ntokens" in out.aux and int(out.aux["ntokens"]) > 0
