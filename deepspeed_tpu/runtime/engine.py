@@ -325,10 +325,10 @@ class DeepSpeedTPUEngine:
             jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params))
         acc, (losses, auxes) = jax.lax.scan(body, zeros, batch)
         grads = jax.tree.map(lambda g: g / gas, acc)
-        # aux: mean over micros for floats, last value otherwise (counts etc.)
+        # aux: mean over micros for floats, sum otherwise (token counts etc.)
         aux = jax.tree.map(
             lambda a: jnp.mean(a, axis=0) if jnp.issubdtype(a.dtype, jnp.inexact)
-            else a[-1], auxes)
+            else jnp.sum(a, axis=0), auxes)
         return grads, jnp.mean(losses), aux
 
     def _apply_update(self, state: TrainState, grads, loss,
@@ -366,7 +366,8 @@ class DeepSpeedTPUEngine:
         )
         out = StepOutput(loss=loss, grad_norm=grad_norm, lr=lr_t,
                          loss_scale=new_scale.scale,
-                         overflow=jnp.logical_not(finite), aux=aux or {})
+                         overflow=jnp.logical_not(finite),
+                         aux={} if aux is None else aux)
         return new_state, out
 
     def _build_train_step(self):
