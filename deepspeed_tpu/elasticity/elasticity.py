@@ -22,6 +22,11 @@ class ElasticityError(Exception):
     pass
 
 
+class ElasticityIncompatibleWorldSize(ElasticityError):
+    """Current chip count is not in the chosen batch's compatible list
+    (reference ``elasticity/config.py`` exception of the same name)."""
+
+
 def _candidate_batch_sizes(base_list: Sequence[int], max_batch: int) -> List[int]:
     """All attainable global batch sizes: multiples of each micro-batch
     candidate up to max (reference v0.1 ``get_candidate_batch_sizes``)."""
@@ -102,25 +107,26 @@ def compute_elastic_config(elastic_config: Dict, target_chips: Optional[int] = N
     if not table:
         raise ElasticityError("no feasible elastic configuration")
 
-    # choose the batch size compatible with the MOST chip counts, largest
-    # batch breaking ties (v0.2 behavior); with a target scale, only batches
-    # runnable at that scale are candidates (reference: final batch resolved
-    # for the current world size)
+    # Choose the batch size ONCE, independent of the current scale — that is
+    # the elasticity promise (restart anywhere on the compatible list with an
+    # identical effective batch; reference get_best_candidates). TPU twist on
+    # the score: slices come in power-of-two chip counts, so we rank by how
+    # many power-of-two scales a batch supports (the reference ranks by raw
+    # count, which favours highly-composite batches full of odd GPU counts
+    # that no TPU slice will ever have). Ties break to the larger batch.
     def score(b):
         chips = {t[0] for t in table[b]}
-        return (len(chips), b if prefer_larger else -b)
+        pow2 = sum(1 for c in chips if c & (c - 1) == 0)
+        return (pow2, len(chips), b if prefer_larger else -b)
 
-    candidates = table
-    if target_chips is not None:
-        candidates = {b: t for b, t in table.items()
-                      if any(x[0] == target_chips for x in t)}
-        if not candidates:
-            all_chips = sorted({t[0] for ts in table.values() for t in ts})
-            raise ElasticityError(
-                f"{target_chips} chips incompatible with every candidate "
-                f"batch; feasible counts: {all_chips}")
-    best_batch = max(candidates, key=score)
-    triples = candidates[best_batch]
+    best_batch = max(table, key=score)
+    if target_chips is not None and \
+            not any(t[0] == target_chips for t in table[best_batch]):
+        compatible = sorted({t[0] for t in table[best_batch]})
+        raise ElasticityIncompatibleWorldSize(
+            f"{target_chips} chips incompatible with elastic batch "
+            f"{best_batch}; compatible counts: {compatible}")
+    triples = table[best_batch]
     compatible = sorted({t[0] for t in triples})
     if target_chips is None:
         target_chips = compatible[-1]  # default to the largest feasible scale

@@ -215,6 +215,14 @@ def build_commands(args) -> Tuple[MultiNodeRunner, List[List[str]]]:
     hosts = parse_inclusion_exclusion(hosts, args.include, args.exclude)
     if args.num_nodes > 0:
         hosts = dict(list(hosts.items())[:args.num_nodes])
+    if len(hosts) > 1 and args.launcher == "local":
+        # ADVICE r1: silently falling back to one local process while
+        # node_env still advertises len(hosts) peers makes
+        # jax.distributed.initialize hang forever waiting for the others
+        raise ValueError(
+            f"hostfile resolves {len(hosts)} hosts but --launcher local runs "
+            f"a single process; pick --launcher ssh/slurm/mpi or restrict "
+            f"with --include/--num_nodes 1")
     multi = (len(hosts) > 1 or args.force_multi) and args.launcher != "local"
     runner_cls = RUNNERS[args.launcher if multi else "local"]
     runner = runner_cls(args, hosts)

@@ -73,6 +73,9 @@ def llama_config_from_hf(hf_config) -> "Any":
         rope_theta=float(getattr(hf_config, "rope_theta", 10000.0)),
         rms_norm_eps=float(getattr(hf_config, "rms_norm_eps", 1e-5)),
         tie_embeddings=bool(getattr(hf_config, "tie_word_embeddings", False)),
+        # Qwen2 always uses QKV biases; Llama exposes an attention_bias flag
+        attention_bias=bool(getattr(hf_config, "attention_bias",
+                                    hf_config.model_type == "qwen2")),
     )
 
 
@@ -102,8 +105,18 @@ def llama_params_from_hf(src, cfg=None) -> Params:
     }
     if "lm_head.weight" in sd:
         params["lm_head"] = sd["lm_head.weight"].T
+    has_bias = (lay.format(i=0) + "self_attn.q_proj.bias") in sd
+    if has_bias:
+        # Qwen2 QKV biases (ADVICE r1: these were silently dropped)
+        params["layers"]["bq"] = _stack(sd, lay + "self_attn.q_proj.bias", L)
+        params["layers"]["bk"] = _stack(sd, lay + "self_attn.k_proj.bias", L)
+        params["layers"]["bv"] = _stack(sd, lay + "self_attn.v_proj.bias", L)
+    if cfg is not None and bool(getattr(cfg, "attention_bias", False)) != has_bias:
+        raise ValueError(
+            f"attention_bias={getattr(cfg, 'attention_bias', False)} but "
+            f"checkpoint {'has' if has_bias else 'lacks'} q_proj.bias tensors")
     log_dist(f"imported HF llama-family weights: {L} layers, "
-             f"vocab {params['embed'].shape[0]}")
+             f"vocab {params['embed'].shape[0]}, qkv_bias={has_bias}")
     return params
 
 

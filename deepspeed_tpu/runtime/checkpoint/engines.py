@@ -99,10 +99,17 @@ class FastCheckpointEngine(CheckpointEngine):
         self.buffer_bytes = buffer_mb << 20
 
     def save(self, tree: Any, path: str) -> None:
+        # multi-host: only process 0 writes (concurrent writers on shared
+        # storage corrupt the file — ADVICE r1); ranks>0 skip BEFORE paying
+        # the D2H snapshot. This single-file path requires fully-addressable
+        # arrays + shared (or rank-0-served) storage; use the orbax engine
+        # for per-shard parallel-safe multi-host writes.
+        if jax.process_index() != 0:
+            return
         host = _tree_to_host(tree)
         leaves, treedef = jax.tree.flatten(host)
         os.makedirs(path, exist_ok=True)
-        tmp = os.path.join(path, ".tmp_state.bin")
+        tmp = os.path.join(path, f".tmp_state.{os.getpid()}.bin")
         with open(tmp, "wb", buffering=self.buffer_bytes) as f:
             header = {"treedef": pickle.dumps(treedef),
                       "leaves": [(l.shape, str(l.dtype)) for l in leaves]}

@@ -92,11 +92,46 @@ class ZenFlowOptimizer:
         order = sorted(range(self.n), key=lambda i: scores[i])
         return sorted(order[:k])
 
+    def _extract_moments(self):
+        """Per-leaf (exp_avg, exp_avg_sq) from BOTH partitions, as numpy —
+        the hand-off that survives re-selection (the reference ZenFlow
+        transfers optimizer state across re-selection; discarding moments
+        every select_interval changes convergence — ADVICE r1)."""
+        m: Dict[int, np.ndarray] = {}
+        v: Dict[int, np.ndarray] = {}
+        # iterate the STATE's keys (the old hot set): by the time rebuild runs,
+        # self.hot_idx already holds the new selection
+        hot_state = getattr(self, "_hot_state", None)
+        if hot_state is not None and hasattr(hot_state, "mu"):
+            for k, arr in hot_state.mu.items():
+                m[int(k)] = np.array(arr, np.float32, copy=True)
+            if hasattr(hot_state, "nu"):
+                for k, arr in hot_state.nu.items():
+                    v[int(k)] = np.array(arr, np.float32, copy=True)
+        if getattr(self, "_cpu_adam", None) is not None:
+            for slot, i in enumerate(self.cold_idx):
+                m[i] = self._cpu_adam.exp_avg[slot]
+                v[i] = self._cpu_adam.exp_avg_sq[slot]
+        return m, v
+
     def _rebuild_partitions(self, betas=(0.9, 0.999), weight_decay=0.0):
         self._betas, self._wd = betas, weight_decay
+        m, v = self._extract_moments()
         self.cold_idx = [i for i in range(self.n) if i not in set(self.hot_idx)]
         hot_params = {str(i): self.leaves[i] for i in self.hot_idx}
         self._hot_state = self.device_opt.init(hot_params)
+        # graft carried moments into the fresh device state (leaves that were
+        # cold now warm-start from the host moments and vice versa)
+        if m and hasattr(self._hot_state, "mu"):
+            mu = {k: (jnp.asarray(m[int(k)]) if int(k) in m else z)
+                  for k, z in self._hot_state.mu.items()}
+            repl = {"mu": mu}
+            if hasattr(self._hot_state, "nu"):
+                repl["nu"] = {k: (jnp.asarray(v[int(k)]) if int(k) in v else z)
+                              for k, z in self._hot_state.nu.items()}
+            if hasattr(self._hot_state, "step"):
+                repl["step"] = jnp.asarray(self.step_count, jnp.int32)
+            self._hot_state = self._hot_state._replace(**repl)
         # cold master copies live on host, updated in place by CPU Adam —
         # MUST be real copies: np.asarray of a CPU jax array can be a
         # zero-copy view, and the worker writes in place
@@ -105,6 +140,14 @@ class ZenFlowOptimizer:
         self._cpu_adam = DeepSpeedCPUAdam(self._cold_host, lr=self.lr,
                                           betas=betas,
                                           weight_decay=weight_decay)
+        if m:
+            self._cpu_adam.load_state_dict({
+                "step": self.step_count,
+                "exp_avg": [m.get(i, np.zeros_like(self._cold_host[s]))
+                            for s, i in enumerate(self.cold_idx)],
+                "exp_avg_sq": [v.get(i, np.zeros_like(self._cold_host[s]))
+                               for s, i in enumerate(self.cold_idx)],
+            })
 
     # ------------------------------------------------------------------ #
     @property

@@ -62,12 +62,12 @@ def test_kd_student_trains_toward_teacher(devices8):
         "train_batch_size": 8,
         "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
         "steps_per_print": 0})
-    losses = []
-    for i in range(5):
-        t = np.random.RandomState(i).randint(0, tcfg.vocab_size, (8, 17))
-        losses.append(float(engine.train_batch(
-            {"tokens": t.astype(np.int32)}).loss))
-    assert losses[-1] < losses[0]
+    # fixed batch, enough steps, and a mean-based margin: 5-step different-
+    # batch trajectories were noise (r1 flaked by 0.009 — VERDICT weak #4)
+    t = np.random.RandomState(0).randint(0, tcfg.vocab_size, (8, 17))
+    losses = [float(engine.train_batch({"tokens": t.astype(np.int32)}).loss)
+              for _ in range(15)]
+    assert np.mean(losses[-3:]) < losses[0] * 0.9, losses
 
 
 def test_elastic_train_config_resolution(devices8):

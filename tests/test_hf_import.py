@@ -85,3 +85,43 @@ def test_state_dict_mapping_inputs(hf_llama):
 def test_unsupported_family_raises(hf_gpt2):
     with pytest.raises(ValueError):
         from_hf(hf_gpt2, family="bloom")
+
+
+@pytest.fixture(scope="module")
+def hf_qwen2():
+    hf_cfg = transformers.Qwen2Config(
+        vocab_size=128, hidden_size=64, intermediate_size=112,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, rope_theta=10000.0, rms_norm_eps=1e-5,
+        tie_word_embeddings=False)
+    torch.manual_seed(3)
+    m = transformers.Qwen2ForCausalLM(hf_cfg).eval()
+    # make the (zero-init-adjacent) biases matter for the parity check
+    with torch.no_grad():
+        for layer in m.model.layers:
+            for proj in (layer.self_attn.q_proj, layer.self_attn.k_proj,
+                         layer.self_attn.v_proj):
+                proj.bias.normal_(0.0, 0.5)
+    return m
+
+
+def test_qwen2_logit_parity(hf_qwen2):
+    """ADVICE r1 (high): qwen2 QKV biases were silently dropped."""
+    cfg, params = from_hf(hf_qwen2)
+    assert cfg.attention_bias and "bq" in params["layers"]
+    tokens = np.random.RandomState(4).randint(0, 128, (2, 10))
+    with torch.no_grad():
+        ref = hf_qwen2(torch.tensor(tokens)).logits.numpy()
+    ours = np.asarray(llama.apply(cfg, params, jnp.asarray(tokens),
+                                  compute_dtype=jnp.float32))
+    np.testing.assert_allclose(ours, ref, rtol=2e-3, atol=2e-3)
+
+
+def test_bias_mismatch_raises(hf_llama):
+    """Importer refuses configs whose attention_bias contradicts the ckpt."""
+    import dataclasses
+
+    cfg, _ = from_hf(hf_llama)
+    bad = dataclasses.replace(cfg, attention_bias=True)
+    with pytest.raises(ValueError, match="attention_bias"):
+        llama_params_from_hf(hf_llama, bad)

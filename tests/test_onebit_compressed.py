@@ -71,12 +71,12 @@ def test_onebit_all_reduce_shard_map(devices8):
     e = jnp.zeros_like(x)
 
     @functools.partial(shard_map, mesh=mesh, in_specs=(P("dp"), P("dp")),
-                       out_specs=(P("dp"), P("dp")))
+                       out_specs=(P("dp"), P("dp"), P("dp")))
     def run(xs, es):
-        avg, new_e = onebit_all_reduce(xs[0], es[0], "dp")
-        return avg[None], new_e[None]
+        avg, new_e, new_se = onebit_all_reduce(xs[0], es[0], "dp")
+        return avg[None], new_e[None], new_se[None]
 
-    avg, new_e = run(x, e)
+    avg, new_e, _ = run(x, e)
     # every worker sees the same compressed average
     for i in range(1, 8):
         np.testing.assert_allclose(np.asarray(avg[i]), np.asarray(avg[0]),
@@ -157,3 +157,32 @@ def test_onebit_adam_matches_adam_in_warmup():
         p2, s2 = ad.update(p2, grads_fn(p2), s2)
     np.testing.assert_allclose(np.asarray(p1["w"]), np.asarray(p2["w"]),
                                rtol=1e-5)
+
+
+def test_onebit_all_reduce_exact_per_worker_scales(devices8):
+    """With wildly different per-worker scales, the two-phase average must
+    track mean_i(sign_i * scale_i) (ADVICE r1: scale mixing bias)."""
+    mesh = Mesh(np.asarray(jax.devices()).reshape(8), ("dp",))
+    rs = np.random.RandomState(3)
+    scales_true = np.array([0.01, 0.01, 0.01, 0.01, 0.01, 0.01, 0.01, 10.0])
+    x = jnp.asarray((rs.randn(8, 64) * scales_true[:, None]).astype(np.float32))
+    e = jnp.zeros_like(x)
+
+    @functools.partial(shard_map, mesh=mesh, in_specs=(P("dp"), P("dp")),
+                       out_specs=(P("dp"), P("dp"), P("dp")))
+    def run(xs, es):
+        avg, new_e, new_se = onebit_all_reduce(xs[0], es[0], "dp")
+        return avg[None], new_e[None], new_se[None]
+
+    avg, _, _ = run(x, e)
+    got = np.asarray(avg[0])
+    # exact mean of per-worker sign_i*scale_i (server recompression adds its
+    # own 1-bit error; compare against that ideal, not the raw mean)
+    signs = np.where(np.asarray(x) >= 0, 1.0, -1.0)
+    per_scale = np.abs(np.asarray(x)).mean(axis=1, keepdims=True)
+    ideal = (signs * per_scale).mean(axis=0)
+    # the dominant worker's scale must show through (old mixing formula
+    # collapsed it by ~8x)
+    assert np.abs(got).max() > 0.5 * np.abs(ideal).max()
+    corr = np.corrcoef(ideal, got)[0, 1]
+    assert corr > 0.9, corr

@@ -90,3 +90,28 @@ def test_zenflow_worker_error_surfaces():
             zf.step(bad)
         zf.finalize()
     zf.close()
+
+
+def test_zenflow_moments_survive_reselection():
+    """ADVICE r1 (medium): re-selection must NOT zero Adam moments — hot and
+    cold exp_avg/exp_avg_sq carry across _rebuild_partitions."""
+    rs = np.random.RandomState(2)
+    params = {"a": jnp.asarray(rs.randn(16,), jnp.float32),
+              "b": jnp.asarray(rs.randn(16,), jnp.float32)}
+    zf = ZenFlowOptimizer(params, lr=0.01, hot_fraction=0.5,
+                          update_interval=1, select_interval=100)
+    g = {"a": jnp.ones((16,), jnp.float32), "b": jnp.ones((16,), jnp.float32)}
+    for _ in range(10):
+        zf.step(g)
+    zf._drain(block=True)
+    m_before, v_before = zf._extract_moments()
+    assert all(np.abs(m).max() > 0 for m in m_before.values())
+    # force a re-selection with the same scores (partitions may swap)
+    zf.hot_idx = zf._select_hot([g["a"], g["b"]])
+    zf._rebuild_partitions(zf._betas, zf._wd)
+    m_after, v_after = zf._extract_moments()
+    for i in m_before:
+        np.testing.assert_allclose(m_after[i], m_before[i], rtol=1e-6)
+        np.testing.assert_allclose(v_after[i], v_before[i], rtol=1e-6)
+    assert zf._cpu_adam.step_count == 10  # bias correction continues
+    zf.close()
