@@ -484,7 +484,89 @@ def model_spec(cfg: LlamaConfig, compute_dtype=jnp.bfloat16):
                                                     compute_dtype=compute_dtype, **kw),
         logical_axes=param_logical_axes(cfg),
         pipeline_capable=cfg.use_pipeline,
+        pipeline_grad_fn=(make_pipeline_grad_fn(cfg, compute_dtype)
+                          if cfg.use_pipeline else None),
     )
+
+
+def make_pipeline_grad_fn(cfg: LlamaConfig, compute_dtype=jnp.bfloat16):
+    """1F1B train-step grads (used by the engine when the mesh has a pipe
+    axis ≥ 2). Embedding/norm/head params are shared stage-replicated state;
+    their grads reduce over 'pipe' — tied-embedding reduction included."""
+
+    def grad_fn(params: Params, batch: Dict[str, jnp.ndarray],
+                loss_scale: Optional[jnp.ndarray] = None):
+        from ..runtime.pipe.one_f_one_b import pipeline_value_and_grad
+
+        tokens = batch["tokens"]
+        if "labels" in batch:
+            inputs, labels = tokens, batch["labels"]
+        else:
+            inputs, labels = tokens[:, :-1], tokens[:, 1:]
+        cos, sin = rope_frequencies(cfg.head_size, cfg.max_seq_len,
+                                    cfg.rope_theta)
+        attn_fn = _resolve_attention(cfg, in_pipeline=True)
+        scale = 1.0 if loss_scale is None else loss_scale
+
+        # each side carries only the params it reads (zero-grad vocab-sized
+        # buffers would otherwise be psum'd over pipe every step); with tied
+        # embeddings the head side includes 'embed' and the grad merge below
+        # sums the two partials — ReduceTiedGrads
+        E_params = {"embed": params["embed"]}
+        H_params = {"final_norm": params["final_norm"]}
+        if "lm_head" in params:
+            H_params["lm_head"] = params["lm_head"]
+        else:
+            H_params["embed"] = params["embed"]
+
+        def embed_fn(P, toks):
+            return embedding_lookup(P["embed"], toks, compute_dtype)
+
+        def block(layer, h):
+            layer = jax.tree.map(
+                lambda p: p.astype(compute_dtype)
+                if jnp.issubdtype(p.dtype, jnp.floating) else p, layer)
+            return _block(cfg, h, layer, cos, sin, None, attn_fn=attn_fn)
+
+        def head_fn(P, h, lab):
+            x = rms_norm(h, P["final_norm"].astype(compute_dtype),
+                         cfg.rms_norm_eps)
+            head = P.get("lm_head")
+            head = P["embed"].T if head is None else head
+            logits = (x @ head.astype(compute_dtype)).astype(jnp.float32)
+            valid = lab != -100
+            safe = jnp.where(valid, lab, 0)
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            tl = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+            # SUM of token losses — the global valid-token mean divides once
+            # at the end (a per-micro mean would up-weight short microbatches
+            # vs the unpipelined loss_fn). Loss scaling seeds the backward.
+            return jnp.where(valid, tl, 0.0).sum() * scale
+
+        loss, grads = pipeline_value_and_grad(
+            embed_fn, block, head_fn,
+            {"embed": E_params, "layers": params["layers"], "head": H_params},
+            inputs, labels)
+        # module returns (1/M)*sum_i loss_i and matching grads; rescale both
+        # to the global valid-token mean
+        from ..comm.mesh import get_mesh
+
+        M = max(get_mesh().pp_world_size, 1)  # module default num_micro = S
+        denom = jnp.maximum((labels != -100).sum(), 1).astype(jnp.float32)
+        factor = M / denom
+        g_merged = dict(grads["embed"])
+        for k, v in grads["head"].items():
+            g_merged[k] = jax.tree.map(jnp.add, g_merged[k], v) \
+                if k in g_merged else v
+        out_grads = {k: jax.tree.map(lambda g: g * factor, v)
+                     for k, v in g_merged.items()}
+        out_grads["layers"] = jax.tree.map(lambda g: g * factor,
+                                           grads["layers"])
+        loss = loss * factor / scale
+        return out_grads, loss, {"loss": loss,
+                                 "ntokens": (labels != -100).sum()}
+
+    return grad_fn
 
 
 def loss_fn(cfg: LlamaConfig, params: Params, batch: Dict[str, jnp.ndarray], *,

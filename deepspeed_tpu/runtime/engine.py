@@ -62,6 +62,10 @@ class ModelSpec:
     # the mesh has a pipe axis — keeps the partitioner's 'layers'->'pipe' rule
     # in sync with the model's actual execution path
     pipeline_capable: bool = True
+    # optional 1F1B train-step grads: (params, batch, loss_scale) ->
+    # (grads_of_scaled_loss, unscaled_loss, aux). Used instead of jax.grad
+    # when the mesh has pipe >= 2 (runtime/pipe/one_f_one_b.py)
+    pipeline_grad_fn: Optional[Callable[..., Any]] = None
 
     def materialize(self, rng: jax.Array):
         if self.params is not None:
@@ -293,6 +297,17 @@ class DeepSpeedTPUEngine:
         return loss.astype(jnp.float32), aux
 
     def _grads_one_micro(self, params, batch, loss_scale):
+        if self.model.pipeline_grad_fn is not None and \
+                self.mesh_mgr.pp_world_size > 1:
+            # 1F1B pipeline schedule (bounded activations) — the model owns
+            # the stage decomposition; the engine supplies the compute cast
+            compute_params = self.precision.cast_to_compute(params)
+            compute_params = jax.lax.with_sharding_constraint(
+                compute_params, self._param_shardings)
+            grads, loss, aux = self.model.pipeline_grad_fn(
+                compute_params, batch, loss_scale.scale)
+            return grads, loss.astype(jnp.float32), aux
+
         def scaled_loss(p):
             loss, aux = self._loss(p, batch)
             return scale_loss(loss, loss_scale), (loss, aux)

@@ -35,6 +35,15 @@ from ...comm.mesh import get_mesh
 from ...utils.logging import logger
 
 
+def psum_f32(x, axis_name: str):
+    """psum with an fp32 payload. Grad/output sums deserve fp32, and XLA:CPU
+    crashes ("Invalid binary instruction opcode copy") on bf16 psum inside a
+    partial-manual shard_map region."""
+    if jnp.issubdtype(x.dtype, jnp.floating) and x.dtype != jnp.float32:
+        return lax.psum(x.astype(jnp.float32), axis_name).astype(x.dtype)
+    return lax.psum(x, axis_name)
+
+
 def _stage_params(layers: Any, stages: int) -> Any:
     """[L, ...] → [S, L/S, ...] on every leaf."""
 
@@ -115,7 +124,7 @@ def pipeline_apply(block_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
 
         state, outputs = lax.fori_loop(0, M + S - 1, tick, (state, outputs))
         # non-last stages hold zeros; psum over 'pipe' broadcasts the results
-        return lax.psum(outputs, pipe_axis)
+        return psum_f32(outputs, pipe_axis)
 
     # Manual ONLY over 'pipe' (axis_names): data/tensor/seq/expert stay under
     # the automatic partitioner, so TP-sharded layer weights remain sharded

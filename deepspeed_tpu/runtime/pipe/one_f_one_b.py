@@ -1,0 +1,241 @@
+"""Compiled 1F1B pipeline schedule (bounded-activation training).
+
+Reference parity: ``runtime/pipe/schedule.py:189 TrainSchedule`` (1F1B
+instruction stream), ``pipe/engine.py:60`` (instruction interpreter with p2p
+send/recv) and ``pipe/engine.py:274`` (tied-weight grad reduction).
+
+TPU-first redesign — the schedule is a *compiled SPMD clock*, not an
+interpreter:
+
+- All stages run one program under ``shard_map`` over the 'pipe' axis for
+  ``T = 2M + 2S - 2`` ticks. At tick ``t`` stage ``s`` forwards microbatch
+  ``i`` iff ``t == s + 2i`` and backwards microbatch ``i`` iff
+  ``t == (2S - 1 - s) + 2i`` — the textbook 1F1B timing, whose fwd/bwd ticks
+  have opposite parity per stage so each tick issues exactly one unit of work
+  (``lax.cond`` skips the idle half; stages branch independently between the
+  collectives, which sit outside the conds).
+- Activations move with ``lax.ppermute`` (+1 ring); gradients with the
+  reverse ring — the reference's SendActivation/RecvActivation/SendGrad/
+  RecvGrad instructions.
+- Memory: each stage stashes only the *block-input* activation of in-flight
+  microbatches — at most ``S`` live at once (a ``[S, micro, ...]`` ring) —
+  and the backward tick recomputes its stage forward under ``jax.vjp``
+  (activation-recompute 1F1B). GPipe-by-AD holds O(M) microbatch residuals;
+  this holds O(S).
+- Tied weights: the embedding is consumed by stage 0's backward and (when
+  tied) the head by the last stage's — both grads are partial per stage and
+  the closing ``psum`` over 'pipe' is exactly ReduceTiedGrads.
+
+The last stage folds the loss into its forward tick (per-microbatch, summed),
+so no O(M) logits/outputs buffer ever exists.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ...comm.mesh import get_mesh
+from .module import _stage_params, psum_f32
+
+
+def pipeline_value_and_grad(embed_fn: Callable[[Any, Any], jnp.ndarray],
+                            block_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
+                            head_fn: Callable[[Any, jnp.ndarray, Any], jnp.ndarray],
+                            params: Any, inputs: Any, labels: Any, *,
+                            num_micro: Optional[int] = None,
+                            pipe_axis: str = "pipe"):
+    """1F1B train step core: returns ``(mean_loss, grads)``.
+
+    params: {"embed": E, "layers": stacked [L, ...] pytree, "head": H}
+    embed_fn(E, inputs_micro) -> h [micro, ...]   (stage-0 work)
+    block_fn(layer, h) -> h                       (ONE layer, unstacked)
+    head_fn(H, h, labels_micro) -> scalar loss    (last-stage work; SUM or
+        MEAN over the microbatch — grads scale by 1/M here either way)
+
+    inputs / labels: arrays with leading batch dim B (microbatched as B/M).
+    Falls back to plain jax.value_and_grad over a lax.scan when pipe size 1.
+    """
+    mm = get_mesh()
+    S = mm.axis_size(pipe_axis)
+    E, layers, H = params["embed"], params["layers"], params["head"]
+
+    if S <= 1:
+        def flat_loss(p):
+            h = embed_fn(p["embed"], inputs)
+
+            def body(h, layer):
+                return block_fn(layer, h), None
+
+            h, _ = lax.scan(body, h, p["layers"])
+            return head_fn(p["head"], h, labels)
+
+        return jax.value_and_grad(flat_loss)(params)
+
+    M = num_micro or S
+    B = jax.tree.leaves(inputs)[0].shape[0]
+    if B % M != 0:
+        raise ValueError(f"batch {B} not divisible by num_micro {M}")
+    split = lambda x: x.reshape((M, B // M) + x.shape[1:])  # noqa: E731
+    micro_in = jax.tree.map(split, inputs)
+    micro_lab = jax.tree.map(split, labels)
+    staged = _stage_params(layers, S)
+
+    fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+    bwd_perm = [((i + 1) % S, i) for i in range(S)]
+    T = 2 * M + 2 * S - 2
+
+    def stage_fwd(my_layers, h):
+        def body(h, layer):
+            return block_fn(layer, h), None
+
+        out, _ = lax.scan(body, h, my_layers)
+        return out
+
+    def pipelined(staged_layers, E, H, micro_in, micro_lab, probe_shape):
+        stage = lax.axis_index(pipe_axis)
+        is_first = stage == 0
+        is_last = stage == S - 1
+        my_layers = jax.tree.map(lambda l: l[0], staged_layers)
+
+        h_shape = probe_shape  # [micro, ...] activation template (zeros)
+        stash = jnp.zeros((S,) + h_shape.shape, h_shape.dtype)
+        h_next = jnp.zeros_like(h_shape)    # activation arriving from below
+        g_next = jnp.zeros_like(h_shape)    # gradient arriving from above
+        # microbatch grads accumulate in fp32 (matching the engine's GAS
+        # accumulator) — bf16 sums across M micros drift
+        f32 = lambda t: jax.tree.map(  # noqa: E731
+            lambda x: jnp.zeros(x.shape, jnp.float32), t)
+        g_layers = f32(my_layers)
+        g_embed = f32(E)
+        g_head = f32(H)
+        loss_sum = jnp.zeros((), jnp.float32)
+
+        def tick(t, carry):
+            stash, h_next, g_next, g_layers, g_embed, g_head, loss_sum = carry
+
+            # ---- schedule predicates (1F1B clock) ----
+            df = t - stage
+            fwd_on = jnp.logical_and(df >= 0,
+                                     jnp.logical_and(df % 2 == 0, df < 2 * M))
+            i_f = jnp.clip(df // 2, 0, M - 1)
+            db = t - (2 * S - 1 - stage)
+            bwd_on = jnp.logical_and(db >= 0,
+                                     jnp.logical_and(db % 2 == 0, db < 2 * M))
+            i_b = jnp.clip(db // 2, 0, M - 1)
+
+            # ---- forward tick ----
+            def do_fwd(stash, h_next, loss_sum):
+                inj = jax.tree.map(lambda x: x[i_f], micro_in)
+                # stage 0 embeds its injection; others use the ring input
+                # (cond: the embed matmul must not run on every stage)
+                h_in = lax.cond(
+                    is_first,
+                    lambda: embed_fn(E, inj).astype(h_next.dtype),
+                    lambda: h_next)
+                stash = lax.dynamic_update_index_in_dim(stash, h_in,
+                                                        i_f % S, 0)
+                out = stage_fwd(my_layers, h_in)
+                lab = jax.tree.map(lambda x: x[i_f], micro_lab)
+                loss_i = lax.cond(
+                    is_last,
+                    lambda: head_fn(H, out, lab).astype(jnp.float32),
+                    lambda: jnp.zeros((), jnp.float32))
+                return stash, out, loss_sum + loss_i
+
+            stash, fwd_out, loss_sum = lax.cond(
+                fwd_on, do_fwd,
+                lambda stash, h_next, loss_sum: (stash,
+                                                 jnp.zeros_like(h_next),
+                                                 loss_sum),
+                stash, h_next, loss_sum)
+
+            # ---- backward tick (recompute + vjp; 1/M grad scaling) ----
+            def do_bwd(g_next, g_layers, g_embed, g_head):
+                h_in = lax.dynamic_index_in_dim(stash, i_b % S, 0,
+                                                keepdims=False)
+                inj = jax.tree.map(lambda x: x[i_b], micro_in)
+                lab = jax.tree.map(lambda x: x[i_b], micro_lab)
+
+                # last stage seeds backward from its loss; others from g_next
+                # (cond: exactly ONE recompute+vjp of the stage per tick)
+                def last_branch():
+                    def f(layers_, h_, H_):
+                        return head_fn(H_, stage_fwd(layers_, h_), lab) / M
+
+                    _, vjp = jax.vjp(f, my_layers, h_in, H)
+                    return vjp(jnp.ones((), jnp.float32))
+
+                def mid_branch():
+                    def f(layers_, h_, H_):
+                        del H_
+                        return stage_fwd(layers_, h_)
+
+                    out, vjp = jax.vjp(f, my_layers, h_in, H)
+                    return vjp(g_next.astype(out.dtype))
+
+                gl, gh, gH = lax.cond(is_last, last_branch, mid_branch)
+                acc = lambda a, g: a + g.astype(jnp.float32)  # noqa: E731
+                g_layers = jax.tree.map(acc, g_layers, gl)
+                g_head = jax.tree.map(acc, g_head, gH)
+
+                # stage 0: push the activation grad through the embedding
+                def embed_branch():
+                    _, vjp_e = jax.vjp(lambda E_: embed_fn(E_, inj)
+                                       .astype(gh.dtype), E)
+                    return vjp_e(gh)[0]
+
+                ge = lax.cond(is_first, embed_branch,
+                              lambda: jax.tree.map(jnp.zeros_like, E))
+                g_embed = jax.tree.map(lambda a, g: a + g.astype(jnp.float32),
+                                       g_embed, ge)
+                return gh, g_layers, g_embed, g_head
+
+            g_out, g_layers, g_embed, g_head = lax.cond(
+                bwd_on, do_bwd,
+                lambda g_next, g_layers, g_embed, g_head: (
+                    jnp.zeros_like(g_next), g_layers, g_embed, g_head),
+                g_next, g_layers, g_embed, g_head)
+
+            # ---- ring transfers (Send/Recv Activation+Grad) ----
+            h_next = lax.ppermute(fwd_out, pipe_axis, fwd_perm)
+            g_next = lax.ppermute(g_out, pipe_axis, bwd_perm)
+            return (stash, h_next, g_next, g_layers, g_embed, g_head,
+                    loss_sum)
+
+        carry = (stash, h_next, g_next, g_layers, g_embed, g_head, loss_sum)
+        carry = lax.fori_loop(0, T, tick, carry)
+        _, _, _, g_layers, g_embed, g_head, loss_sum = carry
+
+        # loss lives on the last stage; tied/replicated params' grads are
+        # partial per stage → psum over 'pipe' is ReduceTiedGrads
+        loss = lax.psum(loss_sum, pipe_axis) / M
+        g_embed = jax.tree.map(lambda g: psum_f32(g, pipe_axis), g_embed)
+        g_head = jax.tree.map(lambda g: psum_f32(g, pipe_axis), g_head)
+        g_staged = jax.tree.map(lambda g: g[None], g_layers)
+        return loss, g_staged, g_embed, g_head
+
+    # activation template: microbatch embedded at stage 0 (zeros probe keeps
+    # it shape-only; never executed eagerly under jit)
+    probe = jax.eval_shape(lambda E_, x: embed_fn(E_, x), E,
+                           jax.tree.map(lambda x: x[0], micro_in))
+    probe_shape = jnp.zeros(probe.shape, probe.dtype)
+
+    loss, g_staged, g_embed, g_head = jax.shard_map(
+        pipelined, mesh=mm.mesh, axis_names={pipe_axis},
+        in_specs=(jax.tree.map(lambda _: P(pipe_axis), staged),
+                  P(), P(), P(), P(), P()),
+        out_specs=(P(), jax.tree.map(lambda _: P(pipe_axis), staged),
+                   P(), P()),
+        check_vma=False)(staged, E, H, micro_in, micro_lab, probe_shape)
+
+    L = jax.tree.leaves(layers)[0].shape[0]
+    g_layers = jax.tree.map(
+        lambda g: g.reshape((L,) + g.shape[2:]), g_staged)
+    grads = {"embed": g_embed, "layers": g_layers, "head": g_head}
+    return loss, grads

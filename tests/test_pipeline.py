@@ -5,7 +5,9 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+import deepspeed_tpu as dst
 from deepspeed_tpu.comm import init_mesh
+from deepspeed_tpu.models import llama
 from deepspeed_tpu.runtime.pipe import pipeline_apply
 
 
@@ -77,3 +79,99 @@ def test_indivisible_microbatch_raises(devices8):
     x = jnp.ones((6, 16))
     with pytest.raises(ValueError):
         pipeline_apply(_block, layers, x, num_micro=4)
+
+
+# --------------------------------------------------------------------------- #
+# 1F1B (reference runtime/pipe/schedule.py:189 TrainSchedule)
+# --------------------------------------------------------------------------- #
+def _pipe_engine(stages, data, gas=1, batch=16, layers=4, micro=None):
+    from deepspeed_tpu.comm import mesh as mesh_lib
+
+    mesh_lib._global_mesh = None
+    mcfg = llama.LlamaConfig.tiny(num_layers=layers)
+    spec = llama.model_spec(mcfg, compute_dtype=jnp.float32)
+    config = {
+        "train_batch_size": batch,
+        "gradient_accumulation_steps": gas,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+        "mesh": {"data": data, "pipe": stages},
+        "pipeline": {"stages": stages},
+        "steps_per_print": 0,
+    }
+    engine, *_ = dst.initialize(model=spec, config=config)
+    return engine, mcfg
+
+
+def test_1f1b_loss_matches_unpipelined(devices8):
+    """5-step fp32 loss trajectory: pipe=4 (1F1B) == pipe=1 (plain AD)."""
+    losses = {}
+    for stages, data in ((1, 8), (4, 2)):
+        engine, mcfg = _pipe_engine(stages, data)
+        rs = np.random.RandomState(0)
+        traj = []
+        for step in range(5):
+            t = rs.randint(0, 256, (16, 33)).astype(np.int32)
+            traj.append(float(engine.train_batch({"tokens": t}).loss))
+        losses[stages] = traj
+    np.testing.assert_allclose(losses[4], losses[1], rtol=2e-4, atol=2e-4)
+    assert losses[1][-1] < losses[1][0]  # it actually trains
+
+
+def test_1f1b_tied_embeddings_grads(devices8):
+    """Tied embed/head: the pipe-axis psum IS ReduceTiedGrads — grads must
+    match the unpipelined run."""
+    from deepspeed_tpu.comm import mesh as mesh_lib
+
+    mcfg = llama.LlamaConfig.tiny(num_layers=4, tie_embeddings=True)
+    rs = np.random.RandomState(1)
+    tokens = rs.randint(0, 256, (8, 17)).astype(np.int32)
+    results = {}
+    for stages, data in ((1, 8), (4, 2)):
+        mesh_lib._global_mesh = None
+        spec = llama.model_spec(mcfg, compute_dtype=jnp.float32)
+        engine, *_ = dst.initialize(model=spec, config={
+            "train_batch_size": 8,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+            "mesh": {"data": data, "pipe": stages},
+            "pipeline": {"stages": stages},
+            "steps_per_print": 0})
+        out = engine.train_batch({"tokens": tokens})
+        results[stages] = (float(out.loss),
+                           np.asarray(engine.state.params["embed"]))
+    assert results[1][0] == pytest.approx(results[4][0], rel=2e-4)
+    np.testing.assert_allclose(results[4][1], results[1][1], rtol=1e-3,
+                               atol=1e-5)
+
+
+def test_1f1b_memory_bounded_vs_gpipe_ad(devices8):
+    """1F1B stashes O(S) microbatch inputs; GPipe-by-AD residuals grow O(M).
+    Compare compiled temp bytes at M=8 microbatches (VERDICT r1 #3)."""
+    from deepspeed_tpu.comm import mesh as mesh_lib
+    from deepspeed_tpu.models.llama import make_pipeline_grad_fn
+    from deepspeed_tpu.runtime.pipe import pipeline_apply
+
+    mesh_lib._global_mesh = None
+    mcfg = llama.LlamaConfig.tiny(num_layers=4)
+    spec = llama.model_spec(mcfg, compute_dtype=jnp.float32)
+    engine, *_ = dst.initialize(model=spec, config={
+        "train_batch_size": 32,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+        "mesh": {"data": 2, "pipe": 4},
+        "pipeline": {"stages": 4},
+        "steps_per_print": 0})
+    params = engine.precision.cast_to_compute(engine.state.params)
+    tokens = jnp.zeros((32, 33), jnp.int32)
+
+    with engine.mesh_mgr.activate():
+        grad_fn = make_pipeline_grad_fn(mcfg, jnp.float32)
+        f1 = jax.jit(lambda p, t: grad_fn(p, {"tokens": t}, None)[0])
+        m_1f1b = f1.lower(params, tokens).compile().memory_analysis()
+
+        def gpipe_loss(p, t):
+            return llama.loss_fn(mcfg, p, {"tokens": t},
+                                 compute_dtype=jnp.float32)[0]
+
+        f2 = jax.jit(jax.grad(gpipe_loss))
+        m_gpipe = f2.lower(params, tokens).compile().memory_analysis()
+    assert m_1f1b.temp_size_in_bytes < m_gpipe.temp_size_in_bytes, (
+        m_1f1b.temp_size_in_bytes, m_gpipe.temp_size_in_bytes)
