@@ -8,9 +8,12 @@ via ``SDLoaderFactory``/``MegatronSDLoader`` (``runtime/state_dict_factory.py:
 once into the stacked [L, ...] pytree layout; resharding to any topology is
 then the checkpoint layer's job (orbax/universal).
 
-Supported families: Llama/Mistral/Qwen2-dense (→ ``models/llama``), GPT-2
-(→ ``models/gpt``). Accepts a live ``transformers`` model, a state-dict
-mapping, or a local checkpoint directory (no network access is assumed).
+Supported families: Llama/Mistral/Qwen2/Phi-3 (→ ``models/llama``; fused
+QKV/gate-up checkpoints are split), GPT-2 (→ ``models/gpt``), Mixtral
+(→ ``models/mixtral``), Falcon (→ ``models/falcon``). Accepts a live
+``transformers`` model, a state-dict mapping, or a local checkpoint directory
+(no network access is assumed). Un-annotated models TP-shard via the AutoTP
+name-rule pass (``module_inject/auto_tp.py``).
 """
 
 from __future__ import annotations
@@ -44,6 +47,14 @@ def _normalize_state_dict(src) -> Dict[str, np.ndarray]:
     return {k: _to_numpy(v) for k, v in src.items()}
 
 
+def _count_indices(sd: Dict[str, np.ndarray], pattern: str) -> int:
+    """1 + max index matched by ``pattern`` (one capture group) over keys."""
+    idx = [int(m.group(1)) for k in sd if (m := re.match(pattern, k))]
+    if not idx:
+        raise KeyError(f"no keys match {pattern!r} — wrong family/prefix?")
+    return 1 + max(idx)
+
+
 def _stack(sd: Dict[str, np.ndarray], pattern: str, num_layers: int,
            transpose: bool = False) -> np.ndarray:
     """Collect per-layer tensors 'prefix.{i}.suffix' into one [L, ...] array."""
@@ -58,9 +69,17 @@ def _stack(sd: Dict[str, np.ndarray], pattern: str, num_layers: int,
 
 
 def llama_config_from_hf(hf_config) -> "Any":
-    """Map a transformers LlamaConfig/MistralConfig/Qwen2Config."""
+    """Map a transformers LlamaConfig/MistralConfig/Qwen2Config/Phi3Config."""
     from .llama import LlamaConfig
 
+    scaling = getattr(hf_config, "rope_scaling", None)
+    if scaling:
+        # longrope (Phi-3-128k) / llama3 scaling rescale even short contexts;
+        # silently applying plain RoPE would give wrong logits everywhere
+        raise ValueError(
+            f"rope_scaling={scaling.get('type', scaling.get('rope_type'))!r} "
+            f"checkpoints are not supported yet — import the base "
+            f"(non-scaled) variant")
     return LlamaConfig(
         vocab_size=hf_config.vocab_size,
         hidden_size=hf_config.hidden_size,
@@ -85,8 +104,7 @@ def llama_params_from_hf(src, cfg=None) -> Params:
     sd = _normalize_state_dict(src)
     pfx = "model." if any(k.startswith("model.") for k in sd) else ""
     L = cfg.num_layers if cfg is not None else \
-        1 + max(int(m.group(1)) for k in sd
-                if (m := re.match(rf"{re.escape(pfx)}layers\.(\d+)\.", k)))
+        _count_indices(sd, rf"{re.escape(pfx)}layers\.(\d+)\.")
     lay = pfx + "layers.{i}."
     params: Params = {
         "embed": sd[pfx + "embed_tokens.weight"],
@@ -103,8 +121,9 @@ def llama_params_from_hf(src, cfg=None) -> Params:
         },
         "final_norm": sd[pfx + "norm.weight"],
     }
-    if "lm_head.weight" in sd:
-        params["lm_head"] = sd["lm_head.weight"].T
+    if "lm_head.weight" in sd and \
+            not (cfg is not None and cfg.tie_embeddings):
+        params["lm_head"] = sd["lm_head.weight"].T  # tied ckpts alias it
     has_bias = (lay.format(i=0) + "self_attn.q_proj.bias") in sd
     if has_bias:
         # Qwen2 QKV biases (ADVICE r1: these were silently dropped)
@@ -141,8 +160,7 @@ def gpt2_params_from_hf(src, cfg=None) -> Params:
     sd = _normalize_state_dict(src)
     pfx = "transformer." if any(k.startswith("transformer.") for k in sd) else ""
     L = cfg.num_layers if cfg is not None else \
-        1 + max(int(m.group(1)) for k in sd
-                if (m := re.match(rf"{re.escape(pfx)}h\.(\d+)\.", k)))
+        _count_indices(sd, rf"{re.escape(pfx)}h\.(\d+)\.")
     lay = pfx + "h.{i}."
     params: Params = {
         "embed": sd[pfx + "wte.weight"],
@@ -168,11 +186,225 @@ def gpt2_params_from_hf(src, cfg=None) -> Params:
     return params
 
 
+def phi3_params_from_hf(src, cfg=None) -> Params:
+    """HF Phi3ForCausalLM → ``models/llama`` pytree. Phi-3 fuses QKV into
+    ``self_attn.qkv_proj`` and gate/up into ``mlp.gate_up_proj`` (reference
+    ``inference/v2/model_implementations/phi3``) — split them here."""
+    sd = _normalize_state_dict(src)
+    pfx = "model." if any(k.startswith("model.") for k in sd) else ""
+    L = cfg.num_layers if cfg is not None else \
+        _count_indices(sd, rf"{re.escape(pfx)}layers\.(\d+)\.")
+    lay = pfx + "layers.{i}."
+    qkv = _stack(sd, lay + "self_attn.qkv_proj.weight", L, transpose=True)
+    gate_up = _stack(sd, lay + "mlp.gate_up_proj.weight", L, transpose=True)
+    h = qkv.shape[1]
+    if cfg is not None:
+        nq = cfg.num_heads * cfg.head_size
+        nkv = cfg.num_kv_heads * cfg.head_size
+    else:  # phi3: q span == hidden, k/v split the rest evenly
+        nq = h
+        nkv = (qkv.shape[2] - nq) // 2
+    inter = gate_up.shape[2] // 2
+    params: Params = {
+        "embed": sd[pfx + "embed_tokens.weight"],
+        "layers": {
+            "attn_norm": _stack(sd, lay + "input_layernorm.weight", L),
+            "wq": qkv[:, :, :nq],
+            "wk": qkv[:, :, nq:nq + nkv],
+            "wv": qkv[:, :, nq + nkv:],
+            "wo": _stack(sd, lay + "self_attn.o_proj.weight", L, transpose=True),
+            "mlp_norm": _stack(sd, lay + "post_attention_layernorm.weight", L),
+            "w_gate": gate_up[:, :, :inter],
+            "w_up": gate_up[:, :, inter:],
+            "w_down": _stack(sd, lay + "mlp.down_proj.weight", L, transpose=True),
+        },
+        "final_norm": sd[pfx + "norm.weight"],
+    }
+    if "lm_head.weight" in sd:
+        params["lm_head"] = sd["lm_head.weight"].T
+    log_dist(f"imported HF phi3 weights: {L} layers (split fused qkv/gate_up)")
+    return params
+
+
+def mixtral_config_from_hf(hf_config) -> "Any":
+    from .mixtral import MixtralConfig
+
+    return MixtralConfig(
+        vocab_size=hf_config.vocab_size,
+        hidden_size=hf_config.hidden_size,
+        intermediate_size=hf_config.intermediate_size,
+        num_layers=hf_config.num_hidden_layers,
+        num_heads=hf_config.num_attention_heads,
+        num_kv_heads=getattr(hf_config, "num_key_value_heads",
+                             hf_config.num_attention_heads),
+        num_experts=hf_config.num_local_experts,
+        top_k=hf_config.num_experts_per_tok,
+        # HF Mixtral routes every token (no capacity limit): disable token
+        # dropping so imported logits match exactly
+        drop_tokens=False,
+        max_seq_len=getattr(hf_config, "max_position_embeddings", 4096),
+        rope_theta=float(getattr(hf_config, "rope_theta", 1e6)),
+        rms_norm_eps=float(getattr(hf_config, "rms_norm_eps", 1e-5)),
+        aux_loss_coef=float(getattr(hf_config, "router_aux_loss_coef", 0.02)),
+    )
+
+
+def mixtral_params_from_hf(src, cfg=None) -> Params:
+    """HF MixtralForCausalLM → ``models/mixtral`` pytree. Experts stack to
+    [L, E, ...] (reference ``inference/v2/model_implementations/mixtral``)."""
+    sd = _normalize_state_dict(src)
+    pfx = "model." if any(k.startswith("model.") for k in sd) else ""
+    L = cfg.num_layers if cfg is not None else \
+        _count_indices(sd, rf"{re.escape(pfx)}layers\.(\d+)\.")
+    lay = pfx + "layers.{i}."
+    E = cfg.num_experts if cfg is not None else \
+        _count_indices(sd, rf"{re.escape(pfx)}layers\.0\.block_sparse_moe"
+                           rf"\.experts\.(\d+)\.")
+
+    def stack_expert(w: str) -> np.ndarray:  # → [L, E, out, in] pre-transpose
+        return np.stack([
+            np.stack([sd[lay.format(i=i) +
+                         f"block_sparse_moe.experts.{e}.{w}.weight"].T
+                      for e in range(E)]) for i in range(L)])
+
+    params: Params = {
+        "embed": sd[pfx + "embed_tokens.weight"],
+        "layers": {
+            "attn_norm": _stack(sd, lay + "input_layernorm.weight", L),
+            "wq": _stack(sd, lay + "self_attn.q_proj.weight", L, transpose=True),
+            "wk": _stack(sd, lay + "self_attn.k_proj.weight", L, transpose=True),
+            "wv": _stack(sd, lay + "self_attn.v_proj.weight", L, transpose=True),
+            "wo": _stack(sd, lay + "self_attn.o_proj.weight", L, transpose=True),
+            "mlp_norm": _stack(sd, lay + "post_attention_layernorm.weight", L),
+            "moe": {
+                "router": _stack(sd, lay + "block_sparse_moe.gate.weight", L,
+                                 transpose=True),
+                "w_gate": stack_expert("w1"),
+                "w_up": stack_expert("w3"),
+                "w_down": stack_expert("w2"),
+            },
+        },
+        "final_norm": sd[pfx + "norm.weight"],
+        # tied checkpoints omit lm_head from the state dict — materialize the
+        # transpose (models/mixtral always carries an explicit head)
+        "lm_head": (sd["lm_head.weight"].T if "lm_head.weight" in sd
+                    else sd[pfx + "embed_tokens.weight"].T.copy()),
+    }
+    log_dist(f"imported HF mixtral weights: {L} layers x {E} experts")
+    return params
+
+
+def falcon_config_from_hf(hf_config) -> "Any":
+    from .falcon import FalconConfig
+
+    return FalconConfig(
+        vocab_size=hf_config.vocab_size,
+        hidden_size=hf_config.hidden_size,
+        num_layers=hf_config.num_hidden_layers,
+        num_heads=hf_config.num_attention_heads,
+        num_kv_heads=(hf_config.num_kv_heads
+                      if getattr(hf_config, "new_decoder_architecture", False)
+                      else (1 if getattr(hf_config, "multi_query", True)
+                            else hf_config.num_attention_heads)),
+        parallel_attn=bool(getattr(hf_config, "parallel_attn", True)),
+        new_decoder_architecture=bool(getattr(hf_config,
+                                              "new_decoder_architecture", False)),
+        layer_norm_eps=float(getattr(hf_config, "layer_norm_epsilon", 1e-5)),
+        rope_theta=float(getattr(hf_config, "rope_theta", 10000.0)),
+        attention_bias=bool(getattr(hf_config, "bias", False)),
+        tie_embeddings=bool(getattr(hf_config, "tie_word_embeddings", True)),
+    )
+
+
+def falcon_params_from_hf(src, cfg) -> Params:
+    """HF FalconForCausalLM → ``models/falcon`` pytree (reference
+    ``inference/v2/model_implementations/falcon``). Fused-QKV layouts (HF
+    ``FalconAttention._split_heads``): new decoder architecture =
+    [nkv groups of (q*g | k | v)]; classic multi_query = [q-block | k | v];
+    classic multi-head (rw-1b) = per-head interleaved [nh, (q | k | v)].
+
+    ``cfg`` is required (head split depends on it) — build via
+    ``falcon_config_from_hf``."""
+    if cfg is None:
+        raise ValueError("falcon_params_from_hf requires cfg (the fused-QKV "
+                         "split depends on head counts) — build it with "
+                         "falcon_config_from_hf")
+    sd = _normalize_state_dict(src)
+    pfx = "transformer." if any(k.startswith("transformer.") for k in sd) else ""
+    L = cfg.num_layers
+    lay = pfx + "h.{i}."
+    if (lay.format(i=0) + "self_attention.query_key_value.bias") in sd:
+        raise ValueError("falcon checkpoints with linear biases (bias=True) "
+                         "are not supported — models/falcon.py has no bias "
+                         "params (classic 7B/40B/180B are bias-free)")
+    qkv = _stack(sd, lay + "self_attention.query_key_value.weight", L,
+                 transpose=True)  # [L, h, (nh + 2*nkv) * hd]
+    h = qkv.shape[1]
+    nh = cfg.num_heads
+    nkv = cfg.num_kv_heads
+    hd = cfg.head_size
+    if cfg.new_decoder_architecture:
+        # interleaved [nkv groups of (q*g | k | v)]
+        g = nh // nkv
+        fused = qkv.reshape(L, h, nkv, g + 2, hd)
+        wq = fused[:, :, :, :g].reshape(L, h, nh * hd)
+        wk = fused[:, :, :, g].reshape(L, h, nkv * hd)
+        wv = fused[:, :, :, g + 1].reshape(L, h, nkv * hd)
+    elif nkv == nh:
+        # classic multi-head (multi_query=False, e.g. rw-1b): per-head
+        # interleave view(.., nh, 3, hd)
+        fused = qkv.reshape(L, h, nh, 3, hd)
+        wq = fused[:, :, :, 0].reshape(L, h, nh * hd)
+        wk = fused[:, :, :, 1].reshape(L, h, nh * hd)
+        wv = fused[:, :, :, 2].reshape(L, h, nh * hd)
+    else:
+        # classic multi_query (7B): [q-block | k | v]
+        wq = qkv[:, :, :nh * hd]
+        wk = qkv[:, :, nh * hd:(nh + nkv) * hd]
+        wv = qkv[:, :, (nh + nkv) * hd:]
+    params: Params = {
+        "embed": sd[pfx + "word_embeddings.weight"],
+        "layers": {
+            "ln_attn_scale": _stack(
+                sd, lay + ("ln_attn.weight" if cfg.new_decoder_architecture
+                           else "input_layernorm.weight"), L),
+            "ln_attn_bias": _stack(
+                sd, lay + ("ln_attn.bias" if cfg.new_decoder_architecture
+                           else "input_layernorm.bias"), L),
+            "wq": wq, "wk": wk, "wv": wv,
+            "wo": _stack(sd, lay + "self_attention.dense.weight", L,
+                         transpose=True),
+            "w_up": _stack(sd, lay + "mlp.dense_h_to_4h.weight", L,
+                           transpose=True),
+            "w_down": _stack(sd, lay + "mlp.dense_4h_to_h.weight", L,
+                             transpose=True),
+        },
+        "final_ln_scale": sd[pfx + "ln_f.weight"],
+        "final_ln_bias": sd[pfx + "ln_f.bias"],
+    }
+    if cfg.new_decoder_architecture:
+        params["layers"]["ln_mlp_scale"] = _stack(sd, lay + "ln_mlp.weight", L)
+        params["layers"]["ln_mlp_bias"] = _stack(sd, lay + "ln_mlp.bias", L)
+    elif not cfg.parallel_attn:
+        # sequential classic blocks carry a distinct second norm
+        params["layers"]["ln_mlp_scale"] = _stack(
+            sd, lay + "post_attention_layernorm.weight", L)
+        params["layers"]["ln_mlp_bias"] = _stack(
+            sd, lay + "post_attention_layernorm.bias", L)
+    if "lm_head.weight" in sd and not cfg.tie_embeddings:
+        params["lm_head"] = sd["lm_head.weight"].T  # tied ckpts alias it
+    log_dist(f"imported HF falcon weights: {L} layers (nkv={nkv})")
+    return params
+
+
 _FAMILIES = {
     "llama": (llama_config_from_hf, llama_params_from_hf),
     "mistral": (llama_config_from_hf, llama_params_from_hf),
     "qwen2": (llama_config_from_hf, llama_params_from_hf),
+    "phi3": (llama_config_from_hf, phi3_params_from_hf),
     "gpt2": (gpt2_config_from_hf, gpt2_params_from_hf),
+    "mixtral": (mixtral_config_from_hf, mixtral_params_from_hf),
+    "falcon": (falcon_config_from_hf, falcon_params_from_hf),
 }
 
 

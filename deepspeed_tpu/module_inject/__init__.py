@@ -1,0 +1,5 @@
+from .auto_tp import (ROW_PARALLEL_PATTERNS, infer_logical_axes,
+                      infer_shard_policy)
+
+__all__ = ["infer_logical_axes", "infer_shard_policy",
+           "ROW_PARALLEL_PATTERNS"]

@@ -1,0 +1,90 @@
+"""AutoTP: infer tensor-parallel sharding rules for ARBITRARY param trees.
+
+Reference parity: ``module_inject/auto_tp.py:194 AutoTP`` — the reference
+walks an nn.Module graph, classifies every Linear as row-parallel (needs an
+all-reduce after it: ``LinearAllreduce``, ``module_inject/layers.py:581``) or
+column-parallel (``LinearLayer`` :678), and splits the weights in place. Its
+policy knowledge is a name list of "layers that end with an all-reduce"
+(o_proj/out_proj/down_proj/dense_4h_to_h/...).
+
+TPU-first redesign: nothing is rewritten or split at runtime. This pass maps
+each leaf's *path name* to logical axis names; the shared ``Partitioner``
+then lays the 'tp' axes onto the 'tensor' mesh axis and XLA inserts the
+all-reduces the sharding implies. Models that publish hand-written
+``param_logical_axes`` skip this entirely — AutoTP is the fallback that makes
+un-annotated (imported) models TP-shardable, exactly the reference's role.
+
+Classification per 2-D (or stacked 3-D [L, in, out]) leaf, by the LAST name
+segment (our [in, out] layout — transposed from HF's [out, in]):
+
+- row-parallel  (shard IN dim; partial sums all-reduce):
+  name matches ROW_PARALLEL_PATTERNS (the reference's allreduce list).
+- column-parallel (shard OUT dim): every other matmul weight.
+- embeddings: ``embed``-like [V, H] shard the vocab dim; ``lm_head``/
+  ``unembed`` [H, V] shard the vocab (out) dim.
+- 1-D leaves (norms, biases, routers): replicated. (Biases of column-
+  parallel linears could shard like their weight's out dim; they are tiny,
+  so the conservative replicate keeps the pass sibling-free.)
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+
+# the reference's "layers that end with an allreduce" knowledge
+# (module_inject/auto_tp.py tp_parser candidates), plus this repo's own
+# stacked-layer names
+ROW_PARALLEL_PATTERNS = (
+    r"o_proj", r"out_proj", r"down_proj", r"dense_4h_to_h", r"w_down",
+    r"wo", r"w2", r"fc2", r"c_proj", r"attention\.dense", r"dense$",
+    r"proj_out",
+)
+
+EMBED_PATTERNS = (r"embed", r"wte", r"word_embeddings", r"tok_embeddings")
+HEAD_PATTERNS = (r"lm_head", r"unembed", r"output_proj$")
+# never shard (small / positional / router tables)
+REPLICATE_PATTERNS = (r"pos_embed", r"wpe", r"router", r"gate\.weight")
+
+
+def _matches(name: str, patterns) -> bool:
+    return any(re.search(p, name) for p in patterns)
+
+
+def infer_shard_policy(path_name: str, shape: Tuple[int, ...]
+                       ) -> Tuple[Optional[str], ...]:
+    """Logical axes for one leaf given its dotted path and shape."""
+    nd = len(shape)
+    leaf = path_name.rsplit(".", 1)[-1]
+    stacked = "layers" in path_name.split(".") and nd >= 2
+    lead: Tuple[Optional[str], ...] = ("layers",) if stacked else ()
+    core = nd - len(lead)
+
+    if _matches(path_name, REPLICATE_PATTERNS) or core < 2:
+        return lead + (None,) * core
+    if _matches(leaf, HEAD_PATTERNS):
+        return lead + (None,) * (core - 2) + ("embed", "vocab")
+    if core == 2 and not stacked and \
+            (_matches(leaf, EMBED_PATTERNS) or
+             _matches(path_name, EMBED_PATTERNS)):
+        return lead + ("vocab", "embed")
+    if _matches(leaf, ROW_PARALLEL_PATTERNS):
+        # [.., in(sharded), out] — partial sums; XLA inserts the all-reduce
+        return lead + (None,) * (core - 2) + ("tp", None)
+    # column-parallel default: [.., in, out(sharded)]
+    return lead + (None,) * (core - 2) + (None, "tp")
+
+
+def infer_logical_axes(params: Any) -> Any:
+    """Pytree of logical-axis tuples for an arbitrary param tree — the
+    ``AutoTP.tp_parser`` equivalent. Feed to ``Partitioner.param_specs``."""
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    treedef = jax.tree_util.tree_structure(params)
+    axes = []
+    for path, leaf in flat:
+        name = ".".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                        for k in path)
+        axes.append(infer_shard_policy(name, tuple(leaf.shape)))
+    return jax.tree_util.tree_unflatten(treedef, axes)

@@ -146,9 +146,17 @@ class DeepSpeedTPUEngine:
         shapes = shapes_of(params)
         if model.logical_axes is not None:
             axes = model.logical_axes
+        elif mesh_mgr.tp_world_size > 1:
+            # un-annotated model on a TP mesh: infer row/col-parallel rules
+            # from param names (AutoTP — module_inject/auto_tp.py:194 analog)
+            from ..module_inject import infer_logical_axes
+
+            axes = infer_logical_axes(params)
+            log_dist("AutoTP: inferred tensor-parallel sharding rules from "
+                     "param names (no logical_axes on the ModelSpec)")
         else:
-            # no metadata: replicate params (ZeRO still shards masters/opt
-            # state over the largest divisible dim of each leaf)
+            # no metadata, no TP: replicate params (ZeRO still shards
+            # masters/opt state over the largest divisible dim of each leaf)
             axes = jax.tree.map(lambda s: tuple([None] * len(s)), shapes,
                                 is_leaf=lambda x: isinstance(x, tuple))
         # compute-time specs (TP always; +ZeRO at stage 3 — gather-on-use)

@@ -40,6 +40,7 @@ DEFAULT_RULES: Dict[str, Optional[Any]] = {
     "kv_heads": "tensor",
     "mlp": "tensor",
     "vocab": "tensor",
+    "tp": "tensor",       # generic AutoTP-inferred dim (module_inject/auto_tp)
     "expert": "expert",   # MoE expert dim
     "embed": None,
     "layers": None,       # stays unsharded for scan; 'pipe' when PP is active
