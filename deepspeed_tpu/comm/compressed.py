@@ -100,6 +100,22 @@ def dequantize_int8(q: jnp.ndarray, scale: jnp.ndarray, shape,
     return flat[:n].reshape(shape).astype(dtype)
 
 
+def quantized_reduce_scatter_dim(x: jnp.ndarray, dim: int,
+                                 axis_names: Tuple[str, ...],
+                                 group_size: int = 256) -> jnp.ndarray:
+    """Hierarchical int8 reduce-scatter of ``x`` along ``dim`` over several
+    mesh axes IN ORDER (qgZ's intra-node → inter-node hierarchy,
+    ``csrc/quantization/quant_reduce.cu`` + ``swizzled_quantize.cu`` analog).
+    Use inside shard_map; returns the local 1/prod(sizes) dim-shard of the
+    SUM. Axis order must match the target PartitionSpec tuple order (slowest-
+    varying first)."""
+    x = jnp.moveaxis(x, dim, 0)
+    for a in axis_names:
+        n = lax.axis_size(a)
+        x = quantized_reduce_scatter(x, a, n, group_size=group_size)
+    return jnp.moveaxis(x, 0, dim)
+
+
 def quantized_reduce_scatter(x: jnp.ndarray, axis_name: str, axis_size: int,
                              group_size: int = 256) -> jnp.ndarray:
     """qgZ analog (``all_to_all_quant_reduce``): quantize int8 → all-to-all

@@ -26,12 +26,17 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..utils.logging import log_dist, logger
 
-MESH_AXES: Tuple[str, ...] = ("data", "expert", "pipe", "seq", "tensor")
+MESH_AXES: Tuple[str, ...] = ("data", "zero_shard", "expert", "pipe", "seq",
+                              "tensor")
 
-# parameter/optimizer-state sharding for ZeRO rides the full DP product
-ZERO_AXES: Tuple[str, ...] = ("data", "expert", "seq")
+# parameter/optimizer-state sharding for ZeRO rides the full DP product.
+# 'zero_shard' (size 1 unless MiCS/hpZ is on) is the data sub-axis that
+# carves the reference's MiCS shard group / ZeRO++ secondary partition
+# (runtime/zero/mics.py:63, zero_hpz_partition_size) out of plain data
+# parallelism: with MiCS, ZeRO shards over it and REPLICATES over 'data'.
+ZERO_AXES: Tuple[str, ...] = ("data", "zero_shard", "expert", "seq")
 # batch (micro-batch leading dim) sharding
-BATCH_AXES: Tuple[str, ...] = ("data", "expert")
+BATCH_AXES: Tuple[str, ...] = ("data", "zero_shard", "expert")
 
 _global_mesh: Optional["MeshManager"] = None
 
@@ -75,6 +80,10 @@ class MeshManager:
     @property
     def zero_world_size(self) -> int:
         return int(np.prod([self.mesh.shape[a] for a in ZERO_AXES]))
+
+    @property
+    def mics_shard_size(self) -> int:
+        return self.mesh.shape["zero_shard"]
 
     @property
     def tp_world_size(self) -> int:

@@ -297,7 +297,15 @@ def mixtral_params_from_hf(src, cfg=None) -> Params:
 def falcon_config_from_hf(hf_config) -> "Any":
     from .falcon import FalconConfig
 
+    if getattr(hf_config, "alibi", False):
+        # models/falcon.py applies rotary embeddings; running an ALiBi
+        # checkpoint through RoPE would give silently wrong logits
+        raise ValueError("alibi=True falcon checkpoints are not supported — "
+                         "models/falcon.py implements the RoPE variants "
+                         "(7B/40B/180B); ALiBi (rw-*) needs an ALiBi "
+                         "attention path")
     return FalconConfig(
+        max_seq_len=int(getattr(hf_config, "max_position_embeddings", 2048)),
         vocab_size=hf_config.vocab_size,
         hidden_size=hf_config.hidden_size,
         num_layers=hf_config.num_hidden_layers,

@@ -139,8 +139,23 @@ class DeepSpeedTPUEngine:
             lambda p: p.astype(self.precision.param_dtype)
             if jnp.issubdtype(p.dtype, jnp.floating) else p, params)
 
+        if config.zero_config.zero_quantized_gradients and \
+                config.zero_config.stage not in (2,):
+            raise ValueError(
+                "zero_quantized_gradients (qgZ) requires ZeRO stage 2 — the "
+                "quantized reduce-scatter produces grads in the stage-2 "
+                "sharded layout (stage 3 param gathering is a separate path)")
+
+        from ..comm.mesh import ZERO_AXES as _ZERO_AXES
+
+        zero_axes = _ZERO_AXES
+        if mesh_mgr.mics_shard_size > 1:
+            # MiCS: shard within the 'zero_shard' group, replicate across
+            # 'data' groups (reference runtime/zero/mics.py:63)
+            zero_axes = tuple(a for a in _ZERO_AXES if a != "data")
         self.partitioner = Partitioner(
             mesh_mgr, zero_stage=config.zero_config.stage,
+            zero_axes=zero_axes,
             tensor_parallel=mesh_mgr.tp_world_size > 1,
             pipeline_layers=model.pipeline_capable)
         shapes = shapes_of(params)
@@ -305,6 +320,12 @@ class DeepSpeedTPUEngine:
         return loss.astype(jnp.float32), aux
 
     def _grads_one_micro(self, params, batch, loss_scale):
+        from ..comm.mesh import BATCH_AXES as _BA
+
+        if self.config.zero_config.zero_quantized_gradients and \
+                self.mesh_mgr.pp_world_size <= 1 and \
+                any(self.mesh_mgr.axis_size(a) > 1 for a in _BA):
+            return self._qgz_one_micro(params, batch, loss_scale)
         if self.model.pipeline_grad_fn is not None and \
                 self.mesh_mgr.pp_world_size > 1:
             # 1F1B pipeline schedule (bounded activations) — the model owns
@@ -322,6 +343,99 @@ class DeepSpeedTPUEngine:
 
         grads, (loss, aux) = jax.grad(scaled_loss, has_aux=True)(params)
         return grads, loss, aux
+
+    def _qgz_one_micro(self, params, batch, loss_scale):
+        """ZeRO++ qgZ (``zero_quantized_gradients``): per-device LOCAL grads,
+        reduced with a hierarchical int8 quantize → reduce-scatter →
+        dequantize over the batch axes (reference
+        ``runtime/comm/coalesced_collectives.py:31 all_to_all_quant_reduce``,
+        ``csrc/quantization/quant_reduce.cu``). The wire moves int8 (+ tiny
+        fp32 group scales) instead of fp32 — the DCN-crossing story. Leaves
+        whose target spec is replicated (and the 'data' axis under MiCS, which
+        replicates) reduce with a plain fp32 psum."""
+        from ..comm.mesh import BATCH_AXES
+        from ..comm.compressed import quantized_reduce_scatter_dim
+
+        mm = self.mesh_mgr
+        manual = tuple(a for a in BATCH_AXES if mm.axis_size(a) > 1)
+        assert manual, "qgZ dispatch requires a >1 batch axis (see caller)"
+        n_total = int(np.prod([mm.axis_size(a) for a in manual]))
+
+        # cast + TP-layout gather OUTSIDE the manual region: compute params
+        # carry no batch-axis sharding below stage 3
+        compute = self.precision.cast_to_compute(params)
+        compute = jax.lax.with_sharding_constraint(compute,
+                                                   self._param_shardings)
+
+        is_p = lambda x: isinstance(x, P)  # noqa: E731
+        flat_specs = jax.tree.leaves(self.grad_specs, is_leaf=is_p)
+        param_leaves = jax.tree.leaves(params)  # grad shapes == param shapes
+
+        def split_axes(spec):
+            """(dim, scatter_axes, residual_axes) for one grad leaf."""
+            for i, e in enumerate(spec):
+                ent = e if isinstance(e, tuple) else ((e,) if e else ())
+                axes = tuple(a for a in ent if a in manual)
+                if axes:
+                    return i, axes, tuple(a for a in manual if a not in axes)
+            return None, (), manual
+
+        # per-leaf plan, decided ONCE from static shapes so the out_specs and
+        # the in-region reduction can never disagree; indivisible dims (only
+        # reachable via non-ZeRO rules like 'expert') demote to a plain psum
+        plans = []
+        for leaf, spec in zip(param_leaves, flat_specs):
+            d, scatter, residual = split_axes(spec)
+            if d is not None:
+                n_sc = int(np.prod([mm.axis_size(a) for a in scatter]))
+                if leaf.shape[d] % n_sc != 0:
+                    d, scatter, residual = None, (), manual
+            plans.append((d, scatter, residual))
+
+        def out_spec(ndim, plan):
+            d, scatter, _ = plan
+            ents = [None] * ndim
+            if d is not None:
+                ents[d] = scatter if len(scatter) > 1 else scatter[0]
+            return P(*ents)
+
+        gdef_template = jax.tree_util.tree_structure(params)
+        out_gspecs = jax.tree_util.tree_unflatten(
+            gdef_template,
+            [out_spec(leaf.ndim, plan)
+             for leaf, plan in zip(param_leaves, plans)])
+        batch_specs = jax.tree.map(lambda x: P(manual), batch)
+
+        def local(compute_params, lbatch):
+            def scaled(p):
+                out = self.model.loss_fn(p, lbatch)
+                loss, aux = out if isinstance(out, tuple) else (out, {})
+                loss = loss.astype(jnp.float32)
+                return scale_loss(loss, loss_scale), (loss, aux)
+
+            grads, (loss, aux) = jax.grad(scaled, has_aux=True)(compute_params)
+            gleaves, gdef = jax.tree_util.tree_flatten(grads)
+            red = []
+            for g, (d, scatter, residual) in zip(gleaves, plans):
+                g = g.astype(jnp.float32)
+                if d is not None:
+                    g = quantized_reduce_scatter_dim(g, d, scatter)
+                if residual:
+                    g = jax.lax.psum(g, residual)
+                red.append(g / n_total)
+            grads = jax.tree_util.tree_unflatten(gdef, red)
+            loss = jax.lax.psum(loss, manual) / n_total
+            aux = jax.tree.map(
+                lambda a: jax.lax.psum(a.astype(jnp.float32), manual) / n_total
+                if jnp.issubdtype(jnp.asarray(a).dtype, jnp.inexact)
+                else jax.lax.psum(jnp.asarray(a), manual), aux)
+            return grads, loss, aux
+
+        return jax.shard_map(
+            local, mesh=mm.mesh, axis_names=set(manual),
+            in_specs=(P(), batch_specs),
+            out_specs=(out_gspecs, P(), P()),
+            check_vma=False)(compute, batch)
 
     def _constrain_grads(self, grads):
         """Apply the stage's gradient sharding (reduce-scatter from stage 2 —
@@ -674,9 +788,22 @@ def initialize(args=None, model: Optional[ModelSpec] = None, optimizer=None,
             raise ValueError(f"device count {n_devices} not divisible by {sizes}")
         sizes["data"] = n_devices // fixed
         axis_sizes = sizes
+    # MiCS / ZeRO++ hpZ: carve the shard group out of the data axis — ZeRO
+    # shards over 'zero_shard' (size G) and replicates over the remaining
+    # 'data' groups (reference runtime/zero/mics.py:63, zero_hpz_partition_size)
+    mics = max(int(pre.zero_config.mics_shard_size),
+               int(pre.zero_config.zero_hpz_partition_size), 1)
+    if mics > 1 and int(axis_sizes.get("zero_shard", 1)) == 1:
+        data = int(axis_sizes.get("data", 1))
+        if data % mics != 0:
+            raise ValueError(f"mics/hpz shard size {mics} does not divide "
+                             f"data-parallel size {data}")
+        axis_sizes["zero_shard"] = mics
+        axis_sizes["data"] = data // mics
     if mesh_mgr is None:
         mesh_mgr = init_mesh(axis_sizes)
-    dp = int(axis_sizes.get("data", 1)) * int(axis_sizes.get("expert", 1))
+    dp = int(axis_sizes.get("data", 1)) * int(axis_sizes.get("zero_shard", 1)) \
+        * int(axis_sizes.get("expert", 1))
     cfg = parse_config(config, world_size=n_devices, dp_world_size=dp)
 
     engine = DeepSpeedTPUEngine(model=model, config=cfg, mesh_mgr=mesh_mgr,

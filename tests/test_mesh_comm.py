@@ -101,7 +101,7 @@ def test_batch_sharding_spec(devices8):
     mm = init_mesh({"data": 2, "expert": 2, "seq": 2, "tensor": 1})
     assert mm.dp_world_size == 4
     s = mm.batch_sharding(extra_seq_axis=True)
-    assert s.spec == P(("data", "expert"), "seq")
+    assert s.spec == P(("data", "zero_shard", "expert"), "seq")
 
 
 def test_send_recv_gather_scatter(devices8):
