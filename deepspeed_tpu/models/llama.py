@@ -418,14 +418,25 @@ def _block_paged(cfg: LlamaConfig, x: jnp.ndarray, layer: Params,
     k_cache = k_cache.at[blk_idx, off].set(k.astype(k_cache.dtype))
     v_cache = v_cache.at[blk_idx, off].set(v.astype(v_cache.dtype))
 
-    # gather the sequence's blocks → dense [b, S, nkv, hd] view for attention
-    S = max_blocks * bs
-    kg = k_cache[block_tables].reshape(b, S, nkv, hd)
-    vg = v_cache[block_tables].reshape(b, S, nkv, hd)
-    kv_pos = jnp.arange(S)[None, None, None, :]
-    q_abs = abs_pos[:, None, :, None]
-    mask = kv_pos <= q_abs
-    attn_out = attention(q, kg, vg, causal=False, mask=mask)
+    if t == 1:
+        # decode: block-table-indexed flash-decode — Pallas kernel on TPU
+        # (reads KV straight from the pool, no dense gather; reference
+        # inference/v2/kernels/ragged_ops), compiled XLA gather elsewhere
+        from ..ops import pallas as _pallas_ops  # noqa: F401 (registers)
+        from ..ops.registry import get_op
+
+        attn_out = get_op("paged_decode_attention")(
+            q[:, 0], k_cache, v_cache, block_tables,
+            context_lens)[:, None]
+    else:
+        # prefill chunks: dense gather view + masked flash/XLA attention
+        S = max_blocks * bs
+        kg = k_cache[block_tables].reshape(b, S, nkv, hd)
+        vg = v_cache[block_tables].reshape(b, S, nkv, hd)
+        kv_pos = jnp.arange(S)[None, None, None, :]
+        q_abs = abs_pos[:, None, :, None]
+        mask = kv_pos <= q_abs
+        attn_out = attention(q, kg, vg, causal=False, mask=mask)
     x = x + attn_out.reshape(b, t, nh * hd) @ layer["wo"]
 
     y = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)

@@ -12,3 +12,4 @@ on TPU; on CPU they run in interpret mode when explicitly selected).
 from . import flash_attention  # noqa: F401
 from . import norms  # noqa: F401
 from . import quantize  # noqa: F401
+from . import paged_attention  # noqa: F401 (registers ops)

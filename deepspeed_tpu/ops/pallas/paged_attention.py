@@ -1,0 +1,158 @@
+"""Paged (blocked-KV) flash-decode attention Pallas kernel.
+
+Reference parity: the inference v2 ragged decode kernels
+(``inference/v2/kernels/ragged_ops/`` — blocked flash attention over the
+``BlockedKVCache``, ``inference/v2/ragged/kv_cache.py``). Round-1 shipped a
+gather-based XLA path (``models/llama.py apply_paged``) that materializes a
+dense [B, max_blocks*bs, ...] KV view per layer; this kernel reads KV blocks
+straight out of the shared pool via a block-table-indexed ``BlockSpec``
+(scalar-prefetch), online-softmax accumulating — no dense copy, HBM traffic =
+exactly the live context.
+
+Decode layout: one query token per sequence.
+  q            [B, nh, hd]
+  k/v pool     [num_blocks, bs, nkv, hd]   (block 0 = trash block)
+  block_tables [B, max_blocks] int32
+  context_lens [B] int32 — tokens ALREADY cached; the current token's K/V
+               must be written to the pool before calling (so the effective
+               length is context_lens + 1).
+Grid: (B, nkv, max_blocks), KV-block loop innermost/sequential; the GQA query
+group (g = nh/nkv rows) rides the MXU sublanes.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+from ._common import interpret as _interpret
+
+NEG_INF = -1e30
+
+
+def _decode_kernel(tables_ref, ctx_ref, q_ref, k_ref, v_ref, o_ref,
+                   m_scr, l_scr, acc_scr, *, bs, scale, nblk, gpad):
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    ctx = ctx_ref[b] + 1  # current token attends to itself too
+
+    @pl.when(j * bs < ctx)
+    def _compute():
+        q = q_ref[...]                     # [gpad, hd]
+        k = k_ref[...]                     # [bs, hd]
+        v = v_ref[...]                     # [bs, hd]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        pos = j * bs + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(pos < ctx, s, NEG_INF)
+
+        m_prev, l_prev = m_scr[...], l_scr[...]
+        m_curr = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, jnp.broadcast_to(m_curr, m_prev.shape))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new[:, :1])
+        l_scr[...] = l_prev * alpha + jnp.broadcast_to(
+            jnp.sum(p, axis=1, keepdims=True), l_prev.shape)
+        acc_scr[...] = acc_scr[...] * alpha[:, :1] + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[...] = m_new
+
+    @pl.when(j == nblk - 1)
+    def _finish():
+        l = l_scr[...]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[...] = (acc_scr[...] / l_safe[:, :1]).astype(o_ref.dtype)
+
+
+def paged_decode_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
+                           v_pool: jnp.ndarray, block_tables: jnp.ndarray,
+                           context_lens: jnp.ndarray, *,
+                           scale: float = None) -> jnp.ndarray:
+    """See module docstring. Returns [B, nh, hd]."""
+    B, nh, hd = q.shape
+    nblocks, bs, nkv, _ = k_pool.shape
+    max_blocks = block_tables.shape[1]
+    g = nh // nkv
+    gpad = max(8, 1 << (g - 1).bit_length())  # sublane-pad the query group
+    scale = hd ** -0.5 if scale is None else scale
+
+    # [B, nkv, gpad, hd] query groups
+    qg = q.reshape(B, nkv, g, hd)
+    qg = jnp.pad(qg, ((0, 0), (0, 0), (0, gpad - g), (0, 0)))
+
+    kernel = functools.partial(_decode_kernel, bs=bs, scale=float(scale),
+                               nblk=max_blocks, gpad=gpad)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,  # block_tables, context_lens
+        grid=(B, nkv, max_blocks),
+        in_specs=[
+            pl.BlockSpec((None, None, gpad, hd),
+                         lambda b, h, j, tables, ctx: (b, h, 0, 0)),
+            # the paged read: pool block chosen by the table (trash block 0
+            # for out-of-range entries is whatever the table holds there)
+            pl.BlockSpec((None, bs, None, hd),
+                         lambda b, h, j, tables, ctx: (
+                             jnp.clip(tables[b, j], 0, nblocks - 1), 0, h, 0)),
+            pl.BlockSpec((None, bs, None, hd),
+                         lambda b, h, j, tables, ctx: (
+                             jnp.clip(tables[b, j], 0, nblocks - 1), 0, h, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, None, gpad, hd),
+                               lambda b, h, j, tables, ctx: (b, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((gpad, 128), jnp.float32),
+            pltpu.VMEM((gpad, 128), jnp.float32),
+            pltpu.VMEM((gpad, hd), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, nkv, gpad, hd), q.dtype),
+        interpret=_interpret(),
+    )(block_tables.astype(jnp.int32), context_lens.astype(jnp.int32),
+      qg, k_pool, v_pool)
+    return out[:, :, :g].reshape(B, nh, hd)
+
+
+def paged_decode_attention_xla(q: jnp.ndarray, k_pool: jnp.ndarray,
+                               v_pool: jnp.ndarray, block_tables: jnp.ndarray,
+                               context_lens: jnp.ndarray, *,
+                               scale: float = None) -> jnp.ndarray:
+    """Dense-gather fallback with identical semantics (compiled XLA — the
+    right choice off-TPU, where the Pallas path runs interpreted)."""
+    from ..attention import attention_xla
+
+    B, nh, hd = q.shape
+    _, bs, nkv, _ = k_pool.shape
+    max_blocks = block_tables.shape[1]
+    S = max_blocks * bs
+    kg = k_pool[block_tables].reshape(B, S, nkv, hd)
+    vg = v_pool[block_tables].reshape(B, S, nkv, hd)
+    kv_pos = jnp.arange(S)[None, None, None, :]
+    mask = kv_pos <= context_lens[:, None, None, None]
+    out = attention_xla(q[:, None], kg, vg, causal=False, mask=mask,
+                        scale=scale)
+    return out[:, 0]
+
+
+from ..registry import register  # noqa: E402
+
+register("paged_decode_attention", backend="pallas")(paged_decode_attention)
+register("paged_decode_attention", backend="xla")(paged_decode_attention_xla)
