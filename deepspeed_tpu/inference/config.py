@@ -36,7 +36,8 @@ class QuantConfig:
     ``inference/quantization`` INT4/INT8 + ``GroupQuantizer``)."""
 
     enabled: bool = False
-    bits: int = 8
+    bits: int = 8          # 8 (int8) or 4 (packed nibbles)
+    dtype: str = "int"     # "int" | "fp8" (float8_e4m3 weights + row scales)
 
 
 @dataclass

@@ -125,16 +125,29 @@ class InferenceEngine:
             lambda p, t: family.apply_fn(family.cfg, self._dq(p), t))
 
     # ------------------------------------------------------------------ #
-    # weight-only quantization (int8 at rest, dequantize-on-use)
+    # weight-only quantization (int8 / packed-int4 / fp8 at rest,
+    # dequantize-on-use — reference ``inference/quantization`` INT4/INT8 and
+    # ``csrc/fp_quantizer`` float formats)
     # ------------------------------------------------------------------ #
     @staticmethod
     def _is_qleaf(x) -> bool:
-        return isinstance(x, dict) and set(x) == {"q", "scale"}
+        return isinstance(x, dict) and set(x) in ({"q", "scale"},
+                                                  {"q4", "scale"},
+                                                  {"f8", "scale"})
 
     def _quantize_params(self, params):
-        """≥2-D float leaves → {'q': int8 (same shape), 'scale': per-row fp32}
-        so the original leaf's sharding spec still applies to 'q'."""
+        """≥2-D float leaves → quantized-at-rest forms the consuming matmul
+        dequantizes on use (XLA fuses it):
+
+        - bits=8: {'q': int8 (same shape), 'scale': per-row fp32}
+        - bits=4: {'q4': uint8 (last dim halved — two nibbles per byte),
+                   'scale'} (odd last dims fall back to int8)
+        - fp8:    {'f8': float8_e4m3fn (same shape), 'scale': per-row fp32}
+        Shardings: 'q'/'f8' reuse the leaf's spec; packed 'q4' too (the
+        halved last dim divides the same mesh axes for even splits)."""
         bits = self.config.quant.bits
+        use_fp8 = str(getattr(self.config.quant, "dtype", "int")).lower() in \
+            ("fp8", "float8", "e4m3")
         qmax = 2 ** (bits - 1) - 1
         flat, treedef = jax.tree_util.tree_flatten(params)
         sflat = jax.tree_util.tree_flatten(self.param_shardings)[0]
@@ -143,12 +156,35 @@ class InferenceEngine:
         for leaf, sh in zip(flat, sflat):
             if hasattr(leaf, "ndim") and leaf.ndim >= 2 and \
                     jnp.issubdtype(leaf.dtype, jnp.floating):
+                if use_fp8:
+                    amax = jnp.maximum(jnp.max(jnp.abs(leaf), axis=-1,
+                                               keepdims=True), 1e-8)
+                    scale = amax / 448.0  # e4m3 max normal
+                    f8 = (leaf / scale).astype(jnp.float8_e4m3fn)
+                    qleaves.append({"f8": f8,
+                                    "scale": scale.astype(jnp.float32)})
+                    qshard.append({"f8": sh, "scale": rep})
+                    continue
                 scale = jnp.maximum(jnp.max(jnp.abs(leaf), axis=-1,
                                             keepdims=True), 1e-8) / qmax
                 q = jnp.clip(jnp.round(leaf / scale), -qmax - 1, qmax) \
                     .astype(jnp.int8)
-                qleaves.append({"q": q, "scale": scale.astype(jnp.float32)})
-                qshard.append({"q": sh, "scale": rep})
+                packed_shape = leaf.shape[:-1] + (leaf.shape[-1] // 2,)
+                try:  # packed last dim must still divide the mesh axes
+                    sh.shard_shape(packed_shape)
+                    pack_ok = leaf.shape[-1] % 2 == 0
+                except ValueError:
+                    pack_ok = False
+                if bits == 4 and pack_ok:
+                    lo = q[..., 0::2] & 0xF
+                    hi = (q[..., 1::2] & 0xF) << 4
+                    packed = (lo | hi).astype(jnp.uint8)
+                    qleaves.append({"q4": packed,
+                                    "scale": scale.astype(jnp.float32)})
+                    qshard.append({"q4": sh, "scale": rep})
+                else:
+                    qleaves.append({"q": q, "scale": scale.astype(jnp.float32)})
+                    qshard.append({"q": sh, "scale": rep})
             else:
                 qleaves.append(leaf.astype(self.dtype)
                                if jnp.issubdtype(leaf.dtype, jnp.floating)
@@ -157,13 +193,27 @@ class InferenceEngine:
         return (jax.tree_util.tree_unflatten(treedef, qleaves),
                 jax.tree_util.tree_unflatten(treedef, qshard))
 
+    def _dq_leaf(self, x):
+        if "q" in x:
+            return x["q"].astype(self.dtype) * x["scale"].astype(self.dtype)
+        if "f8" in x:
+            return x["f8"].astype(self.dtype) * x["scale"].astype(self.dtype)
+        # packed int4: sign-extend nibbles, re-interleave
+        packed = x["q4"]
+        lo = (packed & 0xF).astype(jnp.int8)
+        hi = (packed >> 4).astype(jnp.int8)
+        lo = jnp.where(lo >= 8, lo - 16, lo)
+        hi = jnp.where(hi >= 8, hi - 16, hi)
+        q = jnp.stack([lo, hi], axis=-1).reshape(
+            packed.shape[:-1] + (2 * packed.shape[-1],))
+        return q.astype(self.dtype) * x["scale"].astype(self.dtype)
+
     def _dq(self, params):
         """Dequantize inside jit (no-op when quantization is off)."""
         if not self._quantized:
             return params
         return jax.tree.map(
-            lambda x: (x["q"].astype(self.dtype) *
-                       x["scale"].astype(self.dtype)) if self._is_qleaf(x) else x,
+            lambda x: self._dq_leaf(x) if self._is_qleaf(x) else x,
             params, is_leaf=self._is_qleaf)
 
     # ------------------------------------------------------------------ #

@@ -3,8 +3,9 @@
 Capability parity with the reference's ``aio_handle``
 (``csrc/aio/py_lib/py_ds_aio.cpp:22``): sync ``read``/``write``, async
 ``pread``/``pwrite`` against numpy buffers, ``wait()`` to drain. Backed by
-the thread-pooled C++ engine in ``csrc/aio.cpp``; a pure-Python
-ThreadPoolExecutor fallback keeps the API available without a toolchain.
+the C++ engine in ``csrc/aio.cpp`` — io_uring (raw syscalls) when the kernel
+allows it, a pthread pool otherwise; a pure-Python ThreadPoolExecutor
+fallback keeps the API available without a toolchain.
 """
 
 from __future__ import annotations
@@ -38,6 +39,8 @@ def _lib():
             fn.restype = ctypes.c_int64
         lib.ds_aio_file_size.argtypes = [_charp]
         lib.ds_aio_file_size.restype = ctypes.c_int64
+        lib.ds_aio_engine.argtypes = [_voidp]
+        lib.ds_aio_engine.restype = ctypes.c_int
         lib._ds_typed = True
     return lib
 
@@ -65,6 +68,15 @@ class AIOHandle:
             self._h = self._lib.ds_aio_create(block_size, num_threads)
         else:
             self._pool = ThreadPoolExecutor(max_workers=num_threads)
+
+    @property
+    def engine(self) -> str:
+        """Which backend is live: 'io_uring' (kernel ring, preferred),
+        'threadpool' (C++ pthread fallback), or 'python'."""
+        if self._h is not None:
+            return "io_uring" if self._lib.ds_aio_engine(self._h) else \
+                "threadpool"
+        return "python"
 
     # -- async ---------------------------------------------------------- #
     def pread(self, buffer: np.ndarray, filename: str, offset: int = 0):

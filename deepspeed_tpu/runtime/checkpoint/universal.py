@@ -25,6 +25,7 @@ import shutil
 from typing import Any, Dict, Optional, Tuple
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from ...utils.logging import log_dist, logger
@@ -42,6 +43,52 @@ def _safe(name: str) -> str:
     return re.sub(r"[^A-Za-z0-9_.\-]", "_", name)
 
 
+def _wait_for(fn: str, timeout_s: float = 300.0) -> None:
+    import time
+
+    t0 = time.time()
+    while not os.path.exists(fn):
+        if time.time() - t0 > timeout_s:
+            raise TimeoutError(f"rank-0 fragment file never appeared: {fn}")
+        time.sleep(0.2)
+
+
+def _dump_leaf(leaf, fn: str) -> None:
+    """Stream one (possibly sharded) leaf to a .npy WITHOUT ever gathering it
+    to host (r1 weak #6: a full device_get OOMs the host for any model that
+    needed ZeRO-3). Each process memmaps the file and writes only its
+    addressable replica-0 shards; host RAM stays O(largest shard)."""
+    dtype = getattr(leaf, "dtype", None) or np.asarray(leaf).dtype
+    is_float = jnp.issubdtype(dtype, jnp.floating)
+    target = np.float32 if is_float else np.dtype(str(dtype))
+    shape = tuple(leaf.shape) if hasattr(leaf, "shape") else np.shape(leaf)
+    if not hasattr(leaf, "addressable_shards"):
+        np.save(fn, np.asarray(leaf).astype(target))
+        return
+    if jax.process_index() == 0:
+        mm = np.lib.format.open_memmap(fn, mode="w+", dtype=target,
+                                       shape=shape)
+    else:  # shared FS: rank 0 creates the header, others attach
+        _wait_for(fn)
+        mm = None
+        for _ in range(100):  # existence != complete header: retry briefly
+            try:
+                mm = np.lib.format.open_memmap(fn, mode="r+")
+                break
+            except ValueError:
+                import time
+
+                time.sleep(0.1)
+        if mm is None:
+            raise IOError(f"fragment header never became readable: {fn}")
+    for shard in leaf.addressable_shards:
+        if shard.replica_id != 0:
+            continue  # exactly one writer per region
+        mm[shard.index] = np.asarray(shard.data).astype(target)
+    mm.flush()
+    del mm
+
+
 def _dump_tree(tree: Any, root: str) -> Dict[str, Dict]:
     index: Dict[str, Dict] = {}
     flat = jax.tree_util.tree_flatten_with_path(tree)[0]
@@ -49,10 +96,10 @@ def _dump_tree(tree: Any, root: str) -> Dict[str, Dict]:
         name = _safe(_path_str(path))
         d = os.path.join(root, name)
         os.makedirs(d, exist_ok=True)
-        arr = np.asarray(jax.device_get(leaf))
-        save = arr.astype(np.float32) if np.issubdtype(arr.dtype, np.floating) else arr
-        np.save(os.path.join(d, "fp32.npy"), save)
-        index[name] = {"shape": list(arr.shape), "dtype": str(arr.dtype)}
+        _dump_leaf(leaf, os.path.join(d, "fp32.npy"))
+        index[name] = {"shape": list(np.shape(leaf)),
+                       "dtype": str(getattr(leaf, "dtype",
+                                            np.asarray(leaf).dtype))}
     return index
 
 
@@ -64,32 +111,53 @@ def _load_tree_like(template: Any, root: str, *, place: bool = True) -> Any:
         fn = os.path.join(root, name, "fp32.npy")
         if not os.path.exists(fn):
             raise FileNotFoundError(f"universal checkpoint missing fragment {name}")
-        arr = np.load(fn)
+        # memmap: each device reads only ITS slice (topology-independent
+        # placement without a full host copy — the reference's
+        # load_hp_checkpoint_state fragment mapping, universal_checkpoint.py:99)
+        arr = np.load(fn, mmap_mode="r")
         dtype = getattr(leaf, "dtype", arr.dtype)
-        arr = arr.astype(dtype)
         if arr.shape != tuple(getattr(leaf, "shape", arr.shape)):
             raise ValueError(f"fragment {name}: shape {arr.shape} != "
                              f"expected {leaf.shape}")
         if place and hasattr(leaf, "sharding"):
-            leaves.append(jax.device_put(arr, leaf.sharding))
+            leaves.append(jax.make_array_from_callback(
+                arr.shape, leaf.sharding,
+                # astype always copies -> contiguous; np.asarray (NOT
+                # ascontiguousarray) keeps 0-d scalars 0-d
+                lambda idx, a=arr, dt=dtype: np.asarray(a[idx]).astype(dt)))
         else:
-            leaves.append(arr)
+            leaves.append(np.asarray(arr).astype(dtype))
     return jax.tree.unflatten(treedef, leaves)
 
 
 def save_universal(state, out_dir: str, *, meta: Optional[Dict] = None) -> str:
     """Write a TrainState (or any {'params':..., 'opt_state':...} mapping) as a
-    universal checkpoint. Atomic: writes to a temp dir then renames."""
+    universal checkpoint. Atomic: writes to a temp dir then renames.
+
+    Multi-process (shared FS): rank 0 owns the tmp-dir lifecycle and the
+    final rename; every rank writes its addressable shards and drops a
+    ``.done`` marker; rank 0 renames only after all markers arrive."""
     params = state.params if hasattr(state, "params") else state["params"]
     opt_state = state.opt_state if hasattr(state, "opt_state") else state.get("opt_state")
     final = os.path.join(out_dir, UNIVERSAL_DIR)
     tmp = final + ".tmp"
-    if os.path.exists(tmp):
-        shutil.rmtree(tmp)
-    os.makedirs(tmp, exist_ok=True)
+    rank, nproc = jax.process_index(), jax.process_count()
+    if rank == 0:
+        if os.path.exists(tmp):
+            shutil.rmtree(tmp)
+        os.makedirs(tmp, exist_ok=True)
+    else:
+        _wait_for(tmp)
     index = {"param": _dump_tree(params, os.path.join(tmp, "param"))}
     if opt_state is not None:
         index["optim"] = _dump_tree(opt_state, os.path.join(tmp, "optim"))
+    with open(os.path.join(tmp, f".rank{rank}.done"), "w") as f:
+        f.write("ok")
+    if rank != 0:
+        _wait_for(final)  # rank 0 renames once everyone is done
+        return final
+    for r in range(1, nproc):
+        _wait_for(os.path.join(tmp, f".rank{r}.done"))
     info = dict(meta or {})
     info["index"] = index
     with open(os.path.join(tmp, "meta.json"), "w") as f:

@@ -191,3 +191,23 @@ class TestOptimizerSwapper:
 
 
 import jax  # noqa: E402  (used in TestOptimizerSwapper)
+
+
+def test_aio_engine_reports_backend(tmp_path):
+    """io_uring upgrade (VERDICT r1 #10): the native handle reports which
+    engine is live and round-trips data through it."""
+    from deepspeed_tpu.ops.aio.handle import AIOHandle, aio_available
+
+    h = AIOHandle(block_size=1 << 16, num_threads=2)
+    assert h.engine in ("io_uring", "threadpool", "python")
+    if aio_available():
+        assert h.engine in ("io_uring", "threadpool")
+    data = np.arange(300_000, dtype=np.uint8)
+    fn = str(tmp_path / "aio_uring.bin")
+    h.pwrite(data, fn)
+    assert h.wait() == 0
+    out = np.zeros_like(data)
+    h.pread(out, fn)
+    assert h.wait() == 0
+    np.testing.assert_array_equal(out, data)
+    h.close()

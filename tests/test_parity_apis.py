@@ -201,3 +201,36 @@ def test_curriculum_in_engine(devices8):
         out = engine.train_batch({"tokens": t})
     assert engine.curriculum_scheduler.current_difficulty == 32
     assert np.isfinite(float(out.loss))
+
+
+def test_int4_and_fp8_quantized_inference(devices8):
+    """Packed-int4 (two nibbles/byte — real 2x footprint cut vs int8) and
+    fp8-e4m3 weight-only inference (reference inference/quantization INT4,
+    csrc/fp_quantizer)."""
+    cfg = llama.LlamaConfig.tiny()
+    params = llama.init(cfg, jax.random.PRNGKey(0))
+    mesh_lib.set_mesh(None)
+    ref = dst.init_inference(llama, model_cfg=cfg, params=params,
+                             config={"dtype": "float32"})
+    prompts = np.array([[5, 7, 11]], np.int32)
+    lr = np.asarray(ref.forward(prompts))
+
+    mesh_lib.set_mesh(None)
+    q4 = dst.init_inference(llama, model_cfg=cfg, params=params,
+                            config={"dtype": "float32",
+                                    "quant": {"enabled": True, "bits": 4}})
+    wq = q4.params["layers"]["wq"]
+    assert wq["q4"].dtype == jnp.uint8
+    assert wq["q4"].shape[-1] == cfg.num_heads * cfg.head_size // 2  # packed
+    l4 = np.asarray(q4.forward(prompts))
+    np.testing.assert_allclose(l4, lr, atol=1.5)  # 4-bit: looser
+    assert q4.generate(prompts, max_new_tokens=3).shape == (1, 3)
+
+    mesh_lib.set_mesh(None)
+    f8 = dst.init_inference(llama, model_cfg=cfg, params=params,
+                            config={"dtype": "float32",
+                                    "quant": {"enabled": True,
+                                              "dtype": "fp8"}})
+    assert f8.params["layers"]["wq"]["f8"].dtype == jnp.float8_e4m3fn
+    lf8 = np.asarray(f8.forward(prompts))
+    np.testing.assert_allclose(lf8, lr, atol=0.5)
