@@ -38,6 +38,7 @@ class GPTConfig:
     layer_norm_eps: float = 1e-5
     tie_embeddings: bool = True
     post_ln: bool = False     # True = original transformer/BLOOM ordering
+    activation: str = "gelu"  # "gelu" (GPT-2) | "relu" (OPT)
     remat: bool = False
 
     @property
@@ -155,10 +156,11 @@ def _attn(cfg: GPTConfig, x: jnp.ndarray, layer: Params,
 
 def _block(cfg: GPTConfig, x, layer, kv=None, cache_len=None):
     eps = cfg.layer_norm_eps
+    act = jax.nn.relu if cfg.activation == "relu" else jax.nn.gelu
     if cfg.post_ln:
         a, kv = _attn(cfg, x, layer, kv, cache_len)
         x = layer_norm(x + a, layer["ln1_scale"], layer["ln1_bias"], eps)
-        m = jax.nn.gelu(x @ layer["w_up"] + layer["b_up"]) @ layer["w_down"] \
+        m = act(x @ layer["w_up"] + layer["b_up"]) @ layer["w_down"] \
             + layer["b_down"]
         x = layer_norm(x + m, layer["ln2_scale"], layer["ln2_bias"], eps)
     else:  # pre-LN (GPT-2/OPT)
@@ -166,7 +168,7 @@ def _block(cfg: GPTConfig, x, layer, kv=None, cache_len=None):
         a, kv = _attn(cfg, y, layer, kv, cache_len)
         x = x + a
         y = layer_norm(x, layer["ln2_scale"], layer["ln2_bias"], eps)
-        x = x + jax.nn.gelu(y @ layer["w_up"] + layer["b_up"]) @ layer["w_down"] \
+        x = x + act(y @ layer["w_up"] + layer["b_up"]) @ layer["w_down"] \
             + layer["b_down"]
     return x, kv
 

@@ -10,7 +10,8 @@ then the checkpoint layer's job (orbax/universal).
 
 Supported families: Llama/Mistral/Qwen2/Phi-3 (→ ``models/llama``; fused
 QKV/gate-up checkpoints are split), GPT-2 (→ ``models/gpt``), Mixtral
-(→ ``models/mixtral``), Falcon (→ ``models/falcon``). Accepts a live
+(→ ``models/mixtral``), Falcon (→ ``models/falcon``), OPT (→ ``models/gpt``,
+ReLU/pre-LN). Accepts a live
 ``transformers`` model, a state-dict mapping, or a local checkpoint directory
 (no network access is assumed). Un-annotated models TP-shard via the AutoTP
 name-rule pass (``module_inject/auto_tp.py``).
@@ -183,6 +184,85 @@ def gpt2_params_from_hf(src, cfg=None) -> Params:
         "final_ln_bias": sd[pfx + "ln_f.bias"],
     }
     log_dist(f"imported HF gpt2-family weights: {L} layers")
+    return params
+
+
+def opt_config_from_hf(hf_config) -> "Any":
+    """Map a transformers OPTConfig onto the GPT family (pre-LN, ReLU,
+    learned positions; reference ``inference/v2/model_implementations/opt``)."""
+    from .gpt import GPTConfig
+
+    if getattr(hf_config, "word_embed_proj_dim",
+               hf_config.hidden_size) != hf_config.hidden_size:
+        raise ValueError("OPT variants with word_embed_proj_dim != "
+                         "hidden_size (opt-350m) are not supported")
+    if not getattr(hf_config, "do_layer_norm_before", True):
+        raise ValueError("OPT with do_layer_norm_before=False (opt-350m) "
+                         "is not supported")
+    act = getattr(hf_config, "activation_function", "relu")
+    if act != "relu":
+        # silently running a different activation would give wrong logits
+        # (and HF 'gelu' is exact-erf vs jax's tanh default)
+        raise ValueError(f"OPT activation_function={act!r} not supported "
+                         "(only 'relu', the released OPT family)")
+    return GPTConfig(
+        vocab_size=hf_config.vocab_size,
+        hidden_size=hf_config.hidden_size,
+        intermediate_size=hf_config.ffn_dim,
+        num_layers=hf_config.num_hidden_layers,
+        num_heads=hf_config.num_attention_heads,
+        max_seq_len=hf_config.max_position_embeddings,
+        activation=act,
+        tie_embeddings=bool(getattr(hf_config, "tie_word_embeddings", True)),
+    )
+
+
+def opt_params_from_hf(src, cfg=None) -> Params:
+    """HF OPTForCausalLM → ``models/gpt`` pytree: q/k/v/out projections fuse
+    into wqkv/bqkv; OPT's learned positions carry a +2 offset, dropped here
+    by slicing the table."""
+    sd = _normalize_state_dict(src)
+    pfx = "model.decoder." if any(k.startswith("model.decoder.") for k in sd) \
+        else "decoder." if any(k.startswith("decoder.") for k in sd) else ""
+    L = cfg.num_layers if cfg is not None else \
+        _count_indices(sd, rf"{re.escape(pfx)}layers\.(\d+)\.")
+    lay = pfx + "layers.{i}."
+
+    def fuse_qkv(i):
+        ws = [sd[lay.format(i=i) + f"self_attn.{p}_proj.weight"].T
+              for p in ("q", "k", "v")]
+        bs = [sd[lay.format(i=i) + f"self_attn.{p}_proj.bias"]
+              for p in ("q", "k", "v")]
+        return np.concatenate(ws, axis=1), np.concatenate(bs)
+
+    fused = [fuse_qkv(i) for i in range(L)]
+    params: Params = {
+        "embed": sd[pfx + "embed_tokens.weight"],
+        "pos_embed": sd[pfx + "embed_positions.weight"][2:],  # OPT offset
+        "layers": {
+            "ln1_scale": _stack(sd, lay + "self_attn_layer_norm.weight", L),
+            "ln1_bias": _stack(sd, lay + "self_attn_layer_norm.bias", L),
+            "wqkv": np.stack([w for w, _ in fused]),
+            "bqkv": np.stack([b for _, b in fused]),
+            "wo": _stack(sd, lay + "self_attn.out_proj.weight", L,
+                         transpose=True),
+            "bo": _stack(sd, lay + "self_attn.out_proj.bias", L),
+            "ln2_scale": _stack(sd, lay + "final_layer_norm.weight", L),
+            "ln2_bias": _stack(sd, lay + "final_layer_norm.bias", L),
+            "w_up": _stack(sd, lay + "fc1.weight", L, transpose=True),
+            "b_up": _stack(sd, lay + "fc1.bias", L),
+            "w_down": _stack(sd, lay + "fc2.weight", L, transpose=True),
+            "b_down": _stack(sd, lay + "fc2.bias", L),
+        },
+        "final_ln_scale": sd[pfx + "final_layer_norm.weight"],
+        "final_ln_bias": sd[pfx + "final_layer_norm.bias"],
+    }
+    if cfg is not None and not cfg.tie_embeddings:
+        if "lm_head.weight" not in sd:
+            raise ValueError("untied OPT config but checkpoint has no "
+                             "lm_head.weight")
+        params["lm_head"] = sd["lm_head.weight"].T
+    log_dist(f"imported HF opt weights: {L} layers")
     return params
 
 
@@ -411,6 +491,7 @@ _FAMILIES = {
     "qwen2": (llama_config_from_hf, llama_params_from_hf),
     "phi3": (llama_config_from_hf, phi3_params_from_hf),
     "gpt2": (gpt2_config_from_hf, gpt2_params_from_hf),
+    "opt": (opt_config_from_hf, opt_params_from_hf),
     "mixtral": (mixtral_config_from_hf, mixtral_params_from_hf),
     "falcon": (falcon_config_from_hf, falcon_params_from_hf),
 }

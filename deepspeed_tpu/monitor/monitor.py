@@ -1,8 +1,8 @@
-"""Metrics monitoring — fan-out to TensorBoard / WandB / CSV backends.
+"""Metrics monitoring — fan-out to TensorBoard / WandB / Comet / CSV backends.
 
 Reference parity: ``deepspeed/monitor/monitor.py:30 MonitorMaster`` with
-``tensorboard.py``, ``wandb.py``, ``csv_monitor.py`` (Comet omitted — no SDK
-in image; the backend registry accepts third-party writers). Each backend is
+``tensorboard.py``, ``wandb.py``, ``comet.py``, ``csv_monitor.py`` (the Comet
+backend enables only when the comet_ml SDK imports). Each backend is
 config-gated and degrades to disabled with a warning when its library is
 missing. Events are ``(name, value, step)`` tuples, written by rank 0 only
 (``jax.process_index() == 0``), matching the reference's rank-0 gating.
@@ -94,6 +94,41 @@ class WandbMonitor(MonitorBackend):
             self._wandb.log({name: float(value)}, step=int(step))
 
 
+class CometMonitor(MonitorBackend):
+    """Reference ``monitor/comet.py``; requires the comet_ml SDK."""
+
+    name = "comet"
+
+    def __init__(self, cfg):
+        super().__init__(cfg)
+        self.experiment = None
+        if not self.enabled:
+            return
+        try:
+            import comet_ml
+
+            self.experiment = comet_ml.Experiment(
+                project_name=getattr(cfg, "project", None) or cfg.job_name,
+                workspace=getattr(cfg, "workspace", None) or
+                getattr(cfg, "team", None))
+            name = getattr(cfg, "experiment_name", None)
+            if name:
+                self.experiment.set_name(name)
+        except Exception as e:
+            logger.warning(f"comet monitor disabled: {e}")
+            self.enabled = False
+
+    def write_events(self, events: Sequence[Event]) -> None:
+        if not self.experiment:
+            return
+        for name, value, step in events:
+            self.experiment.log_metric(name, float(value), step=int(step))
+
+    def flush(self) -> None:
+        if self.experiment:
+            self.experiment.flush()
+
+
 class CSVMonitor(MonitorBackend):
     """Reference ``monitor/csv_monitor.py`` — one CSV per metric name."""
 
@@ -144,6 +179,7 @@ class MonitorMaster(MonitorBackend):
             return
         for cls, sub in ((TensorBoardMonitor, getattr(cfg, "tensorboard", None)),
                          (WandbMonitor, getattr(cfg, "wandb", None)),
+                         (CometMonitor, getattr(cfg, "comet", None)),
                          (CSVMonitor, getattr(cfg, "csv_monitor", None))):
             if sub is not None and getattr(sub, "enabled", False):
                 b = cls(sub)

@@ -189,10 +189,12 @@ class MonitorBackendConfig(ConfigModel):
     enabled: bool = False
     output_path: str = ""
     job_name: str = "DeepSpeedTPUJob"
-    # wandb extras
+    # wandb / comet extras
     team: Optional[str] = None
     group: Optional[str] = None
     project: Optional[str] = None
+    workspace: Optional[str] = None
+    experiment_name: Optional[str] = None
 
 
 @register_config_model
@@ -266,6 +268,7 @@ class DeepSpeedTPUConfig:
     comms_logger: CommsLoggerConfig = field(default_factory=CommsLoggerConfig)
     tensorboard: MonitorBackendConfig = field(default_factory=MonitorBackendConfig)
     wandb: MonitorBackendConfig = field(default_factory=MonitorBackendConfig)
+    comet: MonitorBackendConfig = field(default_factory=MonitorBackendConfig)
     csv_monitor: MonitorBackendConfig = field(default_factory=MonitorBackendConfig)
     checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
     aio: AIOConfig = field(default_factory=AIOConfig)
@@ -332,6 +335,7 @@ _SUBCONFIG_KEYS = {
     "comms_logger": CommsLoggerConfig,
     "tensorboard": MonitorBackendConfig,
     "wandb": MonitorBackendConfig,
+    "comet": MonitorBackendConfig,
     "csv_monitor": MonitorBackendConfig,
     "checkpoint": CheckpointConfig,
     "aio": AIOConfig,

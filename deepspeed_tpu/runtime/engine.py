@@ -94,9 +94,7 @@ class StepOutput(NamedTuple):
     aux: Dict[str, Any]
 
 
-def _global_norm(tree) -> jnp.ndarray:
-    leaves = [jnp.sum(jnp.square(g.astype(jnp.float32))) for g in jax.tree.leaves(tree)]
-    return jnp.sqrt(jnp.sum(jnp.stack(leaves)))
+from .utils import global_norm as _global_norm  # shared with runtime.utils
 
 
 class DeepSpeedTPUEngine:

@@ -1,0 +1,53 @@
+"""Misc runtime utilities — reference ``deepspeed/runtime/utils.py`` parity:
+``clip_grad_norm_``, ``CheckOverflow``, ``see_memory_usage`` (re-export).
+
+The engine does clipping/overflow inside the compiled step; these standalone
+versions serve user code and tests that drive grads outside the engine
+(reference-style ``tensor.backward()`` flows)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..utils.memory import memory_stats, see_memory_usage  # noqa: F401
+from .precision import grads_finite
+
+
+def global_norm(tree: Any) -> jnp.ndarray:
+    leaves = [jnp.sum(jnp.square(g.astype(jnp.float32)))
+              for g in jax.tree.leaves(tree)]
+    return jnp.sqrt(jnp.sum(jnp.stack(leaves)))
+
+
+def clip_grad_norm_(grads: Any, max_norm: float,
+                    norm: Optional[jnp.ndarray] = None
+                    ) -> Tuple[Any, jnp.ndarray]:
+    """Scale ``grads`` so their global norm is at most ``max_norm``
+    (reference ``clip_grad_norm_``). Returns (clipped, pre-clip norm)."""
+    norm = global_norm(grads) if norm is None else norm
+    coef = jnp.minimum(1.0, max_norm / (norm + 1e-6))
+    return jax.tree.map(lambda g: g * coef, grads), norm
+
+
+class CheckOverflow:
+    """Reference ``CheckOverflow``: scan grads for inf/nan. Under SPMD the
+    scan is already global (no cross-rank allreduce needed); tracks how many
+    consecutive overflows were seen (the loss-scaler hysteresis input)."""
+
+    def __init__(self, param_groups: Any = None):
+        self.params = param_groups
+        self.consecutive_overflows = 0
+
+    def check(self, grads: Any) -> bool:
+        """True if ANY grad leaf contains inf/nan."""
+        overflow = not bool(grads_finite(grads))
+        self.consecutive_overflows = \
+            self.consecutive_overflows + 1 if overflow else 0
+        return overflow
+
+    def check_using_norm(self, norm_group: Any) -> bool:
+        arr = jnp.asarray(jax.tree.leaves(norm_group))
+        return not bool(jnp.all(jnp.isfinite(arr)))

@@ -275,3 +275,24 @@ def test_rope_scaling_rejected():
 
     with pytest.raises(ValueError, match="rope_scaling"):
         llama_config_from_hf(hf_cfg)
+
+
+def test_opt_logit_parity():
+    """OPT → GPT family (pre-LN, ReLU, +2 position offset, fused QKV)."""
+    from deepspeed_tpu.models import gpt
+
+    hf_cfg = transformers.OPTConfig(
+        vocab_size=128, hidden_size=64, ffn_dim=256, num_hidden_layers=2,
+        num_attention_heads=4, max_position_embeddings=64,
+        do_layer_norm_before=True, activation_function="relu",
+        word_embed_proj_dim=64)
+    torch.manual_seed(10)
+    hf_model = transformers.OPTForCausalLM(hf_cfg).eval()
+    cfg, params = from_hf(hf_model)
+    assert cfg.activation == "relu"
+    tokens = np.random.RandomState(10).randint(4, 128, (2, 10))
+    with torch.no_grad():
+        ref = hf_model(torch.tensor(tokens)).logits.numpy()
+    ours = np.asarray(gpt.apply(cfg, params, jnp.asarray(tokens),
+                                compute_dtype=jnp.float32))
+    np.testing.assert_allclose(ours, ref, rtol=2e-3, atol=2e-3)

@@ -112,3 +112,21 @@ def test_blocksparse_restricts_attention():
     out2 = blocksparse_attention(q, k2, v2, diag, block_size=8, causal=True)
     np.testing.assert_allclose(np.asarray(out[:, 8:]), np.asarray(out2[:, 8:]),
                                rtol=1e-5)
+
+
+def test_check_overflow_and_clip():
+    """Reference runtime/utils.py parity: CheckOverflow + clip_grad_norm_."""
+    from deepspeed_tpu.runtime.utils import CheckOverflow, clip_grad_norm_
+
+    co = CheckOverflow()
+    good = {"a": jnp.ones((4,)), "b": jnp.ones((2, 2))}
+    assert not co.check(good) and co.consecutive_overflows == 0
+    bad = {"a": jnp.asarray([1.0, jnp.inf, 0.0, 1.0]), "b": jnp.ones((2, 2))}
+    assert co.check(bad) and co.consecutive_overflows == 1
+    assert co.check(bad) and co.consecutive_overflows == 2
+    assert not co.check(good) and co.consecutive_overflows == 0
+    assert co.check_using_norm([jnp.asarray(jnp.nan)])
+
+    clipped, norm = clip_grad_norm_({"g": jnp.full((4,), 3.0)}, max_norm=1.0)
+    assert float(norm) == pytest.approx(6.0)
+    assert float(jnp.linalg.norm(clipped["g"])) == pytest.approx(1.0, rel=1e-4)
