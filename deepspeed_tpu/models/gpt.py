@@ -41,6 +41,11 @@ class GPTConfig:
     activation: str = "gelu"  # "gelu" (GPT-2) | "relu" (OPT)
     remat: bool = False
 
+    def __post_init__(self):
+        if self.activation not in ("gelu", "relu"):
+            raise ValueError(f"unsupported activation {self.activation!r} "
+                             "(gelu | relu)")
+
     @property
     def head_size(self) -> int:
         return self.hidden_size // self.num_heads

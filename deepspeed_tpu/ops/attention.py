@@ -36,8 +36,10 @@ def repeat_kv(k: jnp.ndarray, num_q_heads: int) -> jnp.ndarray:
 def attention_xla(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
                   causal: bool = True, scale: Optional[float] = None,
                   mask: Optional[jnp.ndarray] = None,
+                  bias: Optional[jnp.ndarray] = None,
                   q_offset: int = 0) -> jnp.ndarray:
     """mask: optional [batch, 1|heads, q_len, kv_len] additive or boolean mask.
+    bias: optional ADDITIVE logits term (same broadcast shape; differentiable).
     ``q_offset``: absolute position of q[0] within the kv sequence (decode /
     chunked long-seq paths)."""
     q_len, num_heads = q.shape[-3], q.shape[-2]
@@ -52,6 +54,8 @@ def attention_xla(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
         kv_pos = jnp.arange(kv_len)[None, :]
         causal_mask = q_pos >= kv_pos  # True = attend
         logits = jnp.where(causal_mask, logits, NEG_INF)
+    if bias is not None:
+        logits = logits + bias.astype(jnp.float32)
     if mask is not None:
         if mask.dtype == jnp.bool_:
             logits = jnp.where(mask, logits, NEG_INF)

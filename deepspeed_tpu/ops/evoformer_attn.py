@@ -25,13 +25,37 @@ NEG_INF = -1e30
 
 def evoformer_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                         biases: Optional[Sequence[jnp.ndarray]] = None,
-                        scale: Optional[float] = None) -> jnp.ndarray:
+                        scale: Optional[float] = None,
+                        use_kernel: Optional[bool] = None) -> jnp.ndarray:
     """softmax(q·kᵀ/√d + Σ biases)·v over the residue axis.
 
     q/k/v: [*, s, r, h, d] (MSA rows s, residues r). Returns same shape as q.
+
+    Kernel path (default on TPU): MSA rows fold into the batch dim and the
+    summed bias rides the flash kernel's additive-bias input. The score/probs
+    matrices stay blockwise in VMEM (the XLA path materializes BOTH in fp32);
+    the SUMMED fp32 bias is still materialized once — same footprint as one
+    logits tensor — and dbias flows through the backward kernel (the DS4Sci
+    kernel's differentiable pair bias). Per-input block-indexed biases (no
+    summed materialization) are a future optimization.
     """
     *lead, s, r, h, d = q.shape
     scale = scale if scale is not None else d ** -0.5
+    if use_kernel is None:
+        use_kernel = jax.default_backend() == "tpu"
+    if use_kernel:
+        from .pallas.flash_attention import flash_attention
+
+        fold = lambda x: x.reshape((-1, r, h, d))  # noqa: E731
+        bias = None
+        if biases:
+            bias = sum(jnp.broadcast_to(b.astype(jnp.float32),
+                                        tuple(lead) + (s, h, r, r))
+                       for b in biases)
+            bias = bias.reshape((-1, h, r, r))
+        out = flash_attention(fold(q), fold(k), fold(v), causal=False,
+                              scale=scale, bias=bias)
+        return out.reshape(q.shape).astype(q.dtype)
     logits = jnp.einsum("...sqhd,...skhd->...shqk", q, k,
                         preferred_element_type=jnp.float32) * scale
     for b in (biases or ()):

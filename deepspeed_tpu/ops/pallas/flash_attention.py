@@ -60,8 +60,14 @@ def _pad_to(x: jnp.ndarray, axis: int, mult: int) -> jnp.ndarray:
 # --------------------------------------------------------------------------- #
 # forward
 # --------------------------------------------------------------------------- #
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
-                scale, causal, bq, bkv, kv_len, q_offset, nkv):
+def _fwd_kernel(*refs, scale, causal, bq, bkv, kv_len, q_offset, nkv,
+                has_bias):
+    if has_bias:
+        (q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
+         m_scr, l_scr, acc_scr) = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
+        bias_ref = None
     ki = pl.program_id(2)
 
     @pl.when(ki == 0)
@@ -81,6 +87,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
         v = v_ref[0]                              # [bkv, d]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
+        if bias_ref is not None:
+            s = s + bias_ref[0].astype(jnp.float32)
 
         q_idx = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 0) + q_offset
         kv_idx = ki * bkv + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 1)
@@ -116,8 +124,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
         lse_ref[0] = m_scr[...] + jnp.log(l_safe)
 
 
-def _flash_fwd(q, k, v, *, causal, scale, q_offset):
-    """q/k/v: [BH, S, d] → (o [BH, Sq, d], lse [BH, Sq, 128])."""
+def _flash_fwd(q, k, v, bias=None, *, causal, scale, q_offset):
+    """q/k/v: [BH, S, d] (+ optional bias [BH, Sq, Skv]) →
+    (o [BH, Sq, d], lse [BH, Sq, 128])."""
     bh, sq, d = q.shape
     kv_len = k.shape[1]
     bq = _block(sq)
@@ -128,17 +137,24 @@ def _flash_fwd(q, k, v, *, causal, scale, q_offset):
     nq = qp.shape[1] // bq
     nkv = kp.shape[1] // bkv
 
+    in_specs = [
+        pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, bkv, d), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, bkv, d), lambda b, i, j: (b, j, 0)),
+    ]
+    args = [qp, kp, vp]
+    if bias is not None:
+        bp = _pad_to(_pad_to(bias, 1, bq), 2, bkv)
+        in_specs.append(pl.BlockSpec((1, bq, bkv), lambda b, i, j: (b, i, j)))
+        args.append(bp)
+
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, bq=bq, bkv=bkv,
-        kv_len=kv_len, q_offset=q_offset, nkv=nkv)
+        kv_len=kv_len, q_offset=q_offset, nkv=nkv, has_bias=bias is not None)
     o, lse = pl.pallas_call(
         kernel,
         grid=(bh, nq, nkv),
-        in_specs=[
-            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bkv, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, bkv, d), lambda b, i, j: (b, j, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, bq, 128), lambda b, i, j: (b, i, 0)),
@@ -153,15 +169,22 @@ def _flash_fwd(q, k, v, *, causal, scale, q_offset):
             pltpu.VMEM((bq, d), jnp.float32),
         ],
         interpret=_interpret(),
-    )(qp, kp, vp)
+    )(*args)
     return o[:, :sq], lse[:, :sq]
 
 
 # --------------------------------------------------------------------------- #
 # backward
 # --------------------------------------------------------------------------- #
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   dq_scr, *, scale, causal, bq, bkv, kv_len, q_offset, nkv):
+def _bwd_dq_kernel(*refs, scale, causal, bq, bkv, kv_len, q_offset, nkv,
+                   has_bias):
+    if has_bias:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref,
+         dq_ref, dbias_ref, dq_scr) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dq_ref, dq_scr) = refs
+        bias_ref = dbias_ref = None
     ki = pl.program_id(2)
 
     @pl.when(ki == 0)
@@ -179,6 +202,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
+        if bias_ref is not None:
+            s = s + bias_ref[0].astype(jnp.float32)
         q_idx = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 0) + q_offset
         kv_idx = ki * bkv + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 1)
         mask = kv_idx < kv_len
@@ -187,12 +212,21 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         p = jnp.where(mask, jnp.exp(s - lse), 0.0)            # [bq, bkv]
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = (p * (dp - delta) * scale).astype(k.dtype)
+        ds_raw = p * (dp - delta)   # dL/d(logits) — the bias gradient
+        if dbias_ref is not None:
+            dbias_ref[0] = ds_raw.astype(dbias_ref.dtype)
+        ds = (ds_raw * scale).astype(k.dtype)
         dq_scr[...] += jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
                                            preferred_element_type=jnp.float32)
 
     if causal:
         pl.when(ki * bkv <= qi * bq + (bq - 1) + q_offset)(_compute)
+
+        if dbias_ref is not None:
+            # skipped above-diagonal blocks must still zero their dbias block
+            @pl.when(ki * bkv > qi * bq + (bq - 1) + q_offset)
+            def _zero_dbias():
+                dbias_ref[0] = jnp.zeros_like(dbias_ref[0])
     else:
         _compute()
 
@@ -201,9 +235,15 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, dk_scr, dv_scr, *, scale, causal, bq, bkv,
-                    kv_len, q_offset, nq):
+def _bwd_dkv_kernel(*refs, scale, causal, bq, bkv, kv_len, q_offset, nq,
+                    has_bias):
+    if has_bias:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref,
+         dk_ref, dv_ref, dk_scr, dv_scr) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dk_ref, dv_ref, dk_scr, dv_scr) = refs
+        bias_ref = None
     qi = pl.program_id(2)
 
     @pl.when(qi == 0)
@@ -222,6 +262,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
+        if bias_ref is not None:
+            s = s + bias_ref[0].astype(jnp.float32)
         q_idx = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 0) + q_offset
         kv_idx = ki * bkv + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 1)
         mask = kv_idx < kv_len
@@ -248,7 +290,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
 
 
-def _flash_bwd(q, k, v, o, lse, do, *, causal, scale, q_offset):
+def _flash_bwd(q, k, v, o, lse, do, bias=None, *, causal, scale, q_offset):
     bh, sq, d = q.shape
     kv_len = k.shape[1]
     bq = _block(sq)
@@ -259,42 +301,71 @@ def _flash_bwd(q, k, v, o, lse, do, *, causal, scale, q_offset):
     dop = _pad_to(do, 1, bq)
     nq = qp.shape[1] // bq
     nkv = kp.shape[1] // bkv
+    has_bias = bias is not None
 
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
     delta = jnp.broadcast_to(delta[..., None], delta.shape + (128,))
     delta = _pad_to(delta, 1, bq)
     lsep = _pad_to(lse, 1, bq)
 
-    dq = pl.pallas_call(
+    dq_in_specs = [
+        pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, bkv, d), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, bkv, d), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, bq, 128), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, bq, 128), lambda b, i, j: (b, i, 0)),
+    ]
+    dq_args = [qp, kp, vp, dop, lsep, delta]
+    dq_out_specs = pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0))
+    dq_out_shape = jax.ShapeDtypeStruct((bh, qp.shape[1], d), q.dtype)
+    if has_bias:
+        bp = _pad_to(_pad_to(bias, 1, bq), 2, bkv)
+        dq_in_specs.append(pl.BlockSpec((1, bq, bkv),
+                                        lambda b, i, j: (b, i, j)))
+        dq_args.append(bp)
+        dq_out_specs = [dq_out_specs,
+                        pl.BlockSpec((1, bq, bkv), lambda b, i, j: (b, i, j))]
+        dq_out_shape = [dq_out_shape,
+                        jax.ShapeDtypeStruct(bp.shape, jnp.float32)]
+
+    dq_out = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal, bq=bq,
-                          bkv=bkv, kv_len=kv_len, q_offset=q_offset, nkv=nkv),
+                          bkv=bkv, kv_len=kv_len, q_offset=q_offset, nkv=nkv,
+                          has_bias=has_bias),
         grid=(bh, nq, nkv),
-        in_specs=[
-            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bkv, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, bkv, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bq, 128), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bq, 128), lambda b, i, j: (b, i, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, qp.shape[1], d), q.dtype),
+        in_specs=dq_in_specs,
+        out_specs=dq_out_specs,
+        out_shape=dq_out_shape,
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         interpret=_interpret(),
-    )(qp, kp, vp, dop, lsep, delta)
+    )(*dq_args)
+    if has_bias:
+        dq, dbias = dq_out
+        dbias = dbias[:, :sq, :kv_len]
+    else:
+        dq, dbias = dq_out, None
+
+    dkv_in_specs = [
+        pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),
+        pl.BlockSpec((1, bkv, d), lambda b, j, i: (b, j, 0)),
+        pl.BlockSpec((1, bkv, d), lambda b, j, i: (b, j, 0)),
+        pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),
+        pl.BlockSpec((1, bq, 128), lambda b, j, i: (b, i, 0)),
+        pl.BlockSpec((1, bq, 128), lambda b, j, i: (b, i, 0)),
+    ]
+    dkv_args = [qp, kp, vp, dop, lsep, delta]
+    if has_bias:
+        dkv_in_specs.append(pl.BlockSpec((1, bq, bkv),
+                                         lambda b, j, i: (b, i, j)))
+        dkv_args.append(bp)
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal, bq=bq,
-                          bkv=bkv, kv_len=kv_len, q_offset=q_offset, nq=nq),
+                          bkv=bkv, kv_len=kv_len, q_offset=q_offset, nq=nq,
+                          has_bias=has_bias),
         grid=(bh, nkv, nq),
-        in_specs=[
-            pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, bkv, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, bkv, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, bq, 128), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, bq, 128), lambda b, j, i: (b, i, 0)),
-        ],
+        in_specs=dkv_in_specs,
         out_specs=[
             pl.BlockSpec((1, bkv, d), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, bkv, d), lambda b, j, i: (b, j, 0)),
@@ -308,8 +379,8 @@ def _flash_bwd(q, k, v, o, lse, do, *, causal, scale, q_offset):
             pltpu.VMEM((bkv, d), jnp.float32),
         ],
         interpret=_interpret(),
-    )(qp, kp, vp, dop, lsep, delta)
-    return dq[:, :sq], dk[:, :kv_len], dv[:, :kv_len]
+    )(*dkv_args)
+    return dq[:, :sq], dk[:, :kv_len], dv[:, :kv_len], dbias
 
 
 # --------------------------------------------------------------------------- #
@@ -328,26 +399,53 @@ def _flash_vjp_fwd(q, k, v, causal, scale, q_offset):
 
 def _flash_vjp_bwd(causal, scale, q_offset, res, do):
     q, k, v, o, lse = res
-    dq, dk, dv = _flash_bwd(q, k, v, o, lse, do, causal=causal, scale=scale,
-                            q_offset=q_offset)
+    dq, dk, dv, _ = _flash_bwd(q, k, v, o, lse, do, causal=causal,
+                               scale=scale, q_offset=q_offset)
     return dq, dk, dv
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _flash_b(q, k, v, bias, causal, scale, q_offset):
+    o, _ = _flash_fwd(q, k, v, bias, causal=causal, scale=scale,
+                      q_offset=q_offset)
+    return o
+
+
+def _flash_b_vjp_fwd(q, k, v, bias, causal, scale, q_offset):
+    o, lse = _flash_fwd(q, k, v, bias, causal=causal, scale=scale,
+                        q_offset=q_offset)
+    return o, (q, k, v, bias, o, lse)
+
+
+def _flash_b_vjp_bwd(causal, scale, q_offset, res, do):
+    q, k, v, bias, o, lse = res
+    dq, dk, dv, dbias = _flash_bwd(q, k, v, o, lse, do, bias, causal=causal,
+                                   scale=scale, q_offset=q_offset)
+    return dq, dk, dv, dbias.astype(bias.dtype)
+
+
+_flash_b.defvjp(_flash_b_vjp_fwd, _flash_b_vjp_bwd)
+
+
 def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
                     causal: bool = True, scale: Optional[float] = None,
                     mask: Optional[jnp.ndarray] = None,
+                    bias: Optional[jnp.ndarray] = None,
                     q_offset: int = 0) -> jnp.ndarray:
     """Drop-in for ``ops.attention.attention_xla``: [B, S, H, D] layout, GQA
-    K/V broadcast, fp32 accumulation. Arbitrary additive masks fall back to
-    the XLA implementation (the kernel handles causal + length masking)."""
+    K/V broadcast, fp32 accumulation. Supports an ADDITIVE bias
+    (broadcastable to [B, H, Sq, Skv]; differentiable — dbias flows through
+    the backward kernel; the evoformer pair-bias path). Boolean masks fall
+    back to the XLA implementation (the kernel handles causal + length
+    masking natively)."""
     if mask is not None:
         from ..attention import attention_xla
 
         return attention_xla(q, k, v, causal=causal, scale=scale, mask=mask,
-                             q_offset=q_offset)
+                             bias=bias, q_offset=q_offset)
     from ..attention import repeat_kv
 
     b, sq, h, d = q.shape
@@ -359,7 +457,14 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
     def to_bh(x):
         return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
 
-    o = _flash(to_bh(q), to_bh(k), to_bh(v), causal, float(scale), int(q_offset))
+    if bias is not None:
+        bias = jnp.broadcast_to(bias, (b, h, sq, kv_len)) \
+            .reshape(b * h, sq, kv_len)
+        o = _flash_b(to_bh(q), to_bh(k), to_bh(v), bias, causal,
+                     float(scale), int(q_offset))
+    else:
+        o = _flash(to_bh(q), to_bh(k), to_bh(v), causal, float(scale),
+                   int(q_offset))
     return o.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
 
 
