@@ -61,19 +61,8 @@ def bigbird_layout(num_blocks: int, window_blocks: int = 3,
     return lay
 
 
-def blocksparse_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
-                          layout: np.ndarray, block_size: int,
-                          causal: bool = True,
-                          scale: Optional[float] = None) -> jnp.ndarray:
-    """q/k/v: [batch, seq, heads, head_dim]; layout [q_blocks, kv_blocks]
-    (static). Tokens attend iff their blocks are connected AND (optionally)
-    causally ordered."""
+def _dense_masked(q, k, v, layout, block_size, causal, scale):
     s = q.shape[1]
-    if s % block_size:
-        raise ValueError(f"seq {s} not divisible by block {block_size}")
-    nb = s // block_size
-    if layout.shape != (nb, nb):
-        raise ValueError(f"layout {layout.shape} != ({nb},{nb})")
     block_mask = jnp.asarray(layout)
     token_mask = jnp.repeat(jnp.repeat(block_mask, block_size, 0),
                             block_size, 1)  # [s, s]
@@ -81,3 +70,50 @@ def blocksparse_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         token_mask = token_mask & jnp.tril(jnp.ones((s, s), bool))
     return attention(q, k, v, causal=False,
                      mask=token_mask[None, None], scale=scale)
+
+
+def blocksparse_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                          layout: np.ndarray, block_size: int,
+                          causal: bool = True,
+                          scale: Optional[float] = None,
+                          use_kernel: Optional[bool] = None) -> jnp.ndarray:
+    """q/k/v: [batch, seq, heads, head_dim]; layout [q_blocks, kv_blocks]
+    (static). Tokens attend iff their blocks are connected AND (optionally)
+    causally ordered.
+
+    Kernel path (default on TPU): the Pallas block-sparse flash kernel SKIPS
+    inactive blocks — work scales with layout density. Backward recomputes
+    through the dense-masked path (exact gradients; skipping bwd kernel is a
+    future optimization)."""
+    s = q.shape[1]
+    if s % block_size:
+        raise ValueError(f"seq {s} not divisible by block {block_size}")
+    nb = s // block_size
+    if layout.shape != (nb, nb):
+        raise ValueError(f"layout {layout.shape} != ({nb},{nb})")
+    if use_kernel is None:
+        use_kernel = jax.default_backend() == "tpu"
+    if not use_kernel:
+        return _dense_masked(q, k, v, layout, block_size, causal, scale)
+
+    from .pallas.sparse_attention import sparse_flash_attention_fwd
+
+    lay = np.asarray(layout)
+
+    @jax.custom_vjp
+    def _sparse(q, k, v):
+        return sparse_flash_attention_fwd(q, k, v, lay, block_size,
+                                          causal=causal, scale=scale)
+
+    def _fwd(q, k, v):
+        return _sparse(q, k, v), (q, k, v)
+
+    def _bwd(res, g):
+        q, k, v = res
+        _, vjp = jax.vjp(
+            lambda q_, k_, v_: _dense_masked(q_, k_, v_, lay, block_size,
+                                             causal, scale), q, k, v)
+        return vjp(g)
+
+    _sparse.defvjp(_fwd, _bwd)
+    return _sparse(q, k, v)
