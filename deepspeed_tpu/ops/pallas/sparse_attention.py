@@ -33,23 +33,23 @@ from ._common import interpret as _interpret
 NEG_INF = -1e30
 
 
-def _sparse_fwd_kernel(layout_ref, q_ref, k_ref, v_ref, o_ref,
-                       m_scr, l_scr, acc_scr, *, scale, causal, bs, nkv):
+def _sparse_fwd_kernel(idx_ref, cnt_ref, q_ref, k_ref, v_ref, o_ref,
+                       m_scr, l_scr, acc_scr, *, scale, causal, bs, max_a):
     qi = pl.program_id(1)
-    ki = pl.program_id(2)
+    j = pl.program_id(2)
 
-    @pl.when(ki == 0)
+    @pl.when(j == 0)
     def _init():
         m_scr[...] = jnp.full_like(m_scr, NEG_INF)
         l_scr[...] = jnp.zeros_like(l_scr)
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    active = layout_ref[qi, ki] != 0
-    if causal:
-        active = jnp.logical_and(active, ki <= qi)
-
-    @pl.when(active)
+    # j indexes the COMPACTED active-block list for this q row; padded slots
+    # (j >= count) repeat the last active block id, so their DMA is a cache
+    # hit and their compute is skipped
+    @pl.when(j < cnt_ref[qi])
     def _compute():
+        ki = idx_ref[qi, j]
         q = q_ref[0]
         k = k_ref[0]
         v = v_ref[0]
@@ -73,41 +73,71 @@ def _sparse_fwd_kernel(layout_ref, q_ref, k_ref, v_ref, o_ref,
             preferred_element_type=jnp.float32)
         m_scr[...] = m_new
 
-    @pl.when(ki == nkv - 1)
+    @pl.when(j == max_a - 1)
     def _finish():
         l = l_scr[...]
         l_safe = jnp.where(l == 0.0, 1.0, l)
         o_ref[0] = (acc_scr[...] / l_safe[:, :1]).astype(o_ref.dtype)
 
 
+def compact_layout(layout: np.ndarray, causal: bool) -> tuple:
+    """[nb, nb] bool → (indices [nb, max_active], counts [nb]). Every q row
+    must keep ≥1 active block (an empty row has no well-defined softmax)."""
+    lay = np.asarray(layout, bool).copy()
+    nb = lay.shape[0]
+    if causal:
+        lay &= np.tril(np.ones((nb, nb), bool))
+    counts = lay.sum(axis=1)
+    if (counts == 0).any():
+        bad = np.nonzero(counts == 0)[0]
+        raise ValueError(
+            f"layout rows {bad.tolist()} attend to no kv block"
+            f"{' after causal masking' if causal else ''} — softmax over an "
+            f"empty row is undefined; give every q block at least one target")
+    max_a = int(counts.max())
+    idx = np.zeros((nb, max_a), np.int32)
+    for i in range(nb):
+        act = np.nonzero(lay[i])[0]
+        idx[i, :len(act)] = act
+        idx[i, len(act):] = act[-1]  # repeat → DMA reuse, compute skipped
+    return idx, counts.astype(np.int32)
+
+
 def sparse_flash_attention_fwd(q: jnp.ndarray, k: jnp.ndarray,
                                v: jnp.ndarray, layout: np.ndarray,
                                block_size: int, *, causal: bool = True,
                                scale: Optional[float] = None) -> jnp.ndarray:
-    """q/k/v [B, S, H, D]; layout [S/bs, S/bs] (static bool). Returns o."""
+    """q/k/v [B, S, H, D]; layout [S/bs, S/bs] (static bool). Returns o.
+    Grid runs over the compacted active-block lists, so BOTH compute and
+    DMA scale with layout density."""
     from ..attention import repeat_kv
 
     b, s, h, d = q.shape
     k = repeat_kv(k, h)
     v = repeat_kv(v, h)
-    nb = s // block_size
     scale = d ** -0.5 if scale is None else scale
+    nb = s // block_size
+    idx, counts = compact_layout(layout, causal)
+    max_a = idx.shape[1]
 
     def to_bh(x):
         return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
 
     kernel = functools.partial(_sparse_fwd_kernel, scale=float(scale),
-                               causal=causal, bs=block_size, nkv=nb)
+                               causal=causal, bs=block_size, max_a=max_a)
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,
-        grid=(b * h, nb, nb),
+        num_scalar_prefetch=2,
+        grid=(b * h, nb, max_a),
         in_specs=[
-            pl.BlockSpec((1, block_size, d), lambda bh, i, j, lay: (bh, i, 0)),
-            pl.BlockSpec((1, block_size, d), lambda bh, i, j, lay: (bh, j, 0)),
-            pl.BlockSpec((1, block_size, d), lambda bh, i, j, lay: (bh, j, 0)),
+            pl.BlockSpec((1, block_size, d),
+                         lambda bh, i, j, idx, cnt: (bh, i, 0)),
+            pl.BlockSpec((1, block_size, d),
+                         lambda bh, i, j, idx, cnt: (bh, idx[i, j], 0)),
+            pl.BlockSpec((1, block_size, d),
+                         lambda bh, i, j, idx, cnt: (bh, idx[i, j], 0)),
         ],
         out_specs=pl.BlockSpec((1, block_size, d),
-                               lambda bh, i, j, lay: (bh, i, 0)),
+                               lambda bh, i, j, idx, cnt: (bh, i, 0)),
         scratch_shapes=[
             pltpu.VMEM((block_size, 128), jnp.float32),
             pltpu.VMEM((block_size, 128), jnp.float32),
@@ -118,7 +148,7 @@ def sparse_flash_attention_fwd(q: jnp.ndarray, k: jnp.ndarray,
         kernel, grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
         interpret=_interpret(),
-    )(jnp.asarray(np.asarray(layout), jnp.int32), to_bh(q), to_bh(k), to_bh(v))
+    )(jnp.asarray(idx), jnp.asarray(counts), to_bh(q), to_bh(k), to_bh(v))
     return o.reshape(b, h, s, d).transpose(0, 2, 1, 3)
 
 

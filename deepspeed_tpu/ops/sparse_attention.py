@@ -12,6 +12,8 @@ them outright. Layout builders mirror the reference's config families:
 
 from __future__ import annotations
 
+import functools
+
 from typing import Optional
 
 import jax
@@ -91,14 +93,29 @@ def blocksparse_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     nb = s // block_size
     if layout.shape != (nb, nb):
         raise ValueError(f"layout {layout.shape} != ({nb},{nb})")
+    from .pallas.sparse_attention import compact_layout
+
+    # validates every q row keeps >=1 active block (empty-row softmax is
+    # undefined — and the kernel fwd / dense bwd would disagree about it)
+    compact_layout(layout, causal)
     if use_kernel is None:
         use_kernel = jax.default_backend() == "tpu"
     if not use_kernel:
         return _dense_masked(q, k, v, layout, block_size, causal, scale)
+    lay = np.asarray(layout, bool)
+    fn = _kernel_vjp(lay.tobytes(), lay.shape[0], block_size, causal,
+                     None if scale is None else float(scale))
+    return fn(q, k, v)
 
+
+@functools.lru_cache(maxsize=64)
+def _kernel_vjp(layout_bytes: bytes, nb: int, block_size: int, causal: bool,
+                scale: Optional[float]):
+    """One cached custom_vjp closure per (layout, geometry) — a per-call
+    closure would defeat JAX's function-identity trace caches."""
     from .pallas.sparse_attention import sparse_flash_attention_fwd
 
-    lay = np.asarray(layout)
+    lay = np.frombuffer(layout_bytes, bool).reshape(nb, nb)
 
     @jax.custom_vjp
     def _sparse(q, k, v):
@@ -116,4 +133,4 @@ def blocksparse_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         return vjp(g)
 
     _sparse.defvjp(_fwd, _bwd)
-    return _sparse(q, k, v)
+    return _sparse

@@ -269,9 +269,19 @@ def test_blocksparse_kernel_matches_dense_mask():
                                     use_kernel=True)
         np.testing.assert_allclose(np.asarray(ker), np.asarray(ref),
                                    rtol=2e-5, atol=2e-5)
-        g_ref = jax.grad(lambda q_: jnp.sum(blocksparse_attention(
-            q_, k, v, layout, bs, causal=causal, use_kernel=False) ** 2))(q)
-        g_ker = jax.grad(lambda q_: jnp.sum(blocksparse_attention(
-            q_, k, v, layout, bs, causal=causal, use_kernel=True) ** 2))(q)
-        np.testing.assert_allclose(np.asarray(g_ker), np.asarray(g_ref),
-                                   rtol=2e-4, atol=2e-4)
+        g_ref = jax.grad(lambda q_, k_, v_: jnp.sum(blocksparse_attention(
+            q_, k_, v_, layout, bs, causal=causal, use_kernel=False) ** 2),
+            argnums=(0, 1, 2))(q, k, v)
+        g_ker = jax.grad(lambda q_, k_, v_: jnp.sum(blocksparse_attention(
+            q_, k_, v_, layout, bs, causal=causal, use_kernel=True) ** 2),
+            argnums=(0, 1, 2))(q, k, v)
+        for gr, gk, name in zip(g_ref, g_ker, "qkv"):
+            np.testing.assert_allclose(np.asarray(gk), np.asarray(gr),
+                                       rtol=2e-4, atol=2e-4, err_msg=name)
+    # empty q rows are rejected, not silently inconsistent
+    import pytest as _pytest
+
+    empty = np.zeros((s // bs, s // bs), bool)
+    empty[0, 0] = True
+    with _pytest.raises(ValueError, match="attend to no kv block"):
+        blocksparse_attention(q, k, v, empty, bs, causal=True)
