@@ -9,8 +9,8 @@ once into the stacked [L, ...] pytree layout; resharding to any topology is
 then the checkpoint layer's job (orbax/universal).
 
 Supported families: Llama/Mistral/Qwen2/Phi-3 (→ ``models/llama``; fused
-QKV/gate-up checkpoints are split), GPT-2 (→ ``models/gpt``), Mixtral
-(→ ``models/mixtral``), Falcon (→ ``models/falcon``), OPT (→ ``models/gpt``,
+QKV/gate-up checkpoints are split), GPT-2 (→ ``models/gpt``),
+Mixtral/Qwen2-MoE (→ ``models/mixtral``), Falcon (→ ``models/falcon``), OPT (→ ``models/gpt``,
 ReLU/pre-LN). Accepts a live
 ``transformers`` model, a state-dict mapping, or a local checkpoint directory
 (no network access is assumed). Un-annotated models TP-shard via the AutoTP
@@ -374,6 +374,91 @@ def mixtral_params_from_hf(src, cfg=None) -> Params:
     return params
 
 
+def qwen2_moe_config_from_hf(hf_config) -> "Any":
+    """Map a transformers Qwen2MoeConfig (reference ``.../qwen_v2_moe``)."""
+    from .mixtral import MixtralConfig
+
+    if getattr(hf_config, "mlp_only_layers", None) or \
+            getattr(hf_config, "decoder_sparse_step", 1) != 1:
+        raise ValueError("Qwen2-MoE variants with dense interleaved layers "
+                         "(mlp_only_layers/decoder_sparse_step>1) are not "
+                         "supported — the layer stack must be uniform for "
+                         "the scanned block")
+    return MixtralConfig(
+        vocab_size=hf_config.vocab_size,
+        hidden_size=hf_config.hidden_size,
+        intermediate_size=hf_config.moe_intermediate_size,
+        num_layers=hf_config.num_hidden_layers,
+        num_heads=hf_config.num_attention_heads,
+        num_kv_heads=hf_config.num_key_value_heads,
+        num_experts=hf_config.num_experts,
+        top_k=hf_config.num_experts_per_tok,
+        drop_tokens=False,
+        norm_topk_prob=bool(getattr(hf_config, "norm_topk_prob", False)),
+        attention_bias=True,  # Qwen2 family always carries QKV biases
+        shared_expert_intermediate_size=int(
+            getattr(hf_config, "shared_expert_intermediate_size", 0)),
+        max_seq_len=getattr(hf_config, "max_position_embeddings", 4096),
+        rope_theta=float(getattr(hf_config, "rope_theta", 1e6)),
+        rms_norm_eps=float(getattr(hf_config, "rms_norm_eps", 1e-6)),
+        aux_loss_coef=float(getattr(hf_config, "router_aux_loss_coef", 0.001)),
+    )
+
+
+def qwen2_moe_params_from_hf(src, cfg=None) -> Params:
+    """HF Qwen2MoeForCausalLM → ``models/mixtral`` pytree (+ shared expert
+    and QKV biases)."""
+    sd = _normalize_state_dict(src)
+    pfx = "model." if any(k.startswith("model.") for k in sd) else ""
+    L = cfg.num_layers if cfg is not None else \
+        _count_indices(sd, rf"{re.escape(pfx)}layers\.(\d+)\.")
+    lay = pfx + "layers.{i}."
+    E = cfg.num_experts if cfg is not None else \
+        _count_indices(sd, rf"{re.escape(pfx)}layers\.0\.mlp\.experts"
+                           rf"\.(\d+)\.")
+
+    def stack_expert(w: str) -> np.ndarray:
+        return np.stack([
+            np.stack([sd[lay.format(i=i) + f"mlp.experts.{e}.{w}.weight"].T
+                      for e in range(E)]) for i in range(L)])
+
+    moe: Params = {
+        "router": _stack(sd, lay + "mlp.gate.weight", L, transpose=True),
+        "w_gate": stack_expert("gate_proj"),
+        "w_up": stack_expert("up_proj"),
+        "w_down": stack_expert("down_proj"),
+        "shared_w_gate": _stack(sd, lay + "mlp.shared_expert.gate_proj.weight",
+                                L, transpose=True),
+        "shared_w_up": _stack(sd, lay + "mlp.shared_expert.up_proj.weight",
+                              L, transpose=True),
+        "shared_w_down": _stack(sd, lay + "mlp.shared_expert.down_proj.weight",
+                                L, transpose=True),
+        "shared_gate": _stack(sd, lay + "mlp.shared_expert_gate.weight", L,
+                              transpose=True),
+    }
+    params: Params = {
+        "embed": sd[pfx + "embed_tokens.weight"],
+        "layers": {
+            "attn_norm": _stack(sd, lay + "input_layernorm.weight", L),
+            "wq": _stack(sd, lay + "self_attn.q_proj.weight", L, transpose=True),
+            "wk": _stack(sd, lay + "self_attn.k_proj.weight", L, transpose=True),
+            "wv": _stack(sd, lay + "self_attn.v_proj.weight", L, transpose=True),
+            "wo": _stack(sd, lay + "self_attn.o_proj.weight", L, transpose=True),
+            "bq": _stack(sd, lay + "self_attn.q_proj.bias", L),
+            "bk": _stack(sd, lay + "self_attn.k_proj.bias", L),
+            "bv": _stack(sd, lay + "self_attn.v_proj.bias", L),
+            "mlp_norm": _stack(sd, lay + "post_attention_layernorm.weight", L),
+            "moe": moe,
+        },
+        "final_norm": sd[pfx + "norm.weight"],
+        "lm_head": (sd["lm_head.weight"].T if "lm_head.weight" in sd
+                    else sd[pfx + "embed_tokens.weight"].T.copy()),
+    }
+    log_dist(f"imported HF qwen2_moe weights: {L} layers x {E} experts "
+             f"+ shared expert")
+    return params
+
+
 def falcon_config_from_hf(hf_config) -> "Any":
     from .falcon import FalconConfig
 
@@ -493,6 +578,7 @@ _FAMILIES = {
     "gpt2": (gpt2_config_from_hf, gpt2_params_from_hf),
     "opt": (opt_config_from_hf, opt_params_from_hf),
     "mixtral": (mixtral_config_from_hf, mixtral_params_from_hf),
+    "qwen2_moe": (qwen2_moe_config_from_hf, qwen2_moe_params_from_hf),
     "falcon": (falcon_config_from_hf, falcon_params_from_hf),
 }
 

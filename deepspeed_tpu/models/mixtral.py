@@ -46,6 +46,11 @@ class MixtralConfig:
     rope_theta: float = 1000000.0
     rms_norm_eps: float = 1e-5
     remat: bool = False
+    # Qwen2-MoE extensions (reference .../qwen_v2_moe): QKV biases, raw
+    # (unnormalized) top-k gates, and a sigmoid-gated shared dense expert
+    attention_bias: bool = False
+    norm_topk_prob: bool = True
+    shared_expert_intermediate_size: int = 0
 
     @property
     def head_size(self) -> int:
@@ -68,10 +73,21 @@ def init(cfg: MixtralConfig, rng: jax.Array, dtype=jnp.float32) -> Params:
     def normal(key, shape, fan_in):
         return (jax.random.normal(key, shape, jnp.float32) * fan_in ** -0.5).astype(dtype)
 
-    moe = jax.vmap(lambda k: init_moe_ffn(k, cfg.num_experts, h,
-                                          cfg.intermediate_size, dtype))(
-        jax.random.split(keys[5], L))
-    return {
+    def one_moe(k):
+        p = init_moe_ffn(k, cfg.num_experts, h, cfg.intermediate_size, dtype)
+        si = cfg.shared_expert_intermediate_size
+        if si:
+            ks = jax.random.split(jax.random.fold_in(k, 7), 4)
+            scale_h = jnp.float32(h) ** -0.5
+            p["shared_w_gate"] = (jax.random.normal(ks[0], (h, si)) * scale_h).astype(dtype)
+            p["shared_w_up"] = (jax.random.normal(ks[1], (h, si)) * scale_h).astype(dtype)
+            p["shared_w_down"] = (jax.random.normal(ks[2], (si, h)) *
+                                  jnp.float32(si) ** -0.5).astype(dtype)
+            p["shared_gate"] = (jax.random.normal(ks[3], (h, 1)) * scale_h).astype(dtype)
+        return p
+
+    moe = jax.vmap(one_moe)(jax.random.split(keys[5], L))
+    out = {
         "embed": normal(keys[0], (v, h), h),
         "layers": {
             "attn_norm": jnp.ones((L, h), dtype),
@@ -85,11 +101,21 @@ def init(cfg: MixtralConfig, rng: jax.Array, dtype=jnp.float32) -> Params:
         "final_norm": jnp.ones((h,), dtype),
         "lm_head": normal(keys[6], (h, v), h),
     }
+    if cfg.attention_bias:
+        out["layers"]["bq"] = jnp.zeros((L, nh * hd), dtype)
+        out["layers"]["bk"] = jnp.zeros((L, nkv * hd), dtype)
+        out["layers"]["bv"] = jnp.zeros((L, nkv * hd), dtype)
+    return out
 
 
 def param_logical_axes(cfg: MixtralConfig) -> Params:
     moe_axes = {k: ("layers",) + tuple(v) for k, v in moe_ffn_logical_axes().items()}
-    return {
+    if cfg.shared_expert_intermediate_size:
+        moe_axes.update({"shared_w_gate": ("layers", "embed", "mlp"),
+                         "shared_w_up": ("layers", "embed", "mlp"),
+                         "shared_w_down": ("layers", "mlp", "embed"),
+                         "shared_gate": ("layers", "embed", None)})
+    axes = {
         "embed": ("vocab", "embed"),
         "layers": {
             "attn_norm": ("layers", "embed"),
@@ -103,6 +129,11 @@ def param_logical_axes(cfg: MixtralConfig) -> Params:
         "final_norm": ("embed",),
         "lm_head": ("embed", "vocab"),
     }
+    if cfg.attention_bias:
+        axes["layers"]["bq"] = ("layers", "heads")
+        axes["layers"]["bk"] = ("layers", "kv_heads")
+        axes["layers"]["bv"] = ("layers", "kv_heads")
+    return axes
 
 
 def apply(cfg: MixtralConfig, params: Params, tokens: jnp.ndarray, *,
@@ -111,7 +142,8 @@ def apply(cfg: MixtralConfig, params: Params, tokens: jnp.ndarray, *,
     x = embedding_lookup(params["embed"], tokens, compute_dtype)
     cos, sin = rope_frequencies(cfg.head_size, cfg.max_seq_len, cfg.rope_theta)
     moe_layer = MoELayer(cfg.num_experts, cfg.top_k, cfg.capacity_factor,
-                         cfg.min_capacity, cfg.drop_tokens)
+                         cfg.min_capacity, cfg.drop_tokens,
+                         norm_topk=cfg.norm_topk_prob)
 
     layers = jax.tree.map(lambda p: p.astype(compute_dtype)
                           if jnp.issubdtype(p.dtype, jnp.floating) else p,
@@ -121,9 +153,12 @@ def apply(cfg: MixtralConfig, params: Params, tokens: jnp.ndarray, *,
         b, s, h = x.shape
         nh, nkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_size
         y = rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps)
-        q = apply_rotary((y @ layer["wq"]).reshape(b, s, nh, hd), cos, sin)
-        k = apply_rotary((y @ layer["wk"]).reshape(b, s, nkv, hd), cos, sin)
-        v = (y @ layer["wv"]).reshape(b, s, nkv, hd)
+        q, k, v = y @ layer["wq"], y @ layer["wk"], y @ layer["wv"]
+        if "bq" in layer:
+            q, k, v = q + layer["bq"], k + layer["bk"], v + layer["bv"]
+        q = apply_rotary(q.reshape(b, s, nh, hd), cos, sin)
+        k = apply_rotary(k.reshape(b, s, nkv, hd), cos, sin)
+        v = v.reshape(b, s, nkv, hd)
         x = x + attention(q, k, v, causal=True).reshape(b, s, nh * hd) @ layer["wo"]
         y = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
         ffn_out, aux = moe_layer(layer["moe"], y)
@@ -171,7 +206,8 @@ def apply_cached(cfg: MixtralConfig, params: Params, tokens: jnp.ndarray,
     # inference never drops tokens: a dropped decode token would silently
     # corrupt the completion (reference v2 mixtral routes without capacity)
     moe_layer = MoELayer(cfg.num_experts, cfg.top_k, cfg.capacity_factor,
-                         cfg.min_capacity, drop_tokens=False)
+                         cfg.min_capacity, drop_tokens=False,
+                         norm_topk=cfg.norm_topk_prob)
     layers = jax.tree.map(lambda p: p.astype(compute_dtype)
                           if jnp.issubdtype(p.dtype, jnp.floating) else p,
                           params["layers"])
@@ -179,11 +215,12 @@ def apply_cached(cfg: MixtralConfig, params: Params, tokens: jnp.ndarray,
     def scan_body(x, scanned):
         layer, k_c, v_c = scanned
         y = rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps)
-        q = apply_rotary((y @ layer["wq"]).reshape(b, t, nh, hd), cos, sin,
-                         positions)
-        k = apply_rotary((y @ layer["wk"]).reshape(b, t, nkv, hd), cos, sin,
-                         positions)
-        v = (y @ layer["wv"]).reshape(b, t, nkv, hd)
+        q, k, v = y @ layer["wq"], y @ layer["wk"], y @ layer["wv"]
+        if "bq" in layer:
+            q, k, v = q + layer["bq"], k + layer["bk"], v + layer["bv"]
+        q = apply_rotary(q.reshape(b, t, nh, hd), cos, sin, positions)
+        k = apply_rotary(k.reshape(b, t, nkv, hd), cos, sin, positions)
+        v = v.reshape(b, t, nkv, hd)
         k_c = llama_mod._write_cache(k_c, k, cache_len)
         v_c = llama_mod._write_cache(v_c, v, cache_len)
         S = k_c.shape[1]

@@ -67,12 +67,13 @@ class MoELayer:
 
     def __init__(self, n_experts: int, top_k: int = 2,
                  capacity_factor: float = 1.25, min_capacity: int = 4,
-                 drop_tokens: bool = True):
+                 drop_tokens: bool = True, norm_topk: bool = True):
         self.n_experts = n_experts
         self.top_k = top_k
         self.capacity_factor = capacity_factor
         self.min_capacity = min_capacity
         self.drop_tokens = drop_tokens
+        self.norm_topk = norm_topk
 
     def __call__(self, params: Params, x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
         """x: [batch, seq, hidden] → ([batch, seq, hidden], aux_loss)."""
@@ -81,7 +82,8 @@ class MoELayer:
         logits = tokens @ params["router"].astype(tokens.dtype)
         gating: GatingOutput = top_k_gating(
             logits, self.top_k, capacity_factor=self.capacity_factor,
-            min_capacity=self.min_capacity, drop_tokens=self.drop_tokens)
+            min_capacity=self.min_capacity, drop_tokens=self.drop_tokens,
+            norm_topk=self.norm_topk)
 
         # dispatch: [T, E, C] × [T, H] → [E, C, H], then expert-shard (a2a)
         expert_in = jnp.einsum("tec,th->ech",
@@ -104,4 +106,12 @@ class MoELayer:
         # combine: [T, E, C] × [E, C, H] → [T, H]  (a2a back)
         out = jnp.einsum("tec,ech->th",
                          gating.combine_weights.astype(tokens.dtype), expert_out)
+        # Qwen2-MoE shared expert: a dense SwiGLU added to every token,
+        # scaled by a learned sigmoid gate (params present only when used)
+        if "shared_w_gate" in params:
+            sg = jax.nn.silu(tokens @ params["shared_w_gate"].astype(tokens.dtype))
+            su = tokens @ params["shared_w_up"].astype(tokens.dtype)
+            shared = (sg * su) @ params["shared_w_down"].astype(tokens.dtype)
+            gate = jax.nn.sigmoid(tokens @ params["shared_gate"].astype(tokens.dtype))
+            out = out + gate * shared
         return out.reshape(b, s, h), gating.aux_loss

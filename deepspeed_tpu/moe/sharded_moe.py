@@ -36,17 +36,23 @@ def compute_capacity(tokens: int, n_experts: int, k: int,
 
 def top_k_gating(logits: jnp.ndarray, k: int = 1, *,
                  capacity_factor: float = 1.0, min_capacity: int = 4,
-                 drop_tokens: bool = True) -> GatingOutput:
+                 drop_tokens: bool = True,
+                 norm_topk: bool = True) -> GatingOutput:
     """logits: [tokens, experts]. Implements the reference's top1/top2/topk
-    gating family as one k-generic routine (drop policy = capacity truncation)."""
+    gating family as one k-generic routine (drop policy = capacity truncation).
+    ``norm_topk=False`` keeps the raw softmax probs of the selected experts
+    (Qwen2-MoE's norm_topk_prob=False)."""
     tokens, n_experts = logits.shape
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
 
     # top-k expert choice per token
     topk_probs, topk_idx = jax.lax.top_k(probs, k)          # [T, k]
-    # renormalize the selected gates (reference top2: gates /= denom)
-    denom = jnp.sum(topk_probs, axis=-1, keepdims=True)
-    topk_gates = topk_probs / jnp.maximum(denom, 1e-9)
+    if norm_topk:
+        # renormalize the selected gates (reference top2: gates /= denom)
+        denom = jnp.sum(topk_probs, axis=-1, keepdims=True)
+        topk_gates = topk_probs / jnp.maximum(denom, 1e-9)
+    else:
+        topk_gates = topk_probs
 
     capacity = compute_capacity(tokens, n_experts, k, capacity_factor, min_capacity)
     if not drop_tokens:

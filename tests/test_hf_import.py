@@ -296,3 +296,29 @@ def test_opt_logit_parity():
     ours = np.asarray(gpt.apply(cfg, params, jnp.asarray(tokens),
                                 compute_dtype=jnp.float32))
     np.testing.assert_allclose(ours, ref, rtol=2e-3, atol=2e-3)
+
+
+def test_qwen2_moe_logit_parity():
+    """Qwen2-MoE → mixtral family: shared sigmoid-gated expert, QKV biases,
+    unnormalized top-k gates (reference .../qwen_v2_moe)."""
+    from deepspeed_tpu.models import mixtral
+
+    hf_cfg = transformers.Qwen2MoeConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=96,
+        moe_intermediate_size=48, shared_expert_intermediate_size=80,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        num_experts=4, num_experts_per_tok=2, norm_topk_prob=False,
+        decoder_sparse_step=1, mlp_only_layers=[],
+        max_position_embeddings=64, rope_theta=10000.0, rms_norm_eps=1e-6,
+        tie_word_embeddings=False)
+    torch.manual_seed(11)
+    hf_model = transformers.Qwen2MoeForCausalLM(hf_cfg).eval()
+    cfg, params = from_hf(hf_model)
+    assert cfg.attention_bias and not cfg.norm_topk_prob
+    assert "shared_w_gate" in params["layers"]["moe"]
+    tokens = np.random.RandomState(11).randint(0, 128, (2, 10))
+    with torch.no_grad():
+        ref = hf_model(torch.tensor(tokens)).logits.numpy()
+    logits, _aux = mixtral.apply(cfg, params, jnp.asarray(tokens),
+                                 compute_dtype=jnp.float32)
+    np.testing.assert_allclose(np.asarray(logits), ref, rtol=2e-3, atol=2e-3)
