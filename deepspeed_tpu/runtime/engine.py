@@ -274,6 +274,57 @@ class DeepSpeedTPUEngine:
     def loss_scale(self) -> float:
         return float(self.state.loss_scale.scale)
 
+    # --- further reference accessors (engine.py:770-1252) ---
+    def get_batch_info(self):
+        """(train_batch_size, micro_batch_per_gpu, gradient_accumulation)."""
+        return (self.train_batch_size(),
+                self.train_micro_batch_size_per_gpu(),
+                self.gradient_accumulation_steps())
+
+    @property
+    def global_samples(self) -> int:
+        return self.global_steps * self.train_batch_size()
+
+    def zero_optimization(self) -> bool:
+        return self.config.zero_config.stage > 0
+
+    def bfloat16_enabled(self) -> bool:
+        return self.config.bf16.enabled
+
+    def fp16_enabled(self) -> bool:
+        return self.config.fp16.enabled
+
+    def gradient_clipping_value(self) -> float:
+        return float(self.config.gradient_clipping or 0.0)
+
+    def steps_per_print(self) -> int:
+        return self.config.steps_per_print
+
+    def wall_clock_breakdown(self) -> bool:
+        return bool(getattr(self.config, "wall_clock_breakdown", False))
+
+    @property
+    def module(self):
+        """The user model (reference returns the wrapped nn.Module)."""
+        return self.model
+
+    def set_lr(self, lr: float) -> None:
+        """Pin the LR to a constant (reference ``engine.set_lr``)."""
+        self.base_lr = float(lr)
+        self.lr_schedule = constant(float(lr))
+        self.lr_scheduler = LRScheduler(self.lr_schedule)
+        self._train_step = None  # recompile with the new schedule
+
+    def get_mom(self) -> List[float]:
+        b = self.optimizer.hyperparams.get("betas", (0.9, 0.999))
+        return [float(b[0] if isinstance(b, (tuple, list)) else b)]
+
+    def dp_world_size(self) -> int:
+        return self.mesh_mgr.dp_world_size
+
+    def mp_world_size(self) -> int:
+        return self.mesh_mgr.tp_world_size
+
     # ------------------------------------------------------------------ #
     # opt state init (sharded)
     # ------------------------------------------------------------------ #
