@@ -192,7 +192,9 @@ class DecoupledCheckpointEngine(CheckpointEngine):
 def get_checkpoint_engine(name: str = "default", **kw) -> CheckpointEngine:
     """Factory (reference ``runtime/engine.py:_configure_checkpointing :1287``
     + ``model_checkpointing/writer_factory.py``)."""
-    if name in ("default", "torch", "orbax"):
+    if name in ("default", "torch", "orbax", "nebula", "datastates"):
+        # nebula/datastates name-parity: both reference engines are external
+        # checkpoint services; the orbax engine is the durable stand-in
         return SyncCheckpointEngine()
     if name == "fast":
         return FastCheckpointEngine(buffer_mb=kw.get("writer_buffer_mb", 64))

@@ -1,0 +1,44 @@
+"""Profiler range annotations — reference ``deepspeed/utils/nvtx.py``.
+
+The reference wraps hot functions in NVTX ranges
+(``get_accelerator().range_push/pop``) so they show up named in nsight
+traces. The TPU equivalents are ``jax.named_scope`` (names HLO ops, visible
+in xprof/tensorboard traces) and ``jax.profiler.TraceAnnotation`` (names
+host-side spans). ``instrument_w_nvtx`` keeps the reference decorator name.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+
+
+def instrument_w_nvtx(func: Callable) -> Callable:
+    """Decorator: record the function under its qualified name in both the
+    compiled trace (named_scope) and the host profiler timeline."""
+    name = getattr(func, "__qualname__", getattr(func, "__name__", "fn"))
+
+    @functools.wraps(func)
+    def wrapped(*args, **kwargs):
+        with jax.named_scope(name), jax.profiler.TraceAnnotation(name):
+            return func(*args, **kwargs)
+
+    return wrapped
+
+
+def range_push(name: str):
+    """Imperative range begin (reference accelerator.range_push)."""
+    ctx = jax.profiler.TraceAnnotation(name)
+    ctx.__enter__()
+    _stack.append(ctx)
+    return ctx
+
+
+def range_pop():
+    if _stack:
+        _stack.pop().__exit__(None, None, None)
+
+
+_stack: list = []

@@ -121,3 +121,24 @@ def test_comms_telemetry():
     s = tel.summary()
     assert s["all_reduce"]["count"] == 2
     dist.configure(enabled=False)
+
+
+def test_nvtx_parity_decorator():
+    """instrument_w_nvtx / range_push / range_pop (reference utils/nvtx.py)
+    name spans without altering results, inside and outside jit."""
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.utils.nvtx import (instrument_w_nvtx, range_pop,
+                                          range_push)
+
+    @instrument_w_nvtx
+    def f(x):
+        return x * 3
+
+    assert float(jax.jit(f)(jnp.asarray(2.0))) == 6.0
+    assert float(f(jnp.asarray(2.0))) == 6.0
+    range_push("outer")
+    range_push("inner")
+    range_pop()
+    range_pop()
+    range_pop()  # over-pop is a no-op
