@@ -1,0 +1,248 @@
+"""Inference v2 module system: typed module slots with config-driven,
+pluggable implementation selection.
+
+Reference parity: ``inference/v2/modules`` — interfaces
+(``interfaces/{attention,linear,moe,embedding,pre_norm,post_norm,unembed}_base``),
+registry (``module_registry.py``: implementations self-register and are
+chosen by ``supports_config``), configs (``modules/configs``). The reference
+uses this to pick CUDA/CUTLASS kernels per model/dtype at engine build; here
+each slot resolves to an op-registry implementation (XLA always; Pallas when
+the platform supports it), so the same engine code serves CPU tests and TPU
+production. Implementations are plain callables — jit-traceable, no state.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+
+from ..utils.logging import logger
+
+# --------------------------------------------------------------------------- #
+# Configs (reference: inference/v2/modules/configs/*)
+# --------------------------------------------------------------------------- #
+
+
+@dataclass(frozen=True)
+class ModuleConfig:
+    dtype: Any = jnp.bfloat16
+
+
+@dataclass(frozen=True)
+class AttentionConfig(ModuleConfig):
+    num_heads: int = 0
+    num_kv_heads: int = 0
+    head_size: int = 0
+    paged: bool = False          # block-table (ragged decode) layout
+
+
+@dataclass(frozen=True)
+class LinearConfig(ModuleConfig):
+    quant_bits: Optional[int] = None   # None | 8 | 4 (weight-only)
+    activation: Optional[str] = None   # fused epilogue: 'gelu'|'silu'|None
+
+
+@dataclass(frozen=True)
+class NormConfig(ModuleConfig):
+    kind: str = "rms"            # 'rms' | 'layer'
+    eps: float = 1e-5
+
+
+@dataclass(frozen=True)
+class EmbeddingConfig(ModuleConfig):
+    vocab_sharded: bool = False
+
+
+@dataclass(frozen=True)
+class UnembedConfig(ModuleConfig):
+    tile_tokens: Optional[int] = None   # tiled logits (ALST-style) when set
+
+
+@dataclass(frozen=True)
+class MoEConfig(ModuleConfig):
+    num_experts: int = 0
+    top_k: int = 2
+
+
+# --------------------------------------------------------------------------- #
+# Registry (reference: module_registry.py — ConfigBundle → implementation)
+# --------------------------------------------------------------------------- #
+
+_SLOTS = ("attention", "linear", "norm", "embedding", "unembed", "moe")
+
+
+@dataclass
+class _Impl:
+    name: str
+    supports: Callable[[ModuleConfig], bool]
+    build: Callable[[ModuleConfig], Callable]
+    priority: int = 0
+
+
+class DSModuleRegistry:
+    """Per-slot implementation registry. ``instantiate(slot, config)``
+    returns the highest-priority implementation whose ``supports(config)``
+    accepts the config — the reference's ``supports_config`` protocol."""
+
+    def __init__(self):
+        self._impls: Dict[str, List[_Impl]] = {s: [] for s in _SLOTS}
+
+    def register(self, slot: str, name: str, *,
+                 supports: Callable[[ModuleConfig], bool] = lambda c: True,
+                 priority: int = 0):
+        assert slot in _SLOTS, f"unknown module slot {slot!r}"
+
+        def deco(build):
+            self._impls[slot].append(
+                _Impl(name=name, supports=supports, build=build,
+                      priority=priority))
+            self._impls[slot].sort(key=lambda i: -i.priority)
+            return build
+
+        return deco
+
+    def instantiate(self, slot: str, config: ModuleConfig) -> Callable:
+        for impl in self._impls[slot]:
+            try:
+                ok = impl.supports(config)
+            except Exception:
+                ok = False
+            if ok:
+                logger.debug("modules: %s ← %s", slot, impl.name)
+                return impl.build(config)
+        raise ValueError(f"no implementation for slot {slot!r} supports "
+                         f"{config}")
+
+    def implementations(self, slot: str) -> List[str]:
+        return [i.name for i in self._impls[slot]]
+
+
+registry = DSModuleRegistry()
+
+
+# --------------------------------------------------------------------------- #
+# Default implementations — thin bridges onto the op registry / model ops
+# --------------------------------------------------------------------------- #
+
+
+@registry.register("attention", "flash_or_xla",
+                   supports=lambda c: not c.paged, priority=0)
+def _dense_attention(cfg: AttentionConfig):
+    from ..ops.attention import attention
+
+    return attention
+
+
+@registry.register("attention", "paged_pallas",
+                   supports=lambda c: c.paged, priority=10)
+def _paged_attention(cfg: AttentionConfig):
+    from ..ops.pallas.paged_attention import paged_decode_attention
+
+    return paged_decode_attention
+
+
+@registry.register("norm", "rms", supports=lambda c: c.kind == "rms")
+def _rms_norm(cfg: NormConfig):
+    from ..ops.norms import rms_norm
+
+    return lambda x, scale, bias=None: rms_norm(x, scale, cfg.eps)
+
+
+@registry.register("norm", "layer", supports=lambda c: c.kind == "layer")
+def _layer_norm(cfg: NormConfig):
+    from ..ops.norms import layer_norm
+
+    return lambda x, scale, bias: layer_norm(x, scale, bias, cfg.eps)
+
+
+def _act(name):
+    import jax
+
+    return {None: lambda x: x, "gelu": jax.nn.gelu, "silu": jax.nn.silu,
+            "relu": jax.nn.relu}[name]
+
+
+@registry.register("linear", "dense", supports=lambda c: c.quant_bits is None)
+def _dense_linear(cfg: LinearConfig):
+    act = _act(cfg.activation)
+
+    def linear(x, w, b=None):
+        y = x @ w.astype(x.dtype)
+        if b is not None:
+            y = y + b.astype(x.dtype)
+        return act(y)
+
+    return linear
+
+
+@registry.register("linear", "weight_only_quant",
+                   # int8 group quant only — the packed-int4 path lives in
+                   # inference/engine.py (nibble layout needs its own dequant)
+                   supports=lambda c: c.quant_bits == 8, priority=5)
+def _quant_linear(cfg: LinearConfig):
+    from ..ops.quantization import dequantize_int8
+
+    act = _act(cfg.activation)
+
+    def linear(x, qw, scales, b=None):
+        w = dequantize_int8(qw, scales,
+                            group_size=qw.size // scales.size).astype(x.dtype)
+        y = x @ w
+        if b is not None:
+            y = y + b.astype(x.dtype)
+        return act(y)
+
+    return linear
+
+
+@registry.register("embedding", "lookup")
+def _embedding(cfg: EmbeddingConfig):
+    from ..ops.embedding import embedding_lookup
+
+    return lambda table, tokens: embedding_lookup(table, tokens, cfg.dtype)
+
+
+@registry.register("unembed", "full", supports=lambda c: c.tile_tokens is None)
+def _unembed(cfg: UnembedConfig):
+    def unembed(x, head):
+        return (x @ head.astype(x.dtype)).astype(jnp.float32)
+
+    return unembed
+
+
+@registry.register("unembed", "tiled",
+                   supports=lambda c: c.tile_tokens is not None, priority=5)
+def _unembed_tiled(cfg: UnembedConfig):
+    """Tiled logits (never materialize [tokens, vocab] at once) — the
+    reference's ALST TiledFusedLogitsLoss shape, decode flavor."""
+    import jax
+    from jax import lax
+
+    T = cfg.tile_tokens
+
+    def unembed(x, head):
+        flat = x.reshape(-1, x.shape[-1])
+        n = flat.shape[0]
+        pad = (-n) % T
+        padded = jnp.pad(flat, ((0, pad), (0, 0)))
+        tiles = padded.reshape(-1, T, x.shape[-1])
+
+        def body(_, tile):
+            return None, (tile @ head.astype(tile.dtype)).astype(jnp.float32)
+
+        _, out = lax.scan(body, None, tiles)
+        return out.reshape(-1, head.shape[-1])[:n].reshape(
+            x.shape[:-1] + (head.shape[-1],))
+
+    return unembed
+
+
+@registry.register("moe", "dense_dispatch")
+def _moe(cfg: MoEConfig):
+    from functools import partial
+
+    from ..moe.sharded_moe import top_k_gating
+
+    return partial(top_k_gating, k=cfg.top_k)

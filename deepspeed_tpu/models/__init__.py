@@ -1,1 +1,1 @@
-from . import bert, gpt, llama, mixtral  # noqa: F401
+from . import bert, bloom, falcon, gpt, gptneox, llama, mixtral  # noqa: F401

@@ -1,0 +1,286 @@
+"""BLOOM family, written TPU-first.
+
+Reference parity: v1 injection policy ``module_inject/containers/bloom.py``
+(+ ``model_implementations/ds_bloom.py``). BLOOM deltas vs the GPT/Llama
+families, all handled here:
+
+- **ALiBi** position encoding: a per-head additive logits slope instead of
+  rotary. Softmax rows are shift-invariant, so ``slope · key_pos`` is
+  equivalent to ``slope · (key_pos − query_pos)`` under the causal mask —
+  that one-sided form works unchanged for the KV-cached decode path.
+- A LayerNorm over the embedding output (``word_embeddings_layernorm``).
+- Sequential (non-parallel) blocks, LayerNorm with bias, biases on every
+  linear, tied lm_head.
+
+Same TPU shape as the sibling models: stacked layers under ``lax.scan``,
+logical axis names per param for the sharding-rule engine. The fused HF
+``query_key_value`` projection ships head-interleaved [q|k|v]; the importer
+(``models/hf_import.py``) de-interleaves into separate wq/wk/wv so the TP
+rules shard heads cleanly.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops.attention import attention
+from ..ops.embedding import embedding_lookup
+from ..ops.norms import layer_norm
+
+Params = Dict[str, Any]
+
+
+@dataclass(frozen=True)
+class BloomConfig:
+    vocab_size: int = 250880
+    hidden_size: int = 4096
+    num_layers: int = 30
+    num_heads: int = 32
+    max_seq_len: int = 2048
+    layer_norm_eps: float = 1e-5
+
+    @property
+    def head_size(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @property
+    def intermediate_size(self) -> int:
+        return 4 * self.hidden_size
+
+    @classmethod
+    def tiny(cls, **kw) -> "BloomConfig":
+        base = dict(vocab_size=256, hidden_size=64, num_layers=2, num_heads=4,
+                    max_seq_len=128)
+        base.update(kw)
+        return cls(**base)
+
+
+def alibi_slopes(num_heads: int) -> jnp.ndarray:
+    """Per-head ALiBi slopes (HF ``build_alibi_tensor`` formula: geometric
+    series from the closest power of two, odd-step fill for the remainder)."""
+    closest = 2 ** math.floor(math.log2(num_heads))
+    base = 2.0 ** (-(2.0 ** -(math.log2(closest) - 3)))
+    slopes = [base ** i for i in range(1, closest + 1)]
+    if closest != num_heads:
+        extra_base = 2.0 ** (-(2.0 ** -(math.log2(2 * closest) - 3)))
+        n_rem = min(closest, num_heads - closest)
+        slopes += [extra_base ** i for i in range(1, 2 * n_rem, 2)]
+    return jnp.asarray(slopes, jnp.float32)
+
+
+def _alibi_bias(num_heads: int, kv_len: int) -> jnp.ndarray:
+    """[heads, 1, kv_len] additive logits bias (one-sided form)."""
+    slopes = alibi_slopes(num_heads)
+    return (slopes[:, None, None] *
+            jnp.arange(kv_len, dtype=jnp.float32)[None, None, :])
+
+
+def init(cfg: BloomConfig, rng: jax.Array, dtype=jnp.float32) -> Params:
+    h, hd = cfg.hidden_size, cfg.head_size
+    L, nh, v, i = cfg.num_layers, cfg.num_heads, cfg.vocab_size, cfg.intermediate_size
+    keys = jax.random.split(rng, 7)
+
+    def normal(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32) * fan_in ** -0.5).astype(dtype)
+
+    return {
+        "embed": normal(keys[0], (v, h), h),
+        "embed_ln_scale": jnp.ones((h,), dtype),
+        "embed_ln_bias": jnp.zeros((h,), dtype),
+        "layers": {
+            "ln1_scale": jnp.ones((L, h), dtype),
+            "ln1_bias": jnp.zeros((L, h), dtype),
+            "wq": normal(keys[1], (L, h, nh * hd), h),
+            "wk": normal(keys[2], (L, h, nh * hd), h),
+            "wv": normal(keys[3], (L, h, nh * hd), h),
+            "bq": jnp.zeros((L, nh * hd), dtype),
+            "bk": jnp.zeros((L, nh * hd), dtype),
+            "bv": jnp.zeros((L, nh * hd), dtype),
+            "wo": normal(keys[4], (L, nh * hd, h), nh * hd),
+            "bo": jnp.zeros((L, h), dtype),
+            "ln2_scale": jnp.ones((L, h), dtype),
+            "ln2_bias": jnp.zeros((L, h), dtype),
+            "w_up": normal(keys[5], (L, h, i), h),
+            "b_up": jnp.zeros((L, i), dtype),
+            "w_down": normal(keys[6], (L, i, h), i),
+            "b_down": jnp.zeros((L, h), dtype),
+        },
+        "final_ln_scale": jnp.ones((h,), dtype),
+        "final_ln_bias": jnp.zeros((h,), dtype),
+    }
+
+
+def param_logical_axes(cfg: BloomConfig) -> Params:
+    return {
+        "embed": ("vocab", "embed"),
+        "embed_ln_scale": ("embed",),
+        "embed_ln_bias": ("embed",),
+        "layers": {
+            "ln1_scale": ("layers", "embed"),
+            "ln1_bias": ("layers", "embed"),
+            "wq": ("layers", "embed", "heads"),
+            "wk": ("layers", "embed", "heads"),
+            "wv": ("layers", "embed", "heads"),
+            "bq": ("layers", "heads"),
+            "bk": ("layers", "heads"),
+            "bv": ("layers", "heads"),
+            "wo": ("layers", "heads", "embed"),
+            "bo": ("layers", "embed"),
+            "ln2_scale": ("layers", "embed"),
+            "ln2_bias": ("layers", "embed"),
+            "w_up": ("layers", "embed", "mlp"),
+            "b_up": ("layers", "mlp"),
+            "w_down": ("layers", "mlp", "embed"),
+            "b_down": ("layers", "embed"),
+        },
+        "final_ln_scale": ("embed",),
+        "final_ln_bias": ("embed",),
+    }
+
+
+def _block(cfg: BloomConfig, x: jnp.ndarray, layer: Params,
+           bias: jnp.ndarray, mask=None) -> jnp.ndarray:
+    b, s, h = x.shape
+    nh, hd = cfg.num_heads, cfg.head_size
+    y = layer_norm(x, layer["ln1_scale"], layer["ln1_bias"],
+                   cfg.layer_norm_eps)
+    q = (y @ layer["wq"] + layer["bq"]).reshape(b, s, nh, hd)
+    k = (y @ layer["wk"] + layer["bk"]).reshape(b, s, nh, hd)
+    v = (y @ layer["wv"] + layer["bv"]).reshape(b, s, nh, hd)
+    attn_out = attention(q, k, v, causal=mask is None, bias=bias, mask=mask)
+    x = x + attn_out.reshape(b, s, nh * hd) @ layer["wo"] + layer["bo"]
+
+    y = layer_norm(x, layer["ln2_scale"], layer["ln2_bias"],
+                   cfg.layer_norm_eps)
+    u = jax.nn.gelu(y @ layer["w_up"] + layer["b_up"], approximate=True)
+    return x + u @ layer["w_down"] + layer["b_down"]
+
+
+def _embed(cfg: BloomConfig, params: Params, tokens, compute_dtype):
+    x = embedding_lookup(params["embed"], tokens, compute_dtype)
+    return layer_norm(x, params["embed_ln_scale"].astype(compute_dtype),
+                      params["embed_ln_bias"].astype(compute_dtype),
+                      cfg.layer_norm_eps)
+
+
+def _head(cfg: BloomConfig, params: Params, x: jnp.ndarray,
+          compute_dtype) -> jnp.ndarray:
+    x = layer_norm(x, params["final_ln_scale"].astype(compute_dtype),
+                   params["final_ln_bias"].astype(compute_dtype),
+                   cfg.layer_norm_eps)
+    return (x @ params["embed"].T.astype(compute_dtype)).astype(jnp.float32)
+
+
+def _cast_layers(params: Params, compute_dtype):
+    return jax.tree.map(lambda p: p.astype(compute_dtype)
+                        if jnp.issubdtype(p.dtype, jnp.floating) else p,
+                        params["layers"])
+
+
+def apply(cfg: BloomConfig, params: Params, tokens: jnp.ndarray, *,
+          positions: Optional[jnp.ndarray] = None,
+          compute_dtype=jnp.bfloat16) -> jnp.ndarray:
+    del positions  # ALiBi: position information lives in the logits bias
+    x = _embed(cfg, params, tokens, compute_dtype)
+    bias = _alibi_bias(cfg.num_heads, tokens.shape[1])
+    layers = _cast_layers(params, compute_dtype)
+
+    def scan_body(x, layer):
+        return _block(cfg, x, layer, bias), None
+
+    x, _ = lax.scan(scan_body, x, layers)
+    return _head(cfg, params, x, compute_dtype)
+
+
+# ---- KV-cached decode (v1-engine path) ---- #
+def init_cache(cfg: BloomConfig, batch_size: int, max_len: int,
+               dtype=jnp.bfloat16) -> Params:
+    L, nh, hd = cfg.num_layers, cfg.num_heads, cfg.head_size
+    shape = (L, batch_size, max_len, nh, hd)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def cache_logical_axes(cfg: BloomConfig) -> Params:
+    spec = ("layers", None, None, "heads", None)
+    return {"k": spec, "v": spec}
+
+
+def _write_cache(cache, new, starts):
+    def one(c, n, s):
+        return lax.dynamic_update_slice(c, n.astype(c.dtype), (s, 0, 0))
+
+    return jax.vmap(one)(cache, new, starts)
+
+
+def apply_cached(cfg: BloomConfig, params: Params, tokens: jnp.ndarray,
+                 cache: Params, cache_len: jnp.ndarray, *,
+                 compute_dtype=jnp.bfloat16) -> Tuple[jnp.ndarray, Params]:
+    if cache_len.ndim == 0:
+        cache_len = jnp.broadcast_to(cache_len, (tokens.shape[0],))
+    b, t = tokens.shape
+    nh, hd = cfg.num_heads, cfg.head_size
+    x = _embed(cfg, params, tokens, compute_dtype)
+    layers = _cast_layers(params, compute_dtype)
+
+    S = cache["k"].shape[2]
+    bias = _alibi_bias(nh, S)  # layer-invariant: hoisted out of the scan
+
+    def scan_body(x, scanned):
+        layer, k_c, v_c = scanned
+        y = layer_norm(x, layer["ln1_scale"], layer["ln1_bias"],
+                       cfg.layer_norm_eps)
+        q = (y @ layer["wq"] + layer["bq"]).reshape(b, t, nh, hd)
+        k = (y @ layer["wk"] + layer["bk"]).reshape(b, t, nh, hd)
+        v = (y @ layer["wv"] + layer["bv"]).reshape(b, t, nh, hd)
+        k_c = _write_cache(k_c, k, cache_len)
+        v_c = _write_cache(v_c, v, cache_len)
+        kv_pos = jnp.arange(S)[None, None, None, :]
+        q_abs = cache_len[:, None, None, None] + jnp.arange(t)[None, None, :, None]
+        mask = kv_pos <= q_abs
+        attn_out = attention(q, k_c, v_c, causal=False, bias=bias, mask=mask)
+        x = x + attn_out.reshape(b, t, nh * hd) @ layer["wo"] + layer["bo"]
+        y = layer_norm(x, layer["ln2_scale"], layer["ln2_bias"],
+                       cfg.layer_norm_eps)
+        u = jax.nn.gelu(y @ layer["w_up"] + layer["b_up"], approximate=True)
+        x = x + u @ layer["w_down"] + layer["b_down"]
+        return x, (k_c, v_c)
+
+    x, (new_k, new_v) = lax.scan(scan_body, x, (layers, cache["k"], cache["v"]))
+    return _head(cfg, params, x, compute_dtype), {"k": new_k, "v": new_v}
+
+
+def loss_fn(cfg: BloomConfig, params: Params, batch: Dict[str, jnp.ndarray], *,
+            compute_dtype=jnp.bfloat16):
+    tokens = batch["tokens"]
+    if "labels" in batch:
+        inputs, labels = tokens, batch["labels"]
+    else:
+        inputs, labels = tokens[:, :-1], tokens[:, 1:]
+    logits = apply(cfg, params, inputs, compute_dtype=compute_dtype)
+    valid = labels != -100
+    safe = jnp.where(valid, labels, 0)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    tl = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+    loss = jnp.where(valid, tl, 0.0).sum() / jnp.maximum(valid.sum(), 1)
+    return loss, {"loss": loss, "ntokens": valid.sum()}
+
+
+def model_spec(cfg: BloomConfig, compute_dtype=jnp.bfloat16):
+    from ..runtime.engine import ModelSpec
+
+    return ModelSpec(
+        name="bloom",
+        init_fn=lambda rng: init(cfg, rng),
+        loss_fn=lambda params, batch: loss_fn(cfg, params, batch,
+                                              compute_dtype=compute_dtype),
+        apply_fn=lambda params, tokens, **kw: apply(
+            cfg, params, tokens, compute_dtype=compute_dtype, **kw),
+        logical_axes=param_logical_axes(cfg),
+        pipeline_capable=False,
+    )

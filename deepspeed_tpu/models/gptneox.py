@@ -1,0 +1,338 @@
+"""GPT-NeoX / GPT-J family, written TPU-first.
+
+Reference parity: the reference serves both through v1 injection policies
+(``module_inject/containers/gptneox.py`` and ``gptj.py``) over the fused
+inference modules. One config covers both architectures here; the deltas are
+all flags:
+
+==============  ======================  =====================
+                GPT-NeoX                GPT-J
+==============  ======================  =====================
+norms           ln1 + ln2 (parallel)    single shared ln
+rotary          pct of head (split)     rotary_dim, interleaved
+attn biases     yes                     no
+mlp biases      yes                     yes
+lm_head         no bias                 bias
+==============  ======================  =====================
+
+Both use parallel residual blocks (``x + attn(ln(x)) + mlp(ln'(x))``);
+NeoX checkpoints with ``use_parallel_residual=False`` fall back to the
+sequential ordering. Same TPU shape as ``models/llama``: stacked layers under
+``lax.scan``, logical axis names per param for the sharding-rule engine.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops.attention import attention
+from ..ops.embedding import embedding_lookup
+from ..ops.norms import layer_norm
+from ..ops.rotary import apply_rotary_partial, rope_frequencies
+
+Params = Dict[str, Any]
+
+
+@dataclass(frozen=True)
+class GPTNeoXConfig:
+    vocab_size: int = 50432
+    hidden_size: int = 6144
+    intermediate_size: int = 24576
+    num_layers: int = 44
+    num_heads: int = 64
+    max_seq_len: int = 2048
+    rotary_pct: float = 0.25
+    rotary_dim: Optional[int] = None     # explicit override (GPT-J: 64)
+    rotary_interleaved: bool = False     # GPT-J rotate-every-two
+    parallel_residual: bool = True
+    shared_ln: bool = False              # GPT-J: one ln feeds both branches
+    qkv_bias: bool = True
+    attn_out_bias: bool = True
+    mlp_bias: bool = True
+    lm_head_bias: bool = False           # GPT-J: True
+    gelu_approx: bool = False            # NeoX 'gelu' (erf); GPT-J 'gelu_new'
+    layer_norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+
+    def __post_init__(self):
+        if self.shared_ln and not self.parallel_residual:
+            raise ValueError("shared_ln requires parallel_residual (the "
+                             "sequential ordering needs a distinct post-"
+                             "attention norm)")
+
+    @property
+    def head_size(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @property
+    def rot_dim(self) -> int:
+        if self.rotary_dim is not None:
+            return self.rotary_dim
+        return int(self.head_size * self.rotary_pct)
+
+    @classmethod
+    def tiny(cls, **kw) -> "GPTNeoXConfig":
+        base = dict(vocab_size=256, hidden_size=64, intermediate_size=128,
+                    num_layers=2, num_heads=4, max_seq_len=128)
+        base.update(kw)
+        return cls(**base)
+
+    @classmethod
+    def gptj_6b(cls) -> "GPTNeoXConfig":
+        return cls(vocab_size=50400, hidden_size=4096, intermediate_size=16384,
+                   num_layers=28, num_heads=16, max_seq_len=2048,
+                   rotary_dim=64, rotary_interleaved=True, shared_ln=True,
+                   qkv_bias=False, attn_out_bias=False, lm_head_bias=True,
+                   gelu_approx=True)
+
+
+def init(cfg: GPTNeoXConfig, rng: jax.Array, dtype=jnp.float32) -> Params:
+    h, hd = cfg.hidden_size, cfg.head_size
+    L, nh, v, i = cfg.num_layers, cfg.num_heads, cfg.vocab_size, cfg.intermediate_size
+    keys = jax.random.split(rng, 8)
+
+    def normal(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32) * fan_in ** -0.5).astype(dtype)
+
+    layers: Params = {
+        "ln1_scale": jnp.ones((L, h), dtype),
+        "ln1_bias": jnp.zeros((L, h), dtype),
+        "wq": normal(keys[1], (L, h, nh * hd), h),
+        "wk": normal(keys[2], (L, h, nh * hd), h),
+        "wv": normal(keys[3], (L, h, nh * hd), h),
+        "wo": normal(keys[4], (L, nh * hd, h), nh * hd),
+        "w_up": normal(keys[5], (L, h, i), h),
+        "w_down": normal(keys[6], (L, i, h), i),
+    }
+    if not cfg.shared_ln:
+        layers["ln2_scale"] = jnp.ones((L, h), dtype)
+        layers["ln2_bias"] = jnp.zeros((L, h), dtype)
+    if cfg.qkv_bias:
+        layers["bq"] = jnp.zeros((L, nh * hd), dtype)
+        layers["bk"] = jnp.zeros((L, nh * hd), dtype)
+        layers["bv"] = jnp.zeros((L, nh * hd), dtype)
+    if cfg.attn_out_bias:
+        layers["bo"] = jnp.zeros((L, h), dtype)
+    if cfg.mlp_bias:
+        layers["b_up"] = jnp.zeros((L, i), dtype)
+        layers["b_down"] = jnp.zeros((L, h), dtype)
+    params: Params = {
+        "embed": normal(keys[0], (v, h), h),
+        "layers": layers,
+        "final_ln_scale": jnp.ones((h,), dtype),
+        "final_ln_bias": jnp.zeros((h,), dtype),
+        "lm_head": normal(keys[7], (h, v), h),
+    }
+    if cfg.lm_head_bias:
+        params["lm_head_bias"] = jnp.zeros((v,), dtype)
+    return params
+
+
+def param_logical_axes(cfg: GPTNeoXConfig) -> Params:
+    layers = {
+        "ln1_scale": ("layers", "embed"),
+        "ln1_bias": ("layers", "embed"),
+        "wq": ("layers", "embed", "heads"),
+        "wk": ("layers", "embed", "heads"),
+        "wv": ("layers", "embed", "heads"),
+        "wo": ("layers", "heads", "embed"),
+        "w_up": ("layers", "embed", "mlp"),
+        "w_down": ("layers", "mlp", "embed"),
+    }
+    if not cfg.shared_ln:
+        layers["ln2_scale"] = ("layers", "embed")
+        layers["ln2_bias"] = ("layers", "embed")
+    if cfg.qkv_bias:
+        layers["bq"] = ("layers", "heads")
+        layers["bk"] = ("layers", "heads")
+        layers["bv"] = ("layers", "heads")
+    if cfg.attn_out_bias:
+        layers["bo"] = ("layers", "embed")
+    if cfg.mlp_bias:
+        layers["b_up"] = ("layers", "mlp")
+        layers["b_down"] = ("layers", "embed")
+    axes = {
+        "embed": ("vocab", "embed"),
+        "layers": layers,
+        "final_ln_scale": ("embed",),
+        "final_ln_bias": ("embed",),
+        "lm_head": ("embed", "vocab"),
+    }
+    if cfg.lm_head_bias:
+        axes["lm_head_bias"] = ("vocab",)
+    return axes
+
+
+def _qkv(cfg: GPTNeoXConfig, y: jnp.ndarray, layer: Params,
+         cos, sin, positions):
+    b, s, _ = y.shape
+    nh, hd = cfg.num_heads, cfg.head_size
+    q, k, v = y @ layer["wq"], y @ layer["wk"], y @ layer["wv"]
+    if "bq" in layer:
+        q, k, v = q + layer["bq"], k + layer["bk"], v + layer["bv"]
+    q = q.reshape(b, s, nh, hd)
+    k = k.reshape(b, s, nh, hd)
+    v = v.reshape(b, s, nh, hd)
+    rot = partial(apply_rotary_partial, rotary_dim=cfg.rot_dim,
+                  interleaved=cfg.rotary_interleaved)
+    return rot(q, cos, sin, positions), rot(k, cos, sin, positions), v
+
+
+def _mlp(cfg: GPTNeoXConfig, y: jnp.ndarray, layer: Params) -> jnp.ndarray:
+    u = y @ layer["w_up"]
+    if "b_up" in layer:
+        u = u + layer["b_up"]
+    d = jax.nn.gelu(u, approximate=cfg.gelu_approx) @ layer["w_down"]
+    if "b_down" in layer:
+        d = d + layer["b_down"]
+    return d
+
+
+def _block(cfg: GPTNeoXConfig, x: jnp.ndarray, layer: Params,
+           cos, sin, positions) -> jnp.ndarray:
+    b, s, h = x.shape
+    nh, hd = cfg.num_heads, cfg.head_size
+    y1 = layer_norm(x, layer["ln1_scale"], layer["ln1_bias"],
+                    cfg.layer_norm_eps)
+    q, k, v = _qkv(cfg, y1, layer, cos, sin, positions)
+    attn_out = attention(q, k, v, causal=True).reshape(b, s, nh * hd) @ layer["wo"]
+    if "bo" in layer:
+        attn_out = attn_out + layer["bo"]
+    if cfg.parallel_residual:
+        y2 = y1 if cfg.shared_ln else layer_norm(
+            x, layer["ln2_scale"], layer["ln2_bias"], cfg.layer_norm_eps)
+        return x + attn_out + _mlp(cfg, y2, layer)
+    x = x + attn_out
+    y2 = layer_norm(x, layer["ln2_scale"], layer["ln2_bias"],
+                    cfg.layer_norm_eps)
+    return x + _mlp(cfg, y2, layer)
+
+
+def _head(cfg: GPTNeoXConfig, params: Params, x: jnp.ndarray,
+          compute_dtype) -> jnp.ndarray:
+    x = layer_norm(x, params["final_ln_scale"].astype(compute_dtype),
+                   params["final_ln_bias"].astype(compute_dtype),
+                   cfg.layer_norm_eps)
+    logits = x @ params["lm_head"].astype(compute_dtype)
+    if "lm_head_bias" in params:
+        logits = logits + params["lm_head_bias"].astype(compute_dtype)
+    return logits.astype(jnp.float32)
+
+
+def _cast_layers(params: Params, compute_dtype):
+    return jax.tree.map(lambda p: p.astype(compute_dtype)
+                        if jnp.issubdtype(p.dtype, jnp.floating) else p,
+                        params["layers"])
+
+
+def apply(cfg: GPTNeoXConfig, params: Params, tokens: jnp.ndarray, *,
+          positions: Optional[jnp.ndarray] = None,
+          compute_dtype=jnp.bfloat16) -> jnp.ndarray:
+    x = embedding_lookup(params["embed"], tokens, compute_dtype)
+    cos, sin = rope_frequencies(cfg.rot_dim, cfg.max_seq_len, cfg.rope_theta)
+    layers = _cast_layers(params, compute_dtype)
+
+    def scan_body(x, layer):
+        return _block(cfg, x, layer, cos, sin, positions), None
+
+    x, _ = lax.scan(scan_body, x, layers)
+    return _head(cfg, params, x, compute_dtype)
+
+
+# ---- KV-cached decode (v1-engine path) ---- #
+def init_cache(cfg: GPTNeoXConfig, batch_size: int, max_len: int,
+               dtype=jnp.bfloat16) -> Params:
+    L, nh, hd = cfg.num_layers, cfg.num_heads, cfg.head_size
+    shape = (L, batch_size, max_len, nh, hd)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def cache_logical_axes(cfg: GPTNeoXConfig) -> Params:
+    spec = ("layers", None, None, "heads", None)
+    return {"k": spec, "v": spec}
+
+
+def _write_cache(cache, new, starts):
+    def one(c, n, s):
+        return lax.dynamic_update_slice(c, n.astype(c.dtype), (s, 0, 0))
+
+    return jax.vmap(one)(cache, new, starts)
+
+
+def apply_cached(cfg: GPTNeoXConfig, params: Params, tokens: jnp.ndarray,
+                 cache: Params, cache_len: jnp.ndarray, *,
+                 compute_dtype=jnp.bfloat16) -> Tuple[jnp.ndarray, Params]:
+    if cache_len.ndim == 0:
+        cache_len = jnp.broadcast_to(cache_len, (tokens.shape[0],))
+    b, t = tokens.shape
+    nh, hd = cfg.num_heads, cfg.head_size
+    x = embedding_lookup(params["embed"], tokens, compute_dtype)
+    cos, sin = rope_frequencies(cfg.rot_dim, cfg.max_seq_len, cfg.rope_theta)
+    positions = cache_len[:, None] + jnp.arange(t)[None, :]
+    layers = _cast_layers(params, compute_dtype)
+
+    def scan_body(x, scanned):
+        layer, k_c, v_c = scanned
+        S = k_c.shape[1]
+        y1 = layer_norm(x, layer["ln1_scale"], layer["ln1_bias"],
+                        cfg.layer_norm_eps)
+        q, k, v = _qkv(cfg, y1, layer, cos, sin, positions)
+        k_c = _write_cache(k_c, k, cache_len)
+        v_c = _write_cache(v_c, v, cache_len)
+        kv_pos = jnp.arange(S)[None, None, None, :]
+        q_abs = cache_len[:, None, None, None] + jnp.arange(t)[None, None, :, None]
+        mask = kv_pos <= q_abs
+        attn_out = attention(q, k_c, v_c, causal=False, mask=mask)
+        attn_out = attn_out.reshape(b, t, nh * hd) @ layer["wo"]
+        if "bo" in layer:
+            attn_out = attn_out + layer["bo"]
+        if cfg.parallel_residual:
+            y2 = y1 if cfg.shared_ln else layer_norm(
+                x, layer["ln2_scale"], layer["ln2_bias"], cfg.layer_norm_eps)
+            x = x + attn_out + _mlp(cfg, y2, layer)
+        else:
+            x = x + attn_out
+            y2 = layer_norm(x, layer["ln2_scale"], layer["ln2_bias"],
+                            cfg.layer_norm_eps)
+            x = x + _mlp(cfg, y2, layer)
+        return x, (k_c, v_c)
+
+    x, (new_k, new_v) = lax.scan(scan_body, x, (layers, cache["k"], cache["v"]))
+    return _head(cfg, params, x, compute_dtype), {"k": new_k, "v": new_v}
+
+
+def loss_fn(cfg: GPTNeoXConfig, params: Params, batch: Dict[str, jnp.ndarray],
+            *, compute_dtype=jnp.bfloat16):
+    tokens = batch["tokens"]
+    if "labels" in batch:
+        inputs, labels = tokens, batch["labels"]
+    else:
+        inputs, labels = tokens[:, :-1], tokens[:, 1:]
+    logits = apply(cfg, params, inputs, compute_dtype=compute_dtype)
+    valid = labels != -100
+    safe = jnp.where(valid, labels, 0)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    tl = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+    loss = jnp.where(valid, tl, 0.0).sum() / jnp.maximum(valid.sum(), 1)
+    return loss, {"loss": loss, "ntokens": valid.sum()}
+
+
+def model_spec(cfg: GPTNeoXConfig, compute_dtype=jnp.bfloat16):
+    from ..runtime.engine import ModelSpec
+
+    return ModelSpec(
+        name="gptneox",
+        init_fn=lambda rng: init(cfg, rng),
+        loss_fn=lambda params, batch: loss_fn(cfg, params, batch,
+                                              compute_dtype=compute_dtype),
+        apply_fn=lambda params, tokens, **kw: apply(
+            cfg, params, tokens, compute_dtype=compute_dtype, **kw),
+        logical_axes=param_logical_axes(cfg),
+        pipeline_capable=False,
+    )

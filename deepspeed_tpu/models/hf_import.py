@@ -11,7 +11,8 @@ then the checkpoint layer's job (orbax/universal).
 Supported families: Llama/Mistral/Qwen2/Phi-3 (→ ``models/llama``; fused
 QKV/gate-up checkpoints are split), GPT-2 (→ ``models/gpt``),
 Mixtral/Qwen2-MoE (→ ``models/mixtral``), Falcon (→ ``models/falcon``), OPT (→ ``models/gpt``,
-ReLU/pre-LN). Accepts a live
+ReLU/pre-LN), GPT-NeoX/GPT-J (→ ``models/gptneox``), BLOOM (→ ``models/bloom``,
+ALiBi). Accepts a live
 ``transformers`` model, a state-dict mapping, or a local checkpoint directory
 (no network access is assumed). Un-annotated models TP-shard via the AutoTP
 name-rule pass (``module_inject/auto_tp.py``).
@@ -570,6 +571,186 @@ def falcon_params_from_hf(src, cfg) -> Params:
     return params
 
 
+def _split_fused_qkv(w: np.ndarray, nh: int, hd: int):
+    """De-interleave an HF fused query_key_value projection whose output rows
+    are grouped per head as [q(hd); k(hd); v(hd)] (GPT-NeoX views the fused
+    tensor as (nh, 3*hd), BLOOM as (nh, 3, hd) — the same row layout).
+    w: [3*nh*hd, in] or bias [3*nh*hd] → (q, k, v) each [nh*hd(, in)]."""
+    shape = (nh, 3, hd) + w.shape[1:]
+    grouped = w.reshape(shape)
+    return tuple(grouped[:, j].reshape((nh * hd,) + w.shape[1:])
+                 for j in range(3))
+
+
+def gptneox_config_from_hf(hf_config) -> "Any":
+    from .gptneox import GPTNeoXConfig
+
+    return GPTNeoXConfig(
+        vocab_size=hf_config.vocab_size,
+        hidden_size=hf_config.hidden_size,
+        intermediate_size=hf_config.intermediate_size,
+        num_layers=hf_config.num_hidden_layers,
+        num_heads=hf_config.num_attention_heads,
+        max_seq_len=hf_config.max_position_embeddings,
+        rotary_pct=float(getattr(hf_config, "rotary_pct", 1.0)),
+        rope_theta=float(getattr(hf_config, "rotary_emb_base", 10000.0)),
+        parallel_residual=bool(getattr(hf_config, "use_parallel_residual",
+                                       True)),
+        gelu_approx=getattr(hf_config, "hidden_act", "gelu") in
+        ("gelu_new", "gelu_pytorch_tanh", "gelu_fast"),
+        layer_norm_eps=float(getattr(hf_config, "layer_norm_eps", 1e-5)),
+    )
+
+
+def gptneox_params_from_hf(src, cfg=None) -> Params:
+    """HF GPTNeoXForCausalLM → ``models/gptneox`` pytree (fused QKV is
+    de-interleaved per head so TP can shard the heads axis)."""
+    sd = _normalize_state_dict(src)
+    L = cfg.num_layers
+    nh, hd = cfg.num_heads, cfg.head_size
+    lay = "gpt_neox.layers.{i}."
+    qkv_w = _stack(sd, lay + "attention.query_key_value.weight", L)
+    qkv_b = _stack(sd, lay + "attention.query_key_value.bias", L)
+    wq, wk, wv = zip(*(_split_fused_qkv(w, nh, hd) for w in qkv_w))
+    bq, bk, bv = zip(*(_split_fused_qkv(b, nh, hd) for b in qkv_b))
+    params: Params = {
+        "embed": sd["gpt_neox.embed_in.weight"],
+        "layers": {
+            "ln1_scale": _stack(sd, lay + "input_layernorm.weight", L),
+            "ln1_bias": _stack(sd, lay + "input_layernorm.bias", L),
+            "ln2_scale": _stack(sd, lay + "post_attention_layernorm.weight", L),
+            "ln2_bias": _stack(sd, lay + "post_attention_layernorm.bias", L),
+            "wq": np.stack([w.T for w in wq]),
+            "wk": np.stack([w.T for w in wk]),
+            "wv": np.stack([w.T for w in wv]),
+            "bq": np.stack(bq), "bk": np.stack(bk), "bv": np.stack(bv),
+            "wo": _stack(sd, lay + "attention.dense.weight", L, transpose=True),
+            "bo": _stack(sd, lay + "attention.dense.bias", L),
+            "w_up": _stack(sd, lay + "mlp.dense_h_to_4h.weight", L,
+                           transpose=True),
+            "b_up": _stack(sd, lay + "mlp.dense_h_to_4h.bias", L),
+            "w_down": _stack(sd, lay + "mlp.dense_4h_to_h.weight", L,
+                             transpose=True),
+            "b_down": _stack(sd, lay + "mlp.dense_4h_to_h.bias", L),
+        },
+        "final_ln_scale": sd["gpt_neox.final_layer_norm.weight"],
+        "final_ln_bias": sd["gpt_neox.final_layer_norm.bias"],
+        "lm_head": sd["embed_out.weight"].T,
+    }
+    log_dist(f"imported HF gpt_neox weights: {L} layers")
+    return params
+
+
+def gptj_config_from_hf(hf_config) -> "Any":
+    from .gptneox import GPTNeoXConfig
+
+    inner = getattr(hf_config, "n_inner", None) or 4 * hf_config.n_embd
+    # HF GPT-J rotates the FULL head dim when rotary_dim is None
+    rotary_dim = getattr(hf_config, "rotary_dim", None)
+    if rotary_dim is None:
+        rotary_dim = hf_config.n_embd // hf_config.n_head
+    return GPTNeoXConfig(
+        vocab_size=hf_config.vocab_size,
+        hidden_size=hf_config.n_embd,
+        intermediate_size=inner,
+        num_layers=hf_config.n_layer,
+        num_heads=hf_config.n_head,
+        max_seq_len=hf_config.n_positions,
+        rotary_dim=rotary_dim,
+        rotary_interleaved=True,
+        shared_ln=True,
+        qkv_bias=False,
+        attn_out_bias=False,
+        lm_head_bias=True,
+        gelu_approx=True,   # 'gelu_new'
+        layer_norm_eps=float(getattr(hf_config, "layer_norm_epsilon", 1e-5)),
+    )
+
+
+def gptj_params_from_hf(src, cfg=None) -> Params:
+    """HF GPTJForCausalLM → ``models/gptneox`` pytree (shared-ln variant)."""
+    sd = _normalize_state_dict(src)
+    L = cfg.num_layers
+    lay = "transformer.h.{i}."
+    params: Params = {
+        "embed": sd["transformer.wte.weight"],
+        "layers": {
+            "ln1_scale": _stack(sd, lay + "ln_1.weight", L),
+            "ln1_bias": _stack(sd, lay + "ln_1.bias", L),
+            "wq": _stack(sd, lay + "attn.q_proj.weight", L, transpose=True),
+            "wk": _stack(sd, lay + "attn.k_proj.weight", L, transpose=True),
+            "wv": _stack(sd, lay + "attn.v_proj.weight", L, transpose=True),
+            "wo": _stack(sd, lay + "attn.out_proj.weight", L, transpose=True),
+            "w_up": _stack(sd, lay + "mlp.fc_in.weight", L, transpose=True),
+            "b_up": _stack(sd, lay + "mlp.fc_in.bias", L),
+            "w_down": _stack(sd, lay + "mlp.fc_out.weight", L, transpose=True),
+            "b_down": _stack(sd, lay + "mlp.fc_out.bias", L),
+        },
+        "final_ln_scale": sd["transformer.ln_f.weight"],
+        "final_ln_bias": sd["transformer.ln_f.bias"],
+        "lm_head": sd["lm_head.weight"].T,
+        "lm_head_bias": sd["lm_head.bias"],
+    }
+    log_dist(f"imported HF gptj weights: {L} layers")
+    return params
+
+
+def bloom_config_from_hf(hf_config) -> "Any":
+    from .bloom import BloomConfig
+
+    return BloomConfig(
+        vocab_size=hf_config.vocab_size,
+        hidden_size=hf_config.hidden_size,
+        num_layers=hf_config.n_layer,
+        num_heads=hf_config.n_head,
+        max_seq_len=getattr(hf_config, "seq_length", 2048),
+        layer_norm_eps=float(getattr(hf_config, "layer_norm_epsilon", 1e-5)),
+    )
+
+
+def bloom_params_from_hf(src, cfg=None) -> Params:
+    """HF BloomForCausalLM → ``models/bloom`` pytree. The fused
+    query_key_value rows are per-head [q;k;v] groups — same layout as
+    GPT-NeoX — de-interleaved here so the TP rules shard heads."""
+    sd = _normalize_state_dict(src)
+    pfx = "transformer." if any(k.startswith("transformer.") for k in sd) else ""
+    L = cfg.num_layers
+    nh, hd = cfg.num_heads, cfg.head_size
+    lay = pfx + "h.{i}."
+    qkv_w = _stack(sd, lay + "self_attention.query_key_value.weight", L)
+    qkv_b = _stack(sd, lay + "self_attention.query_key_value.bias", L)
+    wq, wk, wv = zip(*(_split_fused_qkv(w, nh, hd) for w in qkv_w))
+    bq, bk, bv = zip(*(_split_fused_qkv(b, nh, hd) for b in qkv_b))
+    params: Params = {
+        "embed": sd[pfx + "word_embeddings.weight"],
+        "embed_ln_scale": sd[pfx + "word_embeddings_layernorm.weight"],
+        "embed_ln_bias": sd[pfx + "word_embeddings_layernorm.bias"],
+        "layers": {
+            "ln1_scale": _stack(sd, lay + "input_layernorm.weight", L),
+            "ln1_bias": _stack(sd, lay + "input_layernorm.bias", L),
+            "wq": np.stack([w.T for w in wq]),
+            "wk": np.stack([w.T for w in wk]),
+            "wv": np.stack([w.T for w in wv]),
+            "bq": np.stack(bq), "bk": np.stack(bk), "bv": np.stack(bv),
+            "wo": _stack(sd, lay + "self_attention.dense.weight", L,
+                         transpose=True),
+            "bo": _stack(sd, lay + "self_attention.dense.bias", L),
+            "ln2_scale": _stack(sd, lay + "post_attention_layernorm.weight", L),
+            "ln2_bias": _stack(sd, lay + "post_attention_layernorm.bias", L),
+            "w_up": _stack(sd, lay + "mlp.dense_h_to_4h.weight", L,
+                           transpose=True),
+            "b_up": _stack(sd, lay + "mlp.dense_h_to_4h.bias", L),
+            "w_down": _stack(sd, lay + "mlp.dense_4h_to_h.weight", L,
+                             transpose=True),
+            "b_down": _stack(sd, lay + "mlp.dense_4h_to_h.bias", L),
+        },
+        "final_ln_scale": sd[pfx + "ln_f.weight"],
+        "final_ln_bias": sd[pfx + "ln_f.bias"],
+    }
+    log_dist(f"imported HF bloom weights: {L} layers (alibi heads={nh})")
+    return params
+
+
 _FAMILIES = {
     "llama": (llama_config_from_hf, llama_params_from_hf),
     "mistral": (llama_config_from_hf, llama_params_from_hf),
@@ -580,6 +761,9 @@ _FAMILIES = {
     "mixtral": (mixtral_config_from_hf, mixtral_params_from_hf),
     "qwen2_moe": (qwen2_moe_config_from_hf, qwen2_moe_params_from_hf),
     "falcon": (falcon_config_from_hf, falcon_params_from_hf),
+    "gpt_neox": (gptneox_config_from_hf, gptneox_params_from_hf),
+    "gptj": (gptj_config_from_hf, gptj_params_from_hf),
+    "bloom": (bloom_config_from_hf, bloom_params_from_hf),
 }
 
 

@@ -207,25 +207,53 @@ def _qkv_proj(cfg: LlamaConfig, y: jnp.ndarray, layer: Params):
             v.reshape(b, s, nkv, hd))
 
 
+def _residual_sharding():
+    """NamedSharding pinning the [batch, seq, hidden] residual stream to its
+    canonical layout (batch over data axes, seq over 'seq', hidden
+    replicated), or None when no TP/SP axis is active.
+
+    Megatron-SP analog: without this pin, SPMD propagation can land the TP
+    row-parallel all-reduce output hidden-sharded (backward-propagated from
+    the next layer's ZeRO-sharded weights) and then pays an involuntary full
+    rematerialization resharding it to batch/seq for the residual add
+    (observed in the r1 8-device dryrun). Pinning the dot output makes XLA
+    emit the partial-sum all-reduce over 'tensor' straight into the
+    batch/seq layout."""
+    try:
+        from ..comm.mesh import get_mesh
+
+        mm = get_mesh()
+        if mm.tp_world_size > 1 or mm.sp_world_size > 1:
+            return mm.batch_sharding(extra_seq_axis=True)
+    except Exception:
+        pass
+    return None
+
+
 def _block(cfg: LlamaConfig, x: jnp.ndarray, layer: Params,
            cos: jnp.ndarray, sin: jnp.ndarray,
            positions: Optional[jnp.ndarray],
-           attn_fn=attention) -> jnp.ndarray:
+           attn_fn=attention, res_sharding=None) -> jnp.ndarray:
     """One transformer block. x: [batch, seq, hidden] (compute dtype)."""
     b, s, h = x.shape
     nh, nkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_size
+
+    def pin(t):
+        if res_sharding is None:
+            return t
+        return lax.with_sharding_constraint(t, res_sharding)
 
     y = rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps)
     q, k, v = _qkv_proj(cfg, y, layer)
     q = apply_rotary(q, cos, sin, positions)
     k = apply_rotary(k, cos, sin, positions)
     attn_out = attn_fn(q, k, v, causal=True)
-    x = x + attn_out.reshape(b, s, nh * hd) @ layer["wo"]
+    x = x + pin(attn_out.reshape(b, s, nh * hd) @ layer["wo"])
 
     y = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
     gate = jax.nn.silu(y @ layer["w_gate"])
     up = y @ layer["w_up"]
-    x = x + (gate * up) @ layer["w_down"]
+    x = x + pin((gate * up) @ layer["w_down"])
     return x
 
 
@@ -255,7 +283,10 @@ def apply(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray, *,
             pipe_stages = 1
 
     attn_fn = _resolve_attention(cfg, in_pipeline=pipe_stages > 1)
-    block = partial(_block, cfg, attn_fn=attn_fn)
+    # no residual pin inside the pipeline's manual shard_map region (the
+    # full-mesh NamedSharding is not addressable from there)
+    res_sharding = _residual_sharding() if pipe_stages == 1 else None
+    block = partial(_block, cfg, attn_fn=attn_fn, res_sharding=res_sharding)
     if cfg.remat:
         # route through the shared remat-policy registry
         # (runtime/activation_checkpointing) so the config knob and the model

@@ -43,3 +43,38 @@ def apply_rotary_xla(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray,
 
 
 apply_rotary = op("rotary_embed")
+
+
+def apply_rotary_interleaved(x: jnp.ndarray, cos: jnp.ndarray,
+                             sin: jnp.ndarray,
+                             positions: jnp.ndarray = None) -> jnp.ndarray:
+    """GPT-J convention: rotate every two adjacent dims ((x0,x1), (x2,x3), …)
+    instead of split halves. Reference: the v1 injection path handles both
+    conventions in ``apply_rotary_pos_emb.cu`` (``rotate_every_two`` vs
+    ``rotate_half``)."""
+    seq = x.shape[-3]
+    if positions is None:
+        c = cos[:seq][:, None, :]
+        s = sin[:seq][:, None, :]
+    else:
+        c = cos[positions][..., :, None, :]
+        s = sin[positions][..., :, None, :]
+    xf = x.astype(jnp.float32)
+    x1, x2 = xf[..., ::2], xf[..., 1::2]
+    out = jnp.stack([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+    return out.reshape(x.shape).astype(x.dtype)
+
+
+def apply_rotary_partial(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray,
+                         positions: jnp.ndarray = None, *,
+                         rotary_dim: int = None,
+                         interleaved: bool = False) -> jnp.ndarray:
+    """Rotate only the first ``rotary_dim`` dims of the head (GPT-NeoX
+    ``rotary_pct``, GPT-J ``rotary_dim``); the tail passes through."""
+    rd = rotary_dim if rotary_dim is not None else x.shape[-1]
+    rot_fn = apply_rotary_interleaved if interleaved else apply_rotary
+    if rd >= x.shape[-1]:
+        return rot_fn(x, cos, sin, positions)
+    x_rot, x_pass = x[..., :rd], x[..., rd:]
+    return jnp.concatenate([rot_fn(x_rot, cos, sin, positions), x_pass],
+                           axis=-1)

@@ -9,6 +9,7 @@ never enters the jit graph.
 
 from __future__ import annotations
 
+import os
 from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
@@ -17,30 +18,108 @@ from ...utils.logging import log_dist
 
 
 class DataAnalyzer:
-    """Compute per-sample metrics over a dataset (reference DataAnalyzer —
-    file-backed map/reduce collapsed to an in-memory pass; datasets that
-    exceed memory stream through ``run_map`` in chunks)."""
+    """Compute per-sample metrics over a dataset (reference DataAnalyzer,
+    ``data_sampling/data_analyzer.py:22``): map workers each cover a
+    contiguous shard of sample indices and persist per-worker index files;
+    ``run_reduce`` merges them into the final metric arrays. The reference's
+    file-backed map/reduce machinery stays, minus torch/mmap: numpy ``.npz``
+    per worker.
+
+    Metric types (reference :71-89):
+    - ``single_value_per_sample`` — fn(sample) → scalar; yields one value per
+      sample plus the sorted easiest→hardest index.
+    - ``accumulate_value_over_samples`` — fn(sample) → vector; values are
+      summed across samples (e.g. vocabulary histograms)."""
 
     def __init__(self, dataset: Sequence,
-                 metric_fns: Dict[str, Callable[[object], float]]):
+                 metric_fns: Dict[str, Callable[[object], object]],
+                 metric_types: Optional[Dict[str, str]] = None,
+                 save_path: Optional[str] = None,
+                 num_workers: int = 1, worker_id: int = 0):
         self.dataset = dataset
         self.metric_fns = metric_fns
+        self.metric_types = metric_types or {
+            m: "single_value_per_sample" for m in metric_fns}
+        self.save_path = save_path
+        self.num_workers = num_workers
+        self.worker_id = worker_id
         self.metrics: Dict[str, np.ndarray] = {}
 
-    def run_map(self, chunk_size: int = 4096) -> Dict[str, np.ndarray]:
-        vals: Dict[str, List[float]] = {m: [] for m in self.metric_fns}
-        for start in range(0, len(self.dataset), chunk_size):
-            for i in range(start, min(start + chunk_size, len(self.dataset))):
-                sample = self.dataset[i]
-                for name, fn in self.metric_fns.items():
-                    vals[name].append(float(fn(sample)))
-        self.metrics = {m: np.asarray(v) for m, v in vals.items()}
-        return self.metrics
+    def _worker_range(self, n: int, worker_id: int):
+        per = (n + self.num_workers - 1) // self.num_workers
+        return range(worker_id * per, min((worker_id + 1) * per, n))
+
+    def _worker_file(self, worker_id: int) -> str:
+        return os.path.join(self.save_path,
+                            f"metrics_worker{worker_id}.npz")
+
+    def run_map(self) -> Dict[str, np.ndarray]:
+        """Analyze this worker's shard; persist to the worker index file when
+        ``save_path`` is set."""
+        idx = self._worker_range(len(self.dataset), self.worker_id)
+        single: Dict[str, List[float]] = {}
+        accum: Dict[str, np.ndarray] = {}
+        for i in idx:
+            sample = self.dataset[i]
+            for name, fn in self.metric_fns.items():
+                v = fn(sample)
+                if self.metric_types[name] == "accumulate_value_over_samples":
+                    v = np.asarray(v)
+                    accum[name] = v if name not in accum else accum[name] + v
+                else:
+                    single.setdefault(name, []).append(float(v))
+        out = {m: np.asarray(v) for m, v in single.items()}
+        out.update(accum)
+        if self.save_path is not None:
+            os.makedirs(self.save_path, exist_ok=True)
+            # persist each metric's type alongside its values so run_reduce
+            # does not depend on being re-constructed with matching
+            # metric_types (concat-vs-sum would silently diverge)
+            types = {f"__type__{m}": np.str_(self.metric_types[m])
+                     for m in out}
+            np.savez(self._worker_file(self.worker_id), **out, **types)
+            log_dist(f"DataAnalyzer worker {self.worker_id}/"
+                     f"{self.num_workers}: wrote "
+                     f"{self._worker_file(self.worker_id)}")
+        if self.num_workers == 1:
+            self.metrics = out
+        return out
+
+    def run_reduce(self) -> Dict[str, np.ndarray]:
+        """Merge all worker index files (concat per-sample metrics in worker
+        order; sum accumulated metrics) → final metric arrays."""
+        if self.num_workers == 1 and self.metrics:
+            return self.metrics
+        assert self.save_path is not None, "run_reduce needs save_path"
+        merged: Dict[str, np.ndarray] = {}
+        for w in range(self.num_workers):
+            with np.load(self._worker_file(w)) as z:
+                types = {name[len("__type__"):]: str(z[name])
+                         for name in z.files if name.startswith("__type__")}
+                for name in z.files:
+                    if name.startswith("__type__"):
+                        continue
+                    part = z[name]
+                    mtype = types.get(name, self.metric_types.get(
+                        name, "single_value_per_sample"))
+                    if name not in merged:
+                        merged[name] = part
+                    elif mtype == "accumulate_value_over_samples":
+                        merged[name] = merged[name] + part
+                    else:
+                        merged[name] = np.concatenate([merged[name], part])
+        self.metrics = merged
+        if self.save_path is not None:
+            np.savez(os.path.join(self.save_path, "metrics_merged.npz"),
+                     **merged)
+        return merged
 
     def index_by_difficulty(self, metric: str) -> np.ndarray:
         """Sample indices sorted easiest → hardest."""
         if metric not in self.metrics:
             self.run_map()
+            if self.num_workers > 1:
+                self.run_reduce()
         return np.argsort(self.metrics[metric], kind="stable")
 
 

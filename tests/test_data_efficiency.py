@@ -142,3 +142,27 @@ def test_data_analyzer_and_curriculum_sampler():
     assert all(metrics["seqlen"][i] <= 5 for i in early)
     late = sampler.sample_batch(global_step=10)
     assert len(late) == 2  # everything eligible at the end
+
+
+def test_data_analyzer_workers_and_reduce(tmp_path):
+    """Multi-worker map/reduce with persisted index files (reference
+    DataAnalyzer file-backed merge) + accumulate-type metrics."""
+    data = [np.full(i + 1, i) for i in range(10)]
+    fns = {"seqlen": len,
+           "token_hist": lambda s: np.bincount(np.asarray(s) % 4,
+                                               minlength=4)}
+    types = {"seqlen": "single_value_per_sample",
+             "token_hist": "accumulate_value_over_samples"}
+    for w in range(3):
+        DataAnalyzer(data, fns, metric_types=types, save_path=str(tmp_path),
+                     num_workers=3, worker_id=w).run_map()
+    final = DataAnalyzer(data, fns, metric_types=types,
+                         save_path=str(tmp_path), num_workers=3,
+                         worker_id=0)
+    merged = final.run_reduce()
+    np.testing.assert_array_equal(merged["seqlen"], np.arange(1, 11))
+    # 1 zero, 2 ones, ... accumulated across all workers
+    assert merged["token_hist"].sum() == sum(len(d) for d in data)
+    order = final.index_by_difficulty("seqlen")
+    np.testing.assert_array_equal(order, np.arange(10))
+    assert (tmp_path / "metrics_merged.npz").exists()

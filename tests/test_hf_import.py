@@ -322,3 +322,124 @@ def test_qwen2_moe_logit_parity():
     logits, _aux = mixtral.apply(cfg, params, jnp.asarray(tokens),
                                  compute_dtype=jnp.float32)
     np.testing.assert_allclose(np.asarray(logits), ref, rtol=2e-3, atol=2e-3)
+
+
+def test_gptneox_logit_parity():
+    """GPT-NeoX: fused per-head QKV de-interleave, partial rotary
+    (rotary_pct), parallel residual with separate norms."""
+    from deepspeed_tpu.models import gptneox
+
+    hf_cfg = transformers.GPTNeoXConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=256,
+        num_hidden_layers=2, num_attention_heads=4,
+        max_position_embeddings=64, rotary_pct=0.25,
+        use_parallel_residual=True, hidden_act="gelu")
+    torch.manual_seed(12)
+    hf_model = transformers.GPTNeoXForCausalLM(hf_cfg).eval()
+    cfg, params = from_hf(hf_model)
+    assert cfg.rot_dim == 4 and cfg.parallel_residual and not cfg.gelu_approx
+    tokens = np.random.RandomState(12).randint(0, 128, (2, 10))
+    with torch.no_grad():
+        ref = hf_model(torch.tensor(tokens)).logits.numpy()
+    ours = np.asarray(gptneox.apply(cfg, params, jnp.asarray(tokens),
+                                    compute_dtype=jnp.float32))
+    np.testing.assert_allclose(ours, ref, rtol=2e-3, atol=2e-3)
+
+
+def test_gptneox_sequential_variant():
+    """use_parallel_residual=False checkpoints run the sequential ordering."""
+    from deepspeed_tpu.models import gptneox
+
+    hf_cfg = transformers.GPTNeoXConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=256,
+        num_hidden_layers=2, num_attention_heads=4,
+        max_position_embeddings=64, rotary_pct=1.0,
+        use_parallel_residual=False)
+    torch.manual_seed(13)
+    hf_model = transformers.GPTNeoXForCausalLM(hf_cfg).eval()
+    cfg, params = from_hf(hf_model)
+    assert not cfg.parallel_residual
+    tokens = np.random.RandomState(13).randint(0, 128, (2, 10))
+    with torch.no_grad():
+        ref = hf_model(torch.tensor(tokens)).logits.numpy()
+    ours = np.asarray(gptneox.apply(cfg, params, jnp.asarray(tokens),
+                                    compute_dtype=jnp.float32))
+    np.testing.assert_allclose(ours, ref, rtol=2e-3, atol=2e-3)
+
+
+def test_gptj_logit_parity():
+    """GPT-J: interleaved (rotate-every-two) partial rotary, shared ln,
+    bias-free attention, lm_head bias."""
+    from deepspeed_tpu.models import gptneox
+
+    hf_cfg = transformers.GPTJConfig(
+        vocab_size=128, n_embd=64, n_layer=2, n_head=4, n_positions=64,
+        rotary_dim=8, n_inner=None, activation_function="gelu_new")
+    torch.manual_seed(14)
+    hf_model = transformers.GPTJForCausalLM(hf_cfg).eval()
+    cfg, params = from_hf(hf_model, family="gptj")
+    assert cfg.rotary_interleaved and cfg.shared_ln and cfg.lm_head_bias
+    tokens = np.random.RandomState(14).randint(0, 128, (2, 10))
+    with torch.no_grad():
+        ref = hf_model(torch.tensor(tokens)).logits.numpy()
+    ours = np.asarray(gptneox.apply(cfg, params, jnp.asarray(tokens),
+                                    compute_dtype=jnp.float32))
+    np.testing.assert_allclose(ours, ref, rtol=2e-3, atol=2e-3)
+
+
+def test_bloom_logit_parity():
+    """BLOOM: ALiBi bias, embedding layernorm, fused QKV de-interleave
+    ((nh, 3, hd) row grouping), tied head."""
+    from deepspeed_tpu.models import bloom as bloom_mod
+
+    hf_cfg = transformers.BloomConfig(
+        vocab_size=128, hidden_size=64, n_layer=2, n_head=4,
+        layer_norm_epsilon=1e-5)
+    torch.manual_seed(15)
+    hf_model = transformers.BloomForCausalLM(hf_cfg).eval()
+    cfg, params = from_hf(hf_model)
+    tokens = np.random.RandomState(15).randint(0, 128, (2, 10))
+    with torch.no_grad():
+        ref = hf_model(torch.tensor(tokens)).logits.numpy()
+    ours = np.asarray(bloom_mod.apply(cfg, params, jnp.asarray(tokens),
+                                      compute_dtype=jnp.float32))
+    np.testing.assert_allclose(ours, ref, rtol=2e-3, atol=2e-3)
+
+
+def test_bloom_cached_matches_full():
+    from deepspeed_tpu.models import bloom as bloom_mod
+
+    cfg = bloom_mod.BloomConfig.tiny()
+    params = bloom_mod.init(cfg, jax.random.PRNGKey(0))
+    tokens = jnp.asarray(np.random.RandomState(16).randint(0, 256, (2, 12)))
+    full = bloom_mod.apply(cfg, params, tokens, compute_dtype=jnp.float32)
+    cache = bloom_mod.init_cache(cfg, 2, 32, dtype=jnp.float32)
+    logits1, cache = bloom_mod.apply_cached(
+        cfg, params, tokens[:, :8], cache, jnp.int32(0),
+        compute_dtype=jnp.float32)
+    logits2, _ = bloom_mod.apply_cached(
+        cfg, params, tokens[:, 8:], cache, jnp.int32(8),
+        compute_dtype=jnp.float32)
+    got = np.concatenate([np.asarray(logits1), np.asarray(logits2)], axis=1)
+    np.testing.assert_allclose(got, np.asarray(full), rtol=2e-4, atol=2e-4)
+
+
+def test_gptj_cached_matches_full():
+    from deepspeed_tpu.models import gptneox
+
+    cfg = gptneox.GPTNeoXConfig.tiny(rotary_dim=8, rotary_interleaved=True,
+                                     shared_ln=True, qkv_bias=False,
+                                     attn_out_bias=False, lm_head_bias=True,
+                                     gelu_approx=True)
+    params = gptneox.init(cfg, jax.random.PRNGKey(1))
+    tokens = jnp.asarray(np.random.RandomState(17).randint(0, 256, (2, 12)))
+    full = gptneox.apply(cfg, params, tokens, compute_dtype=jnp.float32)
+    cache = gptneox.init_cache(cfg, 2, 32, dtype=jnp.float32)
+    logits1, cache = gptneox.apply_cached(
+        cfg, params, tokens[:, :8], cache, jnp.int32(0),
+        compute_dtype=jnp.float32)
+    logits2, _ = gptneox.apply_cached(
+        cfg, params, tokens[:, 8:], cache, jnp.int32(8),
+        compute_dtype=jnp.float32)
+    got = np.concatenate([np.asarray(logits1), np.asarray(logits2)], axis=1)
+    np.testing.assert_allclose(got, np.asarray(full), rtol=2e-4, atol=2e-4)

@@ -1,0 +1,71 @@
+"""Inference v2 module system (reference ``inference/v2/modules`` registry:
+per-slot implementation selection by ``supports_config``)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.inference.modules import (AttentionConfig, LinearConfig,
+                                             NormConfig, UnembedConfig,
+                                             registry)
+
+
+def test_slot_selection_by_config():
+    dense = registry.instantiate("attention", AttentionConfig(paged=False))
+    paged = registry.instantiate("attention", AttentionConfig(paged=True))
+    assert dense is not paged
+    assert "paged_pallas" in registry.implementations("attention")
+
+
+def test_norm_slot_variants():
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 4, 8), jnp.float32)
+    scale = jnp.ones((8,))
+    bias = jnp.zeros((8,))
+    rms = registry.instantiate("norm", NormConfig(kind="rms", eps=1e-6))
+    ln = registry.instantiate("norm", NormConfig(kind="layer", eps=1e-5))
+    out_rms = rms(x, scale)
+    out_ln = ln(x, scale, bias)
+    assert out_rms.shape == x.shape and out_ln.shape == x.shape
+    np.testing.assert_allclose(np.asarray(out_ln).mean(-1), 0.0, atol=1e-5)
+
+
+def test_linear_slot_quant_routing():
+    from deepspeed_tpu.ops.quantization import quantize_int8
+
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(3, 16), jnp.float32)
+    w = jnp.asarray(rng.randn(16, 8), jnp.float32)
+    dense = registry.instantiate("linear", LinearConfig())
+    quant = registry.instantiate("linear", LinearConfig(quant_bits=8))
+    ref = np.asarray(dense(x, w))
+    qw, scales = quantize_int8(w, group_size=16)
+    got = np.asarray(quant(x, qw, scales))
+    np.testing.assert_allclose(got, ref, rtol=0.1, atol=0.1)
+
+
+def test_linear_fused_activation():
+    x = jnp.asarray([[1.0, -2.0]], jnp.float32)
+    w = jnp.eye(2, dtype=jnp.float32)
+    relu = registry.instantiate("linear", LinearConfig(activation="relu"))
+    np.testing.assert_allclose(np.asarray(relu(x, w)), [[1.0, 0.0]])
+
+
+def test_unembed_tiled_matches_full():
+    rng = np.random.RandomState(2)
+    x = jnp.asarray(rng.randn(2, 7, 8), jnp.float32)
+    head = jnp.asarray(rng.randn(8, 32), jnp.float32)
+    full = registry.instantiate("unembed", UnembedConfig())
+    tiled = registry.instantiate("unembed", UnembedConfig(tile_tokens=4))
+    np.testing.assert_allclose(np.asarray(tiled(x, head)),
+                               np.asarray(full(x, head)), rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_no_impl_raises():
+    class Weird(NormConfig):
+        pass
+
+    with pytest.raises(ValueError, match="no implementation"):
+        registry.instantiate("norm", NormConfig(kind="group"))

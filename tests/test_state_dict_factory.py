@@ -1,0 +1,81 @@
+"""TP-degree-changing checkpoint load (reference
+``runtime/state_dict_factory.py`` — merge/split of Megatron mp_rank shards)."""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.state_dict_factory import (MegatronSDLoader,
+                                                      SDLoaderFactory)
+
+H, NH = 8, 4  # hidden, heads
+
+
+def _full_sd(seed=0, ckpt_ver=2.0):
+    rng = np.random.RandomState(seed)
+    return {
+        "checkpoint_version": ckpt_ver,
+        "module": {
+            "layer.0.attention.query_key_value.weight": rng.randn(3 * H, H),
+            "layer.0.attention.dense.weight": rng.randn(H, H),
+            "layer.0.mlp.dense_h_to_4h.weight": rng.randn(4 * H, H),
+            "layer.0.mlp.dense_h_to_4h.bias": rng.randn(4 * H),
+            "layer.0.mlp.dense_4h_to_h.weight": rng.randn(H, 4 * H),
+            "word_embeddings.weight": rng.randn(32, H),
+            "layer.0.input_layernorm.weight": rng.randn(H),
+        },
+    }
+
+
+def _split_all(sd, ways):
+    loader = MegatronSDLoader([sd], version=sd["checkpoint_version"])
+    return [loader.split_state_dict(ways, r)[0] for r in range(ways)]
+
+
+@pytest.mark.parametrize("ckpt_ver", [0, 2.0])
+def test_split_then_merge_roundtrip(ckpt_ver):
+    sd = _full_sd(ckpt_ver=ckpt_ver)
+    shards = _split_all(sd, 4)
+    loader = SDLoaderFactory.get_sd_loader(shards, version=ckpt_ver)
+    merged, n = loader.merge_state_dict(1, 0)
+    assert n == 4
+    for k, v in sd["module"].items():
+        np.testing.assert_allclose(merged["module"][k], v, err_msg=k)
+
+
+def test_split_shapes_and_replication():
+    sd = _full_sd()
+    shards = _split_all(sd, 2)
+    m = shards[1]["module"]
+    assert m["layer.0.attention.query_key_value.weight"].shape == (3 * H // 2, H)
+    assert m["layer.0.attention.dense.weight"].shape == (H, H // 2)
+    # row-parallel splits input dim
+    assert m["layer.0.mlp.dense_4h_to_h.weight"].shape == (H, 2 * H)
+    # col-parallel splits output dim
+    assert m["layer.0.mlp.dense_h_to_4h.weight"].shape == (2 * H, H)
+    assert m["layer.0.mlp.dense_h_to_4h.bias"].shape == (2 * H,)
+    # norms replicate
+    np.testing.assert_array_equal(m["layer.0.input_layernorm.weight"],
+                                  sd["module"]["layer.0.input_layernorm.weight"])
+
+
+def test_degree_change_4_to_2():
+    """4-way checkpoint served at TP=2: each target rank merges 2 shards and
+    equals the direct 2-way split of the full weights."""
+    sd = _full_sd(seed=3)
+    shards4 = _split_all(sd, 4)
+    direct2 = _split_all(sd, 2)
+    loader = SDLoaderFactory.get_sd_loader(shards4, version=2.0)
+    for rank in range(2):
+        got, _ = loader.load(2, rank)
+        for k, v in direct2[rank]["module"].items():
+            np.testing.assert_allclose(got["module"][k], v, err_msg=k)
+
+
+def test_same_degree_passthrough_and_v0_qkv():
+    sd = _full_sd(seed=4, ckpt_ver=0)
+    shards = _split_all(sd, 2)
+    loader = SDLoaderFactory.get_sd_loader(shards, version=0)
+    got, n = loader.load(2, 1)
+    assert n == 1
+    for k, v in shards[1]["module"].items():
+        np.testing.assert_allclose(got["module"][k], v, err_msg=k)
