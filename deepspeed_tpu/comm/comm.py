@@ -225,6 +225,21 @@ def axis_size(axis: str) -> int:
 _initialized = False
 
 
+def resolve_process_id() -> int:
+    """Rank resolution for the multi-host bootstrap: launcher env first;
+    then the transport's own rank var — the MPI-family runners export its
+    NAME via ``DSTPU_RANK_ENV`` (OMPI_COMM_WORLD_RANK / PMI_RANK /
+    MV2_COMM_WORLD_RANK) since one mpirun command line cannot carry per-rank
+    ids — and SLURM rank as the final fallback (same single-command
+    limitation)."""
+    pid = os.environ.get("DSTPU_PROCESS_ID")
+    if pid is None and (rank_env := os.environ.get("DSTPU_RANK_ENV")):
+        pid = os.environ.get(rank_env)
+    if pid is None:
+        pid = os.environ.get("SLURM_PROCID", 0)
+    return int(pid)
+
+
 def init_distributed(dist_backend: str = "xla",
                      coordinator_address: Optional[str] = None,
                      num_processes: Optional[int] = None,
@@ -249,11 +264,7 @@ def init_distributed(dist_backend: str = "xla",
         return
     try:
         if process_id is None:
-            # launcher env first; SLURM rank as fallback (SlurmRunner cannot
-            # export a per-rank id through one srun command line)
-            pid = os.environ.get("DSTPU_PROCESS_ID",
-                                 os.environ.get("SLURM_PROCID", 0))
-            process_id = int(pid)
+            process_id = resolve_process_id()
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes or int(env_procs or 1),

@@ -319,9 +319,22 @@ def init_inference(model=None, config=None, *, family: Optional[ModelFamily] = N
     """
     if isinstance(config, dict) or config is None:
         config = InferenceConfig.from_dict({**(config or {}), **kwargs})
+    if family is None and model is not None and model_cfg is None \
+            and params is None:
+        # reference UX: init_inference(<HF transformers model>) — the
+        # kernel-injection entry (``module_inject/replace_module.py:189``):
+        # import weights once, route to the family's fused TPU implementation
+        from ..models.hf_import import from_hf, is_hf_model, resolve_module
+
+        if is_hf_model(model):
+            fam_name = model.config.model_type
+            module = resolve_module(fam_name)
+            model_cfg, params = from_hf(model, fam_name)
+            model = module
     if family is None:
         if model is None or model_cfg is None:
-            raise ValueError("pass family= or (model module, model_cfg=)")
+            raise ValueError("pass family= or (model module, model_cfg=) "
+                             "or a transformers model")
         family = ModelFamily.from_module(model, model_cfg)
     if params is None:
         raise ValueError("params pytree is required")

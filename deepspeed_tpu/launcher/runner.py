@@ -184,7 +184,85 @@ class SlurmRunner(MultiNodeRunner):
         return [cmd + self.user_cmd()]
 
 
-RUNNERS = {"local": LocalRunner, "pdsh": PDSHRunner, "slurm": SlurmRunner}
+class OpenMPIRunner(MultiNodeRunner):
+    """mpirun launch, one rank per host (reference OpenMPIRunner :126).
+    Process id comes from OMPI's rank env var at bootstrap time, so the
+    exported env omits DSTPU_PROCESS_ID (comm.init_distributed reads
+    OMPI_COMM_WORLD_RANK as a fallback)."""
+
+    name = "openmpi"
+    launcher = "mpirun"
+    rank_env = "OMPI_COMM_WORLD_RANK"
+
+    def backend_exists(self) -> bool:
+        from shutil import which
+
+        return which(self.launcher) is not None
+
+    def _env_flags(self) -> List[str]:
+        flags: List[str] = []
+        for k, v in self.node_env(0).items():
+            if k == "DSTPU_PROCESS_ID":
+                continue
+            flags += ["-x", f"{k}={v}"]
+        flags += ["-x", f"DSTPU_RANK_ENV={self.rank_env}"]
+        return flags
+
+    def get_cmd(self) -> List[List[str]]:
+        n = len(self.hosts)
+        cmd = [self.launcher, "-np", str(n),
+               "--host", ",".join(self.hosts), "--map-by", "ppr:1:node"]
+        return [cmd + self._env_flags() + self.user_cmd()]
+
+
+class MPICHRunner(OpenMPIRunner):
+    """mpiexec (MPICH/hydra) launch (reference MPICHRunner :188)."""
+
+    name = "mpich"
+    launcher = "mpiexec"
+    rank_env = "PMI_RANK"
+
+    def _env_flags(self) -> List[str]:
+        flags: List[str] = []
+        for k, v in self.node_env(0).items():
+            if k == "DSTPU_PROCESS_ID":
+                continue
+            flags += ["-genv", k, v]
+        flags += ["-genv", "DSTPU_RANK_ENV", self.rank_env]
+        return flags
+
+    def get_cmd(self) -> List[List[str]]:
+        n = len(self.hosts)
+        cmd = [self.launcher, "-np", str(n), "-hosts", ",".join(self.hosts),
+               "-ppn", "1"]
+        return [cmd + self._env_flags() + self.user_cmd()]
+
+
+class IMPIRunner(MPICHRunner):
+    """Intel MPI: hydra flags, PMI rank (reference IMPIRunner :260)."""
+
+    name = "impi"
+
+
+class MVAPICHRunner(MPICHRunner):
+    """MVAPICH: mpirun_rsh transport, MV2 rank var (reference :393)."""
+
+    name = "mvapich"
+    launcher = "mpirun_rsh"
+    rank_env = "MV2_COMM_WORLD_RANK"
+
+    def get_cmd(self) -> List[List[str]]:
+        n = len(self.hosts)
+        cmd = [self.launcher, "-np", str(n)] + list(self.hosts)
+        env = [f"{k}={v}" for k, v in self.node_env(0).items()
+               if k != "DSTPU_PROCESS_ID"]
+        env.append(f"DSTPU_RANK_ENV={self.rank_env}")
+        return [cmd + env + self.user_cmd()]
+
+
+RUNNERS = {"local": LocalRunner, "pdsh": PDSHRunner, "slurm": SlurmRunner,
+           "openmpi": OpenMPIRunner, "mpich": MPICHRunner,
+           "impi": IMPIRunner, "mvapich": MVAPICHRunner}
 
 
 # --------------------------------------------------------------------------- #

@@ -767,6 +767,50 @@ _FAMILIES = {
 }
 
 
+def resolve_module(family: str):
+    """Family name → the ``deepspeed_tpu.models`` module that executes it."""
+    from . import bloom, falcon, gpt, gptneox, llama, mixtral
+
+    modules = {
+        "llama": llama, "mistral": llama, "qwen2": llama, "phi3": llama,
+        "gpt2": gpt, "opt": gpt,
+        "mixtral": mixtral, "qwen2_moe": mixtral,
+        "falcon": falcon,
+        "gpt_neox": gptneox, "gptj": gptneox,
+        "bloom": bloom,
+    }
+    if family not in modules:
+        raise ValueError(f"unsupported HF family '{family}' "
+                         f"(supported: {sorted(modules)})")
+    return modules[family]
+
+
+def is_hf_model(model) -> bool:
+    """True for a live transformers/torch model (as opposed to a ModelSpec
+    or one of our model modules)."""
+    return (hasattr(model, "state_dict") and callable(model.state_dict)
+            and hasattr(model, "config")
+            and hasattr(model.config, "model_type"))
+
+
+def spec_from_hf(model, family: Optional[str] = None,
+                 compute_dtype=None):
+    """Live transformers model → a ``ModelSpec`` carrying the imported
+    weights — makes ``deepspeed_tpu.initialize(model=hf_model, ...)`` work
+    exactly like the reference's ``deepspeed.initialize(model=hf_model)``
+    (engine selection ``deepspeed/__init__.py:198-241``)."""
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    family = family or getattr(model.config, "model_type", None)
+    module = resolve_module(family)
+    cfg, params = from_hf(model, family)
+    spec = module.model_spec(
+        cfg, compute_dtype=compute_dtype or jnp.bfloat16)
+    return dataclasses.replace(spec, params=params)
+
+
 def from_hf(model, family: Optional[str] = None):
     """One-stop conversion: (our_config, our_params) from a transformers
     model instance. Family is sniffed from ``model.config.model_type``."""

@@ -819,6 +819,19 @@ def initialize(args=None, model: Optional[ModelSpec] = None, optimizer=None,
         config = getattr(args, "deepspeed_config", None)
     if model is None:
         raise ValueError("model (ModelSpec) is required")
+    hf_model = None
+    if not isinstance(model, ModelSpec):
+        # reference UX: deepspeed.initialize(model=<HF transformers model>)
+        # — import the weights and route to the family's ModelSpec
+        # (conversion is deferred until the config is parsed so the family
+        # closures compute in the configured precision, not a default)
+        from ..models.hf_import import is_hf_model
+
+        if is_hf_model(model):
+            hf_model = model
+        else:
+            raise TypeError(f"model must be a ModelSpec or a transformers "
+                            f"model, got {type(model)}")
 
     if dist_init_required:
         dist.init_distributed()
@@ -826,6 +839,12 @@ def initialize(args=None, model: Optional[ModelSpec] = None, optimizer=None,
     n_devices = len(jax.devices())
     # resolve mesh first so batch math can use the true dp size
     pre = parse_config(config, world_size=n_devices, resolve_batch=False)
+    if hf_model is not None:
+        from ..models.hf_import import spec_from_hf
+
+        compute_dtype = (jnp.bfloat16 if pre.bf16.enabled else
+                         jnp.float16 if pre.fp16.enabled else jnp.float32)
+        model = spec_from_hf(hf_model, compute_dtype=compute_dtype)
     axis_sizes = pre.mesh.axis_sizes(n_devices) if pre.raw.get("mesh") else None
     if axis_sizes is None:
         sizes = {"tensor": pre.tensor_parallel.autotp_size or 1,

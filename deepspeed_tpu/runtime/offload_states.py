@@ -16,6 +16,7 @@ back. ``pin_memory=False`` selects ``unpinned_host``.
 from __future__ import annotations
 
 import enum
+import os
 from typing import Any, Iterable, Optional, Set
 
 import jax
@@ -76,11 +77,11 @@ def offload_engine_states(engine, include: Optional[Iterable] = None,
     """
     if device == OffloadDeviceEnum.none:
         return
-    if device == OffloadDeviceEnum.nvme:
-        raise NotImplementedError(
-            "nvme offload of live engine states goes through the swap_tensor "
-            "tier (deepspeed_tpu.runtime.swap_tensor), not offload_states")
-    kind = "pinned_host" if pin_memory else "unpinned_host"
+    if getattr(engine, "_nvme_swappers", None):
+        # nvme offload is NOT idempotent (a second pass would try to swap the
+        # meta trees themselves and leak the first swapper's files)
+        log_dist("offload_states: states already nvme-offloaded; skipping")
+        return
     if include is None:
         include = {OffloadStateTypeEnum.optim_states,
                    OffloadStateTypeEnum.hp_params}
@@ -88,6 +89,38 @@ def offload_engine_states(engine, include: Optional[Iterable] = None,
         include = {OffloadStateTypeEnum(s) for s in include}
     st = engine.state
 
+    if device == OffloadDeviceEnum.nvme:
+        # disk tier: spill through the swap_tensor stack (ZeRO-Infinity
+        # analog — reference routes offload_states device='nvme' to the
+        # partitioned swappers). The live leaves are replaced by their
+        # SwappedTensorMeta trees; reload streams them back and re-shards.
+        import tempfile
+
+        from .swap_tensor.swapper import PartitionedOptimizerSwapper
+
+        zc = getattr(engine, "config", None)
+        swap_dir = None
+        if zc is not None:
+            oo = getattr(zc.zero_config, "offload_optimizer", None)
+            swap_dir = getattr(oo, "nvme_path", None)
+        swap_dir = swap_dir or os.path.join(tempfile.gettempdir(),
+                                            "dstpu_offload_states")
+        engine._nvme_swappers = {}
+        if OffloadStateTypeEnum.optim_states in include:
+            sw = PartitionedOptimizerSwapper(os.path.join(swap_dir, "opt"))
+            st = st._replace(opt_state=sw.swap_out_optimizer(st.opt_state))
+            engine._nvme_swappers["optim_states"] = sw
+        if OffloadStateTypeEnum.hp_params in include:
+            sw = PartitionedOptimizerSwapper(os.path.join(swap_dir, "params"))
+            st = st._replace(params=sw.swap_out_optimizer(st.params))
+            engine._nvme_swappers["hp_params"] = sw
+        engine.state = st
+        engine._states_offloaded = True
+        log_dist(f"offloaded {sorted(s.value for s in include)} -> nvme "
+                 f"({swap_dir})")
+        return
+
+    kind = "pinned_host" if pin_memory else "unpinned_host"
     if OffloadStateTypeEnum.optim_states in include:
         st = st._replace(opt_state=_move_tree(st.opt_state, kind))
     if OffloadStateTypeEnum.hp_params in include:
@@ -100,9 +133,44 @@ def offload_engine_states(engine, include: Optional[Iterable] = None,
     log_dist(f"offloaded {sorted(s.value for s in include)} -> {kind}")
 
 
+def _nvme_reload(engine, st):
+    """Stream swapped trees back from disk and restore device shardings."""
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    swappers = engine._nvme_swappers
+
+    def shardings_for(specs):
+        return jax.tree.map(
+            lambda s: NamedSharding(engine.mesh_mgr.mesh, s), specs,
+            is_leaf=lambda x: isinstance(x, P))
+
+    if "optim_states" in swappers:
+        sw = swappers.pop("optim_states")
+        host = sw.swap_in_optimizer(device_put=False)
+        sh = shardings_for(engine.opt_state_specs)
+        st = st._replace(opt_state=jax.tree.map(jax.device_put, host, sh))
+        sw.purge()
+    if "hp_params" in swappers:
+        sw = swappers.pop("hp_params")
+        host = sw.swap_in_optimizer(device_put=False)
+        st = st._replace(params=jax.tree.map(
+            jax.device_put, host, engine._master_shardings))
+        sw.purge()
+    return st
+
+
 def reload_engine_states(engine, non_blocking: bool = False) -> None:
     """Reference ``reload_states``: bring everything back to device memory."""
     st = engine.state
+    if getattr(engine, "_nvme_swappers", None):
+        st = _nvme_reload(engine, st)
+        engine.state = st._replace(
+            params=_move_tree(st.params, "device"),
+            opt_state=_move_tree(st.opt_state, "device"))
+        engine._states_offloaded = False
+        log_dist("reloaded nvme-offloaded states -> device")
+        return
     engine.state = st._replace(
         params=_move_tree(st.params, "device"),
         opt_state=_move_tree(st.opt_state, "device"))

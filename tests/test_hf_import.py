@@ -84,7 +84,7 @@ def test_state_dict_mapping_inputs(hf_llama):
 
 def test_unsupported_family_raises(hf_gpt2):
     with pytest.raises(ValueError):
-        from_hf(hf_gpt2, family="bloom")
+        from_hf(hf_gpt2, family="rwkv")
 
 
 @pytest.fixture(scope="module")
@@ -443,3 +443,47 @@ def test_gptj_cached_matches_full():
         compute_dtype=jnp.float32)
     got = np.concatenate([np.asarray(logits1), np.asarray(logits2)], axis=1)
     np.testing.assert_allclose(got, np.asarray(full), rtol=2e-4, atol=2e-4)
+
+
+def test_initialize_accepts_hf_model(hf_llama, devices8):
+    """Reference UX parity: deepspeed.initialize(model=<transformers model>)
+    — weights import automatically and the engine trains on them."""
+    import deepspeed_tpu as dst
+    from deepspeed_tpu.comm import mesh as mesh_lib
+
+    mesh_lib.set_mesh(None)
+    engine, _, _, _ = dst.initialize(
+        model=hf_llama,
+        config={"train_batch_size": 8, "bf16": {"enabled": False},
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 2}})
+    rng = np.random.RandomState(20)
+    losses = [float(engine.train_batch(
+        {"tokens": rng.randint(0, 128, (8, 17)).astype(np.int32)}).loss)
+        for _ in range(5)]
+    assert losses[-1] < losses[0]
+
+
+def test_initialize_rejects_non_model():
+    import deepspeed_tpu as dst
+
+    with pytest.raises(TypeError, match="ModelSpec or a transformers"):
+        dst.initialize(model=object(), config={"train_batch_size": 1})
+
+
+def test_init_inference_accepts_hf_model(hf_gpt2):
+    """Reference UX parity: init_inference(<transformers model>) — the
+    kernel-injection entry routes to the family's fused implementation."""
+    import deepspeed_tpu as dst
+    from deepspeed_tpu.comm import mesh as mesh_lib
+
+    mesh_lib.set_mesh(None)
+    eng = dst.init_inference(hf_gpt2, config={"dtype": "float32"})
+    tokens = np.random.RandomState(21).randint(0, 128, (2, 8))
+    out = eng.generate(tokens, max_new_tokens=4, temperature=0.0)
+    assert out.shape == (2, 4)
+    with torch.no_grad():
+        ref = hf_gpt2.generate(
+            torch.tensor(tokens), max_new_tokens=4, do_sample=False,
+            pad_token_id=0).numpy()
+    np.testing.assert_array_equal(out, ref[:, 8:])

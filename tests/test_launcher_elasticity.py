@@ -129,3 +129,55 @@ def test_ds_report_cli_runs():
                               "PYTHONPATH": "/root/repo"})
     assert out.returncode == 0, out.stderr
     assert "deepspeed_tpu environment report" in out.stdout
+
+
+def test_mpi_family_runner_cmds(tmp_path):
+    """MPI-family runners (reference OpenMPI/MPICH/IMPI/MVAPICH
+    MultiNodeRunner): one launch command, rank sourced from the transport's
+    own env var (exported by name via DSTPU_RANK_ENV)."""
+    from deepspeed_tpu.launcher.runner import (IMPIRunner, MPICHRunner,
+                                               MVAPICHRunner, OpenMPIRunner)
+
+    hf = tmp_path / "hostfile"
+    hf.write_text("w0 slots=4\nw1 slots=4\n")
+    hosts = parse_hostfile(hf.read_text())
+
+    args = parse_args(["-H", str(hf), "--launcher", "openmpi", "train.py"])
+    (cmd,) = OpenMPIRunner(args, hosts).get_cmd()
+    assert cmd[:3] == ["mpirun", "-np", "2"]
+    assert "DSTPU_RANK_ENV=OMPI_COMM_WORLD_RANK" in cmd
+    assert not any("DSTPU_PROCESS_ID" in c for c in cmd)
+    assert cmd[-1] == "train.py"
+
+    (cmd,) = MPICHRunner(args, hosts).get_cmd()
+    assert cmd[:3] == ["mpiexec", "-np", "2"]
+    i = cmd.index("DSTPU_RANK_ENV")
+    assert cmd[i - 1] == "-genv" and cmd[i + 1] == "PMI_RANK"
+
+    (cmd,) = IMPIRunner(args, hosts).get_cmd()
+    assert cmd[0] == "mpiexec"  # hydra flags shared with MPICH
+
+    (cmd,) = MVAPICHRunner(args, hosts).get_cmd()
+    assert cmd[:3] == ["mpirun_rsh", "-np", "2"]
+    assert cmd[3:5] == ["w0", "w1"]
+    assert "DSTPU_RANK_ENV=MV2_COMM_WORLD_RANK" in cmd
+
+
+def test_rank_env_fallback(monkeypatch):
+    """comm.resolve_process_id (used by init_distributed) reads the transport
+    rank var named by DSTPU_RANK_ENV when DSTPU_PROCESS_ID is absent, with
+    SLURM_PROCID as final fallback."""
+    from deepspeed_tpu.comm.comm import resolve_process_id
+
+    monkeypatch.delenv("DSTPU_PROCESS_ID", raising=False)
+    monkeypatch.delenv("SLURM_PROCID", raising=False)
+    monkeypatch.setenv("DSTPU_RANK_ENV", "OMPI_COMM_WORLD_RANK")
+    monkeypatch.setenv("OMPI_COMM_WORLD_RANK", "3")
+    assert resolve_process_id() == 3
+    monkeypatch.setenv("DSTPU_PROCESS_ID", "1")  # launcher env wins
+    assert resolve_process_id() == 1
+    monkeypatch.delenv("DSTPU_PROCESS_ID")
+    monkeypatch.delenv("OMPI_COMM_WORLD_RANK")
+    monkeypatch.delenv("DSTPU_RANK_ENV")
+    monkeypatch.setenv("SLURM_PROCID", "2")
+    assert resolve_process_id() == 2
