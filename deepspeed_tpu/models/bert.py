@@ -35,6 +35,7 @@ class BertConfig:
     max_seq_len: int = 512
     type_vocab_size: int = 2
     layer_norm_eps: float = 1e-12
+    gelu_approx: bool = False  # HF 'gelu' is the exact erf form
     remat: bool = False
 
     @property
@@ -119,7 +120,8 @@ def _block(cfg: BertConfig, x: jnp.ndarray, layer: Params,
     a = attention(q, k, v, causal=False, mask=mask)
     a = a.reshape(b, s, nh * hd) @ layer["wo"] + layer["bo"]
     x = layer_norm(x + a, layer["attn_ln_scale"], layer["attn_ln_bias"], eps)
-    m = jax.nn.gelu(x @ layer["w_up"] + layer["b_up"]) @ layer["w_down"] \
+    m = jax.nn.gelu(x @ layer["w_up"] + layer["b_up"],
+                    approximate=cfg.gelu_approx) @ layer["w_down"] \
         + layer["b_down"]
     return layer_norm(x + m, layer["mlp_ln_scale"], layer["mlp_ln_bias"], eps)
 

@@ -12,7 +12,9 @@ Supported families: Llama/Mistral/Qwen2/Phi-3 (→ ``models/llama``; fused
 QKV/gate-up checkpoints are split), GPT-2 (→ ``models/gpt``),
 Mixtral/Qwen2-MoE (→ ``models/mixtral``), Falcon (→ ``models/falcon``), OPT (→ ``models/gpt``,
 ReLU/pre-LN), GPT-NeoX/GPT-J (→ ``models/gptneox``), BLOOM (→ ``models/bloom``,
-ALiBi). Accepts a live
+ALiBi), BERT/DistilBERT (→ ``models/bert``), Megatron-GPT state dicts
+(``megatron_gpt_params_from_sd``, composing with the TP-degree-changing
+``SDLoaderFactory``). Accepts a live
 ``transformers`` model, a state-dict mapping, or a local checkpoint directory
 (no network access is assumed). Un-annotated models TP-shard via the AutoTP
 name-rule pass (``module_inject/auto_tp.py``).
@@ -751,25 +753,236 @@ def bloom_params_from_hf(src, cfg=None) -> Params:
     return params
 
 
-_FAMILIES = {
-    "llama": (llama_config_from_hf, llama_params_from_hf),
-    "mistral": (llama_config_from_hf, llama_params_from_hf),
-    "qwen2": (llama_config_from_hf, llama_params_from_hf),
-    "phi3": (llama_config_from_hf, phi3_params_from_hf),
-    "gpt2": (gpt2_config_from_hf, gpt2_params_from_hf),
-    "opt": (opt_config_from_hf, opt_params_from_hf),
-    "mixtral": (mixtral_config_from_hf, mixtral_params_from_hf),
-    "qwen2_moe": (qwen2_moe_config_from_hf, qwen2_moe_params_from_hf),
-    "falcon": (falcon_config_from_hf, falcon_params_from_hf),
-    "gpt_neox": (gptneox_config_from_hf, gptneox_params_from_hf),
-    "gptj": (gptj_config_from_hf, gptj_params_from_hf),
-    "bloom": (bloom_config_from_hf, bloom_params_from_hf),
-}
+
+
+def bert_config_from_hf(hf_config) -> "Any":
+    from .bert import BertConfig
+
+    return BertConfig(
+        vocab_size=hf_config.vocab_size,
+        hidden_size=hf_config.hidden_size,
+        intermediate_size=hf_config.intermediate_size,
+        num_layers=hf_config.num_hidden_layers,
+        num_heads=hf_config.num_attention_heads,
+        max_seq_len=hf_config.max_position_embeddings,
+        type_vocab_size=getattr(hf_config, "type_vocab_size", 2),
+        layer_norm_eps=float(getattr(hf_config, "layer_norm_eps", 1e-12)),
+        gelu_approx=getattr(hf_config, "hidden_act", "gelu") in
+        ("gelu_new", "gelu_pytorch_tanh", "gelu_fast"),
+    )
+
+
+def bert_params_from_hf(src, cfg=None) -> Params:
+    """HF BertModel / BertFor* → ``models/bert`` pytree (q/k/v fused into
+    one [h, 3h] block column-wise; the MLM head stays the tied embedding)."""
+    sd = _normalize_state_dict(src)
+    pfx = "bert." if any(k.startswith("bert.") for k in sd) else ""
+    L = cfg.num_layers
+    lay = pfx + "encoder.layer.{i}."
+
+    def qkv_w(i):
+        return np.concatenate(
+            [sd[lay.format(i=i) + f"attention.self.{n}.weight"].T
+             for n in ("query", "key", "value")], axis=1)
+
+    def qkv_b(i):
+        return np.concatenate(
+            [sd[lay.format(i=i) + f"attention.self.{n}.bias"]
+             for n in ("query", "key", "value")])
+
+    emb = pfx + "embeddings."
+    params: Params = {
+        "embed": sd[emb + "word_embeddings.weight"],
+        "pos_embed": sd[emb + "position_embeddings.weight"],
+        "type_embed": sd[emb + "token_type_embeddings.weight"],
+        "embed_ln_scale": sd[emb + "LayerNorm.weight"],
+        "embed_ln_bias": sd[emb + "LayerNorm.bias"],
+        "layers": {
+            "wqkv": np.stack([qkv_w(i) for i in range(L)]),
+            "bqkv": np.stack([qkv_b(i) for i in range(L)]),
+            "wo": _stack(sd, lay + "attention.output.dense.weight", L,
+                         transpose=True),
+            "bo": _stack(sd, lay + "attention.output.dense.bias", L),
+            "attn_ln_scale": _stack(sd, lay + "attention.output.LayerNorm.weight", L),
+            "attn_ln_bias": _stack(sd, lay + "attention.output.LayerNorm.bias", L),
+            "w_up": _stack(sd, lay + "intermediate.dense.weight", L,
+                           transpose=True),
+            "b_up": _stack(sd, lay + "intermediate.dense.bias", L),
+            "w_down": _stack(sd, lay + "output.dense.weight", L,
+                             transpose=True),
+            "b_down": _stack(sd, lay + "output.dense.bias", L),
+            "mlp_ln_scale": _stack(sd, lay + "output.LayerNorm.weight", L),
+            "mlp_ln_bias": _stack(sd, lay + "output.LayerNorm.bias", L),
+        },
+    }
+    h = cfg.hidden_size
+    if pfx + "pooler.dense.weight" in sd:
+        params["pooler_w"] = sd[pfx + "pooler.dense.weight"].T
+        params["pooler_b"] = sd[pfx + "pooler.dense.bias"]
+    else:
+        params["pooler_w"] = np.zeros((h, h), np.float32)
+        params["pooler_b"] = np.zeros((h,), np.float32)
+    log_dist(f"imported HF bert weights: {L} layers")
+    return params
+
+
+def distilbert_config_from_hf(hf_config) -> "Any":
+    from .bert import BertConfig
+
+    return BertConfig(
+        vocab_size=hf_config.vocab_size,
+        hidden_size=hf_config.dim,
+        intermediate_size=hf_config.hidden_dim,
+        num_layers=hf_config.n_layers,
+        num_heads=hf_config.n_heads,
+        max_seq_len=hf_config.max_position_embeddings,
+        type_vocab_size=1,   # DistilBERT drops token-type embeddings
+        layer_norm_eps=1e-12,
+        gelu_approx=getattr(hf_config, "activation", "gelu") in
+        ("gelu_new", "gelu_pytorch_tanh", "gelu_fast"),
+    )
+
+
+def distilbert_params_from_hf(src, cfg=None) -> Params:
+    """HF DistilBertModel / DistilBertFor* → ``models/bert`` pytree
+    (reference policy ``module_inject/containers/distil_bert.py``). The
+    missing token-type table becomes a zero row; the missing pooler becomes
+    zeros (pooled output is then a constant — DistilBERT has none)."""
+    sd = _normalize_state_dict(src)
+    pfx = "distilbert." if any(k.startswith("distilbert.") for k in sd) else ""
+    L, h = cfg.num_layers, cfg.hidden_size
+    lay = pfx + "transformer.layer.{i}."
+
+    def qkv_w(i):
+        return np.concatenate(
+            [sd[lay.format(i=i) + f"attention.{n}.weight"].T
+             for n in ("q_lin", "k_lin", "v_lin")], axis=1)
+
+    def qkv_b(i):
+        return np.concatenate(
+            [sd[lay.format(i=i) + f"attention.{n}.bias"]
+             for n in ("q_lin", "k_lin", "v_lin")])
+
+    emb = pfx + "embeddings."
+    params: Params = {
+        "embed": sd[emb + "word_embeddings.weight"],
+        "pos_embed": sd[emb + "position_embeddings.weight"],
+        "type_embed": np.zeros((1, h), np.float32),
+        "embed_ln_scale": sd[emb + "LayerNorm.weight"],
+        "embed_ln_bias": sd[emb + "LayerNorm.bias"],
+        "layers": {
+            "wqkv": np.stack([qkv_w(i) for i in range(L)]),
+            "bqkv": np.stack([qkv_b(i) for i in range(L)]),
+            "wo": _stack(sd, lay + "attention.out_lin.weight", L,
+                         transpose=True),
+            "bo": _stack(sd, lay + "attention.out_lin.bias", L),
+            "attn_ln_scale": _stack(sd, lay + "sa_layer_norm.weight", L),
+            "attn_ln_bias": _stack(sd, lay + "sa_layer_norm.bias", L),
+            "w_up": _stack(sd, lay + "ffn.lin1.weight", L, transpose=True),
+            "b_up": _stack(sd, lay + "ffn.lin1.bias", L),
+            "w_down": _stack(sd, lay + "ffn.lin2.weight", L, transpose=True),
+            "b_down": _stack(sd, lay + "ffn.lin2.bias", L),
+            "mlp_ln_scale": _stack(sd, lay + "output_layer_norm.weight", L),
+            "mlp_ln_bias": _stack(sd, lay + "output_layer_norm.bias", L),
+        },
+        "pooler_w": np.zeros((h, h), np.float32),
+        "pooler_b": np.zeros((h,), np.float32),
+    }
+    log_dist(f"imported HF distilbert weights: {L} layers")
+    return params
+
+
+def megatron_gpt_params_from_sd(sd, cfg=None, ckpt_ver=None) -> Params:
+    """Megatron-GPT state dict (merged to TP=1 via ``SDLoaderFactory``) →
+    ``models/gpt`` pytree (reference policy
+    ``module_inject/containers/megatron_gpt.py`` + ``MegatronSDLoader``).
+
+    The fused query_key_value layouts by checkpoint version (reference
+    ``state_dict_factory.py:220``): v0 = whole-tensor [q;k;v] blocks (the
+    GPT-2 layout our model uses directly); v2 = per-head [q;k;v] groups,
+    de-interleaved here. v1.0's (np·hn·3) ordering is rejected."""
+    if ckpt_ver is None:
+        # read the version BEFORE unwrapping 'module' (it lives at the top
+        # level of Megatron checkpoints); default 0 matches
+        # SDLoaderBase.get_checkpoint_version — defaulting to 2 would
+        # silently scramble v0 whole-block QKV tensors as per-head groups
+        ckpt_ver = sd.get("checkpoint_version",
+                          sd.get("module", {}).get("checkpoint_version", 0))
+    sd = {k: _to_numpy(v) for k, v in (sd.get("module", sd)).items()
+          if k != "checkpoint_version"}
+    # strip megatron prefixes down to the transformer block names
+    def find(suffix):
+        hits = [k for k in sd if k.endswith(suffix)]
+        if len(hits) != 1:
+            raise KeyError(f"expected exactly one key ending {suffix!r}, "
+                           f"got {hits}")
+        return sd[hits[0]]
+
+    L = _count_indices(sd, r".*?layers\.(\d+)\.")
+    nh, hd = (cfg.num_heads, cfg.head_size) if cfg is not None else (None, None)
+
+    def layer(i, suffix):
+        return find(f"layers.{i}.{suffix}")
+
+    def qkv_to_gpt2(w):
+        """[3h(, h)] megatron fused → [q|k|v] blocks (transposed for weights)."""
+        if ckpt_ver in (0, 0.0):
+            out = w  # already [q;k;v] whole blocks
+        elif ckpt_ver in (2, 2.0):
+            assert nh is not None, "cfg (num_heads) required for v2 layout"
+            grouped = w.reshape((nh, 3, hd) + w.shape[1:])
+            out = np.concatenate(
+                [grouped[:, j].reshape((nh * hd,) + w.shape[1:])
+                 for j in range(3)], axis=0)
+        else:
+            raise ValueError(f"unsupported megatron checkpoint_version "
+                             f"{ckpt_ver} (v0 and v2 layouts supported)")
+        return out.T if out.ndim == 2 else out
+
+    params: Params = {
+        "embed": find("word_embeddings.weight"),
+        "pos_embed": find("position_embeddings.weight"),
+        "layers": {
+            "ln1_scale": np.stack([layer(i, "input_layernorm.weight")
+                                   for i in range(L)]),
+            "ln1_bias": np.stack([layer(i, "input_layernorm.bias")
+                                  for i in range(L)]),
+            "wqkv": np.stack([qkv_to_gpt2(
+                layer(i, "attention.query_key_value.weight"))
+                for i in range(L)]),
+            "bqkv": np.stack([qkv_to_gpt2(
+                layer(i, "attention.query_key_value.bias"))
+                for i in range(L)]),
+            "wo": np.stack([layer(i, "attention.dense.weight").T
+                            for i in range(L)]),
+            "bo": np.stack([layer(i, "attention.dense.bias")
+                            for i in range(L)]),
+            "ln2_scale": np.stack([layer(i, "post_attention_layernorm.weight")
+                                   for i in range(L)]),
+            "ln2_bias": np.stack([layer(i, "post_attention_layernorm.bias")
+                                  for i in range(L)]),
+            "w_up": np.stack([layer(i, "mlp.dense_h_to_4h.weight").T
+                              for i in range(L)]),
+            "b_up": np.stack([layer(i, "mlp.dense_h_to_4h.bias")
+                              for i in range(L)]),
+            "w_down": np.stack([layer(i, "mlp.dense_4h_to_h.weight").T
+                                for i in range(L)]),
+            "b_down": np.stack([layer(i, "mlp.dense_4h_to_h.bias")
+                                for i in range(L)]),
+        },
+        "final_ln_scale": find("final_layernorm.weight"),
+        "final_ln_bias": find("final_layernorm.bias"),
+    }
+    log_dist(f"imported megatron-gpt weights: {L} layers "
+             f"(ckpt_ver={ckpt_ver})")
+    return params
 
 
 def resolve_module(family: str):
     """Family name → the ``deepspeed_tpu.models`` module that executes it."""
     from . import bloom, falcon, gpt, gptneox, llama, mixtral
+
+    from . import bert as bert_mod
 
     modules = {
         "llama": llama, "mistral": llama, "qwen2": llama, "phi3": llama,
@@ -778,6 +991,7 @@ def resolve_module(family: str):
         "falcon": falcon,
         "gpt_neox": gptneox, "gptj": gptneox,
         "bloom": bloom,
+        "bert": bert_mod, "distilbert": bert_mod,
     }
     if family not in modules:
         raise ValueError(f"unsupported HF family '{family}' "
@@ -809,6 +1023,24 @@ def spec_from_hf(model, family: Optional[str] = None,
     spec = module.model_spec(
         cfg, compute_dtype=compute_dtype or jnp.bfloat16)
     return dataclasses.replace(spec, params=params)
+
+
+_FAMILIES = {
+    "llama": (llama_config_from_hf, llama_params_from_hf),
+    "mistral": (llama_config_from_hf, llama_params_from_hf),
+    "qwen2": (llama_config_from_hf, llama_params_from_hf),
+    "phi3": (llama_config_from_hf, phi3_params_from_hf),
+    "gpt2": (gpt2_config_from_hf, gpt2_params_from_hf),
+    "opt": (opt_config_from_hf, opt_params_from_hf),
+    "mixtral": (mixtral_config_from_hf, mixtral_params_from_hf),
+    "qwen2_moe": (qwen2_moe_config_from_hf, qwen2_moe_params_from_hf),
+    "falcon": (falcon_config_from_hf, falcon_params_from_hf),
+    "gpt_neox": (gptneox_config_from_hf, gptneox_params_from_hf),
+    "gptj": (gptj_config_from_hf, gptj_params_from_hf),
+    "bloom": (bloom_config_from_hf, bloom_params_from_hf),
+    "bert": (bert_config_from_hf, bert_params_from_hf),
+    "distilbert": (distilbert_config_from_hf, distilbert_params_from_hf),
+}
 
 
 def from_hf(model, family: Optional[str] = None):
