@@ -183,6 +183,14 @@ class DeepSpeedTPUEngine:
         self.param_specs = param_specs
         self.grad_specs = grad_specs
         self.opt_param_specs = opt_specs
+        # qwZ gather target: the TP-only layout params take after the ZeRO
+        # all-gather (at stage 3 param_specs stay sharded — gather-on-use —
+        # so the int8 copy must be constrained to THIS layout to put the
+        # quantized bytes on the wire)
+        self._qw_gather_specs = self.partitioner.gathered_param_specs(
+            axes, shapes)
+        self._qw_gather_shardings = self.partitioner.shardings(
+            self._qw_gather_specs)
         self._param_shardings = self.partitioner.shardings(param_specs)
         self._grad_shardings = self.partitioner.shardings(grad_specs)
         self._master_shardings = self.partitioner.shardings(opt_specs)
@@ -352,15 +360,80 @@ class DeepSpeedTPUEngine:
     # ------------------------------------------------------------------ #
     # the compiled train step
     # ------------------------------------------------------------------ #
+    def _cast_gather(self, params):
+        """Compute-cast + gather-to-compute-layout.
+
+        ZeRO stages 1/2: masters are sharded over the ZeRO axes but compute
+        wants the TP-only layout — the constraint makes XLA all-gather the
+        low-precision copy (the reference's post-step allgather of updated
+        partitions, stage_1_and_2.py:2223, moved to gather-on-compute-cast).
+        At stage 3 the constraint keeps params sharded; XLA gathers at use.
+
+        ZeRO++ qwZ (``zero_quantized_weights``, reference
+        ``runtime/zero/config.py:309`` + ``csrc/quantization/
+        swizzled_quantize.cu``): the tensor that crosses the gather boundary
+        is int8 with per-row fp32 scales — matrix leaves are quantized in
+        the sharded layout, the sharding constraint moves the int8 copy
+        (halving all-gather bytes vs bf16), and dequantization happens in
+        the gathered layout where XLA fuses it into the consumer."""
+        compute = self.precision.cast_to_compute(params)
+        zc = self.config.zero_config
+        if not (zc.zero_quantized_weights and
+                self.mesh_mgr.zero_world_size > 1):
+            return jax.lax.with_sharding_constraint(
+                compute, self._param_shardings)
+
+        def one(leaf, sharding, spec, param_sharding, master_spec):
+            # quantize only where a gather boundary actually exists (the
+            # master/opt layout differs from the gathered layout) — at stage
+            # 0, or for leaves ZeRO left unsharded (indivisible dims), the
+            # int8 roundtrip would cost precision and save zero wire bytes
+            if not (isinstance(leaf, jnp.ndarray)
+                    and jnp.issubdtype(leaf.dtype, jnp.floating)
+                    and leaf.ndim >= 2
+                    and master_spec != spec):
+                return jax.lax.with_sharding_constraint(leaf, param_sharding)
+            sspec = list(spec)[:leaf.ndim]
+            sspec += [None] * (leaf.ndim - len(sspec))
+            if sspec:
+                sspec[-1] = None  # scales' trailing dim is size 1
+            scale_sharding = self.mesh_mgr.sharding(*sspec)
+
+            def impl(x):
+                amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1,
+                               keepdims=True)
+                scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+                q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale),
+                             -127, 127).astype(jnp.int8)
+                # the barrier pins the f32→s8 convert BEFORE the gather —
+                # without it XLA commutes the convert past the all-gather
+                # and the wire carries f32 again
+                q = jax.lax.optimization_barrier(q)
+                q = jax.lax.with_sharding_constraint(q, sharding)
+                scale = jax.lax.with_sharding_constraint(scale,
+                                                         scale_sharding)
+                return (q.astype(jnp.float32) * scale).astype(x.dtype)
+
+            # straight-through estimator: round() has zero derivative, so the
+            # cotangent passes through unchanged to the sharded master leaf
+            # (SPMD lowers the layout change; the reference's backward also
+            # treats the quantized gather as identity)
+            qw = jax.custom_vjp(impl)
+            qw.defvjp(lambda x: (impl(x), None),
+                      lambda _, g: (g.astype(leaf.dtype),))
+            return qw(leaf)
+
+        # tree.map follows `compute`'s structure, so the P leaves of
+        # param_specs are taken whole (not flattened as tuples). Matrix
+        # leaves with a real gather boundary land in the GATHERED (TP-only)
+        # layout via the int8 wire; everything else keeps the normal param
+        # layout (stage-3 gather-on-use included).
+        return jax.tree.map(one, compute, self._qw_gather_shardings,
+                            self._qw_gather_specs, self._param_shardings,
+                            self.opt_param_specs)
+
     def _loss(self, params, batch):
-        compute_params = self.precision.cast_to_compute(params)
-        # ZeRO stages 1/2: masters are sharded over the ZeRO axes but compute
-        # wants the TP-only layout — this constraint makes XLA all-gather the
-        # low-precision copy (the reference's post-step allgather of updated
-        # partitions, stage_1_and_2.py:2223, moved to gather-on-compute-cast).
-        # At stage 3 the constraint keeps params sharded; XLA gathers at use.
-        compute_params = jax.lax.with_sharding_constraint(
-            compute_params, self._param_shardings)
+        compute_params = self._cast_gather(params)
         out = self.model.loss_fn(compute_params, batch)
         if isinstance(out, tuple):
             loss, aux = out
@@ -379,9 +452,7 @@ class DeepSpeedTPUEngine:
                 self.mesh_mgr.pp_world_size > 1:
             # 1F1B pipeline schedule (bounded activations) — the model owns
             # the stage decomposition; the engine supplies the compute cast
-            compute_params = self.precision.cast_to_compute(params)
-            compute_params = jax.lax.with_sharding_constraint(
-                compute_params, self._param_shardings)
+            compute_params = self._cast_gather(params)
             grads, loss, aux = self.model.pipeline_grad_fn(
                 compute_params, batch, loss_scale.scale)
             return grads, loss.astype(jnp.float32), aux
@@ -412,9 +483,7 @@ class DeepSpeedTPUEngine:
 
         # cast + TP-layout gather OUTSIDE the manual region: compute params
         # carry no batch-axis sharding below stage 3
-        compute = self.precision.cast_to_compute(params)
-        compute = jax.lax.with_sharding_constraint(compute,
-                                                   self._param_shardings)
+        compute = self._cast_gather(params)
 
         is_p = lambda x: isinstance(x, P)  # noqa: E731
         flat_specs = jax.tree.leaves(self.grad_specs, is_leaf=is_p)

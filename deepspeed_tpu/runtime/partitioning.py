@@ -133,6 +133,11 @@ class Partitioner:
         """Parameter shardings: TP always; + ZeRO axes at stage 3."""
         return self._base_specs(logical_axes, shapes, shard_extra=self.zero_stage >= 3)
 
+    def gathered_param_specs(self, logical_axes, shapes):
+        """The compute (TP-only) layout a ZeRO-sharded param leaf has AFTER
+        its all-gather — the target layout for qwZ's int8 gather."""
+        return self._base_specs(logical_axes, shapes, shard_extra=False)
+
     def grad_specs(self, logical_axes, shapes):
         """Gradient shardings: match params at stage<=1; reduce-scattered
         (sharded) at stage >= 2."""
