@@ -139,3 +139,35 @@ def compute_elastic_config(elastic_config: Dict, target_chips: Optional[int] = N
     if return_microbatch:
         return cfg.global_batch_size, cfg.micro_batch_size, cfg
     return cfg.global_batch_size, cfg
+
+
+def main(argv=None) -> int:
+    """``dstpu_elastic`` CLI (reference ``bin/ds_elastic`` →
+    ``elasticity/elastic_agent`` info tool): read a config JSON, print the
+    resolved elastic batch and the chip counts it admits."""
+    import argparse
+    import json
+
+    p = argparse.ArgumentParser(prog="dstpu_elastic")
+    p.add_argument("-c", "--config", required=True,
+                   help="DeepSpeed-style config JSON with an 'elasticity' block")
+    p.add_argument("-w", "--world-size", type=int, default=None,
+                   help="validate this chip count against the config")
+    args = p.parse_args(argv)
+    with open(args.config) as f:
+        cfg = json.load(f)
+    elastic = cfg.get("elasticity")
+    if not elastic:
+        print("no 'elasticity' block in config")
+        return 1
+    try:
+        final_batch, micro, ecfg = compute_elastic_config(
+            elastic, target_chips=args.world_size, return_microbatch=True)
+    except ElasticityError as e:
+        print(f"error: {e}")
+        return 1
+    print(f"final batch size ........ {final_batch}")
+    print(f"micro batch per chip .... {micro}")
+    print(f"grad accumulation ....... {ecfg.gradient_accumulation_steps}")
+    print(f"compatible chip counts .. {ecfg.compatible_chip_counts}")
+    return 0

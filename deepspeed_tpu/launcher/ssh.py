@@ -1,0 +1,42 @@
+"""Parallel ssh fan-out over a hostfile (reference ``bin/ds_ssh``): run one
+command on every resource-pool host and stream per-host output."""
+
+from __future__ import annotations
+
+import argparse
+import shlex
+import subprocess
+import sys
+from typing import List
+
+from .runner import fetch_hostfile
+
+
+def main(argv: List[str] = None) -> int:
+    p = argparse.ArgumentParser(prog="dstpu_ssh")
+    p.add_argument("-H", "--hostfile", default="/job/hostfile")
+    p.add_argument("command", nargs=argparse.REMAINDER,
+                   help="command to run on every host")
+    args = p.parse_args(argv)
+    if not args.command:
+        p.error("no command given")
+    hosts = fetch_hostfile(args.hostfile)
+    if not hosts:
+        print(f"no hosts in {args.hostfile}; running locally")
+        return subprocess.run(args.command).returncode
+    cmd = shlex.join(args.command)  # preserve argv boundaries remotely
+    procs = {h: subprocess.Popen(
+        ["ssh", "-o", "StrictHostKeyChecking=no", h, cmd],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        for h in hosts}
+    rc = 0
+    for h, proc in procs.items():
+        out, _ = proc.communicate()
+        for line in (out or "").splitlines():
+            print(f"[{h}] {line}")
+        rc = rc or proc.returncode
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

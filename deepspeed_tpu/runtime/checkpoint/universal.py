@@ -130,7 +130,8 @@ def _load_tree_like(template: Any, root: str, *, place: bool = True) -> Any:
     return jax.tree.unflatten(treedef, leaves)
 
 
-def save_universal(state, out_dir: str, *, meta: Optional[Dict] = None) -> str:
+def save_universal(state, out_dir: str, *, meta: Optional[Dict] = None,
+                   subdir: bool = True) -> str:
     """Write a TrainState (or any {'params':..., 'opt_state':...} mapping) as a
     universal checkpoint. Atomic: writes to a temp dir then renames.
 
@@ -139,7 +140,7 @@ def save_universal(state, out_dir: str, *, meta: Optional[Dict] = None) -> str:
     ``.done`` marker; rank 0 renames only after all markers arrive."""
     params = state.params if hasattr(state, "params") else state["params"]
     opt_state = state.opt_state if hasattr(state, "opt_state") else state.get("opt_state")
-    final = os.path.join(out_dir, UNIVERSAL_DIR)
+    final = os.path.join(out_dir, UNIVERSAL_DIR) if subdir else out_dir
     tmp = final + ".tmp"
     rank, nproc = jax.process_index(), jax.process_count()
     if rank == 0:
@@ -208,7 +209,29 @@ def ds_to_universal(ckpt_dir: str, tag: Optional[str] = None,
         with open(meta_path) as f:
             meta = {k: v for k, v in json.load(f).items()
                     if k in ("global_steps", "micro_steps", "lr_scheduler")}
+    # an explicit out_dir is honored EXACTLY (reference ds_to_universal
+    # contract: fragments land at --output_folder, not a subdir of it)
     return save_universal(
         type("S", (), {"params": state["params"],
                        "opt_state": state.get("opt_state")})(),
-        out_dir or os.path.join(ckpt_dir, tag), meta=meta)
+        out_dir or os.path.join(ckpt_dir, tag), meta=meta,
+        subdir=out_dir is None)
+
+
+def main(argv=None) -> int:
+    """``dstpu_to_universal`` CLI (reference
+    ``deepspeed/checkpoint/ds_to_universal.py`` entry): engine checkpoint →
+    topology-free universal fragments."""
+    import argparse
+
+    p = argparse.ArgumentParser(prog="dstpu_to_universal")
+    p.add_argument("--input_folder", required=True,
+                   help="checkpoint dir written by engine.save_checkpoint")
+    p.add_argument("--tag", default=None)
+    p.add_argument("--output_folder", default=None,
+                   help="default: <input>/<tag>/universal")
+    args = p.parse_args(argv)
+    out = ds_to_universal(args.input_folder, tag=args.tag,
+                          out_dir=args.output_folder)
+    print(f"universal checkpoint written to {out}")
+    return 0

@@ -1,0 +1,42 @@
+"""Worker for test_multiprocess.py: one OS process of a 2-process
+data-parallel training job, bootstrapped exactly the way `bin/dstpu` does it
+(DSTPU_* env → comm.init_distributed → jax.distributed.initialize)."""
+
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def main():
+    pid, n, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    os.environ["DSTPU_COORDINATOR"] = f"127.0.0.1:{port}"
+    os.environ["DSTPU_NUM_PROCESSES"] = str(n)
+    os.environ["DSTPU_PROCESS_ID"] = str(pid)
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    import deepspeed_tpu as dst
+    from deepspeed_tpu.models import llama
+
+    spec = llama.model_spec(llama.LlamaConfig.tiny(use_pipeline=False),
+                            compute_dtype=jnp.float32)
+    eng, *_ = dst.initialize(model=spec, config={
+        "train_batch_size": 8,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": 2}})
+    assert jax.process_count() == n
+    rng = np.random.default_rng(0)  # same seed → same global batch everywhere
+    fixed = {"tokens": rng.integers(0, 256, (8, 33), dtype=np.int32)}
+    losses = [float(eng.train_batch(fixed).loss) for _ in range(5)]
+    print(f"LOSSES {pid} {' '.join(f'{l:.6f}' for l in losses)}", flush=True)
+    assert losses[-1] < losses[0] - 1.0, losses
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,50 @@
+"""True multi-process distributed training (reference: multi-node launch via
+``bin/deepspeed`` + NCCL; here the same engine step spans OS processes over
+jax.distributed's Gloo/CPU backend — the exact bootstrap ``bin/dstpu``
+performs on TPU pods, minus the ICI).
+
+This is the end-to-end proof for SURVEY §5.8's multi-host claim: two
+processes, one coordinator, a data-parallel ZeRO-2 train step whose loss
+trajectories must be byte-identical on both ranks and decrease."""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.parametrize("n", [2])
+def test_two_process_data_parallel_training(n):
+    workers = []
+    env = {**os.environ, "PYTHONPATH": REPO_ROOT, "JAX_PLATFORMS": "cpu"}
+    # conftest's 8-device virtual mesh must not leak in: each worker is ONE
+    # process with ONE device — the parallelism under test is cross-process
+    env.pop("XLA_FLAGS", None)
+    worker = os.path.join(os.path.dirname(__file__), "_mp_worker.py")
+    port = str(_free_port())
+    for pid in range(n):
+        workers.append(subprocess.Popen(
+            [sys.executable, worker, str(pid), str(n), port],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env))
+    outs = []
+    for w in workers:
+        out, _ = w.communicate(timeout=300)
+        outs.append(out)
+    for w, out in zip(workers, outs):
+        assert w.returncode == 0, out[-2000:]
+    # loss trajectories must be identical across ranks (collectives agree)
+    lines = [next(l for l in out.splitlines() if l.startswith("LOSSES"))
+             for out in outs]
+    trajs = {line.split()[1]: line.split()[2:] for line in lines}
+    assert len(set(map(tuple, trajs.values()))) == 1, trajs

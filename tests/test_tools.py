@@ -21,3 +21,69 @@ def test_io_sweep_roundtrip(tmp_path):
     # sorted ascending by combined bandwidth
     assert rows[-1]["read_GBps"] + rows[-1]["write_GBps"] >= \
         rows[0]["read_GBps"] + rows[0]["write_GBps"]
+
+
+def test_elastic_cli(tmp_path):
+    """dstpu_elastic resolves an elastic config from a ds_config JSON."""
+    import json
+    import subprocess
+    import sys
+
+    cfg = {"elasticity": {"enabled": True, "max_train_batch_size": 64,
+                          "micro_batch_sizes": [2, 4], "min_gpus": 1,
+                          "max_gpus": 16, "version": 0.2}}
+    f = tmp_path / "ds_config.json"
+    f.write_text(json.dumps(cfg))
+    import os
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "import sys; from deepspeed_tpu.elasticity.elasticity import main; "
+         f"sys.exit(main(['-c', '{f}']))"],
+        capture_output=True, text=True, timeout=120,
+        env={"PATH": "/usr/bin:/bin", "HOME": os.path.expanduser("~"),
+             "JAX_PLATFORMS": "cpu", "PYTHONPATH": repo_root})
+    assert out.returncode == 0, out.stderr
+    assert "final batch size" in out.stdout
+    assert "compatible chip counts" in out.stdout
+
+
+def test_ssh_cli_local_fallback(tmp_path):
+    """dstpu_ssh with no hostfile runs the command locally."""
+    from deepspeed_tpu.launcher.ssh import main
+
+    rc = main(["-H", str(tmp_path / "missing_hostfile"), "true"])
+    assert rc == 0
+
+
+def test_to_universal_cli(tmp_path, devices8):
+    """dstpu_to_universal converts a saved engine checkpoint."""
+    import deepspeed_tpu as dst
+    from deepspeed_tpu.comm import mesh as mesh_lib
+    from deepspeed_tpu.runtime.checkpoint.universal import main
+    from deepspeed_tpu.runtime.engine import ModelSpec
+
+    import jax
+    import jax.numpy as jnp
+
+    mesh_lib.set_mesh(None)
+
+    def loss_fn(params, batch):
+        pred = batch["x"] @ params["w"]
+        return jnp.mean((pred - batch["y"]) ** 2), {}
+
+    spec = ModelSpec(
+        loss_fn=loss_fn,
+        init_fn=lambda k: {"w": jax.random.normal(k, (8, 8)) * 0.1},
+        pipeline_capable=False)
+    engine, *_ = dst.initialize(model=spec, config={
+        "train_batch_size": 8,
+        "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 1}})
+    engine.train_batch({"x": np.ones((8, 8), np.float32),
+                        "y": np.zeros((8, 8), np.float32)})
+    engine.save_checkpoint(str(tmp_path), tag="t1")
+    rc = main(["--input_folder", str(tmp_path), "--tag", "t1"])
+    assert rc == 0
+    assert (tmp_path / "t1" / "universal").exists()
