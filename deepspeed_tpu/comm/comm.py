@@ -304,3 +304,54 @@ def broadcast_host(value, src: int = 0):
     from jax.experimental import multihost_utils
 
     return multihost_utils.broadcast_one_to_all(value, is_source=jax.process_index() == src)
+
+
+def all_gather_object(obj):
+    """Gather one picklable host object per process → list ordered by rank
+    (reference ``dist.all_gather_object`` :247). Two phases: agree on the max
+    pickle size, then gather fixed-width byte buffers."""
+    import pickle
+
+    import numpy as np
+
+    if jax.process_count() == 1:
+        return [obj]
+    from jax.experimental import multihost_utils
+
+    payload = np.frombuffer(pickle.dumps(obj), np.uint8)
+    sizes = multihost_utils.process_allgather(
+        np.asarray([payload.size], np.int64))
+    width = int(sizes.max())
+    padded = np.zeros((width,), np.uint8)
+    padded[:payload.size] = payload
+    gathered = multihost_utils.process_allgather(padded)
+    return [pickle.loads(gathered[r, :int(sizes[r, 0])].tobytes())
+            for r in range(jax.process_count())]
+
+
+def broadcast_object_list(object_list, src: int = 0):
+    """In-place broadcast of a list of picklable objects from ``src``
+    (reference ``dist.broadcast_object_list`` :229). Only ``src`` pickles —
+    non-src placeholders may be unpicklable, matching the torch contract —
+    and the wire carries one payload, not an all-gather."""
+    import pickle
+
+    import numpy as np
+
+    if jax.process_count() == 1:
+        return object_list
+    from jax.experimental import multihost_utils
+
+    is_src = jax.process_index() == src
+    payload = (np.frombuffer(pickle.dumps(list(object_list)), np.uint8)
+               if is_src else np.zeros((0,), np.uint8))
+    size = multihost_utils.broadcast_one_to_all(
+        np.asarray([payload.size], np.int64), is_source=is_src)
+    width = int(size[0])
+    padded = np.zeros((width,), np.uint8)
+    if is_src:
+        padded[:payload.size] = payload
+    data = multihost_utils.broadcast_one_to_all(padded, is_source=is_src)
+    for i, obj in enumerate(pickle.loads(np.asarray(data).tobytes())):
+        object_list[i] = obj
+    return object_list

@@ -140,7 +140,13 @@ def save_universal(state, out_dir: str, *, meta: Optional[Dict] = None,
     ``.done`` marker; rank 0 renames only after all markers arrive."""
     params = state.params if hasattr(state, "params") else state["params"]
     opt_state = state.opt_state if hasattr(state, "opt_state") else state.get("opt_state")
+    out_dir = os.path.normpath(out_dir)  # trailing '/' would nest tmp in final
     final = os.path.join(out_dir, UNIVERSAL_DIR) if subdir else out_dir
+    if not subdir and os.path.exists(final) and os.listdir(final):
+        # a user-supplied exact target is never rmtree'd (only the
+        # tool-owned 'universal/' subdir is fair game below)
+        raise ValueError(f"output folder {final} exists and is not empty; "
+                         f"refusing to overwrite")
     tmp = final + ".tmp"
     rank, nproc = jax.process_index(), jax.process_count()
     if rank == 0:

@@ -12,6 +12,11 @@ jax.config.update("jax_platforms", "cpu")
 
 def main():
     pid, n, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    tp = int(sys.argv[4]) if len(sys.argv) > 4 else 1
+    if tp > 1:
+        # pod topology: several devices per process (the host's chips over
+        # ICI) × several processes (DCN) — TP inside, DP across
+        jax.config.update("jax_num_cpu_devices", tp)
     os.environ["DSTPU_COORDINATOR"] = f"127.0.0.1:{port}"
     os.environ["DSTPU_NUM_PROCESSES"] = str(n)
     os.environ["DSTPU_PROCESS_ID"] = str(pid)
@@ -24,13 +29,20 @@ def main():
     import deepspeed_tpu as dst
     from deepspeed_tpu.models import llama
 
-    spec = llama.model_spec(llama.LlamaConfig.tiny(use_pipeline=False),
-                            compute_dtype=jnp.float32)
-    eng, *_ = dst.initialize(model=spec, config={
+    config = {
         "train_batch_size": 8,
         "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
-        "zero_optimization": {"stage": 2}})
+        "zero_optimization": {"stage": 2}}
+    if tp > 1:
+        config["mesh"] = {"data": n, "tensor": tp}
+    spec = llama.model_spec(llama.LlamaConfig.tiny(use_pipeline=False),
+                            compute_dtype=jnp.float32)
+    eng, *_ = dst.initialize(model=spec, config=config)
     assert jax.process_count() == n
+    assert len(jax.devices()) == n * tp
+    from deepspeed_tpu.comm import comm as dist
+    objs = dist.all_gather_object({"rank": pid, "tag": f"w{pid}"})
+    assert [o["rank"] for o in objs] == list(range(n)), objs
     rng = np.random.default_rng(0)  # same seed → same global batch everywhere
     fixed = {"tokens": rng.integers(0, 256, (8, 33), dtype=np.int32)}
     losses = [float(eng.train_batch(fixed).loss) for _ in range(5)]

@@ -23,24 +23,32 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-@pytest.mark.parametrize("n", [2])
-def test_two_process_data_parallel_training(n):
+@pytest.mark.parametrize("n,tp", [(2, 1), (2, 2)])
+def test_two_process_data_parallel_training(n, tp):
+    """tp=1: pure cross-process DP. tp=2: the pod topology — TP across each
+    process's local devices (ICI analog), DP across processes (DCN analog)."""
     workers = []
     env = {**os.environ, "PYTHONPATH": REPO_ROOT, "JAX_PLATFORMS": "cpu"}
-    # conftest's 8-device virtual mesh must not leak in: each worker is ONE
-    # process with ONE device — the parallelism under test is cross-process
+    # conftest's 8-device virtual mesh must not leak in: each worker sets its
+    # own local device count — the parallelism under test is cross-process
     env.pop("XLA_FLAGS", None)
     worker = os.path.join(os.path.dirname(__file__), "_mp_worker.py")
     port = str(_free_port())
     for pid in range(n):
         workers.append(subprocess.Popen(
-            [sys.executable, worker, str(pid), str(n), port],
+            [sys.executable, worker, str(pid), str(n), port, str(tp)],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
             env=env))
     outs = []
-    for w in workers:
-        out, _ = w.communicate(timeout=300)
-        outs.append(out)
+    try:
+        for w in workers:
+            out, _ = w.communicate(timeout=300)
+            outs.append(out)
+    finally:
+        for w in workers:  # never leak a blocked worker into the next case
+            if w.poll() is None:
+                w.kill()
+                w.wait()
     for w, out in zip(workers, outs):
         assert w.returncode == 0, out[-2000:]
     # loss trajectories must be identical across ranks (collectives agree)

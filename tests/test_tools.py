@@ -23,30 +23,22 @@ def test_io_sweep_roundtrip(tmp_path):
         rows[0]["read_GBps"] + rows[0]["write_GBps"]
 
 
-def test_elastic_cli(tmp_path):
+def test_elastic_cli(tmp_path, capsys):
     """dstpu_elastic resolves an elastic config from a ds_config JSON."""
     import json
-    import subprocess
-    import sys
+
+    from deepspeed_tpu.elasticity.elasticity import main
 
     cfg = {"elasticity": {"enabled": True, "max_train_batch_size": 64,
                           "micro_batch_sizes": [2, 4], "min_gpus": 1,
                           "max_gpus": 16, "version": 0.2}}
     f = tmp_path / "ds_config.json"
     f.write_text(json.dumps(cfg))
-    import os
-
-    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    out = subprocess.run(
-        [sys.executable, "-c",
-         "import sys; from deepspeed_tpu.elasticity.elasticity import main; "
-         f"sys.exit(main(['-c', '{f}']))"],
-        capture_output=True, text=True, timeout=120,
-        env={"PATH": "/usr/bin:/bin", "HOME": os.path.expanduser("~"),
-             "JAX_PLATFORMS": "cpu", "PYTHONPATH": repo_root})
-    assert out.returncode == 0, out.stderr
-    assert "final batch size" in out.stdout
-    assert "compatible chip counts" in out.stdout
+    assert main(["-c", str(f)]) == 0
+    out = capsys.readouterr().out
+    assert "final batch size" in out
+    assert "compatible chip counts" in out
+    assert main(["-c", str(f), "-w", "7"]) == 1  # incompatible world size
 
 
 def test_ssh_cli_local_fallback(tmp_path):
