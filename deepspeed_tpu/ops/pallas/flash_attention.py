@@ -20,6 +20,7 @@ one accumulating dQ over kv blocks, one accumulating dK/dV over q blocks.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional
 
 import jax
@@ -41,9 +42,19 @@ NEG_INF = -1e30
 # VMEM reads in the backward kernels.
 
 
-def _block(n: int, pref: int = 512) -> int:
+def _block(n: int, pref: Optional[int] = None) -> int:
     """Block size: large (512) to amortize MXU issue + VPU overhead per block;
-    VMEM at bq=bkv=512, d<=128: scores 1MB fp32 + tiles well under budget."""
+    VMEM at bq=bkv=512, d<=128: scores 1MB fp32 + tiles well under budget.
+    ``DSTPU_FLASH_BLOCK`` overrides the preferred size for on-chip sweeps."""
+    if pref is None:
+        raw = os.environ.get("DSTPU_FLASH_BLOCK", "512")
+        try:
+            pref = int(raw)
+        except ValueError:
+            raise ValueError(f"DSTPU_FLASH_BLOCK={raw!r} is not an integer")
+        if pref <= 0 or pref % 8:
+            raise ValueError(f"DSTPU_FLASH_BLOCK={pref} must be a positive "
+                             f"multiple of 8 (Mosaic tiling)")
     return min(pref, max(8, 1 << (n - 1).bit_length())) if n < pref else pref
 
 

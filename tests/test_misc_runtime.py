@@ -130,3 +130,35 @@ def test_check_overflow_and_clip():
     clipped, norm = clip_grad_norm_({"g": jnp.full((4,), 3.0)}, max_norm=1.0)
     assert float(norm) == pytest.approx(6.0)
     assert float(jnp.linalg.norm(clipped["g"])) == pytest.approx(1.0, rel=1e-4)
+
+
+def test_debug_param_names_and_nonfinite():
+    """utils/debug: pytree path naming, NaN sweep, summary (reference
+    deepspeed/utils/debug.py + runtime NaN checks)."""
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.utils import debug
+
+    tree = {"layers": {"wq": jnp.ones((4, 4)),
+                       "wk": jnp.asarray([[1.0, jnp.nan], [jnp.inf, 2.0]])},
+            "step": jnp.asarray(3)}
+    names = debug.param_names(tree)
+    assert "layers/wq" in names and "step" in names
+    bad = debug.find_nonfinite(tree)
+    assert bad == [("layers/wk", 2)]
+    with pytest.raises(FloatingPointError, match="layers/wk"):
+        debug.assert_all_finite(tree, "grads")
+    s = debug.tree_summary(tree)
+    assert "MB" in s and "layers/wq" in s
+
+
+def test_debug_compiled_memory_report():
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.utils import debug
+
+    compiled = jax.jit(lambda x: x @ x).lower(
+        jnp.ones((64, 64))).compile()
+    rep = debug.compiled_memory_report(compiled)
+    assert rep.get("argument_size_in_bytes", 0) > 0
