@@ -51,7 +51,8 @@ def _block(n: int, pref: Optional[int] = None) -> int:
         try:
             pref = int(raw)
         except ValueError:
-            raise ValueError(f"DSTPU_FLASH_BLOCK={raw!r} is not an integer")
+            raise ValueError(
+                f"DSTPU_FLASH_BLOCK={raw!r} is not an integer") from None
         if pref <= 0 or pref % 8:
             raise ValueError(f"DSTPU_FLASH_BLOCK={pref} must be a positive "
                              f"multiple of 8 (Mosaic tiling)")

@@ -100,6 +100,10 @@ class ZeroConfig(ConfigModel):
 class OptimizerConfig(ConfigModel):
     type: str = "adamw"
     params: Dict[str, Any] = field(default_factory=dict)
+    # param-group analog (reference: the param_groups list handed to
+    # torch optimizers): [{"pattern": <regex over leaf paths>, <hyper
+    # overrides>}, ...]; first match wins, unmatched leaves use `params`
+    param_groups: List[Dict[str, Any]] = field(default_factory=list)
 
 
 @register_config_model

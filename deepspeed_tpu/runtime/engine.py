@@ -118,17 +118,20 @@ class DeepSpeedTPUEngine:
         # --- precision ---
         self.precision = PrecisionPolicy.from_config(config)
 
-        # --- optimizer + schedule (reference _configure_optimizer :1597) ---
-        if optimizer is None:
-            opt_params = dict(config.optimizer.params)
-            optimizer = get_optimizer(config.optimizer.type or "adamw", **opt_params)
-        self.optimizer = optimizer
-        self.base_lr = float(optimizer.hyperparams.get("lr", 1.0)) or 1.0
-        if lr_schedule is None:
-            lr_schedule = get_schedule(config.scheduler.type, config.scheduler.params,
-                                       base_lr=self.base_lr)
-        self.lr_schedule = lr_schedule
-        self.lr_scheduler = LRScheduler(lr_schedule)
+        # --- optimizer (reference _configure_optimizer :1597) ---
+        # one construction site: a config with param_groups defers building
+        # until params materialize (leaf names drive the group match); a
+        # user-supplied optimizer always wins, but dropping the config's
+        # param_groups silently would be a trap — warn.
+        config_groups = config.optimizer.param_groups
+        if optimizer is not None and config_groups:
+            logger.warning(
+                "optimizer.param_groups in the config are IGNORED because an "
+                "optimizer object was passed to initialize()")
+        build_grouped = optimizer is None and bool(config_groups)
+        if optimizer is None and not build_grouped:
+            optimizer = get_optimizer(config.optimizer.type or "adamw",
+                                      **config.optimizer.params)
 
         # --- params + sharding ---
         rng = rng if rng is not None else jax.random.PRNGKey(config.seed)
@@ -136,6 +139,24 @@ class DeepSpeedTPUEngine:
         params = jax.tree.map(
             lambda p: p.astype(self.precision.param_dtype)
             if jnp.issubdtype(p.dtype, jnp.floating) else p, params)
+
+        if build_grouped:
+            # param-group analog (reference torch param_groups): per-group
+            # hyper overrides by leaf-path pattern — needs the materialized
+            # tree for leaf names, hence after materialize
+            from ..ops.optimizers import grouped_optimizer
+
+            optimizer = grouped_optimizer(
+                config.optimizer.type or "adamw", params,
+                config_groups, **config.optimizer.params)
+        self.optimizer = optimizer
+        self.base_lr = float(optimizer.hyperparams.get("lr", 1.0)) or 1.0
+        if lr_schedule is None:
+            lr_schedule = get_schedule(config.scheduler.type,
+                                       config.scheduler.params,
+                                       base_lr=self.base_lr)
+        self.lr_schedule = lr_schedule
+        self.lr_scheduler = LRScheduler(lr_schedule)
 
         if config.zero_config.zero_quantized_gradients and \
                 config.zero_config.stage not in (2,):

@@ -112,3 +112,65 @@ def test_lr_scale_applied():
     p, _ = opt.update(params, grads, state, lr_scale=0.0)
     jax.tree.map(lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b)),
                  p, params)
+
+
+def test_grouped_optimizer_weight_decay_mask():
+    """Param groups (reference torch param_groups): norm/bias leaves get
+    weight_decay=0 while matrices decay — verified against two manual runs."""
+    from deepspeed_tpu.ops.optimizers import get_optimizer, grouped_optimizer
+
+    params = {"w": jnp.ones((4, 4)), "norm": jnp.ones((4,))}
+    grads = {"w": jnp.zeros((4, 4)), "norm": jnp.zeros((4,))}
+    gopt = grouped_optimizer("adamw", params,
+                             [{"pattern": "norm", "weight_decay": 0.0}],
+                             lr=0.1, weight_decay=0.5)
+    state = gopt.init(params)
+    new_params, _ = gopt.update(params, grads, state)
+    # zero grads: adamw pure-decay step shrinks 'w' but must not touch 'norm'
+    assert float(jnp.max(jnp.abs(new_params["norm"] - 1.0))) == 0.0
+    assert float(jnp.max(new_params["w"])) < 1.0
+
+    # unmatched leaves behave exactly like the plain optimizer
+    plain = get_optimizer("adamw", lr=0.1, weight_decay=0.5)
+    pw, _ = plain.update({"w": params["w"]}, {"w": grads["w"]},
+                         plain.init({"w": params["w"]}))
+    np.testing.assert_allclose(np.asarray(new_params["w"]),
+                               np.asarray(pw["w"]))
+
+
+def test_grouped_optimizer_per_group_lr():
+    from deepspeed_tpu.ops.optimizers import grouped_optimizer
+
+    params = {"embed": jnp.ones((4, 4)), "head": jnp.ones((4, 4))}
+    grads = {"embed": jnp.ones((4, 4)), "head": jnp.ones((4, 4))}
+    gopt = grouped_optimizer("sgd", params,
+                             [{"pattern": "head", "lr": 0.01}], lr=0.1)
+    new_params, _ = gopt.update(params, grads, gopt.init(params))
+    d_embed = float(jnp.mean(1.0 - new_params["embed"]))
+    d_head = float(jnp.mean(1.0 - new_params["head"]))
+    np.testing.assert_allclose(d_embed, 0.1, rtol=1e-5)
+    np.testing.assert_allclose(d_head, 0.01, rtol=1e-5)
+
+
+def test_engine_param_groups_config(devices8):
+    """param_groups via the config JSON end to end (ZeRO-2 sharded state)."""
+    import deepspeed_tpu as dst
+    from deepspeed_tpu.comm import mesh as mesh_lib
+    from deepspeed_tpu.models import llama
+
+    mesh_lib.set_mesh(None)
+    engine, *_ = dst.initialize(
+        model=llama.model_spec(llama.LlamaConfig.tiny(),
+                               compute_dtype=jnp.float32),
+        config={"train_batch_size": 8,
+                "optimizer": {"type": "adamw",
+                              "params": {"lr": 1e-2, "weight_decay": 0.1},
+                              "param_groups": [
+                                  {"pattern": "(norm|bias)",
+                                   "weight_decay": 0.0}]},
+                "zero_optimization": {"stage": 2}})
+    rs = np.random.RandomState(0)
+    fixed = {"tokens": rs.randint(0, 256, (8, 33)).astype(np.int32)}
+    losses = [float(engine.train_batch(fixed).loss) for _ in range(5)]
+    assert losses[-1] < losses[0] - 0.5, losses
+    assert "param_groups" in engine.optimizer.hyperparams
