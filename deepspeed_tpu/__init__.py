@@ -15,6 +15,7 @@ Public entry points (reference parity):
 __version__ = "0.1.0"
 
 from . import comm  # noqa: F401
+from .accelerator import get_accelerator  # noqa: F401
 from .runtime.config import DeepSpeedTPUConfig, parse_config  # noqa: F401
 
 

@@ -1,0 +1,219 @@
+"""Accelerator abstraction — the reference's ``deepspeed.accelerator``
+public API (``accelerator/abstract_accelerator.py`` + ``real_accelerator.py
+get_accelerator()``) over JAX devices.
+
+Much of the CUDA surface is meaningless on TPU (streams, cache flushing):
+those entries exist, documented as no-ops, so user code written against
+``get_accelerator()`` ports without edits. Memory queries go through
+``jax.local_devices()[i].memory_stats()`` when the backend provides them.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+class TPU_Accelerator:
+    """Singleton returned by :func:`get_accelerator`."""
+
+    _name = "tpu"
+    communication_backend_name = "xla"
+
+    # --- identity / topology --------------------------------------------- #
+    def is_synchronized_device(self) -> bool:
+        return False  # dispatch is async, like CUDA
+
+    def use_host_timers(self) -> bool:
+        # async dispatch → host timers need an explicit block (ThroughputTimer
+        # does a device sync); matches reference semantics for non-sync devices
+        return False
+
+    def resolves_data_dependency(self) -> bool:
+        return True   # XLA schedules by dataflow
+
+    def handles_memory_backpressure(self) -> bool:
+        return False
+
+    def device_name(self, device_index: Optional[int] = None) -> str:
+        if device_index is None:
+            return jax.default_backend()
+        return str(jax.local_devices()[device_index])
+
+    def device(self, device_index: Optional[int] = None):
+        idx = device_index or 0
+        return jax.local_devices()[idx]
+
+    def set_device(self, device_index: int) -> None:
+        pass  # SPMD: placement comes from shardings, not a current-device
+
+    def current_device(self) -> int:
+        return 0
+
+    def current_device_name(self) -> str:
+        return str(jax.local_devices()[0])
+
+    def device_count(self) -> int:
+        return jax.local_device_count()
+
+    def synchronize(self, device_index: Optional[int] = None) -> None:
+        """Drain in-flight work: enqueue + await a trivial transfer on each
+        local device (all of them when ``device_index`` is None) — a
+        default-device-only block would miss shards still executing on the
+        other chips of a multi-device host."""
+        devs = (jax.local_devices() if device_index is None
+                else [jax.local_devices()[device_index]])
+        for d in devs:
+            jax.device_put(0, d).block_until_ready()
+
+    # --- rng -------------------------------------------------------------- #
+    def manual_seed(self, seed: int) -> None:
+        self._seed = int(seed)
+
+    def manual_seed_all(self, seed: int) -> None:
+        self.manual_seed(seed)
+
+    def initial_seed(self) -> int:
+        return getattr(self, "_seed", 0)
+
+    def random(self):
+        return jax.random  # the functional RNG module is the 'generator'
+
+    # --- streams / events: XLA orders by dataflow — no-op surface --------- #
+    class _NullStream:
+        def synchronize(self):
+            pass
+
+    class _NullEvent:
+        def record(self):
+            pass
+
+        def synchronize(self):
+            pass
+
+        def elapsed_time(self, other):
+            return 0.0
+
+    def Stream(self, **kw):
+        return TPU_Accelerator._NullStream()
+
+    @contextlib.contextmanager
+    def stream(self, s):
+        yield
+
+    def current_stream(self, device_index=None):
+        return TPU_Accelerator._NullStream()
+
+    def default_stream(self, device_index=None):
+        return TPU_Accelerator._NullStream()
+
+    def Event(self, **kw):
+        return TPU_Accelerator._NullEvent()
+
+    # --- memory ----------------------------------------------------------- #
+    def _stats(self, device_index: Optional[int]) -> Dict[str, Any]:
+        dev = jax.local_devices()[device_index or 0]
+        try:
+            return dev.memory_stats() or {}
+        except Exception:
+            return {}
+
+    def memory_allocated(self, device_index=None) -> int:
+        return int(self._stats(device_index).get("bytes_in_use", 0))
+
+    def max_memory_allocated(self, device_index=None) -> int:
+        return int(self._stats(device_index).get(
+            "peak_bytes_in_use", self.memory_allocated(device_index)))
+
+    def reset_max_memory_allocated(self, device_index=None) -> None:
+        pass
+
+    def memory_cached(self, device_index=None) -> int:
+        return self.memory_allocated(device_index)
+
+    def max_memory_cached(self, device_index=None) -> int:
+        return self.max_memory_allocated(device_index)
+
+    def reset_max_memory_cached(self, device_index=None) -> None:
+        pass
+
+    def memory_stats(self, device_index=None) -> Dict[str, Any]:
+        return self._stats(device_index)
+
+    def reset_peak_memory_stats(self, device_index=None) -> None:
+        pass
+
+    def memory_reserved(self, device_index=None) -> int:
+        return self.memory_allocated(device_index)
+
+    def max_memory_reserved(self, device_index=None) -> int:
+        return self.max_memory_allocated(device_index)
+
+    def total_memory(self, device_index=None) -> int:
+        return int(self._stats(device_index).get("bytes_limit", 0))
+
+    def available_memory(self, device_index=None) -> int:
+        s = self._stats(device_index)
+        return int(s.get("bytes_limit", 0)) - int(s.get("bytes_in_use", 0))
+
+    def empty_cache(self) -> None:
+        pass  # XLA owns the arena; nothing to flush
+
+    # --- dtype / capability ---------------------------------------------- #
+    def is_bf16_supported(self) -> bool:
+        return True
+
+    def is_fp16_supported(self) -> bool:
+        return True  # storage + compute work; bf16 is the native fast path
+
+    def is_triton_supported(self) -> bool:
+        return False  # Pallas is the kernel language here
+
+    def supported_dtypes(self) -> List[Any]:
+        return [jnp.float32, jnp.bfloat16, jnp.float16, jnp.int8]
+
+    def device_supports_dtype(self, dtype) -> bool:
+        return jnp.dtype(dtype) in [jnp.dtype(d) for d in
+                                    self.supported_dtypes()]
+
+    # --- misc ------------------------------------------------------------- #
+    def name(self) -> str:
+        return self._name
+
+    def is_available(self) -> bool:
+        try:
+            return len(jax.devices()) > 0
+        except Exception:
+            return False
+
+    def pin_memory(self, array, align_bytes: int = 1):
+        return array  # host arrays feed device_put directly
+
+    def on_accelerator(self, array) -> bool:
+        try:
+            kind = getattr(array.sharding, "memory_kind", "device")
+        except AttributeError:
+            return False
+        return kind in ("device", "tpu_hbm")
+
+    def communication_backend(self) -> str:
+        return self.communication_backend_name
+
+
+_ACCELERATOR: Optional[TPU_Accelerator] = None
+
+
+def get_accelerator() -> TPU_Accelerator:
+    """Reference ``real_accelerator.get_accelerator()``."""
+    global _ACCELERATOR
+    if _ACCELERATOR is None:
+        _ACCELERATOR = TPU_Accelerator()
+    return _ACCELERATOR
+
+
+def set_accelerator(acc) -> None:
+    global _ACCELERATOR
+    _ACCELERATOR = acc

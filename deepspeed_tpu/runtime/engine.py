@@ -149,6 +149,13 @@ class DeepSpeedTPUEngine:
             optimizer = grouped_optimizer(
                 config.optimizer.type or "adamw", params,
                 config_groups, **config.optimizer.params)
+            # abstract leaves only — the wrapper needs paths/structure, and
+            # holding real arrays here would pin the initial params forever
+            abstract = jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), params)
+            self._grouped_ctor = (config.optimizer.type or "adamw",
+                                  [dict(g) for g in config_groups],
+                                  dict(config.optimizer.params), abstract)
         self.optimizer = optimizer
         self.base_lr = float(optimizer.hyperparams.get("lr", 1.0)) or 1.0
         if lr_schedule is None:
@@ -338,10 +345,24 @@ class DeepSpeedTPUEngine:
         return self.model
 
     def set_lr(self, lr: float) -> None:
-        """Pin the LR to a constant (reference ``engine.set_lr``)."""
-        self.base_lr = float(lr)
+        """Pin the LR to a constant (reference ``engine.set_lr`` writes the
+        value into EVERY param group). base_lr must stay the optimizer's
+        factory lr — the step computes ``lr_scale = sched(t)/base_lr`` and
+        the optimizer multiplies by its own lr, so resetting base_lr here
+        would cancel the scale and silently keep the old rate."""
         self.lr_schedule = constant(float(lr))
         self.lr_scheduler = LRScheduler(self.lr_schedule)
+        if getattr(self, "_grouped_ctor", None) is not None:
+            # grouped optimizers have per-group lrs; reference semantics are
+            # uniform after set_lr → rebuild with every group pinned to lr
+            from ..ops.optimizers import grouped_optimizer
+
+            name, groups, kwargs, ptree = self._grouped_ctor
+            kwargs = {**kwargs, "lr": float(lr)}
+            groups = [{k: v for k, v in g.items() if k != "lr"}
+                      for g in groups]
+            self.optimizer = grouped_optimizer(name, ptree, groups, **kwargs)
+            self.base_lr = float(lr)
         self._train_step = None  # recompile with the new schedule
 
     def get_mom(self) -> List[float]:

@@ -238,3 +238,57 @@ def test_reference_accessor_parity(devices8):
     assert engine.get_lr()[0] == pytest.approx(5e-4)
     out = engine.train_batch({"tokens": np.zeros((16, 17), np.int32)})
     assert float(out.lr) == pytest.approx(5e-4)
+
+
+def test_set_lr_changes_effective_rate(devices8):
+    """set_lr must change the rate the optimizer APPLIES, not just the
+    reported schedule value (regression: resetting base_lr cancelled the
+    scale and silently kept the factory lr)."""
+    import deepspeed_tpu as dst
+    from deepspeed_tpu.comm import mesh as mesh_lib
+    from deepspeed_tpu.runtime.engine import ModelSpec
+
+    mesh_lib.set_mesh(None)
+
+    def loss_fn(params, batch):
+        return jnp.sum(params["w"] * batch["x"]), {}  # grad == x
+
+    spec = ModelSpec(loss_fn=loss_fn,
+                     init_fn=lambda k: {"w": jnp.ones((8,))},
+                     pipeline_capable=False)
+    engine, *_ = dst.initialize(model=spec, config={
+        "train_batch_size": 8,
+        "optimizer": {"type": "sgd", "params": {"lr": 0.1}}})
+    batch = {"x": np.ones((8,), np.float32)}
+    engine.set_lr(0.01)
+    w0 = np.asarray(engine.state.params["w"]).copy()
+    engine.train_batch(batch)
+    delta = float(np.mean(w0 - np.asarray(engine.state.params["w"])))
+    np.testing.assert_allclose(delta, 0.01, rtol=1e-5)  # 0.1 under the bug
+
+
+def test_set_lr_uniform_across_param_groups(devices8):
+    """Reference set_lr writes the value into EVERY param group."""
+    import deepspeed_tpu as dst
+    from deepspeed_tpu.comm import mesh as mesh_lib
+    from deepspeed_tpu.runtime.engine import ModelSpec
+
+    mesh_lib.set_mesh(None)
+
+    def loss_fn(params, batch):
+        return jnp.sum((params["w"] + params["head"]) * batch["x"]), {}
+
+    spec = ModelSpec(loss_fn=loss_fn,
+                     init_fn=lambda k: {"w": jnp.ones((8,)),
+                                        "head": jnp.ones((8,))},
+                     pipeline_capable=False)
+    engine, *_ = dst.initialize(model=spec, config={
+        "train_batch_size": 8,
+        "optimizer": {"type": "sgd", "params": {"lr": 0.1},
+                      "param_groups": [{"pattern": "head", "lr": 0.5}]}})
+    engine.set_lr(0.02)
+    w0 = {k: np.asarray(v).copy() for k, v in engine.state.params.items()}
+    engine.train_batch({"x": np.ones((8,), np.float32)})
+    for k in ("w", "head"):
+        delta = float(np.mean(w0[k] - np.asarray(engine.state.params[k])))
+        np.testing.assert_allclose(delta, 0.02, rtol=1e-5, err_msg=k)

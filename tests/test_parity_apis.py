@@ -234,3 +234,32 @@ def test_int4_and_fp8_quantized_inference(devices8):
     assert f8.params["layers"]["wq"]["f8"].dtype == jnp.float8_e4m3fn
     lf8 = np.asarray(f8.forward(prompts))
     np.testing.assert_allclose(lf8, lr, atol=0.5)
+
+
+def test_accelerator_abstraction():
+    """Reference deepspeed.accelerator.get_accelerator() surface over JAX
+    (abstract_accelerator.py API): identity, memory, dtype capability,
+    no-op stream/event shims."""
+    import jax.numpy as jnp
+
+    from deepspeed_tpu import get_accelerator
+
+    acc = get_accelerator()
+    assert acc is get_accelerator()  # singleton
+    assert acc.device_count() >= 1
+    assert acc.is_bf16_supported() and not acc.is_triton_supported()
+    assert acc.device_supports_dtype(jnp.bfloat16)
+    assert not acc.is_synchronized_device()
+    acc.synchronize()  # must not raise
+    acc.manual_seed(17)
+    assert acc.initial_seed() == 17
+    with acc.stream(acc.Stream()):
+        pass
+    ev = acc.Event()
+    ev.record(); ev.synchronize()
+    stats = acc.memory_stats()
+    assert isinstance(stats, dict)
+    assert acc.memory_allocated() >= 0
+    x = jnp.ones((4,))
+    assert acc.on_accelerator(x) in (True, False)
+    assert acc.communication_backend() == "xla"
