@@ -202,6 +202,20 @@ class TPU_Accelerator:
     def communication_backend(self) -> str:
         return self.communication_backend_name
 
+    # --- profiler ranges (reference range_push/range_pop → utils/nvtx) ---- #
+    def range_push(self, name: str) -> None:
+        from .utils.nvtx import range_push
+
+        range_push(name)
+
+    def range_pop(self) -> None:
+        from .utils.nvtx import range_pop
+
+        range_pop()
+
+    def lazy_call(self, fn) -> None:
+        fn()  # no deferred-init phase on TPU; call through
+
 
 _ACCELERATOR: Optional[TPU_Accelerator] = None
 

@@ -308,7 +308,8 @@ class InferenceEngine:
 
 
 def init_inference(model=None, config=None, *, family: Optional[ModelFamily] = None,
-                   model_cfg=None, params=None, **kwargs) -> InferenceEngine:
+                   model_cfg=None, params=None, checkpoint: Optional[str] = None,
+                   **kwargs) -> InferenceEngine:
     """TPU counterpart of ``deepspeed.init_inference`` (``__init__.py:313``).
 
     Accepts either a ``ModelFamily`` (via ``family=``) or a model *module*
@@ -316,7 +317,63 @@ def init_inference(model=None, config=None, *, family: Optional[ModelFamily] = N
 
         engine = init_inference(llama, model_cfg=cfg, params=params,
                                 config={"tensor_parallel": {"tp_size": 4}})
+
+    ``checkpoint`` loads weights from disk (reference checkpoint loading,
+    ``inference/engine.py:303-471``): a directory written by
+    ``engine.save_checkpoint`` (pass model module + model_cfg too), or a
+    local HF checkpoint directory (family/config inferred from its
+    config.json).
     """
+    if params is None and checkpoint is not None:
+        import os as _os
+
+        if _os.path.exists(_os.path.join(checkpoint, "latest")) or \
+                _os.path.exists(_os.path.join(checkpoint, "meta.json")):
+            # our engine checkpoint layout
+            from ..runtime.checkpoint.saver import read_state_tree, resolve_tag
+
+            if family is None and (model is None or model_cfg is None):
+                raise ValueError("engine-checkpoint loading needs the model "
+                                 "module and model_cfg= (or family=) "
+                                 "alongside checkpoint=")
+            tag_dir = checkpoint
+            if _os.path.exists(_os.path.join(checkpoint, "latest")):
+                tag_dir = _os.path.join(checkpoint,
+                                        resolve_tag(checkpoint, None))
+            universal = _os.path.join(tag_dir, "universal")
+            if _os.path.exists(universal) and model is not None:
+                # topology-free path: resharded restore via a shape template
+                from functools import partial as _partial
+
+                from ..runtime.checkpoint.universal import load_universal
+
+                shapes = jax.eval_shape(_partial(model.init, model_cfg),
+                                        jax.random.PRNGKey(0))
+                rep = get_mesh().replicated()
+                template = jax.tree.map(
+                    lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype,
+                                                   sharding=rep), shapes)
+                params, _, _ = load_universal(universal, template, None)
+            else:
+                if jax.process_count() > 1:
+                    raise ValueError(
+                        "multi-host init_inference(checkpoint=) needs a "
+                        "universal checkpoint (bin/dstpu_to_universal) — the "
+                        "raw state tree cannot be reconstituted across "
+                        "processes without one")
+                params = read_state_tree(tag_dir)["params"]
+        else:
+            # local HF checkpoint directory — one read resolves family,
+            # config, and weights
+            import transformers as _tr
+
+            from ..models.hf_import import from_hf, resolve_module
+
+            hf_model = _tr.AutoModelForCausalLM.from_pretrained(
+                checkpoint, local_files_only=True, torch_dtype="float32")
+            fam_name = hf_model.config.model_type
+            model_cfg, params = from_hf(hf_model, fam_name)
+            model = resolve_module(fam_name)
     if isinstance(config, dict) or config is None:
         config = InferenceConfig.from_dict({**(config or {}), **kwargs})
     if family is None and model is not None and model_cfg is None \

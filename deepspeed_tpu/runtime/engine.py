@@ -362,7 +362,8 @@ class DeepSpeedTPUEngine:
             groups = [{k: v for k, v in g.items() if k != "lr"}
                       for g in groups]
             self.optimizer = grouped_optimizer(name, ptree, groups, **kwargs)
-            self.base_lr = float(lr)
+            # guard lr=0 (freeze): base_lr=0 would make lr_scale 0/0 = NaN
+            self.base_lr = float(lr) or 1.0
         self._train_step = None  # recompile with the new schedule
 
     def get_mom(self) -> List[float]:

@@ -200,3 +200,83 @@ def test_v1_tensor_parallel_sharding(tiny):
                             config={"dtype": "float32"})
     ref = single.generate(np.array([[1, 2, 3]], np.int32), max_new_tokens=3)
     np.testing.assert_array_equal(out, ref)
+
+
+def test_init_inference_from_engine_checkpoint(tmp_path, devices8):
+    """checkpoint= pointing at an engine save dir loads the weights
+    (reference inference/engine.py:303 checkpoint loading)."""
+    import deepspeed_tpu as dst
+    from deepspeed_tpu.comm import mesh as mesh_lib
+    from deepspeed_tpu.models import llama
+
+    mesh_lib.set_mesh(None)
+    cfg = llama.LlamaConfig.tiny()
+    engine, *_ = dst.initialize(
+        model=llama.model_spec(cfg, compute_dtype=jnp.float32),
+        config={"train_batch_size": 8,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 0}})
+    engine.train_batch({"tokens": np.zeros((8, 17), np.int32)})
+    engine.save_checkpoint(str(tmp_path), tag="serve")
+    trained_w = np.asarray(engine.state.params["layers"]["wq"])
+
+    mesh_lib.set_mesh(None)
+    eng = dst.init_inference(llama, model_cfg=cfg,
+                             checkpoint=str(tmp_path),
+                             config={"dtype": "float32"})
+    np.testing.assert_allclose(np.asarray(eng.params["layers"]["wq"]),
+                               trained_w, rtol=1e-6)
+    out = eng.generate(np.array([[1, 2, 3]], np.int32), max_new_tokens=3)
+    assert out.shape == (1, 3)
+
+
+def test_init_inference_from_hf_checkpoint_dir(tmp_path):
+    """checkpoint= pointing at a local HF save_pretrained dir."""
+    import deepspeed_tpu as dst
+    import torch
+    import transformers
+    from deepspeed_tpu.comm import mesh as mesh_lib
+
+    hf_cfg = transformers.GPT2Config(vocab_size=64, n_embd=32, n_layer=1,
+                                     n_head=2, n_positions=32)
+    torch.manual_seed(42)
+    hf = transformers.GPT2LMHeadModel(hf_cfg).eval()
+    hf.save_pretrained(str(tmp_path / "gpt2"))
+
+    mesh_lib.set_mesh(None)
+    eng = dst.init_inference(checkpoint=str(tmp_path / "gpt2"),
+                             config={"dtype": "float32"})
+    prompt = np.array([[5, 9]], np.int32)
+    ours = eng.generate(prompt, max_new_tokens=4, temperature=0.0)
+    with torch.no_grad():
+        ref = hf.generate(torch.tensor(prompt), max_new_tokens=4,
+                          do_sample=False, pad_token_id=0).numpy()
+    np.testing.assert_array_equal(ours, ref[:, 2:])
+
+
+def test_init_inference_from_universal_checkpoint(tmp_path, devices8):
+    """checkpoint= prefers the topology-free universal fragments when
+    present (multi-host-safe path)."""
+    import deepspeed_tpu as dst
+    from deepspeed_tpu.comm import mesh as mesh_lib
+    from deepspeed_tpu.models import llama
+    from deepspeed_tpu.runtime.checkpoint.universal import ds_to_universal
+
+    mesh_lib.set_mesh(None)
+    cfg = llama.LlamaConfig.tiny()
+    engine, *_ = dst.initialize(
+        model=llama.model_spec(cfg, compute_dtype=jnp.float32),
+        config={"train_batch_size": 8,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 3}})
+    engine.train_batch({"tokens": np.zeros((8, 17), np.int32)})
+    engine.save_checkpoint(str(tmp_path), tag="u")
+    ds_to_universal(str(tmp_path), tag="u")
+    trained_w = np.asarray(engine.state.params["layers"]["wq"])
+
+    mesh_lib.set_mesh(None)
+    eng = dst.init_inference(llama, model_cfg=cfg,
+                             checkpoint=str(tmp_path),
+                             config={"dtype": "float32"})
+    np.testing.assert_allclose(np.asarray(eng.params["layers"]["wq"]),
+                               trained_w, rtol=1e-6)
