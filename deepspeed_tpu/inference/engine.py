@@ -342,7 +342,10 @@ def init_inference(model=None, config=None, *, family: Optional[ModelFamily] = N
                                         resolve_tag(checkpoint, None))
             universal = _os.path.join(tag_dir, "universal")
             if _os.path.exists(universal) and model is not None:
-                # topology-free path: resharded restore via a shape template
+                # topology-free path: resharded restore via a shape template.
+                # Restored to HOST memory (not replicated HBM — a model that
+                # needs TP to fit would OOM before the engine reshards it);
+                # the engine device_puts with its real shardings afterwards.
                 from functools import partial as _partial
 
                 from ..runtime.checkpoint.universal import load_universal
@@ -350,30 +353,39 @@ def init_inference(model=None, config=None, *, family: Optional[ModelFamily] = N
                 shapes = jax.eval_shape(_partial(model.init, model_cfg),
                                         jax.random.PRNGKey(0))
                 rep = get_mesh().replicated()
+                try:
+                    host = rep.with_memory_kind("pinned_host")
+                except Exception:  # backend without host memory kinds (CPU)
+                    host = rep
                 template = jax.tree.map(
                     lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype,
-                                                   sharding=rep), shapes)
+                                                   sharding=host), shapes)
                 params, _, _ = load_universal(universal, template, None)
+            elif jax.process_count() > 1:
+                raise ValueError(
+                    "multi-host init_inference(checkpoint=) needs a "
+                    "universal checkpoint (bin/dstpu_to_universal) AND the "
+                    "model module + model_cfg for the restore template — "
+                    "the raw state tree cannot be reconstituted across "
+                    "processes")
             else:
-                if jax.process_count() > 1:
-                    raise ValueError(
-                        "multi-host init_inference(checkpoint=) needs a "
-                        "universal checkpoint (bin/dstpu_to_universal) — the "
-                        "raw state tree cannot be reconstituted across "
-                        "processes without one")
                 params = read_state_tree(tag_dir)["params"]
         else:
             # local HF checkpoint directory — one read resolves family,
-            # config, and weights
-            import transformers as _tr
+            # config, and weights (shared loader; falls back to AutoModel
+            # for encoder/contrastive families)
+            from ..models.hf_import import (load_hf_checkpoint_with_family,
+                                            resolve_module)
 
-            from ..models.hf_import import from_hf, resolve_module
-
-            hf_model = _tr.AutoModelForCausalLM.from_pretrained(
-                checkpoint, local_files_only=True, torch_dtype="float32")
-            fam_name = hf_model.config.model_type
-            model_cfg, params = from_hf(hf_model, fam_name)
+            fam_name, model_cfg, params = \
+                load_hf_checkpoint_with_family(checkpoint)
             model = resolve_module(fam_name)
+            if not hasattr(model, "apply_cached"):
+                raise ValueError(
+                    f"family '{fam_name}' is not generative (no KV-cached "
+                    f"decode path) — use its module API directly "
+                    f"(e.g. models/{fam_name}.encode_*) instead of "
+                    f"init_inference")
     if isinstance(config, dict) or config is None:
         config = InferenceConfig.from_dict({**(config or {}), **kwargs})
     if family is None and model is not None and model_cfg is None \

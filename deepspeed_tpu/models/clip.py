@@ -1,0 +1,292 @@
+"""CLIP (contrastive text–image), written TPU-first.
+
+Reference parity: the reference serves CLIP through a v1 injection policy
+(``module_inject/containers/clip.py``) as part of its stable-diffusion
+stack. Here CLIP is a first-class family: both towers are pre-LN ViT-style
+encoders (quick-gelu MLPs) sharing one block implementation — the vision
+tower embeds image patches with an MXU-friendly unfold+matmul instead of a
+conv — plus the contrastive head (projections + learned logit scale).
+
+Same TPU shape as the sibling models: stacked layers under ``lax.scan``,
+logical axis names per param for the sharding-rule engine.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops.attention import attention
+from ..ops.embedding import embedding_lookup
+from ..ops.norms import layer_norm
+
+Params = Dict[str, Any]
+
+
+@dataclass(frozen=True)
+class CLIPTowerConfig:
+    hidden_size: int = 512
+    intermediate_size: int = 2048
+    num_layers: int = 12
+    num_heads: int = 8
+    layer_norm_eps: float = 1e-5
+    hidden_act: str = "quick_gelu"   # OpenAI CLIP; LAION/OpenCLIP use 'gelu'
+
+    def __post_init__(self):
+        if self.hidden_act not in ("quick_gelu", "gelu"):
+            raise ValueError(f"unsupported CLIP activation "
+                             f"{self.hidden_act!r} (quick_gelu | gelu)")
+
+    @property
+    def head_size(self) -> int:
+        return self.hidden_size // self.num_heads
+
+
+@dataclass(frozen=True)
+class CLIPConfig:
+    # text tower
+    vocab_size: int = 49408
+    max_seq_len: int = 77
+    eos_token_id: int = 49407
+    text: CLIPTowerConfig = CLIPTowerConfig()
+    # vision tower
+    image_size: int = 224
+    patch_size: int = 32
+    num_channels: int = 3
+    vision: CLIPTowerConfig = CLIPTowerConfig(hidden_size=768,
+                                              intermediate_size=3072,
+                                              num_heads=12)
+    projection_dim: int = 512
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @classmethod
+    def tiny(cls, **kw) -> "CLIPConfig":
+        base = dict(
+            vocab_size=64, max_seq_len=16, eos_token_id=63,
+            text=CLIPTowerConfig(hidden_size=32, intermediate_size=64,
+                                 num_layers=2, num_heads=2),
+            image_size=32, patch_size=8,
+            vision=CLIPTowerConfig(hidden_size=32, intermediate_size=64,
+                                   num_layers=2, num_heads=2),
+            projection_dim=24)
+        base.update(kw)
+        return cls(**base)
+
+
+def _act(tcfg: CLIPTowerConfig, x):
+    if tcfg.hidden_act == "quick_gelu":
+        return x * jax.nn.sigmoid(1.702 * x)
+    return jax.nn.gelu(x, approximate=False)
+
+
+def _tower_init(cfg: CLIPTowerConfig, rng, dtype) -> Params:
+    h, i, L = cfg.hidden_size, cfg.intermediate_size, cfg.num_layers
+    ks = jax.random.split(rng, 6)
+
+    def normal(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32)
+                * fan_in ** -0.5).astype(dtype)
+
+    return {
+        "ln1_scale": jnp.ones((L, h), dtype), "ln1_bias": jnp.zeros((L, h), dtype),
+        "wq": normal(ks[0], (L, h, h), h), "bq": jnp.zeros((L, h), dtype),
+        "wk": normal(ks[1], (L, h, h), h), "bk": jnp.zeros((L, h), dtype),
+        "wv": normal(ks[2], (L, h, h), h), "bv": jnp.zeros((L, h), dtype),
+        "wo": normal(ks[3], (L, h, h), h), "bo": jnp.zeros((L, h), dtype),
+        "ln2_scale": jnp.ones((L, h), dtype), "ln2_bias": jnp.zeros((L, h), dtype),
+        "w_up": normal(ks[4], (L, h, i), h), "b_up": jnp.zeros((L, i), dtype),
+        "w_down": normal(ks[5], (L, i, h), i), "b_down": jnp.zeros((L, h), dtype),
+    }
+
+
+def _tower_axes(cfg: CLIPTowerConfig) -> Params:
+    return {
+        "ln1_scale": ("layers", "embed"), "ln1_bias": ("layers", "embed"),
+        "wq": ("layers", "embed", "heads"), "bq": ("layers", "heads"),
+        "wk": ("layers", "embed", "heads"), "bk": ("layers", "heads"),
+        "wv": ("layers", "embed", "heads"), "bv": ("layers", "heads"),
+        "wo": ("layers", "heads", "embed"), "bo": ("layers", "embed"),
+        "ln2_scale": ("layers", "embed"), "ln2_bias": ("layers", "embed"),
+        "w_up": ("layers", "embed", "mlp"), "b_up": ("layers", "mlp"),
+        "w_down": ("layers", "mlp", "embed"), "b_down": ("layers", "embed"),
+    }
+
+
+def init(cfg: CLIPConfig, rng: jax.Array, dtype=jnp.float32) -> Params:
+    kt, kv, kp = jax.random.split(rng, 3)
+    h_t, h_v, p = cfg.text.hidden_size, cfg.vision.hidden_size, cfg.projection_dim
+    patch_dim = cfg.num_channels * cfg.patch_size ** 2
+
+    def normal(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32)
+                * fan_in ** -0.5).astype(dtype)
+
+    ks = jax.random.split(kp, 5)
+    return {
+        "text": {
+            "embed": normal(kt, (cfg.vocab_size, h_t), h_t),
+            "pos_embed": normal(ks[0], (cfg.max_seq_len, h_t), h_t),
+            "layers": _tower_init(cfg.text, jax.random.fold_in(kt, 1), dtype),
+            "final_ln_scale": jnp.ones((h_t,), dtype),
+            "final_ln_bias": jnp.zeros((h_t,), dtype),
+        },
+        "vision": {
+            "class_embed": jnp.zeros((h_v,), dtype),
+            "patch_embed": normal(kv, (patch_dim, h_v), patch_dim),
+            "pos_embed": normal(ks[1], (cfg.num_patches + 1, h_v), h_v),
+            "pre_ln_scale": jnp.ones((h_v,), dtype),
+            "pre_ln_bias": jnp.zeros((h_v,), dtype),
+            "layers": _tower_init(cfg.vision, jax.random.fold_in(kv, 1), dtype),
+            "post_ln_scale": jnp.ones((h_v,), dtype),
+            "post_ln_bias": jnp.zeros((h_v,), dtype),
+        },
+        "text_projection": normal(ks[2], (h_t, p), h_t),
+        "visual_projection": normal(ks[3], (h_v, p), h_v),
+        "logit_scale": jnp.asarray(2.6592, dtype),  # ln(1/0.07), HF init
+    }
+
+
+def param_logical_axes(cfg: CLIPConfig) -> Params:
+    return {
+        "text": {
+            "embed": ("vocab", "embed"), "pos_embed": (None, "embed"),
+            "layers": _tower_axes(cfg.text),
+            "final_ln_scale": ("embed",), "final_ln_bias": ("embed",),
+        },
+        "vision": {
+            "class_embed": ("embed",),
+            "patch_embed": (None, "embed"),
+            "pos_embed": (None, "embed"),
+            "pre_ln_scale": ("embed",), "pre_ln_bias": ("embed",),
+            "layers": _tower_axes(cfg.vision),
+            "post_ln_scale": ("embed",), "post_ln_bias": ("embed",),
+        },
+        "text_projection": ("embed", None),
+        "visual_projection": ("embed", None),
+        "logit_scale": (),
+    }
+
+
+def _block(tcfg: CLIPTowerConfig, x: jnp.ndarray, layer: Params,
+           causal: bool) -> jnp.ndarray:
+    b, s, h = x.shape
+    nh, hd = tcfg.num_heads, tcfg.head_size
+    eps = tcfg.layer_norm_eps
+    y = layer_norm(x, layer["ln1_scale"], layer["ln1_bias"], eps)
+    q = (y @ layer["wq"] + layer["bq"]).reshape(b, s, nh, hd)
+    k = (y @ layer["wk"] + layer["bk"]).reshape(b, s, nh, hd)
+    v = (y @ layer["wv"] + layer["bv"]).reshape(b, s, nh, hd)
+    a = attention(q, k, v, causal=causal)
+    x = x + a.reshape(b, s, h) @ layer["wo"] + layer["bo"]
+    y = layer_norm(x, layer["ln2_scale"], layer["ln2_bias"], eps)
+    return x + _act(tcfg, y @ layer["w_up"] + layer["b_up"]) @ layer["w_down"] \
+        + layer["b_down"]
+
+
+def _run_tower(tcfg: CLIPTowerConfig, layers: Params, x: jnp.ndarray,
+               causal: bool) -> jnp.ndarray:
+    def body(x, layer):
+        return _block(tcfg, x, layer, causal), None
+
+    x, _ = lax.scan(body, x, layers)
+    return x
+
+
+def _cast(tree, dtype):
+    return jax.tree.map(lambda p: p.astype(dtype)
+                        if jnp.issubdtype(p.dtype, jnp.floating) else p, tree)
+
+
+def encode_text(cfg: CLIPConfig, params: Params, tokens: jnp.ndarray, *,
+                compute_dtype=jnp.float32, project: bool = True) -> jnp.ndarray:
+    """tokens [b, s] → pooled text features [b, proj] (EOS-position pooling,
+    HF CLIPTextModel semantics)."""
+    tp = _cast(params["text"], compute_dtype)
+    s = tokens.shape[1]
+    x = embedding_lookup(tp["embed"], tokens, compute_dtype) \
+        + tp["pos_embed"][:s][None]
+    x = _run_tower(cfg.text, tp["layers"], x, causal=True)
+    x = layer_norm(x, tp["final_ln_scale"], tp["final_ln_bias"],
+                   cfg.text.layer_norm_eps)
+    eos_pos = jnp.argmax((tokens == cfg.eos_token_id).astype(jnp.int32),
+                         axis=-1)
+    pooled = x[jnp.arange(x.shape[0]), eos_pos]
+    if not project:
+        return pooled
+    return pooled @ params["text_projection"].astype(compute_dtype)
+
+
+def _patchify(cfg: CLIPConfig, images: jnp.ndarray) -> jnp.ndarray:
+    """[b, c, H, W] → [b, num_patches, c*p*p] matching conv-with-stride-p
+    weight layout (out_ch, c, p, p) flattened per patch."""
+    b, c, H, W = images.shape
+    p = cfg.patch_size
+    x = images.reshape(b, c, H // p, p, W // p, p)
+    x = x.transpose(0, 2, 4, 1, 3, 5)          # [b, gh, gw, c, p, p]
+    return x.reshape(b, (H // p) * (W // p), c * p * p)
+
+
+def encode_image(cfg: CLIPConfig, params: Params, images: jnp.ndarray, *,
+                 compute_dtype=jnp.float32, project: bool = True) -> jnp.ndarray:
+    """images [b, c, H, W] → pooled image features [b, proj]."""
+    vp = _cast(params["vision"], compute_dtype)
+    patches = _patchify(cfg, images.astype(compute_dtype)) @ vp["patch_embed"]
+    b = patches.shape[0]
+    cls = jnp.broadcast_to(vp["class_embed"],
+                           (b, 1, cfg.vision.hidden_size))
+    x = jnp.concatenate([cls, patches], axis=1) + vp["pos_embed"][None]
+    x = layer_norm(x, vp["pre_ln_scale"], vp["pre_ln_bias"],
+                   cfg.vision.layer_norm_eps)
+    x = _run_tower(cfg.vision, vp["layers"], x, causal=False)
+    pooled = layer_norm(x[:, 0], vp["post_ln_scale"], vp["post_ln_bias"],
+                        cfg.vision.layer_norm_eps)
+    if not project:
+        return pooled
+    return pooled @ params["visual_projection"].astype(compute_dtype)
+
+
+def apply(cfg: CLIPConfig, params: Params, tokens: jnp.ndarray,
+          images: jnp.ndarray, *,
+          compute_dtype=jnp.float32) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """→ (logits_per_text [bt, bi], logits_per_image [bi, bt])."""
+    t = encode_text(cfg, params, tokens, compute_dtype=compute_dtype)
+    v = encode_image(cfg, params, images, compute_dtype=compute_dtype)
+    t = t / jnp.linalg.norm(t, axis=-1, keepdims=True)
+    v = v / jnp.linalg.norm(v, axis=-1, keepdims=True)
+    scale = jnp.exp(params["logit_scale"].astype(compute_dtype))
+    logits_per_text = scale * t @ v.T
+    return logits_per_text, logits_per_text.T
+
+
+def loss_fn(cfg: CLIPConfig, params: Params, batch: Dict[str, jnp.ndarray],
+            *, compute_dtype=jnp.float32):
+    """Symmetric InfoNCE over in-batch pairs (CLIP pretraining loss)."""
+    lt, li = apply(cfg, params, batch["tokens"], batch["images"],
+                   compute_dtype=compute_dtype)
+    n = lt.shape[0]
+    labels = jnp.arange(n)
+    ce = lambda lg: -jnp.mean(  # noqa: E731
+        jnp.take_along_axis(jax.nn.log_softmax(lg, axis=-1),
+                            labels[:, None], axis=-1))
+    loss = 0.5 * (ce(lt) + ce(li))
+    return loss, {"loss": loss}
+
+
+def model_spec(cfg: CLIPConfig, compute_dtype=jnp.float32):
+    from ..runtime.engine import ModelSpec
+
+    return ModelSpec(
+        name="clip",
+        init_fn=lambda rng: init(cfg, rng),
+        loss_fn=lambda params, batch: loss_fn(cfg, params, batch,
+                                              compute_dtype=compute_dtype),
+        logical_axes=param_logical_axes(cfg),
+        pipeline_capable=False,
+    )

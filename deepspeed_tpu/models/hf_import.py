@@ -12,7 +12,8 @@ Supported families: Llama/Mistral/Qwen2/Phi-3 (→ ``models/llama``; fused
 QKV/gate-up checkpoints are split), GPT-2 (→ ``models/gpt``),
 Mixtral/Qwen2-MoE (→ ``models/mixtral``), Falcon (→ ``models/falcon``), OPT (→ ``models/gpt``,
 ReLU/pre-LN), GPT-NeoX/GPT-J (→ ``models/gptneox``), BLOOM (→ ``models/bloom``,
-ALiBi), BERT/DistilBERT (→ ``models/bert``), Megatron-GPT state dicts
+ALiBi), BERT/DistilBERT (→ ``models/bert``), CLIP (→ ``models/clip``,
+both towers + contrastive head), Megatron-GPT state dicts
 (``megatron_gpt_params_from_sd``, composing with the TP-degree-changing
 ``SDLoaderFactory``). Accepts a live
 ``transformers`` model, a state-dict mapping, or a local checkpoint directory
@@ -978,11 +979,103 @@ def megatron_gpt_params_from_sd(sd, cfg=None, ckpt_ver=None) -> Params:
     return params
 
 
+def clip_config_from_hf(hf_config) -> "Any":
+    from .clip import CLIPConfig, CLIPTowerConfig
+
+    t, v = hf_config.text_config, hf_config.vision_config
+    return CLIPConfig(
+        vocab_size=t.vocab_size,
+        max_seq_len=t.max_position_embeddings,
+        eos_token_id=t.eos_token_id,
+        text=CLIPTowerConfig(hidden_size=t.hidden_size,
+                             intermediate_size=t.intermediate_size,
+                             num_layers=t.num_hidden_layers,
+                             num_heads=t.num_attention_heads,
+                             layer_norm_eps=float(t.layer_norm_eps),
+                             hidden_act=getattr(t, "hidden_act",
+                                                "quick_gelu")),
+        image_size=v.image_size,
+        patch_size=v.patch_size,
+        num_channels=getattr(v, "num_channels", 3),
+        vision=CLIPTowerConfig(hidden_size=v.hidden_size,
+                               intermediate_size=v.intermediate_size,
+                               num_layers=v.num_hidden_layers,
+                               num_heads=v.num_attention_heads,
+                               layer_norm_eps=float(v.layer_norm_eps),
+                               hidden_act=getattr(v, "hidden_act",
+                                                  "quick_gelu")),
+        projection_dim=hf_config.projection_dim,
+    )
+
+
+def _clip_tower_from_hf(sd, prefix: str, L: int) -> Params:
+    lay = prefix + "encoder.layers.{i}."
+    return {
+        "ln1_scale": _stack(sd, lay + "layer_norm1.weight", L),
+        "ln1_bias": _stack(sd, lay + "layer_norm1.bias", L),
+        "wq": _stack(sd, lay + "self_attn.q_proj.weight", L, transpose=True),
+        "bq": _stack(sd, lay + "self_attn.q_proj.bias", L),
+        "wk": _stack(sd, lay + "self_attn.k_proj.weight", L, transpose=True),
+        "bk": _stack(sd, lay + "self_attn.k_proj.bias", L),
+        "wv": _stack(sd, lay + "self_attn.v_proj.weight", L, transpose=True),
+        "bv": _stack(sd, lay + "self_attn.v_proj.bias", L),
+        "wo": _stack(sd, lay + "self_attn.out_proj.weight", L, transpose=True),
+        "bo": _stack(sd, lay + "self_attn.out_proj.bias", L),
+        "ln2_scale": _stack(sd, lay + "layer_norm2.weight", L),
+        "ln2_bias": _stack(sd, lay + "layer_norm2.bias", L),
+        "w_up": _stack(sd, lay + "mlp.fc1.weight", L, transpose=True),
+        "b_up": _stack(sd, lay + "mlp.fc1.bias", L),
+        "w_down": _stack(sd, lay + "mlp.fc2.weight", L, transpose=True),
+        "b_down": _stack(sd, lay + "mlp.fc2.bias", L),
+    }
+
+
+def clip_params_from_hf(src, cfg=None) -> Params:
+    """HF CLIPModel → ``models/clip`` pytree. The vision conv patch embed
+    (out, c, p, p) flattens to the unfold+matmul layout [c·p·p, out]."""
+    if cfg is None:
+        if not hasattr(src, "config"):
+            raise ValueError("clip_params_from_hf needs cfg= when given a "
+                             "bare state dict (no .config to derive it from)")
+        cfg = clip_config_from_hf(src.config)
+    sd = _normalize_state_dict(src)
+    h_v = cfg.vision.hidden_size
+    params: Params = {
+        "text": {
+            "embed": sd["text_model.embeddings.token_embedding.weight"],
+            "pos_embed": sd["text_model.embeddings.position_embedding.weight"],
+            "layers": _clip_tower_from_hf(sd, "text_model.",
+                                          cfg.text.num_layers),
+            "final_ln_scale": sd["text_model.final_layer_norm.weight"],
+            "final_ln_bias": sd["text_model.final_layer_norm.bias"],
+        },
+        "vision": {
+            "class_embed": sd["vision_model.embeddings.class_embedding"],
+            "patch_embed": sd["vision_model.embeddings.patch_embedding.weight"]
+            .reshape(h_v, -1).T,
+            "pos_embed": sd["vision_model.embeddings.position_embedding.weight"],
+            "pre_ln_scale": sd["vision_model.pre_layrnorm.weight"],
+            "pre_ln_bias": sd["vision_model.pre_layrnorm.bias"],
+            "layers": _clip_tower_from_hf(sd, "vision_model.",
+                                          cfg.vision.num_layers),
+            "post_ln_scale": sd["vision_model.post_layernorm.weight"],
+            "post_ln_bias": sd["vision_model.post_layernorm.bias"],
+        },
+        "text_projection": sd["text_projection.weight"].T,
+        "visual_projection": sd["visual_projection.weight"].T,
+        "logit_scale": sd["logit_scale"],
+    }
+    log_dist(f"imported HF clip weights: text {cfg.text.num_layers}L / "
+             f"vision {cfg.vision.num_layers}L")
+    return params
+
+
 def resolve_module(family: str):
     """Family name → the ``deepspeed_tpu.models`` module that executes it."""
     from . import bloom, falcon, gpt, gptneox, llama, mixtral
 
     from . import bert as bert_mod
+    from . import clip as clip_mod
 
     modules = {
         "llama": llama, "mistral": llama, "qwen2": llama, "phi3": llama,
@@ -992,6 +1085,7 @@ def resolve_module(family: str):
         "gpt_neox": gptneox, "gptj": gptneox,
         "bloom": bloom,
         "bert": bert_mod, "distilbert": bert_mod,
+        "clip": clip_mod,
     }
     if family not in modules:
         raise ValueError(f"unsupported HF family '{family}' "
@@ -1040,6 +1134,7 @@ _FAMILIES = {
     "bloom": (bloom_config_from_hf, bloom_params_from_hf),
     "bert": (bert_config_from_hf, bert_params_from_hf),
     "distilbert": (distilbert_config_from_hf, distilbert_params_from_hf),
+    "clip": (clip_config_from_hf, clip_params_from_hf),
 }
 
 
@@ -1055,10 +1150,26 @@ def from_hf(model, family: Optional[str] = None):
     return cfg, params_fn(model, cfg)
 
 
-def load_hf_checkpoint(path: str, family: Optional[str] = None):
-    """Load a LOCAL HF checkpoint directory (no network) and convert."""
+def load_hf_checkpoint_with_family(path: str,
+                                   family: Optional[str] = None):
+    """Load a LOCAL HF checkpoint directory (no network) → (family_name,
+    our_config, our_params). Causal-LM head classes are tried first; encoder
+    and contrastive families (bert/distilbert/clip) fall back to the base
+    AutoModel class."""
     import transformers
 
-    model = transformers.AutoModelForCausalLM.from_pretrained(
-        path, local_files_only=True, torch_dtype="float32")
-    return from_hf(model, family)
+    try:
+        model = transformers.AutoModelForCausalLM.from_pretrained(
+            path, local_files_only=True, torch_dtype="float32")
+    except ValueError:
+        model = transformers.AutoModel.from_pretrained(
+            path, local_files_only=True, torch_dtype="float32")
+    family = family or model.config.model_type
+    cfg, params = from_hf(model, family)
+    return family, cfg, params
+
+
+def load_hf_checkpoint(path: str, family: Optional[str] = None):
+    """Load a LOCAL HF checkpoint directory (no network) and convert."""
+    _, cfg, params = load_hf_checkpoint_with_family(path, family)
+    return cfg, params

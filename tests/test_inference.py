@@ -280,3 +280,25 @@ def test_init_inference_from_universal_checkpoint(tmp_path, devices8):
                              config={"dtype": "float32"})
     np.testing.assert_allclose(np.asarray(eng.params["layers"]["wq"]),
                                trained_w, rtol=1e-6)
+
+
+def test_init_inference_rejects_non_generative_family(tmp_path):
+    """A CLIP checkpoint dir resolves but is refused with a clear message
+    (no KV-cached decode path)."""
+    import deepspeed_tpu as dst
+    import torch
+    import transformers
+
+    hf_cfg = transformers.CLIPConfig(
+        text_config={"vocab_size": 64, "hidden_size": 32,
+                     "intermediate_size": 64, "num_hidden_layers": 1,
+                     "num_attention_heads": 2,
+                     "max_position_embeddings": 16, "eos_token_id": 63},
+        vision_config={"hidden_size": 32, "intermediate_size": 64,
+                       "num_hidden_layers": 1, "num_attention_heads": 2,
+                       "image_size": 16, "patch_size": 8},
+        projection_dim=16)
+    torch.manual_seed(44)
+    transformers.CLIPModel(hf_cfg).save_pretrained(str(tmp_path / "clip"))
+    with pytest.raises(ValueError, match="not generative"):
+        dst.init_inference(checkpoint=str(tmp_path / "clip"), config={})
