@@ -199,9 +199,7 @@ def _run_tower(tcfg: CLIPTowerConfig, layers: Params, x: jnp.ndarray,
     return x
 
 
-def _cast(tree, dtype):
-    return jax.tree.map(lambda p: p.astype(dtype)
-                        if jnp.issubdtype(p.dtype, jnp.floating) else p, tree)
+from ..utils.tree import cast_floating as _cast  # noqa: E402
 
 
 def encode_text(cfg: CLIPConfig, params: Params, tokens: jnp.ndarray, *,
@@ -215,8 +213,15 @@ def encode_text(cfg: CLIPConfig, params: Params, tokens: jnp.ndarray, *,
     x = _run_tower(cfg.text, tp["layers"], x, causal=True)
     x = layer_norm(x, tp["final_ln_scale"], tp["final_ln_bias"],
                    cfg.text.layer_norm_eps)
-    eos_pos = jnp.argmax((tokens == cfg.eos_token_id).astype(jnp.int32),
-                         axis=-1)
+    if cfg.eos_token_id == 2:
+        # legacy OpenAI checkpoints carry eos_token_id=2 in their configs
+        # while the actual EOT token is the vocab max — HF's
+        # CLIPTextTransformer keeps this exact special case; without it,
+        # pooling would match token 2 (never present) and select position 0
+        eos_pos = jnp.argmax(tokens, axis=-1)
+    else:
+        eos_pos = jnp.argmax((tokens == cfg.eos_token_id).astype(jnp.int32),
+                             axis=-1)
     pooled = x[jnp.arange(x.shape[0]), eos_pos]
     if not project:
         return pooled

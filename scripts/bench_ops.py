@@ -1,0 +1,111 @@
+#!/usr/bin/env python
+"""Per-op microbenchmarks on the local accelerator — the tuning companion to
+bench.py. Each sweep prints one JSON line per configuration so results can be
+diffed across block sizes / shapes (used to produce PERF.md's tables).
+
+Usage (on TPU):
+    python scripts/bench_ops.py flash --seq 2048 --blocks 256,512
+    python scripts/bench_ops.py matmul --sizes 1024,2048,4096
+    python scripts/bench_ops.py decode
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _amortized(fn, iters=20, warmup=3):
+    """Median-free amortized timing: chain iters calls, one device sync."""
+    import jax
+
+    for _ in range(warmup):
+        out = fn()
+    jax.block_until_ready(out)
+    # scalar read drains the dispatch queue even where block_until_ready
+    # is a no-op (axon tunnel)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn()
+    float(jax.numpy.sum(out))
+    return (time.perf_counter() - t0) / iters
+
+
+def bench_flash(args):
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+
+    b, h, d = args.batch, args.heads, args.head_dim
+    for blk in [int(x) for x in args.blocks.split(",")]:
+        os.environ["DSTPU_FLASH_BLOCK"] = str(blk)
+        for seq in [int(x) for x in args.seqs.split(",")]:
+            q = jnp.ones((b, seq, h, d), jnp.bfloat16)
+            f = jax.jit(lambda q: flash_attention(q, q, q, causal=True))
+            dt = _amortized(lambda: f(q))
+            flops = 2 * 2 * b * h * seq * seq * d / 2  # causal half
+            print(json.dumps({"op": "flash_fwd", "block": blk, "seq": seq,
+                              "ms": round(dt * 1e3, 3),
+                              "tflops": round(flops / dt / 1e12, 2)}))
+
+
+def bench_matmul(args):
+    import jax
+    import jax.numpy as jnp
+
+    M = args.tokens
+    for n in [int(x) for x in args.sizes.split(",")]:
+        a = jnp.ones((M, n), jnp.bfloat16)
+        w = jnp.ones((n, n), jnp.bfloat16)
+        f = jax.jit(lambda a, w: a @ w)
+        dt = _amortized(lambda: f(a, w))
+        flops = 2 * M * n * n
+        print(json.dumps({"op": "matmul", "mkn": [M, n, n],
+                          "ms": round(dt * 1e3, 3),
+                          "tflops": round(flops / dt / 1e12, 2)}))
+
+
+def bench_decode(args):
+    import numpy as np
+
+    from bench import bench_decode as _bd, bench_model_config, init_backend
+
+    jax = init_backend()
+    mcfg = bench_model_config("tpu" in jax.default_backend())
+    print(json.dumps({"op": "decode",
+                      "tok_per_sec": _bd(jax, mcfg, batch=args.batch)}))
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="bench_ops")
+    p.add_argument("--cpu", action="store_true",
+                   help="force the CPU backend (the axon sitecustomize "
+                        "ignores JAX_PLATFORMS; this flag works)")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    f = sub.add_parser("flash")
+    f.add_argument("--batch", type=int, default=8)
+    f.add_argument("--heads", type=int, default=8)
+    f.add_argument("--head-dim", type=int, default=128)
+    f.add_argument("--seqs", default="1024,2048,4096")
+    f.add_argument("--blocks", default="256,512")
+    m = sub.add_parser("matmul")
+    m.add_argument("--tokens", type=int, default=16384)
+    m.add_argument("--sizes", default="1024,2048,4096,8192")
+    d = sub.add_parser("decode")
+    d.add_argument("--batch", type=int, default=16)
+    args = p.parse_args(argv)
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    {"flash": bench_flash, "matmul": bench_matmul,
+     "decode": bench_decode}[args.cmd](args)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -681,3 +681,31 @@ def test_clip_contrastive_training(devices8):
              "images": rs.randn(8, 3, 32, 32).astype(np.float32)}
     losses = [float(engine.train_batch(batch).loss) for _ in range(6)]
     assert losses[-1] < losses[0] - 0.3, losses
+
+
+def test_clip_legacy_eos_pooling():
+    """OpenAI checkpoints carry eos_token_id=2 while the real EOT is the
+    vocab max — parity with HF's legacy special case."""
+    from deepspeed_tpu.models import clip as clip_mod
+
+    hf_cfg = transformers.CLIPConfig(
+        text_config={"vocab_size": 64, "hidden_size": 32,
+                     "intermediate_size": 64, "num_hidden_layers": 2,
+                     "num_attention_heads": 2,
+                     "max_position_embeddings": 16, "eos_token_id": 2},
+        vision_config={"hidden_size": 32, "intermediate_size": 64,
+                       "num_hidden_layers": 1, "num_attention_heads": 2,
+                       "image_size": 16, "patch_size": 8},
+        projection_dim=16)
+    torch.manual_seed(35)
+    hf = transformers.CLIPModel(hf_cfg).eval()
+    cfg, params = from_hf(hf)
+    assert cfg.eos_token_id == 2
+    rs = np.random.RandomState(35)
+    tokens = rs.randint(3, 60, (2, 10))
+    tokens[:, -2] = 63  # EOT = vocab max, NOT at the last position
+    with torch.no_grad():
+        ref = hf.get_text_features(torch.tensor(tokens)).numpy()
+    ours = np.asarray(clip_mod.encode_text(cfg, params,
+                                           jnp.asarray(tokens)))
+    np.testing.assert_allclose(ours, ref, rtol=2e-3, atol=2e-3)
