@@ -18,20 +18,13 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def _amortized(fn, iters=20, warmup=3):
-    """Median-free amortized timing: chain iters calls, one device sync."""
-    import jax
+def _chain_bench(op, args, flops):
+    """Shared methodology with scripts/profile_ops.py: REPS data-dependent
+    iterations inside ONE jit (per-dispatch tunnel overhead excluded),
+    drained by a scalar read (block_until_ready is a no-op on the tunnel)."""
+    from profile_ops import chain_bench
 
-    for _ in range(warmup):
-        out = fn()
-    jax.block_until_ready(out)
-    # scalar read drains the dispatch queue even where block_until_ready
-    # is a no-op (axon tunnel)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = fn()
-    float(jax.numpy.sum(out))
-    return (time.perf_counter() - t0) / iters
+    return chain_bench(op, args, flops)
 
 
 def bench_flash(args):
@@ -45,12 +38,14 @@ def bench_flash(args):
         os.environ["DSTPU_FLASH_BLOCK"] = str(blk)
         for seq in [int(x) for x in args.seqs.split(",")]:
             q = jnp.ones((b, seq, h, d), jnp.bfloat16)
-            f = jax.jit(lambda q: flash_attention(q, q, q, causal=True))
-            dt = _amortized(lambda: f(q))
             flops = 2 * 2 * b * h * seq * seq * d / 2  # causal half
+            dt, mfu = _chain_bench(
+                lambda k, qq: flash_attention(qq + 0 * k[0, 0, 0, 0], qq, qq,
+                                              causal=True), (q, q), flops)
             print(json.dumps({"op": "flash_fwd", "block": blk, "seq": seq,
                               "ms": round(dt * 1e3, 3),
-                              "tflops": round(flops / dt / 1e12, 2)}))
+                              "tflops": round(flops / dt / 1e12, 2),
+                              "mfu_vs_v5e": round(mfu, 3)}))
 
 
 def bench_matmul(args):
@@ -59,19 +54,17 @@ def bench_matmul(args):
 
     M = args.tokens
     for n in [int(x) for x in args.sizes.split(",")]:
-        a = jnp.ones((M, n), jnp.bfloat16)
         w = jnp.ones((n, n), jnp.bfloat16)
-        f = jax.jit(lambda a, w: a @ w)
-        dt = _amortized(lambda: f(a, w))
+        a = jnp.ones((M, n), jnp.bfloat16)
         flops = 2 * M * n * n
+        dt, mfu = _chain_bench(lambda w, a: a @ w, (w, a), flops)
         print(json.dumps({"op": "matmul", "mkn": [M, n, n],
                           "ms": round(dt * 1e3, 3),
-                          "tflops": round(flops / dt / 1e12, 2)}))
+                          "tflops": round(flops / dt / 1e12, 2),
+                          "mfu_vs_v5e": round(mfu, 3)}))
 
 
 def bench_decode(args):
-    import numpy as np
-
     from bench import bench_decode as _bd, bench_model_config, init_backend
 
     jax = init_backend()
@@ -102,6 +95,9 @@ def main(argv=None):
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+        # the decode path's subprocess probes don't see the in-process
+        # config — this env var makes them skip the accelerator probe too
+        os.environ["DSTPU_BENCH_FORCE_CPU"] = "1"
     {"flash": bench_flash, "matmul": bench_matmul,
      "decode": bench_decode}[args.cmd](args)
     return 0

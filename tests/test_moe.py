@@ -102,3 +102,30 @@ def test_mixtral_expert_params_sharded_over_expert_axis(devices8):
     w = engine.state.params["layers"]["moe"]["w_gate"]  # [L, E, H, I]
     spec_ = w.sharding.spec
     assert spec_[1] == "expert", spec_
+
+
+def test_ep_degree_loss_equivalence(devices8):
+    """Same model, same data: ep=1 (pure DP) vs ep=4 loss trajectories must
+    match — expert-parallel dispatch and the expert/non-expert grad paths
+    are layout changes, not math changes (reference engine.py:3088-3130
+    separate expert grad reduction)."""
+    mcfg = mixtral.MixtralConfig.tiny()
+    tokens = np.asarray(jax.random.randint(jax.random.PRNGKey(9), (8, 33), 0,
+                                           mcfg.vocab_size))
+    trajs = {}
+    for ep in (1, 4):
+        # dst.initialize builds the mesh from config["mesh"] itself
+        spec = mixtral.model_spec(mcfg, compute_dtype=jnp.float32)
+        config = {
+            "train_batch_size": 8,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+            "zero_optimization": {"stage": 2},
+            "moe": {"enabled": ep > 1, "expert_parallel_size": ep,
+                    "num_experts": 4, "top_k": 2},
+            "mesh": {"data": 8 // ep, "expert": ep},
+            "steps_per_print": 0,
+        }
+        engine, _, _, _ = dst.initialize(model=spec, config=config)
+        trajs[ep] = [float(engine.train_batch({"tokens": tokens}).loss)
+                     for _ in range(6)]
+    np.testing.assert_allclose(trajs[4], trajs[1], rtol=2e-4, atol=2e-4)
