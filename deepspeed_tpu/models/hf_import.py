@@ -100,6 +100,9 @@ def llama_config_from_hf(hf_config) -> "Any":
         # Qwen2 always uses QKV biases; Llama exposes an attention_bias flag
         attention_bias=bool(getattr(hf_config, "attention_bias",
                                     hf_config.model_type == "qwen2")),
+        # Qwen3: decoupled head_dim + per-head q/k RMSNorm, no QKV bias
+        head_dim=getattr(hf_config, "head_dim", None),
+        qk_norm=hf_config.model_type == "qwen3",
     )
 
 
@@ -135,6 +138,18 @@ def llama_params_from_hf(src, cfg=None) -> Params:
         params["layers"]["bq"] = _stack(sd, lay + "self_attn.q_proj.bias", L)
         params["layers"]["bk"] = _stack(sd, lay + "self_attn.k_proj.bias", L)
         params["layers"]["bv"] = _stack(sd, lay + "self_attn.v_proj.bias", L)
+    has_qk_norm = (lay.format(i=0) + "self_attn.q_norm.weight") in sd
+    if has_qk_norm:
+        params["layers"]["q_norm"] = _stack(sd, lay + "self_attn.q_norm.weight", L)
+        params["layers"]["k_norm"] = _stack(sd, lay + "self_attn.k_norm.weight", L)
+    if cfg is not None and \
+            bool(getattr(cfg, "qk_norm", False)) != has_qk_norm:
+        # same silent-drop class as the attention_bias check below: a
+        # missing norm would silently skip in _qkv_proj; an unexpected one
+        # would load leaves with no logical-axes entry
+        raise ValueError(
+            f"qk_norm={getattr(cfg, 'qk_norm', False)} but checkpoint "
+            f"{'has' if has_qk_norm else 'lacks'} q_norm.weight tensors")
     if cfg is not None and bool(getattr(cfg, "attention_bias", False)) != has_bias:
         raise ValueError(
             f"attention_bias={getattr(cfg, 'attention_bias', False)} but "
@@ -1078,7 +1093,8 @@ def resolve_module(family: str):
     from . import clip as clip_mod
 
     modules = {
-        "llama": llama, "mistral": llama, "qwen2": llama, "phi3": llama,
+        "llama": llama, "mistral": llama, "qwen2": llama, "qwen3": llama,
+        "phi3": llama,
         "gpt2": gpt, "opt": gpt,
         "mixtral": mixtral, "qwen2_moe": mixtral,
         "falcon": falcon,
@@ -1123,6 +1139,7 @@ _FAMILIES = {
     "llama": (llama_config_from_hf, llama_params_from_hf),
     "mistral": (llama_config_from_hf, llama_params_from_hf),
     "qwen2": (llama_config_from_hf, llama_params_from_hf),
+    "qwen3": (llama_config_from_hf, llama_params_from_hf),
     "phi3": (llama_config_from_hf, phi3_params_from_hf),
     "gpt2": (gpt2_config_from_hf, gpt2_params_from_hf),
     "opt": (opt_config_from_hf, opt_params_from_hf),

@@ -51,6 +51,7 @@ class LlamaConfig:
     rms_norm_eps: float = 1e-5
     tie_embeddings: bool = False
     attention_bias: bool = False  # QKV biases (Qwen2; HF attention_bias flag)
+    qk_norm: bool = False         # per-head RMSNorm on q/k pre-rotary (Qwen3)
     remat: bool = False          # jax.checkpoint each block
     remat_policy: str = "none"   # none | full | dots
     attention_impl: str = "auto"  # auto | xla | ulysses | ring
@@ -135,6 +136,9 @@ def init(cfg: LlamaConfig, rng: jax.Array, dtype=jnp.float32) -> Params:
         params["layers"]["bq"] = jnp.zeros((L, nh * hd), dtype)
         params["layers"]["bk"] = jnp.zeros((L, nkv * hd), dtype)
         params["layers"]["bv"] = jnp.zeros((L, nkv * hd), dtype)
+    if cfg.qk_norm:
+        params["layers"]["q_norm"] = jnp.ones((L, hd), dtype)
+        params["layers"]["k_norm"] = jnp.ones((L, hd), dtype)
     if not cfg.tie_embeddings:
         params["lm_head"] = normal(jax.random.fold_in(rng, 99), (h, v), h)
     return params
@@ -163,6 +167,9 @@ def param_logical_axes(cfg: LlamaConfig) -> Params:
         axes["layers"]["bq"] = ("layers", "heads")
         axes["layers"]["bk"] = ("layers", "kv_heads")
         axes["layers"]["bv"] = ("layers", "kv_heads")
+    if cfg.qk_norm:
+        axes["layers"]["q_norm"] = ("layers", None)
+        axes["layers"]["k_norm"] = ("layers", None)
     if not cfg.tie_embeddings:
         axes["lm_head"] = ("embed", "vocab")
     return axes
@@ -203,8 +210,13 @@ def _qkv_proj(cfg: LlamaConfig, y: jnp.ndarray, layer: Params):
         q = q + layer["bq"]
         k = k + layer["bk"]
         v = v + layer["bv"]
-    return (q.reshape(b, s, nh, hd), k.reshape(b, s, nkv, hd),
-            v.reshape(b, s, nkv, hd))
+    q = q.reshape(b, s, nh, hd)
+    k = k.reshape(b, s, nkv, hd)
+    if "q_norm" in layer:
+        # Qwen3: per-head RMSNorm on q/k before rotary
+        q = rms_norm(q, layer["q_norm"], cfg.rms_norm_eps)
+        k = rms_norm(k, layer["k_norm"], cfg.rms_norm_eps)
+    return q, k, v.reshape(b, s, nkv, hd)
 
 
 def _residual_sharding():

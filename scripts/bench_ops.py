@@ -13,7 +13,6 @@ import argparse
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -28,7 +27,6 @@ def _chain_bench(op, args, flops):
 
 
 def bench_flash(args):
-    import jax
     import jax.numpy as jnp
 
     from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
@@ -49,7 +47,6 @@ def bench_flash(args):
 
 
 def bench_matmul(args):
-    import jax
     import jax.numpy as jnp
 
     M = args.tokens

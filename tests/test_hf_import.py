@@ -709,3 +709,43 @@ def test_clip_legacy_eos_pooling():
     ours = np.asarray(clip_mod.encode_text(cfg, params,
                                            jnp.asarray(tokens)))
     np.testing.assert_allclose(ours, ref, rtol=2e-3, atol=2e-3)
+
+
+def test_qwen3_logit_parity():
+    """Qwen3: per-head q/k RMSNorm + head_dim decoupled from hidden/heads
+    (head_dim=32 with hidden=64/4 heads → q_proj out 128 ≠ hidden, and the
+    norm is a real parity risk if skipped)."""
+    hf_cfg = transformers.Qwen3Config(
+        vocab_size=128, hidden_size=64, intermediate_size=112,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=32, max_position_embeddings=64, rope_theta=10000.0,
+        rms_norm_eps=1e-5, tie_word_embeddings=False)
+    torch.manual_seed(36)
+    hf_model = transformers.Qwen3ForCausalLM(hf_cfg).eval()
+    cfg, params = from_hf(hf_model)
+    assert cfg.qk_norm and cfg.head_size == 32 and not cfg.attention_bias
+    assert "q_norm" in params["layers"]
+    tokens = np.random.RandomState(36).randint(0, 128, (2, 10))
+    with torch.no_grad():
+        ref = hf_model(torch.tensor(tokens)).logits.numpy()
+    ours = np.asarray(llama.apply(cfg, params, jnp.asarray(tokens),
+                                  compute_dtype=jnp.float32))
+    np.testing.assert_allclose(ours, ref, rtol=2e-3, atol=2e-3)
+
+
+def test_qwen3_cached_decode_matches_full():
+    hf_cfg = transformers.Qwen3Config(
+        vocab_size=128, hidden_size=64, intermediate_size=112,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=32, max_position_embeddings=64, tie_word_embeddings=False)
+    torch.manual_seed(37)
+    cfg, params = from_hf(transformers.Qwen3ForCausalLM(hf_cfg).eval())
+    tokens = jnp.asarray(np.random.RandomState(37).randint(0, 128, (2, 12)))
+    full = llama.apply(cfg, params, tokens, compute_dtype=jnp.float32)
+    cache = llama.init_cache(cfg, 2, 32, dtype=jnp.float32)
+    l1, cache = llama.apply_cached(cfg, params, tokens[:, :8], cache,
+                                   jnp.int32(0), compute_dtype=jnp.float32)
+    l2, _ = llama.apply_cached(cfg, params, tokens[:, 8:], cache,
+                               jnp.int32(8), compute_dtype=jnp.float32)
+    got = np.concatenate([np.asarray(l1), np.asarray(l2)], axis=1)
+    np.testing.assert_allclose(got, np.asarray(full), rtol=2e-4, atol=2e-4)
