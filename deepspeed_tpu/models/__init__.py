@@ -1,1 +1,2 @@
-from . import bert, bloom, falcon, gpt, gptneox, llama, mixtral  # noqa: F401
+from . import (bert, bloom, clip, exaone4, falcon, gpt, gptneox,  # noqa: F401
+               llama, mixtral)

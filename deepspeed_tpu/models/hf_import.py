@@ -1085,12 +1085,81 @@ def clip_params_from_hf(src, cfg=None) -> Params:
     return params
 
 
+def exaone4_config_from_hf(hf_config) -> "Any":
+    from .exaone4 import Exaone4Config
+
+    if getattr(hf_config, "rope_scaling", None):
+        # same hazard as the llama guard: silently applying plain RoPE to a
+        # scaled-rope checkpoint gives wrong logits everywhere
+        raise ValueError(
+            "rope_scaling checkpoints are not supported yet — import the "
+            "base (non-scaled) EXAONE-4 variant")
+    return Exaone4Config(
+        vocab_size=hf_config.vocab_size,
+        hidden_size=hf_config.hidden_size,
+        intermediate_size=hf_config.intermediate_size,
+        num_layers=hf_config.num_hidden_layers,
+        num_heads=hf_config.num_attention_heads,
+        num_kv_heads=getattr(hf_config, "num_key_value_heads",
+                             hf_config.num_attention_heads),
+        head_dim=getattr(hf_config, "head_dim", None),
+        max_seq_len=hf_config.max_position_embeddings,
+        sliding_window=getattr(hf_config, "sliding_window", None),
+        sliding_window_pattern=getattr(hf_config, "sliding_window_pattern",
+                                       4) or 4,
+        rope_theta=float(getattr(hf_config, "rope_theta", 1000000.0)),
+        rms_norm_eps=float(getattr(hf_config, "rms_norm_eps", 1e-5)),
+        tie_embeddings=bool(getattr(hf_config, "tie_word_embeddings", False)),
+        layer_types=tuple(hf_config.layer_types)
+        if getattr(hf_config, "layer_types", None) else None,
+    )
+
+
+def exaone4_params_from_hf(src, cfg=None) -> Params:
+    """HF Exaone4ForCausalLM → ``models/exaone4`` pytree (post-norm +
+    QK-norm + hybrid attention)."""
+    sd = _normalize_state_dict(src)
+    L = cfg.num_layers
+    lay = "model.layers.{i}."
+    params: Params = {
+        "embed": sd["model.embed_tokens.weight"],
+        "layers": {
+            "wq": _stack(sd, lay + "self_attn.q_proj.weight", L,
+                         transpose=True),
+            "wk": _stack(sd, lay + "self_attn.k_proj.weight", L,
+                         transpose=True),
+            "wv": _stack(sd, lay + "self_attn.v_proj.weight", L,
+                         transpose=True),
+            "wo": _stack(sd, lay + "self_attn.o_proj.weight", L,
+                         transpose=True),
+            "q_norm": _stack(sd, lay + "self_attn.q_norm.weight", L),
+            "k_norm": _stack(sd, lay + "self_attn.k_norm.weight", L),
+            "post_attn_norm": _stack(
+                sd, lay + "post_attention_layernorm.weight", L),
+            "w_gate": _stack(sd, lay + "mlp.gate_proj.weight", L,
+                             transpose=True),
+            "w_up": _stack(sd, lay + "mlp.up_proj.weight", L, transpose=True),
+            "w_down": _stack(sd, lay + "mlp.down_proj.weight", L,
+                             transpose=True),
+            "post_mlp_norm": _stack(
+                sd, lay + "post_feedforward_layernorm.weight", L),
+        },
+        "final_norm": sd["model.norm.weight"],
+    }
+    if "lm_head.weight" in sd and not cfg.tie_embeddings:
+        params["lm_head"] = sd["lm_head.weight"].T
+    log_dist(f"imported HF exaone4 weights: {L} layers "
+             f"(types={cfg.resolved_layer_types()[:4]}...)")
+    return params
+
+
 def resolve_module(family: str):
     """Family name → the ``deepspeed_tpu.models`` module that executes it."""
     from . import bloom, falcon, gpt, gptneox, llama, mixtral
 
     from . import bert as bert_mod
     from . import clip as clip_mod
+    from . import exaone4 as exaone4_mod
 
     modules = {
         "llama": llama, "mistral": llama, "qwen2": llama, "qwen3": llama,
@@ -1102,6 +1171,7 @@ def resolve_module(family: str):
         "bloom": bloom,
         "bert": bert_mod, "distilbert": bert_mod,
         "clip": clip_mod,
+        "exaone4": exaone4_mod,
     }
     if family not in modules:
         raise ValueError(f"unsupported HF family '{family}' "
@@ -1152,6 +1222,7 @@ _FAMILIES = {
     "bert": (bert_config_from_hf, bert_params_from_hf),
     "distilbert": (distilbert_config_from_hf, distilbert_params_from_hf),
     "clip": (clip_config_from_hf, clip_params_from_hf),
+    "exaone4": (exaone4_config_from_hf, exaone4_params_from_hf),
 }
 
 

@@ -749,3 +749,43 @@ def test_qwen3_cached_decode_matches_full():
                                jnp.int32(8), compute_dtype=jnp.float32)
     got = np.concatenate([np.asarray(l1), np.asarray(l2)], axis=1)
     np.testing.assert_allclose(got, np.asarray(full), rtol=2e-4, atol=2e-4)
+
+
+def test_exaone4_logit_parity():
+    """EXAONE-4: post-norm blocks, QK-norm, hybrid sliding/global layers
+    with global-NoPE — all three must match transformers to pass."""
+    from deepspeed_tpu.models import exaone4 as ex4
+
+    hf_cfg = transformers.Exaone4Config(
+        vocab_size=128, hidden_size=64, intermediate_size=112,
+        num_hidden_layers=4, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, sliding_window=8,
+        sliding_window_pattern=2, rope_theta=10000.0, rms_norm_eps=1e-5,
+        tie_word_embeddings=False)
+    torch.manual_seed(38)
+    hf_model = transformers.Exaone4ForCausalLM(hf_cfg).eval()
+    cfg, params = from_hf(hf_model)
+    types = cfg.resolved_layer_types()
+    assert "sliding_attention" in types and "full_attention" in types
+    tokens = np.random.RandomState(38).randint(0, 128, (2, 24))
+    with torch.no_grad():
+        ref = hf_model(torch.tensor(tokens)).logits.numpy()
+    ours = np.asarray(ex4.apply(cfg, params, jnp.asarray(tokens),
+                                compute_dtype=jnp.float32))
+    np.testing.assert_allclose(ours, ref, rtol=2e-3, atol=2e-3)
+
+
+def test_exaone4_cached_matches_full():
+    from deepspeed_tpu.models import exaone4 as ex4
+
+    cfg = ex4.Exaone4Config.tiny()
+    params = ex4.init(cfg, jax.random.PRNGKey(5))
+    tokens = jnp.asarray(np.random.RandomState(39).randint(0, 256, (2, 24)))
+    full = ex4.apply(cfg, params, tokens, compute_dtype=jnp.float32)
+    cache = ex4.init_cache(cfg, 2, 48, dtype=jnp.float32)
+    l1, cache = ex4.apply_cached(cfg, params, tokens[:, :16], cache,
+                                 jnp.int32(0), compute_dtype=jnp.float32)
+    l2, _ = ex4.apply_cached(cfg, params, tokens[:, 16:], cache,
+                             jnp.int32(16), compute_dtype=jnp.float32)
+    got = np.concatenate([np.asarray(l1), np.asarray(l2)], axis=1)
+    np.testing.assert_allclose(got, np.asarray(full), rtol=2e-4, atol=2e-4)
