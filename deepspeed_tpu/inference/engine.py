@@ -374,17 +374,15 @@ def init_inference(model=None, config=None, *, family: Optional[ModelFamily] = N
             # local HF checkpoint directory — one read resolves family,
             # config, and weights (shared loader; falls back to AutoModel
             # for encoder/contrastive families)
-            from ..models.hf_import import (load_hf_checkpoint_with_family,
-                                            resolve_module)
+            from ..models.hf_import import load_checkpoint_dir_module
 
-            fam_name, model_cfg, params = \
-                load_hf_checkpoint_with_family(checkpoint)
-            model = resolve_module(fam_name)
+            model, model_cfg, params = load_checkpoint_dir_module(checkpoint)
             if not hasattr(model, "apply_cached"):
+                fam = model.__name__.rsplit(".", 1)[-1]
                 raise ValueError(
-                    f"family '{fam_name}' is not generative (no KV-cached "
+                    f"family '{fam}' is not generative (no KV-cached "
                     f"decode path) — use its module API directly "
-                    f"(e.g. models/{fam_name}.encode_*) instead of "
+                    f"(e.g. models/{fam}.encode_*) instead of "
                     f"init_inference")
     if isinstance(config, dict) or config is None:
         config = InferenceConfig.from_dict({**(config or {}), **kwargs})

@@ -205,3 +205,23 @@ def build_engine_v2(model, model_cfg, params, config=None, **kwargs) -> Inferenc
         family, params, config,
         init_paged_cache=getattr(model, "init_paged_cache", None),
         apply_paged=getattr(model, "apply_paged", None))
+
+
+def build_hf_engine(checkpoint: str, config=None,
+                    **kwargs) -> InferenceEngineV2:
+    """One call from a local HF checkpoint directory to a continuous-batching
+    engine (the reference's ``engine_factory.build_hf_engine`` entry:
+    resolve family → import weights → construct the v2 engine)."""
+    from ..models.hf_import import load_checkpoint_dir_module
+
+    model, model_cfg, params = load_checkpoint_dir_module(checkpoint)
+    if not hasattr(model, "apply_paged"):
+        # the engine runs the paged block-table path — gating on the weaker
+        # apply_cached would fall through to llama's kernels on a foreign
+        # config/param tree
+        raise ValueError(
+            f"family module '{model.__name__.rsplit('.', 1)[-1]}' has no "
+            f"paged decode path (apply_paged) — the v2 engine currently "
+            f"serves the llama-module families; use init_inference (v1 "
+            f"KV-cache engine) for this model")
+    return build_engine_v2(model, model_cfg, params, config=config, **kwargs)

@@ -1261,3 +1261,12 @@ def load_hf_checkpoint(path: str, family: Optional[str] = None):
     """Load a LOCAL HF checkpoint directory (no network) and convert."""
     _, cfg, params = load_hf_checkpoint_with_family(path, family)
     return cfg, params
+
+
+def load_checkpoint_dir_module(path: str):
+    """Checkpoint directory → (model_module, our_config, our_params) — the
+    shared resolution step behind ``init_inference(checkpoint=)`` and the v2
+    ``build_hf_engine``; callers gate on the module capability they need
+    (``apply_cached`` for v1 decode, ``apply_paged`` for the paged v2 path)."""
+    fam_name, cfg, params = load_hf_checkpoint_with_family(path)
+    return resolve_module(fam_name), cfg, params

@@ -302,3 +302,39 @@ def test_init_inference_rejects_non_generative_family(tmp_path):
     transformers.CLIPModel(hf_cfg).save_pretrained(str(tmp_path / "clip"))
     with pytest.raises(ValueError, match="not generative"):
         dst.init_inference(checkpoint=str(tmp_path / "clip"), config={})
+
+
+def test_build_hf_engine_v2_from_checkpoint_dir(tmp_path):
+    """engine_factory parity: one call from an HF save dir to a serving
+    continuous-batching engine."""
+    import torch
+    import transformers
+    from deepspeed_tpu.comm import mesh as mesh_lib
+    from deepspeed_tpu.inference.engine_v2 import build_hf_engine
+    from deepspeed_tpu.inference.sampling import SamplingParams
+
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=48,
+        num_hidden_layers=1, num_attention_heads=2, num_key_value_heads=1,
+        max_position_embeddings=64, tie_word_embeddings=False)
+    torch.manual_seed(45)
+    transformers.LlamaForCausalLM(hf_cfg).save_pretrained(
+        str(tmp_path / "llama"))
+
+    mesh_lib.set_mesh(None)
+    eng = build_hf_engine(
+        str(tmp_path / "llama"),
+        config={"dtype": "float32", "prefill_bucket": 8,
+                "ragged": {"max_tracked_sequences": 2,
+                           "max_ragged_batch_size": 2,
+                           "memory_config_blocks": 16, "block_size": 8}})
+    sp = SamplingParams(greedy=True)
+    eng.put(0, [3, 5, 7], sp)
+    eng.put(1, [9, 2], sp)
+    for _ in range(4):
+        out = eng.step(sp)
+    assert set(out) == {0, 1}
+    assert all(0 <= t < 64 for d in eng.state.seqs.values()
+               for t in d.generated)
+    # prefill samples the first token; 4 decode steps add 4 more
+    assert all(len(d.generated) == 5 for d in eng.state.seqs.values())
