@@ -376,9 +376,9 @@ def init_inference(model=None, config=None, *, family: Optional[ModelFamily] = N
             # for encoder/contrastive families)
             from ..models.hf_import import load_checkpoint_dir_module
 
-            model, model_cfg, params = load_checkpoint_dir_module(checkpoint)
+            fam, model, model_cfg, params = \
+                load_checkpoint_dir_module(checkpoint)
             if not hasattr(model, "apply_cached"):
-                fam = model.__name__.rsplit(".", 1)[-1]
                 raise ValueError(
                     f"family '{fam}' is not generative (no KV-cached "
                     f"decode path) — use its module API directly "

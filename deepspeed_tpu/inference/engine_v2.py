@@ -214,14 +214,13 @@ def build_hf_engine(checkpoint: str, config=None,
     resolve family → import weights → construct the v2 engine)."""
     from ..models.hf_import import load_checkpoint_dir_module
 
-    model, model_cfg, params = load_checkpoint_dir_module(checkpoint)
+    fam, model, model_cfg, params = load_checkpoint_dir_module(checkpoint)
     if not hasattr(model, "apply_paged"):
         # the engine runs the paged block-table path — gating on the weaker
         # apply_cached would fall through to llama's kernels on a foreign
         # config/param tree
         raise ValueError(
-            f"family module '{model.__name__.rsplit('.', 1)[-1]}' has no "
-            f"paged decode path (apply_paged) — the v2 engine currently "
-            f"serves the llama-module families; use init_inference (v1 "
-            f"KV-cache engine) for this model")
+            f"family '{fam}' has no paged decode path (apply_paged) — the "
+            f"v2 engine serves the llama- and gpt-module families; use "
+            f"init_inference (v1 KV-cache engine) for this model")
     return build_engine_v2(model, model_cfg, params, config=config, **kwargs)

@@ -159,18 +159,23 @@ def _attn(cfg: GPTConfig, x: jnp.ndarray, layer: Params,
     return out.reshape(b, t, nh * hd) @ layer["wo"] + layer["bo"], (k, v)
 
 
-def _block(cfg: GPTConfig, x, layer, kv=None, cache_len=None):
+def _block(cfg: GPTConfig, x, layer, kv=None, cache_len=None,
+           attn_call=None):
+    """One block; ``attn_call(y) -> (attn_out, kv_state)`` overrides the
+    default dense/cached attention (the paged path supplies its own)."""
+    if attn_call is None:
+        attn_call = lambda y: _attn(cfg, y, layer, kv, cache_len)  # noqa: E731
     eps = cfg.layer_norm_eps
     act = jax.nn.relu if cfg.activation == "relu" else jax.nn.gelu
     if cfg.post_ln:
-        a, kv = _attn(cfg, x, layer, kv, cache_len)
+        a, kv = attn_call(x)
         x = layer_norm(x + a, layer["ln1_scale"], layer["ln1_bias"], eps)
         m = act(x @ layer["w_up"] + layer["b_up"]) @ layer["w_down"] \
             + layer["b_down"]
         x = layer_norm(x + m, layer["ln2_scale"], layer["ln2_bias"], eps)
     else:  # pre-LN (GPT-2/OPT)
         y = layer_norm(x, layer["ln1_scale"], layer["ln1_bias"], eps)
-        a, kv = _attn(cfg, y, layer, kv, cache_len)
+        a, kv = attn_call(y)
         x = x + a
         y = layer_norm(x, layer["ln2_scale"], layer["ln2_bias"], eps)
         x = x + act(y @ layer["w_up"] + layer["b_up"]) @ layer["w_down"] \
@@ -243,6 +248,86 @@ def apply_cached(cfg: GPTConfig, params: Params, tokens: jnp.ndarray,
         layer, k_c, v_c = scanned
         x, (k_c, v_c) = _block(cfg, x, layer, (k_c, v_c), cache_len)
         return x, (k_c, v_c)
+
+    x, (nk, nv) = lax.scan(scan_body, x, (layers, cache["k"], cache["v"]))
+    return _head(cfg, params, x, compute_dtype), {"k": nk, "v": nv}
+
+
+# --------------------------------------------------------------------------- #
+# Paged (blocked) KV-cache path — the v2 continuous-batching protocol
+# (reference serves OPT through inference/v2; see models/llama.py for the
+# block-table layout: fixed-width tables, block 0 is the trash block)
+# --------------------------------------------------------------------------- #
+def init_paged_cache(cfg: GPTConfig, num_blocks: int, block_size: int,
+                     dtype=jnp.bfloat16) -> Params:
+    shape = (cfg.num_layers, num_blocks, block_size, cfg.num_heads,
+             cfg.head_size)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+
+def _attn_paged(cfg: GPTConfig, y: jnp.ndarray, layer: Params,
+                k_cache, v_cache, block_tables, context_lens, valid,
+                positions):
+    b, t, _ = y.shape
+    nh, hd = cfg.num_heads, cfg.head_size
+    bs = k_cache.shape[1]
+    max_blocks = block_tables.shape[1]
+    qkv = y @ layer["wqkv"] + layer["bqkv"]
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(b, t, nh, hd)
+    k = k.reshape(b, t, nh, hd)
+    v = v.reshape(b, t, nh, hd)
+    blk_idx = jnp.take_along_axis(block_tables, positions // bs, axis=1)
+    blk_idx = jnp.where(valid, blk_idx, 0)
+    off = positions % bs
+    k_cache = k_cache.at[blk_idx, off].set(k.astype(k_cache.dtype))
+    v_cache = v_cache.at[blk_idx, off].set(v.astype(v_cache.dtype))
+    if t == 1:
+        from ..ops import pallas as _pallas_ops  # noqa: F401 (registers)
+        from ..ops.registry import get_op
+
+        out = get_op("paged_decode_attention")(
+            q[:, 0], k_cache, v_cache, block_tables, context_lens)[:, None]
+    else:
+        S = max_blocks * bs
+        kg = k_cache[block_tables].reshape(b, S, nh, hd)
+        vg = v_cache[block_tables].reshape(b, S, nh, hd)
+        kv_pos = jnp.arange(S)[None, None, None, :]
+        mask = kv_pos <= positions[:, None, :, None]
+        out = attention(q, kg, vg, causal=False, mask=mask)
+    out = out.reshape(b, t, nh * hd) @ layer["wo"] + layer["bo"]
+    return out, k_cache, v_cache
+
+
+def apply_paged(cfg: GPTConfig, params: Params, tokens: jnp.ndarray,
+                cache: Params, block_tables: jnp.ndarray,
+                context_lens: jnp.ndarray, *,
+                valid: Optional[jnp.ndarray] = None,
+                compute_dtype=jnp.bfloat16) -> Tuple[jnp.ndarray, Params]:
+    """Ragged forward over the paged cache (see llama.apply_paged for the
+    contract); handles both LN orderings and the relu/gelu variants."""
+    b, t = tokens.shape
+    if valid is None:
+        valid = jnp.ones((b, t), bool)
+    positions = jnp.minimum(context_lens[:, None] + jnp.arange(t)[None, :],
+                            cfg.max_seq_len - 1)
+    x = (embedding_lookup(params["embed"], tokens, compute_dtype)
+         + params["pos_embed"][positions].astype(compute_dtype))
+    layers = _cast_layers(params, compute_dtype)
+
+    def scan_body(x, scanned):
+        layer, k_c, v_c = scanned
+        caches = {}
+
+        def attn_call(y):
+            out, nk, nv = _attn_paged(cfg, y, layer, k_c, v_c, block_tables,
+                                      context_lens, valid, positions)
+            caches["kv"] = (nk, nv)
+            return out, None
+
+        x, _ = _block(cfg, x, layer, attn_call=attn_call)
+        return x, caches["kv"]
 
     x, (nk, nv) = lax.scan(scan_body, x, (layers, cache["k"], cache["v"]))
     return _head(cfg, params, x, compute_dtype), {"k": nk, "v": nv}

@@ -1264,9 +1264,11 @@ def load_hf_checkpoint(path: str, family: Optional[str] = None):
 
 
 def load_checkpoint_dir_module(path: str):
-    """Checkpoint directory → (model_module, our_config, our_params) — the
-    shared resolution step behind ``init_inference(checkpoint=)`` and the v2
-    ``build_hf_engine``; callers gate on the module capability they need
-    (``apply_cached`` for v1 decode, ``apply_paged`` for the paged v2 path)."""
+    """Checkpoint directory → (family_name, model_module, our_config,
+    our_params) — the shared resolution step behind
+    ``init_inference(checkpoint=)`` and the v2 ``build_hf_engine``; callers
+    gate on the module capability they need (``apply_cached`` for v1 decode,
+    ``apply_paged`` for the paged v2 path). The family name is kept separate
+    from the module name for error messages (aliases: distilbert → bert)."""
     fam_name, cfg, params = load_hf_checkpoint_with_family(path)
-    return resolve_module(fam_name), cfg, params
+    return fam_name, resolve_module(fam_name), cfg, params

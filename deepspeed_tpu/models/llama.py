@@ -430,10 +430,6 @@ def init_paged_cache(cfg: LlamaConfig, num_blocks: int, block_size: int,
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
 
-def paged_cache_logical_axes(cfg: LlamaConfig) -> Params:
-    spec = ("layers", None, None, "kv_heads", None)
-    return {"k": spec, "v": spec}
-
 
 def _block_paged(cfg: LlamaConfig, x: jnp.ndarray, layer: Params,
                  k_cache: jnp.ndarray, v_cache: jnp.ndarray,
