@@ -220,7 +220,6 @@ def build_hf_engine(checkpoint: str, config=None,
         # apply_cached would fall through to llama's kernels on a foreign
         # config/param tree
         raise ValueError(
-            f"family '{fam}' has no paged decode path (apply_paged) — the "
-            f"v2 engine serves the llama- and gpt-module families; use "
+            f"family '{fam}' has no paged decode path (apply_paged) — use "
             f"init_inference (v1 KV-cache engine) for this model")
     return build_engine_v2(model, model_cfg, params, config=config, **kwargs)

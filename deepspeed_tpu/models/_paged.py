@@ -1,0 +1,57 @@
+"""Shared paged-KV attention step — the one copy of the v2 block-table
+protocol every family's ``apply_paged`` builds on.
+
+Contract (see ``models/llama.py`` for the layout): the KV pool is
+``[num_blocks, block_size, kv_heads, hd]`` per layer, block tables are
+fixed-width ``[b, max_blocks]`` indices into the pool, block 0 is the trash
+block that absorbs writes for padded tokens, and ``positions`` are absolute
+token positions (``context_lens + arange(t)``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+
+from ..ops.attention import attention
+
+
+def paged_attention_step(q, k, v, k_cache, v_cache, block_tables,
+                         context_lens, positions, valid, *,
+                         window=None) -> Tuple:
+    """Scatter this step's K/V into the block pool, then attend over it.
+
+    q [b, t, nh, hd]; k/v [b, t, nkv, hd]. ``window``: optional per-layer
+    sliding-window length (traced scalar) — when given, the gathered-view
+    mask path runs (the plain-causal Pallas decode kernel cannot window);
+    when None, single-token decode dispatches the paged flash-decode kernel.
+    Returns (attn_out [b, t, nh, hd], k_cache, v_cache)."""
+    b, t = q.shape[0], q.shape[1]
+    nkv, hd = k.shape[-2], k.shape[-1]
+    bs = k_cache.shape[1]
+    max_blocks = block_tables.shape[1]
+
+    blk_idx = jnp.take_along_axis(block_tables, positions // bs, axis=1)
+    blk_idx = jnp.where(valid, blk_idx, 0)
+    off = positions % bs
+    k_cache = k_cache.at[blk_idx, off].set(k.astype(k_cache.dtype))
+    v_cache = v_cache.at[blk_idx, off].set(v.astype(v_cache.dtype))
+
+    if t == 1 and window is None:
+        from ..ops import pallas as _pallas_ops  # noqa: F401 (registers)
+        from ..ops.registry import get_op
+
+        out = get_op("paged_decode_attention")(
+            q[:, 0], k_cache, v_cache, block_tables, context_lens)[:, None]
+    else:
+        S = max_blocks * bs
+        kg = k_cache[block_tables].reshape(b, S, nkv, hd)
+        vg = v_cache[block_tables].reshape(b, S, nkv, hd)
+        kv_pos = jnp.arange(S)[None, None, None, :]
+        q_abs = positions[:, None, :, None]
+        mask = kv_pos <= q_abs
+        if window is not None:
+            mask = mask & (q_abs - kv_pos < window)
+        out = attention(q, kg, vg, causal=False, mask=mask)
+    return out, k_cache, v_cache

@@ -27,6 +27,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..ops.attention import attention
+from ._paged import paged_attention_step
 from ..ops.embedding import embedding_lookup
 from ..ops.norms import rms_norm
 from ..ops.rotary import apply_rotary, rope_frequencies
@@ -296,3 +297,54 @@ def model_spec(cfg: Exaone4Config, compute_dtype=jnp.bfloat16):
         logical_axes=param_logical_axes(cfg),
         pipeline_capable=False,
     )
+
+
+# --------------------------------------------------------------------------- #
+# Paged (blocked) KV-cache path — the v2 continuous-batching protocol
+# (reference lists exaone4 among the v2 model implementations). The hybrid
+# sliding/global masks rule out the plain-causal paged decode kernel, so
+# both prefill and decode run the gathered-view attention with the windowed
+# mask; block-table layout as in models/llama.py (block 0 = trash).
+# --------------------------------------------------------------------------- #
+def init_paged_cache(cfg: Exaone4Config, num_blocks: int, block_size: int,
+                     dtype=jnp.bfloat16) -> Params:
+    shape = (cfg.num_layers, num_blocks, block_size, cfg.num_kv_heads,
+             cfg.head_size)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def apply_paged(cfg: Exaone4Config, params: Params, tokens: jnp.ndarray,
+                cache: Params, block_tables: jnp.ndarray,
+                context_lens: jnp.ndarray, *,
+                valid: Optional[jnp.ndarray] = None,
+                compute_dtype=jnp.bfloat16) -> Tuple[jnp.ndarray, Params]:
+    b, t = tokens.shape
+    nh, nkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_size
+    if valid is None:
+        valid = jnp.ones((b, t), bool)
+    x = embedding_lookup(params["embed"], tokens, compute_dtype)
+    cos, sin = rope_frequencies(cfg.head_size, cfg.max_seq_len,
+                                cfg.rope_theta)
+    positions = context_lens[:, None] + jnp.arange(t)[None, :]
+    layers = _cast_layers(params, compute_dtype)
+    windows, use_rope = _layer_scalars(cfg)
+
+    def scan_body(x, scanned):
+        layer, k_c, v_c, window, rope = scanned
+        q, k, v = _qkv(cfg, x, layer, cos, sin, positions, rope)
+        # pure-global configs (static) take window=None → the plain-causal
+        # Pallas decode kernel applies; hybrid configs pass the traced
+        # per-layer window and run the gathered-view mask path
+        attn_out, k_c, v_c = paged_attention_step(
+            q, k, v, k_c, v_c, block_tables, context_lens, positions, valid,
+            window=None if cfg.sliding_window is None else window)
+        attn_out = attn_out.reshape(b, t, nh * hd) @ layer["wo"]
+        x = x + rms_norm(attn_out, layer["post_attn_norm"], cfg.rms_norm_eps)
+        mlp = (jax.nn.silu(x @ layer["w_gate"]) * (x @ layer["w_up"])) \
+            @ layer["w_down"]
+        x = x + rms_norm(mlp, layer["post_mlp_norm"], cfg.rms_norm_eps)
+        return x, (k_c, v_c)
+
+    x, (nk, nv) = lax.scan(
+        scan_body, x, (layers, cache["k"], cache["v"], windows, use_rope))
+    return _head(cfg, params, x, compute_dtype), {"k": nk, "v": nv}

@@ -22,6 +22,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..ops.attention import attention
+from ._paged import paged_attention_step
 from ..ops.embedding import embedding_lookup
 from ..ops.norms import layer_norm
 from ..ops.rotary import apply_rotary, rope_frequencies
@@ -237,9 +238,9 @@ def apply_cached(cfg: FalconConfig, params: Params, tokens: jnp.ndarray,
         mask = kv_pos <= q_abs
         attn_out = attention(q, k_c, v_c, causal=False, mask=mask)
         attn_out = attn_out.reshape(b, t, nh * hd) @ layer["wo"]
-        mlp_out = jax.nn.gelu(y_mlp @ layer["w_up"], approximate=False) \
-            @ layer["w_down"]
         if cfg.parallel_attn:
+            mlp_out = jax.nn.gelu(y_mlp @ layer["w_up"], approximate=False) \
+                @ layer["w_down"]
             x = x + attn_out + mlp_out
         else:
             x = x + attn_out
@@ -282,3 +283,62 @@ def model_spec(cfg: FalconConfig, compute_dtype=jnp.bfloat16):
         logical_axes=param_logical_axes(cfg),
         pipeline_capable=False,
     )
+
+
+# --------------------------------------------------------------------------- #
+# Paged (blocked) KV-cache path — the v2 continuous-batching protocol
+# (reference serves Falcon through inference/v2; block-table layout as in
+# models/llama.py: fixed-width tables, block 0 is the trash block)
+# --------------------------------------------------------------------------- #
+def init_paged_cache(cfg: FalconConfig, num_blocks: int, block_size: int,
+                     dtype=jnp.bfloat16) -> Params:
+    shape = (cfg.num_layers, num_blocks, block_size, cfg.num_kv_heads,
+             cfg.head_size)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def apply_paged(cfg: FalconConfig, params: Params, tokens: jnp.ndarray,
+                cache: Params, block_tables: jnp.ndarray,
+                context_lens: jnp.ndarray, *,
+                valid: Optional[jnp.ndarray] = None,
+                compute_dtype=jnp.bfloat16) -> Tuple[jnp.ndarray, Params]:
+    """Ragged forward over the paged cache (see llama.apply_paged for the
+    contract); handles the parallel / sequential / new-decoder variants."""
+    b, t = tokens.shape
+    nh, nkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_size
+    if valid is None:
+        valid = jnp.ones((b, t), bool)
+    x = embedding_lookup(params["embed"], tokens, compute_dtype)
+    cos, sin = rope_frequencies(cfg.head_size, cfg.max_seq_len, cfg.rope_theta)
+    positions = context_lens[:, None] + jnp.arange(t)[None, :]
+    layers = _cast_layers(params, compute_dtype)
+
+    def scan_body(x, scanned):
+        layer, k_c, v_c = scanned
+        y_attn = layer_norm(x, layer["ln_attn_scale"], layer["ln_attn_bias"],
+                            cfg.layer_norm_eps)
+        y_mlp = layer_norm(x, layer["ln_mlp_scale"], layer["ln_mlp_bias"],
+                           cfg.layer_norm_eps) \
+            if cfg.new_decoder_architecture else y_attn
+        q = (y_attn @ layer["wq"]).reshape(b, t, nh, hd)
+        k = (y_attn @ layer["wk"]).reshape(b, t, nkv, hd)
+        v = (y_attn @ layer["wv"]).reshape(b, t, nkv, hd)
+        q = apply_rotary(q, cos, sin, positions)
+        k = apply_rotary(k, cos, sin, positions)
+        attn_out, k_c, v_c = paged_attention_step(
+            q, k, v, k_c, v_c, block_tables, context_lens, positions, valid)
+        attn_out = attn_out.reshape(b, t, nh * hd) @ layer["wo"]
+        if cfg.parallel_attn:
+            mlp_out = jax.nn.gelu(y_mlp @ layer["w_up"], approximate=False) \
+                @ layer["w_down"]
+            x = x + attn_out + mlp_out
+        else:
+            x = x + attn_out
+            y2 = layer_norm(x, layer["ln_mlp_scale"], layer["ln_mlp_bias"],
+                            cfg.layer_norm_eps)
+            x = x + jax.nn.gelu(y2 @ layer["w_up"], approximate=False) \
+                @ layer["w_down"]
+        return x, (k_c, v_c)
+
+    x, (nk, nv) = lax.scan(scan_body, x, (layers, cache["k"], cache["v"]))
+    return _head(cfg, params, x, compute_dtype), {"k": nk, "v": nv}

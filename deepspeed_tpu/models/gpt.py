@@ -21,6 +21,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..ops.attention import attention
+from ._paged import paged_attention_step
 from ..ops.embedding import embedding_lookup
 from ..ops.norms import layer_norm
 
@@ -271,31 +272,12 @@ def _attn_paged(cfg: GPTConfig, y: jnp.ndarray, layer: Params,
                 positions):
     b, t, _ = y.shape
     nh, hd = cfg.num_heads, cfg.head_size
-    bs = k_cache.shape[1]
-    max_blocks = block_tables.shape[1]
     qkv = y @ layer["wqkv"] + layer["bqkv"]
     q, k, v = jnp.split(qkv, 3, axis=-1)
-    q = q.reshape(b, t, nh, hd)
-    k = k.reshape(b, t, nh, hd)
-    v = v.reshape(b, t, nh, hd)
-    blk_idx = jnp.take_along_axis(block_tables, positions // bs, axis=1)
-    blk_idx = jnp.where(valid, blk_idx, 0)
-    off = positions % bs
-    k_cache = k_cache.at[blk_idx, off].set(k.astype(k_cache.dtype))
-    v_cache = v_cache.at[blk_idx, off].set(v.astype(v_cache.dtype))
-    if t == 1:
-        from ..ops import pallas as _pallas_ops  # noqa: F401 (registers)
-        from ..ops.registry import get_op
-
-        out = get_op("paged_decode_attention")(
-            q[:, 0], k_cache, v_cache, block_tables, context_lens)[:, None]
-    else:
-        S = max_blocks * bs
-        kg = k_cache[block_tables].reshape(b, S, nh, hd)
-        vg = v_cache[block_tables].reshape(b, S, nh, hd)
-        kv_pos = jnp.arange(S)[None, None, None, :]
-        mask = kv_pos <= positions[:, None, :, None]
-        out = attention(q, kg, vg, causal=False, mask=mask)
+    out, k_cache, v_cache = paged_attention_step(
+        q.reshape(b, t, nh, hd), k.reshape(b, t, nh, hd),
+        v.reshape(b, t, nh, hd), k_cache, v_cache, block_tables,
+        context_lens, positions, valid)
     out = out.reshape(b, t, nh * hd) @ layer["wo"] + layer["bo"]
     return out, k_cache, v_cache
 
@@ -318,16 +300,14 @@ def apply_paged(cfg: GPTConfig, params: Params, tokens: jnp.ndarray,
 
     def scan_body(x, scanned):
         layer, k_c, v_c = scanned
-        caches = {}
 
         def attn_call(y):
             out, nk, nv = _attn_paged(cfg, y, layer, k_c, v_c, block_tables,
                                       context_lens, valid, positions)
-            caches["kv"] = (nk, nv)
-            return out, None
+            return out, (nk, nv)
 
-        x, _ = _block(cfg, x, layer, attn_call=attn_call)
-        return x, caches["kv"]
+        x, kv = _block(cfg, x, layer, attn_call=attn_call)
+        return x, kv
 
     x, (nk, nv) = lax.scan(scan_body, x, (layers, cache["k"], cache["v"]))
     return _head(cfg, params, x, compute_dtype), {"k": nk, "v": nv}

@@ -30,6 +30,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..ops.attention import attention
+from ._paged import paged_attention_step
 from ..ops.embedding import embedding_lookup
 from ..ops.norms import rms_norm
 from ..ops.rotary import apply_rotary, rope_frequencies
@@ -440,42 +441,14 @@ def _block_paged(cfg: LlamaConfig, x: jnp.ndarray, layer: Params,
     [B, max_blocks]; context_lens [B]; valid [B, t] (False → write to trash)."""
     b, t, h = x.shape
     nh, nkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_size
-    bs = k_cache.shape[1]
-    max_blocks = block_tables.shape[1]
 
     y = rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps)
     q, k, v = _qkv_proj(cfg, y, layer)
     q = apply_rotary(q, cos, sin, positions)
     k = apply_rotary(k, cos, sin, positions)
-
-    # scatter new K/V into blocks: token j of seq i lands at abs position
-    # context_lens[i]+j → (block_tables[i, p // bs], p % bs); invalid → trash
-    abs_pos = positions  # [b, t]
-    blk_idx = jnp.take_along_axis(block_tables, abs_pos // bs, axis=1)
-    blk_idx = jnp.where(valid, blk_idx, 0)
-    off = abs_pos % bs
-    k_cache = k_cache.at[blk_idx, off].set(k.astype(k_cache.dtype))
-    v_cache = v_cache.at[blk_idx, off].set(v.astype(v_cache.dtype))
-
-    if t == 1:
-        # decode: block-table-indexed flash-decode — Pallas kernel on TPU
-        # (reads KV straight from the pool, no dense gather; reference
-        # inference/v2/kernels/ragged_ops), compiled XLA gather elsewhere
-        from ..ops import pallas as _pallas_ops  # noqa: F401 (registers)
-        from ..ops.registry import get_op
-
-        attn_out = get_op("paged_decode_attention")(
-            q[:, 0], k_cache, v_cache, block_tables,
-            context_lens)[:, None]
-    else:
-        # prefill chunks: dense gather view + masked flash/XLA attention
-        S = max_blocks * bs
-        kg = k_cache[block_tables].reshape(b, S, nkv, hd)
-        vg = v_cache[block_tables].reshape(b, S, nkv, hd)
-        kv_pos = jnp.arange(S)[None, None, None, :]
-        q_abs = abs_pos[:, None, :, None]
-        mask = kv_pos <= q_abs
-        attn_out = attention(q, kg, vg, causal=False, mask=mask)
+    attn_out, k_cache, v_cache = paged_attention_step(
+        q, k, v, k_cache, v_cache, block_tables, context_lens, positions,
+        valid)
     x = x + attn_out.reshape(b, t, nh * hd) @ layer["wo"]
 
     y = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)

@@ -20,6 +20,7 @@ from jax import lax
 
 from ..moe.layer import MoELayer, init_moe_ffn, moe_ffn_logical_axes
 from ..ops.attention import attention
+from ._paged import paged_attention_step
 from ..ops.embedding import embedding_lookup
 from ..ops.norms import rms_norm
 from ..ops.rotary import apply_rotary, rope_frequencies
@@ -262,3 +263,59 @@ def model_spec(cfg: MixtralConfig, compute_dtype=jnp.bfloat16):
         logical_axes=param_logical_axes(cfg),
         pipeline_capable=False,   # MoE model runs plain scan (no pipeline path yet)
     )
+
+
+# --------------------------------------------------------------------------- #
+# Paged (blocked) KV-cache path — the v2 continuous-batching protocol
+# (reference serves Mixtral through inference/v2; block-table layout as in
+# models/llama.py: fixed-width tables, block 0 is the trash block)
+# --------------------------------------------------------------------------- #
+def init_paged_cache(cfg: MixtralConfig, num_blocks: int, block_size: int,
+                     dtype=jnp.bfloat16) -> Params:
+    shape = (cfg.num_layers, num_blocks, block_size, cfg.num_kv_heads,
+             cfg.head_size)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def apply_paged(cfg: MixtralConfig, params: Params, tokens: jnp.ndarray,
+                cache: Params, block_tables: jnp.ndarray,
+                context_lens: jnp.ndarray, *,
+                valid: Optional[jnp.ndarray] = None,
+                compute_dtype=jnp.bfloat16) -> Tuple[jnp.ndarray, Params]:
+    """Ragged forward over the paged cache (see llama.apply_paged for the
+    contract); the FFN is the no-drop MoE routing of apply_cached."""
+    b, t = tokens.shape
+    nh, nkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_size
+    if valid is None:
+        valid = jnp.ones((b, t), bool)
+    x = embedding_lookup(params["embed"], tokens, compute_dtype)
+    cos, sin = rope_frequencies(cfg.head_size, cfg.max_seq_len, cfg.rope_theta)
+    positions = context_lens[:, None] + jnp.arange(t)[None, :]
+    moe_layer = MoELayer(cfg.num_experts, cfg.top_k, cfg.capacity_factor,
+                         cfg.min_capacity, drop_tokens=False,
+                         norm_topk=cfg.norm_topk_prob)
+    layers = jax.tree.map(lambda p: p.astype(compute_dtype)
+                          if jnp.issubdtype(p.dtype, jnp.floating) else p,
+                          params["layers"])
+
+    def scan_body(x, scanned):
+        layer, k_c, v_c = scanned
+        y = rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps)
+        q, k, v = y @ layer["wq"], y @ layer["wk"], y @ layer["wv"]
+        if "bq" in layer:
+            q, k, v = q + layer["bq"], k + layer["bk"], v + layer["bv"]
+        q = apply_rotary(q.reshape(b, t, nh, hd), cos, sin, positions)
+        k = apply_rotary(k.reshape(b, t, nkv, hd), cos, sin, positions)
+        v = v.reshape(b, t, nkv, hd)
+        attn, k_c, v_c = paged_attention_step(
+            q, k, v, k_c, v_c, block_tables, context_lens, positions, valid)
+        x = x + attn.reshape(b, t, nh * hd) @ layer["wo"]
+        y = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
+        ffn_out, _aux = moe_layer(layer["moe"], y)
+        return x + ffn_out, (k_c, v_c)
+
+    x, (nk, nv) = lax.scan(scan_body, x, (layers, cache["k"], cache["v"]))
+    x = rms_norm(x, params["final_norm"].astype(compute_dtype),
+                 cfg.rms_norm_eps)
+    logits = x @ params["lm_head"].astype(compute_dtype)
+    return logits.astype(jnp.float32), {"k": nk, "v": nv}

@@ -339,28 +339,53 @@ def test_build_hf_engine_v2_from_checkpoint_dir(tmp_path):
     # prefill samples the first token; 4 decode steps add 4 more
     assert all(len(d.generated) == 5 for d in eng.state.seqs.values())
 
-
-def test_gpt_v2_paged_engine_matches_cached(tmp_path):
-    """GPT/OPT through the v2 paged engine (reference serves OPT via v2):
-    greedy continuous-batching decode equals the v1 dense-cache decode."""
-    import torch
+def _hf_factory(family):
     import transformers
-    from deepspeed_tpu.comm import mesh as mesh_lib
-    from deepspeed_tpu.inference.engine_v2 import build_hf_engine
-    from deepspeed_tpu.inference.sampling import SamplingParams
 
-    hf_cfg = transformers.OPTConfig(
-        vocab_size=64, hidden_size=32, ffn_dim=64, num_hidden_layers=2,
-        num_attention_heads=2, max_position_embeddings=64,
-        do_layer_norm_before=True, activation_function="relu",
-        word_embed_proj_dim=32)
-    torch.manual_seed(46)
-    hf = transformers.OPTForCausalLM(hf_cfg).eval()
-    hf.save_pretrained(str(tmp_path / "opt"))
+    if family == "opt":
+        return transformers.OPTForCausalLM(transformers.OPTConfig(
+            vocab_size=64, hidden_size=32, ffn_dim=64, num_hidden_layers=2,
+            num_attention_heads=2, max_position_embeddings=64,
+            do_layer_norm_before=True, activation_function="relu",
+            word_embed_proj_dim=32))
+    if family == "mixtral":
+        return transformers.MixtralForCausalLM(transformers.MixtralConfig(
+            vocab_size=64, hidden_size=32, intermediate_size=48,
+            num_hidden_layers=2, num_attention_heads=2,
+            num_key_value_heads=1, num_local_experts=4,
+            num_experts_per_tok=2, max_position_embeddings=64,
+            tie_word_embeddings=False))
+    if family == "falcon":
+        return transformers.FalconForCausalLM(transformers.FalconConfig(
+            vocab_size=64, hidden_size=32, num_hidden_layers=2,
+            num_attention_heads=2, multi_query=True, parallel_attn=True,
+            new_decoder_architecture=False, bias=False,
+            max_position_embeddings=64, alibi=False))
+    if family == "exaone4":
+        return transformers.Exaone4ForCausalLM(transformers.Exaone4Config(
+            vocab_size=64, hidden_size=32, intermediate_size=48,
+            num_hidden_layers=2, num_attention_heads=2,
+            num_key_value_heads=1, max_position_embeddings=64,
+            sliding_window=8, sliding_window_pattern=2, rope_theta=10000.0,
+            tie_word_embeddings=False))
+    raise ValueError(family)
+
+
+@pytest.mark.parametrize("family,seed", [("opt", 46), ("mixtral", 48),
+                                         ("falcon", 49), ("exaone4", 51)])
+def test_v2_paged_engine_matches_v1_per_family(family, seed, tmp_path):
+    """Every reference-v2 family through the continuous-batching engine:
+    greedy paged decode equals the v1 dense-cache decode."""
+    import torch
+
+    from deepspeed_tpu.inference.engine_v2 import build_hf_engine
+
+    torch.manual_seed(seed)
+    _hf_factory(family).save_pretrained(str(tmp_path / family))
 
     mesh_lib.set_mesh(None)
     eng = build_hf_engine(
-        str(tmp_path / "opt"),
+        str(tmp_path / family),
         config={"dtype": "float32", "prefill_bucket": 8,
                 "ragged": {"max_tracked_sequences": 2,
                            "max_ragged_batch_size": 2,
@@ -372,12 +397,9 @@ def test_gpt_v2_paged_engine_matches_cached(tmp_path):
         eng.step(sp)
     v2_tokens = list(eng.state.seqs[0].generated)
 
-    # v1 dense-cache greedy reference
-    import deepspeed_tpu as dst
-
     mesh_lib.set_mesh(None)
-    v1 = dst.init_inference(checkpoint=str(tmp_path / "opt"),
+    v1 = dst.init_inference(checkpoint=str(tmp_path / family),
                             config={"dtype": "float32", "prefill_bucket": 8})
     ref = v1.generate(np.asarray([prompt], np.int32), max_new_tokens=6,
                       temperature=0.0)[0].tolist()
-    assert v2_tokens == ref, (v2_tokens, ref)
+    assert v2_tokens == ref, (family, v2_tokens, ref)
