@@ -1,0 +1,52 @@
+"""Driver-artifact contracts: bench.py must ALWAYS print one JSON line with
+the agreed schema (the round harness records it), and __graft_entry__ must
+expose a jittable entry. These run in degraded-CPU mode so they hold even
+when the accelerator tunnel is down — the exact scenario that produced a
+zero-information round once."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_bench_emits_schema_compliant_json():
+    env = {**os.environ, "DSTPU_BENCH_FORCE_CPU": "1",
+           "PYTHONPATH": os.pathsep.join(
+               p for p in (REPO_ROOT, os.environ.get("PYTHONPATH")) if p)}
+    env.pop("XLA_FLAGS", None)  # tiny single-device run is faster
+    # outer timeout must exceed bench.py's own worst case (600s decode-child
+    # budget + engine build + train steps on a loaded host)
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "bench.py")],
+        capture_output=True, text=True, timeout=1200, env=env)
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = [l for l in out.stdout.strip().splitlines() if l.startswith("{")]
+    assert len(lines) == 1, out.stdout[-500:]
+    rec = json.loads(lines[0])
+    for key in ("metric", "value", "unit", "vs_baseline"):
+        assert key in rec, rec
+    assert rec["metric"] == "llama_zero3_train_mfu"
+    assert rec["detail"]["ok"] is True
+    assert rec["detail"]["backend"] == "cpu-degraded"
+    assert isinstance(rec["detail"]["decode_tok_per_sec"], (int, float))
+
+
+def test_graft_entry_compiles():
+    import jax
+
+    # self-contained CPU pin (don't rely on conftest): a wedged tunnel makes
+    # the accelerator probe hang forever, the scenario this file guards
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass  # backend already initialized by an earlier test — also CPU
+
+    sys.path.insert(0, REPO_ROOT)
+    import __graft_entry__ as g
+
+    fn, args = g.entry()
+    compiled = jax.jit(fn).lower(*args).compile()
+    assert compiled.cost_analysis() is not None
