@@ -7,6 +7,7 @@ import argparse
 import shlex
 import subprocess
 import sys
+import threading
 from typing import List
 
 from .runner import fetch_hostfile
@@ -27,14 +28,32 @@ def main(argv: List[str] = None) -> int:
     cmd = shlex.join(args.command)  # preserve argv boundaries remotely
     procs = {h: subprocess.Popen(
         ["ssh", "-o", "StrictHostKeyChecking=no", h, cmd],
-        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        errors="replace")
         for h in hosts}
+    # drain every host's pipe concurrently — a chatty later host must not
+    # block behind an earlier one filling its OS pipe buffer — but print
+    # each host as soon as its predecessors finish, so one wedged host
+    # doesn't black out all output
+    outputs: dict = {}
+
+    def _drain(h, proc):
+        try:
+            outputs[h] = proc.communicate()[0]
+        except Exception as e:  # a dead drain must not report success
+            outputs[h] = f"dstpu_ssh: drain failed: {e!r}"
+            proc.kill()
+
+    threads = {h: threading.Thread(target=_drain, args=(h, p), daemon=True)
+               for h, p in procs.items()}
+    for t in threads.values():
+        t.start()
     rc = 0
     for h, proc in procs.items():
-        out, _ = proc.communicate()
-        for line in (out or "").splitlines():
+        threads[h].join()
+        for line in (outputs.get(h) or "").splitlines():
             print(f"[{h}] {line}")
-        rc = rc or proc.returncode
+        rc = rc or (1 if proc.returncode is None else proc.returncode)
     return rc
 
 

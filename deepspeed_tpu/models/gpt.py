@@ -292,10 +292,12 @@ def apply_paged(cfg: GPTConfig, params: Params, tokens: jnp.ndarray,
     b, t = tokens.shape
     if valid is None:
         valid = jnp.ones((b, t), bool)
-    positions = jnp.minimum(context_lens[:, None] + jnp.arange(t)[None, :],
-                            cfg.max_seq_len - 1)
+    positions = context_lens[:, None] + jnp.arange(t)[None, :]
+    # clamp ONLY the learned-position lookup; the cache scatter/mask must see
+    # the true absolute positions or slots past max_seq_len silently collide
+    pos_idx = jnp.minimum(positions, cfg.max_seq_len - 1)
     x = (embedding_lookup(params["embed"], tokens, compute_dtype)
-         + params["pos_embed"][positions].astype(compute_dtype))
+         + params["pos_embed"][pos_idx].astype(compute_dtype))
     layers = _cast_layers(params, compute_dtype)
 
     def scan_body(x, scanned):

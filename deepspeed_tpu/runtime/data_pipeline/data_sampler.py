@@ -91,6 +91,14 @@ class DataAnalyzer:
         if self.num_workers == 1 and self.metrics:
             return self.metrics
         assert self.save_path is not None, "run_reduce needs save_path"
+        missing = [w for w in range(self.num_workers)
+                   if not os.path.exists(self._worker_file(w))]
+        if missing:
+            raise FileNotFoundError(
+                f"run_reduce: missing worker index files for workers "
+                f"{missing} under {self.save_path} — every worker must "
+                f"run_map before any worker reduces (stale leftovers from a "
+                f"different num_workers run would merge silently)")
         merged: Dict[str, np.ndarray] = {}
         for w in range(self.num_workers):
             with np.load(self._worker_file(w)) as z:
