@@ -93,7 +93,13 @@ def init_backend():
     else:
         import jax
 
-        RESULT["detail"]["backend"] = jax.default_backend()
+        actual = jax.default_backend()
+        expected = os.environ.get("DSTPU_BENCH_BACKEND", actual)
+        # the tunnel can wedge between the up-front probe and this import
+        # (the decode child holds that window open for up to 600s); a silent
+        # CPU fallback must not masquerade as a healthy accelerator run
+        RESULT["detail"]["backend"] = (
+            actual if actual == expected else f"{actual}-degraded")
     try:
         jax.config.update("jax_compilation_cache_dir", CACHE_DIR)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)

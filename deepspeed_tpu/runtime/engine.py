@@ -164,6 +164,9 @@ class DeepSpeedTPUEngine:
                                        base_lr=self.base_lr)
         self.lr_schedule = lr_schedule
         self.lr_scheduler = LRScheduler(lr_schedule)
+        # set_lr pin, fed to the compiled step as a TRACED scalar (< 0 =
+        # follow the schedule) so changing the LR never triggers a recompile
+        self._lr_override = jnp.asarray(-1.0, jnp.float32)
 
         if config.zero_config.zero_quantized_gradients and \
                 config.zero_config.stage not in (2,):
@@ -222,6 +225,7 @@ class DeepSpeedTPUEngine:
         self._param_shardings = self.partitioner.shardings(param_specs)
         self._grad_shardings = self.partitioner.shardings(grad_specs)
         self._master_shardings = self.partitioner.shardings(opt_specs)
+        self._log_zero_sharding_summary(shapes, opt_specs)
 
         with mesh_mgr.activate():
             # masters live ZeRO-sharded from stage 1 up; the bf16 compute copy
@@ -229,14 +233,24 @@ class DeepSpeedTPUEngine:
             params = jax.jit(
                 lambda p: p, out_shardings=self._master_shardings)(params)
             opt_state = self._init_opt_state(params)
-        loss_scale = make_loss_scaler(config.fp16)
-        self.state = TrainState(
-            step=jnp.zeros((), jnp.int32),
-            params=params,
-            opt_state=opt_state,
-            loss_scale=loss_scale,
-            skipped_steps=jnp.zeros((), jnp.int32),
-        )
+            # scalars go through a jitted identity under the mesh so their
+            # avals carry the same mesh-tracked context as the step outputs —
+            # otherwise the second train_batch always pays one full
+            # retrace/recompile (params/opt_state already come out of jits)
+            repl = NamedSharding(mesh_mgr.mesh, P())
+            step0, loss_scale, skipped0 = jax.jit(
+                lambda s: s,
+                out_shardings=jax.tree.map(lambda _: repl, (
+                    0, make_loss_scaler(config.fp16), 0)))(
+                (jnp.zeros((), jnp.int32), make_loss_scaler(config.fp16),
+                 jnp.zeros((), jnp.int32)))
+            self.state = TrainState(
+                step=step0,
+                params=params,
+                opt_state=opt_state,
+                loss_scale=loss_scale,
+                skipped_steps=skipped0,
+            )
 
         # --- compiled steps ---
         self._train_step = None
@@ -284,6 +298,54 @@ class DeepSpeedTPUEngine:
             f"dtype={config.compute_dtype} mesh={dict(mesh_mgr.mesh.shape)} "
             f"micro_batch={self.train_micro_batch_size_per_gpu()} "
             f"gas={self.gradient_accumulation_steps()}")
+
+    def _log_zero_sharding_summary(self, shapes, opt_specs) -> None:
+        """One bring-up line saying how much master/optimizer state actually
+        got ZeRO-sharded — indivisible leaves silently stay replicated
+        (`_add_zero_axes`), which at scale is exactly the class of memory
+        regression the reference's partitioner errors on. Make it visible."""
+        part = self.partitioner
+        if self.config.zero_config.stage < 1 or part.zero_size <= 1:
+            return
+        zero_axes = set(part.zero_axes)
+        is_p = lambda x: isinstance(x, P)  # noqa: E731
+        shape_leaves = jax.tree.leaves(
+            shapes, is_leaf=lambda x: isinstance(x, tuple))
+        spec_paths = jax.tree_util.tree_flatten_with_path(
+            opt_specs, is_leaf=is_p)[0]
+        n_zero = n_model = n_repl = 0
+        bytes_zero = bytes_model = bytes_repl = 0
+        repl_names: List[str] = []
+        for shape, (path, spec) in zip(shape_leaves, spec_paths):
+            axes_used = set()
+            for e in spec:
+                axes_used.update(e if isinstance(e, tuple) else (e,))
+            axes_used.discard(None)
+            nbytes = int(np.prod(shape or (1,))) * 4  # fp32 master
+            if axes_used & zero_axes:
+                n_zero += 1
+                bytes_zero += nbytes
+            elif axes_used:  # TP/expert/pipe-sharded, just not over ZeRO axes
+                n_model += 1
+                bytes_model += nbytes
+            else:
+                n_repl += 1
+                bytes_repl += nbytes
+                if len(repl_names) < 5:
+                    repl_names.append(jax.tree_util.keystr(path))
+        msg = (f"ZeRO-{self.config.zero_config.stage} partitioning over "
+               f"{tuple(part.zero_axes)} (world {part.zero_size}): "
+               f"{n_zero} leaves ZeRO-sharded "
+               f"({bytes_zero / 2**20:.1f} MiB fp32)")
+        if n_model:
+            msg += (f", {n_model} model-parallel-sharded only "
+                    f"({bytes_model / 2**20:.1f} MiB fp32)")
+        msg += f", {n_repl} replicated ({bytes_repl / 2**20:.1f} MiB fp32)"
+        if n_repl:
+            msg += (f" — replicated (indivisible or rule-pinned): "
+                    f"{', '.join(repl_names)}"
+                    + (", …" if n_repl > len(repl_names) else ""))
+        log_dist(msg)
 
     # ------------------------------------------------------------------ #
     # reference property accessors (engine.py:770-1252 parity, abridged)
@@ -349,12 +411,17 @@ class DeepSpeedTPUEngine:
         value into EVERY param group). base_lr must stay the optimizer's
         factory lr — the step computes ``lr_scale = sched(t)/base_lr`` and
         the optimizer multiplies by its own lr, so resetting base_lr here
-        would cancel the scale and silently keep the old rate."""
+        would cancel the scale and silently keep the old rate.
+
+        The pinned value flows into the compiled step as a traced scalar
+        (``self._lr_override``), so per-interval set_lr (the RLHF pattern)
+        never thrashes recompiles."""
         self.lr_schedule = constant(float(lr))
         self.lr_scheduler = LRScheduler(self.lr_schedule)
         if getattr(self, "_grouped_ctor", None) is not None:
             # grouped optimizers have per-group lrs; reference semantics are
-            # uniform after set_lr → rebuild with every group pinned to lr
+            # uniform after set_lr → rebuild with every group pinned to lr.
+            # This changes the optimizer itself, so the cached steps must go.
             from ..ops.optimizers import grouped_optimizer
 
             name, groups, kwargs, ptree = self._grouped_ctor
@@ -364,7 +431,9 @@ class DeepSpeedTPUEngine:
             self.optimizer = grouped_optimizer(name, ptree, groups, **kwargs)
             # guard lr=0 (freeze): base_lr=0 would make lr_scale 0/0 = NaN
             self.base_lr = float(lr) or 1.0
-        self._train_step = None  # recompile with the new schedule
+            self._train_step = None
+            self._apply_step = None
+        self._lr_override = jnp.asarray(float(lr), jnp.float32)
 
     def get_mom(self) -> List[float]:
         b = self.optimizer.hyperparams.get("betas", (0.9, 0.999))
@@ -629,8 +698,8 @@ class DeepSpeedTPUEngine:
             else jnp.sum(a, axis=0), auxes)
         return grads, jnp.mean(losses), aux
 
-    def _apply_update(self, state: TrainState, grads, loss,
-                      aux=None) -> Tuple[TrainState, StepOutput]:
+    def _apply_update(self, state: TrainState, grads, loss, aux=None,
+                      lr_override=None) -> Tuple[TrainState, StepOutput]:
         cfg = self.config
         finite = grads_finite(grads)
         grads = unscale_grads(grads, state.loss_scale)
@@ -641,6 +710,8 @@ class DeepSpeedTPUEngine:
             grads = jax.tree.map(lambda g: g * clip_coef, grads)
 
         lr_t = self.lr_schedule(state.step.astype(jnp.float32))
+        if lr_override is not None:
+            lr_t = jnp.where(lr_override >= 0, lr_override, lr_t)
         lr_scale = lr_t / self.base_lr
 
         new_params, new_opt = self.optimizer.update(state.params, grads,
@@ -669,9 +740,9 @@ class DeepSpeedTPUEngine:
         return new_state, out
 
     def _build_train_step(self):
-        def step_fn(state: TrainState, batch):
+        def step_fn(state: TrainState, batch, lr_override):
             grads, loss, aux = self._accumulate(state.params, batch, state.loss_scale)
-            return self._apply_update(state, grads, loss, aux)
+            return self._apply_update(state, grads, loss, aux, lr_override)
 
         with self.mesh_mgr.activate():
             self._train_step = jax.jit(step_fn, donate_argnums=(0,))
@@ -721,7 +792,8 @@ class DeepSpeedTPUEngine:
             # difficulty = seq length; each bucket is its own cached jit
             batch = self.curriculum_scheduler.truncate(batch, self.global_steps)
         batch = self._shard_batch(batch, with_gas_dim=True)
-        self.state, out = self._train_step(self.state, batch)
+        self.state, out = self._train_step(self.state, batch,
+                                           self._lr_override)
         self.global_steps += 1
         self._last_grad_norm = out.grad_norm
         self.lr_scheduler.last_step = self.global_steps
@@ -783,12 +855,14 @@ class DeepSpeedTPUEngine:
         if self._apply_step is None:
             with self.mesh_mgr.activate():
                 self._apply_step = jax.jit(
-                    lambda state, grads, loss: self._apply_update(state, grads, loss),
+                    lambda state, grads, loss, lro: self._apply_update(
+                        state, grads, loss, lr_override=lro),
                     donate_argnums=(0,))
         n = self._pending_count
         grads = jax.tree.map(lambda g: g / n, self._pending_grads)
         loss = self._pending_loss / n
-        self.state, out = self._apply_step(self.state, grads, loss)
+        self.state, out = self._apply_step(self.state, grads, loss,
+                                           self._lr_override)
         self._pending_grads = None
         self._pending_loss = None
         self._pending_count = 0
@@ -850,7 +924,8 @@ class DeepSpeedTPUEngine:
                 example_batch = self.curriculum_scheduler.truncate(
                     example_batch, self.global_steps)
             batch = self._shard_batch(example_batch, with_gas_dim=True)
-            lowered = self._train_step.lower(self.state, batch)
+            lowered = self._train_step.lower(self.state, batch,
+                                             self._lr_override)
             compiled = lowered.compile()
             cost = compiled.cost_analysis() or {}
             if isinstance(cost, (list, tuple)):

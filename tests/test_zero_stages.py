@@ -80,7 +80,8 @@ def test_grad_accumulator_sharded_at_stage2(devices8):
         engine = _make_engine(stage, gas=4, batch=32)
         engine._build_train_step()
         batch = engine._shard_batch(_batch(0, batch=32), with_gas_dim=True)
-        compiled = engine._train_step.lower(engine.state, batch).compile()
+        compiled = engine._train_step.lower(engine.state, batch,
+                                               engine._lr_override).compile()
         mem = compiled.memory_analysis()
         temps[stage] = mem.temp_size_in_bytes
     assert temps[2] < temps[1], temps
