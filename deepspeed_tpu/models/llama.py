@@ -222,22 +222,28 @@ def _qkv_proj(cfg: LlamaConfig, y: jnp.ndarray, layer: Params):
 
 def _residual_sharding():
     """NamedSharding pinning the [batch, seq, hidden] residual stream to its
-    canonical layout (batch over data axes, seq over 'seq', hidden
-    replicated), or None when no TP/SP axis is active.
+    canonical layout — batch over the data axes, seq over ('seq', 'tensor'),
+    hidden replicated — or None when no TP/SP axis is active.
 
-    Megatron-SP analog: without this pin, SPMD propagation can land the TP
-    row-parallel all-reduce output hidden-sharded (backward-propagated from
-    the next layer's ZeRO-sharded weights) and then pays an involuntary full
-    rematerialization resharding it to batch/seq for the residual add
-    (observed in the r1 8-device dryrun). Pinning the dot output makes XLA
-    emit the partial-sum all-reduce over 'tensor' straight into the
-    batch/seq layout."""
+    This is the Megatron sequence-parallel pattern (Korthikanti et al. 2022):
+    with the residual's seq dim sharded over the TENSOR axis, the TP
+    row-parallel projections' partial sums REDUCE-SCATTER into seq shards
+    (and the column projections all-gather on entry) instead of all-reducing
+    into a tensor-replicated residual. Same wire bytes, but the residual,
+    norms, and their activations shrink by tp_size, and SPMD never lands the
+    residual hidden-sharded (the involuntary full-rematerialization boundary
+    observed in the r1 8-device dryrun).  Without the pin, propagation from
+    the next layer's ZeRO-sharded weights can reshard the residual
+    mid-stream."""
     try:
-        from ..comm.mesh import get_mesh
+        from ..comm.mesh import BATCH_AXES, get_mesh
 
         mm = get_mesh()
-        if mm.tp_world_size > 1 or mm.sp_world_size > 1:
-            return mm.batch_sharding(extra_seq_axis=True)
+        seq_axes = tuple(
+            a for a, on in (("seq", mm.sp_world_size > 1),
+                            ("tensor", mm.tp_world_size > 1)) if on)
+        if seq_axes:
+            return mm.sharding(BATCH_AXES, seq_axes)
     except Exception:
         pass
     return None
@@ -299,6 +305,10 @@ def apply(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray, *,
     # no residual pin inside the pipeline's manual shard_map region (the
     # full-mesh NamedSharding is not addressable from there)
     res_sharding = _residual_sharding() if pipe_stages == 1 else None
+    if res_sharding is not None:
+        # enter the blocks already in the residual layout so layer 0 doesn't
+        # pay a reshard inside the scan
+        x = lax.with_sharding_constraint(x, res_sharding)
     block = partial(_block, cfg, attn_fn=attn_fn, res_sharding=res_sharding)
     if cfg.remat:
         # route through the shared remat-policy registry

@@ -233,23 +233,13 @@ class DeepSpeedTPUEngine:
             params = jax.jit(
                 lambda p: p, out_shardings=self._master_shardings)(params)
             opt_state = self._init_opt_state(params)
-            # scalars go through a jitted identity under the mesh so their
-            # avals carry the same mesh-tracked context as the step outputs —
-            # otherwise the second train_batch always pays one full
-            # retrace/recompile (params/opt_state already come out of jits)
-            repl = NamedSharding(mesh_mgr.mesh, P())
-            step0, loss_scale, skipped0 = jax.jit(
-                lambda s: s,
-                out_shardings=jax.tree.map(lambda _: repl, (
-                    0, make_loss_scaler(config.fp16), 0)))(
-                (jnp.zeros((), jnp.int32), make_loss_scaler(config.fp16),
-                 jnp.zeros((), jnp.int32)))
+            loss_scale = make_loss_scaler(config.fp16)
             self.state = TrainState(
-                step=step0,
+                step=jnp.zeros((), jnp.int32),
                 params=params,
                 opt_state=opt_state,
                 loss_scale=loss_scale,
-                skipped_steps=skipped0,
+                skipped_steps=jnp.zeros((), jnp.int32),
             )
 
         # --- compiled steps ---

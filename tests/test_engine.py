@@ -287,8 +287,9 @@ def test_set_lr_does_not_recompile(devices8):
         "train_batch_size": 8,
         "optimizer": {"type": "sgd", "params": {"lr": 0.1}}})
     batch = {"x": np.ones((8,), np.float32)}
-    # two warm steps: the second always retraces once (the output state's
-    # scalars carry mesh-tracked avals the freshly-built state lacks)
+    # two warm steps: step 2 adds one FREE cache-key variant (output-state
+    # avals differ from the fresh state's; tracing hits the jaxpr cache and
+    # no XLA recompile happens) — measure from the settled count
     engine.train_batch(batch)
     engine.train_batch(batch)
     step_obj = engine._train_step
