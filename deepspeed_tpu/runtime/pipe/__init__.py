@@ -1,3 +1,5 @@
+from .hetero import LayerSpec, build_pipeline_model, partition_layers
 from .module import pipeline_apply
 
-__all__ = ["pipeline_apply"]
+__all__ = ["pipeline_apply", "LayerSpec", "partition_layers",
+           "build_pipeline_model"]

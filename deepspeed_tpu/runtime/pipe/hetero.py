@@ -1,0 +1,415 @@
+"""Heterogeneous pipeline stages (reference ``PipelineModule`` partitioning).
+
+Reference parity: ``runtime/pipe/module.py:86`` — ``PipelineModule`` accepts
+an arbitrary ``LayerSpec`` list and partitions it across stages by
+``partition_method`` ``'uniform' | 'parameters' | 'type:regex'``
+(``module.py:378``); heterogeneous models (mixed block types, mid-model
+adapters, tower + head) pipeline through the same engine.
+
+TPU-first redesign: stages still execute under ONE compiled 1F1B SPMD clock
+(see ``one_f_one_b.py`` — ppermute rings, recompute-backward, O(S) stash);
+per-stage heterogeneity enters as a ``lax.switch`` over the stage index whose
+branches are the stages' sub-programs. Stage params ride ``shard_map`` as
+explicit inputs, replicated over 'pipe' — ZeRO/TP sharding over the OTHER
+mesh axes still applies outside the manual region, so per-rank param bytes
+match plain DP. The homogeneous stacked path (``one_f_one_b``) keeps true
+stage-local parameter placement and remains the fast path for uniform layer
+stacks; this module buys capability (arbitrary stage programs), not memory.
+
+Activation contract: every stage boundary carries the SAME activation
+shape/dtype (the classic pipeline constraint; the reference's p2p send/recv
+requires it too).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ...comm.mesh import get_mesh
+from .module import (one_f_one_b_predicates, one_f_one_b_ticks, psum_f32,
+                     ring_perms)
+
+
+# --------------------------------------------------------------------------- #
+# layer specs + partitioning (reference module.py:378 partition methods)
+# --------------------------------------------------------------------------- #
+@dataclass
+class LayerSpec:
+    """One pipeline-able layer: a typename (for ``type:`` partitioning), its
+    params pytree, and ``apply(params, h) -> h``. Reference ``LayerSpec``
+    defers construction; here params are a pytree and apply is pure."""
+
+    typename: str
+    params: Any
+    apply: Callable[[Any, jnp.ndarray], jnp.ndarray]
+
+
+def _num_params(tree: Any) -> int:
+    return sum(int(np.prod(x.shape)) for x in jax.tree.leaves(tree))
+
+
+def _balanced_partition(weights: Sequence[float], n_parts: int) -> List[int]:
+    """Boundaries [b_0=0, ..., b_n=len] of the contiguous partition minimizing
+    the max part weight (the reference's ``ds_utils.partition_balanced``).
+    Binary search on the bottleneck + greedy feasibility check."""
+    w = [float(x) for x in weights]
+    n = len(w)
+    if n_parts > n:
+        raise ValueError(f"cannot split {n} layers into {n_parts} stages")
+
+    def parts_needed(cap: float) -> int:
+        parts, acc = 1, 0.0
+        for x in w:
+            if x > cap:
+                return n_parts + 1  # infeasible cap
+            if acc + x > cap:
+                parts, acc = parts + 1, x
+            else:
+                acc += x
+        return parts
+
+    lo, hi = max(w) if w else 0.0, sum(w)
+    for _ in range(60):
+        mid = (lo + hi) / 2
+        if parts_needed(mid) <= n_parts:
+            hi = mid
+        else:
+            lo = mid
+    # greedy emit with cap=hi, then pad empty trailing parts if fewer used —
+    # but every stage must own >= 1 layer, so rebalance from the rear
+    bounds = [0]
+    acc = 0.0
+    for i, x in enumerate(w):
+        if acc + x > hi and len(bounds) < n_parts:
+            bounds.append(i)
+            acc = x
+        else:
+            acc += x
+    bounds.append(n)
+    while len(bounds) < n_parts + 1:  # fewer parts than requested: split rear
+        for j in range(len(bounds) - 1, 0, -1):
+            if bounds[j] - bounds[j - 1] > 1:
+                bounds.insert(j, bounds[j] - 1)
+                break
+        else:
+            raise ValueError(f"cannot split {n} layers into {n_parts} stages")
+    return bounds
+
+
+def partition_layers(specs: Sequence[LayerSpec], n_stages: int,
+                     method: str = "parameters") -> List[int]:
+    """Stage boundaries for a LayerSpec list (reference ``module.py:378``):
+
+    - ``'uniform'``    — equal layer counts;
+    - ``'parameters'`` — balance per-stage parameter counts;
+    - ``'type:regex'`` — balance the count of layers whose typename matches
+      ``regex`` (non-matching layers ride with their preceding group).
+    Returns ``bounds`` with ``len == n_stages + 1``; stage s owns
+    ``specs[bounds[s]:bounds[s+1]]``.
+    """
+    if method == "uniform":
+        weights = [1.0] * len(specs)
+    elif method == "parameters":
+        weights = [float(_num_params(s.params)) for s in specs]
+    elif method.startswith("type:"):
+        pat = re.compile(method[len("type:"):], re.IGNORECASE)
+        weights = [1.0 if pat.search(s.typename) else 0.0 for s in specs]
+        if sum(weights) < n_stages:
+            raise ValueError(
+                f"partition '{method}': only {int(sum(weights))} matching "
+                f"layers for {n_stages} stages")
+    else:
+        raise ValueError(f"unknown partition_method '{method}' "
+                         "(want 'uniform' | 'parameters' | 'type:regex')")
+    bounds = _balanced_partition(weights, n_stages)
+    return bounds
+
+
+# --------------------------------------------------------------------------- #
+# the compiled heterogeneous 1F1B clock
+# --------------------------------------------------------------------------- #
+def hetero_pipeline_value_and_grad(
+        first_fn: Callable[[Any, Any], jnp.ndarray],
+        mid_fns: Sequence[Callable[[Any, jnp.ndarray], jnp.ndarray]],
+        last_fn: Callable[[Any, jnp.ndarray, Any], jnp.ndarray],
+        stage_params: Sequence[Any], inputs: Any, labels: Any, *,
+        num_micro: Optional[int] = None,
+        pipe_axis: str = "pipe") -> Tuple[jnp.ndarray, Tuple[Any, ...]]:
+    """1F1B over ``S = 2 + len(mid_fns)`` heterogeneous stages.
+
+    first_fn(p0, inputs_micro) -> h            (stage 0: embed + its blocks)
+    mid_fns[s-1](ps, h) -> h                   (stages 1..S-2)
+    last_fn(pS, h, labels_micro) -> sum loss   (last stage: blocks + head)
+
+    Returns ``(mean-ish loss, per-stage grads tuple)`` with the same
+    ``(1/M)·Σ`` scaling contract as ``pipeline_value_and_grad``.
+    Falls back to sequential value_and_grad when the mesh has pipe <= 1.
+    """
+    mm = get_mesh()
+    S = len(stage_params)
+    if mm.axis_size(pipe_axis) != S and mm.axis_size(pipe_axis) > 1:
+        raise ValueError(
+            f"model was partitioned into {S} stage(s) but the mesh's "
+            f"'{pipe_axis}' axis has size {mm.axis_size(pipe_axis)} — "
+            f"build the pipeline model AFTER the mesh exists, or pass "
+            f"n_stages={mm.axis_size(pipe_axis)} to build_pipeline_model")
+    if S != 2 + len(mid_fns):
+        raise ValueError(
+            f"stage count mismatch: {S} stage param trees but "
+            f"{len(mid_fns)} mid fns (expect S == 2 + len(mid_fns))")
+
+    if mm.axis_size(pipe_axis) <= 1:
+        def flat_loss(ps):
+            h = first_fn(ps[0], inputs)
+            for fn, p in zip(mid_fns, ps[1:-1]):
+                h = fn(p, h)
+            return last_fn(ps[-1], h, labels)
+
+        loss, grads = jax.value_and_grad(flat_loss)(tuple(stage_params))
+        return loss, grads
+
+    M = num_micro or S
+    B = jax.tree.leaves(inputs)[0].shape[0]
+    if B % M != 0:
+        raise ValueError(f"batch {B} not divisible by num_micro {M}")
+    split = lambda x: x.reshape((M, B // M) + x.shape[1:])  # noqa: E731
+    micro_in = jax.tree.map(split, inputs)
+    micro_lab = jax.tree.map(split, labels)
+
+    fwd_perm, bwd_perm = ring_perms(S)
+    T = one_f_one_b_ticks(S, M)
+
+    # activation template from stage 0 (shape-only)
+    probe = jax.eval_shape(first_fn, stage_params[0],
+                           jax.tree.map(lambda x: x[0], micro_in))
+    f32z = lambda t: jax.tree.map(  # noqa: E731
+        lambda x: jnp.zeros(x.shape, jnp.float32), t)
+
+    def pipelined(params, micro_in, micro_lab, probe_shape):
+        stage = lax.axis_index(pipe_axis)
+        stash = jnp.zeros((S,) + probe_shape.shape, probe_shape.dtype)
+        h_next = jnp.zeros_like(probe_shape)
+        g_next = jnp.zeros_like(probe_shape)
+        g_params = tuple(f32z(p) for p in params)
+        loss_sum = jnp.zeros((), jnp.float32)
+
+        def tick(t, carry):
+            stash, h_next, g_next, g_params, loss_sum = carry
+            fwd_on, i_f, bwd_on, i_b = one_f_one_b_predicates(t, stage, S, M)
+
+            # ---- forward tick: lax.switch over the stage's sub-program ----
+            def do_fwd(stash, h_next, loss_sum):
+                inj = jax.tree.map(lambda x: x[i_f], micro_in)
+                lab = jax.tree.map(lambda x: x[i_f], micro_lab)
+
+                def b_first():
+                    return (first_fn(params[0], inj)
+                            .astype(probe_shape.dtype),
+                            jnp.zeros((), jnp.float32))
+
+                def b_mid(s):
+                    def f():
+                        return (mid_fns[s - 1](params[s], h_next)
+                                .astype(probe_shape.dtype),
+                                jnp.zeros((), jnp.float32))
+                    return f
+
+                def b_last():
+                    return (jnp.zeros_like(h_next),
+                            last_fn(params[-1], h_next, lab)
+                            .astype(jnp.float32))
+
+                branches = ([b_first] + [b_mid(s) for s in range(1, S - 1)]
+                            + [b_last])
+                out, loss_i = lax.switch(stage, branches)
+                # stash the stage INPUT for the recompute backward (stage 0
+                # re-injects from micro_in instead; slot unused)
+                stash = lax.dynamic_update_index_in_dim(stash, h_next,
+                                                        i_f % S, 0)
+                return stash, out, loss_sum + loss_i
+
+            stash, fwd_out, loss_sum = lax.cond(
+                fwd_on, do_fwd,
+                lambda stash, h_next, loss_sum: (
+                    stash, jnp.zeros_like(h_next), loss_sum),
+                stash, h_next, loss_sum)
+
+            # ---- backward tick (recompute + vjp, switch per stage) ----
+            def do_bwd(g_next, g_params):
+                h_in = lax.dynamic_index_in_dim(stash, i_b % S, 0,
+                                                keepdims=False)
+                inj = jax.tree.map(lambda x: x[i_b], micro_in)
+                lab = jax.tree.map(lambda x: x[i_b], micro_lab)
+                zeros_g = tuple(f32z(p) for p in params)
+
+                def set_s(tup, s, val):
+                    return tuple(val if i == s else x
+                                 for i, x in enumerate(tup))
+
+                def b_first():
+                    _, vjp = jax.vjp(
+                        lambda p: first_fn(p, inj).astype(g_next.dtype),
+                        params[0])
+                    (gp,) = vjp(g_next)
+                    return (set_s(zeros_g, 0,
+                                  jax.tree.map(lambda x: x.astype(jnp.float32),
+                                               gp)),
+                            jnp.zeros_like(g_next))
+
+                def b_mid(s):
+                    def f():
+                        # primal carries the SAME cast as the forward tick so
+                        # the cotangent seed dtype always matches, whatever
+                        # dtype the stage's apply returns
+                        out, vjp = jax.vjp(
+                            lambda p, h: mid_fns[s - 1](p, h)
+                            .astype(probe_shape.dtype), params[s], h_in)
+                        gp, gh = vjp(g_next.astype(out.dtype))
+                        return (set_s(zeros_g, s,
+                                      jax.tree.map(
+                                          lambda x: x.astype(jnp.float32),
+                                          gp)),
+                                gh.astype(g_next.dtype))
+                    return f
+
+                def b_last():
+                    _, vjp = jax.vjp(
+                        lambda p, h: (last_fn(p, h, lab) / M)
+                        .astype(jnp.float32), params[-1], h_in)
+                    gp, gh = vjp(jnp.ones((), jnp.float32))
+                    return (set_s(zeros_g, S - 1,
+                                  jax.tree.map(lambda x: x.astype(jnp.float32),
+                                               gp)),
+                            gh.astype(g_next.dtype))
+
+                branches = ([b_first] + [b_mid(s) for s in range(1, S - 1)]
+                            + [b_last])
+                gp_all, gh = lax.switch(stage, branches)
+                g_params = jax.tree.map(jnp.add, g_params, gp_all)
+                return gh, g_params
+
+            g_out, g_params = lax.cond(
+                bwd_on, do_bwd,
+                lambda g_next, g_params: (jnp.zeros_like(g_next), g_params),
+                g_next, g_params)
+
+            h_next = lax.ppermute(fwd_out, pipe_axis, fwd_perm)
+            g_next = lax.ppermute(g_out, pipe_axis, bwd_perm)
+            return stash, h_next, g_next, g_params, loss_sum
+
+        carry = (stash, h_next, g_next, g_params, loss_sum)
+        carry = lax.fori_loop(0, T, tick, carry)
+        _, _, _, g_params, loss_sum = carry
+        loss = lax.psum(loss_sum, pipe_axis) / M
+        g_params = jax.tree.map(lambda g: psum_f32(g, pipe_axis), g_params)
+        return loss, g_params
+
+    probe_shape = jnp.zeros(probe.shape, probe.dtype)
+    params = tuple(stage_params)
+    loss, grads = jax.shard_map(
+        pipelined, mesh=mm.mesh, axis_names={pipe_axis},
+        in_specs=(jax.tree.map(lambda _: P(), params), P(), P(), P()),
+        out_specs=(P(), jax.tree.map(lambda _: P(), params)),
+        check_vma=False)(params, micro_in, micro_lab, probe_shape)
+    return loss, grads
+
+
+# --------------------------------------------------------------------------- #
+# PipelineModule analog: LayerSpecs → engine-ready ModelSpec
+# --------------------------------------------------------------------------- #
+def build_pipeline_model(specs: Sequence[LayerSpec],
+                         first_fn: Callable[[Any, Any], jnp.ndarray],
+                         loss_head: Callable[[jnp.ndarray, Any], jnp.ndarray],
+                         *, n_stages: Optional[int] = None,
+                         partition_method: str = "parameters",
+                         name: str = "hetero_pipeline"):
+    """Reference ``PipelineModule(layers=specs, num_stages=..,
+    partition_method=..)`` analog: group the LayerSpecs into stages and
+    return an engine-ready ``ModelSpec`` whose ``pipeline_grad_fn`` runs the
+    heterogeneous compiled 1F1B clock (and whose ``loss_fn`` runs the same
+    stages sequentially off-pipeline).
+
+    ``first_fn(p, batch_inputs) -> h`` embeds the raw micro inputs using the
+    FIRST spec's params; ``loss_head(h, labels) -> summed loss`` closes the
+    LAST stage. Stage s params live under key ``f"stage{s}"``.
+    """
+    from ..engine import ModelSpec
+
+    mm = None
+    try:
+        mm = get_mesh()
+    except Exception:
+        pass
+    S = n_stages or (mm.pp_world_size if mm is not None else 1)
+    S = max(S, 1)
+    if S == 1:
+        bounds = [0, len(specs)]
+    else:
+        bounds = partition_layers(specs, S, partition_method)
+
+    groups = [list(range(bounds[s], bounds[s + 1])) for s in range(len(bounds) - 1)]
+    params = {f"stage{s}": {str(i): specs[i].params for i in g}
+              for s, g in enumerate(groups)}
+
+    def run_group(s, p_stage, h, first=False, inputs=None):
+        for j, i in enumerate(groups[s]):
+            if first and j == 0:
+                h = first_fn(p_stage[str(i)], inputs)
+            else:
+                h = specs[i].apply(p_stage[str(i)], h)
+        return h
+
+    def split_batch(batch):
+        tokens = batch["tokens"]
+        if "labels" in batch:
+            return tokens, batch["labels"]
+        return tokens[:, :-1], tokens[:, 1:]
+
+    def loss_fn(p, batch):
+        inputs, labels = split_batch(batch)
+        h = None
+        for s in range(len(groups)):
+            h = run_group(s, p[f"stage{s}"], h, first=(s == 0),
+                          inputs=inputs)
+        loss = loss_head(h, labels)
+        denom = jnp.maximum(jax.tree.leaves(labels)[0].size, 1)
+        return loss / denom, {}
+
+    def pipeline_grad_fn(p, batch, loss_scale=None):
+        inputs, labels = split_batch(batch)
+        scale = 1.0 if loss_scale is None else loss_scale
+        n = len(groups)
+
+        def fst(p0, inp):
+            return run_group(0, p0, None, first=True, inputs=inp)
+
+        def mid(s):
+            return lambda ps, h: run_group(s, ps, h)
+
+        def lst(pl, h, lab):
+            return loss_head(run_group(n - 1, pl, h), lab) * scale
+
+        stage_params = [p[f"stage{s}"] for s in range(n)]
+        loss, grads = hetero_pipeline_value_and_grad(
+            fst, [mid(s) for s in range(1, n - 1)], lst, stage_params,
+            inputs, labels)
+        M = max(get_mesh().pp_world_size, 1)
+        denom = jnp.maximum(jax.tree.leaves(labels)[0].size, 1) \
+            .astype(jnp.float32)
+        factor = M / denom
+        out_grads = {f"stage{s}": jax.tree.map(lambda g: g * factor, gs)
+                     for s, gs in enumerate(grads)}
+        loss = loss * factor / scale
+        return out_grads, loss, {}
+
+    return ModelSpec(loss_fn=loss_fn, params=params, name=name,
+                     pipeline_capable=False,
+                     pipeline_grad_fn=pipeline_grad_fn)

@@ -44,6 +44,35 @@ def psum_f32(x, axis_name: str):
     return lax.psum(x, axis_name)
 
 
+def ring_perms(S: int):
+    """(forward, backward) neighbor rings over the pipe axis — the
+    SendActivation/RecvActivation and SendGrad/RecvGrad channels."""
+    fwd = [(i, (i + 1) % S) for i in range(S)]
+    return fwd, [(dst, src) for src, dst in fwd]
+
+
+def one_f_one_b_ticks(S: int, M: int) -> int:
+    """Total clock ticks of the 1F1B schedule: 2M + 2S - 2."""
+    return 2 * M + 2 * S - 2
+
+
+def one_f_one_b_predicates(t, stage, S: int, M: int):
+    """The 1F1B clock: at tick ``t`` stage ``s`` forwards microbatch ``i``
+    iff ``t == s + 2i`` and backwards ``i`` iff ``t == (2S - 1 - s) + 2i``
+    (fwd/bwd ticks have opposite parity per stage, so each tick issues at
+    most one unit of work). Returns ``(fwd_on, i_f, bwd_on, i_b)`` with the
+    microbatch indices clipped into [0, M)."""
+    df = t - stage
+    fwd_on = jnp.logical_and(df >= 0,
+                             jnp.logical_and(df % 2 == 0, df < 2 * M))
+    i_f = jnp.clip(df // 2, 0, M - 1)
+    db = t - (2 * S - 1 - stage)
+    bwd_on = jnp.logical_and(db >= 0,
+                             jnp.logical_and(db % 2 == 0, db < 2 * M))
+    i_b = jnp.clip(db // 2, 0, M - 1)
+    return fwd_on, i_f, bwd_on, i_b
+
+
 def _stage_params(layers: Any, stages: int) -> Any:
     """[L, ...] → [S, L/S, ...] on every leaf."""
 

@@ -41,7 +41,8 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ...comm.mesh import get_mesh
-from .module import _stage_params, psum_f32
+from .module import (_stage_params, one_f_one_b_predicates,
+                     one_f_one_b_ticks, psum_f32, ring_perms)
 
 
 def pipeline_value_and_grad(embed_fn: Callable[[Any, Any], jnp.ndarray],
@@ -86,9 +87,8 @@ def pipeline_value_and_grad(embed_fn: Callable[[Any, Any], jnp.ndarray],
     micro_lab = jax.tree.map(split, labels)
     staged = _stage_params(layers, S)
 
-    fwd_perm = [(i, (i + 1) % S) for i in range(S)]
-    bwd_perm = [((i + 1) % S, i) for i in range(S)]
-    T = 2 * M + 2 * S - 2
+    fwd_perm, bwd_perm = ring_perms(S)
+    T = one_f_one_b_ticks(S, M)
 
     def stage_fwd(my_layers, h):
         def body(h, layer):
@@ -120,14 +120,7 @@ def pipeline_value_and_grad(embed_fn: Callable[[Any, Any], jnp.ndarray],
             stash, h_next, g_next, g_layers, g_embed, g_head, loss_sum = carry
 
             # ---- schedule predicates (1F1B clock) ----
-            df = t - stage
-            fwd_on = jnp.logical_and(df >= 0,
-                                     jnp.logical_and(df % 2 == 0, df < 2 * M))
-            i_f = jnp.clip(df // 2, 0, M - 1)
-            db = t - (2 * S - 1 - stage)
-            bwd_on = jnp.logical_and(db >= 0,
-                                     jnp.logical_and(db % 2 == 0, db < 2 * M))
-            i_b = jnp.clip(db // 2, 0, M - 1)
+            fwd_on, i_f, bwd_on, i_b = one_f_one_b_predicates(t, stage, S, M)
 
             # ---- forward tick ----
             def do_fwd(stash, h_next, loss_sum):

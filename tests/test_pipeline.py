@@ -175,3 +175,104 @@ def test_1f1b_memory_bounded_vs_gpipe_ad(devices8):
         m_gpipe = f2.lower(params, tokens).compile().memory_analysis()
     assert m_1f1b.temp_size_in_bytes < m_gpipe.temp_size_in_bytes, (
         m_1f1b.temp_size_in_bytes, m_gpipe.temp_size_in_bytes)
+
+
+# --------------------------------------------------------------------------- #
+# heterogeneous stages (reference PipelineModule partition_method, module.py:378)
+# --------------------------------------------------------------------------- #
+def test_partition_layers_methods():
+    from deepspeed_tpu.runtime.pipe.hetero import LayerSpec, partition_layers
+
+    def mk(name, n):
+        return LayerSpec(name, {"w": jnp.zeros((n,))}, lambda p, h: h)
+
+    specs = [mk("Embed", 100), mk("Block", 1000), mk("Block", 1000),
+             mk("Adapter", 10), mk("Block", 1000), mk("Head", 100)]
+    # uniform: equal layer counts
+    assert partition_layers(specs, 3, "uniform") == [0, 2, 4, 6]
+    # parameters: balance the 1000-weight blocks (bottleneck-minimal)
+    b = partition_layers(specs, 2, "parameters")
+    counts = [sum(int(jnp.size(s.params["w"])) for s in specs[b[i]:b[i + 1]])
+              for i in range(2)]
+    assert max(counts) <= 2110, (b, counts)
+    # type:regex — balance matching Block layers across stages
+    b = partition_layers(specs, 3, "type:Block")
+    blocks_per_stage = [sum(1 for s in specs[b[i]:b[i + 1]]
+                            if s.typename == "Block") for i in range(3)]
+    assert blocks_per_stage == [1, 1, 1], (b, blocks_per_stage)
+    with pytest.raises(ValueError):
+        partition_layers(specs, 4, "type:Block")  # only 3 Blocks
+    with pytest.raises(ValueError):
+        partition_layers(specs, 2, "type:NoSuch")
+
+
+def test_hetero_pipeline_matches_sequential(devices8):
+    """Non-uniform blocks (wide MLP tower + narrow residual blocks + head)
+    through the compiled heterogeneous 1F1B clock: loss trajectory must match
+    the same model trained WITHOUT a pipe axis, step for step."""
+    from deepspeed_tpu.comm import mesh as mesh_lib
+    from deepspeed_tpu.runtime.pipe.hetero import (LayerSpec,
+                                                   build_pipeline_model)
+
+    d, vocab = 16, 64
+    ks = jax.random.split(jax.random.PRNGKey(0), 8)
+
+    def embed_apply(p, tokens):
+        return p["e"][tokens]
+
+    def wide_apply(p, h):  # MLP block, d->4d->d
+        return h + jnp.tanh(h @ p["up"]) @ p["down"]
+
+    def narrow_apply(p, h):  # cheap residual block (different structure)
+        return h + jnp.tanh(h * p["scale"] + p["bias"])
+
+    def head_apply(p, h):
+        return h @ p["out"]
+
+    def make_specs():
+        return [
+            LayerSpec("Embed", {"e": jax.random.normal(ks[0], (vocab, d)) * 0.1},
+                      embed_apply),
+            LayerSpec("Wide", {"up": jax.random.normal(ks[1], (d, 4 * d)) * 0.1,
+                               "down": jax.random.normal(ks[2], (4 * d, d)) * 0.1},
+                      wide_apply),
+            LayerSpec("Wide", {"up": jax.random.normal(ks[3], (d, 4 * d)) * 0.1,
+                               "down": jax.random.normal(ks[4], (4 * d, d)) * 0.1},
+                      wide_apply),
+            LayerSpec("Narrow", {"scale": jnp.ones((d,)),
+                                 "bias": jnp.zeros((d,))}, narrow_apply),
+            LayerSpec("Narrow", {"scale": jnp.ones((d,)),
+                                 "bias": jnp.zeros((d,))}, narrow_apply),
+            LayerSpec("Head", {"out": jax.random.normal(ks[5], (d, vocab)) * 0.1},
+                      head_apply),
+        ]
+
+    def loss_head(logits, labels):
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return -jnp.take_along_axis(logp, labels[..., None],
+                                    axis=-1).sum()
+
+    def first_fn(p, tokens):
+        return embed_apply(p, tokens)
+
+    tokens = np.asarray(jax.random.randint(jax.random.PRNGKey(9), (8, 9),
+                                           0, vocab))
+
+    def run(mesh_cfg):
+        mesh_lib.set_mesh(None)
+        base = {"train_batch_size": 8,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+                "steps_per_print": 0}
+        base.update(mesh_cfg)
+        spec = build_pipeline_model(
+            make_specs(), first_fn, loss_head,
+            n_stages=mesh_cfg.get("mesh", {}).get("pipe", 1),
+            partition_method="parameters")
+        engine, *_ = dst.initialize(model=spec, config=base)
+        return [float(engine.train_batch({"tokens": tokens}).loss)
+                for _ in range(5)]
+
+    seq_losses = run({})
+    pp_losses = run({"mesh": {"data": 4, "pipe": 2}})
+    assert seq_losses[-1] < seq_losses[0]  # it actually learns
+    np.testing.assert_allclose(seq_losses, pp_losses, rtol=5e-4, atol=5e-5)
