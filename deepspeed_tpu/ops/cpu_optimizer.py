@@ -56,6 +56,36 @@ def _check(a: np.ndarray, name: str):
                         f"{a.dtype}/{a.flags.c_contiguous}")
 
 
+def adam_step_buffers(p: np.ndarray, g: np.ndarray, m: np.ndarray,
+                      v: np.ndarray, *, lr: float, betas=(0.9, 0.999),
+                      eps: float = 1e-8, weight_decay: float = 0.0,
+                      step: int = 1, adamw_mode: bool = True,
+                      bias_correction: bool = True) -> None:
+    """One Adam/AdamW update over caller-owned contiguous fp32 buffers,
+    in place (SIMD kernel when available). The streaming NVMe optimizer
+    feeds swapped-in sub-group buffers through this; ``DeepSpeedCPUAdam``
+    uses it for its internally-held state."""
+    _check(p, "param")
+    _check(g, "grad")
+    _check(m, "exp_avg")
+    _check(v, "exp_avg_sq")
+    b1, b2 = betas
+    lib = _lib()
+    if lib is not None:
+        lib.ds_adam_step(_ptr(p), _ptr(g), _ptr(m), _ptr(v), p.size,
+                         lr, b1, b2, eps, weight_decay, step,
+                         int(adamw_mode), int(bias_correction))
+        return
+    grad = g if adamw_mode else g + weight_decay * p
+    m[:] = b1 * m + (1 - b1) * grad
+    v[:] = b2 * v + (1 - b2) * grad * grad
+    bc1 = 1 - b1 ** step if bias_correction else 1
+    bc2 = 1 - b2 ** step if bias_correction else 1
+    denom = np.sqrt(v) / np.sqrt(bc2) + eps
+    decay = lr * weight_decay * p if adamw_mode else 0.0
+    p -= (lr / bc1) * (m / denom) + decay
+
+
 class DeepSpeedCPUAdam:
     """Adam/AdamW over host-resident numpy state.
 
@@ -84,25 +114,13 @@ class DeepSpeedCPUAdam:
     def step(self, grads: List[np.ndarray], lr: Optional[float] = None):
         lr = self.lr if lr is None else lr
         self.step_count += 1
-        b1, b2 = self.betas
         for p, g, m, v in zip(self.params, grads, self.exp_avg,
                               self.exp_avg_sq):
-            _check(g, "grad")
-            if self._native is not None:
-                self._native.ds_adam_step(
-                    _ptr(p), _ptr(g), _ptr(m), _ptr(v), p.size,
-                    lr, b1, b2, self.eps, self.weight_decay,
-                    self.step_count, int(self.adamw_mode),
-                    int(self.bias_correction))
-            else:
-                grad = g if self.adamw_mode else g + self.weight_decay * p
-                m[:] = b1 * m + (1 - b1) * grad
-                v[:] = b2 * v + (1 - b2) * grad * grad
-                bc1 = 1 - b1 ** self.step_count if self.bias_correction else 1
-                bc2 = 1 - b2 ** self.step_count if self.bias_correction else 1
-                denom = np.sqrt(v) / np.sqrt(bc2) + self.eps
-                decay = lr * self.weight_decay * p if self.adamw_mode else 0.0
-                p -= (lr / bc1) * (m / denom) + decay
+            adam_step_buffers(p, g, m, v, lr=lr, betas=self.betas,
+                              eps=self.eps, weight_decay=self.weight_decay,
+                              step=self.step_count,
+                              adamw_mode=self.adamw_mode,
+                              bias_correction=self.bias_correction)
 
     def state_dict(self) -> Dict[str, Any]:
         return {"step": self.step_count, "exp_avg": self.exp_avg,

@@ -69,6 +69,12 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
     }
     ce.save(state_dict, os.path.join(path, "state"))
 
+    # NVMe-streamed optimizer tier: its fp32 masters + moments live in .swp
+    # files, not in state.opt_state — stream-copy them into the checkpoint
+    nvme = getattr(engine, "_nvme_opt", None)
+    if nvme is not None and jax.process_index() == 0:
+        nvme.save_state_files(os.path.join(path, "nvme_optimizer"))
+
     meta = {
         "global_steps": engine.global_steps,
         "micro_steps": engine.micro_steps,
@@ -138,6 +144,16 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
                                        jax.tree.leaves(restored["loss_scale"])]),
         step=small(restored["step"]),
         skipped_steps=small(restored["skipped_steps"]))
+
+    nvme = getattr(engine, "_nvme_opt", None)
+    if nvme is not None:
+        nvme_dir = os.path.join(path, "nvme_optimizer")
+        if os.path.isdir(nvme_dir):
+            nvme.load_state_files(nvme_dir)
+        else:
+            logger.warning(
+                f"checkpoint {path} has no nvme_optimizer state — the "
+                f"streamed masters/moments keep their current values")
 
     meta_path = os.path.join(path, "meta.json")
     client_state: Dict[str, Any] = {}

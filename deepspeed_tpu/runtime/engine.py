@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
+import os
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Iterable, List, NamedTuple, Optional, Tuple
 
@@ -227,12 +228,27 @@ class DeepSpeedTPUEngine:
         self._master_shardings = self.partitioner.shardings(opt_specs)
         self._log_zero_sharding_summary(shapes, opt_specs)
 
+        # --- ZeRO-Infinity: NVMe-streamed optimizer tier (reference
+        # stage3.py:2412 sub-group swap cycle; offload_config device=nvme) ---
+        self._nvme_opt = None
+        if config.zero_config.offload_optimizer.device == "nvme":
+            self._configure_nvme_optimizer(params)
+
         with mesh_mgr.activate():
-            # masters live ZeRO-sharded from stage 1 up; the bf16 compute copy
-            # is gathered per step in _loss (cast + sharding constraint)
-            params = jax.jit(
-                lambda p: p, out_shardings=self._master_shardings)(params)
-            opt_state = self._init_opt_state(params)
+            if self._nvme_opt is not None:
+                # fp32 masters + moments live on NVMe; the device holds ONLY
+                # the bf16/compute copy (stage layout — ZeRO-sharded at 3)
+                params = jax.jit(
+                    self.precision.cast_to_compute,
+                    out_shardings=self._param_shardings)(params)
+                opt_state = ()
+                self.opt_state_specs = ()
+            else:
+                # masters live ZeRO-sharded from stage 1 up; the bf16 compute
+                # copy is gathered per step in _loss (cast + constraint)
+                params = jax.jit(
+                    lambda p: p, out_shardings=self._master_shardings)(params)
+                opt_state = self._init_opt_state(params)
             # scalars go through a jitted identity with explicit replicated
             # out_shardings: freshly-built uncommitted scalars would otherwise
             # differ from the step outputs' committed NamedSharding avals and
@@ -301,6 +317,117 @@ class DeepSpeedTPUEngine:
             f"dtype={config.compute_dtype} mesh={dict(mesh_mgr.mesh.shape)} "
             f"micro_batch={self.train_micro_batch_size_per_gpu()} "
             f"gas={self.gradient_accumulation_steps()}")
+
+    def _configure_nvme_optimizer(self, params) -> None:
+        """ZeRO-Infinity optimizer tier: fp32 masters + Adam moments live on
+        NVMe and STREAM through the step per sub-group (reference
+        ``stage3.py:2412`` swap_in → update → swap_out; ``:679``
+        ``_configure_tensor_swapping``). The training flow becomes: device
+        jit computes grads → host clip/overflow check → streamed host Adam →
+        updated bf16 copies return to the device. save/load_checkpoint
+        stream-copy the NVMe state files alongside the TrainState
+        (``saver.py`` → ``save_state_files``/``load_state_files``)."""
+        import tempfile
+
+        from .swap_tensor.streaming_optimizer import NVMeStreamingOptimizer
+
+        cfg = self.config
+        if cfg.fp16.enabled:
+            raise ValueError(
+                "offload_optimizer device=nvme supports bf16/fp32 training "
+                "(dynamic fp16 loss scaling is not wired through the host "
+                "optimizer tier)")
+        opt_type = (cfg.optimizer.type or "adamw").lower()
+        if opt_type not in ("adam", "adamw"):
+            raise ValueError(
+                f"offload_optimizer device=nvme streams Adam state; got "
+                f"optimizer type '{opt_type}'")
+        hp = dict(cfg.optimizer.params)
+        swap_dir = cfg.zero_config.offload_optimizer.nvme_path or \
+            os.path.join(tempfile.gettempdir(), "dstpu_nvme_opt")
+        leaves, self._nvme_treedef = jax.tree_util.tree_flatten(params)
+        # leaves pass through unconverted — the optimizer converts to fp32
+        # per sub-group inside its init loop, keeping bring-up bounded too
+        self._nvme_opt = NVMeStreamingOptimizer(
+            leaves,
+            os.path.join(swap_dir, "opt_state"),
+            lr=float(hp.get("lr", 1e-3)),
+            betas=tuple(hp.get("betas", (0.9, 0.999))),
+            eps=float(hp.get("eps", 1e-8)),
+            weight_decay=float(hp.get("weight_decay", 0.0)),
+            adamw_mode=(opt_type == "adamw"),
+            sub_group_size=int(cfg.zero_config.sub_group_size))
+
+    def _train_batch_nvme(self, batch) -> StepOutput:
+        """train_batch when the optimizer state streams through NVMe."""
+        import ml_dtypes
+
+        cfg = self.config
+        if not hasattr(self, "_nvme_grad_step"):
+            def grad_fn(params, b, ls):
+                return self._accumulate(params, b, ls)
+
+            with self.mesh_mgr.activate():
+                self._nvme_grad_step = jax.jit(grad_fn)
+        self.tput_timer.start()
+        if self.curriculum_scheduler is not None:
+            batch = self.curriculum_scheduler.truncate(batch,
+                                                       self.global_steps)
+        batch = self._shard_batch(batch, with_gas_dim=True)
+        grads, loss, aux = self._nvme_grad_step(self.state.params, batch,
+                                                self.state.loss_scale)
+        g_leaves = [np.asarray(g, np.float32)
+                    for g in jax.tree.leaves(grads)]
+        sq = sum(float(np.vdot(g, g)) for g in g_leaves)
+        grad_norm = float(np.sqrt(sq))
+        finite = np.isfinite(grad_norm)
+        # schedule driven by state.step (like the compiled path) so a
+        # skipped non-finite step does not advance the LR
+        lr_t = float(self.lr_schedule(jnp.asarray(int(self.state.step),
+                                                  jnp.float32)))
+        if float(self._lr_override) >= 0:
+            lr_t = float(self._lr_override)
+        if finite:
+            if cfg.gradient_clipping and cfg.gradient_clipping > 0:
+                coef = min(1.0, float(cfg.gradient_clipping) /
+                           (grad_norm + 1e-6))
+                if coef < 1.0:
+                    g_leaves = [g * np.float32(coef) for g in g_leaves]
+            bf16 = self.precision.compute_dtype == jnp.bfloat16
+            outs = self._nvme_opt.step(
+                g_leaves, lr=lr_t,
+                out_dtype="bfloat16" if bf16 else "float32")
+            if bf16:
+                outs = [u.view(ml_dtypes.bfloat16) for u in outs]
+            flat_shardings = jax.tree.leaves(
+                self._param_shardings,
+                is_leaf=lambda x: isinstance(x, NamedSharding))
+            new_leaves = [jax.device_put(u, sh)
+                          for u, sh in zip(outs, flat_shardings)]
+            new_params = jax.tree_util.tree_unflatten(self._nvme_treedef,
+                                                      new_leaves)
+            self.state = self.state._replace(
+                params=new_params,
+                step=self.state.step + 1)
+        else:
+            self.skipped_steps += 1
+            self.state = self.state._replace(
+                skipped_steps=self.state.skipped_steps + 1)
+        out = StepOutput(loss=loss, grad_norm=jnp.float32(grad_norm),
+                         lr=jnp.float32(lr_t),
+                         loss_scale=jnp.float32(1.0),
+                         overflow=jnp.asarray(not finite),
+                         aux=aux)
+        self.global_steps += 1
+        self._last_grad_norm = grad_norm
+        self.lr_scheduler.last_step = self.global_steps
+        self.tput_timer.stop()
+        self._write_monitor_events(out)
+        if cfg.steps_per_print and \
+                self.global_steps % cfg.steps_per_print == 0:
+            log_dist(f"step={self.global_steps} loss={float(out.loss):.4f} "
+                     f"lr={lr_t:.3e} gnorm={grad_norm:.3f} [nvme-opt]")
+        return out
 
     def _log_zero_sharding_summary(self, shapes, opt_specs) -> None:
         """One bring-up line saying how much master/optimizer state actually
@@ -788,6 +915,8 @@ class DeepSpeedTPUEngine:
     def train_batch(self, batch) -> StepOutput:
         """One full optimizer step from one global batch (all GAS micro-batches
         stacked in the leading dim)."""
+        if self._nvme_opt is not None:
+            return self._train_batch_nvme(batch)
         if self._train_step is None:
             self._build_train_step()
         self.tput_timer.start()

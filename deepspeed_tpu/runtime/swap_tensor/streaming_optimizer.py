@@ -1,0 +1,262 @@
+"""NVMe-STREAMED optimizer step — ZeRO-Infinity's disk-resident optimizer.
+
+Reference parity: ``runtime/zero/stage3.py:2412`` (the stage-3 step walks
+parameter SUB-GROUPS: swap state in → update → swap out, so optimizer state
+larger than host RAM trains), ``stage3.py:679 _configure_tensor_swapping``,
+``swap_tensor/partitioned_optimizer_swapper.py:27`` and the overlapped
+``pipelined_optimizer_swapper.py:52``.
+
+TPU-first shape: the device jit computes gradients; the optimizer tier runs
+on HOST over fp32 master + moment buffers that live on NVMe, streamed per
+sub-group through the async C++ aio engine (``csrc/aio.cpp``):
+
+- two ping-pong READ handles prefetch sub-group i+1's state while the SIMD
+  Adam kernel updates sub-group i (the pipelined swapper's overlap);
+- a WRITE handle drains group i's updated state during group i+1's update;
+- peak host residency is O(3 sub-groups), bounded regardless of model size,
+  and tracked (``peak_resident_bytes``) so tests can pin it.
+
+The updated bf16 compute copy per leaf is the only full-model-sized output —
+exactly the bytes that must reach the device anyway.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ...ops.aio import AIOHandle
+from ...ops.cpu_optimizer import adam_step_buffers, fp32_to_bf16
+from ...utils.logging import log_dist
+
+
+class _GroupMeta:
+    """Per-sub-group NVMe residency: one file per (kind, leaf)."""
+
+    def __init__(self, swap_dir: str, gid: int, leaf_ids: List[int],
+                 shapes: List[Tuple[int, ...]]):
+        self.leaf_ids = leaf_ids
+        self.shapes = shapes
+        self.nbytes = sum(int(np.prod(s or (1,))) * 4 for s in shapes) * 3
+        self.paths = {
+            kind: [os.path.join(swap_dir, f"g{gid:04d}_{kind}_{i}.swp")
+                   for i in leaf_ids]
+            for kind in ("p", "m", "v")}
+
+
+class NVMeStreamingOptimizer:
+    """AdamW whose fp32 masters + moments live on NVMe, streamed per
+    sub-group through the aio engine (see module docstring).
+
+    ``params``: list of numpy fp32 arrays (the initial master values; NOT
+    retained — state goes straight to disk group by group).
+    ``sub_group_size``: max elements per sub-group (reference zero config
+    ``sub_group_size``, stage3.py:679 carving).
+    """
+
+    def __init__(self, params: Sequence[np.ndarray], swap_dir: str, *,
+                 lr: float = 1e-3, betas=(0.9, 0.999), eps: float = 1e-8,
+                 weight_decay: float = 0.0, adamw_mode: bool = True,
+                 sub_group_size: int = 1 << 22,
+                 aio_block_size: int = 1 << 20, aio_threads: int = 4):
+        self.swap_dir = os.path.abspath(swap_dir)
+        os.makedirs(self.swap_dir, exist_ok=True)
+        self.lr, self.betas, self.eps = lr, betas, eps
+        self.weight_decay, self.adamw_mode = weight_decay, adamw_mode
+        self.step_count = 0
+        self.shapes = [tuple(p.shape) for p in params]
+        self._read_h = [AIOHandle(block_size=aio_block_size,
+                                  num_threads=aio_threads) for _ in range(2)]
+        self._write_h = AIOHandle(block_size=aio_block_size,
+                                  num_threads=aio_threads)
+
+        # ---- carve sub-groups (stage3.py:679) ----
+        self.groups: List[_GroupMeta] = []
+        ids, shapes, elems = [], [], 0
+        for i, p in enumerate(params):
+            if ids and elems + p.size > sub_group_size:
+                self.groups.append(_GroupMeta(self.swap_dir, len(self.groups),
+                                              ids, shapes))
+                ids, shapes, elems = [], [], 0
+            ids.append(i)
+            shapes.append(tuple(p.shape))
+            elems += p.size
+        if ids:
+            self.groups.append(_GroupMeta(self.swap_dir, len(self.groups),
+                                          ids, shapes))
+
+        # ---- residency accounting ----
+        self._resident = 0
+        self.peak_resident_bytes = 0
+
+        # ---- initial state → NVMe, one group at a time (the fp32 host
+        # conversion happens INSIDE the loop so init is bounded too — the
+        # caller may pass device arrays or non-fp32 leaves without ever
+        # materializing a full duplicate fp32 copy) ----
+        for g in self.groups:
+            bufs = {"p": [np.ascontiguousarray(np.asarray(params[i]),
+                                               np.float32)
+                          for i in g.leaf_ids],
+                    "m": [np.zeros(s, np.float32) for s in g.shapes],
+                    "v": [np.zeros(s, np.float32) for s in g.shapes]}
+            self._track(+g.nbytes)
+            self._issue_write(g, bufs)
+            self._drain_writes()
+            self._track(-g.nbytes)
+        log_dist(
+            f"NVMeStreamingOptimizer: {len(self.shapes)} leaves in "
+            f"{len(self.groups)} sub-groups "
+            f"({sum(g.nbytes for g in self.groups) / 2**20:.1f} MiB fp32 "
+            f"state) -> {self.swap_dir}")
+
+    # ------------------------------------------------------------------ #
+    def _track(self, delta: int) -> None:
+        self._resident += delta
+        self.peak_resident_bytes = max(self.peak_resident_bytes,
+                                       self._resident)
+
+    def _issue_read(self, handle: AIOHandle, g: _GroupMeta) -> Dict[str, list]:
+        bufs = {kind: [np.empty(s, np.float32) for s in g.shapes]
+                for kind in ("p", "m", "v")}
+        self._track(+g.nbytes)
+        for kind in ("p", "m", "v"):
+            for buf, path in zip(bufs[kind], g.paths[kind]):
+                handle.pread(buf, path)
+        return bufs
+
+    def _issue_write(self, g: _GroupMeta, bufs: Dict[str, list]) -> None:
+        for kind in ("p", "m", "v"):
+            for buf, path in zip(bufs[kind], g.paths[kind]):
+                self._write_h.pwrite(buf, path)
+        self._pending_write = (g, bufs)  # keep alive until drained
+
+    def _drain_writes(self) -> None:
+        errs = self._write_h.wait()
+        if errs:
+            raise IOError(f"{errs} NVMe write(s) failed in {self.swap_dir}")
+        self._pending_write = None
+
+    # ------------------------------------------------------------------ #
+    def step(self, grads: Sequence[np.ndarray], lr: Optional[float] = None,
+             out_dtype: str = "bfloat16") -> List[np.ndarray]:
+        """One streamed optimizer step. ``grads``: one fp32 numpy array per
+        leaf (same order as the init params). Returns the updated compute
+        copies — bf16 uint16 bit-pattern arrays by default (view them as
+        bfloat16 on device), or fp32 copies with ``out_dtype='float32'``."""
+        lr = self.lr if lr is None else float(lr)
+        self.step_count += 1
+        n = len(self.groups)
+        out: List[Optional[np.ndarray]] = [None] * len(self.shapes)
+
+        inflight = self._issue_read(self._read_h[0], self.groups[0])
+        for gi, g in enumerate(self.groups):
+            nxt = None
+            if gi + 1 < n:  # prefetch while this group updates
+                nxt = self._issue_read(self._read_h[(gi + 1) % 2],
+                                       self.groups[gi + 1])
+            errs = self._read_h[gi % 2].wait()
+            if errs:
+                raise IOError(f"{errs} NVMe read(s) failed in "
+                              f"{self.swap_dir}")
+            bufs = inflight
+            for j, leaf_id in enumerate(g.leaf_ids):
+                grad = np.ascontiguousarray(grads[leaf_id], np.float32)
+                adam_step_buffers(
+                    bufs["p"][j], grad, bufs["m"][j], bufs["v"][j],
+                    lr=lr, betas=self.betas, eps=self.eps,
+                    weight_decay=self.weight_decay, step=self.step_count,
+                    adamw_mode=self.adamw_mode)
+                out[leaf_id] = (fp32_to_bf16(bufs["p"][j])
+                                if out_dtype == "bfloat16"
+                                else bufs["p"][j].copy())
+            if self._pending_write is not None:  # drain group gi-1's writes
+                prev_g = self._pending_write[0]
+                self._drain_writes()
+                self._track(-prev_g.nbytes)
+            self._issue_write(g, bufs)
+            inflight = nxt
+        if self._pending_write is not None:
+            prev_g = self._pending_write[0]
+            self._drain_writes()
+            self._track(-prev_g.nbytes)
+        return [o for o in out]  # type: ignore[misc]
+
+    # ------------------------------------------------------------------ #
+    def state_leaves(self) -> Tuple[List[np.ndarray], List[np.ndarray],
+                                    List[np.ndarray]]:
+        """Read back the full (p, m, v) state from NVMe — for checkpointing
+        and tests; NOT bounded-memory (materializes everything)."""
+        ps: List[np.ndarray] = [None] * len(self.shapes)  # type: ignore
+        ms: List[np.ndarray] = [None] * len(self.shapes)  # type: ignore
+        vs: List[np.ndarray] = [None] * len(self.shapes)  # type: ignore
+        for g in self.groups:
+            bufs = self._issue_read(self._read_h[0], g)
+            errs = self._read_h[0].wait()
+            if errs:
+                raise IOError(f"{errs} NVMe read(s) failed")
+            for j, leaf_id in enumerate(g.leaf_ids):
+                ps[leaf_id] = bufs["p"][j]
+                ms[leaf_id] = bufs["m"][j]
+                vs[leaf_id] = bufs["v"][j]
+            self._track(-g.nbytes)
+        return ps, ms, vs
+
+    def load_state_leaves(self, ps: Sequence[np.ndarray],
+                          ms: Sequence[np.ndarray],
+                          vs: Sequence[np.ndarray], step: int) -> None:
+        """Write a full (p, m, v) state into the NVMe files (resume)."""
+        self.step_count = step
+        for g in self.groups:
+            bufs = {"p": [np.ascontiguousarray(ps[i], np.float32)
+                          for i in g.leaf_ids],
+                    "m": [np.ascontiguousarray(ms[i], np.float32)
+                          for i in g.leaf_ids],
+                    "v": [np.ascontiguousarray(vs[i], np.float32)
+                          for i in g.leaf_ids]}
+            self._issue_write(g, bufs)
+            self._drain_writes()
+
+    def save_state_files(self, dest_dir: str) -> None:
+        """Stream-copy the NVMe state into a checkpoint directory — a file
+        copy, bounded memory, no tensor materialization."""
+        import json
+        import shutil
+
+        os.makedirs(dest_dir, exist_ok=True)
+        for g in self.groups:
+            for kind in ("p", "m", "v"):
+                for path in g.paths[kind]:
+                    shutil.copyfile(path, os.path.join(
+                        dest_dir, os.path.basename(path)))
+        with open(os.path.join(dest_dir, "meta.json"), "w") as f:
+            json.dump({"step_count": self.step_count}, f)
+
+    def load_state_files(self, src_dir: str) -> None:
+        """Restore the NVMe state from a checkpoint directory written by
+        :meth:`save_state_files` (same model/partitioning)."""
+        import json
+        import shutil
+
+        for g in self.groups:
+            for kind in ("p", "m", "v"):
+                for path in g.paths[kind]:
+                    src = os.path.join(src_dir, os.path.basename(path))
+                    if not os.path.exists(src):
+                        raise FileNotFoundError(
+                            f"NVMe optimizer checkpoint missing {src} — "
+                            f"was it written with a different model or "
+                            f"sub_group_size?")
+                    shutil.copyfile(src, path)
+        with open(os.path.join(src_dir, "meta.json")) as f:
+            self.step_count = int(json.load(f)["step_count"])
+
+    def purge(self) -> None:
+        for g in self.groups:
+            for kind in ("p", "m", "v"):
+                for path in g.paths[kind]:
+                    try:
+                        os.remove(path)
+                    except FileNotFoundError:
+                        pass
