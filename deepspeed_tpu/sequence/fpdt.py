@@ -35,26 +35,50 @@ from .tiled import tiled_fused_logits_loss, tiled_mlp
 def fpdt_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
                    chunks: int = 4, causal: bool = True,
                    scale: Optional[float] = None,
-                   offload: bool = False) -> jnp.ndarray:
+                   offload: bool = False,
+                   offload_kv: Optional[bool] = None) -> jnp.ndarray:
     """Chunked causal attention with online softmax across KV chunks.
 
     q/k/v: [B, S, H, D] (kv may be GQA-narrow). Peak live score tensor is
     [B, H, S/chunks, S/chunks] instead of [B, H, S, S]. With ``offload=True``
     the per-chunk bodies run under the host-offload remat policy.
-    """
+
+    ``offload_kv`` (defaults to ``offload``) is the reference's KV
+    host-offload double buffering (``fpdt_layer.py:511``
+    ``_FPDTGPUOffloadingAttentionImpl_``) expressed TPU-first: the FULL K/V
+    tensors are parked in ``Host`` memory space right after the projections
+    (in their GQA-NARROW form — head repetition happens after the fetch, so
+    host bytes and DMA are not inflated by the group factor) and streamed
+    back one chunk per scan tick through a TRUE double buffer: the scan
+    carry holds the current chunk while the next chunk's copy-in is issued
+    at the top of the tick, data-independent of the tick's matmuls, so the
+    scheduler can overlap DMA with compute. The backward recompute
+    re-streams chunks the same way; device-resident KV is O(2·S/chunks)
+    instead of O(S). On CPU the space annotation is a no-op (one memory)."""
     scale = scale if scale is not None else q.shape[-1] ** -0.5
-    k = repeat_kv(k, q.shape[-2])
-    v = repeat_kv(v, q.shape[-2])
+    if offload_kv is None:
+        offload_kv = offload
     B, S, H, D = q.shape
+    Hkv = k.shape[-2]
     assert S % chunks == 0, f"seq {S} % chunks {chunks} != 0"
     c = S // chunks
 
     q_t = q.reshape(B, chunks, c, H, D).transpose(1, 0, 2, 3, 4)
-    k_t = k.reshape(B, chunks, c, H, D).transpose(1, 0, 2, 3, 4)
-    v_t = v.reshape(B, chunks, c, H, D).transpose(1, 0, 2, 3, 4)
+    k_t = k.reshape(B, chunks, c, Hkv, D).transpose(1, 0, 2, 3, 4)
+    v_t = v.reshape(B, chunks, c, Hkv, D).transpose(1, 0, 2, 3, 4)
+    if offload_kv:
+        k_t = jax.device_put(k_t, jax.memory.Space.Host)
+        v_t = jax.device_put(v_t, jax.memory.Space.Host)
 
     row = jnp.arange(c)[:, None]
     col = jnp.arange(c)[None, :]
+
+    def fetch(buf, idx):
+        """One (narrow) KV chunk → device memory (async copy-in on TPU)."""
+        blk = lax.dynamic_index_in_dim(buf, idx, 0, keepdims=False)
+        if offload_kv:
+            blk = jax.device_put(blk, jax.memory.Space.Device)
+        return blk
 
     def q_chunk_attn(qi, q_blk):
         """Attend query chunk qi over all (≤qi if causal) KV chunks."""
@@ -62,12 +86,27 @@ def fpdt_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
         m0 = jnp.full((B, H, c), NEG_INF, jnp.float32)
         l0 = jnp.zeros((B, H, c), jnp.float32)
         acc0 = jnp.zeros((B, c, H, D), jnp.float32)
+        # double buffer: chunk 0 is fetched before the loop; each tick
+        # computes with the CARRIED chunk and prefetches the next
+        kv0 = (fetch(k_t, 0), fetch(v_t, 0))
 
-        def kv_body(carry, blk):
-            kj_idx, k_blk, v_blk = blk
+        def kv_body(carry, kj_idx):
+            m, l, acc, k_cur, v_cur = carry
+            # issue the NEXT chunk's copy-in first — no data dependence on
+            # this tick's matmuls, so DMA overlaps compute. Under causality
+            # the prefetch is skipped once past qi (no wasted transfers).
+            nxt = jnp.minimum(kj_idx + 1, chunks - 1)
+            if causal:
+                k_nxt, v_nxt = lax.cond(
+                    nxt <= qi, lambda: (fetch(k_t, nxt), fetch(v_t, nxt)),
+                    lambda: (k_cur, v_cur))
+            else:
+                k_nxt, v_nxt = fetch(k_t, nxt), fetch(v_t, nxt)
 
-            def update(carry):
-                m, l, acc = carry
+            def update(mla):
+                m, l, acc = mla
+                k_blk = repeat_kv(k_cur, H)  # GQA widen AFTER the fetch
+                v_blk = repeat_kv(v_cur, H)
                 if causal:
                     # full block if kj < qi, diagonal if ==
                     diag = kj_idx == qi
@@ -82,15 +121,14 @@ def fpdt_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
             if causal:
                 # strictly-future KV blocks contribute nothing — skip their
                 # matmuls at runtime (shapes stay static under lax.cond)
-                carry = lax.cond(kj_idx <= qi, update, lambda carry: carry,
-                                 carry)
+                m, l, acc = lax.cond(kj_idx <= qi, update, lambda mla: mla,
+                                     (m, l, acc))
             else:
-                carry = update(carry)
-            return carry, None
+                m, l, acc = update((m, l, acc))
+            return (m, l, acc, k_nxt, v_nxt), None
 
-        (m, l, acc), _ = lax.scan(
-            kv_body, (m0, l0, acc0),
-            (jnp.arange(chunks), k_t, v_t))
+        (m, l, acc, _, _), _ = lax.scan(
+            kv_body, (m0, l0, acc0) + kv0, jnp.arange(chunks))
         l = jnp.maximum(l, 1e-20)
         out = (acc / l.transpose(0, 2, 1)[..., None]).astype(q.dtype)
         # tag the chunk output so the host-offload remat policy (which
