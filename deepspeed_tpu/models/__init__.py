@@ -1,2 +1,2 @@
-from . import (bert, bloom, clip, exaone4, falcon, gpt, gptneox,  # noqa: F401
-               llama, mixtral)
+from . import (bert, bloom, clip, diffusion, exaone4, falcon,  # noqa: F401
+               gpt, gptneox, llama, mixtral)

@@ -36,14 +36,14 @@ def fpdt_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
                    chunks: int = 4, causal: bool = True,
                    scale: Optional[float] = None,
                    offload: bool = False,
-                   offload_kv: Optional[bool] = None) -> jnp.ndarray:
+                   offload_kv: bool = False) -> jnp.ndarray:
     """Chunked causal attention with online softmax across KV chunks.
 
     q/k/v: [B, S, H, D] (kv may be GQA-narrow). Peak live score tensor is
     [B, H, S/chunks, S/chunks] instead of [B, H, S, S]. With ``offload=True``
     the per-chunk bodies run under the host-offload remat policy.
 
-    ``offload_kv`` (defaults to ``offload``) is the reference's KV
+    ``offload_kv`` (opt-in) is the reference's KV
     host-offload double buffering (``fpdt_layer.py:511``
     ``_FPDTGPUOffloadingAttentionImpl_``) expressed TPU-first: the FULL K/V
     tensors are parked in ``Host`` memory space right after the projections
@@ -56,8 +56,9 @@ def fpdt_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
     re-streams chunks the same way; device-resident KV is O(2·S/chunks)
     instead of O(S). On CPU the space annotation is a no-op (one memory)."""
     scale = scale if scale is not None else q.shape[-1] ** -0.5
-    if offload_kv is None:
-        offload_kv = offload
+    # KV host-parking stays OPT-IN until the S(5)-placement test has run on
+    # real TPU (the memory-space path is numerics-proven but TPU-unprofiled)
+    offload_kv = bool(offload_kv)
     B, S, H, D = q.shape
     Hkv = k.shape[-2]
     assert S % chunks == 0, f"seq {S} % chunks {chunks} != 0"
@@ -93,15 +94,16 @@ def fpdt_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
         def kv_body(carry, kj_idx):
             m, l, acc, k_cur, v_cur = carry
             # issue the NEXT chunk's copy-in first — no data dependence on
-            # this tick's matmuls, so DMA overlaps compute. Under causality
-            # the prefetch is skipped once past qi (no wasted transfers).
+            # this tick's matmuls, so DMA overlaps compute. The prefetch is
+            # skipped past the last chunk and (under causality) past qi —
+            # no wasted transfers.
             nxt = jnp.minimum(kj_idx + 1, chunks - 1)
+            want = kj_idx + 1 < chunks
             if causal:
-                k_nxt, v_nxt = lax.cond(
-                    nxt <= qi, lambda: (fetch(k_t, nxt), fetch(v_t, nxt)),
-                    lambda: (k_cur, v_cur))
-            else:
-                k_nxt, v_nxt = fetch(k_t, nxt), fetch(v_t, nxt)
+                want = jnp.logical_and(want, nxt <= qi)
+            k_nxt, v_nxt = lax.cond(
+                want, lambda: (fetch(k_t, nxt), fetch(v_t, nxt)),
+                lambda: (k_cur, v_cur))
 
             def update(mla):
                 m, l, acc = mla
@@ -158,10 +160,12 @@ class FPDT_Attention:
     """Reference ``FPDT_Attention`` (fpdt_layer.py:972)."""
 
     def __init__(self, chunks: int = 4, causal: bool = True,
-                 offload: bool = True):
-        self.chunks, self.causal, self.offload = chunks, causal, offload
+                 offload: bool = True, offload_kv: bool = False):
+        self.chunks, self.causal = chunks, causal
+        self.offload, self.offload_kv = offload, offload_kv
 
     def __call__(self, q, k, v, **kw):
+        kw.setdefault("offload_kv", self.offload_kv)
         return fpdt_attention(q, k, v, chunks=self.chunks, causal=self.causal,
                               offload=self.offload, **kw)
 

@@ -1,0 +1,86 @@
+"""Diffusion (SD-style) inference tier tests (reference
+``model_implementations/diffusers/`` + ``csrc/spatial/``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.models import diffusion as dm
+
+
+def test_group_norm_matches_manual():
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 4, 4, 8))
+    s, b = jnp.full((8,), 1.5), jnp.full((8,), 0.25)
+    out = dm.group_norm(x, s, b, groups=2)
+    # manual: normalize each group over (H, W, C_group)
+    g = np.asarray(x).reshape(2, 4, 4, 2, 4)
+    mean = g.mean(axis=(1, 2, 4), keepdims=True)
+    var = g.var(axis=(1, 2, 4), keepdims=True)
+    ref = ((g - mean) / np.sqrt(var + 1e-5)).reshape(2, 4, 4, 8) * 1.5 + 0.25
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4, atol=1e-4)
+
+
+def test_ddim_step_recovers_x0_when_eps_known():
+    """With the true eps, stepping to alpha_prev=1 returns x0 exactly."""
+    rng = np.random.default_rng(0)
+    x0 = rng.standard_normal((2, 4, 4, 4)).astype(np.float32)
+    eps = rng.standard_normal((2, 4, 4, 4)).astype(np.float32)
+    alpha_t = jnp.asarray(0.3)
+    x_t = jnp.sqrt(alpha_t) * x0 + jnp.sqrt(1 - alpha_t) * eps
+    out = dm.ddim_step(x_t, jnp.asarray(eps), alpha_t, jnp.asarray(1.0))
+    np.testing.assert_allclose(np.asarray(out), x0, rtol=1e-5, atol=1e-5)
+
+
+def test_ddim_alphas_monotone():
+    a = np.asarray(dm.ddim_alphas(1000))
+    assert a.shape == (1000,)
+    assert (np.diff(a) < 0).all() and a[-1] > 0
+
+
+def test_unet_shapes_and_finite():
+    cfg = dm.DiffusionConfig.tiny()
+    p = dm.init_unet(cfg, jax.random.PRNGKey(0))
+    lat = jax.random.normal(jax.random.PRNGKey(1), (2, 8, 8, cfg.in_channels))
+    ctx = jax.random.normal(jax.random.PRNGKey(2), (2, 5, cfg.context_dim))
+    eps = dm.apply_unet(cfg, p, lat, jnp.asarray([10, 500]), ctx)
+    assert eps.shape == lat.shape
+    assert np.isfinite(np.asarray(eps)).all()
+
+
+def test_pipeline_generates_one_compiled_program(devices8):
+    """The full guided DDIM loop + VAE decode runs as ONE jit (the
+    reference's CUDA-graph capture, DSUNet/DSVAE) and replays without
+    retracing."""
+    cfg = dm.DiffusionConfig.tiny()
+    eng = dm.build_diffusion_engine(cfg, jax.random.PRNGKey(0))
+    lat = jax.random.normal(jax.random.PRNGKey(1), (1, 8, 8,
+                                                    cfg.in_channels))
+    ctx = jax.random.normal(jax.random.PRNGKey(2), (1, 3, cfg.context_dim))
+    img = eng.generate(lat, ctx, steps=4, guidance=3.0)
+    assert img.shape == (1, 16, 16, cfg.image_channels)  # VAE 2x upscale
+    assert np.isfinite(np.asarray(img, np.float32)).all()
+    n = eng._generate._cache_size()
+    img2 = eng.generate(lat * 0.5, ctx, steps=4, guidance=3.0)
+    assert eng._generate._cache_size() == n  # replay, no retrace
+    assert img2.shape == img.shape
+
+
+def test_guidance_changes_output():
+    cfg = dm.DiffusionConfig.tiny()
+    eng = dm.build_diffusion_engine(cfg, jax.random.PRNGKey(0),
+                                    with_vae=False,
+                                    compute_dtype=jnp.float32)
+    # fresh init zeroes the attn out-projection (residual-friendly); scale
+    # it up so the conditioning actually reaches eps in this test
+    o = eng.unet_params["mid"]["attn"]["o"]
+    eng.unet_params["mid"]["attn"]["o"] = {"w": o["w"] * 1e5, "b": o["b"]}
+    lat = jax.random.normal(jax.random.PRNGKey(1), (1, 8, 8,
+                                                    cfg.in_channels))
+    ctx = jax.random.normal(jax.random.PRNGKey(2), (1, 3, cfg.context_dim))
+    a = np.asarray(eng.generate(lat, ctx, steps=2, guidance=1.0),
+                   np.float32)
+    b = np.asarray(eng.generate(lat, ctx, steps=2, guidance=7.5),
+                   np.float32)
+    assert a.shape == (1, 8, 8, cfg.in_channels)  # no VAE: latents out
+    assert not np.allclose(a, b)
