@@ -138,6 +138,73 @@ def bench_model_config(on_tpu: bool, remat: bool = False):
         rope_theta=500000.0, remat=remat)
 
 
+def bench_shape_rows(jax, budget_s: float = None) -> dict:
+    """MFU at the north-star shapes (VERDICT r2: prove the 8B-class rows):
+    few-layer Llama train steps at h=1024/2048/4096, hd=64 vs hd=128 — the
+    headline config must not be the only (flattering) row. Runs inside a
+    wall-clock budget; rows that don't fit are reported as 'skipped'."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    import deepspeed_tpu as dst
+    from deepspeed_tpu.comm import mesh as mesh_lib
+    from deepspeed_tpu.models import llama
+
+    if budget_s is None:
+        budget_s = float(os.environ.get("DSTPU_BENCH_SHAPE_BUDGET_S", 1500))
+    t_start = time.perf_counter()
+    # (label, hidden, inter, layers, heads, kv, head_dim)
+    configs = [
+        ("h1024_hd64", 1024, 3584, 12, 16, 8, 64),
+        ("h1024_hd128", 1024, 3584, 12, 8, 4, 128),
+        ("h2048_hd128", 2048, 7168, 6, 16, 8, 128),
+        ("h4096_hd128", 4096, 14336, 2, 32, 8, 128),  # Llama-3-8B layer
+    ]
+    rows = {}
+    batch = int(os.environ.get("DSTPU_BENCH_SHAPE_BATCH", 4))
+    seqlen = int(os.environ.get("DSTPU_BENCH_SHAPE_SEQLEN", 2048))
+    steps = int(os.environ.get("DSTPU_BENCH_SHAPE_STEPS", 8))
+    peak = peak_flops_per_chip(jax)
+    for label, h, inter, L, nh, nkv, hd in configs:
+        if time.perf_counter() - t_start > budget_s:
+            rows[label] = "skipped: shape budget exhausted"
+            continue
+        try:
+            mesh_lib.set_mesh(None)
+            mcfg = llama.LlamaConfig(
+                vocab_size=32000, hidden_size=h, intermediate_size=inter,
+                num_layers=L, num_heads=nh, num_kv_heads=nkv, head_dim=hd,
+                max_seq_len=seqlen, rope_theta=500000.0, remat=True)
+            spec = llama.model_spec(mcfg, compute_dtype=jnp.bfloat16)
+            engine, _, _, _ = dst.initialize(model=spec, config={
+                "train_batch_size": batch,
+                "bf16": {"enabled": True},
+                "optimizer": {"type": "adamw", "params": {"lr": 3e-4}},
+                "zero_optimization": {"stage": 3},
+                "steps_per_print": 0,
+            })
+            rng = np.random.default_rng(0)
+            toks = {"tokens": rng.integers(
+                0, mcfg.vocab_size, (batch, seqlen + 1), dtype=np.int32)}
+            float(engine.train_batch(toks).loss)  # compile + warm
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                out = engine.train_batch(toks)
+            float(out.loss)
+            dt = (time.perf_counter() - t0) / steps
+            tps = batch * seqlen / dt
+            flops_tok = 6 * mcfg.num_params + \
+                12 * mcfg.num_layers * mcfg.hidden_size * seqlen
+            rows[label] = {"mfu": round(tps * flops_tok / peak, 4),
+                           "tok_per_sec": round(tps, 1),
+                           "params_m": round(mcfg.num_params / 1e6, 1),
+                           "step_s": round(dt, 3)}
+            sys.stderr.write(f"[bench] shape {label}: {rows[label]}\n")
+        except Exception as e:  # one bad shape must not kill the rest
+            rows[label] = f"error: {str(e)[-200:]}"
+    return rows
+
+
 def run_decode_subprocess() -> object:
     """Decode bench in a SUBPROCESS with a hard timeout, BEFORE this process
     initializes its own jax client: a wedged tunnel compile must never hold
@@ -225,6 +292,11 @@ def main():
         "seqlen": seqlen,
         "final_loss": final_loss,
     })
+    # 8B-class shape rows (TPU only — each is a multi-minute compile; the
+    # persistent cache makes re-runs cheap). Forced via DSTPU_BENCH_SHAPES=1.
+    if on_tpu or os.environ.get("DSTPU_BENCH_SHAPES"):
+        RESULT["detail"]["shape_mfu"] = bench_shape_rows(jax)
+
     # a decode child that fell back to CPU must not masquerade as the
     # accelerator decode number
     if isinstance(decode, dict):
