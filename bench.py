@@ -124,6 +124,14 @@ def peak_flops_per_chip(jax) -> float:
     return 2e12  # CPU smoke-run placeholder
 
 
+def model_flops_per_token(mcfg, seqlen: int) -> float:
+    """Model flops per token: 6*N (fwd+bwd matmuls) + the causal-attention
+    term 12*L*H*S. Shared by the headline and shape-row MFU so the two
+    numbers stay comparable."""
+    return (6 * mcfg.num_params
+            + 12 * mcfg.num_layers * mcfg.hidden_size * seqlen)
+
+
 def bench_model_config(on_tpu: bool, remat: bool = False):
     """ONE model for both the train-MFU and decode benches — keep these in
     sync or the decode number describes a different model."""
@@ -161,15 +169,18 @@ def bench_shape_rows(jax, budget_s: float = None) -> dict:
         ("h4096_hd128", 4096, 14336, 2, 32, 8, 128),  # Llama-3-8B layer
     ]
     rows = {}
-    batch = int(os.environ.get("DSTPU_BENCH_SHAPE_BATCH", 4))
+    n_chips = max(1, len(jax.devices()))
+    batch = int(os.environ.get("DSTPU_BENCH_SHAPE_BATCH", 4 * n_chips))
     seqlen = int(os.environ.get("DSTPU_BENCH_SHAPE_SEQLEN", 2048))
     steps = int(os.environ.get("DSTPU_BENCH_SHAPE_STEPS", 8))
     peak = peak_flops_per_chip(jax)
+    engine = None
     for label, h, inter, L, nh, nkv, hd in configs:
         if time.perf_counter() - t_start > budget_s:
             rows[label] = "skipped: shape budget exhausted"
             continue
         try:
+            engine = None  # free the previous row's params/opt state first
             mesh_lib.set_mesh(None)
             mcfg = llama.LlamaConfig(
                 vocab_size=32000, hidden_size=h, intermediate_size=inter,
@@ -192,11 +203,10 @@ def bench_shape_rows(jax, budget_s: float = None) -> dict:
                 out = engine.train_batch(toks)
             float(out.loss)
             dt = (time.perf_counter() - t0) / steps
-            tps = batch * seqlen / dt
-            flops_tok = 6 * mcfg.num_params + \
-                12 * mcfg.num_layers * mcfg.hidden_size * seqlen
-            rows[label] = {"mfu": round(tps * flops_tok / peak, 4),
-                           "tok_per_sec": round(tps, 1),
+            tps_per_chip = batch * seqlen / dt / n_chips
+            flops_tok = model_flops_per_token(mcfg, seqlen)
+            rows[label] = {"mfu": round(tps_per_chip * flops_tok / peak, 4),
+                           "tok_per_sec_per_chip": round(tps_per_chip, 1),
                            "params_m": round(mcfg.num_params / 1e6, 1),
                            "step_s": round(dt, 3)}
             sys.stderr.write(f"[bench] shape {label}: {rows[label]}\n")
@@ -276,10 +286,8 @@ def main():
     n_chips = len(jax.devices())
     tokens_per_step = engine.train_batch_size() * seqlen
     tokens_per_sec_per_chip = tokens_per_step * steps / dt / n_chips
-    # model flops: 6*N per token (fwd+bwd) + attention term 12*L*H*S per token
     n_params = mcfg.num_params
-    attn_flops_per_token = 12 * mcfg.num_layers * mcfg.hidden_size * seqlen
-    flops_per_token = 6 * n_params + attn_flops_per_token
+    flops_per_token = model_flops_per_token(mcfg, seqlen)
     mfu = tokens_per_sec_per_chip * flops_per_token / peak_flops_per_chip(jax)
 
     RESULT["value"] = round(mfu, 4)
@@ -294,7 +302,8 @@ def main():
     })
     # 8B-class shape rows (TPU only — each is a multi-minute compile; the
     # persistent cache makes re-runs cheap). Forced via DSTPU_BENCH_SHAPES=1.
-    if on_tpu or os.environ.get("DSTPU_BENCH_SHAPES"):
+    if on_tpu or os.environ.get("DSTPU_BENCH_SHAPES", "0") not in ("", "0"):
+        del engine  # free the headline engine's state before the sweep
         RESULT["detail"]["shape_mfu"] = bench_shape_rows(jax)
 
     # a decode child that fell back to CPU must not masquerade as the
