@@ -1,3 +1,4 @@
-from .elastic_agent import elastic_train_config, run_elastic  # noqa: F401
+from .elastic_agent import (PreemptionGuard, elastic_train_config,  # noqa: F401
+                            run_elastic)
 from .elasticity import (compute_elastic_config, ElasticityError,  # noqa: F401
                          get_compatible_chip_counts)

@@ -63,3 +63,75 @@ def run_elastic(model_spec, base_config: Dict[str, Any],
         except FileNotFoundError:
             log_dist("elastic: no checkpoint yet — fresh start")
     return engine, opt, loader, sched
+
+
+# --------------------------------------------------------------------------- #
+# in-job failure / preemption hook
+# --------------------------------------------------------------------------- #
+class PreemptionGuard:
+    """In-job failure hook (reference ``DSElasticAgent._invoke_run:127`` —
+    monitor workers, on UNHEALTHY/FAILED checkpoint-and-restart at a new
+    scale). On TPU the failure signal is a PREEMPTION: the resource manager
+    sends SIGTERM with a grace window before reclaiming the slice. The guard
+    installs signal handlers that flip a flag; the training loop calls
+    :meth:`step_boundary` between steps — when the flag is up it saves a
+    checkpoint and returns True so the loop exits cleanly, and the next
+    incarnation resumes at its (possibly different) scale via
+    :func:`run_elastic`.
+
+    Usage::
+
+        guard = PreemptionGuard(save_dir="ckpts")
+        engine, *_ = run_elastic(spec, config, checkpoint_dir="ckpts")
+        for batch in loader:
+            engine.train_batch(batch)
+            if guard.step_boundary(engine):
+                break          # checkpointed; exit for the restart
+    """
+
+    def __init__(self, save_dir: str, *, signals: Tuple[int, ...] = None,
+                 tag: Optional[str] = None):
+        import signal as _signal
+
+        self.save_dir = save_dir
+        self.tag = tag
+        self._triggered = False
+        self._signum: Optional[int] = None
+        self._prev: Dict[int, Any] = {}
+        if signals is None:
+            signals = (_signal.SIGTERM,)
+        for s in signals:
+            self._prev[s] = _signal.signal(s, self._on_signal)
+
+    def _on_signal(self, signum, frame):
+        self._triggered = True
+        self._signum = signum
+        log_dist(f"PreemptionGuard: received signal {signum} — will "
+                 f"checkpoint at the next step boundary")
+        prev = self._prev.get(signum)
+        if callable(prev):  # chain whatever handler was there before
+            prev(signum, frame)
+
+    @property
+    def triggered(self) -> bool:
+        return self._triggered
+
+    def step_boundary(self, engine) -> bool:
+        """Checkpoint-and-signal-exit when a preemption arrived. Returns
+        True exactly once per trigger; safe to call every step (no-op when
+        no signal is pending)."""
+        if not self._triggered:
+            return False
+        self._triggered = False  # once per trigger — never re-save the
+        # checkpoint on later calls inside the preemption grace window
+        path = engine.save_checkpoint(self.save_dir, tag=self.tag)
+        log_dist(f"PreemptionGuard: checkpoint saved to {path} after "
+                 f"signal {self._signum}; exit for elastic restart")
+        return True
+
+    def uninstall(self) -> None:
+        import signal as _signal
+
+        for s, prev in self._prev.items():
+            _signal.signal(s, prev)
+        self._prev.clear()

@@ -53,13 +53,10 @@ class DataAnalyzer:
         return os.path.join(self.save_path,
                             f"metrics_worker{worker_id}.npz")
 
-    def run_map(self) -> Dict[str, np.ndarray]:
-        """Analyze this worker's shard; persist to the worker index file when
-        ``save_path`` is set."""
-        idx = self._worker_range(len(self.dataset), self.worker_id)
+    def _map_range(self, lo: int, hi: int):
         single: Dict[str, List[float]] = {}
         accum: Dict[str, np.ndarray] = {}
-        for i in idx:
+        for i in range(lo, hi):
             sample = self.dataset[i]
             for name, fn in self.metric_fns.items():
                 v = fn(sample)
@@ -68,6 +65,31 @@ class DataAnalyzer:
                     accum[name] = v if name not in accum else accum[name] + v
                 else:
                     single.setdefault(name, []).append(float(v))
+        return single, accum
+
+    def run_map(self, num_threads: int = 1) -> Dict[str, np.ndarray]:
+        """Analyze this worker's shard; persist to the worker index file when
+        ``save_path`` is set. ``num_threads`` splits the shard across a
+        thread pool (reference ``data_analyzer.py`` thread splitting — wins
+        when the metric fns do I/O; sample ORDER is preserved on merge)."""
+        idx = self._worker_range(len(self.dataset), self.worker_id)
+        lo, hi = (idx.start, idx.stop) if len(idx) else (0, 0)
+        if num_threads <= 1 or hi - lo < num_threads:
+            single, accum = self._map_range(lo, hi)
+        else:
+            from concurrent.futures import ThreadPoolExecutor
+
+            bounds = np.linspace(lo, hi, num_threads + 1).astype(int)
+            with ThreadPoolExecutor(max_workers=num_threads) as pool:
+                parts = list(pool.map(
+                    lambda be: self._map_range(be[0], be[1]),
+                    zip(bounds[:-1], bounds[1:])))
+            single, accum = {}, {}
+            for s_part, a_part in parts:  # in shard order
+                for m, vals in s_part.items():
+                    single.setdefault(m, []).extend(vals)
+                for m, v in a_part.items():
+                    accum[m] = v if m not in accum else accum[m] + v
         out = {m: np.asarray(v) for m, v in single.items()}
         out.update(accum)
         if self.save_path is not None:
@@ -129,6 +151,53 @@ class DataAnalyzer:
             if self.num_workers > 1:
                 self.run_reduce()
         return np.argsort(self.metrics[metric], kind="stable")
+
+    # -- persisted index files (reference data_analyzer.py:72-117:
+    #    {metric}_sample_to_metric + {metric}_metric_to_sample) ---------- #
+    def build_indices(self, metric: str) -> Dict[str, np.ndarray]:
+        """Write the reference's two per-metric index artifacts:
+
+        - ``{metric}_sample_to_metric.npy`` — the metric value per sample
+          (lookup by sample index);
+        - ``{metric}_metric_to_sample.npz`` — one array of sample indices
+          per distinct metric value (the curriculum difficulty buckets).
+        Returns the bucket dict (key = str(metric value))."""
+        assert self.save_path is not None, "build_indices needs save_path"
+        if metric not in self.metrics:
+            self.run_map()
+            if self.num_workers > 1:
+                self.run_reduce()
+        values = np.asarray(self.metrics[metric])
+        np.save(os.path.join(self.save_path,
+                             f"{metric}_sample_to_metric.npy"), values)
+        # one argsort + split: O(N log N) and immune to near-continuous
+        # metrics (each distinct value still gets its bucket, but without
+        # a full values==v scan per value)
+        order = np.argsort(values, kind="stable")
+        uniq, starts = np.unique(values[order], return_index=True)
+        groups = np.split(order, starts[1:])
+        buckets = {str(v): g for v, g in zip(uniq, groups)}
+        np.savez(os.path.join(self.save_path,
+                              f"{metric}_metric_to_sample.npz"), **buckets)
+        log_dist(f"DataAnalyzer: wrote {metric}_sample_to_metric.npy + "
+                 f"{metric}_metric_to_sample.npz ({len(buckets)} buckets)")
+        return buckets
+
+    @staticmethod
+    def load_indices(save_path: str, metric: str):
+        """Load the two index artifacts written by :meth:`build_indices`."""
+        values = np.load(os.path.join(save_path,
+                                      f"{metric}_sample_to_metric.npy"))
+        with np.load(os.path.join(
+                save_path, f"{metric}_metric_to_sample.npz")) as z:
+            buckets = {k: z[k] for k in z.files}
+        return values, buckets
+
+    def run_map_reduce(self, num_threads: int = 1) -> Dict[str, np.ndarray]:
+        """Map this worker's shard then merge all workers (reference
+        ``run_map_reduce``). Only valid when every worker has mapped."""
+        self.run_map(num_threads=num_threads)
+        return self.run_reduce() if self.num_workers > 1 else self.metrics
 
 
 class CurriculumDataSampler:

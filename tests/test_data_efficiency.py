@@ -166,3 +166,39 @@ def test_data_analyzer_workers_and_reduce(tmp_path):
     order = final.index_by_difficulty("seqlen")
     np.testing.assert_array_equal(order, np.arange(10))
     assert (tmp_path / "metrics_merged.npz").exists()
+
+
+def test_data_analyzer_index_files_and_threads(tmp_path):
+    """build_indices writes the reference's two per-metric artifacts
+    (sample_to_metric + metric_to_sample buckets, data_analyzer.py:72-117)
+    and threaded map preserves sample order."""
+    from deepspeed_tpu.runtime.data_pipeline.data_sampler import DataAnalyzer
+
+    data = [i % 5 for i in range(40)]  # 5 difficulty buckets
+    da = DataAnalyzer(data, {"diff": lambda s: s}, save_path=str(tmp_path))
+    seq = da.run_map()
+    da_t = DataAnalyzer(data, {"diff": lambda s: s},
+                        save_path=str(tmp_path / "t"))
+    thr = da_t.run_map(num_threads=4)
+    np.testing.assert_array_equal(seq["diff"], thr["diff"])  # order kept
+
+    buckets = da.build_indices("diff")
+    assert len(buckets) == 5
+    values, loaded = DataAnalyzer.load_indices(str(tmp_path), "diff")
+    np.testing.assert_array_equal(values, np.asarray(data, float))
+    for k, idx in loaded.items():
+        assert (values[idx] == float(k)).all()
+        assert len(idx) == 8
+
+
+def test_data_analyzer_run_map_reduce_multiworker(tmp_path):
+    from deepspeed_tpu.runtime.data_pipeline.data_sampler import DataAnalyzer
+
+    data = list(range(20))
+    # both workers map, then either can reduce
+    for wid in (0, 1):
+        DataAnalyzer(data, {"v": lambda s: s}, save_path=str(tmp_path),
+                     num_workers=2, worker_id=wid).run_map()
+    merged = DataAnalyzer(data, {"v": lambda s: s}, save_path=str(tmp_path),
+                          num_workers=2, worker_id=0).run_map_reduce()
+    np.testing.assert_array_equal(merged["v"], np.asarray(data, float))

@@ -117,3 +117,55 @@ def test_run_elastic_resume_roundtrip(devices8, tmp_path):
     assert engine2.global_steps == 1
     np.testing.assert_allclose(
         np.asarray(engine2.state.params["final_norm"]), ref, rtol=1e-6)
+
+
+def test_preemption_guard_checkpoints_on_signal(tmp_path, devices8):
+    """SIGTERM-style preemption between steps → checkpoint + clean exit;
+    the next incarnation resumes from it (reference DSElasticAgent monitor
+    → restart cycle, elastic_agent.py:127)."""
+    import os
+    import signal
+
+    import deepspeed_tpu as dst
+    from deepspeed_tpu.comm import mesh as mesh_lib
+    from deepspeed_tpu.elasticity.elastic_agent import (PreemptionGuard,
+                                                        run_elastic)
+    from deepspeed_tpu.runtime.engine import ModelSpec
+
+    def make_spec():
+        return ModelSpec(
+            loss_fn=lambda p, b: (jnp.sum((p["w"] * b["x"]) ** 2), {}),
+            init_fn=lambda k: {"w": jnp.ones((8,))},
+            pipeline_capable=False)
+
+    config = {"train_batch_size": 8,
+              "optimizer": {"type": "sgd", "params": {"lr": 0.1}},
+              "steps_per_print": 0}
+    ckpt = str(tmp_path / "ckpts")
+
+    mesh_lib.set_mesh(None)
+    engine, *_ = dst.initialize(model=make_spec(), config=config)
+    guard = PreemptionGuard(ckpt, signals=(signal.SIGUSR1,))
+    try:
+        batch = {"x": np.ones((8,), np.float32)}
+        steps_done = 0
+        for i in range(10):
+            engine.train_batch(batch)
+            steps_done += 1
+            if i == 2:  # the resource manager preempts us mid-run
+                os.kill(os.getpid(), signal.SIGUSR1)
+            if guard.step_boundary(engine):
+                break
+        assert steps_done == 3  # exited at the boundary after the signal
+        # once per trigger: no duplicate checkpoint writes in the grace window
+        assert not guard.step_boundary(engine)
+    finally:
+        guard.uninstall()
+
+    # next incarnation resumes from the preemption checkpoint
+    mesh_lib.set_mesh(None)
+    engine2, *_ = run_elastic(make_spec(), config, checkpoint_dir=ckpt)
+    assert engine2.global_steps == 3
+    np.testing.assert_allclose(np.asarray(engine2.state.params["w"]),
+                               np.asarray(engine.state.params["w"]),
+                               rtol=1e-6)
