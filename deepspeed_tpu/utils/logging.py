@@ -28,7 +28,11 @@ def _create_logger(name: str = _LOGGER_NAME, level: int = logging.INFO) -> loggi
     lg.setLevel(level)
     lg.propagate = False
     if not lg.handlers:
-        handler = logging.StreamHandler(stream=sys.stdout)
+        # DSTPU_LOG_STREAM=stderr keeps stdout clean for tools whose stdout
+        # is a machine-readable contract (bench scripts: ONE JSON line)
+        stream = (sys.stderr if os.environ.get("DSTPU_LOG_STREAM") == "stderr"
+                  else sys.stdout)
+        handler = logging.StreamHandler(stream=stream)
         handler.setFormatter(
             logging.Formatter("[%(asctime)s] [%(levelname)s] [%(name)s] %(message)s",
                               datefmt="%Y-%m-%d %H:%M:%S"))

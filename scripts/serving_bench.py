@@ -1,0 +1,135 @@
+#!/usr/bin/env python
+"""Steady-state serving throughput (VERDICT r3 item 5).
+
+Drives the continuous-batching v2 engine with a mixed prefill/decode workload:
+a closed-loop client keeps `batch` sequences live — whenever one finishes, a
+new prompt is admitted — so every measured step interleaves decode with
+periodic prefills exactly the way FastGen's steady-state benchmark does
+(reference blogs/deepspeed-fastgen: throughput at fixed client count).
+
+Reports generated tok/s at 2-3 client counts. ONE JSON line.
+"""
+
+import json
+import logging
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# stdout must carry exactly ONE JSON line; the package logger defaults to
+# stdout, so route it to stderr before any deepspeed_tpu import
+logging.basicConfig(stream=sys.stderr)
+os.environ.setdefault("DSTPU_LOG_STREAM", "stderr")
+
+# vs_baseline is null: FastGen's published rows are 7-70B models on A100
+# clusters — no comparable per-chip 235M row exists to divide by
+RESULT = {"metric": "serving_steady_tok_per_sec", "value": 0.0,
+          "unit": "tok/s", "vs_baseline": None, "detail": {}}
+
+
+def run_closed_loop(eng, sp, vocab, batch, prompt_len, gen_len, measure_s,
+                    rng):
+    """Keep `batch` sequences live for `measure_s` seconds; count generated
+    tokens (decode steps + the first token each prefill produces)."""
+    import numpy as np
+
+    uid = 0
+
+    def admit():
+        nonlocal uid
+        eng.put(uid, rng.integers(0, vocab, (prompt_len,),
+                                  dtype=np.int32).tolist(), sp, seed=uid)
+        uid += 1
+
+    for _ in range(batch):
+        admit()
+    # warm the decode program
+    eng.step(sp)
+    t0 = time.perf_counter()
+    produced = 0
+    prefills = 0
+    while time.perf_counter() - t0 < measure_s:
+        out = eng.step(sp)
+        produced += len(out)
+        for d in list(eng.state.seqs.values()):
+            if len(d.generated) >= gen_len:
+                eng.finish(d.uid)
+                admit()          # prefill happens inside the measured loop
+                produced += 1    # put() samples the first token
+                prefills += 1
+    dt = time.perf_counter() - t0
+    for d in list(eng.state.seqs.values()):
+        eng.finish(d.uid)
+    return produced / dt, prefills
+
+
+def main():
+    import numpy as np
+    import jax
+
+    if os.environ.get("DSTPU_BENCH_FORCE_CPU"):
+        # the axon sitecustomize forces jax_platforms=axon,cpu programmatically;
+        # only the in-process config update bypasses a wedged tunnel
+        jax.config.update("jax_platforms", "cpu")
+
+    from deepspeed_tpu.inference.engine_v2 import build_engine_v2
+    from deepspeed_tpu.inference.sampling import SamplingParams
+    from deepspeed_tpu.models import llama
+
+    backend = jax.default_backend()
+    on_tpu = backend == "tpu"
+    RESULT["detail"]["backend"] = backend
+    if on_tpu:
+        # the bench model (235M, hd=128) at serving-realistic lengths
+        mcfg = llama.LlamaConfig(
+            vocab_size=32000, hidden_size=1024, intermediate_size=3584,
+            num_layers=12, num_heads=8, num_kv_heads=4, max_seq_len=2048,
+            rope_theta=500000.0)
+        prompt_len, gen_len, measure_s = 512, 128, 20.0
+        batches = [8, 16, 32]
+    else:
+        mcfg = llama.LlamaConfig.tiny()
+        prompt_len, gen_len, measure_s = 32, 8, 5.0
+        batches = [4, 8]
+    rng = np.random.default_rng(0)
+    sp = SamplingParams(greedy=True)
+    rows = {}
+    best = 0.0
+    for batch in batches:
+        eng = None
+        try:
+            eng = build_engine_v2(
+                llama, mcfg, llama.init(mcfg, jax.random.PRNGKey(0)),
+                config={"dtype": "bfloat16", "prefill_bucket": prompt_len,
+                        "ragged": {
+                            "max_tracked_sequences": batch,
+                            "max_ragged_batch_size": batch,
+                            "memory_config_blocks":
+                                batch * ((prompt_len + gen_len) // 32 + 2) + 8,
+                            "block_size": 32}})
+            tps, prefills = run_closed_loop(
+                eng, sp, mcfg.vocab_size, batch, prompt_len, gen_len,
+                measure_s, rng)
+            rows[str(batch)] = {"tok_per_sec": round(tps, 1),
+                                "prefills_in_window": prefills,
+                                "prompt_len": prompt_len, "gen_len": gen_len}
+            best = max(best, tps)
+            sys.stderr.write(f"[serving] clients={batch}: {rows[str(batch)]}\n")
+        except Exception as e:
+            rows[str(batch)] = f"error: {str(e)[-200:]}"
+        finally:
+            del eng  # free HBM before the next (larger) client count
+    RESULT["value"] = round(best, 1)
+    RESULT["detail"]["rows"] = rows
+    RESULT["detail"]["params_m"] = round(mcfg.num_params / 1e6, 1)
+    print(json.dumps(RESULT))
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except Exception as e:
+        RESULT["detail"]["error"] = str(e)[-2000:]
+        print(json.dumps(RESULT))
