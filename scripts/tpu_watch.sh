@@ -23,9 +23,11 @@ while true; do
       echo "[watch] $ts TPU bench CAPTURED -> BENCH_TPU_LIVE.json" >> "$LOG"
       # long-context + serving probes, each best-effort with its own timeout
       timeout 2400 python scripts/longctx_bench.py > "bench_runs/LONGCTX_${ts}.json" 2>> "$LOG" \
+        && grep -q '"backend": "tpu"' "bench_runs/LONGCTX_${ts}.json" \
         && cp "bench_runs/LONGCTX_${ts}.json" LONGCTX_TPU_LIVE.json \
         && echo "[watch] $ts longctx captured" >> "$LOG"
       timeout 1800 python scripts/serving_bench.py > "bench_runs/SERVING_${ts}.json" 2>> "$LOG" \
+        && grep -q '"backend": "tpu"' "bench_runs/SERVING_${ts}.json" \
         && cp "bench_runs/SERVING_${ts}.json" SERVING_TPU_LIVE.json \
         && echo "[watch] $ts serving captured" >> "$LOG"
       # after a full capture, slow the poll (evidence is in; re-runs refresh it)
