@@ -41,6 +41,57 @@ BATCH_AXES: Tuple[str, ...] = ("data", "zero_shard", "expert")
 _global_mesh: Optional["MeshManager"] = None
 
 
+def _arrange_devices(devices: Sequence[jax.Device],
+                     sizes: Sequence[int]) -> np.ndarray:
+    """Physical-topology-aware device→mesh assignment.
+
+    The mesh analog of the reference's rank-mapping layer
+    (``deepspeed/utils/groups.py:544``, ``runtime/pipe/topology.py:12``): axis
+    ORDER alone does not put 'tensor' on nearest-neighbor ICI, because
+    ``jax.devices()`` is process-tiled (z,y,x, core) order — a naive reshape
+    of a v5p pod can land the innermost axis across hosts. On TPU,
+    ``mesh_utils.create_device_mesh`` solves the logical→physical-torus
+    assignment so inner axes ride contiguous ICI rings; for multi-slice jobs
+    ``create_hybrid_device_mesh`` confines exactly one (outermost feasible,
+    preferably 'data') axis to DCN and keeps every other axis inside a slice.
+    CPU / single-device meshes keep the plain reshape (virtual devices have
+    no topology, and tests depend on deterministic device order).
+    """
+    if len(devices) == 1 or getattr(devices[0], "platform", "cpu") != "tpu":
+        return np.asarray(devices).reshape(sizes)
+    from jax.experimental import mesh_utils
+
+    slice_ids = {getattr(d, "slice_index", 0) for d in devices}
+    n_slices = len(slice_ids)
+    dcn_axis = None
+    if n_slices > 1:
+        # one axis spans DCN; scan outer→inner so 'data' wins when it can
+        for i in range(len(sizes)):
+            if sizes[i] >= n_slices and sizes[i] % n_slices == 0:
+                dcn_axis = i
+                break
+        else:
+            raise ValueError(
+                f"no mesh axis divisible by slice count {n_slices}: "
+                f"{dict(zip(MESH_AXES, sizes))}")
+    try:
+        if dcn_axis is not None:
+            dcn = [1] * len(sizes)
+            dcn[dcn_axis] = n_slices
+            per_slice = list(sizes)
+            per_slice[dcn_axis] //= n_slices
+            return mesh_utils.create_hybrid_device_mesh(
+                per_slice, dcn, devices=devices)
+        return mesh_utils.create_device_mesh(sizes, devices=devices)
+    except Exception as e:  # unknown topology (e.g. tunneled sub-slice
+        # quirks) — mesh_utils raises plain ValueError for these too, so no
+        # exception type is exempt from the fallback
+        logger.warning(
+            f"topology-aware mesh assignment failed ({e}); falling back to "
+            "device-order reshape — inner-axis collectives may cross hosts")
+        return np.asarray(devices).reshape(sizes)
+
+
 @dataclass
 class MeshManager:
     """Owns the Mesh plus axis bookkeeping.
@@ -61,7 +112,7 @@ class MeshManager:
         if total != len(devices):
             raise ValueError(f"mesh sizes {dict(zip(MESH_AXES, sizes))} product {total} "
                              f"!= device count {len(devices)}")
-        dev_array = np.asarray(devices).reshape(sizes)
+        dev_array = _arrange_devices(devices, sizes)
         mesh = Mesh(dev_array, MESH_AXES)
         log_dist(f"Created mesh {dict(zip(MESH_AXES, sizes))} over {len(devices)} devices "
                  f"({devices[0].platform})")
