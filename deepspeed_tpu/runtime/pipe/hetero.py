@@ -9,12 +9,24 @@ adapters, tower + head) pipeline through the same engine.
 TPU-first redesign: stages still execute under ONE compiled 1F1B SPMD clock
 (see ``one_f_one_b.py`` — ppermute rings, recompute-backward, O(S) stash);
 per-stage heterogeneity enters as a ``lax.switch`` over the stage index whose
-branches are the stages' sub-programs. Stage params ride ``shard_map`` as
-explicit inputs, replicated over 'pipe' — ZeRO/TP sharding over the OTHER
-mesh axes still applies outside the manual region, so per-rank param bytes
-match plain DP. The homogeneous stacked path (``one_f_one_b``) keeps true
-stage-local parameter placement and remains the fast path for uniform layer
-stacks; this module buys capability (arbitrary stage programs), not memory.
+branches are the stages' sub-programs.
+
+Stage-LOCAL parameter placement (reference ``module.py:86``: each rank builds
+only its stage's layers — the whole point of PP for >HBM models): every
+stage's param pytree is packed into per-dtype flat rows, padded to the
+largest stage, and stacked into ``[S, Lpad]`` buffers whose leading dim is
+sharded over 'pipe'. Each pipe rank therefore HOLDS only its own stage's
+bytes (+ pad to the max stage — the bucketed/padded cost of heterogeneity);
+the per-stage tree structure is static unpack metadata (offset/shape slices)
+applied inside that stage's ``lax.switch`` branch. Gradients come back in
+the same packed pipe-sharded layout, so optimizer state and fp32 masters are
+stage-local too, and no cross-'pipe' grad psum is needed (each rank's row
+grads are complete locally).
+
+Batch/data axes: the shard_map is partial-manual over {'pipe'} only — the
+engine's 'data'-axis batch sharding stays an AUTO axis, so XLA partitions
+each micro-batch's compute over 'data' as usual (dp still buys throughput
+on this path; 'pipe' replication applies only to the schedule clock).
 
 Activation contract: every stage boundary carries the SAME activation
 shape/dtype (the classic pipeline constraint; the reference's p2p send/recv
@@ -34,8 +46,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ...comm.mesh import get_mesh
-from .module import (one_f_one_b_predicates, one_f_one_b_ticks, psum_f32,
-                     ring_perms)
+from .module import one_f_one_b_predicates, one_f_one_b_ticks, ring_perms
 
 
 # --------------------------------------------------------------------------- #
@@ -134,27 +145,107 @@ def partition_layers(specs: Sequence[LayerSpec], n_stages: int,
 
 
 # --------------------------------------------------------------------------- #
+# stage-tree <-> packed pipe-sharded buffer conversion
+# --------------------------------------------------------------------------- #
+@dataclass(frozen=True)
+class StageLayout:
+    """Static unpack metadata for one stage: the tree structure plus, per
+    leaf (in flatten order), which dtype-buffer it lives in and at what
+    offset/shape."""
+
+    treedef: Any
+    entries: Tuple[Tuple[str, int, Tuple[int, ...]], ...]
+
+
+_PAD_QUANTUM = 1024  # rows pad to a multiple of this so ZeRO axes divide Lpad
+
+
+def pack_stage_trees(stage_trees: Sequence[Any]
+                     ) -> Tuple[dict, List[StageLayout]]:
+    """Stage param pytrees → ``({dtype_key: [S, Lpad] array}, layouts)``.
+
+    Leaves are grouped by dtype (a flat buffer needs one dtype), raveled and
+    concatenated per stage, zero-padded to the largest stage's length. The
+    leading dim is meant to be sharded over 'pipe' (logical axis 'layers'),
+    which makes each rank's resident bytes its own stage share + pad.
+
+    Packing happens on HOST (numpy): building a fully-replicated [S, Lpad]
+    jnp copy next to the live stage leaves would transiently double the
+    whole model on the default device — the exact OOM stage-local placement
+    exists to avoid. The engine device_puts the packed result with its
+    pipe-sharded layout, so only each rank's row ever lands on a device.
+    """
+    import ml_dtypes  # noqa: F401  (registers bfloat16 etc. with numpy)
+
+    layouts: List[StageLayout] = []
+    per_dtype_len: dict = {}
+    for tree in stage_trees:
+        leaves, treedef = jax.tree.flatten(tree)
+        offs: dict = {}
+        entries = []
+        for leaf in leaves:
+            dt = str(getattr(leaf, "dtype", None) or jnp.asarray(leaf).dtype)
+            o = offs.get(dt, 0)
+            entries.append((dt, o, tuple(leaf.shape)))
+            offs[dt] = o + int(np.prod(leaf.shape))
+        layouts.append(StageLayout(treedef, tuple(entries)))
+        for dt, end in offs.items():
+            per_dtype_len[dt] = max(per_dtype_len.get(dt, 0), end)
+    buffers = {}
+    for dt, L in per_dtype_len.items():
+        Lp = -(-L // _PAD_QUANTUM) * _PAD_QUANTUM
+        np_dt = np.dtype(dt)
+        rows = np.zeros((len(stage_trees), Lp), np_dt)
+        for s, (tree, layout) in enumerate(zip(stage_trees, layouts)):
+            leaves = jax.tree.leaves(tree)
+            for leaf, (d, off, shape) in zip(leaves, layout.entries):
+                if d == dt:
+                    n = int(np.prod(shape))
+                    rows[s, off:off + n] = np.asarray(leaf).ravel()
+        buffers[dt] = rows
+    return buffers, layouts
+
+
+def unpack_stage(rows: dict, layout: StageLayout) -> Any:
+    """One stage's param tree from its packed rows ``{dtype_key: [Lpad]}``.
+    Pure static slicing/reshaping — differentiable, jit-friendly."""
+    leaves = [lax.slice_in_dim(rows[dt], off, off + int(np.prod(shape)))
+              .reshape(shape) for dt, off, shape in layout.entries]
+    return jax.tree.unflatten(layout.treedef, leaves)
+
+
+def buffer_logical_axes(buffers: dict):
+    """Logical axes for the packed buffers: leading dim is the stage dim
+    ('layers' → 'pipe' when PP is active), flat dim left for ZeRO."""
+    return {dt: ("layers", None) for dt in buffers}
+
+
+# --------------------------------------------------------------------------- #
 # the compiled heterogeneous 1F1B clock
 # --------------------------------------------------------------------------- #
 def hetero_pipeline_value_and_grad(
         first_fn: Callable[[Any, Any], jnp.ndarray],
         mid_fns: Sequence[Callable[[Any, jnp.ndarray], jnp.ndarray]],
         last_fn: Callable[[Any, jnp.ndarray, Any], jnp.ndarray],
-        stage_params: Sequence[Any], inputs: Any, labels: Any, *,
+        buffers: dict, layouts: Sequence[StageLayout],
+        inputs: Any, labels: Any, *,
         num_micro: Optional[int] = None,
-        pipe_axis: str = "pipe") -> Tuple[jnp.ndarray, Tuple[Any, ...]]:
-    """1F1B over ``S = 2 + len(mid_fns)`` heterogeneous stages.
+        pipe_axis: str = "pipe") -> Tuple[jnp.ndarray, dict]:
+    """1F1B over ``S = 2 + len(mid_fns)`` heterogeneous stages with
+    stage-LOCAL packed params.
 
     first_fn(p0, inputs_micro) -> h            (stage 0: embed + its blocks)
     mid_fns[s-1](ps, h) -> h                   (stages 1..S-2)
     last_fn(pS, h, labels_micro) -> sum loss   (last stage: blocks + head)
 
-    Returns ``(mean-ish loss, per-stage grads tuple)`` with the same
-    ``(1/M)·Σ`` scaling contract as ``pipeline_value_and_grad``.
+    ``buffers``: ``{dtype_key: [S, Lpad]}`` packed stage params
+    (``pack_stage_trees``); each pipe rank sees only its own row inside the
+    manual region. Returns ``(mean-ish loss, packed f32 grads)`` with the
+    same ``(1/M)·Σ`` scaling contract as ``pipeline_value_and_grad``.
     Falls back to sequential value_and_grad when the mesh has pipe <= 1.
     """
     mm = get_mesh()
-    S = len(stage_params)
+    S = len(layouts)
     if mm.axis_size(pipe_axis) != S and mm.axis_size(pipe_axis) > 1:
         raise ValueError(
             f"model was partitioned into {S} stage(s) but the mesh's "
@@ -163,18 +254,22 @@ def hetero_pipeline_value_and_grad(
             f"n_stages={mm.axis_size(pipe_axis)} to build_pipeline_model")
     if S != 2 + len(mid_fns):
         raise ValueError(
-            f"stage count mismatch: {S} stage param trees but "
+            f"stage count mismatch: {S} stage layouts but "
             f"{len(mid_fns)} mid fns (expect S == 2 + len(mid_fns))")
 
-    if mm.axis_size(pipe_axis) <= 1:
-        def flat_loss(ps):
-            h = first_fn(ps[0], inputs)
-            for fn, p in zip(mid_fns, ps[1:-1]):
-                h = fn(p, h)
-            return last_fn(ps[-1], h, labels)
+    def stage_rows(bufs, s):
+        return {dt: b[s] for dt, b in bufs.items()}
 
-        loss, grads = jax.value_and_grad(flat_loss)(tuple(stage_params))
-        return loss, grads
+    if mm.axis_size(pipe_axis) <= 1:
+        def flat_loss(bufs):
+            h = first_fn(unpack_stage(stage_rows(bufs, 0), layouts[0]), inputs)
+            for s, fn in enumerate(mid_fns, start=1):
+                h = fn(unpack_stage(stage_rows(bufs, s), layouts[s]), h)
+            return last_fn(unpack_stage(stage_rows(bufs, S - 1),
+                                        layouts[S - 1]), h, labels)
+
+        loss, grads = jax.value_and_grad(flat_loss)(buffers)
+        return loss, jax.tree.map(lambda g: g.astype(jnp.float32), grads)
 
     M = num_micro or S
     B = jax.tree.leaves(inputs)[0].shape[0]
@@ -188,43 +283,49 @@ def hetero_pipeline_value_and_grad(
     T = one_f_one_b_ticks(S, M)
 
     # activation template from stage 0 (shape-only)
-    probe = jax.eval_shape(first_fn, stage_params[0],
-                           jax.tree.map(lambda x: x[0], micro_in))
-    f32z = lambda t: jax.tree.map(  # noqa: E731
-        lambda x: jnp.zeros(x.shape, jnp.float32), t)
+    probe = jax.eval_shape(
+        lambda b, x: first_fn(unpack_stage(stage_rows(b, 0), layouts[0]), x),
+        buffers, jax.tree.map(lambda x: x[0], micro_in))
 
-    def pipelined(params, micro_in, micro_lab, probe_shape):
+    def pipelined(bufs, micro_in, micro_lab, probe_shape):
         stage = lax.axis_index(pipe_axis)
+        # each rank's packed row IS its stage's params (P('pipe') in_spec)
+        rows = {dt: b[0] for dt, b in bufs.items()}
         stash = jnp.zeros((S,) + probe_shape.shape, probe_shape.dtype)
         h_next = jnp.zeros_like(probe_shape)
         g_next = jnp.zeros_like(probe_shape)
-        g_params = tuple(f32z(p) for p in params)
+        g_rows = {dt: jnp.zeros(r.shape, jnp.float32)
+                  for dt, r in rows.items()}
         loss_sum = jnp.zeros((), jnp.float32)
 
         def tick(t, carry):
-            stash, h_next, g_next, g_params, loss_sum = carry
+            stash, h_next, g_next, g_rows, loss_sum = carry
             fwd_on, i_f, bwd_on, i_b = one_f_one_b_predicates(t, stage, S, M)
 
             # ---- forward tick: lax.switch over the stage's sub-program ----
+            # branch s unpacks THIS rank's row with stage s's layout; only
+            # the branch matching the rank's stage index ever executes
             def do_fwd(stash, h_next, loss_sum):
                 inj = jax.tree.map(lambda x: x[i_f], micro_in)
                 lab = jax.tree.map(lambda x: x[i_f], micro_lab)
 
                 def b_first():
-                    return (first_fn(params[0], inj)
+                    return (first_fn(unpack_stage(rows, layouts[0]), inj)
                             .astype(probe_shape.dtype),
                             jnp.zeros((), jnp.float32))
 
                 def b_mid(s):
                     def f():
-                        return (mid_fns[s - 1](params[s], h_next)
+                        return (mid_fns[s - 1](unpack_stage(rows, layouts[s]),
+                                               h_next)
                                 .astype(probe_shape.dtype),
                                 jnp.zeros((), jnp.float32))
                     return f
 
                 def b_last():
                     return (jnp.zeros_like(h_next),
-                            last_fn(params[-1], h_next, lab)
+                            last_fn(unpack_stage(rows, layouts[-1]), h_next,
+                                    lab)
                             .astype(jnp.float32))
 
                 branches = ([b_first] + [b_mid(s) for s in range(1, S - 1)]
@@ -243,26 +344,24 @@ def hetero_pipeline_value_and_grad(
                 stash, h_next, loss_sum)
 
             # ---- backward tick (recompute + vjp, switch per stage) ----
-            def do_bwd(g_next, g_params):
+            # vjp runs w.r.t. the packed rows, so row grads land directly in
+            # the stage-local packed layout (zero where other dtypes/pads)
+            def do_bwd(g_next, g_rows):
                 h_in = lax.dynamic_index_in_dim(stash, i_b % S, 0,
                                                 keepdims=False)
                 inj = jax.tree.map(lambda x: x[i_b], micro_in)
                 lab = jax.tree.map(lambda x: x[i_b], micro_lab)
-                zeros_g = tuple(f32z(p) for p in params)
 
-                def set_s(tup, s, val):
-                    return tuple(val if i == s else x
-                                 for i, x in enumerate(tup))
+                def cast_f32(gr):
+                    return {dt: g.astype(jnp.float32)
+                            for dt, g in gr.items()}
 
                 def b_first():
                     _, vjp = jax.vjp(
-                        lambda p: first_fn(p, inj).astype(g_next.dtype),
-                        params[0])
-                    (gp,) = vjp(g_next)
-                    return (set_s(zeros_g, 0,
-                                  jax.tree.map(lambda x: x.astype(jnp.float32),
-                                               gp)),
-                            jnp.zeros_like(g_next))
+                        lambda r: first_fn(unpack_stage(r, layouts[0]), inj)
+                        .astype(g_next.dtype), rows)
+                    (gr,) = vjp(g_next)
+                    return cast_f32(gr), jnp.zeros_like(g_next)
 
                 def b_mid(s):
                     def f():
@@ -270,55 +369,51 @@ def hetero_pipeline_value_and_grad(
                         # the cotangent seed dtype always matches, whatever
                         # dtype the stage's apply returns
                         out, vjp = jax.vjp(
-                            lambda p, h: mid_fns[s - 1](p, h)
-                            .astype(probe_shape.dtype), params[s], h_in)
-                        gp, gh = vjp(g_next.astype(out.dtype))
-                        return (set_s(zeros_g, s,
-                                      jax.tree.map(
-                                          lambda x: x.astype(jnp.float32),
-                                          gp)),
-                                gh.astype(g_next.dtype))
+                            lambda r, h: mid_fns[s - 1](
+                                unpack_stage(r, layouts[s]), h)
+                            .astype(probe_shape.dtype), rows, h_in)
+                        gr, gh = vjp(g_next.astype(out.dtype))
+                        return cast_f32(gr), gh.astype(g_next.dtype)
                     return f
 
                 def b_last():
                     _, vjp = jax.vjp(
-                        lambda p, h: (last_fn(p, h, lab) / M)
-                        .astype(jnp.float32), params[-1], h_in)
-                    gp, gh = vjp(jnp.ones((), jnp.float32))
-                    return (set_s(zeros_g, S - 1,
-                                  jax.tree.map(lambda x: x.astype(jnp.float32),
-                                               gp)),
-                            gh.astype(g_next.dtype))
+                        lambda r, h: (last_fn(unpack_stage(r, layouts[-1]),
+                                              h, lab) / M)
+                        .astype(jnp.float32), rows, h_in)
+                    gr, gh = vjp(jnp.ones((), jnp.float32))
+                    return cast_f32(gr), gh.astype(g_next.dtype)
 
                 branches = ([b_first] + [b_mid(s) for s in range(1, S - 1)]
                             + [b_last])
-                gp_all, gh = lax.switch(stage, branches)
-                g_params = jax.tree.map(jnp.add, g_params, gp_all)
-                return gh, g_params
+                gr, gh = lax.switch(stage, branches)
+                g_rows = jax.tree.map(jnp.add, g_rows, gr)
+                return gh, g_rows
 
-            g_out, g_params = lax.cond(
+            g_out, g_rows = lax.cond(
                 bwd_on, do_bwd,
-                lambda g_next, g_params: (jnp.zeros_like(g_next), g_params),
-                g_next, g_params)
+                lambda g_next, g_rows: (jnp.zeros_like(g_next), g_rows),
+                g_next, g_rows)
 
             h_next = lax.ppermute(fwd_out, pipe_axis, fwd_perm)
             g_next = lax.ppermute(g_out, pipe_axis, bwd_perm)
-            return stash, h_next, g_next, g_params, loss_sum
+            return stash, h_next, g_next, g_rows, loss_sum
 
-        carry = (stash, h_next, g_next, g_params, loss_sum)
+        carry = (stash, h_next, g_next, g_rows, loss_sum)
         carry = lax.fori_loop(0, T, tick, carry)
-        _, _, _, g_params, loss_sum = carry
+        _, _, _, g_rows, loss_sum = carry
         loss = lax.psum(loss_sum, pipe_axis) / M
-        g_params = jax.tree.map(lambda g: psum_f32(g, pipe_axis), g_params)
-        return loss, g_params
+        # each rank's row grads are complete locally (it only ever ran its
+        # own stage's branches) — stacking over 'pipe' replaces the old
+        # replicated-tree psum; the schedule needs NO cross-stage grad comm
+        return loss, {dt: g[None, :] for dt, g in g_rows.items()}
 
     probe_shape = jnp.zeros(probe.shape, probe.dtype)
-    params = tuple(stage_params)
     loss, grads = jax.shard_map(
         pipelined, mesh=mm.mesh, axis_names={pipe_axis},
-        in_specs=(jax.tree.map(lambda _: P(), params), P(), P(), P()),
-        out_specs=(P(), jax.tree.map(lambda _: P(), params)),
-        check_vma=False)(params, micro_in, micro_lab, probe_shape)
+        in_specs=({dt: P(pipe_axis) for dt in buffers}, P(), P(), P()),
+        out_specs=(P(), {dt: P(pipe_axis) for dt in buffers}),
+        check_vma=False)(buffers, micro_in, micro_lab, probe_shape)
     return loss, grads
 
 
@@ -339,7 +434,9 @@ def build_pipeline_model(specs: Sequence[LayerSpec],
 
     ``first_fn(p, batch_inputs) -> h`` embeds the raw micro inputs using the
     FIRST spec's params; ``loss_head(h, labels) -> summed loss`` closes the
-    LAST stage. Stage s params live under key ``f"stage{s}"``.
+    LAST stage. Params are stored PACKED: per-dtype ``[S, Lpad]`` buffers
+    whose stage dim shards over 'pipe' (stage-local bytes, reference
+    ``module.py:86`` parity); per-stage trees are unpacked on the fly.
     """
     from ..engine import ModelSpec
 
@@ -356,8 +453,13 @@ def build_pipeline_model(specs: Sequence[LayerSpec],
         bounds = partition_layers(specs, S, partition_method)
 
     groups = [list(range(bounds[s], bounds[s + 1])) for s in range(len(bounds) - 1)]
-    params = {f"stage{s}": {str(i): specs[i].params for i in g}
-              for s, g in enumerate(groups)}
+    stage_trees = [{str(i): specs[i].params for i in g} for g in groups]
+    buffers, layouts = pack_stage_trees(stage_trees)
+    params = {"pipe_buffers": buffers}
+
+    def stage_tree(p, s):
+        bufs = p["pipe_buffers"]
+        return unpack_stage({dt: b[s] for dt, b in bufs.items()}, layouts[s])
 
     def run_group(s, p_stage, h, first=False, inputs=None):
         for j, i in enumerate(groups[s]):
@@ -377,7 +479,7 @@ def build_pipeline_model(specs: Sequence[LayerSpec],
         inputs, labels = split_batch(batch)
         h = None
         for s in range(len(groups)):
-            h = run_group(s, p[f"stage{s}"], h, first=(s == 0),
+            h = run_group(s, stage_tree(p, s), h, first=(s == 0),
                           inputs=inputs)
         loss = loss_head(h, labels)
         denom = jnp.maximum(jax.tree.leaves(labels)[0].size, 1)
@@ -397,19 +499,19 @@ def build_pipeline_model(specs: Sequence[LayerSpec],
         def lst(pl, h, lab):
             return loss_head(run_group(n - 1, pl, h), lab) * scale
 
-        stage_params = [p[f"stage{s}"] for s in range(n)]
         loss, grads = hetero_pipeline_value_and_grad(
-            fst, [mid(s) for s in range(1, n - 1)], lst, stage_params,
-            inputs, labels)
+            fst, [mid(s) for s in range(1, n - 1)], lst,
+            p["pipe_buffers"], layouts, inputs, labels)
         M = max(get_mesh().pp_world_size, 1)
         denom = jnp.maximum(jax.tree.leaves(labels)[0].size, 1) \
             .astype(jnp.float32)
         factor = M / denom
-        out_grads = {f"stage{s}": jax.tree.map(lambda g: g * factor, gs)
-                     for s, gs in enumerate(grads)}
+        out_grads = {"pipe_buffers":
+                     jax.tree.map(lambda g: g * factor, grads)}
         loss = loss * factor / scale
         return out_grads, loss, {}
 
     return ModelSpec(loss_fn=loss_fn, params=params, name=name,
-                     pipeline_capable=False,
+                     pipeline_capable=True,
+                     logical_axes={"pipe_buffers": buffer_logical_axes(buffers)},
                      pipeline_grad_fn=pipeline_grad_fn)

@@ -276,3 +276,70 @@ def test_hetero_pipeline_matches_sequential(devices8):
     pp_losses = run({"mesh": {"data": 4, "pipe": 2}})
     assert seq_losses[-1] < seq_losses[0]  # it actually learns
     np.testing.assert_allclose(seq_losses, pp_losses, rtol=5e-4, atol=5e-5)
+
+
+def test_hetero_stage_local_param_bytes(devices8):
+    """Each pipe rank holds only its stage's packed params (+ pad to the max
+    stage), NOT the whole model (reference PipelineModule gives each rank
+    only its stage's layers, module.py:86). Lopsided LayerSpec list: wide
+    MLP blocks next to tiny residual blocks."""
+    from deepspeed_tpu.comm import mesh as mesh_lib
+    from deepspeed_tpu.runtime.pipe.hetero import (LayerSpec,
+                                                   build_pipeline_model)
+
+    d, vocab = 32, 64
+    ks = jax.random.split(jax.random.PRNGKey(1), 12)
+
+    def wide_apply(p, h):
+        return h + jnp.tanh(h @ p["up"]) @ p["down"]
+
+    def narrow_apply(p, h):
+        return h + jnp.tanh(h * p["scale"] + p["bias"])
+
+    specs = [LayerSpec("Embed", {"e": jax.random.normal(ks[0], (vocab, d)) * 0.1},
+                       lambda p, t: p["e"][t])]
+    for i in range(4):
+        specs.append(LayerSpec(
+            "Wide", {"up": jax.random.normal(ks[1 + i], (d, 4 * d)) * 0.1,
+                     "down": jax.random.normal(ks[5 + i], (4 * d, d)) * 0.1},
+            wide_apply))
+        specs.append(LayerSpec(
+            "Narrow", {"scale": jnp.ones((d,)), "bias": jnp.zeros((d,))},
+            narrow_apply))
+    specs.append(LayerSpec("Head", {"out": jax.random.normal(ks[9], (d, vocab)) * 0.1},
+                           lambda p, h: h @ p["out"]))
+
+    def loss_head(logits, labels):
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return -jnp.take_along_axis(logp, labels[..., None], axis=-1).sum()
+
+    total_param_bytes = sum(
+        np.prod(s.params[k].shape) * 4 for s in specs for k in s.params)
+
+    mesh_lib.set_mesh(None)
+    model = build_pipeline_model(
+        specs, lambda p, t: p["e"][t], loss_head, n_stages=4,
+        partition_method="parameters")
+    engine, *_ = dst.initialize(model=model, config={
+        "train_batch_size": 8,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+        "mesh": {"data": 2, "pipe": 4},
+        "steps_per_print": 0,
+    })
+    dev0 = jax.devices()[0]
+    dev0_bytes = 0
+    for leaf in jax.tree.leaves(engine.state.params):
+        assert hasattr(leaf, "addressable_shards")
+        for shard in leaf.addressable_shards:
+            if shard.device == dev0:
+                dev0_bytes += shard.data.nbytes
+    # stage share (max stage + pad quantum) is well under half the model;
+    # the old replicated layout held ALL stages (ratio 1.0) on every rank
+    assert dev0_bytes < 0.5 * total_param_bytes, \
+        (dev0_bytes, total_param_bytes)
+    # and training still works on the lopsided partition
+    tokens = np.asarray(jax.random.randint(jax.random.PRNGKey(3), (8, 9),
+                                           0, vocab))
+    losses = [float(engine.train_batch({"tokens": tokens}).loss)
+              for _ in range(4)]
+    assert losses[-1] < losses[0]
