@@ -68,6 +68,10 @@ def run_elastic(model_spec, base_config: Dict[str, Any],
 # --------------------------------------------------------------------------- #
 # in-job failure / preemption hook
 # --------------------------------------------------------------------------- #
+def _process_count() -> int:
+    return jax.process_count()
+
+
 class PreemptionGuard:
     """In-job failure hook (reference ``DSElasticAgent._invoke_run:127`` —
     monitor workers, on UNHEALTHY/FAILED checkpoint-and-restart at a new
@@ -90,11 +94,17 @@ class PreemptionGuard:
     """
 
     def __init__(self, save_dir: str, *, signals: Tuple[int, ...] = None,
-                 tag: Optional[str] = None):
+                 tag: Optional[str] = None, coordinate_interval: int = 1):
         import signal as _signal
 
         self.save_dir = save_dir
         self.tag = tag
+        # multi-host flag agreement runs every Nth boundary (all ranks share
+        # the same counter so they agree on WHICH boundaries coordinate);
+        # raise it to amortize the per-step allgather on big pods — the
+        # trade is up to N-1 extra steps of the SIGTERM grace window
+        self.coordinate_interval = max(1, int(coordinate_interval))
+        self._boundary_count = 0
         self._triggered = False
         self._signum: Optional[int] = None
         self._prev: Dict[int, Any] = {}
@@ -119,14 +129,37 @@ class PreemptionGuard:
     def step_boundary(self, engine) -> bool:
         """Checkpoint-and-signal-exit when a preemption arrived. Returns
         True exactly once per trigger; safe to call every step (no-op when
-        no signal is pending)."""
-        if not self._triggered:
+        no signal is pending).
+
+        Multi-host: SIGTERM can land on different hosts at different times,
+        but ``engine.save_checkpoint`` is COLLECTIVE (orbax over sharded
+        arrays) — entering it at mismatched steps hangs or corrupts the
+        checkpoint (the reference coordinates restarts through torch-elastic
+        rendezvous, ``elastic_agent.py:32``). So the local flag is agreed on
+        globally at every boundary: an allgather-OR, synchronous with the
+        step's collectives, guarantees every process sees the trigger at the
+        SAME boundary and checkpoints the same step."""
+        trig = self._triggered
+        self._boundary_count += 1
+        if _process_count() > 1 and \
+                self._boundary_count % self.coordinate_interval == 0:
+            import numpy as _np
+            from jax.experimental import multihost_utils
+
+            trig = bool(multihost_utils.process_allgather(
+                _np.asarray(self._triggered)).any())
+        elif _process_count() > 1:
+            # off-cadence boundaries never act on the LOCAL flag alone —
+            # acting would desynchronize the collective save
+            trig = False
+        if not trig:
             return False
         self._triggered = False  # once per trigger — never re-save the
         # checkpoint on later calls inside the preemption grace window
         path = engine.save_checkpoint(self.save_dir, tag=self.tag)
         log_dist(f"PreemptionGuard: checkpoint saved to {path} after "
-                 f"signal {self._signum}; exit for elastic restart")
+                 f"signal {self._signum or 'on a peer host'}; exit for "
+                 f"elastic restart")
         return True
 
     def uninstall(self) -> None:

@@ -332,6 +332,13 @@ class DeepSpeedTPUEngine:
         from .swap_tensor.streaming_optimizer import NVMeStreamingOptimizer
 
         cfg = self.config
+        if jax.process_count() > 1:
+            raise ValueError(
+                "offload_optimizer device=nvme is single-host for now: the "
+                "streamed tier gathers grads to host numpy (fails on "
+                "non-addressable multi-host arrays) and writes state files "
+                "on process 0 only — per-host sharded streaming is not "
+                "implemented")
         if cfg.fp16.enabled:
             raise ValueError(
                 "offload_optimizer device=nvme supports bf16/fp32 training "
@@ -376,8 +383,11 @@ class DeepSpeedTPUEngine:
         batch = self._shard_batch(batch, with_gas_dim=True)
         grads, loss, aux = self._nvme_grad_step(self.state.params, batch,
                                                 self.state.loss_scale)
-        g_leaves = [np.asarray(g, np.float32)
-                    for g in jax.tree.leaves(grads)]
+        g_dev = jax.tree.leaves(grads)
+        for g in g_dev:  # start ALL D2H copies before the first blocking
+            if hasattr(g, "copy_to_host_async"):  # np.asarray (overlapped
+                g.copy_to_host_async()  # transfers, not one full-tree sync)
+        g_leaves = [np.asarray(g, np.float32) for g in g_dev]
         sq = sum(float(np.vdot(g, g)) for g in g_leaves)
         grad_norm = float(np.sqrt(sq))
         finite = np.isfinite(grad_norm)
@@ -394,16 +404,25 @@ class DeepSpeedTPUEngine:
                 if coef < 1.0:
                     g_leaves = [g * np.float32(coef) for g in g_leaves]
             bf16 = self.precision.compute_dtype == jnp.bfloat16
-            outs = self._nvme_opt.step(
-                g_leaves, lr=lr_t,
-                out_dtype="bfloat16" if bf16 else "float32")
-            if bf16:
-                outs = [u.view(ml_dtypes.bfloat16) for u in outs]
             flat_shardings = jax.tree.leaves(
                 self._param_shardings,
                 is_leaf=lambda x: isinstance(x, NamedSharding))
-            new_leaves = [jax.device_put(u, sh)
-                          for u, sh in zip(outs, flat_shardings)]
+            new_leaves: list = [None] * len(g_leaves)
+
+            def h2d_group(leaf_ids, outs):
+                # fires per finished sub-group INSIDE the streamed step:
+                # device_put dispatch is async, so these H2D transfers run
+                # while the later sub-groups are still reading/updating
+                # (reference pipelined_optimizer_swapper.py:52 overlap)
+                for lid, u in zip(leaf_ids, outs):
+                    if bf16:
+                        u = u.view(ml_dtypes.bfloat16)
+                    new_leaves[lid] = jax.device_put(u, flat_shardings[lid])
+
+            self._nvme_opt.step(
+                g_leaves, lr=lr_t,
+                out_dtype="bfloat16" if bf16 else "float32",
+                on_group=h2d_group)
             new_params = jax.tree_util.tree_unflatten(self._nvme_treedef,
                                                       new_leaves)
             self.state = self.state._replace(

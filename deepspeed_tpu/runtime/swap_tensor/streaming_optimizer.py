@@ -23,7 +23,7 @@ exactly the bytes that must reach the device anyway.
 from __future__ import annotations
 
 import os
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -140,11 +140,20 @@ class NVMeStreamingOptimizer:
 
     # ------------------------------------------------------------------ #
     def step(self, grads: Sequence[np.ndarray], lr: Optional[float] = None,
-             out_dtype: str = "bfloat16") -> List[np.ndarray]:
+             out_dtype: str = "bfloat16",
+             on_group: Optional[Callable[[List[int], List[np.ndarray]], None]]
+             = None) -> List[np.ndarray]:
         """One streamed optimizer step. ``grads``: one fp32 numpy array per
         leaf (same order as the init params). Returns the updated compute
         copies — bf16 uint16 bit-pattern arrays by default (view them as
-        bfloat16 on device), or fp32 copies with ``out_dtype='float32'``."""
+        bfloat16 on device), or fp32 copies with ``out_dtype='float32'``.
+
+        ``on_group(leaf_ids, out_leaves)`` fires the moment a sub-group's
+        update is done — BEFORE the next group's read-wait and Adam — so the
+        caller can dispatch async H2D transfers of finished sub-groups while
+        the remaining groups still stream (the engine does exactly this;
+        reference ``pipelined_optimizer_swapper.py:52`` overlaps swap with
+        the step the same way)."""
         lr = self.lr if lr is None else float(lr)
         self.step_count += 1
         n = len(self.groups)
@@ -171,6 +180,9 @@ class NVMeStreamingOptimizer:
                 out[leaf_id] = (fp32_to_bf16(bufs["p"][j])
                                 if out_dtype == "bfloat16"
                                 else bufs["p"][j].copy())
+            if on_group is not None:
+                on_group(list(g.leaf_ids),
+                         [out[i] for i in g.leaf_ids])
             if self._pending_write is not None:  # drain group gi-1's writes
                 prev_g = self._pending_write[0]
                 self._drain_writes()

@@ -169,3 +169,47 @@ def test_preemption_guard_checkpoints_on_signal(tmp_path, devices8):
     np.testing.assert_allclose(np.asarray(engine2.state.params["w"]),
                                np.asarray(engine.state.params["w"]),
                                rtol=1e-6)
+
+
+def test_preemption_guard_peer_host_trigger(tmp_path, devices8, monkeypatch):
+    """Multi-host coordination: a SIGTERM observed only on a PEER host must
+    still checkpoint THIS process at the same boundary (the allgather-OR in
+    step_boundary; reference DSElasticAgent coordinates via torch-elastic
+    rendezvous). Simulated by mocking process_count/process_allgather."""
+    import deepspeed_tpu as dst
+    from deepspeed_tpu.comm import mesh as mesh_lib
+    from deepspeed_tpu.elasticity import elastic_agent
+    from deepspeed_tpu.runtime.engine import ModelSpec
+
+    spec = ModelSpec(
+        loss_fn=lambda p, b: (jnp.sum((p["w"] * b["x"]) ** 2), {}),
+        init_fn=lambda k: {"w": jnp.ones((8,))},
+        pipeline_capable=False)
+    config = {"train_batch_size": 8,
+              "optimizer": {"type": "sgd", "params": {"lr": 0.1}},
+              "steps_per_print": 0}
+    mesh_lib.set_mesh(None)
+    engine, *_ = dst.initialize(model=spec, config=config)
+
+    calls = {"n": 0}
+
+    def fake_allgather(x):
+        calls["n"] += 1
+        # peer host triggered from the 2nd boundary on; we never did
+        peer = calls["n"] >= 2
+        return np.asarray([bool(x), peer])
+
+    monkeypatch.setattr(elastic_agent, "_process_count", lambda: 2)
+    from jax.experimental import multihost_utils
+    monkeypatch.setattr(multihost_utils, "process_allgather", fake_allgather)
+
+    guard = elastic_agent.PreemptionGuard(str(tmp_path / "ck"))
+    try:
+        batch = {"x": np.ones((8,), np.float32)}
+        engine.train_batch(batch)
+        assert not guard.step_boundary(engine)  # boundary 1: nobody triggered
+        engine.train_batch(batch)
+        assert guard.step_boundary(engine)      # boundary 2: peer triggered
+        assert calls["n"] == 2                  # agreed at every boundary
+    finally:
+        guard.uninstall()

@@ -495,3 +495,32 @@ def test_fpdt_offload_kv_parks_kv_in_host_space():
     comp = jax.jit(jax.grad(loss, argnums=(0, 1, 2))).lower(
         sh, sh, sh).compile()
     assert "S(5)" in comp.as_text()
+
+
+def test_nvme_h2d_dispatch_interleaves_with_group_stream(tmp_path, monkeypatch):
+    """Overlap structure of the streamed step (reference
+    pipelined_optimizer_swapper.py:52): the caller's ``on_group`` H2D hook
+    for sub-group g fires BEFORE later groups' Adam updates run, so device
+    transfers are in flight while the tail of the stream still computes —
+    not one bulk transfer after a fully synchronous host step."""
+    from deepspeed_tpu.runtime.swap_tensor import streaming_optimizer as so
+
+    leaves = [np.random.default_rng(i).normal(size=(512,)).astype(np.float32)
+              for i in range(6)]
+    opt = so.NVMeStreamingOptimizer(
+        leaves, str(tmp_path / "s"), lr=1e-3, sub_group_size=1024)
+    assert len(opt.groups) >= 3
+    events = []
+    real_adam = so.adam_step_buffers
+
+    def spy_adam(*a, **k):
+        events.append("adam")
+        return real_adam(*a, **k)
+
+    monkeypatch.setattr(so, "adam_step_buffers", spy_adam)
+    grads = [np.ones_like(l) for l in leaves]
+    opt.step(grads, out_dtype="float32",
+             on_group=lambda ids, outs: events.append(("h2d", tuple(ids))))
+    h2d_first = events.index(next(e for e in events if e != "adam"))
+    assert h2d_first < len(events) - 1 and "adam" in events[h2d_first + 1:], \
+        (events, "no Adam work after the first H2D hook — nothing overlaps")

@@ -115,3 +115,33 @@ def test_zenflow_moments_survive_reselection():
         np.testing.assert_allclose(v_after[i], v_before[i], rtol=1e-6)
     assert zf._cpu_adam.step_count == 10  # bias correction continues
     zf.close()
+
+
+def test_zenflow_device_step_proceeds_during_cold_update(monkeypatch):
+    """The stall-free claim (reference blogs/deepspeed-zenflow: the device
+    never waits for the host): step N's cold host update runs in the worker
+    while step N+1 is issued. Pinned by making the host Adam slow and
+    asserting two consecutive steps return before one host update's time."""
+    import time
+
+    from deepspeed_tpu.runtime.zenflow import ZenFlowOptimizer
+
+    params = {"a": jnp.ones((64, 8)), "b": jnp.ones((64, 8))}
+    zf = ZenFlowOptimizer(params, lr=1e-2, hot_fraction=0.1,
+                          update_interval=100, select_interval=100)
+    real_step = zf._cpu_adam.step
+    delay = 0.25
+
+    def slow_step(*a, **k):
+        time.sleep(delay)
+        return real_step(*a, **k)
+
+    zf._cpu_adam.step = slow_step
+    grads = jax.tree.map(jnp.ones_like, params)
+    t0 = time.perf_counter()
+    zf.step(grads)   # host update N in flight...
+    zf.step(grads)   # ...step N+1 issued without waiting for it
+    dt = time.perf_counter() - t0
+    assert dt < 1.5 * delay, f"two steps took {dt:.3f}s — device stalls " \
+        f"on the {delay}s host update instead of overlapping"
+    zf._drain(block=True)  # both cold updates eventually applied, no error
