@@ -61,12 +61,36 @@ def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
         out = inner(q, k, v, **kwargs)
         return _constraint(out, out_spec)
 
-    head_sharded = P(BATCH_AXES, None, seq_axis, None)   # [B, S, H/sp, D]
-    seq_sharded = P(BATCH_AXES, seq_axis, None, None)    # [B, S/sp, H, D]
-    q = _constraint(q, head_sharded)
-    k = _constraint(k, head_sharded)
-    v = _constraint(v, head_sharded)
-    out = inner(q, k, v, **kwargs)   # full attention on H/sp heads
+    # TP-aware head sharding: with Megatron-SP the residual's seq dim is
+    # sharded over ('seq', 'tensor') and the QKV projections put heads on
+    # 'tensor' — constraining heads over 'seq' alone forces the partitioner
+    # into an involuntary full rematerialization (replicate-then-reshard,
+    # XLA spmd_partitioner.cc:652 / b/433785288) at the a2a boundary. Keep
+    # 'tensor' on the head dim so the only transition left is the clean
+    # seq<->head all-to-all over the 'seq' axis.
+    tp = mm.axis_size("tensor")
+    seqlen = q.shape[1]
+
+    def to_heads(t):
+        n = t.shape[-2]
+        if tp > 1 and n % (tp * sp) == 0:
+            return _constraint(t, P(BATCH_AXES, None, ("tensor", seq_axis),
+                                    None))
+        if tp > 1:
+            # GQA-narrow KV: too few heads to absorb 'tensor'. Reshard in
+            # two CLEAN steps — all-gather the seq dim off 'tensor', then
+            # the seq<->head a2a over 'seq' — instead of one mixed
+            # transition the partitioner can only do by full replication
+            t = _constraint(t, P(BATCH_AXES, seq_axis, None, None))
+        return _constraint(t, P(BATCH_AXES, None, seq_axis, None))
+
+    seq_entry = ((seq_axis, "tensor")
+                 if tp > 1 and seqlen % (sp * tp) == 0 else seq_axis)
+    seq_sharded = P(BATCH_AXES, seq_entry, None, None)   # [B, S/sp, H, D]
+    q = to_heads(q)
+    k = to_heads(k)
+    v = to_heads(v)
+    out = inner(q, k, v, **kwargs)   # full attention on H/(sp·tp) heads
     return _constraint(out, seq_sharded)
 
 
