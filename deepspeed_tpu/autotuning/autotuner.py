@@ -16,6 +16,7 @@ from __future__ import annotations
 import dataclasses
 import itertools
 import json
+import os
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -83,7 +84,10 @@ class Autotuner:
                  tuner_type: str = "model_based",
                  micro_batches: Sequence[int] = DEFAULT_MICRO_BATCHES,
                  zero_stages: Sequence[int] = DEFAULT_STAGES,
-                 remat_options: Sequence[bool] = (False,)):
+                 remat_options: Sequence[bool] = (False,),
+                 model_desc: Optional[Dict[str, Any]] = None,
+                 trial_timeout_s: float = 900.0,
+                 seq_len: Optional[int] = None):
         self.model_spec = model_spec
         self.base_config = dict(base_config)
         self.trial_steps = trial_steps
@@ -94,7 +98,18 @@ class Autotuner:
         self.micro_batches = micro_batches
         self.zero_stages = zero_stages
         self.remat_options = remat_options
+        # model_desc = {"family": ..., "config": {...}}: when given, each
+        # trial runs in a SUBPROCESS (trial_worker) — fresh XLA client and
+        # jit cache per trial, an OOM kills only that trial, and timings
+        # are not skewed by cross-trial cache warmth (reference
+        # autotuning/scheduler.py launches real jobs for the same reasons)
+        self.model_desc = model_desc
+        self.trial_timeout_s = trial_timeout_s
+        self.seq_len = seq_len
         self.results: List[TrialResult] = []
+        if model_spec is None and model_desc is None:
+            raise ValueError("need model_spec (in-process trials) or "
+                             "model_desc (subprocess trials)")
 
     def _detect_hbm(self) -> int:
         d = jax.devices()[0]
@@ -138,6 +153,53 @@ class Autotuner:
         cfg["steps_per_print"] = 0
         return cfg
 
+    def run_trial_subprocess(self, point: Dict[str, Any]) -> TrialResult:
+        """One trial in an isolated worker process (fresh jit cache; an OOM
+        or wedge is contained by the process boundary + timeout)."""
+        import subprocess
+        import sys
+        import tempfile
+
+        job = {"model": self.model_desc,
+               "trial_config": self._trial_config(point),
+               "trial_steps": self.trial_steps}
+        if self.seq_len:
+            job["seq_len"] = self.seq_len
+        with tempfile.NamedTemporaryFile("w", suffix=".json",
+                                         delete=False) as f:
+            json.dump(job, f)
+            job_path = f.name
+        try:
+            r = subprocess.run(
+                [sys.executable, "-m",
+                 "deepspeed_tpu.autotuning.trial_worker", job_path],
+                capture_output=True, text=True,
+                timeout=self.trial_timeout_s)
+            tail = r.stdout.strip().splitlines()[-1] if r.stdout.strip() \
+                else "{}"
+            d = json.loads(tail)
+            res = TrialResult(point, float(d.get("samples_per_sec", 0.0)),
+                              float(d.get("step_time_s", float("inf"))),
+                              error=d.get("error") or (
+                                  None if r.returncode == 0
+                                  else f"rc={r.returncode} "
+                                       f"{r.stderr[-300:]}"))
+        except subprocess.TimeoutExpired:
+            res = TrialResult(point, 0.0, float("inf"),
+                              error=f"timeout after {self.trial_timeout_s}s")
+        except Exception as e:
+            res = TrialResult(point, 0.0, float("inf"), error=str(e)[-300:])
+        finally:
+            try:
+                os.unlink(job_path)
+            except OSError:
+                pass
+        self.results.append(res)
+        log_dist(f"autotuning trial {point} [subprocess]: "
+                 f"{res.samples_per_sec:.2f} samples/s"
+                 + (f" ({res.error})" if res.error else ""))
+        return res
+
     def run_trial(self, point: Dict[str, Any],
                   data_fn: Callable[[int], Any]) -> TrialResult:
         import deepspeed_tpu as dst
@@ -163,13 +225,18 @@ class Autotuner:
                  f"{res.samples_per_sec:.2f} samples/s")
         return res
 
-    def tune(self, data_fn: Callable[[int], Any],
+    def tune(self, data_fn: Optional[Callable[[int], Any]] = None,
              max_trials: Optional[int] = None) -> TrialResult:
         space = self.build_space()
         if not space:
             raise ValueError("autotuning space is empty after memory pruning")
-        tuner = TUNERS[self.tuner_type](
-            space, lambda p: self.run_trial(p, data_fn).samples_per_sec)
+        if self.model_desc is not None:
+            trial = lambda p: self.run_trial_subprocess(p).samples_per_sec  # noqa: E731
+        else:
+            if data_fn is None:
+                raise ValueError("in-process tuning needs a data_fn")
+            trial = lambda p: self.run_trial(p, data_fn).samples_per_sec  # noqa: E731
+        tuner = TUNERS[self.tuner_type](space, trial)
         best_cfg, best_metric = tuner.tune(max_trials)
         best = next(r for r in self.results
                     if r.config == best_cfg and r.samples_per_sec == best_metric)

@@ -281,6 +281,14 @@ def parse_args(argv=None):
     p.add_argument("--min_elastic_nodes", type=int, default=-1)
     p.add_argument("--max_elastic_nodes", type=int, default=-1)
     p.add_argument("--force_multi", action="store_true")
+    p.add_argument("--autotuning", choices=["tune"], default=None,
+                   help="run the autotuner instead of launching: "
+                        "user_script is an autotuning job JSON; trials run "
+                        "in isolated worker processes and the best config "
+                        "is written to the job's 'output' path (reference "
+                        "deepspeed --autotuning; the reference's 'run' mode "
+                        "is the same sweep + relaunch — here relaunch with "
+                        "the emitted best_config yourself)")
     p.add_argument("user_script")
     p.add_argument("user_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
@@ -320,6 +328,11 @@ def len_local_devices() -> int:
 
 def main(argv=None) -> int:
     args = parse_args(argv)
+    if args.autotuning:
+        # trials self-launch as isolated worker processes; no host fan-out
+        from ..autotuning.cli import autotune_main
+
+        return autotune_main(args.user_script, args.user_args)
     runner, cmds = build_commands(args)
     logger.info(f"launching {len(cmds)} command(s) via {runner.name}")
     procs = []

@@ -1,0 +1,135 @@
+#!/usr/bin/env python
+"""MoE dispatch: dense one-hot einsum vs compacted gather/scatter.
+
+VERDICT r3 item 6: SURVEY §2.4 lists the reference's dedicated MoE
+dispatch/top-k kernels (``inference/v2/kernels/ragged_ops/top_k_gating``,
+``moe_scatter``, ``moe_gather``) as native-equivalent targets. Our MOELayer
+dispatches with dense einsums ([T,E,C]·[T,H] → [E,C,H]) — MXU-friendly but
+O(T·E·C·H) flops. The compacted alternative (what a Pallas scatter kernel
+would compute) builds the [E,C] token index table from the gating output and
+uses gather / scatter-add — O(k·T·H) memory movement, no E·C blowup.
+
+This script times BOTH paths end-to-end (gating → dispatch → 2-matmul
+expert FFN → combine) at serving/training-realistic shapes and prints one
+JSON line, so the einsum-vs-kernel question is answered with data
+(PERF.md records the verdict: implement the Pallas kernel only if compact
+wins and XLA's lowering of it leaves time on the table).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("DSTPU_LOG_STREAM", "stderr")
+
+RESULT = {"metric": "moe_dispatch_best_impl", "value": 0.0,
+          "unit": "einsum_over_compact_speedup", "vs_baseline": None,
+          "detail": {}}
+
+
+def main():
+    import jax
+
+    if os.environ.get("DSTPU_BENCH_FORCE_CPU"):
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.moe.sharded_moe import compute_capacity, top_k_gating
+
+    backend = jax.default_backend()
+    RESULT["detail"]["backend"] = backend
+    on_tpu = backend == "tpu"
+    if on_tpu:
+        shapes = [(8192, 1024, 8, 2), (8192, 1024, 64, 2),
+                  (16384, 2048, 16, 2)]
+        steps = 10
+    else:
+        shapes = [(512, 64, 8, 2)]
+        steps = 3
+
+    def moe_einsum(x, logits, w1, w2, k, cap_f):
+        g = top_k_gating(logits, k=k, capacity_factor=cap_f)
+        expert_in = jnp.einsum("tec,th->ech",
+                               g.dispatch_mask.astype(x.dtype), x)
+        h = jnp.einsum("ech,ehf->ecf", expert_in, w1)
+        y = jnp.einsum("ecf,efh->ech", jax.nn.gelu(h), w2)
+        out = jnp.einsum("tec,ech->th",
+                         g.combine_weights.astype(x.dtype), y)
+        return out
+
+    def moe_compact(x, logits, w1, w2, k, cap_f):
+        """Same math via index tables: token_for[e,c] + scatter-add."""
+        g = top_k_gating(logits, k=k, capacity_factor=cap_f)
+        T, E, C = g.combine_weights.shape
+        # token index for each (e,c) slot (slots empty -> T, reads a zero row)
+        tok_ids = jnp.arange(T, dtype=jnp.int32)
+        occupied = g.dispatch_mask.any(axis=0)                      # [E, C]
+        token_for = jnp.einsum("tec,t->ec",
+                               g.dispatch_mask.astype(jnp.int32),
+                               tok_ids)                             # [E, C]
+        token_for = jnp.where(occupied, token_for, T)
+        xz = jnp.concatenate([x, jnp.zeros((1,) + x.shape[1:], x.dtype)])
+        expert_in = xz[token_for]                                   # [E, C, H]
+        h = jnp.einsum("ech,ehf->ecf", expert_in, w1)
+        y = jnp.einsum("ecf,efh->ech", jax.nn.gelu(h), w2)
+        w_for = jnp.einsum("tec->ec", g.combine_weights)            # gate per slot
+        out = jnp.zeros_like(x).at[token_for.reshape(-1)].add(
+            (y * w_for[..., None].astype(x.dtype)).reshape(-1, x.shape[-1]),
+            mode="drop")
+        return out
+
+    rows = {}
+    parity_checked = False
+    for T, H, E, k in shapes:
+        key = jax.random.PRNGKey(0)
+        kx, kl, k1, k2 = jax.random.split(key, 4)
+        F = H * 2
+        x = jax.random.normal(kx, (T, H), jnp.bfloat16)
+        logits = jax.random.normal(kl, (T, E), jnp.float32)
+        w1 = jax.random.normal(k1, (E, H, F), jnp.bfloat16) * 0.02
+        w2 = jax.random.normal(k2, (E, F, H), jnp.bfloat16) * 0.02
+        cap = compute_capacity(T, E, k, 1.25)
+        label = f"T{T}_H{H}_E{E}_k{k}_cap{cap}"
+        if not parity_checked:
+            # the timing verdict is only meaningful if both paths compute
+            # the same function — pin it before trusting any ratio
+            a = moe_einsum(x, logits, w1, w2, k, 1.25).astype(jnp.float32)
+            b = moe_compact(x, logits, w1, w2, k, 1.25).astype(jnp.float32)
+            diff = float(jnp.max(jnp.abs(a - b)))
+            assert diff < 1e-2, f"einsum/compact diverge: max diff {diff}"
+            RESULT["detail"]["parity_max_diff"] = diff
+            parity_checked = True
+        row = {}
+        for name, fn in (("einsum", moe_einsum), ("compact", moe_compact)):
+            try:
+                jf = jax.jit(fn, static_argnums=(4, 5))
+                out = jf(x, logits, w1, w2, k, 1.25)
+                float(jnp.sum(out.astype(jnp.float32)))  # compile+sync
+                t0 = time.perf_counter()
+                for _ in range(steps):
+                    out = jf(x, logits, w1, w2, k, 1.25)
+                float(jnp.sum(out.astype(jnp.float32)))
+                row[name] = round((time.perf_counter() - t0) / steps * 1e3, 3)
+            except Exception as e:
+                row[name] = f"error: {str(e)[-150:]}"
+        if all(isinstance(v, float) for v in row.values()):
+            row["einsum_over_compact"] = round(row["einsum"] / row["compact"],
+                                               3)
+        rows[label] = row
+        sys.stderr.write(f"[moe] {label}: {row}\n")
+    RESULT["detail"]["rows_ms"] = rows
+    ratios = [r.get("einsum_over_compact") for r in rows.values()
+              if isinstance(r, dict) and "einsum_over_compact" in r]
+    if ratios:
+        RESULT["value"] = round(sum(ratios) / len(ratios), 3)
+    print(json.dumps(RESULT))
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except Exception as e:
+        RESULT["detail"]["error"] = str(e)[-2000:]
+        print(json.dumps(RESULT))

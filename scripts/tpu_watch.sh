@@ -30,6 +30,10 @@ while true; do
         && grep -q '"backend": "tpu"' "bench_runs/SERVING_${ts}.json" \
         && cp "bench_runs/SERVING_${ts}.json" SERVING_TPU_LIVE.json \
         && echo "[watch] $ts serving captured" >> "$LOG"
+      timeout 1200 python scripts/moe_dispatch_bench.py > "bench_runs/MOE_${ts}.json" 2>> "$LOG" \
+        && grep -q '"backend": "tpu"' "bench_runs/MOE_${ts}.json" \
+        && cp "bench_runs/MOE_${ts}.json" MOE_TPU_LIVE.json \
+        && echo "[watch] $ts moe dispatch captured" >> "$LOG"
       # after a full capture, slow the poll (evidence is in; re-runs refresh it)
       POLL_S=1800
     else
