@@ -34,6 +34,10 @@ while true; do
         && grep -q '"backend": "tpu"' "bench_runs/MOE_${ts}.json" \
         && cp "bench_runs/MOE_${ts}.json" MOE_TPU_LIVE.json \
         && echo "[watch] $ts moe dispatch captured" >> "$LOG"
+      timeout 1200 python scripts/quant_linear_bench.py > "bench_runs/QUANT_${ts}.json" 2>> "$LOG" \
+        && grep -q '"backend": "tpu"' "bench_runs/QUANT_${ts}.json" \
+        && cp "bench_runs/QUANT_${ts}.json" QUANT_TPU_LIVE.json \
+        && echo "[watch] $ts quant linear captured" >> "$LOG"
       # after a full capture, slow the poll (evidence is in; re-runs refresh it)
       POLL_S=1800
     else
