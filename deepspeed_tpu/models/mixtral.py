@@ -52,6 +52,9 @@ class MixtralConfig:
     attention_bias: bool = False
     norm_topk_prob: bool = True
     shared_expert_intermediate_size: int = 0
+    # MoE dispatch implementation: 'einsum' (dense one-hot, MXU) or
+    # 'compact' (index-table gather/scatter) — see moe/layer.py
+    moe_dispatch: str = "einsum"
 
     @property
     def head_size(self) -> int:
@@ -144,7 +147,8 @@ def apply(cfg: MixtralConfig, params: Params, tokens: jnp.ndarray, *,
     cos, sin = rope_frequencies(cfg.head_size, cfg.max_seq_len, cfg.rope_theta)
     moe_layer = MoELayer(cfg.num_experts, cfg.top_k, cfg.capacity_factor,
                          cfg.min_capacity, cfg.drop_tokens,
-                         norm_topk=cfg.norm_topk_prob)
+                         norm_topk=cfg.norm_topk_prob,
+                         dispatch=cfg.moe_dispatch)
 
     layers = jax.tree.map(lambda p: p.astype(compute_dtype)
                           if jnp.issubdtype(p.dtype, jnp.floating) else p,
@@ -208,7 +212,8 @@ def apply_cached(cfg: MixtralConfig, params: Params, tokens: jnp.ndarray,
     # corrupt the completion (reference v2 mixtral routes without capacity)
     moe_layer = MoELayer(cfg.num_experts, cfg.top_k, cfg.capacity_factor,
                          cfg.min_capacity, drop_tokens=False,
-                         norm_topk=cfg.norm_topk_prob)
+                         norm_topk=cfg.norm_topk_prob,
+                         dispatch=cfg.moe_dispatch)
     layers = jax.tree.map(lambda p: p.astype(compute_dtype)
                           if jnp.issubdtype(p.dtype, jnp.floating) else p,
                           params["layers"])
@@ -293,7 +298,8 @@ def apply_paged(cfg: MixtralConfig, params: Params, tokens: jnp.ndarray,
     positions = context_lens[:, None] + jnp.arange(t)[None, :]
     moe_layer = MoELayer(cfg.num_experts, cfg.top_k, cfg.capacity_factor,
                          cfg.min_capacity, drop_tokens=False,
-                         norm_topk=cfg.norm_topk_prob)
+                         norm_topk=cfg.norm_topk_prob,
+                         dispatch=cfg.moe_dispatch)
     layers = jax.tree.map(lambda p: p.astype(compute_dtype)
                           if jnp.issubdtype(p.dtype, jnp.floating) else p,
                           params["layers"])

@@ -67,13 +67,23 @@ class MoELayer:
 
     def __init__(self, n_experts: int, top_k: int = 2,
                  capacity_factor: float = 1.25, min_capacity: int = 4,
-                 drop_tokens: bool = True, norm_topk: bool = True):
+                 drop_tokens: bool = True, norm_topk: bool = True,
+                 dispatch: str = "einsum"):
         self.n_experts = n_experts
         self.top_k = top_k
         self.capacity_factor = capacity_factor
         self.min_capacity = min_capacity
         self.drop_tokens = drop_tokens
         self.norm_topk = norm_topk
+        if dispatch not in ("einsum", "compact"):
+            raise ValueError(f"dispatch must be 'einsum' or 'compact', "
+                             f"got '{dispatch}'")
+        # 'einsum': dense one-hot [T,E,C] contractions (MXU-friendly,
+        # O(T·E·C·H)). 'compact': index-table gather / scatter-add
+        # (O(k·T·H) movement, the shape a Pallas moe_scatter/moe_gather
+        # kernel computes — reference inference/v2/kernels/ragged_ops).
+        # scripts/moe_dispatch_bench.py measures which wins per backend.
+        self.dispatch = dispatch
 
     def __call__(self, params: Params, x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
         """x: [batch, seq, hidden] → ([batch, seq, hidden], aux_loss)."""
@@ -86,8 +96,20 @@ class MoELayer:
             norm_topk=self.norm_topk)
 
         # dispatch: [T, E, C] × [T, H] → [E, C, H], then expert-shard (a2a)
-        expert_in = jnp.einsum("tec,th->ech",
-                               gating.dispatch_mask.astype(tokens.dtype), tokens)
+        if self.dispatch == "compact":
+            T = tokens.shape[0]
+            occupied = gating.dispatch_mask.any(axis=0)           # [E, C]
+            token_for = jnp.einsum(
+                "tec,t->ec", gating.dispatch_mask.astype(jnp.int32),
+                jnp.arange(T, dtype=jnp.int32))                   # [E, C]
+            token_for = jnp.where(occupied, token_for, T)
+            toks_z = jnp.concatenate(
+                [tokens, jnp.zeros((1, h), tokens.dtype)])
+            expert_in = toks_z[token_for]                         # gather
+        else:
+            expert_in = jnp.einsum(
+                "tec,th->ech", gating.dispatch_mask.astype(tokens.dtype),
+                tokens)
         expert_in = _expert_constraint(expert_in)
 
         # expert FFN bank, vmapped over E (each expert's compute lands on its
@@ -104,8 +126,15 @@ class MoELayer:
         expert_out = _expert_constraint(expert_out)
 
         # combine: [T, E, C] × [E, C, H] → [T, H]  (a2a back)
-        out = jnp.einsum("tec,ech->th",
-                         gating.combine_weights.astype(tokens.dtype), expert_out)
+        if self.dispatch == "compact":
+            w_for = jnp.einsum("tec->ec", gating.combine_weights)  # gate/slot
+            out = jnp.zeros_like(tokens).at[token_for.reshape(-1)].add(
+                (expert_out * w_for[..., None].astype(tokens.dtype))
+                .reshape(-1, h), mode="drop")
+        else:
+            out = jnp.einsum(
+                "tec,ech->th", gating.combine_weights.astype(tokens.dtype),
+                expert_out)
         # Qwen2-MoE shared expert: a dense SwiGLU added to every token,
         # scaled by a learned sigmoid gate (params present only when used)
         if "shared_w_gate" in params:

@@ -36,7 +36,9 @@ def main():
         jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
 
-    from deepspeed_tpu.moe.sharded_moe import compute_capacity, top_k_gating
+    from deepspeed_tpu.comm import mesh as mesh_lib
+    from deepspeed_tpu.moe.layer import MoELayer, init_moe_ffn
+    from deepspeed_tpu.moe.sharded_moe import compute_capacity
 
     backend = jax.default_backend()
     RESULT["detail"]["backend"] = backend
@@ -48,68 +50,46 @@ def main():
     else:
         shapes = [(512, 64, 8, 2)]
         steps = 3
-
-    def moe_einsum(x, logits, w1, w2, k, cap_f):
-        g = top_k_gating(logits, k=k, capacity_factor=cap_f)
-        expert_in = jnp.einsum("tec,th->ech",
-                               g.dispatch_mask.astype(x.dtype), x)
-        h = jnp.einsum("ech,ehf->ecf", expert_in, w1)
-        y = jnp.einsum("ecf,efh->ech", jax.nn.gelu(h), w2)
-        out = jnp.einsum("tec,ech->th",
-                         g.combine_weights.astype(x.dtype), y)
-        return out
-
-    def moe_compact(x, logits, w1, w2, k, cap_f):
-        """Same math via index tables: token_for[e,c] + scatter-add."""
-        g = top_k_gating(logits, k=k, capacity_factor=cap_f)
-        T, E, C = g.combine_weights.shape
-        # token index for each (e,c) slot (slots empty -> T, reads a zero row)
-        tok_ids = jnp.arange(T, dtype=jnp.int32)
-        occupied = g.dispatch_mask.any(axis=0)                      # [E, C]
-        token_for = jnp.einsum("tec,t->ec",
-                               g.dispatch_mask.astype(jnp.int32),
-                               tok_ids)                             # [E, C]
-        token_for = jnp.where(occupied, token_for, T)
-        xz = jnp.concatenate([x, jnp.zeros((1,) + x.shape[1:], x.dtype)])
-        expert_in = xz[token_for]                                   # [E, C, H]
-        h = jnp.einsum("ech,ehf->ecf", expert_in, w1)
-        y = jnp.einsum("ecf,efh->ech", jax.nn.gelu(h), w2)
-        w_for = jnp.einsum("tec->ec", g.combine_weights)            # gate per slot
-        out = jnp.zeros_like(x).at[token_for.reshape(-1)].add(
-            (y * w_for[..., None].astype(x.dtype)).reshape(-1, x.shape[-1]),
-            mode="drop")
-        return out
+    mesh_lib.set_mesh(None)  # single-device: measure dispatch, not a2a
 
     rows = {}
     parity_checked = False
     for T, H, E, k in shapes:
-        key = jax.random.PRNGKey(0)
-        kx, kl, k1, k2 = jax.random.split(key, 4)
-        F = H * 2
-        x = jax.random.normal(kx, (T, H), jnp.bfloat16)
-        logits = jax.random.normal(kl, (T, E), jnp.float32)
-        w1 = jax.random.normal(k1, (E, H, F), jnp.bfloat16) * 0.02
-        w2 = jax.random.normal(k2, (E, F, H), jnp.bfloat16) * 0.02
+        params = init_moe_ffn(jax.random.PRNGKey(0), n_experts=E, hidden=H,
+                              intermediate=2 * H, dtype=jnp.bfloat16)
+        x = jax.random.normal(jax.random.PRNGKey(1), (1, T, H), jnp.bfloat16)
         cap = compute_capacity(T, E, k, 1.25)
         label = f"T{T}_H{H}_E{E}_k{k}_cap{cap}"
+
+        # the SHIPPING implementations — both paths are MoELayer(dispatch=..)
+        # so this bench can never drift from what the engine runs
+        def run(impl, params, x):
+            layer = MoELayer(n_experts=E, top_k=k, capacity_factor=1.25,
+                             dispatch=impl)
+            out, _ = layer(params, x)
+            return out
+
         if not parity_checked:
             # the timing verdict is only meaningful if both paths compute
-            # the same function — pin it before trusting any ratio
-            a = moe_einsum(x, logits, w1, w2, k, 1.25).astype(jnp.float32)
-            b = moe_compact(x, logits, w1, w2, k, 1.25).astype(jnp.float32)
+            # the same function — pin it in f32 (bf16 differs only by
+            # accumulation-order noise, which would mask a real bug)
+            p32 = jax.tree.map(lambda t: t.astype(jnp.float32), params)
+            x32 = x.astype(jnp.float32)
+            a = run("einsum", p32, x32)
+            b = run("compact", p32, x32)
             diff = float(jnp.max(jnp.abs(a - b)))
-            assert diff < 1e-2, f"einsum/compact diverge: max diff {diff}"
+            assert diff < 1e-3, f"einsum/compact diverge: max diff {diff}"
             RESULT["detail"]["parity_max_diff"] = diff
             parity_checked = True
         row = {}
-        for name, fn in (("einsum", moe_einsum), ("compact", moe_compact)):
+        for name in ("einsum", "compact"):
             try:
-                jf = jax.jit(fn, static_argnums=(4, 5))
-                out = jf(x, logits, w1, w2, k, 1.25)
+                jf = jax.jit(run, static_argnums=0)
+                out = jf(name, params, x)
                 float(jnp.sum(out.astype(jnp.float32)))  # compile+sync
                 t0 = time.perf_counter()
                 for _ in range(steps):
-                    out = jf(x, logits, w1, w2, k, 1.25)
+                    out = jf(name, params, x)
                 float(jnp.sum(out.astype(jnp.float32)))
                 row[name] = round((time.perf_counter() - t0) / steps * 1e3, 3)
             except Exception as e:

@@ -129,3 +129,30 @@ def test_ep_degree_loss_equivalence(devices8):
         trajs[ep] = [float(engine.train_batch({"tokens": tokens}).loss)
                      for _ in range(6)]
     np.testing.assert_allclose(trajs[4], trajs[1], rtol=2e-4, atol=2e-4)
+
+
+def test_moe_dispatch_compact_matches_einsum(devices8):
+    """The compact (index-table gather/scatter) dispatch computes the exact
+    same function as the dense one-hot einsum dispatch — values AND router
+    gradients — so the backend-dependent choice (moe_dispatch_bench.py) is
+    purely a performance decision."""
+    from deepspeed_tpu.moe.layer import MoELayer, init_moe_ffn
+
+    params = init_moe_ffn(jax.random.PRNGKey(0), n_experts=4, hidden=16,
+                          intermediate=32)
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 24, 16))
+
+    def loss(p, impl):
+        layer = MoELayer(n_experts=4, top_k=2, capacity_factor=2.0,
+                         dispatch=impl)
+        out, aux = layer(p, x)
+        return jnp.sum(out ** 2) + aux
+
+    le, ge = jax.value_and_grad(loss)(params, "einsum")
+    lc, gc = jax.value_and_grad(loss)(params, "compact")
+    np.testing.assert_allclose(float(le), float(lc), rtol=1e-5)
+    for k in ge:
+        np.testing.assert_allclose(np.asarray(ge[k]), np.asarray(gc[k]),
+                                   rtol=1e-4, atol=1e-5, err_msg=k)
+    with pytest.raises(ValueError):
+        MoELayer(n_experts=4, dispatch="nope")
