@@ -1,0 +1,42 @@
+#!/usr/bin/env python
+"""Regenerate tests/.test_durations.json from a pytest --durations=0 log.
+
+Usage: python -m pytest tests/ -q --durations=0 > /tmp/suite.log
+       python scripts/update_test_durations.py /tmp/suite.log
+
+Merges into the existing file (max of old/new per test) so a partial run
+never loses coverage for tests it didn't execute.
+"""
+
+import json
+import os
+import re
+import sys
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PATH = os.path.join(HERE, "tests", ".test_durations.json")
+
+
+def main(log_path: str) -> int:
+    pat = re.compile(r"^\s*([0-9.]+)s\s+(call|setup)\s+(\S+)")
+    try:
+        with open(PATH) as f:
+            durations = json.load(f)
+    except (OSError, ValueError):  # missing or corrupt — start fresh
+        durations = {}
+    n = 0
+    with open(log_path) as f:
+        for line in f:
+            m = pat.match(line)
+            if m:
+                dur, _, test = m.groups()
+                durations[test] = max(durations.get(test, 0.0), float(dur))
+                n += 1
+    with open(PATH, "w") as f:
+        json.dump(durations, f, indent=0, sort_keys=True)
+    print(f"merged {n} duration lines -> {PATH} ({len(durations)} entries)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1]))

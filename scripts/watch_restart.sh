@@ -1,0 +1,17 @@
+#!/bin/bash
+# Restart the tunnel watcher safely. Run THIS script (its own cmdline does
+# not contain the watcher's name, so the pkill cannot kill the caller —
+# a pkill -f typed directly into a shell whose command line includes the
+# watcher path kills that shell too, observed as exit 144).
+cd /root/repo
+pkill -f "scripts/tpu_watch.sh" 2>/dev/null
+sleep 1
+setsid nohup bash scripts/tpu_watch.sh >/dev/null 2>&1 < /dev/null &
+sleep 2
+if pgrep -f "scripts/tpu_watch.sh" > /dev/null; then
+  echo "watcher running: $(pgrep -f 'scripts/tpu_watch.sh' | tr '\n' ' ')"
+  tail -1 bench_runs/watch.log
+else
+  echo "watcher FAILED to start"
+  exit 1
+fi

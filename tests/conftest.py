@@ -15,8 +15,34 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = _flags + " --xla_force_host_platform_device_count=8"
 os.environ["JAX_PLATFORMS"] = "cpu"
 
+import json  # noqa: E402
+
 import jax  # noqa: E402
 import pytest  # noqa: E402
+
+# ---- fast tier -----------------------------------------------------------
+# Tests whose recorded duration exceeds SLOW_S get the 'slow' marker from
+# the checked-in durations file (regenerate: pytest --durations=0 > log,
+# then scripts/update_test_durations.py log). Fast lane: pytest -m "not slow"
+SLOW_S = 10.0
+_dur_path = os.path.join(os.path.dirname(__file__), ".test_durations.json")
+try:
+    with open(_dur_path) as _f:
+        _DURATIONS = json.load(_f)
+except (OSError, ValueError):  # missing OR corrupt/truncated file —
+    _DURATIONS = {}            # the suite must still collect
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: recorded duration > %gs (see .test_durations.json);"
+        " deselect with -m 'not slow'" % SLOW_S)
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        if _DURATIONS.get(item.nodeid, 0.0) > SLOW_S:
+            item.add_marker(pytest.mark.slow)
 
 # The axon sitecustomize sets jax_platforms programmatically, which overrides
 # the env var — force CPU back on for the virtual 8-device test mesh.

@@ -269,24 +269,28 @@ def test_superoffload_device_step_proceeds_during_host_update():
     """SuperOffload's speculative enqueue must not stall the caller: step N's
     host Adam runs in the worker while step N+1 is issued (rollback handles
     the rare clip; reference superoffload blog's async optimizer claim)."""
-    import time
+    import threading
 
     params = {"w": jnp.ones((128, 4))}
     so = SuperOffloadOptimizer(params, lr=1e-3, clip_norm=1e9)
     real_step = so.cpu_adam.step
-    delay = 0.25
+    started, release = threading.Event(), threading.Event()
 
-    def slow_step(*a, **k):
-        time.sleep(delay)
+    def gated_step(*a, **k):
+        started.set()
+        release.wait(10)  # hold the update open; deterministic, no wall-clock
         return real_step(*a, **k)
 
-    so.cpu_adam.step = slow_step
+    so.cpu_adam.step = gated_step
     grads = jax.tree.map(jnp.ones_like, params)
-    t0 = time.perf_counter()
-    so.step(grads)
-    so.step(grads)
-    dt = time.perf_counter() - t0
-    assert dt < 1.5 * delay, f"two steps took {dt:.3f}s — caller stalls " \
-        f"on the {delay}s host update instead of overlapping"
+    try:
+        so.step(grads)  # must return while the host update is held open
+        assert started.wait(5), "worker never entered the host update"
+        assert so._results.empty(), \
+            "host update finished before step returned"
+        so.step(grads)  # step N+1 enqueues while update N is in flight
+        assert so._results.empty()
+    finally:
+        release.set()
     so._drain(block=True)
     so.close()
