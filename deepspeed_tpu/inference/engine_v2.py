@@ -92,6 +92,36 @@ class InferenceEngineV2(InferenceEngine):
             self._paged_fns[key] = jax.jit(decode, donate_argnums=(1,))
         return self._paged_fns[key]
 
+    def _decode_many_fn(self, k: int, sp: SamplingParams):
+        """k fused decode ticks in ONE compiled program (lax.scan) with a
+        single host sync at the end. The reference's persistent-kernel decode
+        loop achieves the same thing on GPU; over a network-attached TPU the
+        per-step host round-trip dominates single-step decode, so this is
+        the serving fast path (block capacity is reserved for all k tokens
+        before launch — see ``StateManager.extend(n=k)``)."""
+        key = ("decode_many", k, sp)
+        if key not in self._paged_fns:
+            fam, ap = self.family, self._apply_paged
+
+            def decode_many(params, cache, tokens, lens, tables, active, rng):
+                dq = self._dq(params)
+
+                def tick(carry, key_t):
+                    tokens, lens, cache = carry
+                    logits, cache = ap(fam.cfg, dq, tokens[:, None], cache,
+                                       tables, lens, valid=active[:, None])
+                    nxt = sample(key_t, logits[:, 0], sp).astype(jnp.int32)
+                    lens = lens + active.astype(jnp.int32)
+                    return (nxt, lens, cache), nxt
+
+                keys = jax.random.split(rng, k)
+                (tokens, lens, cache), toks = jax.lax.scan(
+                    tick, (tokens, lens, cache), keys)
+                return toks, lens, cache  # toks: [k, B]
+
+            self._paged_fns[key] = jax.jit(decode_many, donate_argnums=(1,))
+        return self._paged_fns[key]
+
     # ------------------------------------------------------------------ #
     def put(self, uid: int, prompt_tokens, sp: SamplingParams = SamplingParams(greedy=True),
             seed: int = 0) -> int:
@@ -147,6 +177,44 @@ class InferenceEngineV2(InferenceEngine):
             out[d.uid] = tok
         return out
 
+    def step_many(self, k: int, sp: SamplingParams = SamplingParams(greedy=True),
+                  seed: int = 0) -> Dict[int, List[int]]:
+        """k decode steps over every live sequence with ONE host sync →
+        {uid: [k next tokens]}. Tokens sampled after a sequence's EOS are
+        still produced (the caller trims) — the standard multi-step decode
+        trade. k is clamped so no live sequence can run past max_seq_len."""
+        live = [d for d in self.state.seqs.values() if not d.finished]
+        if not live or k <= 0:
+            return {}
+        max_seen = max(d.seen_tokens for d in live)
+        # a tick at seen writes KV position seen, so seen may reach exactly
+        # max_seq_len after the last tick — same boundary as the per-step
+        # path (which decodes while seen == max_seq_len - 1)
+        k = min(k, self.family.cfg.max_seq_len - max_seen)
+        if k <= 0:
+            return {}
+        for d in live:
+            self.state.extend(d, n=k)  # reserve ALL k tokens up front
+            self._slot_tables[d.slot] = self.state.block_table(d)
+        fn = self._decode_many_fn(k, sp)
+        toks, lens, self.cache = fn(self.params, self.cache,
+                                    jnp.asarray(self._slot_tokens),
+                                    jnp.asarray(self._slot_lens),
+                                    jnp.asarray(self._slot_tables),
+                                    jnp.asarray(self._slot_active),
+                                    jax.random.PRNGKey(seed))
+        toks = np.asarray(toks)          # [k, B] — the ONLY host sync
+        out: Dict[int, List[int]] = {}
+        for d in live:
+            seq = [int(t) for t in toks[:, d.slot]]
+            d.seen_tokens += k
+            d.last_token = seq[-1]
+            d.generated.extend(seq)
+            self._slot_tokens[d.slot] = seq[-1]
+            self._slot_lens[d.slot] = d.seen_tokens
+            out[d.uid] = seq
+        return out
+
     def finish(self, uid: int) -> List[int]:
         """Retire a sequence, free its blocks, return generated tokens."""
         desc = self.state.seqs[uid]
@@ -160,9 +228,15 @@ class InferenceEngineV2(InferenceEngine):
     def generate(self, prompts, max_new_tokens: int = 64,
                  eos_token_id: Optional[int] = None, seed: int = 0,
                  temperature: float = 0.0, top_k: int = 0, top_p: float = 1.0,
-                 prompt_lengths=None) -> List[List[int]]:
+                 prompt_lengths=None, steps_per_sync: int = 1) -> List[List[int]]:
         """Continuous-batching driver: admit prompts as capacity allows,
-        decode all live sequences each step. Returns generated ids per prompt."""
+        decode all live sequences each step. Returns generated ids per prompt.
+
+        ``steps_per_sync > 1`` runs that many decode ticks per compiled call
+        (one host round-trip per quantum instead of per token — the serving
+        fast path); admission and EOS retirement happen at quantum
+        boundaries, and completions are trimmed to the first EOS exactly as
+        in the per-step path."""
         sp = SamplingParams(temperature=temperature, top_k=top_k, top_p=top_p,
                             greedy=temperature == 0.0)
         prompts = [np.asarray(p, np.int32) for p in prompts]
@@ -185,13 +259,27 @@ class InferenceEngineV2(InferenceEngine):
             while pending and self.state.can_admit(len(pending[0][1])):
                 uid, prompt = pending.pop(0)
                 self.put(uid, prompt, sp, seed=seed)
-            self.step(sp, seed=seed + step_i)
-            step_i += 1
+            if steps_per_sync > 1:
+                k = max(1, min(steps_per_sync, max_new_tokens))
+                self.step_many(k, sp, seed=seed + step_i)
+                step_i += k
+            else:
+                self.step(sp, seed=seed + step_i)
+                step_i += 1
             for uid in list(self.state.seqs):
                 d = self.state.seqs[uid]
+                if eos_token_id is not None and eos_token_id in d.generated:
+                    # trim overshoot past the first EOS (multi-step quantum)
+                    d.generated = d.generated[:d.generated.index(eos_token_id) + 1]
+                    d.last_token = d.generated[-1]
                 hit_eos = eos_token_id is not None and d.last_token == eos_token_id
+                # retire at seen == max_seq_len: KV positions 0..max-1 are
+                # then all used (a decode at lens == max-1 writes the LAST
+                # slot — the old `seen+1 >= max` check wasted it, and made
+                # the per-step and fused-quantum paths disagree by a token)
                 if len(d.generated) >= max_new_tokens or hit_eos or \
-                        d.seen_tokens + 1 >= self.family.cfg.max_seq_len:
+                        d.seen_tokens >= self.family.cfg.max_seq_len:
+                    d.generated = d.generated[:max_new_tokens]
                     results[uid] = self.finish(uid)
         return [results[i] for i in range(len(prompts))]
 

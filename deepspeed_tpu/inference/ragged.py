@@ -79,25 +79,36 @@ class StateManager:
     def free_slots(self) -> int:
         return len(self._free_slots)
 
-    def can_admit(self, prompt_len: int) -> bool:
+    def _admit_need(self, prompt_len: int) -> int:
+        """Blocks for the prompt + one pre-reserved decode block, capped at
+        the fixed table width (a prompt near max_seq_len already owns the
+        last block — reserving past the table would overflow it)."""
         need = (prompt_len + self.block_size - 1) // self.block_size + 1
-        return bool(self._free_slots) and self.allocator.free_blocks >= need
+        return min(need, self.max_blocks_per_seq)
+
+    def can_admit(self, prompt_len: int) -> bool:
+        return bool(self._free_slots) and \
+            self.allocator.free_blocks >= self._admit_need(prompt_len)
 
     def admit(self, uid: int, prompt_len: int) -> SequenceDescriptor:
         if uid in self.seqs:
             raise ValueError(f"uid {uid} already tracked")
-        need = (prompt_len + self.block_size - 1) // self.block_size + 1
+        need = self._admit_need(prompt_len)
         slot = self._free_slots.pop()
         desc = SequenceDescriptor(uid=uid, slot=slot,
                                   blocks=self.allocator.allocate(need))
         self.seqs[uid] = desc
         return desc
 
-    def extend(self, desc: SequenceDescriptor) -> None:
-        """Ensure the block table covers one more token."""
-        cap = len(desc.blocks) * self.block_size
-        if desc.seen_tokens + 1 > cap:
-            desc.blocks.extend(self.allocator.allocate(1))
+    def extend(self, desc: SequenceDescriptor, n: int = 1) -> None:
+        """Ensure the block table covers ``n`` more tokens (n > 1 is the
+        multi-step decode path: capacity is reserved up front so a fused
+        k-step scan never needs host allocation mid-flight)."""
+        need = desc.seen_tokens + n
+        short = need - len(desc.blocks) * self.block_size
+        if short > 0:
+            blocks = (short + self.block_size - 1) // self.block_size
+            desc.blocks.extend(self.allocator.allocate(blocks))
         if len(desc.blocks) > self.max_blocks_per_seq:
             raise MemoryError(f"sequence {desc.uid} exceeds max_blocks_per_seq")
 

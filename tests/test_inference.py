@@ -403,3 +403,87 @@ def test_v2_paged_engine_matches_v1_per_family(family, seed, tmp_path):
     ref = v1.generate(np.asarray([prompt], np.int32), max_new_tokens=6,
                       temperature=0.0)[0].tolist()
     assert v2_tokens == ref, (family, v2_tokens, ref)
+
+
+def test_v2_step_many_matches_per_step(tiny):
+    """The fused k-step decode (ONE host sync per quantum, lax.scan over
+    decode ticks) must produce exactly the per-step greedy tokens — the
+    serving fast path cannot change results."""
+    cfg, params = tiny
+    mesh_lib.set_mesh(None)
+
+    def make():
+        return build_engine_v2(
+            llama, cfg, params,
+            config={"dtype": "float32", "prefill_bucket": 16,
+                    "ragged": {"max_tracked_sequences": 4,
+                               "max_ragged_batch_size": 4,
+                               "memory_config_blocks": 64,
+                               "block_size": 16}})
+
+    prompts = [np.array([5, 7, 11, 13], np.int32),
+               np.array([2, 3], np.int32),
+               np.array([9, 1, 4], np.int32)]
+    per_step = make().generate(prompts, max_new_tokens=6)
+    fused = make().generate(prompts, max_new_tokens=6, steps_per_sync=3)
+    assert fused == per_step
+
+    # EOS inside a quantum: completion trimmed exactly at the first EOS
+    eos = per_step[0][2]  # make the 3rd generated token the EOS
+    ref_eos = make().generate(prompts, max_new_tokens=6, eos_token_id=eos)
+    fused_eos = make().generate(prompts, max_new_tokens=6, eos_token_id=eos,
+                                steps_per_sync=4)
+    assert fused_eos == ref_eos
+    assert fused_eos[0][-1] == eos and len(fused_eos[0]) == 3
+
+
+def test_v2_step_many_direct_api(tiny):
+    """step_many returns {uid: [k tokens]} and advances block tables /
+    lengths exactly k; clamps at max_seq_len."""
+    cfg, params = tiny
+    mesh_lib.set_mesh(None)
+    eng = build_engine_v2(
+        llama, cfg, params,
+        config={"dtype": "float32", "prefill_bucket": 16,
+                "ragged": {"max_tracked_sequences": 2,
+                           "max_ragged_batch_size": 2,
+                           "memory_config_blocks": 64,
+                           "block_size": 16}})
+    first = eng.put(0, [5, 7, 11], SamplingParams(greedy=True))
+    d = eng.state.seqs[0]
+    seen0 = d.seen_tokens
+    out = eng.step_many(4)
+    assert list(out) == [0] and len(out[0]) == 4
+    assert d.seen_tokens == seen0 + 4
+    # same tokens as four single steps on a fresh engine
+    eng2 = build_engine_v2(
+        llama, cfg, params,
+        config={"dtype": "float32", "prefill_bucket": 16,
+                "ragged": {"max_tracked_sequences": 2,
+                           "max_ragged_batch_size": 2,
+                           "memory_config_blocks": 64,
+                           "block_size": 16}})
+    assert eng2.put(0, [5, 7, 11], SamplingParams(greedy=True)) == first
+    singles = [eng2.step()[0] for _ in range(4)]
+    assert out[0] == singles
+
+
+def test_v2_step_many_context_boundary(tiny):
+    """Fused and per-step paths agree at the max_seq_len boundary (the
+    clamp must allow seen to reach exactly max_seq_len, like per-step)."""
+    cfg, params = tiny
+    mesh_lib.set_mesh(None)
+
+    def make():
+        return build_engine_v2(
+            llama, cfg, params,
+            config={"dtype": "float32", "prefill_bucket": 16,
+                    "ragged": {"max_tracked_sequences": 2,
+                               "max_ragged_batch_size": 2,
+                               "memory_config_blocks": 96,
+                               "block_size": 16}})
+
+    prompt = np.arange(cfg.max_seq_len - 2, dtype=np.int32) % cfg.vocab_size
+    ref = make().generate([prompt], max_new_tokens=10)
+    fused = make().generate([prompt], max_new_tokens=10, steps_per_sync=8)
+    assert fused == ref and len(ref[0]) >= 2, (len(ref[0]), len(fused[0]))
