@@ -38,9 +38,10 @@ requires it too).
 
 from __future__ import annotations
 
+import os
 import re
 from dataclasses import dataclass
-from typing import Any, Callable, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -221,6 +222,154 @@ def buffer_logical_axes(buffers: dict):
     """Logical axes for the packed buffers: leading dim is the stage dim
     ('layers' → 'pipe' when PP is active), flat dim left for ZeRO."""
     return {dt: ("layers", None) for dt in buffers}
+
+
+# --------------------------------------------------------------------------- #
+# elastic PP: repartition packed checkpoints across stage counts
+# --------------------------------------------------------------------------- #
+def _bounds_for(specs: Sequence[LayerSpec], n_stages: int,
+                method: str) -> List[int]:
+    return [0, len(specs)] if n_stages <= 1 else \
+        partition_layers(specs, n_stages, method)
+
+
+def _layer_slices(specs: Sequence[LayerSpec], bounds: Sequence[int]):
+    """Per-layer packed coordinates under a given partitioning:
+    ``({layer: [(dtype_key, offset, size), ...in leaf order]}, {layer: stage},
+    {dtype_key: (S, Lpad)})``. Offsets and the padded shapes follow the
+    exact flatten order / quantum ``pack_stage_trees`` uses — no values are
+    touched (pure metadata, O(leaves) not O(model bytes))."""
+    slices: Dict[int, list] = {}
+    stage_of: Dict[int, int] = {}
+    per_dtype_len: Dict[str, int] = {}
+    n_stages = len(bounds) - 1
+    for s in range(n_stages):
+        tree = {str(i): specs[i].params for i in range(bounds[s], bounds[s + 1])}
+        flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+        offs: Dict[str, int] = {}
+        for path, leaf in flat:
+            # same leaf coercion pack_stage_trees applies (plain scalars/lists)
+            dt = str(getattr(leaf, "dtype", None) or jnp.asarray(leaf).dtype)
+            layer = int(path[0].key)
+            n = int(np.prod(np.shape(leaf)))
+            slices.setdefault(layer, []).append((dt, offs.get(dt, 0), n))
+            stage_of[layer] = s
+            offs[dt] = offs.get(dt, 0) + n
+        for dt, end in offs.items():
+            per_dtype_len[dt] = max(per_dtype_len.get(dt, 0), end)
+    shapes = {dt: (n_stages, -(-L // _PAD_QUANTUM) * _PAD_QUANTUM)
+              for dt, L in per_dtype_len.items()}
+    return slices, stage_of, shapes
+
+
+def repack_pipeline_arrays(arrays_old: Dict[str, np.ndarray],
+                           specs: Sequence[LayerSpec],
+                           old_stages: int, new_stages: int,
+                           method: str = "parameters"
+                           ) -> Dict[str, np.ndarray]:
+    """Re-layout packed ``[S_old, Lpad_old]`` arrays (params OR same-keyed
+    optimizer moments) for a different stage count. The reference's
+    universal checkpoint re-maps per-layer fragments across PP topologies
+    (``universal_checkpoint.py:99``); here the per-layer fragments are
+    slices of the packed rows, moved between rows as layers change stage."""
+    old_sl, old_stage, old_shapes = _layer_slices(
+        specs, _bounds_for(specs, old_stages, method))
+    new_sl, new_stage, new_shapes = _layer_slices(
+        specs, _bounds_for(specs, new_stages, method))
+    for dt, arr in arrays_old.items():
+        if dt not in old_shapes or tuple(np.shape(arr)) != old_shapes[dt]:
+            # wrong old_stages/method would otherwise scramble weights
+            # SILENTLY whenever padding happens to cover the bad offsets
+            raise ValueError(
+                f"packed array '{dt}' has shape {np.shape(arr)} but "
+                f"(specs, old_stages={old_stages}, method='{method}') "
+                f"implies {old_shapes.get(dt)} — wrong stage count, "
+                f"partition method, or LayerSpec list")
+    out = {dt: np.zeros(new_shapes[dt], dtype=arrays_old[dt].dtype)
+           for dt in new_shapes if dt in arrays_old}
+    for layer, old_entries in old_sl.items():
+        for (dt, o_old, n), (dt2, o_new, n2) in zip(old_entries,
+                                                    new_sl[layer]):
+            assert dt == dt2 and n == n2, (layer, dt, dt2, n, n2)
+            if dt not in arrays_old:
+                continue
+            out[dt][new_stage[layer], o_new:o_new + n] = \
+                np.asarray(arrays_old[dt])[old_stage[layer], o_old:o_old + n]
+    return out
+
+
+def repartition_universal_pipeline(universal_dir: str,
+                                   specs: Sequence[LayerSpec],
+                                   old_stages: int, new_stages: int, *,
+                                   method: str = "parameters",
+                                   out_dir: str) -> str:
+    """Rewrite a universal checkpoint of a packed hetero pipeline for a new
+    stage count (elastic PP resume). Every fragment whose tree path ends in
+    ``pipe_buffers.<dtype>`` — the params AND each optimizer-moment mirror —
+    is repacked; everything else (step counters, scalars) copies through.
+    ``specs`` must be the same LayerSpec list both models were built from
+    (layouts are recomputed from it deterministically)."""
+    import json as _json
+    import re as _re
+    import shutil as _shutil
+
+    from ..checkpoint.universal import UNIVERSAL_DIR
+
+    root = universal_dir
+    if os.path.basename(root) != UNIVERSAL_DIR and \
+            os.path.isdir(os.path.join(root, UNIVERSAL_DIR)):
+        root = os.path.join(root, UNIVERSAL_DIR)
+    if os.path.exists(out_dir) and os.listdir(out_dir):
+        raise ValueError(f"out_dir {out_dir} exists and is not empty")
+    # atomic like save_universal: build in a tmp dir, os.replace at the end,
+    # so a mid-repack failure never leaves a loadable half-converted dir
+    tmp = os.path.normpath(out_dir) + ".tmp"
+    if os.path.exists(tmp):
+        _shutil.rmtree(tmp)
+    _shutil.copytree(root, tmp)
+    try:
+        # group fragments by their pipe_buffers dict (a params tree and each
+        # moment mirror repack as one unit so dtype-buffer pairs stay aligned)
+        pat = _re.compile(r"^(.*?)pipe_buffers\.([A-Za-z0-9_]+)$")
+        groups: Dict[str, Dict[str, str]] = {}
+        for sub in ("param", "optim"):
+            d = os.path.join(tmp, sub)
+            if not os.path.isdir(d):
+                continue
+            for name in os.listdir(d):
+                m = pat.match(name)
+                if m:
+                    groups.setdefault(sub + "/" + m.group(1), {})[m.group(2)] \
+                        = os.path.join(d, name, "fp32.npy")
+        if not groups:
+            raise ValueError(
+                f"no pipe_buffers fragments found under {root} — "
+                f"not a packed hetero-pipeline checkpoint")
+        index_updates: Dict[str, list] = {}
+        for _, by_dt in groups.items():
+            arrays_old = {dt: np.load(fn) for dt, fn in by_dt.items()}
+            arrays_new = repack_pipeline_arrays(arrays_old, specs, old_stages,
+                                                new_stages, method)
+            for dt, fn in by_dt.items():
+                np.save(fn, arrays_new[dt])
+                frag = os.path.basename(os.path.dirname(fn))
+                index_updates[frag] = list(arrays_new[dt].shape)
+        meta_path = os.path.join(tmp, "meta.json")
+        if os.path.exists(meta_path):
+            with open(meta_path) as f:
+                meta = _json.load(f)
+            for sec in meta.get("index", {}).values():
+                for frag, shape in index_updates.items():
+                    if frag in sec:
+                        sec[frag]["shape"] = shape
+            with open(meta_path, "w") as f:
+                _json.dump(meta, f, indent=2, default=str)
+    except Exception:
+        _shutil.rmtree(tmp, ignore_errors=True)
+        raise
+    os.makedirs(os.path.dirname(os.path.abspath(out_dir)), exist_ok=True)
+    os.replace(tmp, out_dir)
+    return out_dir
 
 
 # --------------------------------------------------------------------------- #
@@ -450,10 +599,10 @@ def build_pipeline_model(specs: Sequence[LayerSpec],
         pass
     S = n_stages or (mm.pp_world_size if mm is not None else 1)
     S = max(S, 1)
-    if S == 1:
-        bounds = [0, len(specs)]
-    else:
-        bounds = partition_layers(specs, S, partition_method)
+    # single source of truth with the checkpoint repartitioner: bounds MUST
+    # be reproducible from (specs, S, method) alone or repacked checkpoints
+    # desynchronize from the engine layout
+    bounds = _bounds_for(specs, S, partition_method)
 
     groups = [list(range(bounds[s], bounds[s + 1])) for s in range(len(bounds) - 1)]
     stage_trees = [{str(i): specs[i].params for i in g} for g in groups]

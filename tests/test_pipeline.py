@@ -343,3 +343,68 @@ def test_hetero_stage_local_param_bytes(devices8):
     losses = [float(engine.train_batch({"tokens": tokens}).loss)
               for _ in range(4)]
     assert losses[-1] < losses[0]
+
+
+def test_hetero_elastic_repartition_universal(devices8, tmp_path):
+    """Elastic PP: a packed hetero-pipeline universal checkpoint saved at
+    pipe=2 resumes at pipe=4 (params AND Adam moments re-laid out per layer
+    — reference universal_checkpoint.py:99 cross-topology fragment mapping).
+    The repartitioned engine's loss trajectory must continue like the
+    original's."""
+    from deepspeed_tpu.comm import mesh as mesh_lib
+    from deepspeed_tpu.runtime.checkpoint import load_universal, save_universal
+    from deepspeed_tpu.runtime.pipe.hetero import (
+        LayerSpec, build_pipeline_model, repartition_universal_pipeline)
+
+    d, vocab = 16, 64
+    ks = jax.random.split(jax.random.PRNGKey(0), 12)
+
+    def make_specs():
+        specs = [LayerSpec("Embed",
+                           {"e": jax.random.normal(ks[0], (vocab, d)) * 0.1},
+                           lambda p, t: p["e"][t])]
+        for i in range(4):
+            specs.append(LayerSpec(
+                "Wide", {"up": jax.random.normal(ks[1 + i], (d, 4 * d)) * 0.1,
+                         "down": jax.random.normal(ks[5 + i], (4 * d, d)) * 0.1},
+                lambda p, h: h + jnp.tanh(h @ p["up"]) @ p["down"]))
+        specs.append(LayerSpec(
+            "Head", {"out": jax.random.normal(ks[9], (d, vocab)) * 0.1},
+            lambda p, h: h @ p["out"]))
+        return specs
+
+    def loss_head(logits, labels):
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return -jnp.take_along_axis(logp, labels[..., None], axis=-1).sum()
+
+    def make_engine(pipe):
+        mesh_lib.set_mesh(None)
+        model = build_pipeline_model(
+            make_specs(), lambda p, t: p["e"][t], loss_head, n_stages=pipe,
+            partition_method="parameters")
+        engine, *_ = dst.initialize(model=model, config={
+            "train_batch_size": 8,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+            "mesh": {"data": 8 // pipe, "pipe": pipe},
+            "steps_per_print": 0})
+        return engine
+
+    tokens = np.asarray(jax.random.randint(jax.random.PRNGKey(9), (8, 9),
+                                           0, vocab))
+    e2 = make_engine(2)
+    for _ in range(3):
+        e2.train_batch({"tokens": tokens})
+    save_universal(e2.state, str(tmp_path / "ck"))
+    cont2 = [float(e2.train_batch({"tokens": tokens}).loss)
+             for _ in range(3)]
+
+    repartition_universal_pipeline(
+        str(tmp_path / "ck"), make_specs(), 2, 4,
+        out_dir=str(tmp_path / "ck4"))
+    e4 = make_engine(4)
+    params, opt_state, _ = load_universal(str(tmp_path / "ck4"),
+                                          e4.state.params, e4.state.opt_state)
+    e4.state = e4.state._replace(params=params, opt_state=opt_state)
+    cont4 = [float(e4.train_batch({"tokens": tokens}).loss)
+             for _ in range(3)]
+    np.testing.assert_allclose(cont2, cont4, rtol=5e-4, atol=5e-5)
