@@ -62,11 +62,14 @@ def run_closed_loop(eng, sp, vocab, batch, prompt_len, gen_len, measure_s,
     t0 = time.perf_counter()
     produced_retired = 0
     prefills = 0
+    call_ms = []  # per-call wall time -> per-token latency percentiles
     while time.perf_counter() - t0 < measure_s:
+        tc = time.perf_counter()
         if quantum > 1:
             eng.step_many(quantum, sp)
         else:
             eng.step(sp)
+        call_ms.append((time.perf_counter() - tc) * 1e3)
         for d in list(eng.state.seqs.values()):
             if len(d.generated) >= gen_len:
                 produced_retired += gen_len
@@ -77,7 +80,14 @@ def run_closed_loop(eng, sp, vocab, batch, prompt_len, gen_len, measure_s,
     produced = produced_retired + useful_live() - base
     for d in list(eng.state.seqs.values()):
         eng.finish(d.uid)
-    return produced / dt, prefills
+    import numpy as np
+
+    # FastGen-comparable per-token latency: a quantum call emits `quantum`
+    # tokens per sequence, so token latency = call time / quantum
+    tok_ms = np.asarray(call_ms) / max(1, quantum)
+    lat = {"p50_ms": round(float(np.percentile(tok_ms, 50)), 2),
+           "p95_ms": round(float(np.percentile(tok_ms, 95)), 2)}
+    return produced / dt, prefills, lat
 
 
 def main():
@@ -128,12 +138,13 @@ def main():
                                     batch * ((prompt_len + gen_len) // 32 + 3)
                                     + 8,
                                 "block_size": 32}})
-                tps, prefills = run_closed_loop(
+                tps, prefills, lat = run_closed_loop(
                     eng, sp, mcfg.vocab_size, batch, prompt_len, gen_len,
                     measure_s, rng, quantum=quantum)
                 rows[label] = {"tok_per_sec": round(tps, 1),
                                "prefills_in_window": prefills,
-                               "prompt_len": prompt_len, "gen_len": gen_len}
+                               "prompt_len": prompt_len, "gen_len": gen_len,
+                               "token_latency": lat}
                 best = max(best, tps)
                 sys.stderr.write(f"[serving] {label}: {rows[label]}\n")
             except Exception as e:
