@@ -13,6 +13,7 @@ jax.config.update("jax_platforms", "cpu")
 def main():
     pid, n, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
     tp = int(sys.argv[4]) if len(sys.argv) > 4 else 1
+    mode = sys.argv[5] if len(sys.argv) > 5 else "train"
     if tp > 1:
         # pod topology: several devices per process (the host's chips over
         # ICI) × several processes (DCN) — TP inside, DP across
@@ -45,9 +46,32 @@ def main():
     assert [o["rank"] for o in objs] == list(range(n)), objs
     rng = np.random.default_rng(0)  # same seed → same global batch everywhere
     fixed = {"tokens": rng.integers(0, 256, (8, 33), dtype=np.int32)}
+    if mode == "preempt":
+        return preempt_mode(eng, fixed, pid)
     losses = [float(eng.train_batch(fixed).loss) for _ in range(5)]
     print(f"LOSSES {pid} {' '.join(f'{l:.6f}' for l in losses)}", flush=True)
     assert losses[-1] < losses[0] - 1.0, losses
+
+
+def preempt_mode(eng, fixed, pid):
+    """Cross-host preemption coordination: the preemption signal (SIGUSR1
+    standing in for the resource manager's SIGTERM) lands ONLY on rank 1,
+    but both ranks must agree (allgather-OR) and enter the collective
+    checkpoint at the SAME step."""
+    import signal
+
+    from deepspeed_tpu.elasticity.elastic_agent import PreemptionGuard
+
+    guard = PreemptionGuard(os.environ["DSTPU_TEST_CKPT"],
+                            signals=(signal.SIGUSR1,))
+    for i in range(20):
+        eng.train_batch(fixed)
+        if pid == 1 and i == 2:  # the resource manager preempts rank 1 only
+            os.kill(os.getpid(), signal.SIGUSR1)
+        if guard.step_boundary(eng):
+            print(f"PREEMPTED {pid} at_boundary {i}", flush=True)
+            return
+    raise SystemExit(f"rank {pid} never observed the peer preemption")
 
 
 if __name__ == "__main__":

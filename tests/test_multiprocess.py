@@ -23,22 +23,19 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-@pytest.mark.parametrize("n,tp", [(2, 1), (2, 2)])
-def test_two_process_data_parallel_training(n, tp):
-    """tp=1: pure cross-process DP. tp=2: the pod topology — TP across each
-    process's local devices (ICI analog), DP across processes (DCN analog)."""
-    workers = []
-    env = {**os.environ, "PYTHONPATH": REPO_ROOT, "JAX_PLATFORMS": "cpu"}
-    # conftest's 8-device virtual mesh must not leak in: each worker sets its
-    # own local device count — the parallelism under test is cross-process
+def _run_workers(n: int, tp: int, mode: str = "train", extra_env=None):
+    """Spawn n _mp_worker.py processes and return their stdouts; asserts
+    every worker exits 0. Workers set their own local device count, so
+    conftest's 8-device virtual mesh must not leak in (XLA_FLAGS popped)."""
+    env = {**os.environ, "PYTHONPATH": REPO_ROOT, "JAX_PLATFORMS": "cpu",
+           **(extra_env or {})}
     env.pop("XLA_FLAGS", None)
     worker = os.path.join(os.path.dirname(__file__), "_mp_worker.py")
     port = str(_free_port())
-    for pid in range(n):
-        workers.append(subprocess.Popen(
-            [sys.executable, worker, str(pid), str(n), port, str(tp)],
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-            env=env))
+    workers = [subprocess.Popen(
+        [sys.executable, worker, str(pid), str(n), port, str(tp), mode],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env)
+        for pid in range(n)]
     outs = []
     try:
         for w in workers:
@@ -51,8 +48,35 @@ def test_two_process_data_parallel_training(n, tp):
                 w.wait()
     for w, out in zip(workers, outs):
         assert w.returncode == 0, out[-2000:]
+    return outs
+
+
+@pytest.mark.parametrize("n,tp", [(2, 1), (2, 2)])
+def test_two_process_data_parallel_training(n, tp):
+    """tp=1: pure cross-process DP. tp=2: the pod topology — TP across each
+    process's local devices (ICI analog), DP across processes (DCN analog)."""
+    outs = _run_workers(n, tp)
     # loss trajectories must be identical across ranks (collectives agree)
     lines = [next(l for l in out.splitlines() if l.startswith("LOSSES"))
              for out in outs]
     trajs = {line.split()[1]: line.split()[2:] for line in lines}
     assert len(set(map(tuple, trajs.values()))) == 1, trajs
+
+
+def test_two_process_preemption_coordination(tmp_path):
+    """A preemption signal on ONE rank → BOTH ranks checkpoint at the same
+    boundary (the PreemptionGuard allgather-OR; reference DSElasticAgent
+    coordinates via torch-elastic rendezvous). SIGUSR1 stands in for the
+    resource manager's SIGTERM (the guard's default, not exercised under
+    pytest). The collective save runs over real 2-process sharded arrays —
+    the exact path that hangs if ranks enter it at different steps."""
+    outs = _run_workers(
+        2, 1, mode="preempt",
+        extra_env={"DSTPU_TEST_CKPT": str(tmp_path / "preempt_ck")})
+    lines = [next(l for l in out.splitlines() if l.startswith("PREEMPTED"))
+             for out in outs]
+    boundaries = {line.split()[1]: line.split()[3] for line in lines}
+    assert set(boundaries) == {"0", "1"}
+    assert len(set(boundaries.values())) == 1, \
+        f"ranks checkpointed at different boundaries: {boundaries}"
+    assert (tmp_path / "preempt_ck").exists()
