@@ -259,8 +259,29 @@ class InferenceEngine:
             nxt = sample(rng, logits[:, 0], params_s)
             return nxt.astype(jnp.int32), cache
 
+        def decode_chunk(params, tok, cache, cache_len, rng, finished, eos,
+                         n_steps):
+            """``n_steps`` decode ticks in one lax.scan — one compiled
+            program and ONE host sync per chunk (per-token np.asarray syncs
+            dominate decode over a network-attached chip). EOS propagation
+            runs in-jit: finished rows keep emitting eos, exactly like the
+            old host loop; the caller checks ``finished`` between chunks
+            for the early exit."""
+            def tick(carry, key_t):
+                tok, cache, cache_len, finished = carry
+                nxt, cache = decode(params, tok, cache, cache_len, key_t)
+                step = jnp.where(finished, eos, nxt)
+                finished = finished | (step == eos)
+                return (step, cache, cache_len + 1, finished), step
+
+            keys = jax.random.split(rng, n_steps)
+            (tok, cache, cache_len, finished), steps = jax.lax.scan(
+                tick, (tok, cache, cache_len, finished), keys)
+            return steps.T, tok, cache, cache_len, finished  # [b, n_steps]
+
         fns = (jax.jit(prefill),
-               jax.jit(decode, donate_argnums=(2,)))
+               jax.jit(decode_chunk, donate_argnums=(2,),
+                       static_argnums=(7,)))
         self._generate_cache[key] = fns
         return fns
 
@@ -283,28 +304,37 @@ class InferenceEngine:
         padded[:, :t] = prompts
         sp = SamplingParams(temperature=temperature, top_k=top_k, top_p=top_p,
                             greedy=temperature == 0.0)
-        prefill, decode = self._step_fns(b, pad_t, max_len, sp)
+        prefill, decode_chunk = self._step_fns(b, pad_t, max_len, sp)
 
         rng = jax.random.PRNGKey(seed)
         rng, k = jax.random.split(rng)
         tok, cache = prefill(self.params, jnp.asarray(padded), lengths, k)
+        first_tok = tok
+        if max_new_tokens <= 1:
+            return np.asarray(tok)[:, None]
+        # -1 never matches a token id, so "no EOS" needs no separate trace
+        eos_val = -1 if eos_token_id is None else int(eos_token_id)
+        eos_dev = jnp.int32(eos_val)
+        finished = tok == eos_dev  # device op: decode dispatch never waits
         cache_len = lengths
-        out = [np.asarray(tok)]
-        finished = (np.asarray(tok) == eos_token_id) if eos_token_id is not None \
-            else np.zeros((b,), bool)
-        for _ in range(max_new_tokens - 1):
-            if finished.all():
-                out.append(np.full((b,), eos_token_id, np.int32))
-                continue
+        # chunked quanta: one compiled scan + ONE host sync per CHUNK tokens,
+        # with the all-finished early exit checked between chunks (an
+        # EOS-at-step-2 batch must not pay for max_new_tokens of decode)
+        CHUNK = 32
+        outs = []
+        remaining = max_new_tokens - 1
+        while remaining > 0:
+            n = min(CHUNK, remaining)
             rng, k = jax.random.split(rng)
-            tok, cache = decode(self.params, tok, cache, cache_len, k)
-            cache_len = cache_len + 1
-            step = np.asarray(tok)
-            if eos_token_id is not None:
-                step = np.where(finished, eos_token_id, step)
-                finished |= step == eos_token_id
-            out.append(step)
-        return np.stack(out, axis=1)
+            steps, tok, cache, cache_len, finished = decode_chunk(
+                self.params, tok, cache, cache_len, k, finished, eos_dev, n)
+            outs.append(np.asarray(steps))
+            remaining -= n
+            if eos_token_id is not None and bool(np.asarray(finished).all()):
+                break
+        if remaining > 0:  # early exit: pad the tail with EOS on host
+            outs.append(np.full((b, remaining), eos_token_id, np.int32))
+        return np.concatenate([np.asarray(first_tok)[:, None]] + outs, axis=1)
 
 
 def init_inference(model=None, config=None, *, family: Optional[ModelFamily] = None,
