@@ -98,6 +98,28 @@ class StepOutput(NamedTuple):
 from .utils import global_norm as _global_norm  # shared with runtime.utils
 
 
+def _enable_compile_cache(config) -> None:
+    """Persistent XLA compilation cache: re-runs skip the multi-minute TPU
+    compiles. ``compile_cache_dir``: None → fall back to
+    ``$DSTPU_COMPILE_CACHE``; "" → explicitly OFF even with the env var set.
+    A cache problem must never break training — best-effort only."""
+    path = getattr(config, "compile_cache_dir", None)
+    if path is None:
+        path = os.environ.get("DSTPU_COMPILE_CACHE", "")
+    if not path:
+        return
+    try:
+        jax.config.update("jax_compilation_cache_dir", path)
+    except Exception as e:
+        log_dist(f"compile cache unavailable ({e}); continuing without")
+        return
+    try:  # optional knob — its absence must not disable the active cache
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+    except Exception:
+        pass
+    log_dist(f"persistent compilation cache: {path}")
+
+
 class DeepSpeedTPUEngine:
     """See module docstring. Construct via :func:`initialize`."""
 
@@ -109,6 +131,7 @@ class DeepSpeedTPUEngine:
         self.model = model
         self.config = config
         self.mesh_mgr = mesh_mgr
+        _enable_compile_cache(config)
         self.global_steps = 0
         self.skipped_steps = 0
         self.micro_steps = 0

@@ -98,3 +98,44 @@ def test_mesh_axis_sizes():
     cfg = parse_config({"mesh": {"tensor": 2, "seq": 2}}, world_size=8)
     sizes = cfg.mesh.axis_sizes(8)
     assert sizes == {"data": 2, "expert": 1, "pipe": 1, "seq": 2, "tensor": 2}
+
+
+def test_compile_cache_dir_config(tmp_path, devices8, monkeypatch):
+    """config.compile_cache_dir / DSTPU_COMPILE_CACHE turn on the persistent
+    XLA compilation cache at engine construction (TPU cold-start cutter)."""
+    import jax.numpy as jnp
+
+    import deepspeed_tpu as dst
+    from deepspeed_tpu.comm import mesh as mesh_lib
+    from deepspeed_tpu.runtime.engine import ModelSpec
+
+    import jax as _jax
+
+    cache = tmp_path / "xla_cache"
+    cache.mkdir()
+    mesh_lib.set_mesh(None)
+    spec = ModelSpec(loss_fn=lambda p, b: (jnp.sum((p["w"] * b["x"]) ** 2), {}),
+                     init_fn=lambda k: {"w": jnp.ones((4,))},
+                     pipeline_capable=False)
+    prev = _jax.config.jax_compilation_cache_dir
+    try:
+        engine, *_ = dst.initialize(model=spec, config={
+            "train_batch_size": 8,
+            "optimizer": {"type": "sgd", "params": {"lr": 0.1}},
+            "compile_cache_dir": str(cache),
+            "steps_per_print": 0})
+        assert engine.config.compile_cache_dir == str(cache)
+        assert _jax.config.jax_compilation_cache_dir == str(cache)
+        # "" disables explicitly, even when the env var is set
+        mesh_lib.set_mesh(None)
+        monkeypatch.setenv("DSTPU_COMPILE_CACHE", str(tmp_path / "envcache"))
+        _jax.config.update("jax_compilation_cache_dir", None)
+        dst.initialize(model=spec, config={
+            "train_batch_size": 8,
+            "optimizer": {"type": "sgd", "params": {"lr": 0.1}},
+            "compile_cache_dir": "",
+            "steps_per_print": 0})
+        assert _jax.config.jax_compilation_cache_dir is None
+    finally:
+        # process-global jax config must not leak into later tests
+        _jax.config.update("jax_compilation_cache_dir", prev)
