@@ -60,19 +60,31 @@ class InferenceEngineV2(InferenceEngine):
                  f"{rc.block_size} tokens, {B} sequence slots")
 
     # ------------------------------------------------------------------ #
-    def _prefill_fn(self, pad_t: int, sp: SamplingParams):
-        key = ("prefill", pad_t, sp)
+    def _prefill_fn(self, pad_t: int, sp: SamplingParams, n: int = 1):
+        """One compiled prefill over ``n`` admitted sequences at once —
+        admission bursts (serving start, high churn) run one program call
+        instead of n (the reference schedules multi-sequence ragged prefill
+        batches the same way). Callers pad n to a power-of-two bucket with
+        zero-length dummy rows (masked by ``valid``, writing to the trash
+        block) so compile count stays O(log max_sequences) per pad_t, not
+        O(max_sequences). Per-row rng keys fold in each uid, keeping
+        first-token sampling independent of burst composition."""
+        key = ("prefill", pad_t, sp, n)
         if key not in self._paged_fns:
             fam, ap = self.family, self._apply_paged
 
-            def prefill(params, cache, tokens, length, table, rng):
-                valid = jnp.arange(pad_t)[None, :] < length
-                logits, cache = ap(fam.cfg, self._dq(params), tokens[None, :], cache,
-                                   table[None, :], jnp.zeros((1,), jnp.int32),
+            def prefill(params, cache, tokens, lengths, tables, rng, uids):
+                # tokens [n, pad_t]; lengths [n]; tables [n, blocks]
+                valid = jnp.arange(pad_t)[None, :] < lengths[:, None]
+                logits, cache = ap(fam.cfg, self._dq(params), tokens, cache,
+                                   tables, jnp.zeros((n,), jnp.int32),
                                    valid=valid)
                 last = jnp.take_along_axis(
-                    logits, (length - 1)[None, None, None], axis=1)[0, 0]
-                return sample(rng, last, sp).astype(jnp.int32), cache
+                    logits, jnp.maximum(lengths - 1, 0)[:, None, None],
+                    axis=1)[:, 0]
+                keys = jax.vmap(lambda u: jax.random.fold_in(rng, u))(uids)
+                toks = jax.vmap(lambda k, l: sample(k, l, sp))(keys, last)
+                return toks.astype(jnp.int32), cache
 
             self._paged_fns[key] = jax.jit(prefill, donate_argnums=(1,))
         return self._paged_fns[key]
@@ -128,26 +140,69 @@ class InferenceEngineV2(InferenceEngine):
         """Admit one sequence and run its prefill; returns the first sampled
         token (reference ``engine_v2.put`` returns logits for the client to
         sample — here sampling is fused into the step)."""
-        prompt = np.asarray(prompt_tokens, np.int32)
-        desc = self.state.admit(uid, len(prompt))
-        pad_t = _round_up(max(len(prompt), 1), self.config.prefill_bucket)
-        padded = np.zeros((pad_t,), np.int32)
-        padded[:len(prompt)] = prompt
-        table = self.state.block_table(desc)
-        fn = self._prefill_fn(pad_t, sp)
-        tok, self.cache = fn(self.params, self.cache, jnp.asarray(padded),
-                             jnp.int32(len(prompt)), jnp.asarray(table),
-                             jax.random.PRNGKey(seed ^ uid))
-        tok = int(tok)
-        desc.seen_tokens = len(prompt)
-        desc.last_token = tok
-        desc.generated.append(tok)
-        s = desc.slot
-        self._slot_tokens[s] = tok
-        self._slot_lens[s] = desc.seen_tokens
-        self._slot_tables[s] = table
-        self._slot_active[s] = True
-        return tok
+        return self.put_many([(uid, prompt_tokens)], sp, seed=seed)[uid]
+
+    def put_many(self, uid_prompts,
+                 sp: SamplingParams = SamplingParams(greedy=True),
+                 seed: int = 0) -> Dict[int, int]:
+        """Admit a BATCH of sequences with one compiled prefill call →
+        {uid: first sampled token}. Prompts pad to the longest one's bucket
+        (same budget trade the reference's ragged prefill batches make).
+        All-or-nothing: if capacity runs out mid-batch, already-admitted
+        entries are retired before the error propagates (no half-admitted
+        descriptors ever become visible to step())."""
+        entries = []
+        try:
+            for uid, p in uid_prompts:
+                prompt = np.asarray(p, np.int32)
+                entries.append((uid, prompt,
+                                self.state.admit(uid, len(prompt))))
+        except Exception:
+            for uid, _, _ in entries:
+                self.state.retire(uid)
+            raise
+        return self._prefill_admitted(entries, sp, seed)
+
+    def _prefill_admitted(self, entries, sp: SamplingParams,
+                          seed: int = 0) -> Dict[int, int]:
+        """Batched prefill over already-admitted ``(uid, prompt, desc)``
+        entries (callers admit first so capacity accounting stays exact).
+        The batch pads to a power-of-two row count with masked dummy rows —
+        one compile per (pad_t, bucket), not per burst size."""
+        if not entries:
+            return {}
+        n = len(entries)
+        n_pad = 1 << (n - 1).bit_length()
+        pad_t = _round_up(max(max(len(p) for _, p, _ in entries), 1),
+                          self.config.prefill_bucket)
+        padded = np.zeros((n_pad, pad_t), np.int32)
+        lengths = np.zeros((n_pad,), np.int32)  # dummy rows: length 0
+        uids_arr = np.zeros((n_pad,), np.int32)
+        tables = np.zeros((n_pad, self._slot_tables.shape[1]), np.int32)
+        for i, (uid, prompt, desc) in enumerate(entries):
+            padded[i, :len(prompt)] = prompt
+            lengths[i] = len(prompt)
+            uids_arr[i] = uid
+            tables[i] = self.state.block_table(desc)
+        fn = self._prefill_fn(pad_t, sp, n_pad)
+        toks, self.cache = fn(self.params, self.cache, jnp.asarray(padded),
+                              jnp.asarray(lengths), jnp.asarray(tables),
+                              jax.random.PRNGKey(seed),
+                              jnp.asarray(uids_arr))
+        toks = np.asarray(toks)
+        out: Dict[int, int] = {}
+        for i, (uid, prompt, desc) in enumerate(entries):
+            tok = int(toks[i])
+            desc.seen_tokens = len(prompt)
+            desc.last_token = tok
+            desc.generated.append(tok)
+            s = desc.slot
+            self._slot_tokens[s] = tok
+            self._slot_lens[s] = desc.seen_tokens
+            self._slot_tables[s] = tables[i]
+            self._slot_active[s] = True
+            out[uid] = tok
+        return out
 
     def step(self, sp: SamplingParams = SamplingParams(greedy=True),
              seed: int = 0) -> Dict[int, int]:
@@ -256,9 +311,14 @@ class InferenceEngineV2(InferenceEngine):
                     f"pool only holds {capacity}; raise ragged.memory_config_blocks")
         step_i = 0
         while pending or self.state.seqs:
+            batch_adm = []
             while pending and self.state.can_admit(len(pending[0][1])):
                 uid, prompt = pending.pop(0)
-                self.put(uid, prompt, sp, seed=seed)
+                # admit eagerly so can_admit sees each admission's capacity
+                batch_adm.append((uid, prompt,
+                                  self.state.admit(uid, len(prompt))))
+            if batch_adm:  # one compiled prefill for the whole burst
+                self._prefill_admitted(batch_adm, sp, seed=seed)
             if steps_per_sync > 1:
                 k = max(1, min(steps_per_sync, max_new_tokens))
                 self.step_many(k, sp, seed=seed + step_i)

@@ -487,3 +487,31 @@ def test_v2_step_many_context_boundary(tiny):
     ref = make().generate([prompt], max_new_tokens=10)
     fused = make().generate([prompt], max_new_tokens=10, steps_per_sync=8)
     assert fused == ref and len(ref[0]) >= 2, (len(ref[0]), len(fused[0]))
+
+
+def test_v2_put_many_matches_sequential_put(tiny):
+    """Batched admission (one compiled prefill for the burst) produces the
+    same greedy first tokens and identical downstream decode as one-by-one
+    put()."""
+    cfg, params = tiny
+    mesh_lib.set_mesh(None)
+
+    def make():
+        return build_engine_v2(
+            llama, cfg, params,
+            config={"dtype": "float32", "prefill_bucket": 16,
+                    "ragged": {"max_tracked_sequences": 4,
+                               "max_ragged_batch_size": 4,
+                               "memory_config_blocks": 64,
+                               "block_size": 16}})
+
+    prompts = {0: [5, 7, 11, 13], 1: [2, 3], 2: [9, 1, 4]}
+    sp = SamplingParams(greedy=True)
+    a = make()
+    seq_first = {u: a.put(u, p, sp) for u, p in prompts.items()}
+    seq_next = a.step(sp)
+    b = make()
+    batch_first = b.put_many(list(prompts.items()), sp)
+    batch_next = b.step(sp)
+    assert batch_first == seq_first
+    assert batch_next == seq_next
