@@ -47,7 +47,9 @@ def attach_live_evidence():
     here = os.path.dirname(os.path.abspath(__file__))
     for name, key in (("BENCH_TPU_LIVE.json", "tpu_capture"),
                       ("LONGCTX_TPU_LIVE.json", "tpu_longctx_capture"),
-                      ("SERVING_TPU_LIVE.json", "tpu_serving_capture")):
+                      ("SERVING_TPU_LIVE.json", "tpu_serving_capture"),
+                      ("MOE_TPU_LIVE.json", "tpu_moe_dispatch_capture"),
+                      ("QUANT_TPU_LIVE.json", "tpu_quant_linear_capture")):
         path = os.path.join(here, name)
         try:
             with open(path) as f:

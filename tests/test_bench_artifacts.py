@@ -50,3 +50,48 @@ def test_graft_entry_compiles():
     fn, args = g.entry()
     compiled = jax.jit(fn).lower(*args).compile()
     assert compiled.cost_analysis() is not None
+
+
+def test_bench_attaches_watcher_captures(tmp_path):
+    """attach_live_evidence: with the tunnel down at driver time, any
+    mid-round watcher captures (BENCH/LONGCTX/SERVING/MOE/QUANT_TPU_LIVE)
+    embed into the emitted JSON, timestamped and labeled — a round whose
+    window opened mid-round can never ship zero TPU evidence again."""
+    sys.path.insert(0, REPO_ROOT)
+    import bench
+
+    captures = {
+        "BENCH_TPU_LIVE.json": {"metric": "llama_zero3_train_mfu",
+                                "value": 0.5, "detail": {"backend": "tpu"}},
+        "LONGCTX_TPU_LIVE.json": {"metric": "fpdt_longctx_max_seq",
+                                  "value": 131072,
+                                  "detail": {"backend": "tpu"}},
+        "SERVING_TPU_LIVE.json": {"metric": "serving_steady_tok_per_sec",
+                                  "value": 999.0,
+                                  "detail": {"backend": "tpu"}},
+    }
+    created = []
+    try:
+        for name, content in captures.items():
+            path = os.path.join(REPO_ROOT, name)
+            assert not os.path.exists(path), f"real capture present: {name}"
+            with open(path, "w") as f:
+                json.dump(content, f)
+            created.append(path)
+        result = dict(bench.RESULT, detail={"backend": "cpu-degraded"})
+        saved = bench.RESULT
+        bench.RESULT = result
+        try:
+            bench.attach_live_evidence()
+        finally:
+            bench.RESULT = saved
+        d = result["detail"]
+        assert d["tpu_capture"]["value"] == 0.5
+        assert d["tpu_longctx_capture"]["value"] == 131072
+        assert d["tpu_serving_capture"]["value"] == 999.0
+        for key in ("tpu_capture", "tpu_longctx_capture",
+                    "tpu_serving_capture"):
+            assert "captured_at_utc" in d[key] and "note" in d[key]
+    finally:
+        for path in created:
+            os.unlink(path)
