@@ -79,3 +79,27 @@ def test_to_universal_cli(tmp_path, devices8):
     rc = main(["--input_folder", str(tmp_path), "--tag", "t1"])
     assert rc == 0
     assert (tmp_path / "t1" / "universal").exists()
+
+
+def test_examples_run(tmp_path):
+    """The shipped examples execute end-to-end on CPU (the switching-user
+    smoke: train a few steps + checkpoint, then serve)."""
+    import os
+    import subprocess
+    import sys
+
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    env.pop("XLA_FLAGS", None)
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, os.path.join(root, "examples", "train_llama.py"),
+         "--tiny", "--steps", "4", "--ckpt", str(tmp_path / "ck")],
+        capture_output=True, text=True, timeout=420, env=env)
+    assert r.returncode == 0, r.stderr[-1500:]
+    assert "final loss" in r.stdout and (tmp_path / "ck").exists()
+    r = subprocess.run(
+        [sys.executable, os.path.join(root, "examples", "serve_llama.py"),
+         "--max-new-tokens", "8"],
+        capture_output=True, text=True, timeout=420, env=env)
+    assert r.returncode == 0, r.stderr[-1500:]
+    assert "tok/s" in r.stdout
