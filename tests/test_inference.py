@@ -515,3 +515,25 @@ def test_v2_put_many_matches_sequential_put(tiny):
     batch_next = b.step(sp)
     assert batch_first == seq_first
     assert batch_next == seq_next
+
+
+def test_v2_tensor_parallel_matches_single(tiny, devices8):
+    """Continuous batching (incl. batched prefill + fused decode) under a
+    tensor-parallel mesh produces exactly the single-device greedy tokens."""
+    cfg, params = tiny
+    prompts = [np.array([5, 7, 11, 13], np.int32),
+               np.array([2, 3], np.int32)]
+    rc = {"max_tracked_sequences": 4, "max_ragged_batch_size": 4,
+          "memory_config_blocks": 64, "block_size": 16}
+    mesh_lib.set_mesh(None)
+    ref = build_engine_v2(
+        llama, cfg, params,
+        config={"dtype": "float32", "prefill_bucket": 16, "ragged": rc}
+    ).generate(prompts, max_new_tokens=6)
+    mesh_lib.set_mesh(None)
+    got = build_engine_v2(
+        llama, cfg, params,
+        config={"dtype": "float32", "prefill_bucket": 16,
+                "tensor_parallel": {"tp_size": 2}, "ragged": rc}
+    ).generate(prompts, max_new_tokens=6, steps_per_sync=3)
+    assert got == ref
