@@ -16,7 +16,7 @@ so the agent's work is the RESUME protocol:
 
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, Optional, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 

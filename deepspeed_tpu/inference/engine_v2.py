@@ -21,7 +21,7 @@ from ..comm.mesh import MeshManager
 from ..utils.logging import log_dist
 from .config import InferenceConfig
 from .engine import InferenceEngine, ModelFamily, _round_up
-from .ragged import SequenceDescriptor, StateManager
+from .ragged import StateManager
 from .sampling import SamplingParams, sample
 
 
