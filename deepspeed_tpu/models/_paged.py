@@ -2,7 +2,8 @@
 protocol every family's ``apply_paged`` builds on.
 
 Contract (see ``models/llama.py`` for the layout): the KV pool is
-``[num_blocks, block_size, kv_heads, hd]`` per layer, block tables are
+``[num_blocks, kv_heads, block_size, hd]`` per layer (last two dims are the
+decode kernel's per-block tile — TPU tiling legal), block tables are
 fixed-width ``[b, max_blocks]`` indices into the pool, block 0 is the trash
 block that absorbs writes for padded tokens, and ``positions`` are absolute
 token positions (``context_lens + arange(t)``).
@@ -29,14 +30,16 @@ def paged_attention_step(q, k, v, k_cache, v_cache, block_tables,
     Returns (attn_out [b, t, nh, hd], k_cache, v_cache)."""
     b, t = q.shape[0], q.shape[1]
     nkv, hd = k.shape[-2], k.shape[-1]
-    bs = k_cache.shape[1]
+    bs = k_cache.shape[2]
     max_blocks = block_tables.shape[1]
 
     blk_idx = jnp.take_along_axis(block_tables, positions // bs, axis=1)
     blk_idx = jnp.where(valid, blk_idx, 0)
     off = positions % bs
-    k_cache = k_cache.at[blk_idx, off].set(k.astype(k_cache.dtype))
-    v_cache = v_cache.at[blk_idx, off].set(v.astype(v_cache.dtype))
+    # advanced indices (blk_idx, off) straddle the kv-head slice, so the
+    # result dims land in front: [b, t, nkv, hd] — exactly k's layout
+    k_cache = k_cache.at[blk_idx, :, off].set(k.astype(k_cache.dtype))
+    v_cache = v_cache.at[blk_idx, :, off].set(v.astype(v_cache.dtype))
 
     if t == 1 and window is None:
         from ..ops import pallas as _pallas_ops  # noqa: F401 (registers)
@@ -46,8 +49,8 @@ def paged_attention_step(q, k, v, k_cache, v_cache, block_tables,
             q[:, 0], k_cache, v_cache, block_tables, context_lens)[:, None]
     else:
         S = max_blocks * bs
-        kg = k_cache[block_tables].reshape(b, S, nkv, hd)
-        vg = v_cache[block_tables].reshape(b, S, nkv, hd)
+        kg = k_cache[block_tables].swapaxes(2, 3).reshape(b, S, nkv, hd)
+        vg = v_cache[block_tables].swapaxes(2, 3).reshape(b, S, nkv, hd)
         kv_pos = jnp.arange(S)[None, None, None, :]
         q_abs = positions[:, None, :, None]
         mask = kv_pos <= q_abs

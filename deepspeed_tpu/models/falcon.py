@@ -292,7 +292,7 @@ def model_spec(cfg: FalconConfig, compute_dtype=jnp.bfloat16):
 # --------------------------------------------------------------------------- #
 def init_paged_cache(cfg: FalconConfig, num_blocks: int, block_size: int,
                      dtype=jnp.bfloat16) -> Params:
-    shape = (cfg.num_layers, num_blocks, block_size, cfg.num_kv_heads,
+    shape = (cfg.num_layers, num_blocks, cfg.num_kv_heads, block_size,
              cfg.head_size)
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 

@@ -261,7 +261,7 @@ def apply_cached(cfg: GPTConfig, params: Params, tokens: jnp.ndarray,
 # --------------------------------------------------------------------------- #
 def init_paged_cache(cfg: GPTConfig, num_blocks: int, block_size: int,
                      dtype=jnp.bfloat16) -> Params:
-    shape = (cfg.num_layers, num_blocks, block_size, cfg.num_heads,
+    shape = (cfg.num_layers, num_blocks, cfg.num_heads, block_size,
              cfg.head_size)
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 

@@ -437,7 +437,10 @@ def apply_cached(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,
 def init_paged_cache(cfg: LlamaConfig, num_blocks: int, block_size: int,
                      dtype=jnp.bfloat16) -> Params:
     L, nkv, hd = cfg.num_layers, cfg.num_kv_heads, cfg.head_size
-    shape = (L, num_blocks, block_size, nkv, hd)
+    # [*, nkv, block_size, hd]: the decode kernel's per-block tile is then
+    # (block_size, hd) — legal TPU tiling (second-to-last %8; a squeezed kv
+    # head in the last two positions is rejected by the Mosaic lowering)
+    shape = (L, num_blocks, nkv, block_size, hd)
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
 

@@ -3,6 +3,7 @@
 from __future__ import annotations
 
 import jax
+import jax.numpy as jnp
 
 
 def interpret() -> bool:
@@ -17,3 +18,17 @@ def row_block(n_rows: int) -> int:
         if n_rows % b == 0:
             return b
     return 1
+
+
+def pad_rows(x2, multiple: int = 8):
+    """Pad the leading (row) axis up to ``multiple`` and return the original
+    row count. Mosaic rejects blocks whose second-to-last dim is neither %8
+    nor the full array dim, so decode-sized row counts (1..7, odd) must be
+    padded before a row-blocked pallas_call; callers slice the output back
+    with the returned ``n``. Rows are independent in every kernel that uses
+    this (norms, group quantization), so the pad rows are dead compute."""
+    n = x2.shape[0]
+    pad = (-n) % multiple
+    if pad:
+        x2 = jnp.pad(x2, ((0, pad),) + ((0, 0),) * (x2.ndim - 1))
+    return x2, n

@@ -16,7 +16,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 from ..registry import register
-from ._common import interpret as _interpret, row_block as _row_block
+from ._common import (interpret as _interpret, pad_rows as _pad_rows,
+                      row_block as _row_block)
 
 
 # --------------------------------------------------------------------------- #
@@ -30,17 +31,19 @@ def _rms_kernel(x_ref, w_ref, o_ref, *, eps):
 
 
 def _rms_fwd_pallas(x2, w, eps):
-    n, d = x2.shape
-    bn = _row_block(n)
-    return pl.pallas_call(
+    x2, n = _pad_rows(x2)
+    np_, d = x2.shape
+    bn = _row_block(np_)
+    out = pl.pallas_call(
         functools.partial(_rms_kernel, eps=eps),
-        grid=(n // bn,),
+        grid=(np_ // bn,),
         in_specs=[pl.BlockSpec((bn, d), lambda i: (i, 0)),
                   pl.BlockSpec((d,), lambda i: (0,))],
         out_specs=pl.BlockSpec((bn, d), lambda i: (i, 0)),
-        out_shape=jax.ShapeDtypeStruct((n, d), x2.dtype),
+        out_shape=jax.ShapeDtypeStruct((np_, d), x2.dtype),
         interpret=_interpret(),
     )(x2, w)
+    return out[:n]
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
@@ -90,18 +93,20 @@ def _ln_kernel(x_ref, w_ref, b_ref, o_ref, *, eps):
 
 
 def _ln_fwd_pallas(x2, w, b, eps):
-    n, d = x2.shape
-    bn = _row_block(n)
-    return pl.pallas_call(
+    x2, n = _pad_rows(x2)
+    np_, d = x2.shape
+    bn = _row_block(np_)
+    out = pl.pallas_call(
         functools.partial(_ln_kernel, eps=eps),
-        grid=(n // bn,),
+        grid=(np_ // bn,),
         in_specs=[pl.BlockSpec((bn, d), lambda i: (i, 0)),
                   pl.BlockSpec((d,), lambda i: (0,)),
                   pl.BlockSpec((d,), lambda i: (0,))],
         out_specs=pl.BlockSpec((bn, d), lambda i: (i, 0)),
-        out_shape=jax.ShapeDtypeStruct((n, d), x2.dtype),
+        out_shape=jax.ShapeDtypeStruct((np_, d), x2.dtype),
         interpret=_interpret(),
     )(x2, w, b)
+    return out[:n]
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))

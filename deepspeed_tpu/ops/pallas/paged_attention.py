@@ -11,7 +11,10 @@ exactly the live context.
 
 Decode layout: one query token per sequence.
   q            [B, nh, hd]
-  k/v pool     [num_blocks, bs, nkv, hd]   (block 0 = trash block)
+  k/v pool     [num_blocks, nkv, bs, hd]   (block 0 = trash block; kv-head
+               axis ahead of the token axis so the per-block tile is
+               (bs, hd) — a squeezed dim in the last two positions is
+               rejected by the Mosaic TPU lowering's tiling check)
   block_tables [B, max_blocks] int32
   context_lens [B] int32 — tokens ALREADY cached; the current token's K/V
                must be written to the pool before calling (so the effective
@@ -87,7 +90,7 @@ def paged_decode_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
                            scale: float = None) -> jnp.ndarray:
     """See module docstring. Returns [B, nh, hd]."""
     B, nh, hd = q.shape
-    nblocks, bs, nkv, _ = k_pool.shape
+    nblocks, nkv, bs, _ = k_pool.shape
     max_blocks = block_tables.shape[1]
     g = nh // nkv
     gpad = max(8, 1 << (g - 1).bit_length())  # sublane-pad the query group
@@ -107,12 +110,12 @@ def paged_decode_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
                          lambda b, h, j, tables, ctx: (b, h, 0, 0)),
             # the paged read: pool block chosen by the table (trash block 0
             # for out-of-range entries is whatever the table holds there)
-            pl.BlockSpec((None, bs, None, hd),
+            pl.BlockSpec((None, None, bs, hd),
                          lambda b, h, j, tables, ctx: (
-                             jnp.clip(tables[b, j], 0, nblocks - 1), 0, h, 0)),
-            pl.BlockSpec((None, bs, None, hd),
+                             jnp.clip(tables[b, j], 0, nblocks - 1), h, 0, 0)),
+            pl.BlockSpec((None, None, bs, hd),
                          lambda b, h, j, tables, ctx: (
-                             jnp.clip(tables[b, j], 0, nblocks - 1), 0, h, 0)),
+                             jnp.clip(tables[b, j], 0, nblocks - 1), h, 0, 0)),
         ],
         out_specs=pl.BlockSpec((None, None, gpad, hd),
                                lambda b, h, j, tables, ctx: (b, h, 0, 0)),
@@ -140,11 +143,11 @@ def paged_decode_attention_xla(q: jnp.ndarray, k_pool: jnp.ndarray,
     from ..attention import attention_xla
 
     B, nh, hd = q.shape
-    _, bs, nkv, _ = k_pool.shape
+    _, nkv, bs, _ = k_pool.shape
     max_blocks = block_tables.shape[1]
     S = max_blocks * bs
-    kg = k_pool[block_tables].reshape(B, S, nkv, hd)
-    vg = v_pool[block_tables].reshape(B, S, nkv, hd)
+    kg = k_pool[block_tables].swapaxes(2, 3).reshape(B, S, nkv, hd)
+    vg = v_pool[block_tables].swapaxes(2, 3).reshape(B, S, nkv, hd)
     kv_pos = jnp.arange(S)[None, None, None, :]
     mask = kv_pos <= context_lens[:, None, None, None]
     out = attention_xla(q[:, None], kg, vg, causal=False, mask=mask,

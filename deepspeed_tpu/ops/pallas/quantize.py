@@ -22,7 +22,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 from ..registry import register
-from ._common import interpret as _interpret, row_block as _row_block
+from ._common import (interpret as _interpret, pad_rows as _pad_rows,
+                      row_block as _row_block)
 
 
 def _quant_kernel(x_ref, q_ref, s_ref):
@@ -44,37 +45,37 @@ def quantize_int8_pallas(x: jnp.ndarray, group_size: int = 2048):
     """x: any shape with size % group_size == 0 →
     (int8 values same shape, fp32 scales [n_groups])."""
     shape = x.shape
-    x2 = x.reshape(-1, group_size)
-    n = x2.shape[0]
-    bn = _row_block(n)
+    x2, n = _pad_rows(x.reshape(-1, group_size))
+    np_ = x2.shape[0]
+    bn = _row_block(np_)
     q, s = pl.pallas_call(
         _quant_kernel,
-        grid=(n // bn,),
+        grid=(np_ // bn,),
         in_specs=[pl.BlockSpec((bn, group_size), lambda i: (i, 0))],
         out_specs=[pl.BlockSpec((bn, group_size), lambda i: (i, 0)),
                    pl.BlockSpec((bn, 128), lambda i: (i, 0))],
-        out_shape=[jax.ShapeDtypeStruct((n, group_size), jnp.int8),
-                   jax.ShapeDtypeStruct((n, 128), jnp.float32)],
+        out_shape=[jax.ShapeDtypeStruct((np_, group_size), jnp.int8),
+                   jax.ShapeDtypeStruct((np_, 128), jnp.float32)],
         interpret=_interpret(),
     )(x2)
-    return q.reshape(shape), s[:, 0]
+    return q[:n].reshape(shape), s[:n, 0]
 
 
 @register("dequantize_int8", backend="pallas")
 def dequantize_int8_pallas(q: jnp.ndarray, scales: jnp.ndarray,
                            group_size: int = 2048, dtype=jnp.float32):
     shape = q.shape
-    q2 = q.reshape(-1, group_size)
-    n = q2.shape[0]
-    bn = _row_block(n)
-    s2 = jnp.broadcast_to(scales[:, None], (n, 128))
+    q2, n = _pad_rows(q.reshape(-1, group_size))
+    np_ = q2.shape[0]
+    bn = _row_block(np_)
+    s2, _ = _pad_rows(jnp.broadcast_to(scales[:, None], (n, 128)))
     out = pl.pallas_call(
         _dequant_kernel,
-        grid=(n // bn,),
+        grid=(np_ // bn,),
         in_specs=[pl.BlockSpec((bn, group_size), lambda i: (i, 0)),
                   pl.BlockSpec((bn, 128), lambda i: (i, 0))],
         out_specs=pl.BlockSpec((bn, group_size), lambda i: (i, 0)),
-        out_shape=jax.ShapeDtypeStruct((n, group_size), dtype),
+        out_shape=jax.ShapeDtypeStruct((np_, group_size), dtype),
         interpret=_interpret(),
     )(q2, s2)
-    return out.reshape(shape)
+    return out[:n].reshape(shape)

@@ -28,10 +28,10 @@ def _create_logger(name: str = _LOGGER_NAME, level: int = logging.INFO) -> loggi
     lg.setLevel(level)
     lg.propagate = False
     if not lg.handlers:
-        # DSTPU_LOG_STREAM=stderr keeps stdout clean for tools whose stdout
-        # is a machine-readable contract (bench scripts: ONE JSON line)
-        stream = (sys.stderr if os.environ.get("DSTPU_LOG_STREAM") == "stderr"
-                  else sys.stdout)
+        # stderr by default: stdout is a machine-readable contract for the
+        # bench/CLI tools (ONE JSON line) and log lines must never pollute it
+        stream = (sys.stdout if os.environ.get("DSTPU_LOG_STREAM") == "stdout"
+                  else sys.stderr)
         handler = logging.StreamHandler(stream=stream)
         handler.setFormatter(
             logging.Formatter("[%(asctime)s] [%(levelname)s] [%(name)s] %(message)s",

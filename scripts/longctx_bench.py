@@ -18,7 +18,6 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 # stdout carries exactly ONE JSON line; package logs go to stderr
-os.environ.setdefault("DSTPU_LOG_STREAM", "stderr")
 
 RESULT = {"metric": "fpdt_longctx_max_seq", "value": 0, "unit": "tokens",
           "vs_baseline": 0.0, "detail": {}}

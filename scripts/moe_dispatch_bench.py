@@ -22,7 +22,6 @@ import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-os.environ.setdefault("DSTPU_LOG_STREAM", "stderr")
 
 RESULT = {"metric": "moe_dispatch_best_impl", "value": 0.0,
           "unit": "einsum_over_compact_speedup", "vs_baseline": None,

@@ -18,7 +18,6 @@ import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-os.environ.setdefault("DSTPU_LOG_STREAM", "stderr")
 
 RESULT = {"metric": "int8_linear_slowdown_vs_bf16", "value": 0.0,
           "unit": "x", "vs_baseline": None, "detail": {}}

@@ -21,7 +21,6 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 # stdout must carry exactly ONE JSON line; the package logger defaults to
 # stdout, so route it to stderr before any deepspeed_tpu import
 logging.basicConfig(stream=sys.stderr)
-os.environ.setdefault("DSTPU_LOG_STREAM", "stderr")
 
 # vs_baseline is null: FastGen's published rows are 7-70B models on A100
 # clusters — no comparable per-chip 235M row exists to divide by

@@ -99,6 +99,18 @@ class TestNorms:
         np.testing.assert_allclose(layer_norm_pallas(x, w, b),
                                    layer_norm_xla(x, w, b), atol=1e-5, rtol=1e-5)
 
+    @pytest.mark.parametrize("n", [1, 3, 7, 13])
+    def test_odd_row_counts(self, n):
+        """Decode-sized row counts (not %8) ride the pad_rows path — Mosaic
+        rejects row blocks of 1..7, so these shapes must pad and slice back."""
+        x = rand(0, (n, 256))
+        w = 1.0 + 0.1 * rand(1, (256,))
+        b = 0.1 * rand(2, (256,))
+        np.testing.assert_allclose(rms_norm_pallas(x, w), rms_norm_xla(x, w),
+                                   atol=1e-5, rtol=1e-5)
+        np.testing.assert_allclose(layer_norm_pallas(x, w, b),
+                                   layer_norm_xla(x, w, b), atol=1e-5, rtol=1e-5)
+
     def test_layer_norm_grad(self):
         x = rand(0, (16, 128))
         w = 1.0 + 0.1 * rand(1, (128,))
@@ -127,6 +139,19 @@ class TestQuantize:
         np.testing.assert_array_equal(np.asarray(qp), np.asarray(qx))
         np.testing.assert_allclose(sp, sx, rtol=1e-6)
 
+    def test_odd_group_count_roundtrip(self):
+        """Group counts not divisible by 8 pad through pad_rows and slice
+        back — values AND scales must come back at the original count."""
+        x = rand(0, (5 * 256,))
+        q, s = quantize_int8_pallas(x, group_size=256)
+        assert q.shape == x.shape and s.shape == (5,)
+        qx, sx = quantize_int8_xla(x, group_size=256)
+        np.testing.assert_array_equal(np.asarray(q), np.asarray(qx))
+        np.testing.assert_allclose(s, sx, rtol=1e-6)
+        back = dequantize_int8_pallas(q, s, group_size=256)
+        err = jnp.max(jnp.abs(back - x.reshape(back.shape)))
+        assert err <= jnp.max(jnp.abs(x)) / 127.0 + 1e-6
+
     def test_zero_input(self):
         x = jnp.zeros((4, 256))
         q, s = quantize_int8_pallas(x, group_size=256)
@@ -143,15 +168,17 @@ def test_paged_decode_attention_matches_dense():
     rs = np.random.RandomState(0)
     B, nh, nkv, hd, bs, nblocks, max_blocks = 3, 8, 4, 64, 16, 32, 4
     q = jnp.asarray(rs.randn(B, nh, hd).astype(np.float32))
-    kp = jnp.asarray(rs.randn(nblocks, bs, nkv, hd).astype(np.float32))
-    vp = jnp.asarray(rs.randn(nblocks, bs, nkv, hd).astype(np.float32))
+    kp = jnp.asarray(rs.randn(nblocks, nkv, bs, hd).astype(np.float32))
+    vp = jnp.asarray(rs.randn(nblocks, nkv, bs, hd).astype(np.float32))
     tables = jnp.asarray(rs.choice(np.arange(1, nblocks), (B, max_blocks),
                                    replace=False).astype(np.int32))
     ctx = jnp.asarray([5, 30, 63], np.int32)
     out = np.asarray(paged_decode_attention(q, kp, vp, tables, ctx))
 
-    kg = np.asarray(kp)[np.asarray(tables)].reshape(B, max_blocks * bs, nkv, hd)
-    vg = np.asarray(vp)[np.asarray(tables)].reshape(B, max_blocks * bs, nkv, hd)
+    kg = np.asarray(kp)[np.asarray(tables)].swapaxes(2, 3).reshape(
+        B, max_blocks * bs, nkv, hd)
+    vg = np.asarray(vp)[np.asarray(tables)].swapaxes(2, 3).reshape(
+        B, max_blocks * bs, nkv, hd)
     g = nh // nkv
     for b in range(B):
         n = int(ctx[b]) + 1
