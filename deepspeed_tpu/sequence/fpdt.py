@@ -8,19 +8,34 @@ chunks through attention with online-softmax rescaling across chunks, and keep
 only the live chunk's activations in accelerator memory — the reference
 double-buffers KV chunks between GPU and host to reach 2M tokens on 4×A100.
 
-TPU-first redesign: the chunk pipeline is a ``lax.scan`` over query chunks
-with an inner masked pass over KV chunks (flash-style online softmax, shared
-with ring attention's block update) — XLA keeps one chunk's working set live.
-Host residency of the non-live KV chunks is expressed with the remat
-*offload* policy (residuals stream to ``pinned_host`` between forward and
-backward) rather than hand-rolled double buffering — see
-``runtime/activation_checkpointing``. FFN and logits-loss chunking reuse the
-ALST tiled compute (``sequence/tiled.py``), which the reference also does
-conceptually (both are position-wise tilings).
+TPU-first redesign: ONE ``jax.custom_vjp`` over the chunked q/k/v (the analog
+of the reference's hand-written ``autograd.Function``):
+
+- forward: ``lax.scan`` over query chunks; per query chunk, a double-buffered
+  scan over KV chunks runs the Pallas flash FORWARD kernel per (q-chunk,
+  kv-chunk) pair and merges partial outputs with their log-sum-exp stats
+  (``merge(o_a,l_a,o_b,l_b)``) — a softmax decomposition that is exactly full
+  attention. Residuals are O(S): the chunked inputs plus per-chunk
+  ``(out, lse)``.
+- backward: re-streams KV chunks through the Pallas flash BACKWARD kernel
+  with the GLOBAL lse and the merged output — ``p_j = exp(s_j - lse_tot)``
+  gives globally-correct probabilities, so per-pair grads sum to the exact
+  full-attention gradient. The chunk loop is the kernel's own KV-block loop
+  lifted one level, so no [c, c] score tensor is ever saved between forward
+  and backward (the round-3 einsum formulation OOMed at S=128K on v5e: the
+  inner scan's backward stacked per-tick fp32 scores — 16 × 2.1 GB).
+
+``offload_kv`` parks the full (GQA-narrow) K/V in TPU host memory and
+streams one chunk per tick through a true double buffer — the prefetch of
+chunk j+1 is issued before chunk j's matmuls, so DMA overlaps compute; the
+backward re-streams the same way. ``offload`` additionally parks the forward
+residuals (q chunks, per-chunk out/lse) in host memory between forward and
+backward. On CPU the space annotations are no-ops (one memory).
 """
 
 from __future__ import annotations
 
+import functools
 from typing import Optional
 
 import jax
@@ -28,8 +43,213 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..ops.attention import repeat_kv
-from .ring import NEG_INF, _block_attn_update
+from ..ops.pallas.flash_attention import _flash_bwd, _flash_fwd
 from .tiled import tiled_fused_logits_loss, tiled_mlp
+
+NEG_BIG = -1e30
+
+
+def _to_bh(x):
+    """[B, c, H, D] → [B*H, c, D] (the flash kernels' layout)."""
+    B, c, H, D = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(B * H, c, D)
+
+
+def _from_bh(x, B, H):
+    """[B*H, c, D] → [B, c, H, D]."""
+    _, c, D = x.shape
+    return x.reshape(B, H, c, D).transpose(0, 2, 1, 3)
+
+
+def _fetch(buf, idx, offload):
+    """One chunk → device memory (async copy-in on TPU when host-parked)."""
+    blk = lax.dynamic_index_in_dim(buf, idx, 0, keepdims=False)
+    if offload:
+        blk = jax.device_put(blk, jax.memory.Space.Device)
+    return blk
+
+
+def _pair_fwd(q_bh, k_blk, v_blk, diag, causal, scale, H):
+    """Flash forward over one (q-chunk, kv-chunk) pair → (o fp32, lse [BH,c]).
+
+    ``diag`` (traced bool): this is the j == qi diagonal pair, which masks
+    causally; off-diagonal pairs are fully visible (j < qi are the only
+    others that run). q_offset is static in the kernel, so the two cases are
+    two branches of a ``lax.cond`` rather than a traced offset.
+    """
+    kw = _to_bh(repeat_kv(k_blk, H))
+    vw = _to_bh(repeat_kv(v_blk, H))
+
+    def _diag():
+        return _flash_fwd(q_bh, kw, vw, causal=True, scale=scale, q_offset=0)
+
+    def _full():
+        return _flash_fwd(q_bh, kw, vw, causal=False, scale=scale, q_offset=0)
+
+    o_j, lse_j = lax.cond(diag, _diag, _full) if causal else _full()
+    return o_j.astype(jnp.float32), lse_j[..., 0]
+
+
+def _merge(o_run, l_run, o_j, lse_j):
+    """Merge normalized partial attention outputs via their log-sum-exps."""
+    l_new = jnp.logaddexp(l_run, lse_j)
+    w_old = jnp.exp(l_run - l_new)[..., None]
+    w_new = jnp.exp(lse_j - l_new)[..., None]
+    return o_run * w_old + o_j * w_new, l_new
+
+
+def _pair_bwd(q_bh, k_blk, v_blk, o_bh, lse128, do_bh, diag, causal, scale):
+    """Flash backward over one pair with the GLOBAL (merged) lse/out →
+    (dq [BH,c,D] f32, dk/dv narrow [B,c,Hkv,D] f32). See ``_pair_fwd`` for
+    the diag/full branching; ``repeat_kv``'s head widening is inverted by
+    summing each query-head group back onto its KV head."""
+    B, c, Hkv, D = k_blk.shape
+    H = q_bh.shape[0] // B
+    g = H // Hkv
+    kw = _to_bh(repeat_kv(k_blk, H))
+    vw = _to_bh(repeat_kv(v_blk, H))
+
+    def _diag():
+        return _flash_bwd(q_bh, kw, vw, o_bh, lse128, do_bh,
+                          causal=True, scale=scale, q_offset=0)
+
+    def _full():
+        return _flash_bwd(q_bh, kw, vw, o_bh, lse128, do_bh,
+                          causal=False, scale=scale, q_offset=0)
+
+    dq_j, dk_j, dv_j, _ = lax.cond(diag, _diag, _full) if causal else _full()
+
+    def narrow(d_wide_bh):
+        d4 = _from_bh(d_wide_bh.astype(jnp.float32), B, H)  # [B, c, H, D]
+        return d4.reshape(B, c, Hkv, g, D).sum(axis=3)
+
+    return dq_j.astype(jnp.float32), narrow(dk_j), narrow(dv_j)
+
+
+def _prefetch_next(k_t, v_t, k_cur, v_cur, j, qi, chunks, causal, offload_kv):
+    """Issue the NEXT chunk's copy-in — data-independent of the current
+    tick's kernels, so the DMA overlaps compute. Skipped past the last
+    chunk and (under causality) past qi: no wasted transfers. The ONE copy
+    of the double-buffer predicate, shared by forward and backward so the
+    two streams can never desynchronize."""
+    nxt = jnp.minimum(j + 1, chunks - 1)
+    want = j + 1 < chunks
+    if causal:
+        want = jnp.logical_and(want, nxt <= qi)
+    return lax.cond(
+        want, lambda: (_fetch(k_t, nxt, offload_kv),
+                       _fetch(v_t, nxt, offload_kv)),
+        lambda: (k_cur, v_cur))
+
+
+def _fwd_impl(q_t, k_t, v_t, causal, scale, offload_kv):
+    chunks, B, c, H, D = q_t.shape
+
+    def q_chunk(qi, q_blk):
+        q_bh = _to_bh(q_blk)
+        o0 = jnp.zeros((B * H, c, D), jnp.float32)
+        l0 = jnp.full((B * H, c), NEG_BIG, jnp.float32)
+        kv0 = (_fetch(k_t, 0, offload_kv), _fetch(v_t, 0, offload_kv))
+
+        def body(carry, j):
+            o_run, l_run, k_cur, v_cur = carry
+            k_nxt, v_nxt = _prefetch_next(k_t, v_t, k_cur, v_cur, j, qi,
+                                          chunks, causal, offload_kv)
+
+            def compute(ol):
+                o_run, l_run = ol
+                o_j, lse_j = _pair_fwd(q_bh, k_cur, v_cur, j == qi,
+                                       causal, scale, H)
+                return _merge(o_run, l_run, o_j, lse_j)
+
+            if causal:
+                o_run, l_run = lax.cond(j <= qi, compute, lambda ol: ol,
+                                        (o_run, l_run))
+            else:
+                o_run, l_run = compute((o_run, l_run))
+            return (o_run, l_run, k_nxt, v_nxt), None
+
+        (o_run, l_run, _, _), _ = lax.scan(body, (o0, l0) + kv0,
+                                           jnp.arange(chunks))
+        return _from_bh(o_run.astype(q_t.dtype), B, H), l_run
+
+    def outer(carry, blk):
+        qi, q_blk = blk
+        return carry, q_chunk(qi, q_blk)
+
+    _, (o_t, lse_t) = lax.scan(outer, None, (jnp.arange(chunks), q_t))
+    return o_t, lse_t  # [chunks, B, c, H, D], [chunks, B*H, c]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _fpdt_core(q_t, k_t, v_t, causal, scale, offload, offload_kv):
+    o_t, _ = _fwd_impl(q_t, k_t, v_t, causal, scale, offload_kv)
+    return o_t
+
+
+def _fpdt_core_fwd(q_t, k_t, v_t, causal, scale, offload, offload_kv):
+    o_t, lse_t = _fwd_impl(q_t, k_t, v_t, causal, scale, offload_kv)
+    if offload:  # park forward residuals host-side until the backward
+        res = tuple(jax.device_put(x, jax.memory.Space.Host)
+                    for x in (q_t, o_t, lse_t))
+    else:
+        res = (q_t, o_t, lse_t)
+    return o_t, res + (k_t, v_t)
+
+
+def _fpdt_core_bwd(causal, scale, offload, offload_kv, res, do_t):
+    q_t, o_t, lse_t, k_t, v_t = res
+    chunks, B, c, H, D = q_t.shape
+    Hkv = k_t.shape[3]
+
+    dk0 = jnp.zeros((chunks, B, c, Hkv, D), jnp.float32)
+    dv0 = jnp.zeros((chunks, B, c, Hkv, D), jnp.float32)
+
+    def q_chunk_bwd(qi, dk_acc, dv_acc):
+        q_bh = _to_bh(_fetch(q_t, qi, offload))
+        o_bh = _to_bh(_fetch(o_t, qi, offload))
+        do_bh = _to_bh(lax.dynamic_index_in_dim(do_t, qi, 0, keepdims=False))
+        lse_row = _fetch(lse_t, qi, offload)  # [BH, c]
+        lse128 = jnp.broadcast_to(lse_row[..., None], lse_row.shape + (128,))
+        dq0 = jnp.zeros((B * H, c, D), jnp.float32)
+        kv0 = (_fetch(k_t, 0, offload_kv), _fetch(v_t, 0, offload_kv))
+
+        def body(carry, j):
+            dq_run, dk_acc, dv_acc, k_cur, v_cur = carry
+            k_nxt, v_nxt = _prefetch_next(k_t, v_t, k_cur, v_cur, j, qi,
+                                          chunks, causal, offload_kv)
+
+            def compute(args):
+                dq_run, dk_acc, dv_acc = args
+                dq_j, dk_j, dv_j = _pair_bwd(q_bh, k_cur, v_cur, o_bh,
+                                             lse128, do_bh, j == qi,
+                                             causal, scale)
+                dq_run = dq_run + dq_j
+                dk_acc = dk_acc.at[j].add(dk_j)
+                dv_acc = dv_acc.at[j].add(dv_j)
+                return dq_run, dk_acc, dv_acc
+
+            if causal:
+                dq_run, dk_acc, dv_acc = lax.cond(
+                    j <= qi, compute, lambda a: a, (dq_run, dk_acc, dv_acc))
+            else:
+                dq_run, dk_acc, dv_acc = compute((dq_run, dk_acc, dv_acc))
+            return (dq_run, dk_acc, dv_acc, k_nxt, v_nxt), None
+
+        (dq_run, dk_acc, dv_acc, _, _), _ = lax.scan(
+            body, (dq0, dk_acc, dv_acc) + kv0, jnp.arange(chunks))
+        return _from_bh(dq_run, B, H).astype(q_t.dtype), dk_acc, dv_acc
+
+    def outer(carry, qi):
+        dk_acc, dv_acc = carry
+        dq_blk, dk_acc, dv_acc = q_chunk_bwd(qi, dk_acc, dv_acc)
+        return (dk_acc, dv_acc), dq_blk
+
+    (dk_acc, dv_acc), dq_t = lax.scan(outer, (dk0, dv0), jnp.arange(chunks))
+    return dq_t, dk_acc.astype(k_t.dtype), dv_acc.astype(v_t.dtype)
+
+
+_fpdt_core.defvjp(_fpdt_core_fwd, _fpdt_core_bwd)
 
 
 def fpdt_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
@@ -37,28 +257,14 @@ def fpdt_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
                    scale: Optional[float] = None,
                    offload: bool = False,
                    offload_kv: bool = False) -> jnp.ndarray:
-    """Chunked causal attention with online softmax across KV chunks.
+    """Chunked causal attention, exact full-attention semantics.
 
-    q/k/v: [B, S, H, D] (kv may be GQA-narrow). Peak live score tensor is
-    [B, H, S/chunks, S/chunks] instead of [B, H, S, S]. With ``offload=True``
-    the per-chunk bodies run under the host-offload remat policy.
-
-    ``offload_kv`` (opt-in) is the reference's KV
-    host-offload double buffering (``fpdt_layer.py:511``
-    ``_FPDTGPUOffloadingAttentionImpl_``) expressed TPU-first: the FULL K/V
-    tensors are parked in ``Host`` memory space right after the projections
-    (in their GQA-NARROW form — head repetition happens after the fetch, so
-    host bytes and DMA are not inflated by the group factor) and streamed
-    back one chunk per scan tick through a TRUE double buffer: the scan
-    carry holds the current chunk while the next chunk's copy-in is issued
-    at the top of the tick, data-independent of the tick's matmuls, so the
-    scheduler can overlap DMA with compute. The backward recompute
-    re-streams chunks the same way; device-resident KV is O(2·S/chunks)
-    instead of O(S). On CPU the space annotation is a no-op (one memory)."""
-    scale = scale if scale is not None else q.shape[-1] ** -0.5
-    # KV host-parking stays OPT-IN until the S(5)-placement test has run on
-    # real TPU (the memory-space path is numerics-proven but TPU-unprofiled)
-    offload_kv = bool(offload_kv)
+    q/k/v: [B, S, H, D] (kv may be GQA-narrow; head repetition happens on
+    device AFTER the per-chunk fetch, so host bytes and DMA stay narrow).
+    Device-resident KV is O(2·S/chunks) with ``offload_kv``; no score tensor
+    larger than one kernel block ever exists in any pass. See module
+    docstring for the forward/backward structure."""
+    scale = float(scale if scale is not None else q.shape[-1] ** -0.5)
     B, S, H, D = q.shape
     Hkv = k.shape[-2]
     assert S % chunks == 0, f"seq {S} % chunks {chunks} != 0"
@@ -71,87 +277,8 @@ def fpdt_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
         k_t = jax.device_put(k_t, jax.memory.Space.Host)
         v_t = jax.device_put(v_t, jax.memory.Space.Host)
 
-    row = jnp.arange(c)[:, None]
-    col = jnp.arange(c)[None, :]
-
-    def fetch(buf, idx):
-        """One (narrow) KV chunk → device memory (async copy-in on TPU)."""
-        blk = lax.dynamic_index_in_dim(buf, idx, 0, keepdims=False)
-        if offload_kv:
-            blk = jax.device_put(blk, jax.memory.Space.Device)
-        return blk
-
-    def q_chunk_attn(qi, q_blk):
-        """Attend query chunk qi over all (≤qi if causal) KV chunks."""
-        qf = q_blk.astype(jnp.float32)
-        m0 = jnp.full((B, H, c), NEG_INF, jnp.float32)
-        l0 = jnp.zeros((B, H, c), jnp.float32)
-        acc0 = jnp.zeros((B, c, H, D), jnp.float32)
-        # double buffer: chunk 0 is fetched before the loop; each tick
-        # computes with the CARRIED chunk and prefetches the next
-        kv0 = (fetch(k_t, 0), fetch(v_t, 0))
-
-        def kv_body(carry, kj_idx):
-            m, l, acc, k_cur, v_cur = carry
-            # issue the NEXT chunk's copy-in first — no data dependence on
-            # this tick's matmuls, so DMA overlaps compute. The prefetch is
-            # skipped past the last chunk and (under causality) past qi —
-            # no wasted transfers.
-            nxt = jnp.minimum(kj_idx + 1, chunks - 1)
-            want = kj_idx + 1 < chunks
-            if causal:
-                want = jnp.logical_and(want, nxt <= qi)
-            k_nxt, v_nxt = lax.cond(
-                want, lambda: (fetch(k_t, nxt), fetch(v_t, nxt)),
-                lambda: (k_cur, v_cur))
-
-            def update(mla):
-                m, l, acc = mla
-                k_blk = repeat_kv(k_cur, H)  # GQA widen AFTER the fetch
-                v_blk = repeat_kv(v_cur, H)
-                if causal:
-                    # full block if kj < qi, diagonal if ==
-                    diag = kj_idx == qi
-                    mask = jnp.where(diag, row >= col,
-                                     jnp.ones((c, c), bool))
-                else:
-                    mask = None
-                return _block_attn_update(qf, k_blk.astype(jnp.float32),
-                                          v_blk, m, l, acc,
-                                          scale=scale, mask=mask)
-
-            if causal:
-                # strictly-future KV blocks contribute nothing — skip their
-                # matmuls at runtime (shapes stay static under lax.cond)
-                m, l, acc = lax.cond(kj_idx <= qi, update, lambda mla: mla,
-                                     (m, l, acc))
-            else:
-                m, l, acc = update((m, l, acc))
-            return (m, l, acc, k_nxt, v_nxt), None
-
-        (m, l, acc, _, _), _ = lax.scan(
-            kv_body, (m0, l0, acc0) + kv0, jnp.arange(chunks))
-        l = jnp.maximum(l, 1e-20)
-        out = (acc / l.transpose(0, 2, 1)[..., None]).astype(q.dtype)
-        # tag the chunk output so the host-offload remat policy (which
-        # matches names in CHECKPOINT_NAMES) actually parks it in pinned_host
-        from jax.ad_checkpoint import checkpoint_name
-
-        return checkpoint_name(out, "block_out")
-
-    if offload:
-        from ..runtime.activation_checkpointing import checkpointing as ac
-
-        q_chunk_attn = jax.checkpoint(q_chunk_attn,
-                                      policy=ac.get_policy("offload"))
-    else:
-        q_chunk_attn = jax.checkpoint(q_chunk_attn)
-
-    def outer(carry, blk):
-        qi, q_blk = blk
-        return carry, q_chunk_attn(qi, q_blk)
-
-    _, out_t = lax.scan(outer, None, (jnp.arange(chunks), q_t))
+    out_t = _fpdt_core(q_t, k_t, v_t, bool(causal), scale, bool(offload),
+                       bool(offload_kv))
     return out_t.transpose(1, 0, 2, 3, 4).reshape(B, S, H, D)
 
 

@@ -4,18 +4,33 @@ The reference has NO ring attention (its long-context stack is Ulysses a2a +
 FPDT chunking + ALST tiling — SURVEY.md §5.7); on TPU the ICI torus makes a
 ring the idiomatic *additional* option, so this framework provides it
 first-class: KV blocks rotate around the 'seq' axis via ``ppermute`` while
-each rank keeps its query block, with flash-style online-softmax rescaling
-across blocks (the same rescaling FPDT implements for its chunked pipeline,
+each rank keeps its query block, with log-sum-exp merging of per-block flash
+results (the same decomposition FPDT uses for its chunked pipeline,
 ``deepspeed/sequence/fpdt_layer.py`` — cited for capability parity).
 
+Like FPDT, the whole ring is ONE ``jax.custom_vjp``:
+
+- forward: P ``ppermute`` steps; each visiting KV block runs the Pallas flash
+  FORWARD kernel against the resident query block and merges via its lse.
+  KV rotates GQA-NARROW — head widening happens on-device per step, so ICI
+  bytes are not inflated by the group factor.
+- backward: the KV blocks make the same trip again, now accompanied by their
+  dk/dv accumulators: each rank adds its pair-gradient (Pallas flash
+  BACKWARD kernel with the GLOBAL lse) onto the traveling accumulator, and
+  after P rotations every block arrives home carrying its complete gradient.
+  Residuals are O(S/P) per chip — no per-step score tensor is ever saved
+  (plain autodiff through the rotation loop would stack one fp32
+  [B, H, S/P, S/P] score block per step for the backward).
+
 Memory: O(S/P) activations per chip, no S×S materialization. Comm: P-1
-point-to-point KV block transfers per attention, all riding neighbor ICI
-links (vs. Ulysses' global a2a) — the better choice when heads < sp or for
-very long sequences.
+point-to-point KV block transfers per direction per attention, all riding
+neighbor ICI links (vs. Ulysses' global a2a) — the better choice when
+heads < sp or for very long sequences.
 """
 
 from __future__ import annotations
 
+import functools
 from functools import partial
 from typing import Optional
 
@@ -26,78 +41,117 @@ from jax.sharding import PartitionSpec as P
 
 from ..comm import comm as dist
 from ..comm.mesh import BATCH_AXES, get_mesh
-from ..ops.attention import repeat_kv
+from .fpdt import NEG_BIG, _from_bh, _merge, _pair_bwd, _pair_fwd, _to_bh
 
-NEG_INF = -1e30
+NEG_INF = NEG_BIG  # kept for back-compat with older imports
 
 
-def _block_attn_update(q, k, v, m, l, acc, *, scale, mask):
-    """One flash-attention block update with online softmax stats.
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _ring_core(q, k, v, axis, p_size, causal, scale):
+    o, _ = _ring_fwd_impl(q, k, v, axis, p_size, causal, scale)
+    return o
 
-    q: [B, Sq, H, D]; k/v: [B, Skv, H, D]; m/l: [B, H, Sq]; acc: [B, Sq, H, D];
-    mask: [Sq, Skv] boolean (True = attend) or None.
-    """
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
-                   preferred_element_type=jnp.float32) * scale
-    if mask is not None:
-        s = jnp.where(mask[None, None], s, NEG_INF)
-    m_blk = jnp.max(s, axis=-1)                     # [B, H, Sq]
-    m_new = jnp.maximum(m, m_blk)
-    # guard fully-masked rows (m_new == NEG_INF): keep stats unchanged
-    alive = m_new > NEG_INF / 2
-    corr = jnp.where(alive, jnp.exp(m - m_new), 1.0)
-    p = jnp.exp(s - m_new[..., None])
-    p = jnp.where(alive[..., None], p, 0.0)
-    l_new = l * corr + jnp.sum(p, axis=-1)
-    pv = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
-                    preferred_element_type=jnp.float32)
-    acc_new = acc * corr.transpose(0, 2, 1)[..., None] + pv
-    m = jnp.where(alive, m_new, m)
-    return m, l_new, acc_new
+
+def _ring_fwd_impl(q, k, v, axis, p_size, causal, scale):
+    my = lax.axis_index(axis)
+    B, sq, H, D = q.shape
+    q_bh = _to_bh(q)
+    o0 = jnp.zeros((B * H, sq, D), jnp.float32)
+    l0 = jnp.full((B * H, sq), NEG_BIG, jnp.float32)
+    fwd_perm = [(i, (i + 1) % p_size) for i in range(p_size)]
+
+    def step(t, o_run, l_run, kt, vt):
+        src = (my - t) % p_size  # owner of the kv block now held
+
+        def compute(ol):
+            # full block if src < my, diagonal (causal) if src == my
+            o_j, lse_j = _pair_fwd(q_bh, kt, vt, src == my, causal, scale, H)
+            return _merge(ol[0], ol[1], o_j, lse_j)
+
+        if causal:
+            # strictly-future blocks (src > my) contribute nothing — skip
+            # their kernels at runtime; the block still rotates on
+            return lax.cond(src <= my, compute, lambda ol: ol, (o_run, l_run))
+        return compute((o_run, l_run))
+
+    def body(t, carry):
+        o_run, l_run, kt, vt = carry
+        o_run, l_run = step(t, o_run, l_run, kt, vt)
+        kt = lax.ppermute(kt, axis, fwd_perm)
+        vt = lax.ppermute(vt, axis, fwd_perm)
+        return o_run, l_run, kt, vt
+
+    # final step outside the loop: its kv block has no further consumer, so
+    # the last two ppermutes (pure wasted ICI bytes) never happen
+    o_run, l_run, kt, vt = lax.fori_loop(0, p_size - 1, body, (o0, l0, k, v))
+    o_run, l_run = step(p_size - 1, o_run, l_run, kt, vt)
+    return _from_bh(o_run.astype(q.dtype), B, H), l_run
+
+
+def _ring_core_fwd(q, k, v, axis, p_size, causal, scale):
+    o, lse = _ring_fwd_impl(q, k, v, axis, p_size, causal, scale)
+    return o, (q, k, v, o, lse)
+
+
+def _ring_core_bwd(axis, p_size, causal, scale, res, do):
+    q, k, v, o, lse = res
+    my = lax.axis_index(axis)
+    B, sq, H, D = q.shape
+    q_bh, o_bh, do_bh = _to_bh(q), _to_bh(o), _to_bh(do)
+    lse128 = jnp.broadcast_to(lse[..., None], lse.shape + (128,))
+    dq0 = jnp.zeros((B * H, sq, D), jnp.float32)
+    dk0 = jnp.zeros(k.shape, jnp.float32)
+    dv0 = jnp.zeros(v.shape, jnp.float32)
+    fwd_perm = [(i, (i + 1) % p_size) for i in range(p_size)]
+
+    def step(t, dq_run, kt, vt, dk_run, dv_run):
+        src = (my - t) % p_size
+
+        def compute(args):
+            dq_run, dk_run, dv_run = args
+            dq_j, dk_j, dv_j = _pair_bwd(q_bh, kt, vt, o_bh, lse128, do_bh,
+                                         src == my, causal, scale)
+            return dq_run + dq_j, dk_run + dk_j, dv_run + dv_j
+
+        if causal:
+            return lax.cond(src <= my, compute, lambda a: a,
+                            (dq_run, dk_run, dv_run))
+        return compute((dq_run, dk_run, dv_run))
+
+    def body(t, carry):
+        dq_run, kt, vt, dk_run, dv_run = carry
+        dq_run, dk_run, dv_run = step(t, dq_run, kt, vt, dk_run, dv_run)
+        # the dk/dv accumulators TRAVEL with their kv block: after the P-th
+        # rotation each block is home again, carrying its complete gradient
+        kt = lax.ppermute(kt, axis, fwd_perm)
+        vt = lax.ppermute(vt, axis, fwd_perm)
+        dk_run = lax.ppermute(dk_run, axis, fwd_perm)
+        dv_run = lax.ppermute(dv_run, axis, fwd_perm)
+        return dq_run, kt, vt, dk_run, dv_run
+
+    dq_run, kt, vt, dk_run, dv_run = lax.fori_loop(
+        0, p_size - 1, body, (dq0, k, v, dk0, dv0))
+    # final step outside the loop: the kv blocks are done (skip their
+    # rotations), but the accumulators still need the P-th hop to get home
+    dq_run, dk_run, dv_run = step(p_size - 1, dq_run, kt, vt, dk_run, dv_run)
+    dk_run = lax.ppermute(dk_run, axis, fwd_perm)
+    dv_run = lax.ppermute(dv_run, axis, fwd_perm)
+    return (_from_bh(dq_run, B, H).astype(q.dtype),
+            dk_run.astype(k.dtype), dv_run.astype(v.dtype))
+
+
+_ring_core.defvjp(_ring_core_fwd, _ring_core_bwd)
 
 
 def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
                    axis: str = "seq", axis_size: Optional[int] = None,
                    causal: bool = True, scale: Optional[float] = None) -> jnp.ndarray:
     """Call INSIDE shard_map over ``axis``. q/k/v: local blocks [B, S/P, H, D]
-    (kv may have fewer heads — GQA). Returns local output block."""
+    (kv may have fewer heads — GQA; it rotates narrow). Returns local output
+    block."""
     p_size = axis_size if axis_size is not None else dist.axis_size(axis)
-    my = lax.axis_index(axis)
-    scale = scale if scale is not None else q.shape[-1] ** -0.5
-    k = repeat_kv(k, q.shape[-2])
-    v = repeat_kv(v, q.shape[-2])
-
-    b, sq, h, d = q.shape
-    qf = q.astype(jnp.float32)
-    m0 = jnp.full((b, h, sq), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((b, h, sq), jnp.float32)
-    acc0 = jnp.zeros((b, sq, h, d), jnp.float32)
-
-    row = jnp.arange(sq)[:, None]
-    col = jnp.arange(k.shape[1])[None, :]
-    fwd_perm = [(i, (i + 1) % p_size) for i in range(p_size)]
-
-    def body(t, carry):
-        m, l, acc, kt, vt = carry
-        src = (my - t) % p_size          # owner of the kv block now held
-        if causal:
-            # block-level causal: attend fully if src < my, diagonal if ==
-            full = src < my
-            diag = src == my
-            block_mask = jnp.where(diag, row >= col,
-                                   jnp.broadcast_to(full, (sq, k.shape[1])))
-        else:
-            block_mask = None
-        m, l, acc = _block_attn_update(qf, kt.astype(jnp.float32), vt,
-                                       m, l, acc, scale=scale, mask=block_mask)
-        kt = lax.ppermute(kt, axis, fwd_perm)
-        vt = lax.ppermute(vt, axis, fwd_perm)
-        return m, l, acc, kt, vt
-
-    m, l, acc, _, _ = lax.fori_loop(0, p_size, body, (m0, l0, acc0, k, v))
-    l = jnp.maximum(l, 1e-20)
-    out = acc / l.transpose(0, 2, 1)[..., None]
-    return out.astype(q.dtype)
+    scale = float(scale if scale is not None else q.shape[-1] ** -0.5)
+    return _ring_core(q, k, v, axis, int(p_size), bool(causal), scale)
 
 
 def ring_attention_spmd(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,

@@ -71,11 +71,14 @@ def main():
         if not parity_checked:
             # the timing verdict is only meaningful if both paths compute
             # the same function — pin it in f32 (bf16 differs only by
-            # accumulation-order noise, which would mask a real bug)
+            # accumulation-order noise, which would mask a real bug). On TPU
+            # f32 matmuls themselves run as bf16 passes at DEFAULT precision,
+            # so force true-f32 matmuls or the noise floor comes back.
             p32 = jax.tree.map(lambda t: t.astype(jnp.float32), params)
             x32 = x.astype(jnp.float32)
-            a = run("einsum", p32, x32)
-            b = run("compact", p32, x32)
+            with jax.default_matmul_precision("highest"):
+                a = run("einsum", p32, x32)
+                b = run("compact", p32, x32)
             diff = float(jnp.max(jnp.abs(a - b)))
             assert diff < 1e-3, f"einsum/compact diverge: max diff {diff}"
             RESULT["detail"]["parity_max_diff"] = diff

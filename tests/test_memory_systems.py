@@ -426,9 +426,11 @@ def test_engine_nvme_checkpoint_roundtrip(tmp_path, devices8):
     np.testing.assert_allclose(cont, resumed, rtol=1e-3, atol=1e-3)
 
 
-def test_fpdt_offload_kv_numerics_match(devices8):
-    """KV host-parking (offload_kv) is a placement change, not a math change:
-    fwd outputs and input grads must match the on-device path exactly."""
+@pytest.mark.parametrize("flag", ["offload_kv", "offload"])
+def test_fpdt_offload_numerics_match(devices8, flag):
+    """Host-parking (offload_kv: the K/V stream; offload: the forward
+    residuals) is a placement change, not a math change: fwd outputs and
+    input grads must match the on-device path exactly."""
     from deepspeed_tpu.sequence.fpdt import fpdt_attention
 
     B, S, H, Hkv, D = 1, 256, 4, 2, 16  # GQA-narrow KV parks narrow
@@ -437,14 +439,13 @@ def test_fpdt_offload_kv_numerics_match(devices8):
     k = jax.random.normal(ks[1], (B, S, Hkv, D), jnp.float32)
     v = jax.random.normal(ks[2], (B, S, Hkv, D), jnp.float32)
 
-    def loss(q, k, v, offload_kv):
-        out = fpdt_attention(q, k, v, chunks=4, offload_kv=offload_kv)
+    def loss(q, k, v, **kw):
+        out = fpdt_attention(q, k, v, chunks=4, **kw)
         return jnp.sum(out ** 2)
 
-    base = jax.jit(jax.value_and_grad(lambda *a: loss(*a, False),
-                                      argnums=(0, 1, 2)))(q, k, v)
-    host = jax.jit(jax.value_and_grad(lambda *a: loss(*a, True),
-                                      argnums=(0, 1, 2)))(q, k, v)
+    base = jax.jit(jax.value_and_grad(loss, argnums=(0, 1, 2)))(q, k, v)
+    host = jax.jit(jax.value_and_grad(
+        lambda *a: loss(*a, **{flag: True}), argnums=(0, 1, 2)))(q, k, v)
     np.testing.assert_allclose(float(base[0]), float(host[0]), rtol=1e-6)
     for g0, g1 in zip(base[1], host[1]):
         np.testing.assert_allclose(np.asarray(g0), np.asarray(g1),
