@@ -36,7 +36,7 @@ def emit(ok: bool, err: str = ""):
     print(json.dumps(RESULT))
 
 
-def attach_live_evidence():
+def attach_live_evidence(base_dir: str = None):
     """If this run could not reach the TPU but the in-round tunnel watcher
     (scripts/tpu_watch.sh) captured a full TPU bench in an earlier working
     window, embed that capture — clearly labeled with its timestamp — so a
@@ -44,7 +44,7 @@ def attach_live_evidence():
     numbers. The headline value stays the honest current-run number."""
     if "tpu" in str(RESULT["detail"].get("backend", "")):
         return  # live TPU run; nothing to attach
-    here = os.path.dirname(os.path.abspath(__file__))
+    here = base_dir or os.path.dirname(os.path.abspath(__file__))
     for name, key in (("BENCH_TPU_LIVE.json", "tpu_capture"),
                       ("LONGCTX_TPU_LIVE.json", "tpu_longctx_capture"),
                       ("SERVING_TPU_LIVE.json", "tpu_serving_capture"),

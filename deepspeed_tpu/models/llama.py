@@ -55,7 +55,9 @@ class LlamaConfig:
     qk_norm: bool = False         # per-head RMSNorm on q/k pre-rotary (Qwen3)
     remat: bool = False          # jax.checkpoint each block
     remat_policy: str = "none"   # none | full | dots
-    attention_impl: str = "auto"  # auto | xla | ulysses | ring
+    attention_impl: str = "auto"  # auto | xla | ulysses | ring | fpdt | ulysses_fpdt
+    fpdt_chunks: int = 4         # query/KV chunk count for the fpdt impls
+    fpdt_offload_kv: bool = False  # park K/V in host memory between chunks
     use_pipeline: bool = True    # use the pipe mesh axis when present
 
     @property
@@ -182,7 +184,7 @@ def _resolve_attention(cfg: LlamaConfig, in_pipeline: bool = False):
     manual 'pipe' region (nested shard_map / sharding constraints over other
     axes), so that combination is rejected explicitly."""
     impl = cfg.attention_impl
-    if in_pipeline and impl in ("ring", "ulysses"):
+    if in_pipeline and impl in ("ring", "ulysses", "ulysses_fpdt"):
         raise ValueError(
             f"attention_impl='{impl}' cannot run inside pipeline parallelism; "
             "use attention_impl='auto'/'xla' with the pipe axis, or drop the "
@@ -191,6 +193,65 @@ def _resolve_attention(cfg: LlamaConfig, in_pipeline: bool = False):
         from ..sequence.ring import ring_attention_spmd
 
         return ring_attention_spmd
+    if impl in ("fpdt", "ulysses_fpdt"):
+        # the reference's FPDT composition (fpdt_layer.py:972): chunked
+        # flash attention (optionally KV-host-offloaded) as the LOCAL
+        # attention, under the Ulysses a2a when a seq axis is present
+        from ..sequence.fpdt import fpdt_attention
+
+        chunked = partial(fpdt_attention, chunks=cfg.fpdt_chunks,
+                          offload_kv=cfg.fpdt_offload_kv)
+
+        if impl == "fpdt":
+            def chunked_plain(q, k, v, causal=True, **kw):
+                return chunked(q, k, v, causal=causal)
+
+            return chunked_plain
+        import math
+
+        from jax.sharding import PartitionSpec as P
+
+        from ..comm.mesh import BATCH_AXES, get_mesh
+        from ..sequence.layer import head_shard_axes, ulysses_attention
+
+        def chunked_inner(q, k, v, causal=True, **kw):
+            # post-a2a the head dim is sharded per head_shard_axes (the ONE
+            # policy, shared with ulysses' to_heads). Run the chunked
+            # attention under shard_map over those axes: heads are
+            # independent, so each device runs fpdt locally on its head
+            # group — and the Pallas kernels never meet the SPMD partitioner
+            # (a pallas_call under plain jit with sharded operands forces an
+            # involuntary full remat, b/433785288)
+            mm = get_mesh()
+            sp, tp = mm.axis_size("seq"), mm.axis_size("tensor")
+            n = q.shape[-2]
+            axes = head_shard_axes(n, sp=sp, tp=tp)
+            group = tp * sp if "tensor" in axes else sp
+            if n % group != 0:  # uneven heads: ulysses gathered the sequence
+                return chunked(q, k, v, causal=causal)
+            nkv = k.shape[-2]
+            if nkv % group != 0:
+                # GQA-narrow KV can't shard over the head group — widen by
+                # the SMALLEST factor that aligns (full q-width only as a
+                # last resort), keeping the host-offload stream as narrow as
+                # possible (fpdt fetches narrow and widens after)
+                r = group // math.gcd(nkv, group)
+                target = nkv * r
+                if target > n or n % target != 0 or (n // nkv) % r != 0:
+                    target = n
+                from ..ops.attention import repeat_kv
+
+                k, v = repeat_kv(k, target), repeat_kv(v, target)
+            spec = P(BATCH_AXES, None, axes, None)
+            return jax.shard_map(
+                lambda ql, kl, vl: chunked(ql, kl, vl, causal=causal),
+                mesh=mm.mesh, in_specs=(spec, spec, spec), out_specs=spec,
+                check_vma=False)(q, k, v)
+
+        def ulysses_fpdt(q, k, v, **kw):
+            return ulysses_attention(q, k, v, inner=chunked_inner, **kw)
+
+        return ulysses_fpdt
     if impl == "ulysses" or (impl == "auto" and not in_pipeline):
         from ..comm.mesh import get_mesh
 

@@ -34,6 +34,16 @@ def _constraint(x, spec: P):
     return lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
 
 
+def head_shard_axes(n_heads: int, *, sp: int, tp: int,
+                    seq_axis: str = "seq"):
+    """The ONE post-a2a head-sharding policy (shared by ``to_heads`` below
+    and the ulysses_fpdt composition, which must shard_map over the exact
+    same axes or the layouts disagree and the partitioner full-remats)."""
+    if tp > 1 and n_heads % (tp * sp) == 0:
+        return ("tensor", seq_axis)
+    return (seq_axis,)
+
+
 def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
                       inner: Optional[Callable] = None,
                       seq_axis: str = "seq", **kwargs) -> jnp.ndarray:
@@ -72,10 +82,9 @@ def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
     seqlen = q.shape[1]
 
     def to_heads(t):
-        n = t.shape[-2]
-        if tp > 1 and n % (tp * sp) == 0:
-            return _constraint(t, P(BATCH_AXES, None, ("tensor", seq_axis),
-                                    None))
+        axes = head_shard_axes(t.shape[-2], sp=sp, tp=tp, seq_axis=seq_axis)
+        if axes is not None and axes != (seq_axis,):
+            return _constraint(t, P(BATCH_AXES, None, axes, None))
         if tp > 1:
             # GQA-narrow KV: too few heads to absorb 'tensor'. Reshard in
             # two CLEAN steps — all-gather the seq dim off 'tensor', then

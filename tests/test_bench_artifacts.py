@@ -70,28 +70,20 @@ def test_bench_attaches_watcher_captures(tmp_path):
                                   "value": 999.0,
                                   "detail": {"backend": "tpu"}},
     }
-    created = []
+    for name, content in captures.items():
+        with open(os.path.join(tmp_path, name), "w") as f:
+            json.dump(content, f)
+    result = dict(bench.RESULT, detail={"backend": "cpu-degraded"})
+    saved = bench.RESULT
+    bench.RESULT = result
     try:
-        for name, content in captures.items():
-            path = os.path.join(REPO_ROOT, name)
-            assert not os.path.exists(path), f"real capture present: {name}"
-            with open(path, "w") as f:
-                json.dump(content, f)
-            created.append(path)
-        result = dict(bench.RESULT, detail={"backend": "cpu-degraded"})
-        saved = bench.RESULT
-        bench.RESULT = result
-        try:
-            bench.attach_live_evidence()
-        finally:
-            bench.RESULT = saved
-        d = result["detail"]
-        assert d["tpu_capture"]["value"] == 0.5
-        assert d["tpu_longctx_capture"]["value"] == 131072
-        assert d["tpu_serving_capture"]["value"] == 999.0
-        for key in ("tpu_capture", "tpu_longctx_capture",
-                    "tpu_serving_capture"):
-            assert "captured_at_utc" in d[key] and "note" in d[key]
+        bench.attach_live_evidence(base_dir=str(tmp_path))
     finally:
-        for path in created:
-            os.unlink(path)
+        bench.RESULT = saved
+    d = result["detail"]
+    assert d["tpu_capture"]["value"] == 0.5
+    assert d["tpu_longctx_capture"]["value"] == 131072
+    assert d["tpu_serving_capture"]["value"] == 999.0
+    for key in ("tpu_capture", "tpu_longctx_capture",
+                "tpu_serving_capture"):
+        assert "captured_at_utc" in d[key] and "note" in d[key]

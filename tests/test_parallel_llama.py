@@ -50,6 +50,23 @@ def test_ring_attention_llama_matches(devices8):
     np.testing.assert_allclose(plain, ring, rtol=1e-3, atol=1e-4)
 
 
+def test_fpdt_attention_llama_matches(devices8):
+    """attention_impl='fpdt' (chunked local attention, host-KV stream) and
+    'ulysses_fpdt' (the reference FPDT composition: a2a + chunked) train to
+    the same losses as plain attention (reference fpdt_layer.py:972)."""
+    base = {"train_batch_size": 8,
+            "optimizer": {"type": "adamw", "params": {"lr": 5e-3}},
+            "steps_per_print": 0}
+    plain = _run(dict(base), llama.LlamaConfig.tiny(), seed=6)
+    fpdt = _run(dict(base), llama.LlamaConfig.tiny(
+        attention_impl="fpdt", fpdt_chunks=4, fpdt_offload_kv=True), seed=6)
+    np.testing.assert_allclose(plain, fpdt, rtol=1e-3, atol=1e-4)
+    uf_cfg = dict(base, mesh={"data": 2, "seq": 4}, sequence_parallel_size=4)
+    uf = _run(uf_cfg, llama.LlamaConfig.tiny(
+        attention_impl="ulysses_fpdt", fpdt_chunks=2), seed=6)
+    np.testing.assert_allclose(plain, uf, rtol=1e-3, atol=1e-4)
+
+
 def test_pipeline_mesh_llama_matches(devices8):
     mcfg = llama.LlamaConfig.tiny(num_layers=4)
     base = {"train_batch_size": 8,
