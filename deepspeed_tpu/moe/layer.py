@@ -19,7 +19,7 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..comm.mesh import get_mesh
-from .sharded_moe import GatingOutput, top_k_gating
+from .sharded_moe import (GatingOutput, top_k_gating, top_k_gating_compact)
 
 Params = Dict[str, Any]
 
@@ -89,24 +89,39 @@ class MoELayer:
         """x: [batch, seq, hidden] → ([batch, seq, hidden], aux_loss)."""
         b, s, h = x.shape
         tokens = x.reshape(b * s, h)
+        T = tokens.shape[0]
         logits = tokens @ params["router"].astype(tokens.dtype)
-        gating: GatingOutput = top_k_gating(
-            logits, self.top_k, capacity_factor=self.capacity_factor,
-            min_capacity=self.min_capacity, drop_tokens=self.drop_tokens,
-            norm_topk=self.norm_topk)
+        gate_kw = dict(capacity_factor=self.capacity_factor,
+                       min_capacity=self.min_capacity,
+                       drop_tokens=self.drop_tokens,
+                       norm_topk=self.norm_topk)
 
-        # dispatch: [T, E, C] × [T, H] → [E, C, H], then expert-shard (a2a)
+        # dispatch to [E, C, H], then expert-shard (a2a)
         if self.dispatch == "compact":
-            T = tokens.shape[0]
-            occupied = gating.dispatch_mask.any(axis=0)           # [E, C]
-            token_for = jnp.einsum(
-                "tec,t->ec", gating.dispatch_mask.astype(jnp.int32),
-                jnp.arange(T, dtype=jnp.int32))                   # [E, C]
-            token_for = jnp.where(occupied, token_for, T)
+            # O(k·T) end to end: the gating stays compact (no [T, E, C]
+            # tensor ever exists) and the (expert, slot) → token table +
+            # per-slot gate come from two scatters — the computation the
+            # reference's moe_scatter/top_k_gating kernels perform
+            # (inference/v2/kernels/ragged_ops)
+            cg = top_k_gating_compact(logits, self.top_k, **gate_kw)
+            aux_loss = cg.aux_loss
+            E, C = self.n_experts, cg.capacity
+            t_ids = jnp.broadcast_to(
+                jnp.arange(T, dtype=jnp.int32)[:, None], cg.pos.shape)
+            e_flat = jnp.where(cg.keep, cg.topk_idx, E).reshape(-1)
+            p_flat = cg.pos.reshape(-1)
+            # distinct (expert, slot) pairs are unique by construction, so
+            # .set scatters can't collide; dropped entries go out of bounds
+            token_for = jnp.full((E, C), T, jnp.int32).at[
+                e_flat, p_flat].set(t_ids.reshape(-1), mode="drop")
+            w_for = jnp.zeros((E, C), jnp.float32).at[
+                e_flat, p_flat].set(cg.gates.reshape(-1), mode="drop")
             toks_z = jnp.concatenate(
                 [tokens, jnp.zeros((1, h), tokens.dtype)])
             expert_in = toks_z[token_for]                         # gather
         else:
+            gating: GatingOutput = top_k_gating(logits, self.top_k, **gate_kw)
+            aux_loss = gating.aux_loss
             expert_in = jnp.einsum(
                 "tec,th->ech", gating.dispatch_mask.astype(tokens.dtype),
                 tokens)
@@ -125,9 +140,8 @@ class MoELayer:
                                    expert_in)
         expert_out = _expert_constraint(expert_out)
 
-        # combine: [T, E, C] × [E, C, H] → [T, H]  (a2a back)
+        # combine: back to [T, H]  (a2a back)
         if self.dispatch == "compact":
-            w_for = jnp.einsum("tec->ec", gating.combine_weights)  # gate/slot
             out = jnp.zeros_like(tokens).at[token_for.reshape(-1)].add(
                 (expert_out * w_for[..., None].astype(tokens.dtype))
                 .reshape(-1, h), mode="drop")
@@ -143,4 +157,4 @@ class MoELayer:
             shared = (sg * su) @ params["shared_w_down"].astype(tokens.dtype)
             gate = jax.nn.sigmoid(tokens @ params["shared_gate"].astype(tokens.dtype))
             out = out + gate * shared
-        return out.reshape(b, s, h), gating.aux_loss
+        return out.reshape(b, s, h), aux_loss

@@ -28,24 +28,45 @@ class GatingOutput(NamedTuple):
     router_probs: jnp.ndarray      # [tokens, experts]
 
 
+class CompactGating(NamedTuple):
+    """O(k·T) gating result — no [T, E, C] tensor anywhere.
+
+    This is the output shape of the reference's dedicated gating kernels
+    (``inference/v2/kernels/ragged_ops/top_k_gating``: expert assignment +
+    offset per token), and the form the compact dispatch consumes directly.
+    """
+    topk_idx: jnp.ndarray          # [T, k] int32 — chosen expert per level
+    gates: jnp.ndarray             # [T, k] f32 — (renormalized) gate values,
+                                   #   zeroed where keep is False (dropped)
+    pos: jnp.ndarray               # [T, k] int32 — slot within the expert
+    keep: jnp.ndarray              # [T, k] bool — survived capacity
+    capacity: int
+    aux_loss: jnp.ndarray          # scalar load-balancing loss
+    router_probs: jnp.ndarray      # [T, E] f32
+
+
 def compute_capacity(tokens: int, n_experts: int, k: int,
                      capacity_factor: float, min_capacity: int = 4) -> int:
     cap = int(math.ceil(k * tokens * capacity_factor / n_experts))
     return max(cap, min_capacity)
 
 
-def top_k_gating(logits: jnp.ndarray, k: int = 1, *,
-                 capacity_factor: float = 1.0, min_capacity: int = 4,
-                 drop_tokens: bool = True,
-                 norm_topk: bool = True) -> GatingOutput:
-    """logits: [tokens, experts]. Implements the reference's top1/top2/topk
-    gating family as one k-generic routine (drop policy = capacity truncation).
-    ``norm_topk=False`` keeps the raw softmax probs of the selected experts
-    (Qwen2-MoE's norm_topk_prob=False)."""
+def top_k_gating_compact(logits: jnp.ndarray, k: int = 1, *,
+                         capacity_factor: float = 1.0, min_capacity: int = 4,
+                         drop_tokens: bool = True,
+                         norm_topk: bool = True) -> CompactGating:
+    """logits: [tokens, experts] → compact assignment (see CompactGating).
+
+    The reference's top1/top2/topk gating family as one k-generic routine
+    (drop policy = capacity truncation); position assignment is priority by
+    token order within each k-level, levels sequential (reference: top1
+    first). ``norm_topk=False`` keeps the raw softmax probs of the selected
+    experts (Qwen2-MoE's norm_topk_prob=False). Biggest live tensor is the
+    [T, E] cumsum — the dense [T, E, C] view exists only in
+    :func:`top_k_gating` for the einsum dispatch."""
     tokens, n_experts = logits.shape
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
 
-    # top-k expert choice per token
     topk_probs, topk_idx = jax.lax.top_k(probs, k)          # [T, k]
     if norm_topk:
         # renormalize the selected gates (reference top2: gates /= denom)
@@ -54,31 +75,24 @@ def top_k_gating(logits: jnp.ndarray, k: int = 1, *,
     else:
         topk_gates = topk_probs
 
-    capacity = compute_capacity(tokens, n_experts, k, capacity_factor, min_capacity)
+    capacity = compute_capacity(tokens, n_experts, k, capacity_factor,
+                                min_capacity)
     if not drop_tokens:
         capacity = max(capacity, tokens)  # no-drop: every token fits
 
-    # position of each (token, choice) within its expert: priority by token
-    # order within each k-level, k-levels interleaved (reference: top1 first)
-    combine = jnp.zeros((tokens, n_experts, capacity), jnp.float32)
+    pos_levels, keep_levels = [], []
     prior_count = jnp.zeros((n_experts,), jnp.int32)
     for level in range(k):
         idx = topk_idx[:, level]                              # [T]
         onehot = jax.nn.one_hot(idx, n_experts, dtype=jnp.int32)  # [T, E]
         pos_in_level = jnp.cumsum(onehot, axis=0) - onehot        # [T, E]
-        pos = pos_in_level + prior_count[None, :]                 # global position
-        pos_tok = jnp.sum(pos * onehot, axis=-1)                  # [T]
-        keep = pos_tok < capacity
-        gate = topk_gates[:, level] * keep
-        combine = combine + (
-            gate[:, None, None]
-            * jax.nn.one_hot(idx, n_experts, dtype=jnp.float32)[:, :, None]
-            * jax.nn.one_hot(jnp.where(keep, pos_tok, 0), capacity,
-                             dtype=jnp.float32)[:, None, :]
-            * keep[:, None, None])
+        pos_tok = (jnp.take_along_axis(pos_in_level, idx[:, None], 1)[:, 0]
+                   + prior_count[idx])                            # [T]
+        pos_levels.append(pos_tok)
+        keep_levels.append(pos_tok < capacity)
         prior_count = prior_count + jnp.sum(onehot, axis=0)
-
-    dispatch = combine > 0
+    pos = jnp.stack(pos_levels, axis=1)                       # [T, k]
+    keep = jnp.stack(keep_levels, axis=1)                     # [T, k]
 
     # load-balancing aux loss (reference top1gating l_aux): E * Σ_e f_e · P_e
     top1_onehot = jax.nn.one_hot(topk_idx[:, 0], n_experts, dtype=jnp.float32)
@@ -86,5 +100,30 @@ def top_k_gating(logits: jnp.ndarray, k: int = 1, *,
     ce = jnp.mean(top1_onehot, axis=0)      # fraction of tokens per expert
     aux_loss = jnp.sum(me * ce) * n_experts
 
-    return GatingOutput(combine_weights=combine, dispatch_mask=dispatch,
-                        aux_loss=aux_loss, router_probs=probs)
+    return CompactGating(topk_idx=topk_idx, gates=topk_gates * keep,
+                         pos=pos, keep=keep, capacity=capacity,
+                         aux_loss=aux_loss, router_probs=probs)
+
+
+def top_k_gating(logits: jnp.ndarray, k: int = 1, *,
+                 capacity_factor: float = 1.0, min_capacity: int = 4,
+                 drop_tokens: bool = True,
+                 norm_topk: bool = True) -> GatingOutput:
+    """Dense [T, E, C] view of :func:`top_k_gating_compact` — the form the
+    einsum dispatch contracts with (MXU-friendly, but O(T·E·C) memory)."""
+    cg = top_k_gating_compact(logits, k, capacity_factor=capacity_factor,
+                              min_capacity=min_capacity,
+                              drop_tokens=drop_tokens, norm_topk=norm_topk)
+    tokens, n_experts = logits.shape
+    combine = jnp.zeros((tokens, n_experts, cg.capacity), jnp.float32)
+    for level in range(cg.topk_idx.shape[1]):
+        # cg.gates is already keep-masked, and one_hot of an out-of-range
+        # position (dropped: pos >= capacity) is all-zero — no extra guards
+        combine = combine + (
+            cg.gates[:, level][:, None, None]
+            * jax.nn.one_hot(cg.topk_idx[:, level], n_experts,
+                             dtype=jnp.float32)[:, :, None]
+            * jax.nn.one_hot(cg.pos[:, level], cg.capacity,
+                             dtype=jnp.float32)[:, None, :])
+    return GatingOutput(combine_weights=combine, dispatch_mask=combine > 0,
+                        aux_loss=cg.aux_loss, router_probs=cg.router_probs)

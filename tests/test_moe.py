@@ -131,11 +131,19 @@ def test_ep_degree_loss_equivalence(devices8):
     np.testing.assert_allclose(trajs[4], trajs[1], rtol=2e-4, atol=2e-4)
 
 
-def test_moe_dispatch_compact_matches_einsum(devices8):
+@pytest.mark.parametrize("kw", [
+    dict(top_k=2, capacity_factor=2.0),                   # no drops
+    dict(top_k=2, capacity_factor=0.5),                   # heavy dropping
+    dict(top_k=2, capacity_factor=0.5, norm_topk=False),  # Qwen2-MoE gates
+    dict(top_k=2, capacity_factor=0.25, drop_tokens=False),  # no-drop mode
+    dict(top_k=1, capacity_factor=1.0),                   # top-1 (switch)
+])
+def test_moe_dispatch_compact_matches_einsum(devices8, kw):
     """The compact (index-table gather/scatter) dispatch computes the exact
     same function as the dense one-hot einsum dispatch — values AND router
-    gradients — so the backend-dependent choice (moe_dispatch_bench.py) is
-    purely a performance decision."""
+    gradients, across the drop / norm_topk / k branches — so the
+    backend-dependent choice (moe_dispatch_bench.py) is purely a performance
+    decision."""
     from deepspeed_tpu.moe.layer import MoELayer, init_moe_ffn
 
     params = init_moe_ffn(jax.random.PRNGKey(0), n_experts=4, hidden=16,
@@ -143,8 +151,7 @@ def test_moe_dispatch_compact_matches_einsum(devices8):
     x = jax.random.normal(jax.random.PRNGKey(1), (2, 24, 16))
 
     def loss(p, impl):
-        layer = MoELayer(n_experts=4, top_k=2, capacity_factor=2.0,
-                         dispatch=impl)
+        layer = MoELayer(n_experts=4, dispatch=impl, **kw)
         out, aux = layer(p, x)
         return jnp.sum(out ** 2) + aux
 
