@@ -29,6 +29,13 @@ def main():
     if os.environ.get("DSTPU_BENCH_FORCE_CPU"):
         jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
+    try:  # persistent XLA cache: re-runs across tunnel windows skip compiles
+        jax.config.update("jax_compilation_cache_dir", os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            ".xla_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+    except Exception:
+        pass
 
     from deepspeed_tpu.ops.quantization import (dequantize_int8,
                                                 quantize_int8)

@@ -92,6 +92,13 @@ def run_closed_loop(eng, sp, vocab, batch, prompt_len, gen_len, measure_s,
 def main():
     import numpy as np
     import jax
+    try:  # persistent XLA cache: re-runs across tunnel windows skip compiles
+        jax.config.update("jax_compilation_cache_dir", os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            ".xla_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+    except Exception:
+        pass
 
     if os.environ.get("DSTPU_BENCH_FORCE_CPU"):
         # the axon sitecustomize forces jax_platforms=axon,cpu programmatically;
