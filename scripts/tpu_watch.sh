@@ -12,6 +12,11 @@ while true; do
   ts=$(date -u +%Y%m%dT%H%M%SZ)
   if timeout 120 python -c "import jax; assert jax.default_backend()=='tpu', jax.default_backend(); print(jax.devices()[0].device_kind)" > bench_runs/probe.out 2>&1; then
     echo "[watch] $ts TPU ALIVE: $(cat bench_runs/probe.out | tail -1) — running bench" >> "$LOG"
+    # kernel sanity first: fast, and a failure here explains any bench error
+    timeout 900 python scripts/tpu_kernel_sanity.py > "bench_runs/KERNELS_${ts}.json" 2>> "$LOG" \
+      && grep -q '"backend": "tpu"' "bench_runs/KERNELS_${ts}.json" \
+      && cp "bench_runs/KERNELS_${ts}.json" KERNELS_TPU_LIVE.json \
+      && echo "[watch] $ts kernel sanity captured" >> "$LOG"
     # full bench incl. shape rows; generous timeout (first compiles are slow)
     DSTPU_BENCH_SHAPES=1 timeout 3000 python bench.py \
       > "bench_runs/BENCH_tpu_${ts}.json" 2> "bench_runs/bench_${ts}.err"
