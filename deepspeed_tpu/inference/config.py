@@ -50,6 +50,12 @@ class InferenceConfig:
     enable_cuda_graph: bool = False      # accepted for parity; jit caches anyway
     max_batch_size: int = 8
     prefill_bucket: int = 64             # pad prompts to a multiple of this
+    # Dynamic-SplitFuse analog (reference blogs/deepspeed-fastgen: long
+    # prompts decompose into fixed-size chunks scheduled alongside decode):
+    # >0 = tokens per prefill chunk for split-admitted sequences (rounded up
+    # to prefill_bucket); one chunk advances per step()/step_many() call, so
+    # ongoing decodes are never blocked for more than one chunk's compute
+    split_prefill_chunk: int = 0
     ragged: RaggedConfig = field(default_factory=RaggedConfig)
     quant: QuantConfig = field(default_factory=QuantConfig)
 

@@ -56,6 +56,8 @@ class InferenceEngineV2(InferenceEngine):
         self._slot_lens = np.zeros((B,), np.int32)
         self._slot_tables = np.zeros((B, max_blocks_per_seq), np.int32)
         self._slot_active = np.zeros((B,), bool)
+        # uid → (full prompt, SamplingParams from put_split)
+        self._pending_prefill: Dict[int, Tuple] = {}
         log_dist(f"InferenceEngineV2: {rc.memory_config_blocks} blocks × "
                  f"{rc.block_size} tokens, {B} sequence slots")
 
@@ -88,6 +90,90 @@ class InferenceEngineV2(InferenceEngine):
 
             self._paged_fns[key] = jax.jit(prefill, donate_argnums=(1,))
         return self._paged_fns[key]
+
+    def _chunk_prefill_fn(self, chunk_t: int, sp: SamplingParams,
+                          final: bool):
+        """One compiled prefill CHUNK for one sequence at an arbitrary
+        context offset — the Dynamic-SplitFuse unit (reference
+        blogs/deepspeed-fastgen: 'decompose long prompts into chunks').
+        Mid chunks only write KV; the final chunk also samples the first
+        token. One compile per (chunk_t, final, sp)."""
+        key = ("chunk_prefill", chunk_t, sp, final)
+        if key not in self._paged_fns:
+            fam, ap = self.family, self._apply_paged
+
+            def chunk_prefill(params, cache, tokens, n_valid, ctx, table,
+                              rng, uid):
+                # tokens [1, chunk_t]; ctx = tokens already cached
+                valid = (jnp.arange(chunk_t) < n_valid)[None, :]
+                logits, cache = ap(fam.cfg, self._dq(params), tokens, cache,
+                                   table[None], ctx[None], valid=valid)
+                if not final:
+                    return cache
+                last = jnp.take_along_axis(
+                    logits, jnp.maximum(n_valid - 1, 0)[None, None, None],
+                    axis=1)[0, 0]
+                tok = sample(jax.random.fold_in(rng, uid), last, sp)
+                return tok.astype(jnp.int32), cache
+
+            donate = (1,)
+            self._paged_fns[key] = jax.jit(chunk_prefill,
+                                           donate_argnums=donate)
+        return self._paged_fns[key]
+
+    def _advance_prefill(self, seed: int = 0) -> Dict[int, int]:
+        """Advance the OLDEST pending split prefill by one chunk (FIFO, the
+        reference scheduler's arrival order), sampling with the
+        SamplingParams given at put_split time. Returns {uid: first_token}
+        when that chunk completes the prompt, else {}."""
+        if not self._pending_prefill:
+            return {}
+        uid = next(iter(self._pending_prefill))
+        prompt, sp = self._pending_prefill[uid]
+        desc = self.state.seqs[uid]
+        chunk_tokens = _round_up(
+            max(self.config.split_prefill_chunk, 1), self.config.prefill_bucket)
+        done = desc.seen_tokens
+        chunk = prompt[done:done + chunk_tokens]
+        final = done + len(chunk) >= len(prompt)
+        padded = np.zeros((1, chunk_tokens), np.int32)
+        padded[0, :len(chunk)] = chunk
+        table = self.state.block_table(desc)
+        fn = self._chunk_prefill_fn(chunk_tokens, sp, final)
+        args = (self.params, self.cache, jnp.asarray(padded),
+                jnp.asarray(len(chunk), jnp.int32),
+                jnp.asarray(done, jnp.int32), jnp.asarray(table),
+                jax.random.PRNGKey(seed), jnp.asarray(uid, jnp.int32))
+        if not final:
+            self.cache = fn(*args)
+            desc.seen_tokens = done + len(chunk)
+            return {}
+        tok, self.cache = fn(*args)
+        tok = int(tok)
+        del self._pending_prefill[uid]
+        desc.seen_tokens = len(prompt)
+        desc.prefilling = False
+        desc.last_token = tok
+        desc.generated.append(tok)
+        s = desc.slot
+        self._slot_tokens[s] = tok
+        self._slot_lens[s] = desc.seen_tokens
+        self._slot_tables[s] = table
+        self._slot_active[s] = True
+        return {uid: tok}
+
+    def put_split(self, uid: int, prompt_tokens,
+                  sp: SamplingParams = SamplingParams(greedy=True)) -> None:
+        """Admit a sequence WITHOUT prefilling it: the prompt enters the KV
+        cache one chunk per subsequent step()/step_many() call, alongside
+        ongoing decodes — so a long prompt never blocks live sequences for
+        more than one chunk's compute (the FastGen Dynamic-SplitFuse
+        scheduling property). The first sampled token arrives in the step()
+        result that completes the prompt."""
+        prompt = np.asarray(prompt_tokens, np.int32)
+        desc = self.state.admit(uid, len(prompt))
+        desc.prefilling = True
+        self._pending_prefill[uid] = (prompt, sp)
 
     def _decode_fn(self, sp: SamplingParams):
         key = ("decode", sp)
@@ -206,10 +292,15 @@ class InferenceEngineV2(InferenceEngine):
 
     def step(self, sp: SamplingParams = SamplingParams(greedy=True),
              seed: int = 0) -> Dict[int, int]:
-        """One decode step over every live sequence → {uid: next_token}."""
-        live = [d for d in self.state.seqs.values() if not d.finished]
+        """One decode step over every live sequence → {uid: next_token}.
+        Split-admitted sequences advance one prefill chunk first; a sequence
+        whose prompt completes this step contributes its first token."""
+        out = self._advance_prefill(seed)
+        live = [d for d in self.state.seqs.values()
+                if not d.finished and not d.prefilling
+                and d.uid not in out]  # completed-this-step: first token only
         if not live:
-            return {}
+            return out
         for d in live:
             self.state.extend(d)
             self._slot_tables[d.slot] = self.state.block_table(d)
@@ -221,7 +312,6 @@ class InferenceEngineV2(InferenceEngine):
                              jnp.asarray(self._slot_active),
                              jax.random.PRNGKey(seed))
         nxt = np.asarray(nxt)
-        out = {}
         for d in live:
             tok = int(nxt[d.slot])
             d.seen_tokens += 1
@@ -237,17 +327,23 @@ class InferenceEngineV2(InferenceEngine):
         """k decode steps over every live sequence with ONE host sync →
         {uid: [k next tokens]}. Tokens sampled after a sequence's EOS are
         still produced (the caller trims) — the standard multi-step decode
-        trade. k is clamped so no live sequence can run past max_seq_len."""
-        live = [d for d in self.state.seqs.values() if not d.finished]
+        trade. k is clamped so no live sequence can run past max_seq_len.
+        Split-admitted sequences advance one prefill chunk per quantum; a
+        prompt completing here contributes its first token as a 1-list."""
+        first = self._advance_prefill(seed)
+        out: Dict[int, List[int]] = {u: [t] for u, t in first.items()}
+        live = [d for d in self.state.seqs.values()
+                if not d.finished and not d.prefilling
+                and d.uid not in first]
         if not live or k <= 0:
-            return {}
+            return out
         max_seen = max(d.seen_tokens for d in live)
         # a tick at seen writes KV position seen, so seen may reach exactly
         # max_seq_len after the last tick — same boundary as the per-step
         # path (which decodes while seen == max_seq_len - 1)
         k = min(k, self.family.cfg.max_seq_len - max_seen)
         if k <= 0:
-            return {}
+            return out
         for d in live:
             self.state.extend(d, n=k)  # reserve ALL k tokens up front
             self._slot_tables[d.slot] = self.state.block_table(d)
@@ -259,7 +355,6 @@ class InferenceEngineV2(InferenceEngine):
                                     jnp.asarray(self._slot_active),
                                     jax.random.PRNGKey(seed))
         toks = np.asarray(toks)          # [k, B] — the ONLY host sync
-        out: Dict[int, List[int]] = {}
         for d in live:
             seq = [int(t) for t in toks[:, d.slot]]
             d.seen_tokens += k
@@ -273,6 +368,7 @@ class InferenceEngineV2(InferenceEngine):
     def finish(self, uid: int) -> List[int]:
         """Retire a sequence, free its blocks, return generated tokens."""
         desc = self.state.seqs[uid]
+        self._pending_prefill.pop(uid, None)  # cancel an in-flight split
         self._slot_active[desc.slot] = False
         self._slot_lens[desc.slot] = 0
         self._slot_tables[desc.slot] = 0
@@ -312,8 +408,18 @@ class InferenceEngineV2(InferenceEngine):
         step_i = 0
         while pending or self.state.seqs:
             batch_adm = []
+            split = self.config.split_prefill_chunk
+            # a prompt that fits one EFFECTIVE chunk gains nothing from the
+            # split path — keep it in the batched one-shot burst
+            eff_chunk = (_round_up(split, self.config.prefill_bucket)
+                         if split > 0 else 0)
             while pending and self.state.can_admit(len(pending[0][1])):
                 uid, prompt = pending.pop(0)
+                if split > 0 and len(prompt) > eff_chunk:
+                    # SplitFuse path: the prompt enters chunk-by-chunk inside
+                    # the step calls below, never stalling live decodes
+                    self.put_split(uid, prompt, sp)
+                    continue
                 # admit eagerly so can_admit sees each admission's capacity
                 batch_adm.append((uid, prompt,
                                   self.state.admit(uid, len(prompt))))
@@ -328,6 +434,8 @@ class InferenceEngineV2(InferenceEngine):
                 step_i += 1
             for uid in list(self.state.seqs):
                 d = self.state.seqs[uid]
+                if d.prefilling:
+                    continue  # no tokens yet — nothing to retire on
                 if eos_token_id is not None and eos_token_id in d.generated:
                     # trim overshoot past the first EOS (multi-step quantum)
                     d.generated = d.generated[:d.generated.index(eos_token_id) + 1]

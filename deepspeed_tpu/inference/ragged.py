@@ -60,6 +60,7 @@ class SequenceDescriptor:
     last_token: int = 0
     generated: List[int] = dataclasses.field(default_factory=list)
     finished: bool = False
+    prefilling: bool = False       # split prefill in flight — not decodable
 
 
 class StateManager:
