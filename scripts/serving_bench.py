@@ -89,6 +89,56 @@ def run_closed_loop(eng, sp, vocab, batch, prompt_len, gen_len, measure_s,
     return produced / dt, prefills, lat
 
 
+def run_longprompt_probe(build, sp, vocab, rng, batch, short_len, long_len,
+                         chunk, n_steps=24):
+    """Head-of-line blocking (the FastGen Dynamic-SplitFuse motivation):
+    ``batch`` short clients decode steadily; a LONG prompt is admitted
+    mid-stream. Per step-call wall times show how long the live decodes
+    stall — one-shot prefill stalls for the whole prompt, split admission
+    for at most one chunk. Returns {mode: {p50/p95/worst step ms}}."""
+    import numpy as np
+
+    out = {}
+    for split in (0, chunk):
+        eng = build(split)
+        for u in range(batch):
+            eng.put(u, rng.integers(0, vocab, (short_len,),
+                                    dtype=np.int32).tolist(), sp, seed=u)
+        eng.step(sp)  # warm the decode program
+        long_prompt = rng.integers(0, vocab, (long_len,),
+                                   dtype=np.int32).tolist()
+        # warm the admission path's COMPILES outside the measured steps: a
+        # throwaway long sequence runs the one-shot prefill / every chunk
+        # variant once, then retires
+        if split:
+            eng.put_split(9998, long_prompt, sp)
+            while 9998 in eng._pending_prefill:
+                eng.step(sp)
+        else:
+            eng.put(9998, long_prompt, sp, seed=98)
+        eng.finish(9998)
+        if split:
+            eng.put_split(9999, long_prompt, sp)
+        call_ms = []
+        for i in range(n_steps):
+            if not split and i == 2:
+                t0 = time.perf_counter()
+                eng.put(9999, long_prompt, sp, seed=99)
+                call_ms.append((time.perf_counter() - t0) * 1e3)
+            t0 = time.perf_counter()
+            eng.step(sp)
+            call_ms.append((time.perf_counter() - t0) * 1e3)
+        for d in list(eng.state.seqs.values()):
+            eng.finish(d.uid)
+        del eng
+        arr = np.asarray(call_ms)
+        out["split_%d" % split if split else "one_shot"] = {
+            "p50_ms": round(float(np.percentile(arr, 50)), 2),
+            "worst_ms": round(float(arr.max()), 2),
+            "long_len": long_len, "chunk": chunk or long_len}
+    return out
+
+
 def main():
     import numpy as np
     import jax
@@ -159,6 +209,33 @@ def main():
                 del eng  # free HBM before the next configuration
     RESULT["value"] = round(best, 1)
     RESULT["detail"]["rows"] = rows
+
+    # head-of-line probe: long-prompt admission stall, split vs one-shot
+    try:
+        if on_tpu:
+            batch_hl, short_hl, long_hl, chunk_hl = 8, 64, 1536, 256
+        else:
+            batch_hl, short_hl, long_hl, chunk_hl = 4, 16, 96, 32
+        nblocks = (batch_hl + 1) * ((long_hl + 256) // 32 + 3) + 8
+
+        def build(split):
+            return build_engine_v2(
+                llama, mcfg, llama.init(mcfg, jax.random.PRNGKey(0)),
+                config={"dtype": "bfloat16", "prefill_bucket": chunk_hl,
+                        "split_prefill_chunk": split,
+                        "ragged": {"max_tracked_sequences": batch_hl + 1,
+                                   "max_ragged_batch_size": batch_hl + 1,
+                                   "memory_config_blocks": nblocks,
+                                   "block_size": 32}})
+
+        RESULT["detail"]["longprompt_headofline"] = run_longprompt_probe(
+            build, sp, mcfg.vocab_size, rng, batch_hl, short_hl, long_hl,
+            chunk_hl)
+        sys.stderr.write(
+            f"[serving] headofline: "
+            f"{RESULT['detail']['longprompt_headofline']}\n")
+    except Exception as e:
+        RESULT["detail"]["longprompt_headofline"] = f"error: {str(e)[-200:]}"
     RESULT["detail"]["params_m"] = round(mcfg.num_params / 1e6, 1)
     print(json.dumps(RESULT))
 
