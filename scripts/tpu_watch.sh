@@ -8,14 +8,33 @@ mkdir -p bench_runs
 POLL_S=${POLL_S:-480}
 LOG=bench_runs/watch.log
 echo "[watch] start $(date -u +%FT%TZ) poll=${POLL_S}s" >> "$LOG"
+
+promote() {
+  # promote a probe JSON to its *_TPU_LIVE.json slot only if it ran on the
+  # TPU AND measured something (value != 0) — a failed run must never
+  # overwrite or ship as evidence (the raw file stays in bench_runs/)
+  python - "$1" "$2" <<'EOF'
+import json, shutil, sys
+src, dst = sys.argv[1], sys.argv[2]
+try:
+    d = json.loads(open(src).read().strip().splitlines()[-1])
+except Exception:
+    sys.exit(1)
+if "tpu" not in str(d.get("detail", {}).get("backend", "")):
+    sys.exit(1)
+if not d.get("value"):
+    sys.exit(1)
+shutil.copy(src, dst)
+EOF
+}
+
 while true; do
   ts=$(date -u +%Y%m%dT%H%M%SZ)
   if timeout 120 python -c "import jax; assert jax.default_backend()=='tpu', jax.default_backend(); print(jax.devices()[0].device_kind)" > bench_runs/probe.out 2>&1; then
     echo "[watch] $ts TPU ALIVE: $(cat bench_runs/probe.out | tail -1) — running bench" >> "$LOG"
     # kernel sanity first: fast, and a failure here explains any bench error
     timeout 900 python scripts/tpu_kernel_sanity.py > "bench_runs/KERNELS_${ts}.json" 2>> "$LOG" \
-      && grep -q '"backend": "tpu"' "bench_runs/KERNELS_${ts}.json" \
-      && cp "bench_runs/KERNELS_${ts}.json" KERNELS_TPU_LIVE.json \
+      && promote "bench_runs/KERNELS_${ts}.json" KERNELS_TPU_LIVE.json \
       && echo "[watch] $ts kernel sanity captured" >> "$LOG"
     # full bench incl. shape rows; generous timeout (first compiles are slow)
     DSTPU_BENCH_SHAPES=1 timeout 3000 python bench.py \
@@ -23,25 +42,20 @@ while true; do
     rc=$?
     tail -c 300 "bench_runs/BENCH_tpu_${ts}.json" >> "$LOG"
     echo "" >> "$LOG"
-    if [ $rc -eq 0 ] && grep -q '"backend": "tpu"' "bench_runs/BENCH_tpu_${ts}.json"; then
-      cp "bench_runs/BENCH_tpu_${ts}.json" BENCH_TPU_LIVE.json
+    if [ $rc -eq 0 ] && promote "bench_runs/BENCH_tpu_${ts}.json" BENCH_TPU_LIVE.json; then
       echo "[watch] $ts TPU bench CAPTURED -> BENCH_TPU_LIVE.json" >> "$LOG"
       # long-context + serving probes, each best-effort with its own timeout
       timeout 2400 python scripts/longctx_bench.py > "bench_runs/LONGCTX_${ts}.json" 2>> "$LOG" \
-        && grep -q '"backend": "tpu"' "bench_runs/LONGCTX_${ts}.json" \
-        && cp "bench_runs/LONGCTX_${ts}.json" LONGCTX_TPU_LIVE.json \
+        && promote "bench_runs/LONGCTX_${ts}.json" LONGCTX_TPU_LIVE.json \
         && echo "[watch] $ts longctx captured" >> "$LOG"
       timeout 1800 python scripts/serving_bench.py > "bench_runs/SERVING_${ts}.json" 2>> "$LOG" \
-        && grep -q '"backend": "tpu"' "bench_runs/SERVING_${ts}.json" \
-        && cp "bench_runs/SERVING_${ts}.json" SERVING_TPU_LIVE.json \
+        && promote "bench_runs/SERVING_${ts}.json" SERVING_TPU_LIVE.json \
         && echo "[watch] $ts serving captured" >> "$LOG"
       timeout 1200 python scripts/moe_dispatch_bench.py > "bench_runs/MOE_${ts}.json" 2>> "$LOG" \
-        && grep -q '"backend": "tpu"' "bench_runs/MOE_${ts}.json" \
-        && cp "bench_runs/MOE_${ts}.json" MOE_TPU_LIVE.json \
+        && promote "bench_runs/MOE_${ts}.json" MOE_TPU_LIVE.json \
         && echo "[watch] $ts moe dispatch captured" >> "$LOG"
       timeout 1200 python scripts/quant_linear_bench.py > "bench_runs/QUANT_${ts}.json" 2>> "$LOG" \
-        && grep -q '"backend": "tpu"' "bench_runs/QUANT_${ts}.json" \
-        && cp "bench_runs/QUANT_${ts}.json" QUANT_TPU_LIVE.json \
+        && promote "bench_runs/QUANT_${ts}.json" QUANT_TPU_LIVE.json \
         && echo "[watch] $ts quant linear captured" >> "$LOG"
       # after a full capture, slow the poll (evidence is in; re-runs refresh it)
       POLL_S=1800

@@ -103,3 +103,9 @@ def test_examples_run(tmp_path):
         capture_output=True, text=True, timeout=420, env=env)
     assert r.returncode == 0, r.stderr[-1500:]
     assert "tok/s" in r.stdout
+    r = subprocess.run(
+        [sys.executable, os.path.join(root, "examples", "long_context.py"),
+         "--seq", "128", "--steps", "2"],
+        capture_output=True, text=True, timeout=420, env=env)
+    assert r.returncode == 0, r.stderr[-1500:]
+    assert "fpdt train" in r.stdout and "splitfuse serve" in r.stdout
