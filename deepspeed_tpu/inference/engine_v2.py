@@ -22,7 +22,7 @@ from ..utils.logging import log_dist
 from .config import InferenceConfig
 from .engine import InferenceEngine, ModelFamily, _round_up
 from .ragged import StateManager
-from .sampling import SamplingParams, sample
+from .sampling import SamplingParams, sample, sample_batch, sp_arrays
 
 
 class InferenceEngineV2(InferenceEngine):
@@ -56,6 +56,9 @@ class InferenceEngineV2(InferenceEngine):
         self._slot_lens = np.zeros((B,), np.int32)
         self._slot_tables = np.zeros((B, max_blocks_per_seq), np.int32)
         self._slot_active = np.zeros((B,), bool)
+        # per-slot sampling params, recorded at admission — decode honors
+        # these (the reference's v2 engine carries per-request sampling)
+        self._slot_sp: List[SamplingParams] = [SamplingParams(greedy=True)] * B
         # uid → (full prompt, SamplingParams from put_split)
         self._pending_prefill: Dict[int, Tuple] = {}
         log_dist(f"InferenceEngineV2: {rc.memory_config_blocks} blocks × "
@@ -160,6 +163,7 @@ class InferenceEngineV2(InferenceEngine):
         self._slot_lens[s] = desc.seen_tokens
         self._slot_tables[s] = table
         self._slot_active[s] = True
+        self._slot_sp[s] = sp
         return {uid: tok}
 
     def put_split(self, uid: int, prompt_tokens,
@@ -189,6 +193,59 @@ class InferenceEngineV2(InferenceEngine):
 
             self._paged_fns[key] = jax.jit(decode, donate_argnums=(1,))
         return self._paged_fns[key]
+
+    def _decode_dyn_fn(self):
+        """Decode with per-SLOT sampling params as traced arrays — ONE
+        compile serves any mix of client sampling configs."""
+        key = ("decode_dyn",)
+        if key not in self._paged_fns:
+            fam, ap = self.family, self._apply_paged
+
+            def decode(params, cache, tokens, lens, tables, active, rng,
+                       temp, topk, topp, greedy):
+                logits, cache = ap(fam.cfg, self._dq(params), tokens[:, None], cache,
+                                   tables, lens, valid=active[:, None])
+                nxt = sample_batch(rng, logits[:, 0], temp, topk, topp, greedy)
+                return nxt.astype(jnp.int32), cache
+
+            self._paged_fns[key] = jax.jit(decode, donate_argnums=(1,))
+        return self._paged_fns[key]
+
+    def _decode_many_dyn_fn(self, k: int):
+        key = ("decode_many_dyn", k)
+        if key not in self._paged_fns:
+            fam, ap = self.family, self._apply_paged
+
+            def decode_many(params, cache, tokens, lens, tables, active, rng,
+                            temp, topk, topp, greedy):
+                dq = self._dq(params)
+
+                def tick(carry, key_t):
+                    tokens, lens, cache = carry
+                    logits, cache = ap(fam.cfg, dq, tokens[:, None], cache,
+                                       tables, lens, valid=active[:, None])
+                    nxt = sample_batch(key_t, logits[:, 0], temp, topk, topp,
+                                       greedy).astype(jnp.int32)
+                    lens = lens + active.astype(jnp.int32)
+                    return (nxt, lens, cache), nxt
+
+                keys = jax.random.split(rng, k)
+                (tokens, lens, cache), toks = jax.lax.scan(
+                    tick, (tokens, lens, cache), keys)
+                return toks, lens, cache  # toks: [k, B]
+
+            self._paged_fns[key] = jax.jit(decode_many, donate_argnums=(1,))
+        return self._paged_fns[key]
+
+    def _needs_dynamic_sp(self, live) -> bool:
+        """True unless every live sequence is greedy. Greedy batches take
+        the static variant (argmax only — no per-row sort machinery); any
+        stochastic request takes the per-slot-array variant, which compiles
+        ONCE for every sampling-config mix (keying the static variant on a
+        non-greedy sp would compile per distinct client config)."""
+        return not all(self._slot_sp[d.slot].greedy
+                       or self._slot_sp[d.slot].temperature == 0.0
+                       for d in live)
 
     def _decode_many_fn(self, k: int, sp: SamplingParams):
         """k fused decode ticks in ONE compiled program (lax.scan) with a
@@ -287,6 +344,7 @@ class InferenceEngineV2(InferenceEngine):
             self._slot_lens[s] = desc.seen_tokens
             self._slot_tables[s] = tables[i]
             self._slot_active[s] = True
+            self._slot_sp[s] = sp
             out[uid] = tok
         return out
 
@@ -294,7 +352,11 @@ class InferenceEngineV2(InferenceEngine):
              seed: int = 0) -> Dict[int, int]:
         """One decode step over every live sequence → {uid: next_token}.
         Split-admitted sequences advance one prefill chunk first; a sequence
-        whose prompt completes this step contributes its first token."""
+        whose prompt completes this step contributes its first token.
+
+        Sampling uses each sequence's ADMISSION-time params (per-request
+        sampling, like the reference v2 engine); the ``sp`` argument is
+        accepted for backward compatibility and ignored."""
         out = self._advance_prefill(seed)
         live = [d for d in self.state.seqs.values()
                 if not d.finished and not d.prefilling
@@ -304,13 +366,15 @@ class InferenceEngineV2(InferenceEngine):
         for d in live:
             self.state.extend(d)
             self._slot_tables[d.slot] = self.state.block_table(d)
-        fn = self._decode_fn(sp)
-        nxt, self.cache = fn(self.params, self.cache,
-                             jnp.asarray(self._slot_tokens),
-                             jnp.asarray(self._slot_lens),
-                             jnp.asarray(self._slot_tables),
-                             jnp.asarray(self._slot_active),
-                             jax.random.PRNGKey(seed))
+        base = (self.params, self.cache, jnp.asarray(self._slot_tokens),
+                jnp.asarray(self._slot_lens), jnp.asarray(self._slot_tables),
+                jnp.asarray(self._slot_active), jax.random.PRNGKey(seed))
+        if self._needs_dynamic_sp(live):
+            nxt, self.cache = self._decode_dyn_fn()(
+                *base, *map(jnp.asarray, sp_arrays(self._slot_sp)))
+        else:
+            nxt, self.cache = self._decode_fn(
+                SamplingParams(greedy=True))(*base)
         nxt = np.asarray(nxt)
         for d in live:
             tok = int(nxt[d.slot])
@@ -347,13 +411,15 @@ class InferenceEngineV2(InferenceEngine):
         for d in live:
             self.state.extend(d, n=k)  # reserve ALL k tokens up front
             self._slot_tables[d.slot] = self.state.block_table(d)
-        fn = self._decode_many_fn(k, sp)
-        toks, lens, self.cache = fn(self.params, self.cache,
-                                    jnp.asarray(self._slot_tokens),
-                                    jnp.asarray(self._slot_lens),
-                                    jnp.asarray(self._slot_tables),
-                                    jnp.asarray(self._slot_active),
-                                    jax.random.PRNGKey(seed))
+        base = (self.params, self.cache, jnp.asarray(self._slot_tokens),
+                jnp.asarray(self._slot_lens), jnp.asarray(self._slot_tables),
+                jnp.asarray(self._slot_active), jax.random.PRNGKey(seed))
+        if self._needs_dynamic_sp(live):
+            toks, lens, self.cache = self._decode_many_dyn_fn(k)(
+                *base, *map(jnp.asarray, sp_arrays(self._slot_sp)))
+        else:
+            toks, lens, self.cache = self._decode_many_fn(
+                k, SamplingParams(greedy=True))(*base)
         toks = np.asarray(toks)          # [k, B] — the ONLY host sync
         for d in live:
             seq = [int(t) for t in toks[:, d.slot]]
@@ -372,6 +438,7 @@ class InferenceEngineV2(InferenceEngine):
         self._slot_active[desc.slot] = False
         self._slot_lens[desc.slot] = 0
         self._slot_tables[desc.slot] = 0
+        self._slot_sp[desc.slot] = SamplingParams(greedy=True)
         self.state.retire(uid)
         return desc.generated
 

@@ -11,6 +11,7 @@ from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 class SamplingParams(NamedTuple):
@@ -41,3 +42,45 @@ def sample(rng: jax.Array, logits: jnp.ndarray,
                          keepdims=True)
         logits = jnp.where(logits < cutoff, -jnp.inf, logits)
     return jax.random.categorical(rng, logits, axis=-1)
+
+
+def sample_batch(rng: jax.Array, logits: jnp.ndarray,
+                 temperature: jnp.ndarray, top_k: jnp.ndarray,
+                 top_p: jnp.ndarray, greedy: jnp.ndarray) -> jnp.ndarray:
+    """Per-ROW sampling params, all traced: logits [B, V]; temperature/top_p
+    f32 [B]; top_k int32 [B] (0 = disabled); greedy bool [B]. One compiled
+    program serves any mix of client sampling configs (the reference's v2
+    engine carries per-request sampling the same way). Rows with greedy or
+    temperature 0 take the argmax; the rest sample through their own
+    temperature/top-k/top-p filter."""
+    B, V = logits.shape
+    argmax = jnp.argmax(logits, axis=-1)
+    t = jnp.maximum(temperature, 1e-6)[:, None]
+    scaled = logits / t
+    srt = jnp.sort(scaled, axis=-1)[:, ::-1]              # descending
+    # top-k cutoff: the k-th largest per row (k=0 → keep all)
+    k_eff = jnp.where(top_k > 0, jnp.clip(top_k, 1, V), V)
+    kth = jnp.take_along_axis(srt, (k_eff - 1)[:, None], axis=-1)
+    filt = jnp.where(scaled < kth, -jnp.inf, scaled)
+    # top-p AFTER top-k with renormalization, matching `sample`'s sequential
+    # filtering (cutoff on the raw distribution would make a request's
+    # distribution depend on its batch neighbors)
+    col = jax.lax.broadcasted_iota(jnp.int32, (B, V), 1)
+    srt_k = jnp.where(col < k_eff[:, None], srt, -jnp.inf)
+    probs = jax.nn.softmax(srt_k, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep = cum - probs < jnp.minimum(top_p, 1.0)[:, None]  # always keeps #1
+    cutoff = jnp.min(jnp.where(keep, srt_k, jnp.inf), axis=-1, keepdims=True)
+    filt = jnp.where(scaled < cutoff, -jnp.inf, filt)
+    sampled = jax.random.categorical(rng, filt, axis=-1)
+    pick_greedy = jnp.logical_or(greedy, temperature <= 0.0)
+    return jnp.where(pick_greedy, argmax, sampled)
+
+
+def sp_arrays(sps) -> tuple:
+    """Pack a list of SamplingParams into the (temperature, top_k, top_p,
+    greedy) arrays ``sample_batch`` consumes."""
+    return (np.asarray([s.temperature for s in sps], np.float32),
+            np.asarray([s.top_k for s in sps], np.int32),
+            np.asarray([s.top_p for s in sps], np.float32),
+            np.asarray([s.greedy for s in sps], bool))

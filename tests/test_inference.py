@@ -596,3 +596,57 @@ def test_v2_tensor_parallel_matches_single(tiny, devices8):
                 "tensor_parallel": {"tp_size": 2}, "ragged": rc}
     ).generate(prompts, max_new_tokens=6, steps_per_sync=3)
     assert got == ref
+
+
+def test_v2_per_sequence_sampling(tiny):
+    """Per-request sampling params (reference v2 engine): a greedy sequence
+    and a temperature/top-k sequence decode in the SAME batch — the greedy
+    one matches its solo run token-for-token, and the stochastic one only
+    ever emits tokens inside its own top-k set."""
+    cfg, params = tiny
+    mesh_lib.set_mesh(None)
+    base = {"dtype": "float32", "prefill_bucket": 16,
+            "ragged": {"max_tracked_sequences": 4,
+                       "max_ragged_batch_size": 4,
+                       "memory_config_blocks": 64, "block_size": 16}}
+    rng = np.random.default_rng(3)
+    p_greedy = rng.integers(0, cfg.vocab_size, (6,), dtype=np.int32)
+    p_hot = rng.integers(0, cfg.vocab_size, (9,), dtype=np.int32)
+    sp_g = SamplingParams(greedy=True)
+    sp_h = SamplingParams(temperature=0.8, top_k=5)
+
+    solo = build_engine_v2(llama, cfg, params, config=dict(base))
+    solo.put(0, p_greedy.tolist(), sp_g)
+    for i in range(6):
+        solo.step(sp_g, seed=100 + i)
+    ref_greedy = solo.finish(0)
+
+    eng = build_engine_v2(llama, cfg, params, config=dict(base))
+    eng.put(0, p_greedy.tolist(), sp_g)
+    eng.put(1, p_hot.tolist(), sp_h)
+    for i in range(6):
+        eng.step(seed=100 + i)
+    got_greedy = eng.finish(0)
+    got_hot = eng.finish(1)
+    assert got_greedy == ref_greedy  # greedy row unaffected by the neighbor
+
+    # every stochastic token must come from ITS OWN top-5 at that position.
+    # The replay recomputes logits on the DENSE path; the engine sampled on
+    # the paged path, so rank boundaries can flip within numeric noise —
+    # check membership by logit margin, not exact rank (a filterless
+    # sampler over vocab=256 would still fail this overwhelmingly).
+    seq = list(p_hot)
+    for tok in got_hot:
+        logits = np.asarray(llama.apply(
+            cfg, params, jnp.asarray([seq], jnp.int32),
+            compute_dtype=jnp.float32))[0, -1]
+        kth = np.sort(logits)[-5]
+        assert logits[tok] >= kth - 0.05, (tok, logits[tok], kth)
+        seq.append(tok)
+
+    # fused quantum path: same mixed batch through step_many
+    eng2 = build_engine_v2(llama, cfg, params, config=dict(base))
+    eng2.put(0, p_greedy.tolist(), sp_g)
+    eng2.put(1, p_hot.tolist(), sp_h)
+    out = eng2.step_many(6, seed=100)
+    assert out[0] == ref_greedy[1:7]
