@@ -94,6 +94,41 @@ class InferenceEngineV2(InferenceEngine):
             self._paged_fns[key] = jax.jit(prefill, donate_argnums=(1,))
         return self._paged_fns[key]
 
+    @staticmethod
+    def _canon_sp(sp: SamplingParams) -> SamplingParams:
+        """Greedy-equivalent configs (greedy=True, or temperature 0) all
+        canonicalize to ONE params value so they share compiled programs."""
+        if sp.greedy or sp.temperature == 0.0:
+            return SamplingParams(greedy=True)
+        return sp
+
+    def _prefill_dyn_fn(self, pad_t: int, n: int):
+        """Batched prefill with per-ROW sampling params as traced arrays —
+        one compile per (pad_t, n) serves any mix of client configs (the
+        static variant would compile per distinct SamplingParams and break
+        admission bursts into per-config groups)."""
+        key = ("prefill_dyn", pad_t, n)
+        if key not in self._paged_fns:
+            fam, ap = self.family, self._apply_paged
+
+            def prefill(params, cache, tokens, lengths, tables, rng, uids,
+                        temp, topk, topp, greedy):
+                valid = jnp.arange(pad_t)[None, :] < lengths[:, None]
+                logits, cache = ap(fam.cfg, self._dq(params), tokens, cache,
+                                   tables, jnp.zeros((n,), jnp.int32),
+                                   valid=valid)
+                last = jnp.take_along_axis(
+                    logits, jnp.maximum(lengths - 1, 0)[:, None, None],
+                    axis=1)[:, 0]
+                keys = jax.vmap(lambda u: jax.random.fold_in(rng, u))(uids)
+                toks = jax.vmap(lambda k, l, t, tk, tp, g: sample_batch(
+                    k, l[None], t[None], tk[None], tp[None], g[None])[0])(
+                        keys, last, temp, topk, topp, greedy)
+                return toks.astype(jnp.int32), cache
+
+            self._paged_fns[key] = jax.jit(prefill, donate_argnums=(1,))
+        return self._paged_fns[key]
+
     def _chunk_prefill_fn(self, chunk_t: int, sp: SamplingParams,
                           final: bool):
         """One compiled prefill CHUNK for one sequence at an arbitrary
@@ -163,7 +198,7 @@ class InferenceEngineV2(InferenceEngine):
         self._slot_lens[s] = desc.seen_tokens
         self._slot_tables[s] = table
         self._slot_active[s] = True
-        self._slot_sp[s] = sp
+        self._slot_sp[s] = self._canon_sp(sp)
         return {uid: tok}
 
     def put_split(self, uid: int, prompt_tokens,
@@ -304,16 +339,20 @@ class InferenceEngineV2(InferenceEngine):
             for uid, _, _ in entries:
                 self.state.retire(uid)
             raise
-        return self._prefill_admitted(entries, sp, seed)
+        return self._prefill_admitted(entries, [sp] * len(entries), seed)
 
-    def _prefill_admitted(self, entries, sp: SamplingParams,
+    def _prefill_admitted(self, entries, sps,
                           seed: int = 0) -> Dict[int, int]:
         """Batched prefill over already-admitted ``(uid, prompt, desc)``
-        entries (callers admit first so capacity accounting stays exact).
-        The batch pads to a power-of-two row count with masked dummy rows —
-        one compile per (pad_t, bucket), not per burst size."""
+        entries (callers admit first so capacity accounting stays exact),
+        with per-ENTRY sampling params ``sps``. The batch pads to a
+        power-of-two row count with masked dummy rows — one compile per
+        (pad_t, bucket), not per burst size; an all-greedy burst runs the
+        static argmax program, any stochastic entry switches to the
+        per-row-array variant (one compile for every config mix)."""
         if not entries:
             return {}
+        sps = [self._canon_sp(s_) for s_ in sps]
         n = len(entries)
         n_pad = 1 << (n - 1).bit_length()
         pad_t = _round_up(max(max(len(p) for _, p, _ in entries), 1),
@@ -327,11 +366,16 @@ class InferenceEngineV2(InferenceEngine):
             lengths[i] = len(prompt)
             uids_arr[i] = uid
             tables[i] = self.state.block_table(desc)
-        fn = self._prefill_fn(pad_t, sp, n_pad)
-        toks, self.cache = fn(self.params, self.cache, jnp.asarray(padded),
-                              jnp.asarray(lengths), jnp.asarray(tables),
-                              jax.random.PRNGKey(seed),
-                              jnp.asarray(uids_arr))
+        base = (self.params, self.cache, jnp.asarray(padded),
+                jnp.asarray(lengths), jnp.asarray(tables),
+                jax.random.PRNGKey(seed), jnp.asarray(uids_arr))
+        greedy_sp = SamplingParams(greedy=True)
+        if all(s_ == greedy_sp for s_ in sps):
+            toks, self.cache = self._prefill_fn(pad_t, greedy_sp, n_pad)(*base)
+        else:
+            pad_sps = sps + [greedy_sp] * (n_pad - n)  # dummy rows: greedy
+            toks, self.cache = self._prefill_dyn_fn(pad_t, n_pad)(
+                *base, *map(jnp.asarray, sp_arrays(pad_sps)))
         toks = np.asarray(toks)
         out: Dict[int, int] = {}
         for i, (uid, prompt, desc) in enumerate(entries):
@@ -344,7 +388,7 @@ class InferenceEngineV2(InferenceEngine):
             self._slot_lens[s] = desc.seen_tokens
             self._slot_tables[s] = tables[i]
             self._slot_active[s] = True
-            self._slot_sp[s] = sp
+            self._slot_sp[s] = sps[i]
             out[uid] = tok
         return out
 
@@ -446,7 +490,8 @@ class InferenceEngineV2(InferenceEngine):
     def generate(self, prompts, max_new_tokens: int = 64,
                  eos_token_id: Optional[int] = None, seed: int = 0,
                  temperature: float = 0.0, top_k: int = 0, top_p: float = 1.0,
-                 prompt_lengths=None, steps_per_sync: int = 1) -> List[List[int]]:
+                 prompt_lengths=None, steps_per_sync: int = 1,
+                 sampling_params=None) -> List[List[int]]:
         """Continuous-batching driver: admit prompts as capacity allows,
         decode all live sequences each step. Returns generated ids per prompt.
 
@@ -454,9 +499,21 @@ class InferenceEngineV2(InferenceEngine):
         (one host round-trip per quantum instead of per token — the serving
         fast path); admission and EOS retirement happen at quantum
         boundaries, and completions are trimmed to the first EOS exactly as
-        in the per-step path."""
+        in the per-step path.
+
+        ``sampling_params``: optional list of per-PROMPT SamplingParams
+        (overrides the scalar temperature/top_k/top_p args) — each request
+        decodes under its own config in the shared batch."""
         sp = SamplingParams(temperature=temperature, top_k=top_k, top_p=top_p,
                             greedy=temperature == 0.0)
+        if sampling_params is not None:
+            if len(sampling_params) != len(prompts):
+                raise ValueError(
+                    f"{len(sampling_params)} sampling_params for "
+                    f"{len(prompts)} prompts")
+            sp_for = list(sampling_params)
+        else:
+            sp_for = [sp] * len(prompts)
         prompts = [np.asarray(p, np.int32) for p in prompts]
         if prompt_lengths is not None:
             prompts = [p[:n] for p, n in zip(prompts, prompt_lengths)]
@@ -485,13 +542,15 @@ class InferenceEngineV2(InferenceEngine):
                 if split > 0 and len(prompt) > eff_chunk:
                     # SplitFuse path: the prompt enters chunk-by-chunk inside
                     # the step calls below, never stalling live decodes
-                    self.put_split(uid, prompt, sp)
+                    self.put_split(uid, prompt, sp_for[uid])
                     continue
                 # admit eagerly so can_admit sees each admission's capacity
                 batch_adm.append((uid, prompt,
                                   self.state.admit(uid, len(prompt))))
             if batch_adm:  # one compiled prefill for the whole burst
-                self._prefill_admitted(batch_adm, sp, seed=seed)
+                self._prefill_admitted(
+                    batch_adm, [sp_for[uid] for uid, _, _ in batch_adm],
+                    seed=seed)
             if steps_per_sync > 1:
                 k = max(1, min(steps_per_sync, max_new_tokens))
                 self.step_many(k, sp, seed=seed + step_i)

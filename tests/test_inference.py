@@ -650,3 +650,29 @@ def test_v2_per_sequence_sampling(tiny):
     eng2.put(1, p_hot.tolist(), sp_h)
     out = eng2.step_many(6, seed=100)
     assert out[0] == ref_greedy[1:7]
+
+
+def test_v2_generate_per_prompt_sampling(tiny):
+    """generate(sampling_params=[...]) mixes greedy and stochastic requests
+    in one continuous batch; the greedy prompt's output matches an all-
+    greedy generate exactly."""
+    cfg, params = tiny
+    mesh_lib.set_mesh(None)
+    base = {"dtype": "float32", "prefill_bucket": 16,
+            "ragged": {"max_tracked_sequences": 4,
+                       "max_ragged_batch_size": 4,
+                       "memory_config_blocks": 64, "block_size": 16}}
+    rng = np.random.default_rng(11)
+    prompts = [rng.integers(0, cfg.vocab_size, (n,), dtype=np.int32)
+               for n in (7, 12)]
+    ref = build_engine_v2(llama, cfg, params, config=dict(base)) \
+        .generate(prompts, max_new_tokens=5)
+    got = build_engine_v2(llama, cfg, params, config=dict(base)) \
+        .generate(prompts, max_new_tokens=5, sampling_params=[
+            SamplingParams(greedy=True),
+            SamplingParams(temperature=0.9, top_k=4)])
+    assert got[0] == ref[0]          # greedy row unaffected by the neighbor
+    assert len(got[1]) == 5
+    with pytest.raises(ValueError):
+        build_engine_v2(llama, cfg, params, config=dict(base)).generate(
+            prompts, sampling_params=[SamplingParams()])
