@@ -31,7 +31,16 @@ RESULT = {
 def emit(ok: bool, err: str = ""):
     if err:
         RESULT["detail"]["error"] = err[-2000:]
-    RESULT["detail"]["ok"] = ok
+    # a failed subprobe must poison the ok flag (VERDICT r4 item 4b: a
+    # failed decode row shipped inside an ok:true capture) — budget skips
+    # are not failures. ONE failure rule, shared with every probe script.
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "scripts"))
+    from _probe_common import _bad
+    subprobes = {k: RESULT["detail"].get(k)
+                 for k in ("decode_tok_per_sec", "shape_mfu")
+                 if k in RESULT["detail"]}
+    RESULT["detail"]["ok"] = ok and not _bad(subprobes)
     attach_live_evidence()
     print(json.dumps(RESULT))
 

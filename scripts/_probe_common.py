@@ -1,0 +1,48 @@
+"""Shared finalizer for the TPU probe scripts (ONE failure-detection rule).
+
+Round-4 lesson (VERDICT item 4): failed subprobes shipped inside ok-looking
+captures because each consumer scanned for failure strings its own way. Now
+every probe computes ``detail.ok`` itself via this one rule, and the
+watcher's promote() trusts ONLY that flag.
+"""
+
+import json
+import signal
+import sys
+
+
+def _bad(v) -> bool:
+    if isinstance(v, str):
+        low = v.lower()
+        return "error" in low or "fail" in low or "timeout" in low
+    if isinstance(v, dict):
+        return any(_bad(x) for x in v.values())
+    if isinstance(v, (list, tuple)):
+        return any(_bad(x) for x in v)
+    return False
+
+
+def finalize(result: dict, ok=None) -> None:
+    """Set ``detail.ok`` and print the one stdout JSON line.
+
+    ``ok=None`` (the default rule): False if any nested detail string
+    reports an error/failure/timeout — 'skipped: <budget>' rows are not
+    failures. An explicit bool overrides the scan for probes where a
+    failure row is part of a successful run (longctx records its OOM
+    frontier by design)."""
+    result["detail"]["ok"] = (not _bad(result["detail"])) if ok is None \
+        else bool(ok)
+    print(json.dumps(result), flush=True)
+
+
+def install_term_handler(result: dict) -> None:
+    """Emit the partial RESULT (ok=false) on SIGTERM so a watcher-timeout
+    kill still leaves a valid, promotion-rejected artifact instead of an
+    empty file (round 4: 'the gate produced nothing')."""
+
+    def on_term(signum, frame):
+        result["detail"]["interrupted"] = "SIGTERM (watcher timeout)"
+        finalize(result, ok=False)
+        sys.exit(0)
+
+    signal.signal(signal.SIGTERM, on_term)

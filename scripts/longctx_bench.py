@@ -16,6 +16,8 @@ import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _probe_common import finalize, install_term_handler  # noqa: E402
 
 # stdout carries exactly ONE JSON line; package logs go to stderr
 
@@ -32,6 +34,7 @@ def peak_hbm_bytes(dev):
 
 
 def main():
+    install_term_handler(RESULT)
     import jax
 
     if os.environ.get("DSTPU_BENCH_FORCE_CPU"):
@@ -70,6 +73,7 @@ def main():
         return jnp.sum(o.astype(jnp.float32) ** 2)
 
     rows = {}
+    RESULT["detail"]["rows"] = rows
     best = 0
     for S in seqs:
         if time.perf_counter() - t_start > budget_s:
@@ -113,7 +117,10 @@ def main():
     # baseline: reference FPDT reaches 2M tokens on 4 GPUs => 512K/device
     RESULT["vs_baseline"] = round(best / (512 * 1024), 4)
     RESULT["detail"]["rows"] = rows
-    print(json.dumps(RESULT))
+    # explicit ok: hitting the OOM frontier after ≥1 passing size IS a
+    # successful run (value = max proven S); only an immediate first-row
+    # failure (best == 0) means the probe found nothing
+    finalize(RESULT, ok=best > 0)
 
 
 if __name__ == "__main__":
@@ -121,4 +128,4 @@ if __name__ == "__main__":
         main()
     except Exception as e:  # always emit the JSON line
         RESULT["detail"]["error"] = str(e)[-2000:]
-        print(json.dumps(RESULT))
+        finalize(RESULT, ok=False)

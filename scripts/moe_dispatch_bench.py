@@ -22,6 +22,8 @@ import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _probe_common import finalize, install_term_handler  # noqa: E402
 
 RESULT = {"metric": "moe_dispatch_best_impl", "value": 0.0,
           "unit": "einsum_over_compact_speedup", "vs_baseline": None,
@@ -29,6 +31,7 @@ RESULT = {"metric": "moe_dispatch_best_impl", "value": 0.0,
 
 
 def main():
+    install_term_handler(RESULT)
     import jax
 
     if os.environ.get("DSTPU_BENCH_FORCE_CPU"):
@@ -59,6 +62,7 @@ def main():
     mesh_lib.set_mesh(None)  # single-device: measure dispatch, not a2a
 
     rows = {}
+    RESULT["detail"]["rows_ms"] = rows
     parity_checked = False
     for T, H, E, k in shapes:
         params = init_moe_ffn(jax.random.PRNGKey(0), n_experts=E, hidden=H,
@@ -113,7 +117,7 @@ def main():
               if isinstance(r, dict) and "einsum_over_compact" in r]
     if ratios:
         RESULT["value"] = round(sum(ratios) / len(ratios), 3)
-    print(json.dumps(RESULT))
+    finalize(RESULT)
 
 
 if __name__ == "__main__":
@@ -121,4 +125,4 @@ if __name__ == "__main__":
         main()
     except Exception as e:
         RESULT["detail"]["error"] = str(e)[-2000:]
-        print(json.dumps(RESULT))
+        finalize(RESULT, ok=False)

@@ -18,12 +18,15 @@ import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _probe_common import finalize, install_term_handler  # noqa: E402
 
 RESULT = {"metric": "int8_linear_slowdown_vs_bf16", "value": 0.0,
           "unit": "x", "vs_baseline": None, "detail": {}}
 
 
 def main():
+    install_term_handler(RESULT)
     import jax
 
     if os.environ.get("DSTPU_BENCH_FORCE_CPU"):
@@ -60,6 +63,7 @@ def main():
         return x @ w
 
     rows = {}
+    RESULT["detail"]["rows_us"] = rows
     ratios = []
     for M, K, N in shapes:
         key = jax.random.PRNGKey(0)
@@ -86,7 +90,7 @@ def main():
         sys.stderr.write(f"[quant] M{M}_K{K}_N{N}: {row} (us)\n")
     RESULT["value"] = round(sum(ratios) / len(ratios), 3)
     RESULT["detail"]["rows_us"] = rows
-    print(json.dumps(RESULT))
+    finalize(RESULT)
 
 
 if __name__ == "__main__":
@@ -94,4 +98,4 @@ if __name__ == "__main__":
         main()
     except Exception as e:
         RESULT["detail"]["error"] = str(e)[-2000:]
-        print(json.dumps(RESULT))
+        finalize(RESULT, ok=False)

@@ -17,6 +17,8 @@ import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _probe_common import finalize, install_term_handler  # noqa: E402
 
 # stdout must carry exactly ONE JSON line; the package logger defaults to
 # stdout, so route it to stderr before any deepspeed_tpu import
@@ -140,6 +142,7 @@ def run_longprompt_probe(build, sp, vocab, rng, batch, short_len, long_len,
 
 
 def main():
+    install_term_handler(RESULT)
     import numpy as np
     import jax
     try:  # persistent XLA cache: re-runs across tunnel windows skip compiles
@@ -177,6 +180,7 @@ def main():
     rng = np.random.default_rng(0)
     sp = SamplingParams(greedy=True)
     rows = {}
+    RESULT["detail"]["rows"] = rows
     best = 0.0
     for batch in batches:
         for quantum in (1, 8):
@@ -237,7 +241,7 @@ def main():
     except Exception as e:
         RESULT["detail"]["longprompt_headofline"] = f"error: {str(e)[-200:]}"
     RESULT["detail"]["params_m"] = round(mcfg.num_params / 1e6, 1)
-    print(json.dumps(RESULT))
+    finalize(RESULT)
 
 
 if __name__ == "__main__":
@@ -245,4 +249,4 @@ if __name__ == "__main__":
         main()
     except Exception as e:
         RESULT["detail"]["error"] = str(e)[-2000:]
-        print(json.dumps(RESULT))
+        finalize(RESULT, ok=False)

@@ -1,18 +1,30 @@
 #!/bin/bash
 # Tunnel watcher: probe the TPU every POLL_S seconds; in any working window,
-# run the full bench (headline + 8B-class shape rows + decode) and save
-# timestamped evidence under bench_runs/. Runs for the whole round in the
-# background so no tunnel window is missed (PERF.md: windows are short).
+# serially capture kernel-sanity -> headline bench -> longctx -> serving ->
+# MoE -> quant as timestamped evidence under bench_runs/, promoting each
+# successful TPU-backed run to its *_TPU_LIVE.json slot. Runs for the whole
+# round in the background so no tunnel window is missed (PERF.md: windows
+# are short; the chip is exclusive-access so everything here is serial).
+#
+# Round-5 hardening (VERDICT item 4):
+#  - kernel-sanity ALWAYS leaves an artifact and a log line, pass or fail;
+#  - every sub-bench runs even if the headline bench fails (independent
+#    evidence, and the serving/longctx probes are this round's target);
+#  - a bench_runs/BUSY marker is held while the chip is in use so
+#    interactive debugging sessions can coordinate (exclusive-access chip).
 cd /root/repo
 mkdir -p bench_runs
 POLL_S=${POLL_S:-480}
 LOG=bench_runs/watch.log
-echo "[watch] start $(date -u +%FT%TZ) poll=${POLL_S}s" >> "$LOG"
+rm -f bench_runs/BUSY            # a killed predecessor may have left one
+trap 'rm -f bench_runs/BUSY' EXIT
+echo "[watch] start $(date -u +%FT%TZ) poll=${POLL_S}s pid=$$" >> "$LOG"
 
 promote() {
   # promote a probe JSON to its *_TPU_LIVE.json slot only if it ran on the
-  # TPU AND measured something (value != 0) — a failed run must never
-  # overwrite or ship as evidence (the raw file stays in bench_runs/)
+  # TPU, measured something (value != 0), and self-reports detail.ok=true
+  # (every probe computes ok via scripts/_probe_common.py — ONE failure
+  # rule, no per-consumer string scanning). Raw files stay in bench_runs/.
   python - "$1" "$2" <<'EOF'
 import json, shutil, sys
 src, dst = sys.argv[1], sys.argv[2]
@@ -24,45 +36,90 @@ if "tpu" not in str(d.get("detail", {}).get("backend", "")):
     sys.exit(1)
 if not d.get("value"):
     sys.exit(1)
+if d.get("detail", {}).get("ok") is not True:
+    sys.exit(1)
 shutil.copy(src, dst)
 EOF
 }
 
+hold_requested() {
+  if [ -e bench_runs/HOLD ]; then
+    # skipped probes mean this cycle did NOT capture everything — stay on
+    # the fast poll
+    CYCLE_OK=0
+    echo "[watch] $(date -u +%Y%m%dT%H%M%SZ) HOLD honored mid-cycle" >> "$LOG"
+    return 0
+  fi
+  return 1
+}
+
+run_probe() {
+  # run_probe NAME SCRIPT TIMEOUT LIVE_SLOT — sets CYCLE_OK=0 on failure
+  local name=$1 script=$2 tmo=$3 live=$4 ts rc
+  ts=$(date -u +%Y%m%dT%H%M%SZ)
+  # -k 120: TERM first (the probes' handlers emit partial artifacts), KILL
+  # 120s later if the process is wedged inside a native compile
+  timeout -k 120 "$tmo" python "$script" > "bench_runs/${name}_${ts}.json" 2>> "$LOG"
+  rc=$?
+  if promote "bench_runs/${name}_${ts}.json" "${live}"; then
+    echo "[watch] $ts ${name} CAPTURED -> ${live}" >> "$LOG"
+  else
+    CYCLE_OK=0
+    echo "[watch] $ts ${name} rc=$rc NOT promoted: $(tail -c 200 bench_runs/${name}_${ts}.json | tr '\n' ' ')" >> "$LOG"
+  fi
+}
+
 while true; do
   ts=$(date -u +%Y%m%dT%H%M%SZ)
-  if timeout 120 python -c "import jax; assert jax.default_backend()=='tpu', jax.default_backend(); print(jax.devices()[0].device_kind)" > bench_runs/probe.out 2>&1; then
-    echo "[watch] $ts TPU ALIVE: $(cat bench_runs/probe.out | tail -1) — running bench" >> "$LOG"
-    # kernel sanity first: fast, and a failure here explains any bench error
-    timeout 900 python scripts/tpu_kernel_sanity.py > "bench_runs/KERNELS_${ts}.json" 2>> "$LOG" \
-      && promote "bench_runs/KERNELS_${ts}.json" KERNELS_TPU_LIVE.json \
-      && echo "[watch] $ts kernel sanity captured" >> "$LOG"
-    # full bench incl. shape rows; generous timeout (first compiles are slow)
-    DSTPU_BENCH_SHAPES=1 timeout 3000 python bench.py \
-      > "bench_runs/BENCH_tpu_${ts}.json" 2> "bench_runs/bench_${ts}.err"
-    rc=$?
-    tail -c 300 "bench_runs/BENCH_tpu_${ts}.json" >> "$LOG"
-    echo "" >> "$LOG"
-    if [ $rc -eq 0 ] && promote "bench_runs/BENCH_tpu_${ts}.json" BENCH_TPU_LIVE.json; then
-      echo "[watch] $ts TPU bench CAPTURED -> BENCH_TPU_LIVE.json" >> "$LOG"
-      # long-context + serving probes, each best-effort with its own timeout
-      timeout 2400 python scripts/longctx_bench.py > "bench_runs/LONGCTX_${ts}.json" 2>> "$LOG" \
-        && promote "bench_runs/LONGCTX_${ts}.json" LONGCTX_TPU_LIVE.json \
-        && echo "[watch] $ts longctx captured" >> "$LOG"
-      timeout 1800 python scripts/serving_bench.py > "bench_runs/SERVING_${ts}.json" 2>> "$LOG" \
-        && promote "bench_runs/SERVING_${ts}.json" SERVING_TPU_LIVE.json \
-        && echo "[watch] $ts serving captured" >> "$LOG"
-      timeout 1200 python scripts/moe_dispatch_bench.py > "bench_runs/MOE_${ts}.json" 2>> "$LOG" \
-        && promote "bench_runs/MOE_${ts}.json" MOE_TPU_LIVE.json \
-        && echo "[watch] $ts moe dispatch captured" >> "$LOG"
-      timeout 1200 python scripts/quant_linear_bench.py > "bench_runs/QUANT_${ts}.json" 2>> "$LOG" \
-        && promote "bench_runs/QUANT_${ts}.json" QUANT_TPU_LIVE.json \
-        && echo "[watch] $ts quant linear captured" >> "$LOG"
-      # after a full capture, slow the poll (evidence is in; re-runs refresh it)
+  if [ -e bench_runs/HOLD ]; then
+    # an interactive session asked for the chip — skip this cycle entirely
+    echo "[watch] $ts HOLD present, skipping cycle" >> "$LOG"
+    sleep 60
+    continue
+  fi
+  # BUSY covers the alive-probe too: the probe itself attaches to the
+  # exclusive-access chip, so an interactive session must see BUSY first
+  touch bench_runs/BUSY
+  if timeout -k 60 120 python -c "import jax; assert jax.default_backend()=='tpu', jax.default_backend(); print(jax.devices()[0].device_kind)" > bench_runs/probe.out 2>&1; then
+    echo "[watch] $ts TPU ALIVE: $(tail -1 bench_runs/probe.out) — capturing" >> "$LOG"
+    CYCLE_OK=1
+    # kernel sanity first: fast, and a failure here explains any bench error.
+    # Artifact + log line are unconditional (round-4 gate produced nothing);
+    # 1800s: the fpdt-128K AOT compile check can be multi-minute cold.
+    run_probe KERNELS scripts/tpu_kernel_sanity.py 1800 KERNELS_TPU_LIVE.json
+    # full headline bench incl. shape rows (first compiles are slow)
+    if ! hold_requested; then
+      bts=$(date -u +%Y%m%dT%H%M%SZ)
+      DSTPU_BENCH_SHAPES=1 timeout -k 120 3000 python bench.py \
+        > "bench_runs/BENCH_tpu_${bts}.json" 2> "bench_runs/bench_${bts}.err"
+      rc=$?
+      tail -c 300 "bench_runs/BENCH_tpu_${bts}.json" >> "$LOG"
+      echo "" >> "$LOG"
+      if [ $rc -eq 0 ] && promote "bench_runs/BENCH_tpu_${bts}.json" BENCH_TPU_LIVE.json; then
+        echo "[watch] $bts TPU bench CAPTURED -> BENCH_TPU_LIVE.json" >> "$LOG"
+      else
+        CYCLE_OK=0
+        echo "[watch] $bts bench rc=$rc NOT promoted" >> "$LOG"
+      fi
+    fi
+    # sub-benches run regardless of headline outcome — independent evidence;
+    # each checks for a mid-cycle HOLD so an interactive session waits at
+    # most one probe, not the whole cycle
+    hold_requested || run_probe LONGCTX scripts/longctx_bench.py 2400 LONGCTX_TPU_LIVE.json
+    hold_requested || run_probe SERVING scripts/serving_bench.py 1800 SERVING_TPU_LIVE.json
+    hold_requested || run_probe MOE scripts/moe_dispatch_bench.py 1200 MOE_TPU_LIVE.json
+    hold_requested || run_probe QUANT scripts/quant_linear_bench.py 1200 QUANT_TPU_LIVE.json
+    rm -f bench_runs/BUSY
+    # only when THIS cycle promoted every probe (incl. the headline bench)
+    # does the poll slow down; any failure keeps probing fast so a fix
+    # gets its evidence in the same window
+    if [ "$CYCLE_OK" = "1" ]; then
       POLL_S=1800
     else
-      echo "[watch] $ts bench rc=$rc (window may have closed mid-run)" >> "$LOG"
+      POLL_S=480
     fi
   else
+    rm -f bench_runs/BUSY
     echo "[watch] $ts tunnel down: $(tail -c 120 bench_runs/probe.out | tr '\n' ' ')" >> "$LOG"
   fi
   sleep "$POLL_S"

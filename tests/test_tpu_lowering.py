@@ -1,0 +1,158 @@
+"""TPU-lowering regression gates that run WITHOUT a chip.
+
+Round 4's three silicon failures (VERDICT r4 items 1-3) were all invisible
+to CPU interpret-mode tests: Mosaic's BlockSpec/tiling validation and XLA's
+TPU buffer assignment only run on the real lowering path. Two of the three
+failure classes ARE reproducible host-side:
+
+1. Mosaic BlockSpec legality — ``jax.export`` with ``platforms=["tpu"]``
+   runs the full Pallas→Mosaic lowering (including
+   ``_check_block_mappings``) on a CPU host. The round-4 serving failure
+   (squeezed kv-head dim in the paged-KV block at pool sizes 192/376/744,
+   ``bench_runs/SERVING_20260731T034754Z.json``) fails this export; the
+   fixed ``[blocks, kv_heads, block_size, hd]`` layout passes.
+2. Dense-score materialization — the round-4 FPDT lowering allocated a
+   32 GiB per-chunk score temp at S=131K (``LONGCTX_20260731T042825Z``).
+   Walking the traced jaxpr bounds every intermediate's size: the flash-VJP
+   formulation keeps all avals O(chunk), a dense [c, c] score tensor shows
+   up as a huge aval long before any compile.
+
+(The third class — numeric divergence from bf16-matmul default precision —
+is chip-only; ``scripts/tpu_kernel_sanity.py`` pins it per window.)
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import export
+
+from deepspeed_tpu.ops.pallas import paged_attention as pa
+
+
+@pytest.fixture
+def mosaic_lowering(monkeypatch):
+    """Force the real Mosaic lowering path (interpret=False) for kernels
+    that bind ``_interpret`` at import time."""
+    monkeypatch.setattr(pa, "_interpret", lambda: False)
+
+
+# serving geometries from scripts/serving_bench.py: 8/16/32 clients at
+# prompt 512 + gen 128, block_size 32 — the exact pool sizes that failed
+SERVING_POOLS = [(8, 192), (16, 376), (32, 744)]
+
+
+@pytest.mark.parametrize("B,nblocks", SERVING_POOLS)
+def test_paged_decode_lowers_for_tpu_at_serving_pool_sizes(
+        mosaic_lowering, B, nblocks):
+    max_blocks, nh, nkv, bs, hd = 64, 8, 4, 32, 128
+    q = jnp.zeros((B, nh, hd), jnp.bfloat16)
+    pool = jnp.zeros((nblocks, nkv, bs, hd), jnp.bfloat16)
+    bt = jnp.zeros((B, max_blocks), jnp.int32)
+    cl = jnp.zeros((B,), jnp.int32)
+    f = jax.jit(lambda q, kp, vp, bt, cl:
+                pa.paged_decode_attention(q, kp, vp, bt, cl))
+    export.export(f, platforms=["tpu"])(q, pool, pool, bt, cl)  # must not raise
+
+
+def test_engine_decode_step_lowers_for_tpu(mosaic_lowering, monkeypatch):
+    """The full serving decode program (paged scatter + kernel inside the
+    layer scan, argmax head) through ``apply_paged`` at 32-client shapes.
+
+    ``apply_paged`` resolves the kernel through the op registry, which
+    skips the pallas backend off-TPU — force it so this export actually
+    contains the Mosaic kernel, not the XLA gather fallback."""
+    from deepspeed_tpu.models import llama
+    from deepspeed_tpu.ops import registry
+
+    monkeypatch.setattr(
+        registry, "_OVERRIDES", dict(registry._OVERRIDES), raising=True)
+    registry.set_backend("paged_decode_attention", "pallas")
+
+    # head_dim=128 — the failing round-4 geometry; Mosaic tiling legality
+    # depends on the trailing lane dims, so a smaller head would not gate it
+    mcfg = llama.LlamaConfig(
+        vocab_size=1024, hidden_size=256, intermediate_size=512,
+        num_layers=2, num_heads=8, num_kv_heads=4, head_dim=128,
+        max_seq_len=2048, rope_theta=500000.0)
+    params = jax.tree.map(lambda x: x.astype(jnp.bfloat16),
+                          llama.init(mcfg, jax.random.PRNGKey(0)))
+    B, nblocks = 32, 744
+    cache = llama.init_paged_cache(mcfg, nblocks, 32)
+    bt = jnp.zeros((B, 64), jnp.int32)
+    cl = jnp.zeros((B,), jnp.int32)
+    tokens = jnp.zeros((B, 1), jnp.int32)
+
+    def decode(params, tokens, cache, bt, cl):
+        logits, cache = llama.apply_paged(mcfg, params, tokens, cache, bt, cl)
+        return jnp.argmax(logits[:, 0], -1), cache
+
+    exp = export.export(jax.jit(decode), platforms=["tpu"])(
+        params, tokens, cache, bt, cl)
+    # the Mosaic kernel must actually be IN the program — if the registry
+    # fell back to the XLA gather path this gate would prove nothing
+    assert "tpu_custom_call" in exp.mlir_module()
+
+
+def _max_intermediate_bytes(jaxpr) -> int:
+    """Largest output aval of any equation, walking sub-jaxprs (scan/cond
+    bodies, custom-vjp closures) recursively."""
+    worst = 0
+    for eqn in jaxpr.eqns:
+        for v in eqn.outvars:
+            aval = getattr(v, "aval", None)
+            if aval is not None and hasattr(aval, "shape"):
+                worst = max(worst, int(np.prod(aval.shape, dtype=np.int64))
+                            * aval.dtype.itemsize)
+        for p in eqn.params.values():
+            sub = getattr(p, "jaxpr", None)
+            if sub is not None:
+                worst = max(worst, _max_intermediate_bytes(sub))
+            if isinstance(p, (list, tuple)):
+                for q in p:
+                    sub = getattr(q, "jaxpr", None)
+                    if sub is not None:
+                        worst = max(worst, _max_intermediate_bytes(sub))
+    return worst
+
+
+@pytest.mark.parametrize("pass_", ["fwd", "grad"])
+def test_fpdt_no_dense_scores_in_trace(pass_):
+    """At S=32K/chunk=8K no traced intermediate may exceed ~0.5 GiB — a
+    dense [8192, 8192] f32 per-chunk score block (the round-4 OOM shape,
+    2 GiB+ after batching) trips this immediately, while the flash-VJP
+    path's largest aval is the chunked KV stream itself."""
+    from deepspeed_tpu.sequence.fpdt import fpdt_attention
+
+    S, H, Hkv, D = 32 * 1024, 8, 4, 128
+    chunks = S // 8192
+
+    def loss(q, k, v):
+        o = fpdt_attention(q, k, v, chunks=chunks, causal=True)
+        return jnp.sum(o.astype(jnp.float32) ** 2)
+
+    args = [jax.ShapeDtypeStruct((1, S, H, D), jnp.bfloat16),
+            jax.ShapeDtypeStruct((1, S, Hkv, D), jnp.bfloat16),
+            jax.ShapeDtypeStruct((1, S, Hkv, D), jnp.bfloat16)]
+    fn = loss if pass_ == "fwd" else jax.grad(loss, argnums=(0, 1, 2))
+    jaxpr = jax.make_jaxpr(fn)(*args)
+    worst = _max_intermediate_bytes(jaxpr.jaxpr)
+    assert worst <= 512 * 2**20, (
+        f"largest traced intermediate is {worst / 2**30:.2f} GiB — "
+        "a dense score tensor is back in the FPDT path")
+
+
+def test_flash_attention_lowers_for_tpu(monkeypatch):
+    """Train-shape flash fwd+bwd must pass the Mosaic checks host-side."""
+    from deepspeed_tpu.ops.pallas import flash_attention as fa
+
+    monkeypatch.setattr(fa, "_interpret", lambda: False)
+    q = jnp.zeros((2, 1024, 8, 128), jnp.bfloat16)
+    k = jnp.zeros((2, 1024, 4, 128), jnp.bfloat16)
+
+    def loss(q, k, v):
+        return jnp.sum(fa.flash_attention(q, k, v, causal=True)
+                       .astype(jnp.float32) ** 2)
+
+    g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+    export.export(g, platforms=["tpu"])(q, k, k)
