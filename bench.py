@@ -252,27 +252,56 @@ def bench_shape_rows(jax, budget_s: float = None) -> dict:
     return rows
 
 
+_DECODE_CHILD: dict = {}
+
+
 def run_decode_subprocess() -> object:
     """Decode bench in a SUBPROCESS with a hard timeout, BEFORE this process
     initializes its own jax client: a wedged tunnel compile must never hold
     the headline JSON hostage (observed: >25 min hang in the paged-decode
     warmup), and on exclusive-access TPU runtimes a child started after the
-    parent attaches could never get the device."""
+    parent attaches could never get the device. The Popen handle is kept so
+    the SIGTERM handler can kill the child too (exclusive chip, no orphans)."""
     import subprocess
 
+    proc = subprocess.Popen([sys.executable, __file__, "--decode-only"],
+                            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                            text=True)
+    _DECODE_CHILD["proc"] = proc
     try:
-        r = subprocess.run([sys.executable, __file__, "--decode-only"],
-                           capture_output=True, text=True, timeout=600)
-        tail = r.stdout.strip().splitlines()[-1] if r.stdout.strip() else ""
-        if r.returncode == 0 and tail.startswith("DECODE_TOK_PER_SEC="):
+        out, err = proc.communicate(timeout=600)
+        tail = out.strip().splitlines()[-1] if out.strip() else ""
+        if proc.returncode == 0 and tail.startswith("DECODE_TOK_PER_SEC="):
             val, child_backend = tail.split("=")[1].split()
             return {"value": float(val), "backend": child_backend}
-        return f"failed: rc={r.returncode} {r.stderr[-200:]}"
+        return f"failed: rc={proc.returncode} {err[-200:]}"
     except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.communicate()
         return "timeout after 600s"
+    finally:
+        _DECODE_CHILD.pop("proc", None)
+
+
+def install_term_handler():
+    """Emit the partial RESULT on SIGTERM (watcher `timeout -k` kill) so a
+    wall-clock overrun still ships whatever was measured — same contract as
+    every probe script's _probe_common.install_term_handler."""
+    import signal
+
+    def on_term(signum, frame):
+        child = _DECODE_CHILD.get("proc")
+        if child is not None and child.poll() is None:
+            child.kill()  # the chip is exclusive-access; no orphans
+        RESULT["detail"]["interrupted"] = "SIGTERM (watcher timeout)"
+        emit(ok=False)
+        sys.exit(0)
+
+    signal.signal(signal.SIGTERM, on_term)
 
 
 def main():
+    install_term_handler()
     probe_backend()  # one probe pass; children inherit the verdict via env
     decode = run_decode_subprocess()
     jax = init_backend()

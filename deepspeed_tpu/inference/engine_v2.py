@@ -94,6 +94,22 @@ class InferenceEngineV2(InferenceEngine):
             self._paged_fns[key] = jax.jit(prefill, donate_argnums=(1,))
         return self._paged_fns[key]
 
+    _sp_warned = False
+
+    def _warn_ignored_sp(self, sp: SamplingParams) -> None:
+        """step()/step_many() sample with ADMISSION-time params; a caller
+        passing a non-default sp here (the pre-r4 API contract) would
+        otherwise silently get each slot's put()-time config instead."""
+        if not self._sp_warned and \
+                self._canon_sp(sp) != SamplingParams(greedy=True):
+            import warnings
+
+            warnings.warn(
+                "step()/step_many() ignore their sp argument — sampling "
+                "params are per-request, fixed at put()/put_split() time; "
+                "pass them there instead", DeprecationWarning, stacklevel=3)
+            self._sp_warned = True
+
     @staticmethod
     def _canon_sp(sp: SamplingParams) -> SamplingParams:
         """Greedy-equivalent configs (greedy=True, or temperature 0) all
@@ -135,8 +151,10 @@ class InferenceEngineV2(InferenceEngine):
         context offset — the Dynamic-SplitFuse unit (reference
         blogs/deepspeed-fastgen: 'decompose long prompts into chunks').
         Mid chunks only write KV; the final chunk also samples the first
-        token. One compile per (chunk_t, final, sp)."""
-        key = ("chunk_prefill", chunk_t, sp, final)
+        token. One compile per (chunk_t, final) for mid chunks — sp is
+        unused there, so keying on it would recompile identical programs
+        per client config — plus one per sp for final chunks."""
+        key = ("chunk_prefill", chunk_t, sp if final else None, final)
         if key not in self._paged_fns:
             fam, ap = self.family, self._apply_paged
 
@@ -401,11 +419,19 @@ class InferenceEngineV2(InferenceEngine):
         Sampling uses each sequence's ADMISSION-time params (per-request
         sampling, like the reference v2 engine); the ``sp`` argument is
         accepted for backward compatibility and ignored."""
+        self._warn_ignored_sp(sp)
         out = self._advance_prefill(seed)
         live = [d for d in self.state.seqs.values()
                 if not d.finished and not d.prefilling
                 and d.uid not in out]  # completed-this-step: first token only
         if not live:
+            # no decodes in flight: the one-chunk-per-step bound exists to
+            # protect live decodes from prefill stalls — with none to
+            # protect, advance the oldest split prefill chunk after chunk
+            # until it completes (it holds KV blocks the whole time), then
+            # stop: the completed sequence is a live decode to protect again
+            while self._pending_prefill and not out:
+                out.update(self._advance_prefill(seed))
             return out
         for d in live:
             self.state.extend(d)
@@ -438,11 +464,17 @@ class InferenceEngineV2(InferenceEngine):
         trade. k is clamped so no live sequence can run past max_seq_len.
         Split-admitted sequences advance one prefill chunk per quantum; a
         prompt completing here contributes its first token as a 1-list."""
+        self._warn_ignored_sp(sp)
         first = self._advance_prefill(seed)
-        out: Dict[int, List[int]] = {u: [t] for u, t in first.items()}
         live = [d for d in self.state.seqs.values()
                 if not d.finished and not d.prefilling
                 and d.uid not in first]
+        if not live:
+            # same no-decodes fast path as step(): drain the oldest split
+            # prefill to completion instead of one chunk per quantum call
+            while self._pending_prefill and not first:
+                first.update(self._advance_prefill(seed))
+        out: Dict[int, List[int]] = {u: [t] for u, t in first.items()}
         if not live or k <= 0:
             return out
         max_seen = max(d.seen_tokens for d in live)
@@ -553,10 +585,10 @@ class InferenceEngineV2(InferenceEngine):
                     seed=seed)
             if steps_per_sync > 1:
                 k = max(1, min(steps_per_sync, max_new_tokens))
-                self.step_many(k, sp, seed=seed + step_i)
+                self.step_many(k, seed=seed + step_i)
                 step_i += k
             else:
-                self.step(sp, seed=seed + step_i)
+                self.step(seed=seed + step_i)
                 step_i += 1
             for uid in list(self.state.seqs):
                 d = self.state.seqs[uid]

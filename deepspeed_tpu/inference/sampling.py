@@ -70,6 +70,10 @@ def sample_batch(rng: jax.Array, logits: jnp.ndarray,
     probs = jax.nn.softmax(srt_k, axis=-1)
     cum = jnp.cumsum(probs, axis=-1)
     keep = cum - probs < jnp.minimum(top_p, 1.0)[:, None]  # always keeps #1
+    # top_p >= 1.0 means DISABLED and must be exactly a no-op (as in the
+    # static `sample` path, which skips the filter entirely): a cumsum that
+    # rounds up could otherwise drop a valid tail column for those rows
+    keep = jnp.logical_or(keep, (top_p >= 1.0)[:, None])
     cutoff = jnp.min(jnp.where(keep, srt_k, jnp.inf), axis=-1, keepdims=True)
     filt = jnp.where(scaled < cutoff, -jnp.inf, filt)
     sampled = jax.random.categorical(rng, filt, axis=-1)

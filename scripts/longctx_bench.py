@@ -116,7 +116,6 @@ def main():
     RESULT["value"] = best
     # baseline: reference FPDT reaches 2M tokens on 4 GPUs => 512K/device
     RESULT["vs_baseline"] = round(best / (512 * 1024), 4)
-    RESULT["detail"]["rows"] = rows
     # explicit ok: hitting the OOM frontier after ≥1 passing size IS a
     # successful run (value = max proven S); only an immediate first-row
     # failure (best == 0) means the probe found nothing

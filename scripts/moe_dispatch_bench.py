@@ -112,7 +112,6 @@ def main():
                                                3)
         rows[label] = row
         sys.stderr.write(f"[moe] {label}: {row}\n")
-    RESULT["detail"]["rows_ms"] = rows
     ratios = [r.get("einsum_over_compact") for r in rows.values()
               if isinstance(r, dict) and "einsum_over_compact" in r]
     if ratios:

@@ -89,7 +89,6 @@ def main():
         ratios.append(row["int8_over_bf16"])
         sys.stderr.write(f"[quant] M{M}_K{K}_N{N}: {row} (us)\n")
     RESULT["value"] = round(sum(ratios) / len(ratios), 3)
-    RESULT["detail"]["rows_us"] = rows
     finalize(RESULT)
 
 

@@ -212,7 +212,6 @@ def main():
             finally:
                 del eng  # free HBM before the next configuration
     RESULT["value"] = round(best, 1)
-    RESULT["detail"]["rows"] = rows
 
     # head-of-line probe: long-prompt admission stall, split vs one-shot
     try:

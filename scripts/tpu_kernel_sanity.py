@@ -177,6 +177,10 @@ def main():
         ma = compiled.memory_analysis()
         temp = int(getattr(ma, "temp_size_in_bytes", 0) or 0)
         RESULT["detail"]["fpdt_128k_temp_gib"] = round(temp / 2**30, 2)
+        if on_tpu:
+            # temp==0 means memory_analysis didn't report — a vacuous pass
+            # here would blind the exact gate this check exists to be
+            assert temp > 0, "memory_analysis reported no temp allocation"
         assert temp < 13 * 2**30, f"temp alloc {temp / 2**30:.1f} GiB >= 13"
 
     check("fpdt_128k_compile", fpdt_128k_compile)

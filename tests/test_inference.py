@@ -676,3 +676,116 @@ def test_v2_generate_per_prompt_sampling(tiny):
     with pytest.raises(ValueError):
         build_engine_v2(llama, cfg, params, config=dict(base)).generate(
             prompts, sampling_params=[SamplingParams()])
+
+
+def test_v2_split_prefill_drains_when_no_decodes_live(tiny):
+    """ADVICE r4: with NO live decodes there is nothing for the
+    one-chunk-per-step bound to protect — a split-admitted prompt must
+    complete its whole prefill in one step() call (its KV blocks were
+    reserved at admission and sat idle otherwise), and stop draining as
+    soon as a sequence becomes decodable."""
+    cfg, params = tiny
+    mesh_lib.set_mesh(None)
+    eng = build_engine_v2(
+        llama, cfg, params,
+        config={"dtype": "float32", "prefill_bucket": 16,
+                "split_prefill_chunk": 32,
+                "ragged": {"max_tracked_sequences": 4,
+                           "max_ragged_batch_size": 4,
+                           "memory_config_blocks": 64, "block_size": 16}})
+    rng = np.random.default_rng(1)
+    long_prompt = rng.integers(0, cfg.vocab_size, (100,), dtype=np.int32)
+    sp = SamplingParams(greedy=True)
+    eng.put_split(7, long_prompt.tolist(), sp)
+    out = eng.step()
+    # 100 tokens / 32-chunk = 4 chunks, all in ONE step: first token arrives
+    assert 7 in out and not eng._pending_prefill
+    # parity with the one-shot path
+    ref = build_engine_v2(
+        llama, cfg, params,
+        config={"dtype": "float32", "prefill_bucket": 16,
+                "ragged": {"max_tracked_sequences": 4,
+                           "max_ragged_batch_size": 4,
+                           "memory_config_blocks": 64, "block_size": 16}})
+    assert out[7] == ref.put(7, long_prompt.tolist(), sp)
+
+
+def test_v2_step_warns_on_ignored_sampling_params(tiny):
+    """ADVICE r4: a non-default sp passed to step() (the pre-r4 contract)
+    is ignored in favor of admission-time params — loudly, not silently."""
+    import warnings
+
+    cfg, params = tiny
+    mesh_lib.set_mesh(None)
+    eng = build_engine_v2(
+        llama, cfg, params,
+        config={"dtype": "float32", "prefill_bucket": 16,
+                "ragged": {"max_tracked_sequences": 2,
+                           "max_ragged_batch_size": 2,
+                           "memory_config_blocks": 32, "block_size": 16}})
+    eng.put(1, [3, 5, 7], SamplingParams(greedy=True))
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        eng.step(SamplingParams(temperature=0.7, top_p=0.9))
+    assert any(issubclass(x.category, DeprecationWarning) for x in w)
+    with warnings.catch_warnings(record=True) as w:  # default sp: silent
+        warnings.simplefilter("always")
+        eng2 = build_engine_v2(
+            llama, cfg, params,
+            config={"dtype": "float32", "prefill_bucket": 16,
+                    "ragged": {"max_tracked_sequences": 2,
+                               "max_ragged_batch_size": 2,
+                               "memory_config_blocks": 32,
+                               "block_size": 16}})
+        eng2.put(1, [3, 5, 7], SamplingParams(greedy=True))
+        eng2.step()
+    assert not any(issubclass(x.category, DeprecationWarning) for x in w)
+
+
+def test_v2_midchunk_prefill_compiles_shared_across_sampling_params(tiny):
+    """ADVICE r4: mid prefill chunks never sample, so every sampling
+    config must share ONE compiled mid-chunk program."""
+    cfg, params = tiny
+    mesh_lib.set_mesh(None)
+    eng = build_engine_v2(
+        llama, cfg, params,
+        config={"dtype": "float32", "prefill_bucket": 16,
+                "split_prefill_chunk": 32,
+                "ragged": {"max_tracked_sequences": 4,
+                           "max_ragged_batch_size": 4,
+                           "memory_config_blocks": 64, "block_size": 16}})
+    f1 = eng._chunk_prefill_fn(32, SamplingParams(temperature=0.7),
+                               final=False)
+    f2 = eng._chunk_prefill_fn(32, SamplingParams(temperature=1.3, top_k=5),
+                               final=False)
+    assert f1 is f2
+    g1 = eng._chunk_prefill_fn(32, SamplingParams(temperature=0.7),
+                               final=True)
+    g2 = eng._chunk_prefill_fn(32, SamplingParams(temperature=1.3, top_k=5),
+                               final=True)
+    assert g1 is not g2  # final chunks DO sample with their own sp
+
+
+def test_sample_batch_top_p_disabled_is_noop():
+    """ADVICE r4: top_p=1.0 rows must match the static sample() path
+    exactly (which skips the filter) — a rounding-up cumsum must not drop
+    a valid tail column."""
+    from deepspeed_tpu.inference.sampling import sample, sample_batch
+
+    rng = jax.random.PRNGKey(0)
+    V = 64
+    logits = jnp.asarray(
+        np.log(np.full((3, V), 1.0 / V, np.float32)))  # uniform: cumsum hits 1.0
+    temp = jnp.asarray([1.0, 1.0, 0.7], jnp.float32)
+    topk = jnp.zeros((3,), jnp.int32)
+    topp = jnp.asarray([1.0, 1.0, 1.0], jnp.float32)
+    greedy = jnp.zeros((3,), bool)
+    # run many draws: with the filter a true no-op, every column stays
+    # reachable; a dropped tail column shows up as that id never sampled
+    keys = jax.random.split(rng, 512)
+    toks = jax.vmap(
+        lambda k: sample_batch(k, logits, temp, topk, topp, greedy))(keys)
+    seen = np.unique(np.asarray(toks))
+    assert len(seen) == V, f"only {len(seen)}/{V} ids reachable"
+    del sample  # draw-level parity is ill-posed: categorical's uniforms
+    # depend on batch shape, so only the keep-everything contract is pinned
