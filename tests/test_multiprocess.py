@@ -80,3 +80,47 @@ def test_two_process_preemption_coordination(tmp_path):
     assert len(set(boundaries.values())) == 1, \
         f"ranks checkpointed at different boundaries: {boundaries}"
     assert (tmp_path / "preempt_ck").exists()
+
+
+def _launch(args, timeout=300):
+    """Run the real launcher CLI (python -m deepspeed_tpu.launcher.runner)
+    and return its combined stdout. The launcher itself spawns and waits on
+    the workers — this is the bin/dstpu path end to end."""
+    env = {**os.environ, "PYTHONPATH": REPO_ROOT, "JAX_PLATFORMS": "cpu"}
+    env.pop("XLA_FLAGS", None)  # workers get 1 CPU device each
+    r = subprocess.run(
+        [sys.executable, "-m", "deepspeed_tpu.launcher.runner", *args],
+        capture_output=True, text=True, timeout=timeout, env=env,
+        cwd=REPO_ROOT)
+    assert r.returncode == 0, (r.stdout[-1500:], r.stderr[-1500:])
+    return r.stdout
+
+
+def test_launcher_two_process_train_parity(tmp_path):
+    """VERDICT r4 item 7: the LAUNCHER (not hand-spawned workers) starts 2
+    coordinated local processes — jax.distributed bootstrap from the
+    injected DSTPU_* env alone — which train 5 real ZeRO-2 DP steps; both
+    ranks' loss trajectories must match each other AND the single-process
+    run of the same global batch (reference launch.py:145 capability)."""
+    worker = os.path.join(os.path.dirname(__file__), "_launcher_worker.py")
+    hostfile = tmp_path / "hostfile"
+    hostfile.write_text("localhost slots=1\n")
+    port = str(_free_port())
+    out2 = _launch(["-H", str(hostfile), "--num_local_procs", "2",
+                    "--coordinator_port", port, worker])
+    lines = [l for l in out2.splitlines() if l.startswith("LOSSES")]
+    assert len(lines) == 2, out2[-1500:]
+    trajs = {line.split()[1]: line.split()[2:] for line in lines}
+    assert set(trajs) == {"0/2", "1/2"}
+    assert len(set(map(tuple, trajs.values()))) == 1, trajs
+    # single-process reference: same launcher, one process, same global batch
+    out1 = _launch(["-H", str(hostfile), "--coordinator_port",
+                    str(_free_port()), worker])
+    ref = next(l for l in out1.splitlines()
+               if l.startswith("LOSSES")).split()[2:]
+    two = next(iter(trajs.values()))
+    import numpy as np
+    np.testing.assert_allclose(np.asarray(two, np.float64),
+                               np.asarray(ref, np.float64), atol=5e-4)
+    # and training actually trained
+    assert float(two[-1]) < float(two[0]) - 1.0, two
