@@ -21,6 +21,7 @@ import os
 import shlex
 import subprocess
 import sys
+import time
 from typing import Dict, List, Optional, Tuple
 
 from ..utils.logging import logger
@@ -162,6 +163,9 @@ class LocalMultiRunner(MultiNodeRunner):
         env["DSTPU_COORDINATOR"] = \
             f"127.0.0.1:{self.args.coordinator_port}"
         env["DSTPU_NUM_PROCESSES"] = str(self.nproc)
+        # world info must agree with the actual process count, not the
+        # 1-host hostfile it was derived from
+        env["DSTPU_WORLD_INFO"] = encode_world_info({"localhost": self.nproc})
         return env
 
     def get_cmd(self) -> List[List[str]]:
@@ -336,6 +340,10 @@ def build_commands(args) -> Tuple[MultiNodeRunner, List[List[str]]]:
             raise ValueError(
                 "--num_local_procs is a single-host mode; restrict the "
                 "hostfile with --include/--num_nodes 1")
+        if args.launcher != "local":
+            raise ValueError(
+                f"--num_local_procs forks plain local processes and cannot "
+                f"honor --launcher {args.launcher}; drop one of the two")
         runner = LocalMultiRunner(args, hosts, args.num_local_procs)
         return runner, runner.get_cmd()
     if len(hosts) > 1 and args.launcher == "local":
@@ -378,9 +386,31 @@ def main(argv=None) -> int:
         if runner.name != "slurm":
             env.update(runner.node_env(pid if runner.name != "local" else 0))
         procs.append(subprocess.Popen(cmd, env=env))
+    # reap as a GROUP: one worker dying (nonzero) must kill its siblings —
+    # survivors would otherwise block in jax.distributed.initialize waiting
+    # for the dead rank forever (reference launch.py kills the local group
+    # the same way)
     rc = 0
-    for pr in procs:
-        rc = pr.wait() or rc
+    live = list(procs)
+    try:
+        while live:
+            time.sleep(0.2)
+            for pr in list(live):
+                ret = pr.poll()
+                if ret is None:
+                    continue
+                live.remove(pr)
+                rc = ret or rc
+                if ret and live:
+                    logger.error(
+                        f"worker pid {pr.pid} exited rc={ret}; terminating "
+                        f"{len(live)} sibling(s)")
+                    for sib in live:
+                        sib.terminate()
+    finally:
+        for pr in procs:
+            if pr.poll() is None:
+                pr.kill()
     return rc
 
 

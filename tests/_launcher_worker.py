@@ -1,41 +1,15 @@
 """Worker for the launcher end-to-end test: bootstraps ONLY from the
 DSTPU_* env the launcher injects (the real `bin/dstpu` contract — no argv
-side channel), runs 5 identical ZeRO-2 data-parallel train steps, prints a
-loss trajectory line tagged with its process id."""
+side channel), then runs the SAME training scenario as _mp_worker.run so
+the launcher-spawned and hand-spawned tests validate one workload."""
 
 import os
 import sys
 
-import jax
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-jax.config.update("jax_platforms", "cpu")
-
-
-def main():
-    sys.path.insert(0, os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))))
-    import numpy as np
-    import jax.numpy as jnp
-
-    import deepspeed_tpu as dst
-    from deepspeed_tpu.models import llama
-
-    n = int(os.environ.get("DSTPU_NUM_PROCESSES", "1"))
-    pid = int(os.environ.get("DSTPU_PROCESS_ID", "0"))
-    config = {
-        "train_batch_size": 8,
-        "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
-        "zero_optimization": {"stage": 2}}
-    spec = llama.model_spec(llama.LlamaConfig.tiny(use_pipeline=False),
-                            compute_dtype=jnp.float32)
-    eng, *_ = dst.initialize(model=spec, config=config)
-    assert jax.process_count() == n, (jax.process_count(), n)
-    rng = np.random.default_rng(0)  # same seed → same global batch everywhere
-    fixed = {"tokens": rng.integers(0, 256, (8, 33), dtype=np.int32)}
-    losses = [float(eng.train_batch(fixed).loss) for _ in range(5)]
-    print(f"LOSSES {pid}/{n} {' '.join(f'{l:.6f}' for l in losses)}",
-          flush=True)
-
+import _mp_worker  # noqa: E402  (sets jax platform to cpu on import)
 
 if __name__ == "__main__":
-    main()
+    _mp_worker.run(pid=int(os.environ.get("DSTPU_PROCESS_ID", "0")),
+                   n=int(os.environ.get("DSTPU_NUM_PROCESSES", "1")))

@@ -1,6 +1,10 @@
-"""Worker for test_multiprocess.py: one OS process of a 2-process
+"""Worker for test_multiprocess.py: one OS process of an n-process
 data-parallel training job, bootstrapped exactly the way `bin/dstpu` does it
-(DSTPU_* env → comm.init_distributed → jax.distributed.initialize)."""
+(DSTPU_* env → comm.init_distributed → jax.distributed.initialize).
+
+``run()`` is the shared scenario body — _launcher_worker.py reuses it with
+env-only bootstrap so the hand-spawned and launcher-spawned tests always
+validate the identical workload."""
 
 import os
 import sys
@@ -10,17 +14,9 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 
 
-def main():
-    pid, n, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
-    tp = int(sys.argv[4]) if len(sys.argv) > 4 else 1
-    mode = sys.argv[5] if len(sys.argv) > 5 else "train"
-    if tp > 1:
-        # pod topology: several devices per process (the host's chips over
-        # ICI) × several processes (DCN) — TP inside, DP across
-        jax.config.update("jax_num_cpu_devices", tp)
-    os.environ["DSTPU_COORDINATOR"] = f"127.0.0.1:{port}"
-    os.environ["DSTPU_NUM_PROCESSES"] = str(n)
-    os.environ["DSTPU_PROCESS_ID"] = str(pid)
+def run(pid: int, n: int, tp: int = 1, mode: str = "train"):
+    """Build the engine from the ambient DSTPU_* env and train 5 fixed
+    steps, printing one `LOSSES {pid}/{n} ...` line."""
     sys.path.insert(0, os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
     import numpy as np
@@ -49,8 +45,23 @@ def main():
     if mode == "preempt":
         return preempt_mode(eng, fixed, pid)
     losses = [float(eng.train_batch(fixed).loss) for _ in range(5)]
-    print(f"LOSSES {pid} {' '.join(f'{l:.6f}' for l in losses)}", flush=True)
+    print(f"LOSSES {pid}/{n} {' '.join(f'{l:.6f}' for l in losses)}",
+          flush=True)
     assert losses[-1] < losses[0] - 1.0, losses
+
+
+def main():
+    pid, n, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    tp = int(sys.argv[4]) if len(sys.argv) > 4 else 1
+    mode = sys.argv[5] if len(sys.argv) > 5 else "train"
+    if tp > 1:
+        # pod topology: several devices per process (the host's chips over
+        # ICI) × several processes (DCN) — TP inside, DP across
+        jax.config.update("jax_num_cpu_devices", tp)
+    os.environ["DSTPU_COORDINATOR"] = f"127.0.0.1:{port}"
+    os.environ["DSTPU_NUM_PROCESSES"] = str(n)
+    os.environ["DSTPU_PROCESS_ID"] = str(pid)
+    run(pid, n, tp, mode)
 
 
 def preempt_mode(eng, fixed, pid):

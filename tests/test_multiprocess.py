@@ -124,3 +124,27 @@ def test_launcher_two_process_train_parity(tmp_path):
                                np.asarray(ref, np.float64), atol=5e-4)
     # and training actually trained
     assert float(two[-1]) < float(two[0]) - 1.0, two
+
+
+def test_launcher_kills_siblings_on_worker_failure(tmp_path):
+    """One worker dying must not leave its siblings blocked in rendezvous:
+    the launcher terminates the group and exits nonzero (reference
+    launch.py's process-group kill)."""
+    crash = tmp_path / "crash_worker.py"
+    crash.write_text(
+        "import os, sys, time\n"
+        "if os.environ.get('DSTPU_PROCESS_ID') == '1':\n"
+        "    sys.exit(3)\n"
+        "time.sleep(600)  # stands in for a blocked jax.distributed init\n")
+    hostfile = tmp_path / "hostfile"
+    hostfile.write_text("localhost slots=1\n")
+    env = {**os.environ, "PYTHONPATH": REPO_ROOT}
+    t0 = __import__("time").perf_counter()
+    r = subprocess.run(
+        [sys.executable, "-m", "deepspeed_tpu.launcher.runner",
+         "-H", str(hostfile), "--num_local_procs", "2",
+         "--coordinator_port", str(_free_port()), str(crash)],
+        capture_output=True, text=True, timeout=60, env=env, cwd=REPO_ROOT)
+    assert r.returncode != 0
+    assert __import__("time").perf_counter() - t0 < 30, \
+        "launcher waited on a blocked sibling instead of killing it"
