@@ -11,6 +11,7 @@ those entries exist, documented as no-ops, so user code written against
 from __future__ import annotations
 
 import contextlib
+import functools
 from typing import Any, Dict, List, Optional
 
 import jax
@@ -70,14 +71,39 @@ class TPU_Accelerator:
             jax.device_put(0, d).block_until_ready()
 
     # --- rng -------------------------------------------------------------- #
+    # JAX RNG is functional (explicit keys); the stateful surface below keeps
+    # a key that ``manual_seed`` resets and ``get/set_rng_state`` snapshot,
+    # so reference-style code that seeds globally still behaves.
     def manual_seed(self, seed: int) -> None:
         self._seed = int(seed)
+        self._key = jax.random.PRNGKey(self._seed)
 
     def manual_seed_all(self, seed: int) -> None:
         self.manual_seed(seed)
 
     def initial_seed(self) -> int:
         return getattr(self, "_seed", 0)
+
+    def get_rng_state(self, device_index: Optional[int] = None):
+        if not hasattr(self, "_key"):
+            self.manual_seed(0)
+        return self._key
+
+    def set_rng_state(self, state, device_index: Optional[int] = None):
+        self._key = state
+
+    def default_generator(self, device_index: Optional[int] = None):
+        """Splitting generator over the held key — ``next(gen)`` yields a
+        fresh subkey (the functional analog of a stateful generator)."""
+        if not hasattr(self, "_key"):
+            self.manual_seed(0)
+
+        def gen():
+            while True:
+                self._key, sub = jax.random.split(self._key)
+                yield sub
+
+        return gen()
 
     def random(self):
         return jax.random  # the functional RNG module is the 'generator'
@@ -179,6 +205,96 @@ class TPU_Accelerator:
         return jnp.dtype(dtype) in [jnp.dtype(d) for d in
                                     self.supported_dtypes()]
 
+    # --- graphs: XLA compilation subsumes CUDA-graph capture --------------- #
+    # Reference contract: g = create_graph(); with capture_to_graph(g): fn();
+    # replay_graph(g). Imperative stream capture has no XLA analog — the jit
+    # cache IS the graph — and silently replaying nothing would make every
+    # post-capture step a no-op, so: register the work explicitly
+    # (`graph.calls.append(jitted_fn)` inside the capture block, or
+    # `create_graph(fn)`), and replaying an EMPTY graph raises instead of
+    # pretending.
+    class _Graph:
+        def __init__(self, fn: Optional[Any] = None):
+            self.calls: List[Any] = [fn] if fn is not None else []
+
+    def create_graph(self, fn=None, device_index: Optional[int] = None):
+        return TPU_Accelerator._Graph(fn)
+
+    @contextlib.contextmanager
+    def capture_to_graph(self, graph, **kwargs):
+        yield graph
+
+    def replay_graph(self, graph) -> None:
+        if not graph.calls:
+            raise RuntimeError(
+                "replay_graph: nothing was registered on this graph. XLA "
+                "cannot capture eager work the way CUDA stream capture "
+                "does — the jit cache IS the graph. Register the step "
+                "explicitly (create_graph(jitted_fn) or "
+                "graph.calls.append(fn) inside capture_to_graph), or just "
+                "call your jax.jit function directly.")
+        for fn in graph.calls:
+            fn()
+
+    # --- tensor factories (reference FloatTensor etc.) --------------------- #
+    # DoubleTensor/LongTensor yield f32/i32 unless jax_enable_x64 is set.
+    @staticmethod
+    def _factory(dtype):
+        return functools.partial(jnp.asarray, dtype=dtype)
+
+    BFloat16Tensor = property(lambda self: self._factory(jnp.bfloat16))
+    ByteTensor = property(lambda self: self._factory(jnp.uint8))
+    DoubleTensor = property(lambda self: self._factory(jnp.float64))
+    FloatTensor = property(lambda self: self._factory(jnp.float32))
+    HalfTensor = property(lambda self: self._factory(jnp.float16))
+    IntTensor = property(lambda self: self._factory(jnp.int32))
+    LongTensor = property(lambda self: self._factory(jnp.int64))
+
+    # --- op builder bridge (reference op_builder_dir/create_op_builder) ---- #
+    def op_builder_dir(self) -> str:
+        return "deepspeed_tpu.ops"
+
+    def get_op_builder(self, class_name: str):
+        from .ops import op_builder
+
+        return getattr(op_builder, class_name, None)
+
+    def create_op_builder(self, class_name: str):
+        cls = self.get_op_builder(class_name)
+        return cls() if cls is not None else None
+
+    def build_extension(self):
+        from .ops import op_builder
+
+        return op_builder  # cc-based JIT build module (the BuildExtension analog)
+
+    # --- launcher env plumbing -------------------------------------------- #
+    def export_envs(self) -> List[str]:
+        """Env PREFIXES the launchers forward to remote workers (reference
+        returns e.g. ['NCCL'])."""
+        return ["JAX", "XLA", "TPU", "LIBTPU", "DSTPU"]
+
+    def visible_devices_envs(self) -> List[str]:
+        return ["TPU_VISIBLE_CHIPS"]
+
+    def set_visible_devices_envs(self, current_env: Dict[str, str],
+                                 local_accelerator_ids: List[int]) -> None:
+        for env in self.visible_devices_envs():
+            current_env[env] = ",".join(map(str, local_accelerator_ids))
+
+    # --- compile backend (reference get/set_compile_backend) --------------- #
+    _compile_backend = "xla"
+
+    def get_compile_backend(self) -> str:
+        return self._compile_backend
+
+    def set_compile_backend(self, backend: str) -> None:
+        if backend != "xla":
+            raise ValueError(
+                f"{backend} not supported by tpu accelerator (only 'xla'; "
+                f"everything under jit is XLA-compiled)")
+        self._compile_backend = backend
+
     # --- misc ------------------------------------------------------------- #
     def name(self) -> str:
         return self._name
@@ -191,6 +307,11 @@ class TPU_Accelerator:
 
     def pin_memory(self, array, align_bytes: int = 1):
         return array  # host arrays feed device_put directly
+
+    def is_pinned(self, array) -> bool:
+        import numpy as np
+
+        return isinstance(array, np.ndarray)  # host numpy feeds DMA directly
 
     def on_accelerator(self, array) -> bool:
         try:

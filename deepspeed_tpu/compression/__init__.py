@@ -1,6 +1,7 @@
-from .compress import (fake_quantize, init_compression,  # noqa: F401
-                       layer_reduction, magnitude_prune, head_prune,
-                       row_prune, quantize_weights_ptq)
+from .compress import (SnipMomentumPruner, fake_quantize,  # noqa: F401
+                       init_compression, layer_reduction, magnitude_prune,
+                       head_prune, row_prune, quantize_weights_ptq,
+                       snip_saliency)
 from .distillation import (distillation_loss, hidden_state_loss,  # noqa: F401
                            make_distill_loss_fn)
 from .scheduler import CompressionScheduler  # noqa: F401

@@ -120,6 +120,137 @@ def head_prune(w: jnp.ndarray, num_heads: int, sparsity: float) -> jnp.ndarray:
 
 
 # --------------------------------------------------------------------------- #
+# snip_momentum structured sparse pruning
+# (reference compress.py:125-143 + constants.py:115 — the reference
+# delegates to neural_compressor's block pruners registered as step-begin
+# hooks; here the pruner is pure-functional state the scheduler owns:
+# saliency EMA tree + mask tree, updated on a cubic sparsity ramp)
+# --------------------------------------------------------------------------- #
+def _parse_block_pattern(pattern: str) -> Tuple[int, int]:
+    """'4x1' → (4, 1): prune in blocks of 4 rows × 1 col (NC convention)."""
+    try:
+        r, c = pattern.lower().split("x")
+        return max(1, int(r)), max(1, int(c))
+    except Exception:
+        raise ValueError(f"bad block_pattern {pattern!r}; expected 'RxC'")
+
+
+def _block_scores(x: jnp.ndarray, br: int, bc: int) -> jnp.ndarray:
+    """Sum |x| within (br × bc) blocks over the LAST TWO dims; leading dims
+    (stacked layers) ride along. Pads up so ragged edges form partial
+    blocks rather than being dropped."""
+    *lead, r, c = x.shape
+    pr, pc = (-r) % br, (-c) % bc
+    if pr or pc:
+        x = jnp.pad(x, [(0, 0)] * len(lead) + [(0, pr), (0, pc)])
+    nr, nc_ = (r + pr) // br, (c + pc) // bc
+    xb = jnp.abs(x).reshape(*lead, nr, br, nc_, bc)
+    return xb.sum(axis=(-3, -1))  # [*lead, nr, nc_]
+
+
+def _expand_block_mask(mask: jnp.ndarray, shape: Tuple[int, ...],
+                       br: int, bc: int) -> jnp.ndarray:
+    *lead, r, c = shape
+    m = jnp.repeat(jnp.repeat(mask, br, axis=-2), bc, axis=-1)
+    return m[..., :r, :c]
+
+
+def snip_saliency(w: jnp.ndarray, g: jnp.ndarray) -> jnp.ndarray:
+    """SNIP connection sensitivity |w ⊙ ∂L/∂w| (Lee et al.; what the
+    reference's snip_momentum criterion accumulates with momentum)."""
+    return jnp.abs(w.astype(jnp.float32) * g.astype(jnp.float32))
+
+
+@dataclasses.dataclass
+class SnipMomentumPruner:
+    """Progressive block-structured pruning on the SNIP-with-momentum
+    criterion. State (saliency EMA + masks) is a pytree pair the caller
+    threads through training; ``update`` is jit-compatible per leaf.
+
+    Schedule: cubic sparsity ramp s(t) = target·(1-(1-t)^3) from
+    ``start_step`` to ``end_step`` (the standard gradual-pruning curve the
+    NC pruner uses), masks recomputed every ``stride`` steps in-window.
+    """
+
+    target_sparsity: float
+    block_pattern: str = "4x1"
+    start_step: int = 0
+    end_step: int = 1000
+    stride: int = 100
+    beta: float = 0.9
+    predicate: Optional[Callable] = None  # (path, leaf) -> prune this leaf?
+
+    def _prunable(self, path, p) -> bool:
+        if not (hasattr(p, "ndim") and hasattr(p, "dtype") and p.ndim >= 2
+                and jnp.issubdtype(p.dtype, jnp.floating)):
+            return False
+        return self.predicate is None or self.predicate(path, p)
+
+    def init_state(self, params: Params) -> Tuple[Params, Params]:
+        """→ (saliency EMA tree, mask tree); non-prunable leaves get None
+        saliency and an all-keep mask (non-array leaves: the scalar True)."""
+        sal = jax.tree_util.tree_map_with_path(
+            lambda path, p: jnp.zeros(p.shape, jnp.float32)
+            if self._prunable(path, p) else None, params)
+        masks = jax.tree.map(
+            lambda p: jnp.ones(p.shape, bool)
+            if hasattr(p, "shape") else True, params)
+        return sal, masks
+
+    def sparsity_at(self, step: int) -> float:
+        if step < self.start_step:
+            return 0.0
+        t = min(1.0, (step - self.start_step)
+                / max(1, self.end_step - self.start_step))
+        return self.target_sparsity * (1.0 - (1.0 - t) ** 3)
+
+    def update(self, state: Tuple[Params, Params], params: Params,
+               grads: Params, step: int) -> Tuple[Params, Params]:
+        """Accumulate saliency every step; recompute masks on the stride."""
+        sal, masks = state
+        sal = jax.tree_util.tree_map_with_path(
+            lambda path, s, p, g: None if s is None
+            else self.beta * s + (1.0 - self.beta) * snip_saliency(p, g),
+            sal, params, grads, is_leaf=lambda x: x is None)
+        # remask on the stride inside the window, PLUS a final prune at
+        # end_step so the ramp always lands exactly on target_sparsity even
+        # when (end-start) is not a stride multiple (the NC pruner does the
+        # same final prune)
+        in_window = self.start_step <= step <= self.end_step
+        hit = in_window and ((step - self.start_step) % self.stride == 0
+                             or step == self.end_step)
+        if not hit:
+            return sal, masks
+        sp = self.sparsity_at(step)
+        br, bc = _parse_block_pattern(self.block_pattern)
+
+        def remask(s, p):
+            if s is None:
+                return (jnp.ones(p.shape, bool)
+                        if hasattr(p, "shape") else True)
+            scores = _block_scores(s, br, bc)          # [*lead, nr, nc]
+            flat = scores.reshape(-1)
+            k = max(1, int(flat.shape[0] * (1.0 - sp)))  # blocks KEPT
+            # exact top-k (ties broken by index): a >=threshold compare
+            # keeps every tied block — an all-zero-saliency leaf (frozen
+            # weight) would then never prune at all
+            keep_idx = jnp.argsort(flat)[-k:]
+            mflat = jnp.zeros(flat.shape, bool).at[keep_idx].set(True)
+            return _expand_block_mask(mflat.reshape(scores.shape),
+                                      p.shape, br, bc)
+
+        masks = jax.tree.map(remask, sal, params,
+                             is_leaf=lambda x: x is None)
+        return sal, masks
+
+    @staticmethod
+    def apply(masks: Params, params: Params) -> Params:
+        return jax.tree.map(
+            lambda p, m: p * m.astype(p.dtype) if hasattr(p, "dtype") else p,
+            params, masks)
+
+
+# --------------------------------------------------------------------------- #
 # init_compression (reference compress.py)
 # --------------------------------------------------------------------------- #
 @dataclasses.dataclass
@@ -130,6 +261,11 @@ class CompressionPlan:
     activation_quant_start_step: int = 0
     sparsity: Optional[float] = None
     sparsity_start_step: int = 0
+    sparse_method: str = "l1"           # l1 | topk | snip_momentum
+    sparse_block_pattern: str = "4x1"
+    sparsity_end_step: Optional[int] = None
+    sparsity_stride: int = 100
+    sparse_excluded: Optional[List[str]] = None
     keep_layers: Optional[List[int]] = None
 
     @classmethod
@@ -149,6 +285,12 @@ class CompressionPlan:
             # compression/constants.py) — sparsity is the fraction pruned
             plan.sparsity = 1.0 - float(sp.get("dense_ratio", 0.5))
             plan.sparsity_start_step = int(sp.get("schedule_offset", 0))
+            plan.sparse_method = str(sp.get("method", "l1"))
+            plan.sparse_block_pattern = str(sp.get("block_pattern", "4x1"))
+            if sp.get("schedule_offset_end") is not None:
+                plan.sparsity_end_step = int(sp["schedule_offset_end"])
+            plan.sparsity_stride = int(sp.get("schedule_offset_stride", 100))
+            plan.sparse_excluded = list(sp.get("excluded_modules", [])) or None
         lr_ = cfg.get("layer_reduction", {})
         if lr_.get("enabled"):
             plan.keep_layers = [int(i) for i in lr_["keep_number_layer"]] \

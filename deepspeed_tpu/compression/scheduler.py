@@ -15,7 +15,8 @@ import jax
 import jax.numpy as jnp
 
 from ..utils.logging import log_dist
-from .compress import CompressionPlan, fake_quantize, magnitude_prune
+from .compress import (CompressionPlan, SnipMomentumPruner, fake_quantize,
+                       magnitude_prune)
 
 
 class CompressionScheduler:
@@ -23,19 +24,51 @@ class CompressionScheduler:
         self.plan = plan
         self.masks: Optional[Any] = None
         self._announced = set()
+        self.pruner: Optional[SnipMomentumPruner] = None
+        self._snip_state = None
+        if plan.sparsity is not None and plan.sparse_method == "snip_momentum":
+            excluded = plan.sparse_excluded or []
+
+            def keep(path, p):
+                name = "/".join(str(getattr(k, "key", k)) for k in path)
+                return not any(pat in name for pat in excluded)
+
+            self.pruner = SnipMomentumPruner(
+                target_sparsity=plan.sparsity,
+                block_pattern=plan.sparse_block_pattern,
+                start_step=plan.sparsity_start_step,
+                end_step=plan.sparsity_end_step
+                or plan.sparsity_start_step + 1000,
+                stride=plan.sparsity_stride,
+                predicate=keep)
 
     def _announce(self, what: str, step: int) -> None:
         if what not in self._announced:
             log_dist(f"compression: {what} active from step {step}")
             self._announced.add(what)
 
+    def observe_gradients(self, params, grads, step: int) -> None:
+        """snip_momentum hook — call once per step after backward (the
+        reference registers this as the NC pruner's on_step_begin). No-op
+        for magnitude methods."""
+        if self.pruner is None:
+            return
+        if self._snip_state is None:
+            self._snip_state = self.pruner.init_state(params)
+        self._snip_state = self.pruner.update(
+            self._snip_state, params, grads, step)
+        self.masks = self._snip_state[1]
+
     def transform(self, params, step: int):
         """Apply active methods to the param tree (outside jit; each branch
         is itself jit-compatible)."""
         p = self.plan
         if p.sparsity is not None and step >= p.sparsity_start_step:
-            self._announce("sparse_pruning", step)
-            if self.masks is None:
+            self._announce(f"sparse_pruning({p.sparse_method})", step)
+            if self.pruner is not None:
+                if self.masks is not None:
+                    params = SnipMomentumPruner.apply(self.masks, params)
+            elif self.masks is None:
                 params, self.masks = magnitude_prune(params, p.sparsity)
             else:
                 params = jax.tree.map(

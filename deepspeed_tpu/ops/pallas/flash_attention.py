@@ -42,20 +42,47 @@ NEG_INF = -1e30
 # VMEM reads in the backward kernels.
 
 
-def _block(n: int, pref: Optional[int] = None) -> int:
-    """Block size: large (512) to amortize MXU issue + VPU overhead per block;
-    VMEM at bq=bkv=512, d<=128: scores 1MB fp32 + tiles well under budget.
-    ``DSTPU_FLASH_BLOCK`` overrides the preferred size for on-chip sweeps."""
-    if pref is None:
-        raw = os.environ.get("DSTPU_FLASH_BLOCK", "512")
+_TUNED_CACHE: dict = {}
+
+
+def _tuned_default() -> int:
+    """Best measured block size, if `scripts/attn_sweep.py` has run on this
+    machine: read ONCE from `.dstpu_tuned.json` at the repo root (two dirs
+    above the package). Falls back to 512 — large enough to amortize MXU
+    issue + VPU overhead; VMEM at bq=bkv=512, d<=128 stays well under
+    budget. Env/`pref` still override."""
+    if "flash_block" not in _TUNED_CACHE:
+        _TUNED_CACHE["flash_block"] = 512
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "..", "..", "..", ".dstpu_tuned.json")
         try:
-            pref = int(raw)
-        except ValueError:
-            raise ValueError(
-                f"DSTPU_FLASH_BLOCK={raw!r} is not an integer") from None
-        if pref <= 0 or pref % 8:
-            raise ValueError(f"DSTPU_FLASH_BLOCK={pref} must be a positive "
-                             f"multiple of 8 (Mosaic tiling)")
+            import json
+
+            with open(path) as f:
+                v = int(json.load(f).get("flash_block", 512))
+            if v > 0 and v % 8 == 0:
+                _TUNED_CACHE["flash_block"] = v
+        except Exception:
+            pass  # no sweep artifact — compiled-in default
+    return _TUNED_CACHE["flash_block"]
+
+
+def _block(n: int, pref: Optional[int] = None) -> int:
+    """Block size preference order: explicit ``pref`` > ``DSTPU_FLASH_BLOCK``
+    env (on-chip sweeps) > measured `.dstpu_tuned.json` > 512."""
+    if pref is None:
+        raw = os.environ.get("DSTPU_FLASH_BLOCK")
+        if raw is None:
+            pref = _tuned_default()
+        else:
+            try:
+                pref = int(raw)
+            except ValueError:
+                raise ValueError(
+                    f"DSTPU_FLASH_BLOCK={raw!r} is not an integer") from None
+            if pref <= 0 or pref % 8:
+                raise ValueError(f"DSTPU_FLASH_BLOCK={pref} must be a "
+                                 f"positive multiple of 8 (Mosaic tiling)")
     return min(pref, max(8, 1 << (n - 1).bit_length())) if n < pref else pref
 
 

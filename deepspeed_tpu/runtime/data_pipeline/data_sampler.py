@@ -203,21 +203,51 @@ class DataAnalyzer:
 class CurriculumDataSampler:
     """Batch sampler drawing only samples with difficulty ≤ threshold(step);
     threshold comes from a CurriculumScheduler (reference
-    DeepSpeedDataSampler + curriculum integration)."""
+    DeepSpeedDataSampler + curriculum integration).
 
-    def __init__(self, difficulties: np.ndarray, batch_size: int,
+    Multi-metric form (reference ``data_sampling/data_sampler.py``: the
+    sampler tracks one difficulty array + scheduler PER curriculum metric
+    and a sample is eligible only when EVERY metric admits it): pass dicts
+    ``{metric: difficulties}`` / ``{metric: scheduler}`` with matching
+    keys. Scalars remain accepted as the single-metric special case."""
+
+    def __init__(self, difficulties, batch_size: int,
                  scheduler, seed: int = 0, drop_last: bool = True):
-        self.difficulties = np.asarray(difficulties)
+        if isinstance(difficulties, dict) != isinstance(scheduler, dict):
+            raise ValueError("difficulties and scheduler must BOTH be "
+                             "dicts (multi-metric) or both single")
+        if isinstance(difficulties, dict):
+            if set(difficulties) != set(scheduler):
+                raise ValueError(
+                    f"metric sets differ: {sorted(difficulties)} vs "
+                    f"{sorted(scheduler)}")
+            self.difficulties = {m: np.asarray(d)
+                                 for m, d in difficulties.items()}
+            lens = {m: len(d) for m, d in self.difficulties.items()}
+            if len(set(lens.values())) > 1:
+                raise ValueError(f"metric arrays disagree on dataset "
+                                 f"size: {lens}")
+            self.schedulers = dict(scheduler)
+        else:
+            self.difficulties = {"difficulty": np.asarray(difficulties)}
+            self.schedulers = {"difficulty": scheduler}
         self.batch_size = batch_size
-        self.scheduler = scheduler
         self.rng = np.random.RandomState(seed)
         self.drop_last = drop_last
 
     def eligible(self, global_step: int) -> np.ndarray:
-        thresh = self.scheduler.get_difficulty(global_step)
-        idx = np.nonzero(self.difficulties <= thresh)[0]
-        if len(idx) < self.batch_size:  # always serve at least one batch
-            idx = np.argsort(self.difficulties)[:self.batch_size]
+        n = len(next(iter(self.difficulties.values())))
+        ok = np.ones(n, bool)
+        for m, diff in self.difficulties.items():
+            ok &= diff <= self.schedulers[m].get_difficulty(global_step)
+        idx = np.nonzero(ok)[0]
+        if len(idx) < self.batch_size:
+            # always serve at least one batch: easiest by SUMMED rank
+            # across metrics (single-metric: plain difficulty order)
+            ranks = np.zeros(n)
+            for diff in self.difficulties.values():
+                ranks += np.argsort(np.argsort(diff, kind="stable"))
+            idx = np.argsort(ranks, kind="stable")[:self.batch_size]
         return idx
 
     def sample_batch(self, global_step: int) -> np.ndarray:

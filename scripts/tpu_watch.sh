@@ -109,6 +109,9 @@ while true; do
     hold_requested || run_probe SERVING scripts/serving_bench.py 1800 SERVING_TPU_LIVE.json
     hold_requested || run_probe MOE scripts/moe_dispatch_bench.py 1200 MOE_TPU_LIVE.json
     hold_requested || run_probe QUANT scripts/quant_linear_bench.py 1200 QUANT_TPU_LIVE.json
+    # attention block sweep LAST: it may write .dstpu_tuned.json, which the
+    # NEXT cycle's headline bench then picks up as the kernel default
+    hold_requested || run_probe ATTN scripts/attn_sweep.py 1800 ATTN_TPU_LIVE.json
     rm -f bench_runs/BUSY
     # only when THIS cycle promoted every probe (incl. the headline bench)
     # does the poll slow down; any failure keeps probing fast so a fix

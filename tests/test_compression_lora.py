@@ -161,3 +161,93 @@ def test_lora_quantized_base_and_merge():
     merged = merge_lora(p, cfg)
     np.testing.assert_allclose(np.asarray(apply_lora_linear(p, x, cfg)),
                                np.asarray(x @ merged), atol=1e-3)
+
+
+def test_snip_momentum_block_pruning_schedule():
+    """snip_momentum (reference compress.py:125, constants.py:115): block-
+    structured masks driven by the |w·g| momentum criterion on a cubic
+    sparsity ramp — low-saliency 4x1 blocks are pruned first, excluded
+    modules never prune, and sparsity reaches the target by end_step."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deepspeed_tpu.compression import CompressionScheduler
+    from deepspeed_tpu.compression.compress import CompressionPlan
+
+    plan = CompressionPlan.from_config({
+        "sparse_pruning": {"enabled": True, "method": "snip_momentum",
+                           "dense_ratio": 0.5, "block_pattern": "4x1",
+                           "schedule_offset": 0, "schedule_offset_end": 10,
+                           "schedule_offset_stride": 1,
+                           "excluded_modules": ["embed"]}})
+    assert plan.sparse_method == "snip_momentum"
+    sched = CompressionScheduler(plan)
+
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.normal(size=(16, 8)).astype(np.float32))
+    params = {"dense": w, "embed": jnp.asarray(
+        rng.normal(size=(8, 8)).astype(np.float32))}
+    # gradient saliency concentrated on the TOP half of `dense`: those
+    # blocks must survive, the bottom half must be pruned at 50% sparsity
+    g = np.zeros((16, 8), np.float32)
+    g[:8] = 1.0
+    grads = {"dense": jnp.asarray(g),
+             "embed": jnp.ones((8, 8), jnp.float32)}
+
+    for step in range(12):
+        sched.observe_gradients(params, grads, step)
+    pruned = sched.transform(params, step=12)
+
+    dm = np.asarray(pruned["dense"]) != 0
+    # rows 0..7 (high saliency) kept, rows 8..15 pruned
+    assert dm[:8].all(), "high-saliency blocks were pruned"
+    assert not dm[8:].any(), "low-saliency blocks survived"
+    # block structure: each 4x1 block is uniformly kept or dropped
+    m = np.asarray(sched.masks["dense"])
+    blocks = m.reshape(4, 4, 8)
+    assert ((blocks.all(axis=1)) | (~blocks.any(axis=1))).all()
+    # excluded module untouched
+    assert (np.asarray(pruned["embed"]) != 0).all()
+
+
+def test_snip_momentum_cubic_ramp():
+    from deepspeed_tpu.compression import SnipMomentumPruner
+
+    pr = SnipMomentumPruner(target_sparsity=0.8, start_step=100,
+                            end_step=200, stride=10)
+    assert pr.sparsity_at(0) == 0.0
+    assert pr.sparsity_at(100) == 0.0
+    mid = pr.sparsity_at(150)
+    assert 0.0 < mid < 0.8
+    assert abs(pr.sparsity_at(200) - 0.8) < 1e-9
+    assert pr.sparsity_at(10_000) == 0.8  # clamps past the end
+
+
+def test_snip_momentum_edge_cases():
+    """Zero-saliency leaves still prune to the exact block budget (no
+    >=threshold tie flood); a non-stride-multiple end_step gets a final
+    prune landing exactly on target; scalar leaves don't crash."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deepspeed_tpu.compression import SnipMomentumPruner
+
+    pr = SnipMomentumPruner(target_sparsity=0.5, block_pattern="4x1",
+                            start_step=0, end_step=150, stride=100)
+    params = {"w": jnp.ones((16, 8), jnp.float32), "step": 3}
+    grads = {"w": jnp.zeros((16, 8), jnp.float32), "step": 0}  # frozen: g=0
+    state = pr.init_state(params)
+    for step in range(151):
+        state = pr.update(state, params, grads, step)
+    masks = state[1]
+    kept = float(np.asarray(masks["w"]).mean())
+    # exact 50% of blocks kept despite all-tied (zero) saliency
+    assert abs(kept - 0.5) < 1e-6, kept
+    assert masks["step"] is True
+    # block structure intact
+    m = np.asarray(masks["w"]).reshape(4, 4, 8)
+    assert ((m.all(axis=1)) | (~m.any(axis=1))).all()
+    # sparsity at the final prune equals the target even though
+    # 150 % 100 != 0
+    assert abs(pr.sparsity_at(150) - 0.5) < 1e-9

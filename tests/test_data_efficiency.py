@@ -202,3 +202,42 @@ def test_data_analyzer_run_map_reduce_multiworker(tmp_path):
     merged = DataAnalyzer(data, {"v": lambda s: s}, save_path=str(tmp_path),
                           num_workers=2, worker_id=0).run_map_reduce()
     np.testing.assert_array_equal(merged["v"], np.asarray(data, float))
+
+
+def test_curriculum_sampler_multi_metric_intersection():
+    """Reference data_sampler tracks one difficulty array + scheduler per
+    curriculum metric; a sample is eligible only when EVERY metric admits
+    it (threshold AND)."""
+    import numpy as np
+
+    from deepspeed_tpu.runtime.data_pipeline.data_sampler import (
+        CurriculumDataSampler)
+
+    class Fixed:
+        def __init__(self, t):
+            self.t = t
+
+        def get_difficulty(self, step):
+            return self.t
+
+    # metric A admits samples 0..5, metric B admits 3..9 → overlap 3..5
+    diff_a = np.arange(10)
+    diff_b = 9 - np.arange(10)
+    s = CurriculumDataSampler({"a": diff_a, "b": diff_b}, batch_size=2,
+                              scheduler={"a": Fixed(5), "b": Fixed(6)},
+                              seed=0)
+    elig = s.eligible(0)
+    assert set(elig) == {3, 4, 5}, elig
+    batch = s.sample_batch(0)
+    assert set(batch) <= {3, 4, 5}
+    # mismatched metric sets / shapes are rejected loudly
+    import pytest
+
+    with pytest.raises(ValueError):
+        CurriculumDataSampler({"a": diff_a}, 2, {"b": Fixed(1)})
+    with pytest.raises(ValueError):
+        CurriculumDataSampler({"a": diff_a, "b": diff_b[:5]}, 2,
+                              {"a": Fixed(1), "b": Fixed(1)})
+    # single-metric scalar form unchanged
+    s1 = CurriculumDataSampler(diff_a, batch_size=2, scheduler=Fixed(3))
+    assert set(s1.eligible(0)) == {0, 1, 2, 3}

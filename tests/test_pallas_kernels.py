@@ -312,3 +312,37 @@ def test_blocksparse_kernel_matches_dense_mask():
     empty[0, 0] = True
     with _pytest.raises(ValueError, match="attend to no kv block"):
         blocksparse_attention(q, k, v, empty, bs, causal=True)
+
+
+def test_flash_block_preference_order(monkeypatch, tmp_path):
+    """_block precedence: explicit pref > DSTPU_FLASH_BLOCK env > measured
+    .dstpu_tuned.json (attn_sweep artifact) > compiled-in 512."""
+    from deepspeed_tpu.ops.pallas import flash_attention as fa
+
+    monkeypatch.delenv("DSTPU_FLASH_BLOCK", raising=False)
+    # compiled-in default (empty tuned cache, no file read)
+    monkeypatch.setattr(fa, "_TUNED_CACHE", {"flash_block": 512})
+    assert fa._block(4096) == 512
+    # tuned artifact wins over the default
+    monkeypatch.setattr(fa, "_TUNED_CACHE", {"flash_block": 1024})
+    assert fa._block(4096) == 1024
+    # env wins over tuned
+    monkeypatch.setenv("DSTPU_FLASH_BLOCK", "256")
+    assert fa._block(4096) == 256
+    # explicit pref wins over everything
+    assert fa._block(4096, pref=128) == 128
+    # short sequences clamp to the next pow2 regardless of source
+    monkeypatch.delenv("DSTPU_FLASH_BLOCK")
+    assert fa._block(96) == 128
+    # the file loader itself: valid artifact is read once
+    import json as _json
+
+    tuned = tmp_path / ".dstpu_tuned.json"
+    tuned.write_text(_json.dumps({"flash_block": 768}))
+    monkeypatch.setattr(fa, "_TUNED_CACHE", {})
+    real_join = fa.os.path.join
+    monkeypatch.setattr(
+        fa.os.path, "join",
+        lambda *a: str(tuned) if a[-1] == ".dstpu_tuned.json"
+        else real_join(*a))
+    assert fa._tuned_default() == 768
