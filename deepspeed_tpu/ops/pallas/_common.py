@@ -5,11 +5,27 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+try:
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
 
 def interpret() -> bool:
     """Run kernels through the Pallas interpreter off-TPU (tests select the
     pallas backend explicitly on the CPU mesh)."""
     return jax.default_backend() != "tpu"
+
+
+def dim_semantics(*sem: str):
+    """CompilerParams marking grid dims parallel/arbitrary. Accumulation
+    dims (scratch carried across iterations) must be 'arbitrary'; truly
+    independent dims marked 'parallel' let Mosaic partition them across
+    TensorCores (a no-op on single-core v5e, significant on multi-core
+    generations) and relax ordering constraints."""
+    if pltpu is None:
+        return None
+    return pltpu.CompilerParams(dimension_semantics=sem)
 
 
 def row_block(n_rows: int) -> int:

@@ -32,6 +32,7 @@ try:  # pltpu is importable on CPU builds too, but guard anyway
 except ImportError:  # pragma: no cover
     pltpu = None
 
+from ._common import dim_semantics as _dim_semantics
 from ._common import interpret as _interpret
 
 NEG_INF = -1e30
@@ -207,6 +208,7 @@ def _flash_fwd(q, k, v, bias=None, *, causal, scale, q_offset):
             pltpu.VMEM((bq, 128), jnp.float32),
             pltpu.VMEM((bq, d), jnp.float32),
         ],
+        compiler_params=_dim_semantics("parallel", "parallel", "arbitrary"),
         interpret=_interpret(),
     )(*args)
     return o[:, :sq], lse[:, :sq]
@@ -377,6 +379,7 @@ def _flash_bwd(q, k, v, o, lse, do, bias=None, *, causal, scale, q_offset):
         out_specs=dq_out_specs,
         out_shape=dq_out_shape,
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        compiler_params=_dim_semantics("parallel", "parallel", "arbitrary"),
         interpret=_interpret(),
     )(*dq_args)
     if has_bias:
@@ -417,6 +420,7 @@ def _flash_bwd(q, k, v, o, lse, do, bias=None, *, causal, scale, q_offset):
             pltpu.VMEM((bkv, d), jnp.float32),
             pltpu.VMEM((bkv, d), jnp.float32),
         ],
+        compiler_params=_dim_semantics("parallel", "parallel", "arbitrary"),
         interpret=_interpret(),
     )(*dkv_args)
     return dq[:, :sq], dk[:, :kv_len], dv[:, :kv_len], dbias

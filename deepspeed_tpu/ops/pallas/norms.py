@@ -16,6 +16,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 from ..registry import register
+from ._common import dim_semantics as _dim_semantics
 from ._common import (interpret as _interpret, pad_rows as _pad_rows,
                       row_block as _row_block)
 
@@ -41,6 +42,7 @@ def _rms_fwd_pallas(x2, w, eps):
                   pl.BlockSpec((d,), lambda i: (0,))],
         out_specs=pl.BlockSpec((bn, d), lambda i: (i, 0)),
         out_shape=jax.ShapeDtypeStruct((np_, d), x2.dtype),
+        compiler_params=_dim_semantics("parallel"),
         interpret=_interpret(),
     )(x2, w)
     return out[:n]
@@ -104,6 +106,7 @@ def _ln_fwd_pallas(x2, w, b, eps):
                   pl.BlockSpec((d,), lambda i: (0,))],
         out_specs=pl.BlockSpec((bn, d), lambda i: (i, 0)),
         out_shape=jax.ShapeDtypeStruct((np_, d), x2.dtype),
+        compiler_params=_dim_semantics("parallel"),
         interpret=_interpret(),
     )(x2, w, b)
     return out[:n]

@@ -37,6 +37,7 @@ try:
 except ImportError:  # pragma: no cover
     pltpu = None
 
+from ._common import dim_semantics as _dim_semantics
 from ._common import interpret as _interpret
 
 NEG_INF = -1e30
@@ -128,6 +129,7 @@ def paged_decode_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
     out = pl.pallas_call(
         kernel, grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, nkv, gpad, hd), q.dtype),
+        compiler_params=_dim_semantics("parallel", "parallel", "arbitrary"),
         interpret=_interpret(),
     )(block_tables.astype(jnp.int32), context_lens.astype(jnp.int32),
       qg, k_pool, v_pool)

@@ -22,6 +22,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 from ..registry import register
+from ._common import dim_semantics as _dim_semantics
 from ._common import (interpret as _interpret, pad_rows as _pad_rows,
                       row_block as _row_block)
 
@@ -56,6 +57,7 @@ def quantize_int8_pallas(x: jnp.ndarray, group_size: int = 2048):
                    pl.BlockSpec((bn, 128), lambda i: (i, 0))],
         out_shape=[jax.ShapeDtypeStruct((np_, group_size), jnp.int8),
                    jax.ShapeDtypeStruct((np_, 128), jnp.float32)],
+        compiler_params=_dim_semantics("parallel"),
         interpret=_interpret(),
     )(x2)
     return q[:n].reshape(shape), s[:n, 0]
@@ -76,6 +78,7 @@ def dequantize_int8_pallas(q: jnp.ndarray, scales: jnp.ndarray,
                   pl.BlockSpec((bn, 128), lambda i: (i, 0))],
         out_specs=pl.BlockSpec((bn, group_size), lambda i: (i, 0)),
         out_shape=jax.ShapeDtypeStruct((np_, group_size), dtype),
+        compiler_params=_dim_semantics("parallel"),
         interpret=_interpret(),
     )(q2, s2)
     return out[:n].reshape(shape)

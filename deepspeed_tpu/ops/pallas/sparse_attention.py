@@ -28,6 +28,7 @@ try:
 except ImportError:  # pragma: no cover
     pltpu = None
 
+from ._common import dim_semantics as _dim_semantics
 from ._common import interpret as _interpret
 
 NEG_INF = -1e30
@@ -147,6 +148,7 @@ def sparse_flash_attention_fwd(q: jnp.ndarray, k: jnp.ndarray,
     o = pl.pallas_call(
         kernel, grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+        compiler_params=_dim_semantics("parallel", "parallel", "arbitrary"),
         interpret=_interpret(),
     )(jnp.asarray(idx), jnp.asarray(counts), to_bh(q), to_bh(k), to_bh(v))
     return o.reshape(b, h, s, d).transpose(0, 2, 1, 3)

@@ -156,3 +156,40 @@ def test_flash_attention_lowers_for_tpu(monkeypatch):
 
     g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
     export.export(g, platforms=["tpu"])(q, k, k)
+
+
+def test_norms_quantize_sparse_lower_for_tpu(monkeypatch):
+    """The remaining Pallas kernels (1-D row grids + sparse scalar-prefetch)
+    must pass the host-side Mosaic validation too — dimension_semantics
+    mistakes are exactly the silicon-only class this gate exists for."""
+    from deepspeed_tpu.ops.pallas import norms as pnorm
+    from deepspeed_tpu.ops.pallas import quantize as pquant
+    from deepspeed_tpu.ops.pallas import sparse_attention as psparse
+
+    for mod in (pnorm, pquant, psparse):
+        monkeypatch.setattr(mod, "_interpret", lambda: False)
+
+    x = jnp.zeros((1024, 256), jnp.float32)
+    w = jnp.ones((256,), jnp.float32)
+    export.export(jax.jit(lambda x, w: pnorm.rms_norm_pallas(x, w)),
+                  platforms=["tpu"])(x, w)
+    export.export(jax.jit(lambda x, w: pnorm.layer_norm_pallas(x, w, w)),
+                  platforms=["tpu"])(x, w)
+
+    flat = jnp.zeros((64 * 256,), jnp.float32)
+    export.export(
+        jax.jit(lambda v: pquant.quantize_int8_pallas(v, group_size=256)),
+        platforms=["tpu"])(flat)
+    qv = jnp.zeros((64 * 256,), jnp.int8)
+    sc = jnp.ones((64,), jnp.float32)
+    export.export(
+        jax.jit(lambda q, s: pquant.dequantize_int8_pallas(
+            q, s, group_size=256)), platforms=["tpu"])(qv, sc)
+
+    bs, nb = 128, 4
+    layout = np.tril(np.ones((nb, nb), bool))
+    q = jnp.zeros((1, bs * nb, 4, 128), jnp.bfloat16)
+    export.export(
+        jax.jit(lambda q, k, v: psparse.sparse_flash_attention_fwd(
+            q, k, v, layout, bs, causal=True)),
+        platforms=["tpu"])(q, q, q)
