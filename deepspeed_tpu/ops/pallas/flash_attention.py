@@ -43,6 +43,47 @@ NEG_INF = -1e30
 # VMEM reads in the backward kernels.
 
 
+def _mask_split(qi, ki, *, causal, bq, bkv, kv_len, q_offset, nkv):
+    """Disjoint (no_mask, masked) block predicates for the causal/pad mask.
+
+    Only diagonal-band blocks and the ragged last KV block need the
+    [bq, bkv] iota/compare/where mask; interior blocks are fully visible
+    and skip that VPU work entirely (at bq=bkv=512 the mask build costs
+    about as much VPU time as the block's two MXU matmuls take — the
+    official TPU flash kernels specialize the same way). Returns None when
+    NO block ever needs a mask (non-causal, no KV padding)."""
+    has_pad = (nkv * bkv) != kv_len
+    if not causal and not has_pad:
+        return None
+    if causal:
+        participates = ki * bkv <= qi * bq + (bq - 1) + q_offset
+        fully_visible = ki * bkv + (bkv - 1) <= qi * bq + q_offset
+    else:
+        participates = jnp.bool_(True)
+        fully_visible = jnp.bool_(True)
+    pad_blk = (ki == nkv - 1) if has_pad else jnp.bool_(False)
+    no_mask = jnp.logical_and(
+        participates, jnp.logical_and(fully_visible,
+                                      jnp.logical_not(pad_blk)))
+    masked = jnp.logical_and(
+        participates, jnp.logical_or(jnp.logical_not(fully_visible),
+                                     pad_blk))
+    return no_mask, masked
+
+
+def _block_mask(qi, ki, *, causal, bq, bkv, kv_len, q_offset):
+    """The [bq, bkv] validity mask for a masked block — ONE definition
+    shared by fwd/dq/dkv so the three kernels cannot drift."""
+    q_idx = qi * bq + jax.lax.broadcasted_iota(
+        jnp.int32, (bq, bkv), 0) + q_offset
+    kv_idx = ki * bkv + jax.lax.broadcasted_iota(
+        jnp.int32, (bq, bkv), 1)
+    mask = kv_idx < kv_len
+    if causal:
+        mask = jnp.logical_and(mask, kv_idx <= q_idx)
+    return mask
+
+
 _TUNED_CACHE: dict = {}
 
 
@@ -118,8 +159,9 @@ def _fwd_kernel(*refs, scale, causal, bq, bkv, kv_len, q_offset, nkv,
 
     qi = pl.program_id(1)
     # causal: kv blocks strictly above the diagonal band contribute nothing —
-    # skip their compute entirely (the reference's flash kernels do the same).
-    def _compute():
+    # skip their compute entirely (the reference's flash kernels do the same);
+    # interior (fully visible) blocks additionally skip the mask build.
+    def _compute(masked):
         # keep q/k in input dtype (bf16): the MXU runs bf16xbf16->fp32 at full
         # rate; casting inputs to fp32 first would drop to ~1/8 peak.
         q = q_ref[0]                              # [bq, d]
@@ -130,12 +172,10 @@ def _fwd_kernel(*refs, scale, causal, bq, bkv, kv_len, q_offset, nkv,
         if bias_ref is not None:
             s = s + bias_ref[0].astype(jnp.float32)
 
-        q_idx = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 0) + q_offset
-        kv_idx = ki * bkv + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 1)
-        mask = kv_idx < kv_len
-        if causal:
-            mask = jnp.logical_and(mask, kv_idx <= q_idx)
-        s = jnp.where(mask, s, NEG_INF)
+        if masked:
+            s = jnp.where(_block_mask(qi, ki, causal=causal, bq=bq, bkv=bkv,
+                                      kv_len=kv_len, q_offset=q_offset),
+                          s, NEG_INF)
 
         m_prev = m_scr[...]                       # [bq, 128] (lane-replicated)
         l_prev = l_scr[...]
@@ -151,10 +191,14 @@ def _fwd_kernel(*refs, scale, causal, bq, bkv, kv_len, q_offset, nkv,
         m_scr[...] = m_new
         l_scr[...] = l_new
 
-    if causal:
-        pl.when(ki * bkv <= qi * bq + (bq - 1) + q_offset)(_compute)
+    split = _mask_split(qi, ki, causal=causal, bq=bq, bkv=bkv, kv_len=kv_len,
+                        q_offset=q_offset, nkv=nkv)
+    if split is None:
+        _compute(masked=False)
     else:
-        _compute()
+        no_mask, masked = split
+        pl.when(no_mask)(lambda: _compute(masked=False))
+        pl.when(masked)(lambda: _compute(masked=True))
 
     @pl.when(ki == nkv - 1)
     def _finish():
@@ -233,7 +277,7 @@ def _bwd_dq_kernel(*refs, scale, causal, bq, bkv, kv_len, q_offset, nkv,
         dq_scr[...] = jnp.zeros_like(dq_scr)
 
     qi = pl.program_id(1)
-    def _compute():
+    def _compute(masked):
         q = q_ref[0]
         k = k_ref[0]
         v = v_ref[0]
@@ -245,12 +289,12 @@ def _bwd_dq_kernel(*refs, scale, causal, bq, bkv, kv_len, q_offset, nkv,
                                 preferred_element_type=jnp.float32) * scale
         if bias_ref is not None:
             s = s + bias_ref[0].astype(jnp.float32)
-        q_idx = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 0) + q_offset
-        kv_idx = ki * bkv + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 1)
-        mask = kv_idx < kv_len
-        if causal:
-            mask = jnp.logical_and(mask, kv_idx <= q_idx)
-        p = jnp.where(mask, jnp.exp(s - lse), 0.0)            # [bq, bkv]
+        if masked:
+            p = jnp.where(_block_mask(qi, ki, causal=causal, bq=bq, bkv=bkv,
+                                      kv_len=kv_len, q_offset=q_offset),
+                          jnp.exp(s - lse), 0.0)              # [bq, bkv]
+        else:
+            p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds_raw = p * (dp - delta)   # dL/d(logits) — the bias gradient
@@ -260,16 +304,20 @@ def _bwd_dq_kernel(*refs, scale, causal, bq, bkv, kv_len, q_offset, nkv,
         dq_scr[...] += jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
                                            preferred_element_type=jnp.float32)
 
-    if causal:
-        pl.when(ki * bkv <= qi * bq + (bq - 1) + q_offset)(_compute)
-
-        if dbias_ref is not None:
-            # skipped above-diagonal blocks must still zero their dbias block
-            @pl.when(ki * bkv > qi * bq + (bq - 1) + q_offset)
+    split = _mask_split(qi, ki, causal=causal, bq=bq, bkv=bkv, kv_len=kv_len,
+                        q_offset=q_offset, nkv=nkv)
+    if split is None:
+        _compute(masked=False)
+    else:
+        no_mask, masked = split
+        pl.when(no_mask)(lambda: _compute(masked=False))
+        pl.when(masked)(lambda: _compute(masked=True))
+        if causal and dbias_ref is not None:
+            # skipped above-diagonal blocks must still zero their dbias
+            # block — exactly the complement of the two branches above
+            @pl.when(jnp.logical_not(jnp.logical_or(no_mask, masked)))
             def _zero_dbias():
                 dbias_ref[0] = jnp.zeros_like(dbias_ref[0])
-    else:
-        _compute()
 
     @pl.when(ki == nkv - 1)
     def _finish():
@@ -277,7 +325,7 @@ def _bwd_dq_kernel(*refs, scale, causal, bq, bkv, kv_len, q_offset, nkv,
 
 
 def _bwd_dkv_kernel(*refs, scale, causal, bq, bkv, kv_len, q_offset, nq,
-                    has_bias):
+                    nkv, has_bias):
     if has_bias:
         (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref,
          dk_ref, dv_ref, dk_scr, dv_scr) = refs
@@ -293,7 +341,7 @@ def _bwd_dkv_kernel(*refs, scale, causal, bq, bkv, kv_len, q_offset, nq,
         dv_scr[...] = jnp.zeros_like(dv_scr)
 
     ki = pl.program_id(1)
-    def _compute():
+    def _compute(masked):
         q = q_ref[0]
         k = k_ref[0]
         v = v_ref[0]
@@ -305,12 +353,12 @@ def _bwd_dkv_kernel(*refs, scale, causal, bq, bkv, kv_len, q_offset, nq,
                                 preferred_element_type=jnp.float32) * scale
         if bias_ref is not None:
             s = s + bias_ref[0].astype(jnp.float32)
-        q_idx = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 0) + q_offset
-        kv_idx = ki * bkv + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 1)
-        mask = kv_idx < kv_len
-        if causal:
-            mask = jnp.logical_and(mask, kv_idx <= q_idx)
-        p = jnp.where(mask, jnp.exp(s - lse), 0.0)
+        if masked:
+            p = jnp.where(_block_mask(qi, ki, causal=causal, bq=bq, bkv=bkv,
+                                      kv_len=kv_len, q_offset=q_offset),
+                          jnp.exp(s - lse), 0.0)
+        else:
+            p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = (p * (dp - delta) * scale).astype(q.dtype)
@@ -320,10 +368,14 @@ def _bwd_dkv_kernel(*refs, scale, causal, bq, bkv, kv_len, q_offset, nq,
         dk_scr[...] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
                                            preferred_element_type=jnp.float32)
 
-    if causal:
-        pl.when(ki * bkv <= qi * bq + (bq - 1) + q_offset)(_compute)
+    split = _mask_split(qi, ki, causal=causal, bq=bq, bkv=bkv, kv_len=kv_len,
+                        q_offset=q_offset, nkv=nkv)
+    if split is None:
+        _compute(masked=False)
     else:
-        _compute()
+        no_mask, masked = split
+        pl.when(no_mask)(lambda: _compute(masked=False))
+        pl.when(masked)(lambda: _compute(masked=True))
 
     @pl.when(qi == nq - 1)
     def _finish():
@@ -405,7 +457,7 @@ def _flash_bwd(q, k, v, o, lse, do, bias=None, *, causal, scale, q_offset):
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal, bq=bq,
                           bkv=bkv, kv_len=kv_len, q_offset=q_offset, nq=nq,
-                          has_bias=has_bias),
+                          nkv=nkv, has_bias=has_bias),
         grid=(bh, nkv, nq),
         in_specs=dkv_in_specs,
         out_specs=[
