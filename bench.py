@@ -58,7 +58,9 @@ def attach_live_evidence(base_dir: str = None):
                       ("LONGCTX_TPU_LIVE.json", "tpu_longctx_capture"),
                       ("SERVING_TPU_LIVE.json", "tpu_serving_capture"),
                       ("MOE_TPU_LIVE.json", "tpu_moe_dispatch_capture"),
-                      ("QUANT_TPU_LIVE.json", "tpu_quant_linear_capture")):
+                      ("QUANT_TPU_LIVE.json", "tpu_quant_linear_capture"),
+                      ("KERNELS_TPU_LIVE.json", "tpu_kernel_sanity_capture"),
+                      ("ATTN_TPU_LIVE.json", "tpu_attn_sweep_capture")):
         path = os.path.join(here, name)
         try:
             with open(path) as f:

@@ -53,24 +53,43 @@ def test_graft_entry_compiles():
 
 
 def test_bench_attaches_watcher_captures(tmp_path):
-    """attach_live_evidence: with the tunnel down at driver time, any
-    mid-round watcher captures (BENCH/LONGCTX/SERVING/MOE/QUANT_TPU_LIVE)
-    embed into the emitted JSON, timestamped and labeled — a round whose
-    window opened mid-round can never ship zero TPU evidence again."""
+    """attach_live_evidence: with the tunnel down at driver time, EVERY
+    watcher capture slot (BENCH/LONGCTX/SERVING/MOE/QUANT/KERNELS/ATTN
+    _TPU_LIVE) embeds into the emitted JSON, timestamped and labeled — a
+    round whose window opened mid-round can never ship zero TPU evidence
+    again."""
     sys.path.insert(0, REPO_ROOT)
     import bench
 
     captures = {
-        "BENCH_TPU_LIVE.json": {"metric": "llama_zero3_train_mfu",
-                                "value": 0.5, "detail": {"backend": "tpu"}},
-        "LONGCTX_TPU_LIVE.json": {"metric": "fpdt_longctx_max_seq",
-                                  "value": 131072,
-                                  "detail": {"backend": "tpu"}},
-        "SERVING_TPU_LIVE.json": {"metric": "serving_steady_tok_per_sec",
-                                  "value": 999.0,
-                                  "detail": {"backend": "tpu"}},
+        "BENCH_TPU_LIVE.json": ("tpu_capture",
+                                {"metric": "llama_zero3_train_mfu",
+                                 "value": 0.5,
+                                 "detail": {"backend": "tpu"}}),
+        "LONGCTX_TPU_LIVE.json": ("tpu_longctx_capture",
+                                  {"metric": "fpdt_longctx_max_seq",
+                                   "value": 131072,
+                                   "detail": {"backend": "tpu"}}),
+        "SERVING_TPU_LIVE.json": ("tpu_serving_capture",
+                                  {"metric": "serving_steady_tok_per_sec",
+                                   "value": 999.0,
+                                   "detail": {"backend": "tpu"}}),
+        "MOE_TPU_LIVE.json": ("tpu_moe_dispatch_capture",
+                              {"metric": "moe_dispatch_best_impl",
+                               "value": 1.5, "detail": {"backend": "tpu"}}),
+        "QUANT_TPU_LIVE.json": ("tpu_quant_linear_capture",
+                                {"metric": "int8_over_bf16", "value": 1.1,
+                                 "detail": {"backend": "tpu"}}),
+        "KERNELS_TPU_LIVE.json": ("tpu_kernel_sanity_capture",
+                                  {"metric": "pallas_kernel_sanity_pass",
+                                   "value": 8,
+                                   "detail": {"backend": "tpu"}}),
+        "ATTN_TPU_LIVE.json": ("tpu_attn_sweep_capture",
+                               {"metric": "flash_attn_fwdbwd_mfu_best",
+                                "value": 0.2,
+                                "detail": {"backend": "tpu"}}),
     }
-    for name, content in captures.items():
+    for name, (_, content) in captures.items():
         with open(os.path.join(tmp_path, name), "w") as f:
             json.dump(content, f)
     result = dict(bench.RESULT, detail={"backend": "cpu-degraded"})
@@ -81,9 +100,6 @@ def test_bench_attaches_watcher_captures(tmp_path):
     finally:
         bench.RESULT = saved
     d = result["detail"]
-    assert d["tpu_capture"]["value"] == 0.5
-    assert d["tpu_longctx_capture"]["value"] == 131072
-    assert d["tpu_serving_capture"]["value"] == 999.0
-    for key in ("tpu_capture", "tpu_longctx_capture",
-                "tpu_serving_capture"):
+    for name, (key, content) in captures.items():
+        assert d[key]["value"] == content["value"], key
         assert "captured_at_utc" in d[key] and "note" in d[key]
