@@ -45,6 +45,19 @@ def emit(ok: bool, err: str = ""):
     print(json.dumps(RESULT))
 
 
+# every watcher-promoted capture slot and its detail key — the test suite
+# iterates this same constant, so adding a slot is one edit
+LIVE_CAPTURE_SLOTS = (
+    ("BENCH_TPU_LIVE.json", "tpu_capture"),
+    ("LONGCTX_TPU_LIVE.json", "tpu_longctx_capture"),
+    ("SERVING_TPU_LIVE.json", "tpu_serving_capture"),
+    ("MOE_TPU_LIVE.json", "tpu_moe_dispatch_capture"),
+    ("QUANT_TPU_LIVE.json", "tpu_quant_linear_capture"),
+    ("KERNELS_TPU_LIVE.json", "tpu_kernel_sanity_capture"),
+    ("ATTN_TPU_LIVE.json", "tpu_attn_sweep_capture"),
+)
+
+
 def attach_live_evidence(base_dir: str = None):
     """If this run could not reach the TPU but the in-round tunnel watcher
     (scripts/tpu_watch.sh) captured a full TPU bench in an earlier working
@@ -54,13 +67,7 @@ def attach_live_evidence(base_dir: str = None):
     if "tpu" in str(RESULT["detail"].get("backend", "")):
         return  # live TPU run; nothing to attach
     here = base_dir or os.path.dirname(os.path.abspath(__file__))
-    for name, key in (("BENCH_TPU_LIVE.json", "tpu_capture"),
-                      ("LONGCTX_TPU_LIVE.json", "tpu_longctx_capture"),
-                      ("SERVING_TPU_LIVE.json", "tpu_serving_capture"),
-                      ("MOE_TPU_LIVE.json", "tpu_moe_dispatch_capture"),
-                      ("QUANT_TPU_LIVE.json", "tpu_quant_linear_capture"),
-                      ("KERNELS_TPU_LIVE.json", "tpu_kernel_sanity_capture"),
-                      ("ATTN_TPU_LIVE.json", "tpu_attn_sweep_capture")):
+    for name, key in LIVE_CAPTURE_SLOTS:
         path = os.path.join(here, name)
         try:
             with open(path) as f:

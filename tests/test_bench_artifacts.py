@@ -61,33 +61,12 @@ def test_bench_attaches_watcher_captures(tmp_path):
     sys.path.insert(0, REPO_ROOT)
     import bench
 
+    # drive EVERY slot from bench's own constant — a new slot added there
+    # is automatically exercised here
     captures = {
-        "BENCH_TPU_LIVE.json": ("tpu_capture",
-                                {"metric": "llama_zero3_train_mfu",
-                                 "value": 0.5,
-                                 "detail": {"backend": "tpu"}}),
-        "LONGCTX_TPU_LIVE.json": ("tpu_longctx_capture",
-                                  {"metric": "fpdt_longctx_max_seq",
-                                   "value": 131072,
-                                   "detail": {"backend": "tpu"}}),
-        "SERVING_TPU_LIVE.json": ("tpu_serving_capture",
-                                  {"metric": "serving_steady_tok_per_sec",
-                                   "value": 999.0,
-                                   "detail": {"backend": "tpu"}}),
-        "MOE_TPU_LIVE.json": ("tpu_moe_dispatch_capture",
-                              {"metric": "moe_dispatch_best_impl",
-                               "value": 1.5, "detail": {"backend": "tpu"}}),
-        "QUANT_TPU_LIVE.json": ("tpu_quant_linear_capture",
-                                {"metric": "int8_over_bf16", "value": 1.1,
-                                 "detail": {"backend": "tpu"}}),
-        "KERNELS_TPU_LIVE.json": ("tpu_kernel_sanity_capture",
-                                  {"metric": "pallas_kernel_sanity_pass",
-                                   "value": 8,
-                                   "detail": {"backend": "tpu"}}),
-        "ATTN_TPU_LIVE.json": ("tpu_attn_sweep_capture",
-                               {"metric": "flash_attn_fwdbwd_mfu_best",
-                                "value": 0.2,
-                                "detail": {"backend": "tpu"}}),
+        name: (key, {"metric": f"m_{i}", "value": float(i + 1),
+                     "detail": {"backend": "tpu"}})
+        for i, (name, key) in enumerate(bench.LIVE_CAPTURE_SLOTS)
     }
     for name, (_, content) in captures.items():
         with open(os.path.join(tmp_path, name), "w") as f:

@@ -109,3 +109,9 @@ def test_examples_run(tmp_path):
         capture_output=True, text=True, timeout=420, env=env)
     assert r.returncode == 0, r.stderr[-1500:]
     assert "fpdt train" in r.stdout and "splitfuse serve" in r.stdout
+    r = subprocess.run(
+        [sys.executable, os.path.join(root, "examples", "compress_model.py"),
+         "--tiny", "--steps", "8"],
+        capture_output=True, text=True, timeout=420, env=env)
+    assert r.returncode == 0, r.stderr[-1500:]
+    assert "COMPRESS_EXAMPLE_OK" in r.stdout and "sparse" in r.stdout
