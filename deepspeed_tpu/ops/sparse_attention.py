@@ -83,10 +83,10 @@ def blocksparse_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     (static). Tokens attend iff their blocks are connected AND (optionally)
     causally ordered.
 
-    Kernel path (default on TPU): the Pallas block-sparse flash kernel SKIPS
-    inactive blocks — work scales with layout density. Backward recomputes
-    through the dense-masked path (exact gradients; skipping bwd kernel is a
-    future optimization)."""
+    Kernel path (default on TPU): the Pallas block-sparse flash kernels SKIP
+    inactive blocks in BOTH directions — the backward streams the same
+    compacted block lists with the forward's saved logsumexp, so training
+    compute and memory scale with layout density, not S²."""
     s = q.shape[1]
     if s % block_size:
         raise ValueError(f"seq {s} not divisible by block {block_size}")
@@ -112,25 +112,51 @@ def blocksparse_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
 def _kernel_vjp(layout_bytes: bytes, nb: int, block_size: int, causal: bool,
                 scale: Optional[float]):
     """One cached custom_vjp closure per (layout, geometry) — a per-call
-    closure would defeat JAX's function-identity trace caches."""
-    from .pallas.sparse_attention import sparse_flash_attention_fwd
+    closure would defeat JAX's function-identity trace caches. Forward AND
+    backward run the skipping Pallas kernels (round 5): the backward
+    streams the same compacted block lists with the forward's saved lse,
+    so sparse training cost scales with layout density, not S²."""
+    from .attention import repeat_kv
+    from .pallas.sparse_attention import (_sparse_fwd_lse,
+                                          sparse_flash_attention_bwd)
 
     lay = np.frombuffer(layout_bytes, bool).reshape(nb, nb)
 
+    def _widened(q, k, v):
+        h = q.shape[2]
+        sc = q.shape[-1] ** -0.5 if scale is None else scale
+        kw, vw = repeat_kv(k, h), repeat_kv(v, h)
+        o, lse = _sparse_fwd_lse(q, kw, vw, lay, block_size, causal=causal,
+                                 scale=sc)
+        return o, lse, kw, vw, sc
+
     @jax.custom_vjp
     def _sparse(q, k, v):
-        return sparse_flash_attention_fwd(q, k, v, lay, block_size,
-                                          causal=causal, scale=scale)
+        return _widened(q, k, v)[0]
 
     def _fwd(q, k, v):
-        return _sparse(q, k, v), (q, k, v)
+        o, lse, _, _, _ = _widened(q, k, v)
+        # residuals stay NARROW: k/v re-widen in _bwd (repeat_kv is cheap,
+        # the widened copies are h/hkv× the memory) and lse keeps one lane
+        # of its 128-replicated layout
+        return o, (q, k, v, o, lse[..., :1])
 
     def _bwd(res, g):
-        q, k, v = res
-        _, vjp = jax.vjp(
-            lambda q_, k_, v_: _dense_masked(q_, k_, v_, lay, block_size,
-                                             causal, scale), q, k, v)
-        return vjp(g)
+        q, k, v, o, lse1 = res
+        h, hkv = q.shape[2], k.shape[2]
+        sc = q.shape[-1] ** -0.5 if scale is None else scale
+        kw, vw = repeat_kv(k, h), repeat_kv(v, h)
+        lse = jnp.broadcast_to(lse1, lse1.shape[:-1] + (128,))
+        dq, dk, dv = sparse_flash_attention_bwd(
+            q, kw, vw, o, lse, g, lay, block_size, causal=causal, scale=sc)
+
+        def narrow(dwide):
+            if hkv == h:
+                return dwide
+            b, s, _, d = dwide.shape
+            return dwide.reshape(b, s, hkv, h // hkv, d).sum(axis=3)
+
+        return dq, narrow(dk), narrow(dv)
 
     _sparse.defvjp(_fwd, _bwd)
     return _sparse

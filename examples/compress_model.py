@@ -72,17 +72,19 @@ def main():
 
     # The compression transforms are pure pytree functions the scheduler
     # drives: feed each step's (params, grads) into observe_gradients — the
-    # snip_momentum saliency is |w * dL/dw|, so it needs REAL gradients (in
-    # a custom loop, reuse the step's grads; here one probe grad per step):
+    # snip_momentum saliency is |w * dL/dw|, so it needs REAL gradients. In
+    # a training loop you pass each step's fresh grads; params and batch are
+    # fixed in this demo, so ONE probe gradient serves every step (the loop
+    # below only advances the pruning schedule):
     def loss_fn(p):
         logits = llama.apply(mcfg, p, jnp.asarray(batch["tokens"][:, :-1]))
         tgt = jnp.asarray(batch["tokens"][:, 1:])
         lp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
         return -jnp.mean(jnp.take_along_axis(lp, tgt[..., None], -1))
 
-    grad_fn = jax.jit(jax.grad(loss_fn))
+    probe_grads = jax.jit(jax.grad(loss_fn))(raw)
     for step in range(args.steps):
-        sched.observe_gradients(raw, grad_fn(raw), step)
+        sched.observe_gradients(raw, probe_grads, step)
     pruned = sched.transform(raw, step=args.steps)
     total = kept = 0
     for leaf in jax.tree.leaves(pruned):

@@ -216,11 +216,13 @@ def main():
 
     check("quantize/dequantize_int8", quant)
 
-    # block-sparse attention vs dense-masked reference
+    # block-sparse attention vs dense-masked reference (fwd AND the round-5
+    # skipping backward through the custom-vjp path)
     def sparse():
         from deepspeed_tpu.ops.attention import attention_xla
         from deepspeed_tpu.ops.pallas.sparse_attention import (
             sparse_flash_attention_fwd)
+        from deepspeed_tpu.ops.sparse_attention import blocksparse_attention
 
         bs, nb = 128, 4
         layout = np.tril(np.ones((nb, nb), bool))
@@ -235,6 +237,15 @@ def main():
                                   >= jnp.arange(bs * nb)[None, None, None, :])
         ref = attention_xla(q, k, v, causal=False, mask=mask)
         diff_ok(out, ref, 0.05)
+
+        def loss(use_kernel, q):
+            return jnp.sum(blocksparse_attention(
+                q, k, v, layout, bs, causal=True,
+                use_kernel=use_kernel).astype(jnp.float32) ** 2)
+
+        gk = jax.grad(lambda q: loss(True, q))(q)
+        gx = jax.grad(lambda q: loss(False, q))(q)
+        diff_ok(gk, gx, 1.0)  # bf16 grad-scale tolerance; NaN/shape guard
 
     check("sparse_flash_attention", sparse)
 

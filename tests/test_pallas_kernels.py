@@ -346,3 +346,35 @@ def test_flash_block_preference_order(monkeypatch, tmp_path):
         lambda *a: str(tuned) if a[-1] == ".dstpu_tuned.json"
         else real_join(*a))
     assert fa._tuned_default() == 768
+
+
+def test_blocksparse_bwd_gqa_and_empty_kv_columns():
+    """Round-5 skipping backward: GQA-narrow KV gets group-summed grads
+    identical to the dense-masked reference, and a kv block NO q block
+    attends to receives exactly zero dk/dv."""
+    from deepspeed_tpu.ops.sparse_attention import blocksparse_attention
+
+    rs = np.random.RandomState(7)
+    b, s, h, hkv, d, bs = 2, 128, 4, 2, 32, 16
+    nb = s // bs
+    q = jnp.asarray(rs.randn(b, s, h, d).astype(np.float32))
+    k = jnp.asarray(rs.randn(b, s, hkv, d).astype(np.float32))
+    v = jnp.asarray(rs.randn(b, s, hkv, d).astype(np.float32))
+    # row i attends block 0 and itself — except row 1, which attends ONLY
+    # block 0, leaving COLUMN 1 with no attenders
+    layout = np.eye(nb, dtype=bool)
+    layout[:, 0] = True
+    layout[1, 1] = False
+    for use_kernel in (False, True):
+        g = jax.grad(lambda q_, k_, v_: jnp.sum(blocksparse_attention(
+            q_, k_, v_, layout, bs, causal=False,
+            use_kernel=use_kernel) ** 2), argnums=(0, 1, 2))(q, k, v)
+        if not use_kernel:
+            g_ref = g
+    for gr, gk, name in zip(g_ref, g, "qkv"):
+        np.testing.assert_allclose(np.asarray(gk), np.asarray(gr),
+                                   rtol=2e-4, atol=2e-4, err_msg=name)
+    # the unattended kv block's grads are exactly zero
+    dk, dv = np.asarray(g[1]), np.asarray(g[2])
+    assert (dk[:, bs:2 * bs] == 0).all() and (dv[:, bs:2 * bs] == 0).all()
+    assert np.abs(dk).sum() > 0  # and the rest is not trivially zero

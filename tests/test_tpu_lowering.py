@@ -193,3 +193,23 @@ def test_norms_quantize_sparse_lower_for_tpu(monkeypatch):
         jax.jit(lambda q, k, v: psparse.sparse_flash_attention_fwd(
             q, k, v, layout, bs, causal=True)),
         platforms=["tpu"])(q, q, q)
+
+
+def test_blocksparse_bwd_lowers_for_tpu(monkeypatch):
+    """The skipping sparse backward (dq + transposed dk/dv streams) must
+    pass the host-side Mosaic validation at TPU-real geometry."""
+    from deepspeed_tpu.ops import sparse_attention as sparse_mod
+    from deepspeed_tpu.ops.pallas import sparse_attention as psparse
+
+    monkeypatch.setattr(psparse, "_interpret", lambda: False)
+    bs, nb = 128, 4
+    layout = np.tril(np.ones((nb, nb), bool))
+    q = jnp.zeros((1, bs * nb, 4, 128), jnp.bfloat16)
+
+    def loss(q, k, v):
+        fn = sparse_mod._kernel_vjp(
+            np.asarray(layout, bool).tobytes(), nb, bs, True, None)
+        return jnp.sum(fn(q, k, v).astype(jnp.float32) ** 2)
+
+    g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+    export.export(g, platforms=["tpu"])(q, q, q)
