@@ -24,10 +24,10 @@ def paged_attention_step(q, k, v, k_cache, v_cache, block_tables,
     """Scatter this step's K/V into the block pool, then attend over it.
 
     q [b, t, nh, hd]; k/v [b, t, nkv, hd]. ``window``: optional per-layer
-    sliding-window length (traced scalar) — when given, the gathered-view
-    mask path runs (the plain-causal Pallas decode kernel cannot window);
-    when None, single-token decode dispatches the paged flash-decode kernel.
-    Returns (attn_out [b, t, nh, hd], k_cache, v_cache)."""
+    sliding-window length (int or traced scalar — exaone4 scans per-layer
+    windows). Single-token decode dispatches the paged flash-decode kernel
+    (windowed or plain-causal); multi-token prefill takes the gathered-view
+    mask path. Returns (attn_out [b, t, nh, hd], k_cache, v_cache)."""
     b, t = q.shape[0], q.shape[1]
     nkv, hd = k.shape[-2], k.shape[-1]
     bs = k_cache.shape[2]
@@ -41,12 +41,13 @@ def paged_attention_step(q, k, v, k_cache, v_cache, block_tables,
     k_cache = k_cache.at[blk_idx, :, off].set(k.astype(k_cache.dtype))
     v_cache = v_cache.at[blk_idx, :, off].set(v.astype(v_cache.dtype))
 
-    if t == 1 and window is None:
+    if t == 1:
         from ..ops import pallas as _pallas_ops  # noqa: F401 (registers)
         from ..ops.registry import get_op
 
         out = get_op("paged_decode_attention")(
-            q[:, 0], k_cache, v_cache, block_tables, context_lens)[:, None]
+            q[:, 0], k_cache, v_cache, block_tables, context_lens,
+            window=window)[:, None]
     else:
         S = max_blocks * bs
         kg = k_cache[block_tables].swapaxes(2, 3).reshape(b, S, nkv, hd)

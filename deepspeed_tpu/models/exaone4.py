@@ -332,9 +332,10 @@ def apply_paged(cfg: Exaone4Config, params: Params, tokens: jnp.ndarray,
     def scan_body(x, scanned):
         layer, k_c, v_c, window, rope = scanned
         q, k, v = _qkv(cfg, x, layer, cos, sin, positions, rope)
-        # pure-global configs (static) take window=None → the plain-causal
-        # Pallas decode kernel applies; hybrid configs pass the traced
-        # per-layer window and run the gathered-view mask path
+        # pure-global configs (static) take window=None (plain-causal
+        # decode kernel); hybrid configs pass the traced per-layer window —
+        # single-token decode runs the WINDOWED Pallas kernel (the window
+        # rides scalar prefetch), prefill takes the gathered mask path
         attn_out, k_c, v_c = paged_attention_step(
             q, k, v, k_c, v_c, block_tables, context_lens, positions, valid,
             window=None if cfg.sliding_window is None else window)

@@ -43,8 +43,14 @@ from ._common import interpret as _interpret
 NEG_INF = -1e30
 
 
-def _decode_kernel(tables_ref, ctx_ref, q_ref, k_ref, v_ref, o_ref,
-                   m_scr, l_scr, acc_scr, *, bs, scale, nblk, gpad):
+def _decode_kernel(*refs, bs, scale, nblk, gpad, has_window):
+    if has_window:
+        (tables_ref, ctx_ref, wnd_ref, q_ref, k_ref, v_ref, o_ref,
+         m_scr, l_scr, acc_scr) = refs
+    else:
+        (tables_ref, ctx_ref, q_ref, k_ref, v_ref, o_ref,
+         m_scr, l_scr, acc_scr) = refs
+        wnd_ref = None
     b = pl.program_id(0)
     j = pl.program_id(2)
 
@@ -55,8 +61,16 @@ def _decode_kernel(tables_ref, ctx_ref, q_ref, k_ref, v_ref, o_ref,
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
     ctx = ctx_ref[b] + 1  # current token attends to itself too
+    # sliding window: only positions in (ctx-1-w, ctx-1] are visible; blocks
+    # entirely older than the window skip their compute (their DMA still
+    # runs — the table entry is whatever the scheduler left there)
+    if has_window:
+        lo = ctx_ref[b] - wnd_ref[0]
+        live = jnp.logical_and(j * bs < ctx, j * bs + bs - 1 > lo)
+    else:
+        live = j * bs < ctx
 
-    @pl.when(j * bs < ctx)
+    @pl.when(live)
     def _compute():
         q = q_ref[...]                     # [gpad, hd]
         k = k_ref[...]                     # [bs, hd]
@@ -64,7 +78,10 @@ def _decode_kernel(tables_ref, ctx_ref, q_ref, k_ref, v_ref, o_ref,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         pos = j * bs + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        s = jnp.where(pos < ctx, s, NEG_INF)
+        valid = pos < ctx
+        if has_window:
+            valid = jnp.logical_and(valid, pos > lo)
+        s = jnp.where(valid, s, NEG_INF)
 
         m_prev, l_prev = m_scr[...], l_scr[...]
         m_curr = jnp.max(s, axis=1, keepdims=True)
@@ -88,58 +105,78 @@ def _decode_kernel(tables_ref, ctx_ref, q_ref, k_ref, v_ref, o_ref,
 def paged_decode_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
                            v_pool: jnp.ndarray, block_tables: jnp.ndarray,
                            context_lens: jnp.ndarray, *,
-                           scale: float = None) -> jnp.ndarray:
-    """See module docstring. Returns [B, nh, hd]."""
+                           scale: float = None,
+                           window=None) -> jnp.ndarray:
+    """See module docstring. Returns [B, nh, hd]. ``window``: optional
+    sliding-window length (int or traced scalar — exaone4 scans per-layer
+    windows): only the last ``window`` positions are attended; blocks
+    entirely outside the window skip their compute."""
     B, nh, hd = q.shape
     nblocks, nkv, bs, _ = k_pool.shape
     max_blocks = block_tables.shape[1]
     g = nh // nkv
     gpad = max(8, 1 << (g - 1).bit_length())  # sublane-pad the query group
     scale = hd ** -0.5 if scale is None else scale
+    has_window = window is not None
 
     # [B, nkv, gpad, hd] query groups
     qg = q.reshape(B, nkv, g, hd)
     qg = jnp.pad(qg, ((0, 0), (0, 0), (0, gpad - g), (0, 0)))
 
     kernel = functools.partial(_decode_kernel, bs=bs, scale=float(scale),
-                               nblk=max_blocks, gpad=gpad)
+                               nblk=max_blocks, gpad=gpad,
+                               has_window=has_window)
+
+    # index maps are called positionally with one trailing arg per
+    # prefetched scalar array — varargs serves both arities. Dead grid
+    # steps (past the context, or older than the window) FOLD onto the
+    # nearest live block index: Pallas elides the DMA when consecutive
+    # steps map to the same block, so HBM traffic stays "exactly the live
+    # context" with or without a window.
+    def qmap(b, h, j, *_):
+        return (b, h, 0, 0)
+
+    def kvmap(b, h, j, tables, ctx, *rest):
+        hi_blk = ctx[b] // bs              # block holding the current token
+        lo_blk = (jnp.maximum(ctx[b] - rest[0][0] + 1, 0) // bs
+                  if rest else 0)
+        j_eff = jnp.clip(j, lo_blk, hi_blk)
+        return (jnp.clip(tables[b, j_eff], 0, nblocks - 1), h, 0, 0)
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,  # block_tables, context_lens
+        num_scalar_prefetch=2 + int(has_window),
         grid=(B, nkv, max_blocks),
         in_specs=[
-            pl.BlockSpec((None, None, gpad, hd),
-                         lambda b, h, j, tables, ctx: (b, h, 0, 0)),
-            # the paged read: pool block chosen by the table (trash block 0
-            # for out-of-range entries is whatever the table holds there)
-            pl.BlockSpec((None, None, bs, hd),
-                         lambda b, h, j, tables, ctx: (
-                             jnp.clip(tables[b, j], 0, nblocks - 1), h, 0, 0)),
-            pl.BlockSpec((None, None, bs, hd),
-                         lambda b, h, j, tables, ctx: (
-                             jnp.clip(tables[b, j], 0, nblocks - 1), h, 0, 0)),
+            pl.BlockSpec((None, None, gpad, hd), qmap),
+            # the paged read: pool block chosen by the table
+            pl.BlockSpec((None, None, bs, hd), kvmap),
+            pl.BlockSpec((None, None, bs, hd), kvmap),
         ],
-        out_specs=pl.BlockSpec((None, None, gpad, hd),
-                               lambda b, h, j, tables, ctx: (b, h, 0, 0)),
+        out_specs=pl.BlockSpec((None, None, gpad, hd), qmap),
         scratch_shapes=[
             pltpu.VMEM((gpad, 128), jnp.float32),
             pltpu.VMEM((gpad, 128), jnp.float32),
             pltpu.VMEM((gpad, hd), jnp.float32),
         ],
     )
+    prefetch = [block_tables.astype(jnp.int32),
+                context_lens.astype(jnp.int32)]
+    if has_window:
+        prefetch.append(jnp.asarray(window, jnp.int32).reshape(1))
     out = pl.pallas_call(
         kernel, grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, nkv, gpad, hd), q.dtype),
         compiler_params=_dim_semantics("parallel", "parallel", "arbitrary"),
         interpret=_interpret(),
-    )(block_tables.astype(jnp.int32), context_lens.astype(jnp.int32),
-      qg, k_pool, v_pool)
+    )(*prefetch, qg, k_pool, v_pool)
     return out[:, :, :g].reshape(B, nh, hd)
 
 
 def paged_decode_attention_xla(q: jnp.ndarray, k_pool: jnp.ndarray,
                                v_pool: jnp.ndarray, block_tables: jnp.ndarray,
                                context_lens: jnp.ndarray, *,
-                               scale: float = None) -> jnp.ndarray:
+                               scale: float = None,
+                               window=None) -> jnp.ndarray:
     """Dense-gather fallback with identical semantics (compiled XLA — the
     right choice off-TPU, where the Pallas path runs interpreted)."""
     from ..attention import attention_xla
@@ -151,7 +188,10 @@ def paged_decode_attention_xla(q: jnp.ndarray, k_pool: jnp.ndarray,
     kg = k_pool[block_tables].swapaxes(2, 3).reshape(B, S, nkv, hd)
     vg = v_pool[block_tables].swapaxes(2, 3).reshape(B, S, nkv, hd)
     kv_pos = jnp.arange(S)[None, None, None, :]
-    mask = kv_pos <= context_lens[:, None, None, None]
+    cl = context_lens[:, None, None, None]
+    mask = kv_pos <= cl
+    if window is not None:
+        mask = mask & (kv_pos > cl - jnp.asarray(window, jnp.int32))
     out = attention_xla(q[:, None], kg, vg, causal=False, mask=mask,
                         scale=scale)
     return out[:, 0]

@@ -100,6 +100,11 @@ def main():
         cl = jnp.asarray([0, 17, 100], np.int32)
         diff_ok(paged_decode_attention(q, kp, vp, bt, cl),
                 paged_decode_attention_xla(q, kp, vp, bt, cl), 0.05)
+        # sliding-window variant (mistral/exaone4 serving): extra prefetched
+        # scalar + window masking — silicon numerics are chip-only
+        diff_ok(paged_decode_attention(q, kp, vp, bt, cl, window=32),
+                paged_decode_attention_xla(q, kp, vp, bt, cl, window=32),
+                0.05)
 
     check("paged_decode_attention", paged)
 

@@ -213,3 +213,17 @@ def test_blocksparse_bwd_lowers_for_tpu(monkeypatch):
 
     g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
     export.export(g, platforms=["tpu"])(q, q, q)
+
+
+def test_paged_decode_windowed_lowers_for_tpu(mosaic_lowering):
+    """The windowed decode variant (extra prefetched scalar) must pass the
+    Mosaic validation at serving pool sizes too."""
+    B, nblocks, max_blocks, nh, nkv, bs, hd = 32, 744, 64, 8, 4, 32, 128
+    q = jnp.zeros((B, nh, hd), jnp.bfloat16)
+    pool = jnp.zeros((nblocks, nkv, bs, hd), jnp.bfloat16)
+    bt = jnp.zeros((B, max_blocks), jnp.int32)
+    cl = jnp.zeros((B,), jnp.int32)
+    f = jax.jit(lambda q, kp, vp, bt, cl, w:
+                pa.paged_decode_attention(q, kp, vp, bt, cl, window=w))
+    export.export(f, platforms=["tpu"])(
+        q, pool, pool, bt, cl, jnp.asarray(4096, jnp.int32))
