@@ -87,6 +87,13 @@ while true; do
     # Artifact + log line are unconditional (round-4 gate produced nothing);
     # 1800s: the fpdt-128K AOT compile check can be multi-minute cold.
     run_probe KERNELS scripts/tpu_kernel_sanity.py 1800 KERNELS_TPU_LIVE.json
+    # the three ZERO-evidence round-5 targets capture before the headline
+    # (which already has a credible r4 TPU capture) — a short window must
+    # prove serving/longctx/MoE first; each probe checks for a mid-cycle
+    # HOLD so an interactive session waits at most one probe
+    hold_requested || run_probe SERVING scripts/serving_bench.py 1800 SERVING_TPU_LIVE.json
+    hold_requested || run_probe LONGCTX scripts/longctx_bench.py 2400 LONGCTX_TPU_LIVE.json
+    hold_requested || run_probe MOE scripts/moe_dispatch_bench.py 1200 MOE_TPU_LIVE.json
     # full headline bench incl. shape rows (first compiles are slow)
     if ! hold_requested; then
       bts=$(date -u +%Y%m%dT%H%M%SZ)
@@ -102,12 +109,6 @@ while true; do
         echo "[watch] $bts bench rc=$rc NOT promoted" >> "$LOG"
       fi
     fi
-    # sub-benches run regardless of headline outcome — independent evidence;
-    # each checks for a mid-cycle HOLD so an interactive session waits at
-    # most one probe, not the whole cycle
-    hold_requested || run_probe LONGCTX scripts/longctx_bench.py 2400 LONGCTX_TPU_LIVE.json
-    hold_requested || run_probe SERVING scripts/serving_bench.py 1800 SERVING_TPU_LIVE.json
-    hold_requested || run_probe MOE scripts/moe_dispatch_bench.py 1200 MOE_TPU_LIVE.json
     hold_requested || run_probe QUANT scripts/quant_linear_bench.py 1200 QUANT_TPU_LIVE.json
     # attention block sweep LAST: it may write .dstpu_tuned.json, which the
     # NEXT cycle's headline bench then picks up as the kernel default
