@@ -42,6 +42,11 @@ shutil.copy(src, dst)
 EOF
 }
 
+past_deadline() {
+  [ "${DSTPU_WATCH_UNTIL:-0}" -gt 0 ] && \
+    [ "$(date -u +%s)" -ge "${DSTPU_WATCH_UNTIL}" ]
+}
+
 hold_requested() {
   if [ -e bench_runs/HOLD ]; then
     # skipped probes mean this cycle did NOT capture everything — stay on
@@ -56,6 +61,11 @@ hold_requested() {
 run_probe() {
   # run_probe NAME SCRIPT TIMEOUT LIVE_SLOT — sets CYCLE_OK=0 on failure
   local name=$1 script=$2 tmo=$3 live=$4 ts rc
+  if past_deadline; then
+    CYCLE_OK=0
+    echo "[watch] $(date -u +%Y%m%dT%H%M%SZ) ${name} skipped: deadline" >> "$LOG"
+    return 0
+  fi
   ts=$(date -u +%Y%m%dT%H%M%SZ)
   # -k 120: TERM first (the probes' handlers emit partial artifacts), KILL
   # 120s later if the process is wedged inside a native compile
@@ -70,6 +80,12 @@ run_probe() {
 }
 
 while true; do
+  # stand down before the round driver needs the exclusive chip for its own
+  # bench run (DSTPU_WATCH_UNTIL: epoch seconds; 0 = run forever)
+  if [ "${DSTPU_WATCH_UNTIL:-0}" -gt 0 ] && [ "$(date -u +%s)" -ge "${DSTPU_WATCH_UNTIL}" ]; then
+    echo "[watch] $(date -u +%FT%TZ) deadline reached — standing down for the driver" >> "$LOG"
+    exit 0
+  fi
   ts=$(date -u +%Y%m%dT%H%M%SZ)
   if [ -e bench_runs/HOLD ]; then
     # an interactive session asked for the chip — skip this cycle entirely
@@ -95,7 +111,7 @@ while true; do
     hold_requested || run_probe LONGCTX scripts/longctx_bench.py 2400 LONGCTX_TPU_LIVE.json
     hold_requested || run_probe MOE scripts/moe_dispatch_bench.py 1200 MOE_TPU_LIVE.json
     # full headline bench incl. shape rows (first compiles are slow)
-    if ! hold_requested; then
+    if ! hold_requested && ! past_deadline; then
       bts=$(date -u +%Y%m%dT%H%M%SZ)
       DSTPU_BENCH_SHAPES=1 timeout -k 120 3000 python bench.py \
         > "bench_runs/BENCH_tpu_${bts}.json" 2> "bench_runs/bench_${bts}.err"
