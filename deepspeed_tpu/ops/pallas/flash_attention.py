@@ -84,6 +84,58 @@ def _block_mask(qi, ki, *, causal, bq, bkv, kv_len, q_offset):
     return mask
 
 
+def _fold_kv(qi, ki, *, bq, bkv, q_offset):
+    """Clamp a causal-dead kv block index onto the diagonal band: blocks
+    strictly above the diagonal compute nothing, so their BlockSpec index
+    folds to the last participating block — consecutive grid steps then
+    map to the same block and Pallas elides the DMA. Halves causal K/V
+    HBM traffic (same trick as the paged kernel's dead-step fold)."""
+    j_max = jnp.maximum((qi * bq + (bq - 1) + q_offset) // bkv, 0)
+    return jnp.minimum(ki, j_max)
+
+
+def _fold_q(qi, ki, *, bq, bkv, q_offset, nq):
+    """dkv-kernel counterpart: clamp a dead Q block index up to the first
+    participating one for kv block ki (qi*bq+bq-1+q_offset >= ki*bkv).
+    Upper clamp to nq-1: with kv_len > sq (legal — trailing keys are fully
+    masked) a kv block past the last q row has NO participant and the
+    unclamped first-participant index would run off the q array."""
+    q_min = jnp.maximum((ki * bkv - q_offset) // bq, 0)
+    return jnp.minimum(jnp.maximum(qi, q_min), nq - 1)
+
+
+def _fold_maps(*, causal, bq, bkv, q_offset):
+    """(kvmap, biasmap) for the q-major grids (b, qi, ki) — ONE builder
+    shared by _flash_fwd and the dq backward so the fold cannot drift."""
+    if not causal:
+        return (lambda b, i, j: (b, j, 0)), (lambda b, i, j: (b, i, j))
+
+    def kvmap(b, i, j):
+        return (b, _fold_kv(i, j, bq=bq, bkv=bkv, q_offset=q_offset), 0)
+
+    def biasmap(b, i, j):
+        return (b, i, _fold_kv(i, j, bq=bq, bkv=bkv, q_offset=q_offset))
+
+    return kvmap, biasmap
+
+
+def _fold_maps_dkv(*, causal, bq, bkv, q_offset, nq):
+    """(qmap, biasmap) for the kv-major dkv grid (b, ki, qi); qmap also
+    serves the do/lse/delta specs."""
+    if not causal:
+        return (lambda b, j, i: (b, i, 0)), (lambda b, j, i: (b, i, j))
+
+    def qmap(b, j, i):
+        return (b, _fold_q(i, j, bq=bq, bkv=bkv, q_offset=q_offset, nq=nq),
+                0)
+
+    def biasmap(b, j, i):
+        return (b, _fold_q(i, j, bq=bq, bkv=bkv, q_offset=q_offset, nq=nq),
+                j)
+
+    return qmap, biasmap
+
+
 _TUNED_CACHE: dict = {}
 
 
@@ -221,15 +273,17 @@ def _flash_fwd(q, k, v, bias=None, *, causal, scale, q_offset):
     nq = qp.shape[1] // bq
     nkv = kp.shape[1] // bkv
 
+    kvmap, biasmap = _fold_maps(causal=causal, bq=bq, bkv=bkv,
+                                q_offset=q_offset)
     in_specs = [
         pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-        pl.BlockSpec((1, bkv, d), lambda b, i, j: (b, j, 0)),
-        pl.BlockSpec((1, bkv, d), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, bkv, d), kvmap),
+        pl.BlockSpec((1, bkv, d), kvmap),
     ]
     args = [qp, kp, vp]
     if bias is not None:
         bp = _pad_to(_pad_to(bias, 1, bq), 2, bkv)
-        in_specs.append(pl.BlockSpec((1, bq, bkv), lambda b, i, j: (b, i, j)))
+        in_specs.append(pl.BlockSpec((1, bq, bkv), biasmap))
         args.append(bp)
 
     kernel = functools.partial(
@@ -401,10 +455,15 @@ def _flash_bwd(q, k, v, o, lse, do, bias=None, *, causal, scale, q_offset):
     delta = _pad_to(delta, 1, bq)
     lsep = _pad_to(lse, 1, bq)
 
+    # causal: fold dead (above-diagonal) steps' INPUT fetches onto the
+    # diagonal band so their DMA is elided; output specs never fold (dead
+    # dbias blocks must still write their zeros to the right slot)
+    kvmap_dq, biasmap_dq = _fold_maps(causal=causal, bq=bq, bkv=bkv,
+                                      q_offset=q_offset)
     dq_in_specs = [
         pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-        pl.BlockSpec((1, bkv, d), lambda b, i, j: (b, j, 0)),
-        pl.BlockSpec((1, bkv, d), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, bkv, d), kvmap_dq),
+        pl.BlockSpec((1, bkv, d), kvmap_dq),
         pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
         pl.BlockSpec((1, bq, 128), lambda b, i, j: (b, i, 0)),
         pl.BlockSpec((1, bq, 128), lambda b, i, j: (b, i, 0)),
@@ -414,8 +473,7 @@ def _flash_bwd(q, k, v, o, lse, do, bias=None, *, causal, scale, q_offset):
     dq_out_shape = jax.ShapeDtypeStruct((bh, qp.shape[1], d), q.dtype)
     if has_bias:
         bp = _pad_to(_pad_to(bias, 1, bq), 2, bkv)
-        dq_in_specs.append(pl.BlockSpec((1, bq, bkv),
-                                        lambda b, i, j: (b, i, j)))
+        dq_in_specs.append(pl.BlockSpec((1, bq, bkv), biasmap_dq))
         dq_args.append(bp)
         dq_out_specs = [dq_out_specs,
                         pl.BlockSpec((1, bq, bkv), lambda b, i, j: (b, i, j))]
@@ -440,18 +498,21 @@ def _flash_bwd(q, k, v, o, lse, do, bias=None, *, causal, scale, q_offset):
     else:
         dq, dbias = dq_out, None
 
+    # dkv mirror: dead steps are q blocks ABOVE kv block j's band — clamp
+    # the q-side fetches (q/do/lse/delta/bias) up to the first participant
+    qmap_dkv, biasmap_dkv = _fold_maps_dkv(causal=causal, bq=bq, bkv=bkv,
+                                           q_offset=q_offset, nq=nq)
     dkv_in_specs = [
-        pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),
+        pl.BlockSpec((1, bq, d), qmap_dkv),
         pl.BlockSpec((1, bkv, d), lambda b, j, i: (b, j, 0)),
         pl.BlockSpec((1, bkv, d), lambda b, j, i: (b, j, 0)),
-        pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),
-        pl.BlockSpec((1, bq, 128), lambda b, j, i: (b, i, 0)),
-        pl.BlockSpec((1, bq, 128), lambda b, j, i: (b, i, 0)),
+        pl.BlockSpec((1, bq, d), qmap_dkv),
+        pl.BlockSpec((1, bq, 128), qmap_dkv),
+        pl.BlockSpec((1, bq, 128), qmap_dkv),
     ]
     dkv_args = [qp, kp, vp, dop, lsep, delta]
     if has_bias:
-        dkv_in_specs.append(pl.BlockSpec((1, bq, bkv),
-                                         lambda b, j, i: (b, i, j)))
+        dkv_in_specs.append(pl.BlockSpec((1, bq, bkv), biasmap_dkv))
         dkv_args.append(bp)
 
     dk, dv = pl.pallas_call(

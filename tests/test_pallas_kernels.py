@@ -411,3 +411,31 @@ def test_paged_decode_sliding_window():
     full = paged_decode_attention(q, kp, vp, bt, cl)
     win = paged_decode_attention(q, kp, vp, bt, cl, window=16)
     assert np.abs(np.asarray(full[2]) - np.asarray(win[2])).max() > 1e-3
+
+
+def test_flash_causal_kv_longer_than_q():
+    """kv_len > sq with causal=True is API-legal (trailing keys fully
+    masked); the dead-step DMA fold must clamp the dkv kernel's q-side
+    index to the last real q block (round-5 OOB regression)."""
+    from deepspeed_tpu.ops.attention import attention_xla
+    from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+
+    rs = np.random.RandomState(5)
+    b, sq, skv, h, d = 1, 64, 192, 2, 32
+    q = jnp.asarray(rs.randn(b, sq, h, d).astype(np.float32))
+    k = jnp.asarray(rs.randn(b, skv, h, d).astype(np.float32))
+    v = jnp.asarray(rs.randn(b, skv, h, d).astype(np.float32))
+
+    def loss(fn, q, k, v):
+        return jnp.sum(fn(q, k, v, causal=True).astype(jnp.float32) ** 2)
+
+    np.testing.assert_allclose(
+        np.asarray(flash_attention(q, k, v, causal=True)),
+        np.asarray(attention_xla(q, k, v, causal=True)),
+        rtol=2e-5, atol=2e-5)
+    gk = jax.grad(lambda k_: loss(flash_attention, q, k_, v))(k)
+    gx = jax.grad(lambda k_: loss(attention_xla, q, k_, v))(k)
+    np.testing.assert_allclose(np.asarray(gk), np.asarray(gx),
+                               rtol=2e-4, atol=2e-4)
+    # trailing (fully-masked) keys must receive exactly zero gradient
+    assert (np.asarray(gk)[:, sq:] == 0).all()
