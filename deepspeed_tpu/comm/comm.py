@@ -35,39 +35,145 @@ AxisName = Union[str, Sequence[str]]
 # --------------------------------------------------------------------------- #
 # telemetry (comms-logger parity)
 # --------------------------------------------------------------------------- #
+def _tree_bytes(x: Any) -> tuple:
+    """Total payload bytes + representative shape(s) for an arbitrary pytree
+    (arrays, scalars, dicts/lists of either). Leaves that carry no countable
+    payload (strings, None) contribute zero instead of poisoning the total."""
+    total = 0
+    shapes = []
+    for leaf in jax.tree_util.tree_leaves(x):
+        try:
+            shp = tuple(np.shape(leaf))
+            total += int(np.prod(shp, dtype=np.int64)) * \
+                jnp.result_type(leaf).itemsize
+            shapes.append(shp)
+        except Exception:
+            continue
+    shape = shapes[0] if len(shapes) == 1 else tuple(shapes)
+    return total, shape
+
+
+def _axis_world(axis: AxisName) -> int:
+    """Members of the axis (product over tuple axes); 0 when unknown. Reads
+    the installed global mesh only — never creates one as a side effect."""
+    names = axis if isinstance(axis, (tuple, list)) else (axis,)
+    try:
+        from . import mesh as _mesh_mod
+
+        mm = _mesh_mod._global_mesh
+        if mm is None:
+            return 0
+        return int(np.prod([mm.axis_size(a) for a in names]))
+    except Exception:
+        return 0
+
+
+# busbw convention (NCCL-style): wire bytes per member as a function of the
+# payload and the axis world size n. Keyed by op-name prefix.
+_ALGO_FACTORS = (
+    ("all_reduce", lambda b, n: 2.0 * b * (n - 1) / n),
+    ("inference_all_reduce", lambda b, n: 2.0 * b * (n - 1) / n),
+    ("all_gather", lambda b, n: float(b) * (n - 1)),
+    ("reduce_scatter", lambda b, n: b * (n - 1) / n),
+    ("all_to_all", lambda b, n: b * (n - 1) / n),
+    ("gather", lambda b, n: float(b) * (n - 1)),
+)
+
+
+def _algo_bytes(op: str, nbytes: int, world: int) -> float:
+    """Estimated algorithmic ("bus") bytes a member puts on the wire."""
+    if world == 1:
+        return 0.0
+    if world <= 0:  # axis size unknown at record time — report the payload
+        return float(nbytes)
+    for prefix, f in _ALGO_FACTORS:
+        if op.startswith(prefix):
+            return f(nbytes, world)
+    return float(nbytes)  # broadcast / ppermute / send_recv / scatter
+
+
+def _trace_site() -> str:
+    """Nearest stack frame outside this module — where the collective was
+    issued from (the reference comms logger's caller_func analog)."""
+    import traceback
+
+    this = os.path.abspath(__file__)
+    for fr in reversed(traceback.extract_stack()):
+        if os.path.abspath(fr.filename) != this:
+            return f"{os.path.basename(fr.filename)}:{fr.lineno}"
+    return "?"
+
+
 @dataclass
 class CommsTelemetry:
-    """Records every traced collective: op name, axis, bytes. Since collectives
-    are compile-time constructs, records are per-trace (not per-step) — one
-    entry describes what every execution of the compiled step does."""
+    """Records every traced collective: op name, axis, payload bytes,
+    trace-site, and estimated algorithmic (bus) bytes. Since collectives are
+    compile-time constructs, records are per-trace (not per-step) — one entry
+    describes what every execution of the compiled step does. Byte accounting
+    is pytree-aware: payloads may be arrays, scalars, or nested containers.
+
+    ``prof_all``/``prof_ops`` mirror the reference comms-logger config
+    (``utils/comms_logging.py``): with ``prof_all`` off, only ops whose name
+    starts with an entry of ``prof_ops`` are recorded."""
 
     enabled: bool = False
     verbose: bool = False
+    prof_all: bool = True
+    debug: bool = False
+    prof_ops: List[str] = field(default_factory=list)
     records: List[Dict[str, Any]] = field(default_factory=list)
 
+    def _profiled(self, op: str) -> bool:
+        if self.prof_all:
+            return True
+        return any(op == p or op.startswith(p) for p in self.prof_ops)
+
     def record(self, op: str, axis: AxisName, x: Any) -> None:
-        if not self.enabled:
+        if not self.enabled or not self._profiled(op):
             return
-        try:
-            nbytes = int(np.prod(np.shape(x))) * jnp.result_type(x).itemsize
-        except Exception:
-            nbytes = -1
-        rec = {"op": op, "axis": axis, "bytes": nbytes, "shape": tuple(np.shape(x))}
+        nbytes, shape = _tree_bytes(x)
+        world = _axis_world(axis)
+        rec = {"op": op, "axis": axis, "bytes": nbytes, "shape": shape,
+               "world": world, "algo_bytes": _algo_bytes(op, nbytes, world),
+               "site": _trace_site()}
         self.records.append(rec)
         if self.verbose:
-            logger.info(f"comm: {op} over {axis}: {nbytes} bytes {rec['shape']}")
+            logger.info(f"comm: {op} over {axis}: {nbytes} bytes "
+                        f"{rec['shape']} from {rec['site']}")
 
-    def summary(self) -> Dict[str, Dict[str, float]]:
-        out: Dict[str, Dict[str, float]] = {}
+    def summary(self) -> Dict[str, Dict[str, Any]]:
+        out: Dict[str, Dict[str, Any]] = {}
         for r in self.records:
-            s = out.setdefault(r["op"], {"count": 0, "bytes": 0})
+            s = out.setdefault(r["op"], {"count": 0, "bytes": 0,
+                                         "algo_bytes": 0.0, "sites": []})
             s["count"] += 1
             s["bytes"] += max(r["bytes"], 0)
+            s["algo_bytes"] += max(r.get("algo_bytes", 0.0), 0.0)
+            site = r.get("site")
+            if site and site not in s["sites"]:
+                s["sites"].append(site)
         return out
 
-    def log_summary(self) -> None:
-        for op, s in self.summary().items():
-            logger.info(f"comm summary | {op}: count={s['count']} bytes={s['bytes']:,}")
+    def log_summary(self, step_time_s: Optional[float] = None) -> None:
+        """Periodic per-op rollup (reference ``log_summary()``); with a step
+        time, adds the estimated algorithmic bandwidth of the compiled step."""
+        for op, s in sorted(self.summary().items()):
+            msg = (f"comm summary | {op}: count={s['count']} "
+                   f"bytes={s['bytes']:,} algo_bytes={s['algo_bytes']:,.0f}")
+            if step_time_s:
+                msg += f" busbw~{s['algo_bytes'] / step_time_s / 1e9:.2f} GB/s"
+            if s["sites"]:
+                msg += f" sites={','.join(s['sites'][:4])}"
+            logger.info(msg)
+
+    def events(self, step: int) -> List[tuple]:
+        """Monitor events (``Comm/<op>/{bytes,count}``) for the current trace
+        records — cumulative per trace, constant across executed steps."""
+        ev = []
+        for op, s in sorted(self.summary().items()):
+            ev.append((f"Comm/{op}/bytes", float(s["bytes"]), step))
+            ev.append((f"Comm/{op}/count", float(s["count"]), step))
+        return ev
 
     def reset(self) -> None:
         self.records.clear()
@@ -80,10 +186,15 @@ def get_telemetry() -> CommsTelemetry:
     return _telemetry
 
 
-def configure(enabled: bool = False, verbose: bool = False) -> None:
+def configure(enabled: bool = False, verbose: bool = False,
+              prof_all: bool = True, prof_ops: Optional[List[str]] = None,
+              debug: bool = False) -> None:
     """Reference parity: ``dist.configure(config)`` enabling the comms logger."""
     _telemetry.enabled = enabled
     _telemetry.verbose = verbose
+    _telemetry.prof_all = prof_all
+    _telemetry.prof_ops = list(prof_ops or [])
+    _telemetry.debug = debug
 
 
 # --------------------------------------------------------------------------- #

@@ -27,6 +27,7 @@ import numpy as np
 
 from ..comm.mesh import MeshManager, get_mesh, init_mesh, set_mesh
 from ..runtime.partitioning import Partitioner
+from ..telemetry.profiler import annotate as _annotate
 from ..utils.logging import log_dist
 from .config import InferenceConfig
 from .sampling import SamplingParams, sample
@@ -308,7 +309,8 @@ class InferenceEngine:
 
         rng = jax.random.PRNGKey(seed)
         rng, k = jax.random.split(rng)
-        tok, cache = prefill(self.params, jnp.asarray(padded), lengths, k)
+        with _annotate("prefill"):
+            tok, cache = prefill(self.params, jnp.asarray(padded), lengths, k)
         first_tok = tok
         if max_new_tokens <= 1:
             return np.asarray(tok)[:, None]
@@ -326,8 +328,9 @@ class InferenceEngine:
         while remaining > 0:
             n = min(CHUNK, remaining)
             rng, k = jax.random.split(rng)
-            steps, tok, cache, cache_len, finished = decode_chunk(
-                self.params, tok, cache, cache_len, k, finished, eos_dev, n)
+            with _annotate("decode_chunk"):
+                steps, tok, cache, cache_len, finished = decode_chunk(
+                    self.params, tok, cache, cache_len, k, finished, eos_dev, n)
             outs.append(np.asarray(steps))
             remaining -= n
             if eos_token_id is not None and bool(np.asarray(finished).all()):

@@ -1,1 +1,2 @@
-from .monitor import MonitorMaster, get_monitor  # noqa: F401
+from .monitor import (JSONLMonitor, MonitorBackend, MonitorMaster,  # noqa: F401
+                      get_monitor)

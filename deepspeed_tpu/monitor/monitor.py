@@ -10,8 +10,11 @@ missing. Events are ``(name, value, step)`` tuples, written by rank 0 only
 
 from __future__ import annotations
 
+import atexit
 import csv
+import json
 import os
+import time
 from typing import Any, List, Optional, Sequence, Tuple
 
 import jax
@@ -33,6 +36,12 @@ class MonitorBackend:
 
     def flush(self) -> None:
         pass
+
+    def close(self) -> None:
+        """Flush and release any held resources (file handles, SDK runs).
+        Idempotent; called from engine shutdown / atexit so partial rows are
+        never lost."""
+        self.flush()
 
 
 class TensorBoardMonitor(MonitorBackend):
@@ -65,6 +74,12 @@ class TensorBoardMonitor(MonitorBackend):
         if self.writer:
             self.writer.flush()
 
+    def close(self) -> None:
+        if self.writer:
+            self.writer.close()
+            self.writer = None
+        self.enabled = False
+
 
 class WandbMonitor(MonitorBackend):
     """Reference ``monitor/wandb.py``; requires the wandb SDK."""
@@ -92,6 +107,15 @@ class WandbMonitor(MonitorBackend):
             return
         for name, value, step in events:
             self._wandb.log({name: float(value)}, step=int(step))
+
+    def close(self) -> None:
+        if self.run:
+            try:
+                self.run.finish()
+            except Exception:
+                pass
+            self.run = None
+        self.enabled = False
 
 
 class CometMonitor(MonitorBackend):
@@ -127,6 +151,15 @@ class CometMonitor(MonitorBackend):
     def flush(self) -> None:
         if self.experiment:
             self.experiment.flush()
+
+    def close(self) -> None:
+        if self.experiment:
+            try:
+                self.experiment.end()
+            except Exception:
+                pass
+            self.experiment = None
+        self.enabled = False
 
 
 class CSVMonitor(MonitorBackend):
@@ -164,6 +197,62 @@ class CSVMonitor(MonitorBackend):
         for f, _ in self._files.values():
             f.flush()
 
+    def close(self) -> None:
+        for f, _ in self._files.values():
+            try:
+                f.close()
+            except Exception:
+                pass
+        self._files = {}
+        self.enabled = False
+
+
+class JSONLMonitor(MonitorBackend):
+    """Append-only JSONL sink: one ``{"name", "value", "step", "ts"}`` object
+    per line in ``<output_path>/<job_name>/events.jsonl``. The machine-readable
+    counterpart of the CSV backend — a single ordered stream that
+    ``scripts/telemetry_report.py`` can replay, and the sink the TelemetryHub
+    acceptance path writes through."""
+
+    name = "jsonl"
+
+    def __init__(self, cfg):
+        super().__init__(cfg)
+        self._f = None
+        self.path: Optional[str] = None
+        if not self.enabled:
+            return
+        try:
+            root = os.path.join(cfg.output_path or "jsonl_monitor",
+                                cfg.job_name)
+            os.makedirs(root, exist_ok=True)
+            self.path = os.path.join(root, "events.jsonl")
+            self._f = open(self.path, "a")
+        except Exception as e:
+            logger.warning(f"jsonl monitor disabled: {e}")
+            self.enabled = False
+
+    def write_events(self, events: Sequence[Event]) -> None:
+        if not self._f:
+            return
+        now = time.time()
+        for name, value, step in events:
+            self._f.write(json.dumps({"name": name, "value": float(value),
+                                      "step": int(step), "ts": now}) + "\n")
+
+    def flush(self) -> None:
+        if self._f:
+            self._f.flush()
+
+    def close(self) -> None:
+        if self._f:
+            try:
+                self._f.close()
+            except Exception:
+                pass
+            self._f = None
+        self.enabled = False
+
 
 class MonitorMaster(MonitorBackend):
     """Fans every event out to all enabled backends (reference
@@ -180,12 +269,17 @@ class MonitorMaster(MonitorBackend):
         for cls, sub in ((TensorBoardMonitor, getattr(cfg, "tensorboard", None)),
                          (WandbMonitor, getattr(cfg, "wandb", None)),
                          (CometMonitor, getattr(cfg, "comet", None)),
-                         (CSVMonitor, getattr(cfg, "csv_monitor", None))):
+                         (CSVMonitor, getattr(cfg, "csv_monitor", None)),
+                         (JSONLMonitor, getattr(cfg, "jsonl_monitor", None))):
             if sub is not None and getattr(sub, "enabled", False):
                 b = cls(sub)
                 if b.enabled:
                     self.backends.append(b)
         self.enabled = bool(self.backends)
+        if self.backends:
+            # engine shutdown calls close(); atexit is the backstop so an
+            # interrupted run still lands its buffered rows on disk
+            atexit.register(self.close)
 
     def write_events(self, events: Sequence[Event]) -> None:
         for b in self.backends:
@@ -194,6 +288,20 @@ class MonitorMaster(MonitorBackend):
     def flush(self) -> None:
         for b in self.backends:
             b.flush()
+
+    def close(self) -> None:
+        for b in self.backends:
+            try:
+                b.close()
+            except Exception:
+                pass
+        if self.backends:
+            try:
+                atexit.unregister(self.close)
+            except Exception:
+                pass
+        self.backends = []
+        self.enabled = False
 
 
 def get_monitor(config) -> MonitorMaster:

@@ -180,11 +180,26 @@ class FlopsProfilerConfig(ConfigModel):
 @register_config_model
 @dataclass
 class CommsLoggerConfig(ConfigModel):
+    """Reference ``comms_logger`` block (``utils/comms_logging.py``): with
+    ``prof_all`` off, only op names starting with a ``prof_ops`` entry are
+    recorded by ``comm.CommsTelemetry``."""
     enabled: bool = False
     verbose: bool = False
     prof_all: bool = True
     debug: bool = False
     prof_ops: List[str] = field(default_factory=list)
+
+
+@register_config_model
+@dataclass
+class ProfilerConfig(ConfigModel):
+    """Config-gated JAX profiler session: brackets global steps
+    ``[start_step, end_step]`` with ``jax.profiler.start_trace/stop_trace``
+    (xprof/tensorboard-viewable), managed by ``telemetry.ProfilerSession``."""
+    enabled: bool = False
+    start_step: int = 1
+    end_step: int = 1
+    output_dir: str = ""  # "" → <tmpdir>/dstpu_profile
 
 
 @register_config_model
@@ -270,10 +285,12 @@ class DeepSpeedTPUConfig:
         default_factory=ActivationCheckpointingConfig)
     flops_profiler: FlopsProfilerConfig = field(default_factory=FlopsProfilerConfig)
     comms_logger: CommsLoggerConfig = field(default_factory=CommsLoggerConfig)
+    profiler: ProfilerConfig = field(default_factory=ProfilerConfig)
     tensorboard: MonitorBackendConfig = field(default_factory=MonitorBackendConfig)
     wandb: MonitorBackendConfig = field(default_factory=MonitorBackendConfig)
     comet: MonitorBackendConfig = field(default_factory=MonitorBackendConfig)
     csv_monitor: MonitorBackendConfig = field(default_factory=MonitorBackendConfig)
+    jsonl_monitor: MonitorBackendConfig = field(default_factory=MonitorBackendConfig)
     checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
     aio: AIOConfig = field(default_factory=AIOConfig)
 
@@ -341,10 +358,12 @@ _SUBCONFIG_KEYS = {
     "activation_checkpointing": ActivationCheckpointingConfig,
     "flops_profiler": FlopsProfilerConfig,
     "comms_logger": CommsLoggerConfig,
+    "profiler": ProfilerConfig,
     "tensorboard": MonitorBackendConfig,
     "wandb": MonitorBackendConfig,
     "comet": MonitorBackendConfig,
     "csv_monitor": MonitorBackendConfig,
+    "jsonl_monitor": MonitorBackendConfig,
     "checkpoint": CheckpointConfig,
     "aio": AIOConfig,
 }

@@ -34,8 +34,13 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..comm import comm as dist
 from ..comm.mesh import BATCH_AXES, MeshManager, init_mesh
 from ..ops.optimizers import Optimizer, get_optimizer
+from ..telemetry.profiler import annotate as _annotate
 from ..utils.logging import log_dist, logger
-from ..utils.timer import ThroughputTimer
+from ..utils.timer import (BACKWARD_GLOBAL_TIMER, BACKWARD_MICRO_TIMER,
+                           FORWARD_GLOBAL_TIMER, FORWARD_MICRO_TIMER,
+                           STEP_GLOBAL_TIMER, STEP_MICRO_TIMER,
+                           TRAIN_BATCH_TIMER, SynchronizedWallClockTimer,
+                           ThroughputTimer)
 from .config import DeepSpeedTPUConfig, parse_config
 from .lr_schedules import LRScheduler, Schedule, constant, get_schedule
 from .partitioning import Partitioner, shapes_of
@@ -298,6 +303,10 @@ class DeepSpeedTPUEngine:
         self._train_step = None
         self._grad_step = None
         self._apply_step = None
+        # breakdown-mode phase steps (wall_clock_breakdown: true)
+        self._fwd_step = None
+        self._bwd_step = None
+        self._flops_estimated = False
 
         # --- dataloader ---
         if training_data is not None:
@@ -324,6 +333,15 @@ class DeepSpeedTPUEngine:
         from ..profiling import FlopsProfiler
 
         self.flops_profiler = FlopsProfiler(config.flops_profiler, engine=self)
+
+        # --- telemetry hub: step breakdown + comms logger + HBM memory +
+        # trace sessions, fanned out through the monitor (telemetry/hub.py) ---
+        from ..telemetry import TelemetryHub
+
+        self.timers = SynchronizedWallClockTimer()
+        self.telemetry = TelemetryHub(config, monitor=self.monitor,
+                                      timers=self.timers,
+                                      tput_timer=self.tput_timer)
 
         # --- curriculum learning (reference engine hooks :395-408 wire the
         # curriculum scheduler into the forward prologue) ---
@@ -400,12 +418,19 @@ class DeepSpeedTPUEngine:
             with self.mesh_mgr.activate():
                 self._nvme_grad_step = jax.jit(grad_fn)
         self.tput_timer.start()
+        self.telemetry.step_begin(self.global_steps + 1)
+        breakdown = self.wall_clock_breakdown()
         if self.curriculum_scheduler is not None:
             batch = self.curriculum_scheduler.truncate(batch,
                                                        self.global_steps)
         batch = self._shard_batch(batch, with_gas_dim=True)
+        if breakdown:
+            self.timers(BACKWARD_GLOBAL_TIMER).start(sync=True)
         grads, loss, aux = self._nvme_grad_step(self.state.params, batch,
                                                 self.state.loss_scale)
+        if breakdown:
+            self.timers(BACKWARD_GLOBAL_TIMER).stop(sync=True)
+            self.timers(STEP_GLOBAL_TIMER).start()
         g_dev = jax.tree.leaves(grads)
         for g in g_dev:  # start ALL D2H copies before the first blocking
             if hasattr(g, "copy_to_host_async"):  # np.asarray (overlapped
@@ -463,8 +488,13 @@ class DeepSpeedTPUEngine:
         self.global_steps += 1
         self._last_grad_norm = grad_norm
         self.lr_scheduler.last_step = self.global_steps
+        if breakdown:
+            self.timers(STEP_GLOBAL_TIMER).stop(sync=True)
         self.tput_timer.stop()
         self._write_monitor_events(out)
+        self.telemetry.step_end(self.global_steps,
+                                step_time_s=self.tput_timer.avg_step_time()
+                                or None)
         if cfg.steps_per_print and \
                 self.global_steps % cfg.steps_per_print == 0:
             log_dist(f"step={self.global_steps} loss={float(out.loss):.4f} "
@@ -661,6 +691,16 @@ class DeepSpeedTPUEngine:
         (halving all-gather bytes vs bf16), and dequantization happens in
         the gathered layout where XLA fuses it into the consumer."""
         compute = self.precision.cast_to_compute(params)
+        # comms-logger: the constraint below makes XLA all-gather the
+        # ZeRO-sharded low-precision params — record that implied collective
+        # at trace time (its bytes are what actually crosses the wire)
+        tel = dist.get_telemetry()
+        if tel.enabled and self.config.zero_config.stage >= 1 and \
+                self.mesh_mgr.zero_world_size > 1:
+            axes = tuple(a for a in self.partitioner.zero_axes
+                         if self.mesh_mgr.axis_size(a) > 1)
+            if axes:
+                tel.record("all_gather_params", axes, compute)
         zc = self.config.zero_config
         if not (zc.zero_quantized_weights and
                 self.mesh_mgr.zero_world_size > 1):
@@ -843,6 +883,18 @@ class DeepSpeedTPUEngine:
         """Apply the stage's gradient sharding (reduce-scatter from stage 2 —
         reference stage_1_and_2.py:126): XLA fuses the implied psum over the
         data axes with this placement into a reduce-scatter."""
+        # comms-logger: the batch-sharded loss implies a grad reduction over
+        # the batch axes — record it at trace time so data-parallel volume
+        # shows up in the per-op summary even though XLA inserts the op
+        tel = dist.get_telemetry()
+        if tel.enabled:
+            axes = tuple(a for a in BATCH_AXES
+                         if self.mesh_mgr.axis_size(a) > 1)
+            if axes:
+                op = ("reduce_scatter_grads"
+                      if self.config.zero_config.stage >= 2
+                      else "all_reduce_grads")
+                tel.record(op, axes, grads)
         return jax.lax.with_sharding_constraint(grads, self._grad_shardings)
 
     def _accumulate(self, params, batch, loss_scale):
@@ -920,6 +972,87 @@ class DeepSpeedTPUEngine:
             self._train_step = jax.jit(step_fn, donate_argnums=(0,))
         return self._train_step
 
+    def _ensure_apply_step(self):
+        """The jitted optimizer-apply phase, shared by the forward/backward/
+        step API shims and the wall-clock-breakdown path."""
+        if self._apply_step is None:
+            with self.mesh_mgr.activate():
+                self._apply_step = jax.jit(
+                    lambda state, grads, loss, lro: self._apply_update(
+                        state, grads, loss, lr_override=lro),
+                    donate_argnums=(0,))
+        return self._apply_step
+
+    def _build_breakdown_steps(self):
+        """Phase-split steps for ``wall_clock_breakdown``: a loss-only
+        forward, the grad computation, and the optimizer apply as three
+        separately-jitted programs so each phase can be bracketed by a
+        synchronized timer."""
+        gas = self.gradient_accumulation_steps()
+
+        def fwd_fn(params, batch):
+            if gas == 1:
+                return self._loss(params, batch)[0]
+            losses = jax.lax.map(lambda mb: self._loss(params, mb)[0], batch)
+            return jnp.mean(losses)
+
+        def bwd_fn(params, batch, loss_scale):
+            return self._accumulate(params, batch, loss_scale)
+
+        with self.mesh_mgr.activate():
+            self._fwd_step = jax.jit(fwd_fn)
+            self._bwd_step = jax.jit(bwd_fn)
+        self._ensure_apply_step()
+
+    def _train_batch_breakdown(self, batch) -> StepOutput:
+        """Instrumented optimizer step (``wall_clock_breakdown: true``):
+        three jitted phases bracketed by device-synchronized timers and
+        profiler spans. XLA fuses forward into the grad program, so ``fwd``
+        is measured from a dedicated loss-only pass and ``bwd`` is the full
+        grad phase (it includes the fused forward, as with rematerialized
+        activations). This is a diagnostic mode: it costs roughly one extra
+        forward per step and defeats the fused-step overlap — production
+        throughput numbers come from the un-instrumented path."""
+        if self._bwd_step is None:
+            self._build_breakdown_steps()
+        t = self.timers
+        with _annotate("fwd"):
+            t(FORWARD_GLOBAL_TIMER).start(sync=True)
+            self._fwd_step(self.state.params, batch)
+            t(FORWARD_GLOBAL_TIMER).stop(sync=True)
+        with _annotate("bwd"):
+            t(BACKWARD_GLOBAL_TIMER).start()
+            grads, loss, aux = self._bwd_step(self.state.params, batch,
+                                              self.state.loss_scale)
+            t(BACKWARD_GLOBAL_TIMER).stop(sync=True)
+        with _annotate("step"):
+            t(STEP_GLOBAL_TIMER).start()
+            self.state, out = self._apply_step(self.state, grads, loss,
+                                               self._lr_override)
+            t(STEP_GLOBAL_TIMER).stop(sync=True)
+        return out
+
+    def _estimate_step_flops(self, batch) -> None:
+        """One-shot per-step flops estimate from XLA's cost analysis of the
+        fused train step → feeds ThroughputTimer TFLOPS reporting. Gated on
+        the flops profiler being enabled (the lowering is not free)."""
+        self._flops_estimated = True
+        try:
+            if self._train_step is None:
+                self._build_train_step()
+            lowered = self._train_step.lower(self.state, batch,
+                                             self._lr_override)
+            cost = lowered.compile().cost_analysis() or {}
+            if isinstance(cost, (list, tuple)):
+                cost = cost[0] if cost else {}
+            flops = float(cost.get("flops", 0.0))
+            if flops > 0:
+                self.tput_timer.set_flops_per_step(flops)
+                log_dist(f"flops/step estimate: {flops:.3e} "
+                         f"(XLA cost analysis)")
+        except Exception as e:
+            logger.debug(f"step flops estimate unavailable: {e}")
+
     # ------------------------------------------------------------------ #
     # public API — train_batch (PipelineEngine.train_batch parity)
     # ------------------------------------------------------------------ #
@@ -959,20 +1092,32 @@ class DeepSpeedTPUEngine:
         stacked in the leading dim)."""
         if self._nvme_opt is not None:
             return self._train_batch_nvme(batch)
-        if self._train_step is None:
+        breakdown = self.wall_clock_breakdown()
+        if self._train_step is None and not breakdown:
             self._build_train_step()
         self.tput_timer.start()
+        self.telemetry.step_begin(self.global_steps + 1)
         if self.curriculum_scheduler is not None:
             # difficulty = seq length; each bucket is its own cached jit
             batch = self.curriculum_scheduler.truncate(batch, self.global_steps)
         batch = self._shard_batch(batch, with_gas_dim=True)
-        self.state, out = self._train_step(self.state, batch,
-                                           self._lr_override)
+        if not self._flops_estimated and self.config.flops_profiler.enabled:
+            self._estimate_step_flops(batch)
+        if breakdown:
+            self.timers(TRAIN_BATCH_TIMER).start()
+            out = self._train_batch_breakdown(batch)
+            self.timers(TRAIN_BATCH_TIMER).stop(sync=False)
+        else:
+            self.state, out = self._train_step(self.state, batch,
+                                               self._lr_override)
         self.global_steps += 1
         self._last_grad_norm = out.grad_norm
         self.lr_scheduler.last_step = self.global_steps
         self.tput_timer.stop()
         self._write_monitor_events(out)
+        self.telemetry.step_end(self.global_steps,
+                                step_time_s=self.tput_timer.avg_step_time()
+                                or None)
         if self.config.steps_per_print and \
                 self.global_steps % self.config.steps_per_print == 0:
             log_dist(f"step={self.global_steps} loss={float(out.loss):.4f} "
@@ -996,9 +1141,13 @@ class DeepSpeedTPUEngine:
             with self.mesh_mgr.activate():
                 self._grad_step = jax.jit(one_micro)
         self._staged_batches.append(self._shard_batch(batch, with_gas_dim=False))
+        if self.wall_clock_breakdown():
+            self.timers(FORWARD_MICRO_TIMER).start(sync=True)
         grads, loss, aux = self._grad_step(self.state.params,
                                            self._staged_batches[-1],
                                            self.state.loss_scale)
+        if self.wall_clock_breakdown():
+            self.timers(FORWARD_MICRO_TIMER).stop(sync=True)
         self._last_micro = (grads, loss)
         return loss
 
@@ -1006,6 +1155,8 @@ class DeepSpeedTPUEngine:
         """Accumulate the staged micro-batch's grads (already computed in
         forward — JAX computes loss+grads together)."""
         grads, loss_val = self._last_micro
+        if self.wall_clock_breakdown():
+            self.timers(BACKWARD_MICRO_TIMER).start()
         if getattr(self, "_pending_grads", None) is None:
             self._pending_grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
             self._pending_loss = loss_val
@@ -1016,6 +1167,8 @@ class DeepSpeedTPUEngine:
             self._pending_loss = self._pending_loss + loss_val
             self._pending_count += 1
         self.micro_steps += 1
+        if self.wall_clock_breakdown():
+            self.timers(BACKWARD_MICRO_TIMER).stop(sync=True)
         return loss_val
 
     def is_gradient_accumulation_boundary(self) -> bool:
@@ -1026,12 +1179,11 @@ class DeepSpeedTPUEngine:
         matching reference semantics)."""
         if not self.is_gradient_accumulation_boundary():
             return None
-        if self._apply_step is None:
-            with self.mesh_mgr.activate():
-                self._apply_step = jax.jit(
-                    lambda state, grads, loss, lro: self._apply_update(
-                        state, grads, loss, lr_override=lro),
-                    donate_argnums=(0,))
+        self._ensure_apply_step()
+        breakdown = self.wall_clock_breakdown()
+        if breakdown:
+            self.timers(STEP_MICRO_TIMER).start()
+            self.timers(STEP_GLOBAL_TIMER).start()
         n = self._pending_count
         grads = jax.tree.map(lambda g: g / n, self._pending_grads)
         loss = self._pending_loss / n
@@ -1043,12 +1195,16 @@ class DeepSpeedTPUEngine:
         self._staged_batches.clear()
         self.global_steps += 1
         self._last_grad_norm = out.grad_norm
+        if breakdown:
+            self.timers(STEP_MICRO_TIMER).stop(sync=True)
+            self.timers(STEP_GLOBAL_TIMER).stop(sync=False)
         # commit any in-flight async checkpoint at the boundary (reference
         # decoupled-engine commit, runtime/engine.py:2797)
         ce = getattr(self, "checkpoint_engine", None)
         if ce is not None and getattr(ce, "_pending", None):
             ce.wait_all()
         self._write_monitor_events(out)
+        self.telemetry.step_end(self.global_steps)
         return out
 
     def _write_monitor_events(self, out) -> None:
@@ -1075,7 +1231,14 @@ class DeepSpeedTPUEngine:
             with self.mesh_mgr.activate():
                 self._eval_step = jax.jit(lambda p, b: self._loss(p, b)[0])
         batch = self._shard_batch(batch, with_gas_dim=False)
-        return self._eval_step(self.state.params, batch)
+        breakdown = self.wall_clock_breakdown()
+        with _annotate("eval_batch"):
+            if breakdown:
+                self.timers("eval_batch").start(sync=True)
+            loss = self._eval_step(self.state.params, batch)
+            if breakdown:
+                self.timers("eval_batch").stop(sync=True)
+        return loss
 
     def __call__(self, batch):
         return self.forward(batch)
@@ -1107,6 +1270,10 @@ class DeepSpeedTPUEngine:
             log_dist(f"engine.compile: AOT-compiled train step "
                      f"(flops={cost.get('flops', 0):.3e}, "
                      f"bytes={cost.get('bytes accessed', 0):.3e})")
+            flops = float(cost.get("flops", 0.0))
+            if flops > 0:  # free TFLOPS baseline — the analysis is in hand
+                self.tput_timer.set_flops_per_step(flops)
+                self._flops_estimated = True
         self._is_compiled = True
         return self
 
@@ -1162,6 +1329,20 @@ class DeepSpeedTPUEngine:
         from .offload_states import reload_engine_states
 
         reload_engine_states(self, non_blocking=non_blocking)
+
+    # ------------------------------------------------------------------ #
+    # shutdown (reference engine.destroy :390)
+    # ------------------------------------------------------------------ #
+    def destroy(self) -> None:
+        """Release observability resources: stop any live profiler trace,
+        flush + close monitor backends (so partial CSV/JSONL rows land on
+        disk). Safe to call more than once; atexit backstops it."""
+        tel = getattr(self, "telemetry", None)
+        if tel is not None:
+            tel.close()
+        mon = getattr(self, "monitor", None)
+        if mon is not None:
+            mon.close()
 
 
 # --------------------------------------------------------------------------- #

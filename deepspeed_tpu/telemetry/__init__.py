@@ -1,0 +1,10 @@
+"""Unified observability layer: TelemetryHub + its sources.
+
+See ``docs/observability.md`` for the config surface
+(``wall_clock_breakdown``, ``memory_breakdown``, ``comms_logger``,
+``profiler``, monitor backends incl. the JSONL sink).
+"""
+
+from .hub import TelemetryHub  # noqa: F401
+from .memory import MemoryTelemetry  # noqa: F401
+from .profiler import ProfilerSession, annotate  # noqa: F401

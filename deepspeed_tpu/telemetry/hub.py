@@ -1,0 +1,150 @@
+"""TelemetryHub — unified per-step observability fan-out.
+
+One rank-0-gated aggregation point for the four telemetry sources the engine
+produces, fanned out through ``MonitorMaster`` (TensorBoard / WandB / Comet /
+CSV / JSONL backends):
+
+1. **step breakdown** — drains the engine's ``SynchronizedWallClockTimer``
+   (fwd/bwd/step/train_batch) into ``Train/Step/{fwd,bwd,step,train_batch}_ms``
+   events, gated by ``wall_clock_breakdown``;
+2. **comms logger** — per-op ``Comm/<op>/{bytes,count}`` events from
+   ``comm.CommsTelemetry`` (trace-time records of explicit AND engine-implied
+   collectives), plus the periodic ``log_summary()`` at ``steps_per_print``;
+3. **HBM memory** — ``Memory/{bytes_in_use,peak_bytes}`` events from
+   ``MemoryTelemetry``, plus the ``memory_breakdown`` per-step log line;
+4. **trace sessions** — a ``ProfilerSession`` bracketing the configured step
+   window with ``jax.profiler.start_trace``/``stop_trace``.
+
+The engine calls ``step_begin`` before and ``step_end`` after every optimizer
+step; both are cheap no-ops on non-zero ranks and when nothing is enabled.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax
+
+from ..comm import comm as dist
+from ..utils.logging import log_dist
+from ..utils.memory import see_memory_usage
+from ..utils.timer import (BACKWARD_GLOBAL_TIMER, BACKWARD_MICRO_TIMER,
+                           FORWARD_GLOBAL_TIMER, FORWARD_MICRO_TIMER,
+                           STEP_GLOBAL_TIMER, STEP_MICRO_TIMER,
+                           TRAIN_BATCH_TIMER, SynchronizedWallClockTimer)
+from .memory import MemoryTelemetry
+from .profiler import ProfilerSession
+
+Event = Tuple[str, float, int]
+
+# (timer name, event suffix) — emission order of the step-breakdown events.
+# Every timer the engine can start appears here so each step_end drains (and
+# resets) it; an undrained timer's record list would grow without bound.
+_STEP_TIMERS = ((FORWARD_GLOBAL_TIMER, "fwd"),
+                (BACKWARD_GLOBAL_TIMER, "bwd"),
+                (STEP_GLOBAL_TIMER, "step"),
+                (TRAIN_BATCH_TIMER, "train_batch"),
+                (FORWARD_MICRO_TIMER, "fwd_micro"),
+                (BACKWARD_MICRO_TIMER, "bwd_micro"),
+                (STEP_MICRO_TIMER, "step_micro"),
+                ("eval_batch", "eval"))
+
+
+class TelemetryHub:
+    def __init__(self, config, monitor=None,
+                 timers: Optional[SynchronizedWallClockTimer] = None,
+                 tput_timer=None):
+        self.cfg = config
+        self.monitor = monitor
+        self.timers = timers if timers is not None else \
+            SynchronizedWallClockTimer()
+        self.tput_timer = tput_timer
+        self.rank0 = jax.process_index() == 0
+        self.memory = MemoryTelemetry()
+        self.profiler = ProfilerSession(getattr(config, "profiler", None))
+        cl = getattr(config, "comms_logger", None)
+        if cl is not None and getattr(cl, "enabled", False):
+            dist.configure(enabled=True, verbose=cl.verbose,
+                           prof_all=cl.prof_all, prof_ops=list(cl.prof_ops),
+                           debug=cl.debug)
+        self.comms = dist.get_telemetry()
+
+    # ------------------------------------------------------------------ #
+    @property
+    def wall_clock_breakdown(self) -> bool:
+        return bool(getattr(self.cfg, "wall_clock_breakdown", False))
+
+    def _monitor_on(self) -> bool:
+        return self.monitor is not None and \
+            bool(getattr(self.monitor, "enabled", False))
+
+    # ------------------------------------------------------------------ #
+    def step_begin(self, step: int) -> None:
+        """Called with the global step about to execute."""
+        if self.rank0:
+            self.profiler.maybe_start(step)
+
+    def step_end(self, step: int,
+                 step_time_s: Optional[float] = None) -> List[Event]:
+        """Called with the global step that just completed. Collects events
+        from every enabled source, writes them through the monitor, emits the
+        periodic log summaries, and advances the profiler window. Returns the
+        events (for tests and callers that want them)."""
+        if not self.rank0:
+            return []
+        events: List[Event] = []
+        mon_on = self._monitor_on()
+        breakdown = self.wall_clock_breakdown
+
+        if breakdown:
+            # drain (and reset) the phase timers whether or not a monitor
+            # backend is attached — steady accumulation would skew the next
+            # step's numbers. Aux timers (micro/eval) only emit when they
+            # actually ran this step; an idle timer left over from another
+            # execution path would otherwise spam zero-valued events.
+            core = {FORWARD_GLOBAL_TIMER, BACKWARD_GLOBAL_TIMER,
+                    STEP_GLOBAL_TIMER, TRAIN_BATCH_TIMER}
+            for name, key in _STEP_TIMERS:
+                if self.timers.has(name):
+                    ms = self.timers(name).elapsed(reset=True) * 1000.0
+                    if ms == 0.0 and name not in core:
+                        continue
+                    events.append((f"Train/Step/{key}_ms", ms, step))
+
+        if mon_on or breakdown:
+            if self.comms.enabled:
+                events += self.comms.events(step)
+            events += self.memory.events(step)
+            if self.tput_timer is not None and \
+                    getattr(self.tput_timer, "flops_per_step", None):
+                tf = self.tput_timer.avg_tflops_per_sec()
+                if tf > 0:
+                    events.append(("Train/Step/tflops", tf, step))
+
+        spp = int(getattr(self.cfg, "steps_per_print", 0) or 0)
+        if spp and step % spp == 0:
+            if breakdown and events:
+                parts = [f"{n.split('/')[-1]}: {v:.2f}"
+                         for n, v, _ in events if n.endswith("_ms")]
+                if parts:
+                    log_dist("time (ms) | " + " | ".join(parts))
+            if self.comms.enabled:
+                self.comms.log_summary(step_time_s)
+        if bool(getattr(self.cfg, "memory_breakdown", False)):
+            see_memory_usage(f"after step {step}", force=True)
+
+        if mon_on and events:
+            self.monitor.write_events(events)
+        self.profiler.maybe_stop(step)
+        return events
+
+    # ------------------------------------------------------------------ #
+    def close(self) -> None:
+        """Engine shutdown: stop any live trace session, flush + close the
+        monitor backends. Idempotent."""
+        self.profiler.close()
+        if self.monitor is not None:
+            try:
+                self.monitor.close()
+            except Exception:
+                pass

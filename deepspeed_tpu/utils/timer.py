@@ -127,6 +127,10 @@ class ThroughputTimer:
     steps_per_output: int = 0
     monitor_memory: bool = False
     logging_fn: Optional[object] = None
+    # model flops for ONE optimizer step (all micro-batches); set via
+    # set_flops_per_step (typically from FlopsProfiler / XLA cost analysis)
+    # to make the periodic log line and avg_tflops_per_sec report TFLOPS
+    flops_per_step: Optional[float] = None
 
     total_elapsed: float = 0.0
     step_count: int = 0
@@ -147,8 +151,14 @@ class ThroughputTimer:
             self.total_elapsed += time.perf_counter() - self._start
             if (report_speed and self.steps_per_output
                     and self.step_count % self.steps_per_output == 0):
-                logger.info(
-                    f"step={self.step_count}, samples/sec={self.avg_samples_per_sec():.2f}")
+                msg = (f"step={self.step_count}, "
+                       f"samples/sec={self.avg_samples_per_sec():.2f}")
+                if self.flops_per_step:
+                    msg += f", TFLOPS={self.avg_tflops_per_sec():.2f}"
+                logger.info(msg)
+
+    def set_flops_per_step(self, flops: Optional[float]) -> None:
+        self.flops_per_step = float(flops) if flops else None
 
     def avg_samples_per_sec(self) -> float:
         counted = self.step_count - self.start_step
@@ -161,3 +171,10 @@ class ThroughputTimer:
         if counted <= 0:
             return 0.0
         return self.total_elapsed / counted
+
+    def avg_tflops_per_sec(self) -> float:
+        """Achieved model TFLOPS (needs flops_per_step + measured steps)."""
+        st = self.avg_step_time()
+        if not st or not self.flops_per_step:
+            return 0.0
+        return self.flops_per_step / st / 1e12

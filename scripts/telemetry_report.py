@@ -1,0 +1,131 @@
+#!/usr/bin/env python
+"""Summarize a TelemetryHub JSONL file (the ``jsonl_monitor`` sink).
+
+Reads ``events.jsonl`` lines of ``{"name", "value", "step", "ts"}`` and prints
+a step-time / comm-volume / memory summary table — the offline companion to
+the live ``log_summary()`` output. Deliberately free of jax/numpy imports so
+it runs anywhere a telemetry file lands.
+
+Usage: python scripts/telemetry_report.py runs/job/events.jsonl [--last N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from collections import OrderedDict
+from typing import Dict, List
+
+
+def load_events(path: str) -> List[dict]:
+    events = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue  # torn final line from a killed run
+            if isinstance(rec, dict) and "name" in rec and "value" in rec:
+                events.append(rec)
+    return events
+
+
+def _series(events: List[dict]) -> "OrderedDict[str, List[dict]]":
+    by_name: "OrderedDict[str, List[dict]]" = OrderedDict()
+    for e in events:
+        by_name.setdefault(e["name"], []).append(e)
+    return by_name
+
+
+def _fmt_bytes(n: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return f"{n:,.1f} {unit}"
+        n /= 1024
+    return f"{n:,.1f} TiB"
+
+
+def summarize(events: List[dict], last: int = 0) -> str:
+    if last > 0:
+        steps = sorted({e.get("step", 0) for e in events})[-last:]
+        events = [e for e in events if e.get("step", 0) in set(steps)]
+    by_name = _series(events)
+    lines: List[str] = []
+    n_steps = len({e.get("step", 0) for e in events})
+    lines.append(f"telemetry report: {len(events)} events over "
+                 f"{n_steps} steps")
+
+    phase = {n: s for n, s in by_name.items()
+             if n.startswith("Train/Step/") and n.endswith("_ms")}
+    if phase:
+        lines.append("")
+        lines.append("step time (ms)")
+        lines.append(f"  {'phase':<16} {'count':>6} {'mean':>10} "
+                     f"{'min':>10} {'max':>10} {'last':>10}")
+        for name, recs in phase.items():
+            vals = [r["value"] for r in recs]
+            label = name[len("Train/Step/"):-len("_ms")]
+            lines.append(f"  {label:<16} {len(vals):>6} "
+                         f"{sum(vals) / len(vals):>10.2f} {min(vals):>10.2f} "
+                         f"{max(vals):>10.2f} {vals[-1]:>10.2f}")
+
+    comm: Dict[str, Dict[str, float]] = {}
+    for name, recs in by_name.items():
+        if not name.startswith("Comm/"):
+            continue
+        _, op, kind = name.split("/", 2)
+        # per-trace cumulative counters: the last sample is the total
+        comm.setdefault(op, {})[kind] = recs[-1]["value"]
+    if comm:
+        lines.append("")
+        lines.append("comm volume (per compiled step)")
+        lines.append(f"  {'op':<24} {'count':>6} {'bytes':>14}")
+        for op, kinds in sorted(comm.items()):
+            lines.append(f"  {op:<24} {int(kinds.get('count', 0)):>6} "
+                         f"{_fmt_bytes(kinds.get('bytes', 0.0)):>14}")
+
+    mem = {n: s for n, s in by_name.items() if n.startswith("Memory/")}
+    if mem:
+        lines.append("")
+        lines.append("device memory")
+        for name, recs in sorted(mem.items()):
+            vals = [r["value"] for r in recs]
+            lines.append(f"  {name[len('Memory/'):]:<16} "
+                         f"last {_fmt_bytes(vals[-1]):>14}   "
+                         f"max {_fmt_bytes(max(vals)):>14}")
+
+    other = {n: s for n, s in by_name.items()
+             if n not in phase and n not in mem
+             and not n.startswith("Comm/")}
+    if other:
+        lines.append("")
+        lines.append("scalars (last value)")
+        for name, recs in other.items():
+            lines.append(f"  {name:<32} {recs[-1]['value']:.6g}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", help="path to an events.jsonl telemetry file")
+    ap.add_argument("--last", type=int, default=0,
+                    help="restrict to the last N steps")
+    args = ap.parse_args(argv)
+    try:
+        events = load_events(args.path)
+    except OSError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    if not events:
+        print(f"error: no telemetry events in {args.path}", file=sys.stderr)
+        return 1
+    print(summarize(events, last=args.last))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
