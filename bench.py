@@ -17,7 +17,12 @@ import sys
 import time
 import traceback
 
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+_HERE = os.path.dirname(os.path.abspath(__file__))
+# one-time path setup (scripts/ holds the shared probe finalizer) — emit()
+# used to re-insert this on every call, growing sys.path per emission
+for _p in (_HERE, os.path.join(_HERE, "scripts")):
+    if _p not in sys.path:
+        sys.path.insert(0, _p)
 
 RESULT = {
     "metric": "llama_zero3_train_mfu",
@@ -33,9 +38,8 @@ def emit(ok: bool, err: str = ""):
         RESULT["detail"]["error"] = err[-2000:]
     # a failed subprobe must poison the ok flag (VERDICT r4 item 4b: a
     # failed decode row shipped inside an ok:true capture) — budget skips
-    # are not failures. ONE failure rule, shared with every probe script.
-    sys.path.insert(0, os.path.join(
-        os.path.dirname(os.path.abspath(__file__)), "scripts"))
+    # are not failures. ONE failure rule, shared with every probe script
+    # (scripts/ is on sys.path from module import).
     from _probe_common import _bad
     subprobes = {k: RESULT["detail"].get(k)
                  for k in ("decode_tok_per_sec", "shape_mfu")
@@ -287,7 +291,7 @@ def run_decode_subprocess() -> object:
     except subprocess.TimeoutExpired:
         proc.kill()
         proc.communicate()
-        return "timeout after 600s"
+        return "timeout: decode child exceeded 600s"
     finally:
         _DECODE_CHILD.pop("proc", None)
 
@@ -334,6 +338,10 @@ def main():
         "zero_optimization": {"stage": 3},
         "gradient_clipping": 1.0,
         "steps_per_print": 0,
+        # trace-time comm accounting (free at run time): the per-step
+        # collective count + algorithmic bytes land in detail so comm-volume
+        # regressions are visible from the headline artifact
+        "comms_logger": {"enabled": True},
     }
     sys.stderr.write(f"[bench] t={time.perf_counter():.0f} building engine\n")
     spec = llama.model_spec(mcfg, compute_dtype=jnp.bfloat16)
@@ -375,6 +383,20 @@ def main():
         "seqlen": seqlen,
         "final_loss": final_loss,
     })
+    try:  # per-step comm volume of the compiled step (trace-time records)
+        from deepspeed_tpu.comm import comm as ds_comm
+
+        tel = ds_comm.get_telemetry()
+        if tel.records:
+            total_algo = tel.total_algo_bytes()
+            RESULT["detail"]["comm_per_step"] = {
+                "collectives": int(sum(s["count"]
+                                       for s in tel.summary().values())),
+                "algo_bytes": int(total_algo),
+                "busbw_gbps": round(total_algo / (dt / steps) / 1e9, 2),
+            }
+    except Exception:
+        pass  # comm accounting must never fail the headline
     # 8B-class shape rows (TPU only — each is a multi-minute compile; the
     # persistent cache makes re-runs cheap). Forced via DSTPU_BENCH_SHAPES=1.
     if on_tpu or os.environ.get("DSTPU_BENCH_SHAPES", "0") not in ("", "0"):

@@ -20,7 +20,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from .comm import shard_map
 
 _FACTORS = {
     "all_reduce": lambda n: 2 * (n - 1) / n,

@@ -112,6 +112,12 @@ class CommsTelemetry:
     describes what every execution of the compiled step does. Byte accounting
     is pytree-aware: payloads may be arrays, scalars, or nested containers.
 
+    ``repeats`` covers collectives traced once but executed several times per
+    step (a ``lax.scan`` body over GAS micro-batches): the record carries the
+    per-execution payload and the summary multiplies count/bytes by
+    ``repeats``, so per-step volume comparisons (per-micro vs deferred
+    reduction) stay honest.
+
     ``prof_all``/``prof_ops`` mirror the reference comms-logger config
     (``utils/comms_logging.py``): with ``prof_all`` off, only ops whose name
     starts with an entry of ``prof_ops`` are recorded."""
@@ -128,14 +134,15 @@ class CommsTelemetry:
             return True
         return any(op == p or op.startswith(p) for p in self.prof_ops)
 
-    def record(self, op: str, axis: AxisName, x: Any) -> None:
+    def record(self, op: str, axis: AxisName, x: Any,
+               repeats: int = 1) -> None:
         if not self.enabled or not self._profiled(op):
             return
         nbytes, shape = _tree_bytes(x)
         world = _axis_world(axis)
         rec = {"op": op, "axis": axis, "bytes": nbytes, "shape": shape,
                "world": world, "algo_bytes": _algo_bytes(op, nbytes, world),
-               "site": _trace_site()}
+               "repeats": max(int(repeats), 1), "site": _trace_site()}
         self.records.append(rec)
         if self.verbose:
             logger.info(f"comm: {op} over {axis}: {nbytes} bytes "
@@ -146,13 +153,18 @@ class CommsTelemetry:
         for r in self.records:
             s = out.setdefault(r["op"], {"count": 0, "bytes": 0,
                                          "algo_bytes": 0.0, "sites": []})
-            s["count"] += 1
-            s["bytes"] += max(r["bytes"], 0)
-            s["algo_bytes"] += max(r.get("algo_bytes", 0.0), 0.0)
+            rep = max(int(r.get("repeats", 1)), 1)
+            s["count"] += rep
+            s["bytes"] += max(r["bytes"], 0) * rep
+            s["algo_bytes"] += max(r.get("algo_bytes", 0.0), 0.0) * rep
             site = r.get("site")
             if site and site not in s["sites"]:
                 s["sites"].append(site)
         return out
+
+    def total_algo_bytes(self) -> float:
+        """Per-step algorithmic bytes across every recorded collective."""
+        return sum(s["algo_bytes"] for s in self.summary().values())
 
     def log_summary(self, step_time_s: Optional[float] = None) -> None:
         """Periodic per-op rollup (reference ``log_summary()``); with a step
@@ -167,12 +179,14 @@ class CommsTelemetry:
             logger.info(msg)
 
     def events(self, step: int) -> List[tuple]:
-        """Monitor events (``Comm/<op>/{bytes,count}``) for the current trace
-        records — cumulative per trace, constant across executed steps."""
+        """Monitor events (``Comm/<op>/{bytes,count,algo_bytes}``) for the
+        current trace records — cumulative per trace, constant across
+        executed steps."""
         ev = []
         for op, s in sorted(self.summary().items()):
             ev.append((f"Comm/{op}/bytes", float(s["bytes"]), step))
             ev.append((f"Comm/{op}/count", float(s["count"]), step))
+            ev.append((f"Comm/{op}/algo_bytes", float(s["algo_bytes"]), step))
         return ev
 
     def reset(self) -> None:
@@ -195,6 +209,32 @@ def configure(enabled: bool = False, verbose: bool = False,
     _telemetry.prof_all = prof_all
     _telemetry.prof_ops = list(prof_ops or [])
     _telemetry.debug = debug
+
+
+# --------------------------------------------------------------------------- #
+# shard_map across jax versions
+# --------------------------------------------------------------------------- #
+def shard_map(f, *, mesh, in_specs, out_specs, axis_names=None,
+              check_vma: bool = False):
+    """Version-portable ``shard_map``: newer jax exposes ``jax.shard_map``
+    with ``axis_names``/``check_vma``; 0.4-era jax has
+    ``jax.experimental.shard_map.shard_map`` where partial-manual regions are
+    spelled as ``auto=<complement>`` and the replication check is
+    ``check_rep``. Every manual collective region in the framework goes
+    through this one shim so a jax upgrade is a one-line change."""
+    if hasattr(jax, "shard_map"):
+        kw = dict(mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_vma=check_vma)
+        if axis_names is not None:
+            kw["axis_names"] = set(axis_names)
+        return jax.shard_map(f, **kw)
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    auto = frozenset()
+    if axis_names is not None:
+        auto = frozenset(set(mesh.axis_names) - set(axis_names))
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      check_rep=bool(check_vma), auto=auto)
 
 
 # --------------------------------------------------------------------------- #

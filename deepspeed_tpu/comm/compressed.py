@@ -22,6 +22,16 @@ import jax.numpy as jnp
 from jax import lax
 
 
+def _axis_size(axis_name: str) -> int:
+    """Static member count of a named axis, portable across jax versions:
+    ``lax.axis_size`` where it exists; on 0.4-era jax, ``psum`` of a Python
+    int short-circuits to ``value * axis_size`` at trace time, resolving the
+    size from the enclosing shard_map's axis env without a global mesh."""
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    return int(lax.psum(1, axis_name))
+
+
 def onebit_compress(x: jnp.ndarray, error: jnp.ndarray
                     ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Error-feedback 1-bit compression (reference compressed_allreduce
@@ -55,7 +65,7 @@ def onebit_all_reduce(x: jnp.ndarray, error: jnp.ndarray, axis_name: str,
 
     Wire traffic is int8 + scalar scales in both phases; per-device memory
     stays O(|x|). Returns (averaged gradient, new_error, new_server_error)."""
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     signs, scale, new_error = onebit_compress(x, error)
 
     k = onebit_server_chunk_size(x.size, n)
@@ -102,7 +112,8 @@ def dequantize_int8(q: jnp.ndarray, scale: jnp.ndarray, shape,
 
 def quantized_reduce_scatter_dim(x: jnp.ndarray, dim: int,
                                  axis_names: Tuple[str, ...],
-                                 group_size: int = 256) -> jnp.ndarray:
+                                 group_size: int = 256,
+                                 repeats: int = 1) -> jnp.ndarray:
     """Hierarchical int8 reduce-scatter of ``x`` along ``dim`` over several
     mesh axes IN ORDER (qgZ's intra-node → inter-node hierarchy,
     ``csrc/quantization/quant_reduce.cu`` + ``swizzled_quantize.cu`` analog).
@@ -111,13 +122,79 @@ def quantized_reduce_scatter_dim(x: jnp.ndarray, dim: int,
     varying first)."""
     x = jnp.moveaxis(x, dim, 0)
     for a in axis_names:
-        n = lax.axis_size(a)
-        x = quantized_reduce_scatter(x, a, n, group_size=group_size)
+        n = _axis_size(a)
+        x = quantized_reduce_scatter(x, a, n, group_size=group_size,
+                                     repeats=repeats)
     return jnp.moveaxis(x, 0, dim)
 
 
+def loco_quantized_reduce_scatter_dim(x: jnp.ndarray, dim: int,
+                                      axis_names: Tuple[str, ...],
+                                      residual: jnp.ndarray,
+                                      err_beta: float = 0.8,
+                                      group_size: int = 256,
+                                      repeats: int = 1
+                                      ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """LoCo error-feedback variant of :func:`quantized_reduce_scatter_dim`
+    (reference ``runtime/comm/coalesced_collectives.py:81
+    all_to_all_loco_quant_reduce``, ZeRO++ arXiv:2306.10209): the carried
+    quantization-error ``residual`` (same shape as ``x``) is added BEFORE the
+    first quantization and the fresh local error ``err_beta * (corrected -
+    dequantize(quantize(corrected)))`` becomes the new residual, so int8
+    rounding bias no longer accumulates across optimizer steps.
+
+    Error feedback applies at the first (full-gradient) hierarchy stage — the
+    one whose input magnitude dominates the rounding error; deeper stages
+    reduce already-compensated partial sums with plain quantization.
+
+    Returns ``(scattered_sum, new_residual)``; the residual keeps ``x``'s
+    (pre-scatter) shape and the caller carries it across steps."""
+    x = jnp.moveaxis(x, dim, 0)
+    residual = jnp.moveaxis(residual.astype(x.dtype), dim, 0)
+    first, rest = axis_names[0], axis_names[1:]
+    x, new_residual = quantized_reduce_scatter_ef(
+        x, first, _axis_size(first), residual, err_beta=err_beta,
+        group_size=group_size, repeats=repeats)
+    for a in rest:
+        x = quantized_reduce_scatter(x, a, _axis_size(a),
+                                     group_size=group_size, repeats=repeats)
+    return jnp.moveaxis(x, 0, dim), jnp.moveaxis(new_residual, 0, dim)
+
+
+def _chunk_quantize(x: jnp.ndarray, axis_size: int, group_size: int):
+    """Groupwise-int8 quantize each of ``axis_size`` destination chunks of
+    the leading dim independently (so the INT8 payload plus tiny fp32 scales
+    is what crosses the wire). Returns ``(q, scale, cols)`` with
+    ``q: [axis_size, ngroups, group_size] int8``."""
+    chunks = x.reshape(axis_size, -1)
+    cols = chunks.shape[1]
+    pad = (-cols) % group_size
+    chunks = jnp.pad(chunks, ((0, 0), (0, pad)))
+    g = chunks.reshape(axis_size, -1, group_size)
+    scale = jnp.maximum(jnp.max(jnp.abs(g), axis=2, keepdims=True), 1e-8) / 127.0
+    q = jnp.clip(jnp.round(g / scale), -127, 127).astype(jnp.int8)
+    return q, scale, cols
+
+
+def _a2a_sum(q, scale, cols, chunk_shape, axis_name, dtype, repeats=1):
+    """All-to-all the int8 chunks + scales, dequantize, local sum → this
+    worker's chunk of the total."""
+    from . import comm as dist
+
+    dist.get_telemetry().record("all_to_all_quant_reduce", axis_name,
+                                (q, scale), repeats=repeats)
+    swapped_q = lax.all_to_all(q, axis_name, split_axis=0, concat_axis=0,
+                               tiled=False)
+    swapped_s = lax.all_to_all(scale, axis_name, split_axis=0, concat_axis=0,
+                               tiled=False)
+    deq = swapped_q.astype(jnp.float32) * swapped_s
+    summed = jnp.sum(deq, axis=0).reshape(-1)[:cols]
+    return summed.reshape(chunk_shape).astype(dtype)
+
+
 def quantized_reduce_scatter(x: jnp.ndarray, axis_name: str, axis_size: int,
-                             group_size: int = 256) -> jnp.ndarray:
+                             group_size: int = 256,
+                             repeats: int = 1) -> jnp.ndarray:
     """qgZ analog (``all_to_all_quant_reduce``): quantize int8 → all-to-all
     scatter chunks over the axis → dequantize → local sum. Each worker ends
     with ITS 1/axis_size shard of the sum, having moved int8 on the wire.
@@ -126,19 +203,30 @@ def quantized_reduce_scatter(x: jnp.ndarray, axis_name: str, axis_size: int,
     n = x.shape[0]
     assert n % axis_size == 0, (n, axis_size)
     chunk_shape = (n // axis_size,) + x.shape[1:]
-    # quantize each destination chunk independently so the INT8 payload (plus
-    # tiny fp32 scales) is what crosses the wire
-    chunks = x.reshape(axis_size, -1)
-    cols = chunks.shape[1]
-    pad = (-cols) % group_size
-    chunks = jnp.pad(chunks, ((0, 0), (0, pad)))
-    g = chunks.reshape(axis_size, -1, group_size)
-    scale = jnp.maximum(jnp.max(jnp.abs(g), axis=2, keepdims=True), 1e-8) / 127.0
-    q = jnp.clip(jnp.round(g / scale), -127, 127).astype(jnp.int8)
-    swapped_q = lax.all_to_all(q, axis_name, split_axis=0, concat_axis=0,
-                               tiled=False)
-    swapped_s = lax.all_to_all(scale, axis_name, split_axis=0, concat_axis=0,
-                               tiled=False)
-    deq = swapped_q.astype(jnp.float32) * swapped_s
-    summed = jnp.sum(deq, axis=0).reshape(-1)[:cols]
-    return summed.reshape(chunk_shape).astype(x.dtype)
+    q, scale, cols = _chunk_quantize(x, axis_size, group_size)
+    return _a2a_sum(q, scale, cols, chunk_shape, axis_name, x.dtype,
+                    repeats=repeats)
+
+
+def quantized_reduce_scatter_ef(x: jnp.ndarray, axis_name: str,
+                                axis_size: int, residual: jnp.ndarray,
+                                err_beta: float = 0.8,
+                                group_size: int = 256,
+                                repeats: int = 1
+                                ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """:func:`quantized_reduce_scatter` with LoCo error feedback: quantizes
+    ``x + residual``, and the damped local quantization error becomes the new
+    residual. Returns ``(scattered_sum, new_residual)`` (residual has ``x``'s
+    shape)."""
+    n = x.shape[0]
+    assert n % axis_size == 0, (n, axis_size)
+    chunk_shape = (n // axis_size,) + x.shape[1:]
+    corrected = x + residual
+    q, scale, cols = _chunk_quantize(corrected, axis_size, group_size)
+    # what this worker actually transmitted, dequantized locally
+    sent = (q.astype(jnp.float32) * scale).reshape(axis_size, -1)[:, :cols]
+    sent = sent.reshape(x.shape).astype(x.dtype)
+    new_residual = err_beta * (corrected - sent)
+    return (_a2a_sum(q, scale, cols, chunk_shape, axis_name, x.dtype,
+                     repeats=repeats),
+            new_residual)

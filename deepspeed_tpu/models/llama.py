@@ -243,7 +243,8 @@ def _resolve_attention(cfg: LlamaConfig, in_pipeline: bool = False):
 
                 k, v = repeat_kv(k, target), repeat_kv(v, target)
             spec = P(BATCH_AXES, None, axes, None)
-            return jax.shard_map(
+            from ..comm import comm as dist
+            return dist.shard_map(
                 lambda ql, kl, vl: chunked(ql, kl, vl, causal=causal),
                 mesh=mm.mesh, in_specs=(spec, spec, spec), out_specs=spec,
                 check_vma=False)(q, k, v)

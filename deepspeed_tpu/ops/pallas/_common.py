@@ -25,7 +25,12 @@ def dim_semantics(*sem: str):
     generations) and relax ordering constraints."""
     if pltpu is None:
         return None
-    return pltpu.CompilerParams(dimension_semantics=sem)
+    # renamed TPUCompilerParams -> CompilerParams across jax versions
+    params_cls = getattr(pltpu, "CompilerParams", None) or \
+        getattr(pltpu, "TPUCompilerParams", None)
+    if params_cls is None:  # pragma: no cover
+        return None
+    return params_cls(dimension_semantics=sem)
 
 
 def row_block(n_rows: int) -> int:

@@ -30,6 +30,7 @@ from typing import Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 
 try:
@@ -62,8 +63,9 @@ def _decode_kernel(*refs, bs, scale, nblk, gpad, has_window):
 
     ctx = ctx_ref[b] + 1  # current token attends to itself too
     # sliding window: only positions in (ctx-1-w, ctx-1] are visible; blocks
-    # entirely older than the window skip their compute (their DMA still
-    # runs — the table entry is whatever the scheduler left there)
+    # entirely older than the window skip their compute AND their DMA —
+    # kvmap folds dead grid steps onto the nearest live block index, and
+    # Pallas elides the copy when consecutive steps map to the same block
     if has_window:
         lo = ctx_ref[b] - wnd_ref[0]
         live = jnp.logical_and(j * bs < ctx, j * bs + bs - 1 > lo)
@@ -118,6 +120,13 @@ def paged_decode_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
     gpad = max(8, 1 << (g - 1).bit_length())  # sublane-pad the query group
     scale = hd ** -0.5 if scale is None else scale
     has_window = window is not None
+    if has_window:
+        # window <= 0 is nonsensical: every score masks to NEG_INF and the
+        # all-masked softmax degenerates to a uniform average over a garbage
+        # block (ADVICE r5). Reject static values outright; clamp traced ones.
+        if isinstance(window, (int, np.integer)):
+            assert window >= 1, f"sliding window must be >= 1, got {window}"
+        window = jnp.maximum(jnp.asarray(window, jnp.int32), 1)
 
     # [B, nkv, gpad, hd] query groups
     qg = q.reshape(B, nkv, g, hd)
@@ -191,7 +200,11 @@ def paged_decode_attention_xla(q: jnp.ndarray, k_pool: jnp.ndarray,
     cl = context_lens[:, None, None, None]
     mask = kv_pos <= cl
     if window is not None:
-        mask = mask & (kv_pos > cl - jnp.asarray(window, jnp.int32))
+        # same window >= 1 contract as the Pallas kernel
+        if isinstance(window, (int, np.integer)):
+            assert window >= 1, f"sliding window must be >= 1, got {window}"
+        window = jnp.maximum(jnp.asarray(window, jnp.int32), 1)
+        mask = mask & (kv_pos > cl - window)
     out = attention_xla(q[:, None], kg, vg, causal=False, mask=mask,
                         scale=scale)
     return out[:, 0]

@@ -192,6 +192,39 @@ class CommsLoggerConfig(ConfigModel):
 
 @register_config_model
 @dataclass
+class CommsOverlapConfig(ConfigModel):
+    """``comms_overlap`` block — the gradient-communication overlap engine
+    (``comm/overlap.py``; see docs/performance.md). ``enabled: false`` (the
+    default) reproduces the baseline numerics bit-for-bit; when enabled the
+    engine reduces gradients with explicit, coalesced collectives under
+    shard_map instead of per-leaf sharding-constraint-implied ones.
+
+    Requires ZeRO stage <= 2 (stage 3's gather-on-use parameter sharding
+    conflicts with the manual data-parallel region) and no pipeline axis."""
+    enabled: bool = False
+    # flatten small grad leaves into flat buckets of ~this size before the
+    # reduce-scatter (reference reduce_bucket_size analog); leaves larger
+    # than the cap keep their own per-leaf reduce-scatter
+    coalesce_buckets: bool = True
+    bucket_size_mb: float = 25.0
+    # accumulate micro-batch grads locally and reduce ONCE per optimizer
+    # step (gas x less DP comm volume; costs a full-size fp32 accumulator)
+    deferred_gradient_reduce: bool = True
+    # LoCo error feedback for the qgZ int8 reduce-scatter (reference
+    # all_to_all_loco_quant_reduce; needs zero_quantized_gradients)
+    loco: bool = False
+    loco_err_beta: float = 0.8
+    # XLA latency-hiding-scheduler / async-collective programming
+    async_collectives: bool = True
+    combine_threshold_mb: float = 0.0  # 0 -> leave the XLA default
+    extra_xla_flags: List[str] = field(default_factory=list)
+    # optional link bandwidth (GB/s per device) for the telemetry hub's
+    # estimated unoverlapped-comm fraction; 0 -> skip that event
+    reference_bw_gbps: float = 0.0
+
+
+@register_config_model
+@dataclass
 class ProfilerConfig(ConfigModel):
     """Config-gated JAX profiler session: brackets global steps
     ``[start_step, end_step]`` with ``jax.profiler.start_trace/stop_trace``
@@ -285,6 +318,7 @@ class DeepSpeedTPUConfig:
         default_factory=ActivationCheckpointingConfig)
     flops_profiler: FlopsProfilerConfig = field(default_factory=FlopsProfilerConfig)
     comms_logger: CommsLoggerConfig = field(default_factory=CommsLoggerConfig)
+    comms_overlap: CommsOverlapConfig = field(default_factory=CommsOverlapConfig)
     profiler: ProfilerConfig = field(default_factory=ProfilerConfig)
     tensorboard: MonitorBackendConfig = field(default_factory=MonitorBackendConfig)
     wandb: MonitorBackendConfig = field(default_factory=MonitorBackendConfig)
@@ -358,6 +392,7 @@ _SUBCONFIG_KEYS = {
     "activation_checkpointing": ActivationCheckpointingConfig,
     "flops_profiler": FlopsProfilerConfig,
     "comms_logger": CommsLoggerConfig,
+    "comms_overlap": CommsOverlapConfig,
     "profiler": ProfilerConfig,
     "tensorboard": MonitorBackendConfig,
     "wandb": MonitorBackendConfig,

@@ -32,6 +32,9 @@ EXPERT_PARALLEL_SIZE = "expert_parallel_size"
 
 MESH = "mesh"
 
+COMMS_LOGGER = "comms_logger"
+COMMS_OVERLAP = "comms_overlap"
+
 ZERO_STAGE_0 = 0
 ZERO_STAGE_1 = 1
 ZERO_STAGE_2 = 2

@@ -89,6 +89,12 @@ class TrainState(NamedTuple):
     opt_state: Any
     loss_scale: LossScaleState
     skipped_steps: jnp.ndarray
+    # LoCo error-feedback residuals (comms_overlap.loco + qgZ): one fp32
+    # array of global shape [dp_world, *leaf.shape] per quantized-reduce
+    # leaf, sharded over the batch axes so each device carries ITS OWN
+    # quantization error. Empty tuple (no pytree leaves) when disabled, so
+    # the default step's compiled program is unchanged.
+    loco_residual: Any = ()
 
 
 class StepOutput(NamedTuple):
@@ -204,6 +210,29 @@ class DeepSpeedTPUEngine:
                 "quantized reduce-scatter produces grads in the stage-2 "
                 "sharded layout (stage 3 param gathering is a separate path)")
 
+        # --- comms_overlap: gradient-comm overlap engine (comm/overlap.py) ---
+        co = config.comms_overlap
+        self.comms_overlap_flags: List[str] = []
+        self._overlap_plan_cache = None
+        if co.enabled:
+            if config.zero_config.stage >= 3:
+                raise ValueError(
+                    "comms_overlap requires ZeRO stage <= 2: stage 3's "
+                    "gather-on-use parameter sharding conflicts with the "
+                    "manual data-parallel reduction region")
+            if mesh_mgr.pp_world_size > 1:
+                log_dist("comms_overlap: pipeline axis active — the overlap "
+                         "engine is disabled (1F1B owns its own reduction); "
+                         "XLA flags still apply")
+            if co.loco and not config.zero_config.zero_quantized_gradients:
+                logger.warning(
+                    "comms_overlap.loco has no effect without "
+                    "zero_quantized_gradients (qgZ) — there is no quantizer "
+                    "to error-compensate")
+            from ..comm.overlap import apply_xla_overlap_flags
+
+            self.comms_overlap_flags = apply_xla_overlap_flags(co)
+
         from ..comm.mesh import ZERO_AXES as _ZERO_AXES
 
         zero_axes = _ZERO_AXES
@@ -298,6 +327,11 @@ class DeepSpeedTPUEngine:
                 loss_scale=loss_scale,
                 skipped_steps=skipped0,
             )
+
+        if self._overlap_active():
+            self._overlap_setup()  # static routing, cached for engine life
+            if co.loco and config.zero_config.zero_quantized_gradients:
+                self._init_loco_residuals()
 
         # --- compiled steps ---
         self._train_step = None
@@ -797,6 +831,7 @@ class DeepSpeedTPUEngine:
         fp32 group scales) instead of fp32 — the DCN-crossing story. Leaves
         whose target spec is replicated (and the 'data' axis under MiCS, which
         replicates) reduce with a plain fp32 psum."""
+        from ..comm import overlap as ov
         from ..comm.mesh import BATCH_AXES
         from ..comm.compressed import quantized_reduce_scatter_dim
 
@@ -813,38 +848,14 @@ class DeepSpeedTPUEngine:
         flat_specs = jax.tree.leaves(self.grad_specs, is_leaf=is_p)
         param_leaves = jax.tree.leaves(params)  # grad shapes == param shapes
 
-        def split_axes(spec):
-            """(dim, scatter_axes, residual_axes) for one grad leaf."""
-            for i, e in enumerate(spec):
-                ent = e if isinstance(e, tuple) else ((e,) if e else ())
-                axes = tuple(a for a in ent if a in manual)
-                if axes:
-                    return i, axes, tuple(a for a in manual if a not in axes)
-            return None, (), manual
-
-        # per-leaf plan, decided ONCE from static shapes so the out_specs and
-        # the in-region reduction can never disagree; indivisible dims (only
-        # reachable via non-ZeRO rules like 'expert') demote to a plain psum
-        plans = []
-        for leaf, spec in zip(param_leaves, flat_specs):
-            d, scatter, residual = split_axes(spec)
-            if d is not None:
-                n_sc = int(np.prod([mm.axis_size(a) for a in scatter]))
-                if leaf.shape[d] % n_sc != 0:
-                    d, scatter, residual = None, (), manual
-            plans.append((d, scatter, residual))
-
-        def out_spec(ndim, plan):
-            d, scatter, _ = plan
-            ents = [None] * ndim
-            if d is not None:
-                ents[d] = scatter if len(scatter) > 1 else scatter[0]
-            return P(*ents)
+        # per-leaf plan (shared with the comms_overlap engine — overlap.py)
+        plans = ov.make_reduce_plans(param_leaves, flat_specs, manual,
+                                     mm.axis_size)
 
         gdef_template = jax.tree_util.tree_structure(params)
         out_gspecs = jax.tree_util.tree_unflatten(
             gdef_template,
-            [out_spec(leaf.ndim, plan)
+            [ov.plan_out_spec(leaf.ndim, plan)
              for leaf, plan in zip(param_leaves, plans)])
         batch_specs = jax.tree.map(lambda x: P(manual), batch)
 
@@ -873,32 +884,41 @@ class DeepSpeedTPUEngine:
                 else jax.lax.psum(jnp.asarray(a), manual), aux)
             return grads, loss, aux
 
-        return jax.shard_map(
+        return dist.shard_map(
             local, mesh=mm.mesh, axis_names=set(manual),
             in_specs=(P(), batch_specs),
             out_specs=(out_gspecs, P(), P()),
             check_vma=False)(compute, batch)
 
-    def _constrain_grads(self, grads):
+    def _constrain_grads(self, grads, record: bool = True,
+                         repeats: int = 1):
         """Apply the stage's gradient sharding (reduce-scatter from stage 2 —
         reference stage_1_and_2.py:126): XLA fuses the implied psum over the
-        data axes with this placement into a reduce-scatter."""
+        data axes with this placement into a reduce-scatter.
+
+        ``repeats``: execution count of the enclosing trace region (a scan
+        body over GAS micros executes per micro) so the telemetry's per-step
+        volume stays honest; ``record=False`` for constraints that imply no
+        reduction (placing a fresh zeros accumulator)."""
         # comms-logger: the batch-sharded loss implies a grad reduction over
         # the batch axes — record it at trace time so data-parallel volume
         # shows up in the per-op summary even though XLA inserts the op
         tel = dist.get_telemetry()
-        if tel.enabled:
+        if tel.enabled and record:
             axes = tuple(a for a in BATCH_AXES
                          if self.mesh_mgr.axis_size(a) > 1)
             if axes:
                 op = ("reduce_scatter_grads"
                       if self.config.zero_config.stage >= 2
                       else "all_reduce_grads")
-                tel.record(op, axes, grads)
+                tel.record(op, axes, grads, repeats=repeats)
         return jax.lax.with_sharding_constraint(grads, self._grad_shardings)
 
     def _accumulate(self, params, batch, loss_scale):
-        """GAS micro-batch loop under lax.scan; batch leading dim = gas."""
+        """GAS micro-batch loop under lax.scan; batch leading dim = gas.
+        The PER-MICRO reduction path: each micro's implied grad reduce fires
+        inside the scan body (gas collectives per step). The comms_overlap
+        config block swaps this for :meth:`_accumulate_overlap`."""
         gas = self.gradient_accumulation_steps()
         if gas == 1:
             grads, loss, aux = self._grads_one_micro(params, batch, loss_scale)
@@ -909,11 +929,13 @@ class DeepSpeedTPUEngine:
             grads, loss, aux = self._grads_one_micro(params, micro, loss_scale)
             acc = jax.tree.map(lambda a, g: a + g.astype(jnp.float32), acc, grads)
             # keep the accumulator in the stage's grad layout between micros
-            # (stage>=2: sharded — the API-parity path stays O(params/N))
-            return self._constrain_grads(acc), (loss, aux)
+            # (stage>=2: sharded — the API-parity path stays O(params/N));
+            # the body executes once per micro → repeats=gas
+            return self._constrain_grads(acc, repeats=gas), (loss, aux)
 
         zeros = self._constrain_grads(
-            jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params))
+            jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params),
+            record=False)
         acc, (losses, auxes) = jax.lax.scan(body, zeros, batch)
         grads = jax.tree.map(lambda g: g / gas, acc)
         # aux: mean over micros for floats, sum otherwise (token counts etc.)
@@ -922,8 +944,235 @@ class DeepSpeedTPUEngine:
             else jnp.sum(a, axis=0), auxes)
         return grads, jnp.mean(losses), aux
 
+    # ------------------------------------------------------------------ #
+    # comms_overlap: deferred / bucketed / LoCo gradient reduction
+    # ------------------------------------------------------------------ #
+    def _overlap_active(self) -> bool:
+        """The comms_overlap reduction engine replaces ``_accumulate`` when
+        the block is enabled, a data-parallel axis exists, and no pipeline
+        schedule owns the backward."""
+        co = self.config.comms_overlap
+        if not co.enabled:
+            return False
+        if self.mesh_mgr.pp_world_size > 1:
+            return False  # 1F1B owns its reduction (logged at init)
+        return any(self.mesh_mgr.axis_size(a) > 1 for a in BATCH_AXES)
+
+    def _overlap_setup(self):
+        """Static per-leaf routing for the overlap engine, computed once:
+        (manual axes, world, reduce plans, flat buckets, bucketed set, LoCo
+        leaf indices). Shapes only — safe to cache for the engine's life."""
+        if self._overlap_plan_cache is not None:
+            return self._overlap_plan_cache
+        from ..comm import overlap as ov
+
+        co = self.config.comms_overlap
+        mm = self.mesh_mgr
+        manual = tuple(a for a in BATCH_AXES if mm.axis_size(a) > 1)
+        n_total = int(np.prod([mm.axis_size(a) for a in manual]))
+        is_p = lambda x: isinstance(x, P)  # noqa: E731
+        flat_specs = jax.tree.leaves(self.grad_specs, is_leaf=is_p)
+        leaves = jax.tree.leaves(self.state.params)
+        plans = ov.make_reduce_plans(leaves, flat_specs, manual, mm.axis_size)
+        buckets: List[List[int]] = []
+        bucketed: frozenset = frozenset()
+        if co.coalesce_buckets:
+            bucket_bytes = max(int(co.bucket_size_mb * 2 ** 20), 4 * n_total)
+            small = [i for i, l in enumerate(leaves)
+                     if ov.padded_rows(l.size, n_total) * 4 <= bucket_bytes]
+            buckets = ov.plan_buckets(small, [l.size for l in leaves],
+                                      n_total, bucket_bytes)
+            bucketed = frozenset(i for b in buckets for i in b)
+        loco_idx: Tuple[int, ...] = ()
+        if co.loco and self.config.zero_config.zero_quantized_gradients:
+            # error feedback exists where quantization does: the int8
+            # scatter-planned leaves (bucketed small leaves reduce in exact
+            # fp32 and need no compensation)
+            loco_idx = tuple(i for i, p in enumerate(plans)
+                             if p.dim is not None and i not in bucketed)
+        self._overlap_plan_cache = (manual, n_total, plans, buckets,
+                                    bucketed, loco_idx)
+        return self._overlap_plan_cache
+
+    def _init_loco_residuals(self) -> None:
+        """Allocate the per-leaf LoCo quantization-error residuals into
+        ``TrainState``: global shape [dp_world, *leaf.shape] fp32, sharded
+        over the batch axes (each device owns its own error)."""
+        manual, n_total, _, _, _, loco_idx = self._overlap_setup()
+        if not loco_idx:
+            return
+        leaves = jax.tree.leaves(self.state.params)
+        res = []
+        for i in loco_idx:
+            leaf = leaves[i]
+            sharding = NamedSharding(self.mesh_mgr.mesh, P(manual))
+            res.append(jax.device_put(
+                jnp.zeros((n_total,) + tuple(leaf.shape), jnp.float32),
+                sharding))
+        self.state = self.state._replace(loco_residual=tuple(res))
+        log_dist(f"comms_overlap LoCo: carrying {len(res)} error-feedback "
+                 f"residual leaves (err_beta="
+                 f"{self.config.comms_overlap.loco_err_beta})")
+
+    def _accumulate_overlap(self, params, batch, loss_scale, residuals):
+        """The comms_overlap replacement for :meth:`_accumulate`: gradients
+        reduce with EXPLICIT collectives in a manual (shard_map) region —
+
+        - small leaves coalesce into flat buckets → one reduce-scatter +
+          all-gather per bucket instead of one collective per leaf;
+        - large leaves reduce-scatter straight into the stage's sharded grad
+          layout (int8-quantized when qgZ is on, with optional LoCo error
+          feedback);
+        - with ``deferred_gradient_reduce``, micro-batch grads accumulate in
+          the local (unreduced, full-shape fp32) layout and the collectives
+          fire ONCE per optimizer step instead of once per micro.
+
+        Returns ``(grads, loss, aux, new_residuals)``."""
+        from ..comm import compressed as cc
+        from ..comm import overlap as ov
+
+        co = self.config.comms_overlap
+        mm = self.mesh_mgr
+        gas = self.gradient_accumulation_steps()
+        manual, n_total, plans, buckets, bucketed, loco_idx = \
+            self._overlap_setup()
+        qgz = self.config.zero_config.zero_quantized_gradients
+        deferred = co.deferred_gradient_reduce and gas > 1
+        err_beta = float(co.loco_err_beta)
+        # collectives in a non-deferred scan body run once per micro
+        reps = gas if (gas > 1 and not deferred) else 1
+        res_pos = {leaf_i: k for k, leaf_i in enumerate(loco_idx)}
+
+        compute = self._cast_gather(params)
+        param_leaves = jax.tree.leaves(params)
+        gdef = jax.tree_util.tree_structure(params)
+
+        def scatter_world(plan):
+            return int(np.prod([mm.axis_size(a) for a in plan.scatter]))
+
+        def reduced_shape(leaf, i):
+            plan = plans[i]
+            if i in bucketed or plan.dim is None:
+                return tuple(leaf.shape)
+            shape = list(leaf.shape)
+            shape[plan.dim] //= scatter_world(plan)
+            return tuple(shape)
+
+        out_gspecs = jax.tree_util.tree_unflatten(
+            gdef,
+            [P() if i in bucketed else ov.plan_out_spec(leaf.ndim, plans[i])
+             for i, leaf in enumerate(param_leaves)])
+        batch_specs = jax.tree.map(
+            lambda x: P(None, manual) if gas > 1 else P(manual), batch)
+        res_specs = tuple(P(manual) for _ in loco_idx)
+
+        def reduce_all(gleaves, res_leaves):
+            """One full explicit reduction of the (local) grad leaves."""
+            red: List[Any] = [None] * len(gleaves)
+            new_res = list(res_leaves)
+            for bucket in buckets:
+                outs = ov.coalesced_reduce([gleaves[i] for i in bucket],
+                                           manual, repeats=reps)
+                for i, o in zip(bucket, outs):
+                    red[i] = o
+            for i, (g, plan) in enumerate(zip(gleaves, plans)):
+                if red[i] is not None:
+                    continue
+                g = g.astype(jnp.float32)
+                if plan.dim is not None:
+                    if qgz:
+                        if i in res_pos:
+                            g, nr = cc.loco_quantized_reduce_scatter_dim(
+                                g, plan.dim, plan.scatter,
+                                new_res[res_pos[i]], err_beta=err_beta)
+                            new_res[res_pos[i]] = nr
+                        else:
+                            g = cc.quantized_reduce_scatter_dim(
+                                g, plan.dim, plan.scatter)
+                    else:
+                        g = ov.reduce_scatter_dim(g, plan.dim, plan.scatter,
+                                                  repeats=reps)
+                if plan.psum_axes:
+                    dist.get_telemetry().record(
+                        "all_reduce_grads", plan.psum_axes, g, repeats=reps)
+                    g = jax.lax.psum(g, plan.psum_axes)
+                red[i] = g
+            return red, new_res
+
+        def local(compute_params, lbatch, res_in):
+            res_leaves = [r[0] for r in res_in]  # drop the device dim
+
+            def grads_of(mb):
+                def scaled(p):
+                    out = self.model.loss_fn(p, mb)
+                    loss, aux = out if isinstance(out, tuple) else (out, {})
+                    loss = loss.astype(jnp.float32)
+                    return scale_loss(loss, loss_scale), (loss, aux)
+
+                return jax.grad(scaled, has_aux=True)(compute_params)
+
+            denom = float(n_total * gas)
+            if gas == 1:
+                grads, (loss, aux) = grads_of(lbatch)
+                red, new_res = reduce_all(jax.tree.leaves(grads), res_leaves)
+                losses, auxes = loss, aux
+            elif deferred:
+                # local-layout accumulation: full-shape fp32 partial grads
+                # per device, ONE reduction at the step boundary
+                def body(acc, mb):
+                    grads, (loss, aux) = grads_of(mb)
+                    acc = jax.tree.map(
+                        lambda a, g: a + g.astype(jnp.float32), acc, grads)
+                    return acc, (loss, aux)
+
+                zeros = jax.tree.map(
+                    lambda p: jnp.zeros(p.shape, jnp.float32), compute_params)
+                acc, (losses, auxes) = jax.lax.scan(body, zeros, lbatch)
+                red, new_res = reduce_all(jax.tree.leaves(acc), res_leaves)
+            else:
+                # per-micro explicit reduction (the collectives run inside
+                # the scan body, but bucketed/quantized/LoCo still apply)
+                def body(carry, mb):
+                    acc, res = carry
+                    grads, (loss, aux) = grads_of(mb)
+                    red, res = reduce_all(jax.tree.leaves(grads), res)
+                    acc = [a + r for a, r in zip(acc, red)]
+                    return (acc, res), (loss, aux)
+
+                zeros = [jnp.zeros(reduced_shape(leaf, i), jnp.float32)
+                         for i, leaf in enumerate(param_leaves)]
+                (red, new_res), (losses, auxes) = jax.lax.scan(
+                    body, (zeros, res_leaves), lbatch)
+
+            red = [g / denom for g in red]
+            grads = jax.tree_util.tree_unflatten(gdef, red)
+            loss = jax.lax.psum(jnp.mean(losses), manual) / n_total
+            aux = jax.tree.map(
+                lambda a: jax.lax.psum(
+                    jnp.mean(a, axis=0).astype(jnp.float32)
+                    if jnp.asarray(a).ndim and gas > 1 else
+                    jnp.asarray(a).astype(jnp.float32), manual) / n_total
+                if jnp.issubdtype(jnp.asarray(a).dtype, jnp.inexact)
+                else jax.lax.psum(
+                    jnp.sum(jnp.asarray(a), axis=0)
+                    if jnp.asarray(a).ndim and gas > 1
+                    else jnp.asarray(a), manual), auxes)
+            return (grads, loss, aux,
+                    tuple(r[None] for r in new_res))
+
+        grads, loss, aux, new_residuals = dist.shard_map(
+            local, mesh=mm.mesh, axis_names=set(manual),
+            in_specs=(P(), batch_specs, res_specs),
+            out_specs=(out_gspecs, P(), P(), res_specs),
+            check_vma=False)(compute, batch, residuals)
+        # place (bucketed leaves: a local slice; planned leaves: no-op) into
+        # the stage's grad layout — no additional comm is implied here
+        grads = jax.lax.with_sharding_constraint(grads, self._grad_shardings)
+        return grads, loss, aux, new_residuals
+
     def _apply_update(self, state: TrainState, grads, loss, aux=None,
-                      lr_override=None) -> Tuple[TrainState, StepOutput]:
+                      lr_override=None,
+                      loco_residual=None) -> Tuple[TrainState, StepOutput]:
         cfg = self.config
         finite = grads_finite(grads)
         grads = unscale_grads(grads, state.loss_scale)
@@ -956,6 +1205,10 @@ class DeepSpeedTPUEngine:
             opt_state=new_opt,
             loss_scale=new_scale,
             skipped_steps=state.skipped_steps + jnp.where(finite, 0, 1).astype(jnp.int32),
+            # LoCo residuals advance even on a skipped step: they describe
+            # the quantization error of the reduce that DID happen
+            loco_residual=(state.loco_residual if loco_residual is None
+                           else loco_residual),
         )
         out = StepOutput(loss=loss, grad_norm=grad_norm, lr=lr_t,
                          loss_scale=new_scale.scale,
@@ -964,7 +1217,16 @@ class DeepSpeedTPUEngine:
         return new_state, out
 
     def _build_train_step(self):
+        overlap = self._overlap_active()
+
         def step_fn(state: TrainState, batch, lr_override):
+            if overlap:
+                grads, loss, aux, new_res = self._accumulate_overlap(
+                    state.params, batch, state.loss_scale,
+                    state.loco_residual)
+                return self._apply_update(state, grads, loss, aux,
+                                          lr_override,
+                                          loco_residual=new_res)
             grads, loss, aux = self._accumulate(state.params, batch, state.loss_scale)
             return self._apply_update(state, grads, loss, aux, lr_override)
 

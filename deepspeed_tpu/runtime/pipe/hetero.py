@@ -49,6 +49,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from ...comm import comm as dist
 from ...comm.mesh import get_mesh
 from .module import one_f_one_b_predicates, one_f_one_b_ticks, ring_perms
 
@@ -561,7 +562,7 @@ def hetero_pipeline_value_and_grad(
         return loss, {dt: g[None, :] for dt, g in g_rows.items()}
 
     probe_shape = jnp.zeros(probe.shape, probe.dtype)
-    loss, grads = jax.shard_map(
+    loss, grads = dist.shard_map(
         pipelined, mesh=mm.mesh, axis_names={pipe_axis},
         in_specs=({dt: P(pipe_axis) for dt in buffers}, P(), P(), P()),
         out_specs=(P(), {dt: P(pipe_axis) for dt in buffers}),

@@ -31,6 +31,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from ...comm import comm as dist
 from ...comm.mesh import get_mesh
 from ...utils.logging import logger
 
@@ -158,7 +159,7 @@ def pipeline_apply(block_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
     # Manual ONLY over 'pipe' (axis_names): data/tensor/seq/expert stay under
     # the automatic partitioner, so TP-sharded layer weights remain sharded
     # inside each stage and the batch keeps its dp sharding.
-    out = jax.shard_map(
+    out = dist.shard_map(
         pipelined, mesh=mm.mesh, axis_names={pipe_axis},
         in_specs=(jax.tree.map(lambda _: P(pipe_axis), staged), P()),
         out_specs=P(), check_vma=False)(staged, micro)

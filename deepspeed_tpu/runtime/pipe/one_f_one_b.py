@@ -40,6 +40,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from ...comm import comm as dist
 from ...comm.mesh import get_mesh
 from .module import (_stage_params, one_f_one_b_predicates,
                      one_f_one_b_ticks, psum_f32, ring_perms)
@@ -219,7 +220,7 @@ def pipeline_value_and_grad(embed_fn: Callable[[Any, Any], jnp.ndarray],
                            jax.tree.map(lambda x: x[0], micro_in))
     probe_shape = jnp.zeros(probe.shape, probe.dtype)
 
-    loss, g_staged, g_embed, g_head = jax.shard_map(
+    loss, g_staged, g_embed, g_head = dist.shard_map(
         pipelined, mesh=mm.mesh, axis_names={pipe_axis},
         in_specs=(jax.tree.map(lambda _: P(pipe_axis), staged),
                   P(), P(), P(), P(), P()),

@@ -169,5 +169,5 @@ def ring_attention_spmd(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
     spec = P(BATCH_AXES, seq_axis, None, None)
     fn = partial(ring_attention, axis=seq_axis, axis_size=sp, causal=causal,
                  scale=scale)
-    return jax.shard_map(fn, mesh=mm.mesh, in_specs=(spec, spec, spec),
+    return dist.shard_map(fn, mesh=mm.mesh, in_specs=(spec, spec, spec),
                          out_specs=spec, check_vma=False)(q, k, v)

@@ -114,6 +114,7 @@ class TelemetryHub:
         if mon_on or breakdown:
             if self.comms.enabled:
                 events += self.comms.events(step)
+                events += self._comm_efficiency_events(step, step_time_s)
             events += self.memory.events(step)
             if self.tput_timer is not None and \
                     getattr(self.tput_timer, "flops_per_step", None):
@@ -136,6 +137,30 @@ class TelemetryHub:
         if mon_on and events:
             self.monitor.write_events(events)
         self.profiler.maybe_stop(step)
+        return events
+
+    # ------------------------------------------------------------------ #
+    def _comm_efficiency_events(self, step: int,
+                                step_time_s: Optional[float]) -> List[Event]:
+        """Comm-efficiency rollup for the overlap engine: total per-step
+        algorithmic bytes across every recorded collective, the achieved
+        algorithmic bus bandwidth, and — when ``comms_overlap.
+        reference_bw_gbps`` names the link speed — the estimated
+        UNOVERLAPPED comm fraction (serial comm time / step time; an upper
+        bound, since overlapped collectives hide behind compute)."""
+        total = self.comms.total_algo_bytes()
+        if total <= 0:
+            return []
+        events: List[Event] = [("Comm/total/algo_bytes", total, step)]
+        if step_time_s:
+            events.append(("Comm/total/busbw_gbps",
+                           total / step_time_s / 1e9, step))
+            co = getattr(self.cfg, "comms_overlap", None)
+            ref_bw = float(getattr(co, "reference_bw_gbps", 0.0) or 0.0)
+            if ref_bw > 0:
+                serial_s = total / (ref_bw * 1e9)
+                events.append(("Comm/total/est_comm_frac",
+                               min(1.0, serial_s / step_time_s), step))
         return events
 
     # ------------------------------------------------------------------ #

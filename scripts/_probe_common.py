@@ -11,12 +11,25 @@ import signal
 import sys
 
 
-def _bad(v) -> bool:
+# Structured failure markers (ADVICE r5): a failure row must START with one
+# of these prefixes ("error: <detail>"), or be a dict with status == "error".
+# The old substring scan flagged benign labels ("failover", "timeout_budget")
+# and silently poisoned ok — prefix matching keeps producers explicit.
+_BAD_PREFIXES = ("error:", "fail:", "failed:", "timeout:")
+# dedicated failure slots: any non-empty string under these keys is a failure
+# even without the prefix (every probe stores its traceback tail there)
+_BAD_KEYS = ("error", "exception")
+
+
+def _bad(v, key=None) -> bool:
     if isinstance(v, str):
-        low = v.lower()
-        return "error" in low or "fail" in low or "timeout" in low
+        if key in _BAD_KEYS:
+            return bool(v.strip())
+        return v.lower().lstrip().startswith(_BAD_PREFIXES)
     if isinstance(v, dict):
-        return any(_bad(x) for x in v.values())
+        if str(v.get("status", "")).strip().lower() == "error":
+            return True
+        return any(_bad(x, key=k) for k, x in v.items())
     if isinstance(v, (list, tuple)):
         return any(_bad(x) for x in v)
     return False
@@ -25,9 +38,12 @@ def _bad(v) -> bool:
 def finalize(result: dict, ok=None) -> None:
     """Set ``detail.ok`` and print the one stdout JSON line.
 
-    ``ok=None`` (the default rule): False if any nested detail string
-    reports an error/failure/timeout — 'skipped: <budget>' rows are not
-    failures. An explicit bool overrides the scan for probes where a
+    ``ok=None`` (the default rule): False if any nested detail value carries
+    a STRUCTURED failure marker — a string starting with ``error:`` /
+    ``fail:`` / ``failed:`` / ``timeout:``, a dict with ``status: "error"``,
+    or any non-empty string under an ``error``/``exception`` key. Benign
+    labels that merely contain those words ('failover', 'skipped: <budget>')
+    are not failures. An explicit bool overrides the scan for probes where a
     failure row is part of a successful run (longctx records its OOM
     frontier by design)."""
     result["detail"]["ok"] = (not _bad(result["detail"])) if ok is None \

@@ -49,6 +49,53 @@ def _fmt_bytes(n: float) -> str:
     return f"{n:,.1f} TiB"
 
 
+def comm_efficiency(events: List[dict]) -> str:
+    """``--comm-efficiency``: collective count, total algorithmic bytes, and
+    bytes-per-step from the ``Comm/*`` series — the offline comm-volume
+    regression check (comm records are per compiled step, so the last sample
+    of each series IS the per-step number; totals scale by executed steps)."""
+    steps = sorted({e.get("step", 0) for e in events})
+    n_steps = len(steps)
+    per_op: Dict[str, Dict[str, float]] = {}
+    for e in events:
+        name = e["name"]
+        if not name.startswith("Comm/") or name.startswith("Comm/total/"):
+            continue
+        _, op, kind = name.split("/", 2)
+        per_op.setdefault(op, {})[kind] = e["value"]  # last sample wins
+    if not per_op:
+        return "comm efficiency: no Comm/* events in this file"
+    lines = [f"comm efficiency ({n_steps} steps)"]
+    lines.append(f"  {'op':<28} {'count/step':>10} {'bytes/step':>14} "
+                 f"{'algo bytes/step':>16}")
+    tot_count = tot_bytes = tot_algo = 0.0
+    for op, kinds in sorted(per_op.items()):
+        count = kinds.get("count", 0.0)
+        nbytes = kinds.get("bytes", 0.0)
+        algo = kinds.get("algo_bytes", nbytes)
+        tot_count += count
+        tot_bytes += nbytes
+        tot_algo += algo
+        lines.append(f"  {op:<28} {int(count):>10} "
+                     f"{_fmt_bytes(nbytes):>14} {_fmt_bytes(algo):>16}")
+    lines.append(f"  {'TOTAL':<28} {int(tot_count):>10} "
+                 f"{_fmt_bytes(tot_bytes):>14} {_fmt_bytes(tot_algo):>16}")
+    lines.append("")
+    lines.append(f"  collectives/step:      {int(tot_count)}")
+    lines.append(f"  algo bytes/step:       {_fmt_bytes(tot_algo)}")
+    lines.append(f"  algo bytes whole run:  {_fmt_bytes(tot_algo * n_steps)}")
+    busbw = [e["value"] for e in events
+             if e["name"] == "Comm/total/busbw_gbps"]
+    if busbw:
+        lines.append(f"  busbw (last):          {busbw[-1]:.2f} GB/s")
+    frac = [e["value"] for e in events
+            if e["name"] == "Comm/total/est_comm_frac"]
+    if frac:
+        lines.append(f"  est unoverlapped comm: {frac[-1] * 100:.1f}% "
+                     f"of step time (upper bound)")
+    return "\n".join(lines)
+
+
 def summarize(events: List[dict], last: int = 0) -> str:
     if last > 0:
         steps = sorted({e.get("step", 0) for e in events})[-last:]
@@ -114,6 +161,9 @@ def main(argv=None) -> int:
     ap.add_argument("path", help="path to an events.jsonl telemetry file")
     ap.add_argument("--last", type=int, default=0,
                     help="restrict to the last N steps")
+    ap.add_argument("--comm-efficiency", action="store_true",
+                    help="print collective count / total algorithmic bytes / "
+                         "bytes-per-step (comm-volume regression check)")
     args = ap.parse_args(argv)
     try:
         events = load_events(args.path)
@@ -123,6 +173,9 @@ def main(argv=None) -> int:
     if not events:
         print(f"error: no telemetry events in {args.path}", file=sys.stderr)
         return 1
+    if args.comm_efficiency:
+        print(comm_efficiency(events))
+        return 0
     print(summarize(events, last=args.last))
     return 0
 

@@ -8,7 +8,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from deepspeed_tpu.comm.comm import shard_map
 
 from deepspeed_tpu.comm.compressed import (dequantize_int8, onebit_all_reduce,
                                            onebit_compress,

@@ -63,7 +63,7 @@ def test_tp_model_init(devices8):
 
 
 def test_domino_parallel_linears(devices8):
-    from jax import shard_map
+    from deepspeed_tpu.comm.comm import shard_map
 
     mesh = Mesh(np.asarray(jax.devices()).reshape(4, 2), ("data", "tensor"))
     x = jax.random.normal(jax.random.PRNGKey(0), (8, 16))

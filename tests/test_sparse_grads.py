@@ -7,7 +7,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from deepspeed_tpu.comm.comm import shard_map
 
 from deepspeed_tpu.runtime.sparse_grads import (SparseTensor, dense_grad_wins,
                                                 sparse_all_reduce,
