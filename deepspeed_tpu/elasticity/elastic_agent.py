@@ -94,7 +94,8 @@ class PreemptionGuard:
     """
 
     def __init__(self, save_dir: str, *, signals: Tuple[int, ...] = None,
-                 tag: Optional[str] = None, coordinate_interval: int = 1):
+                 tag: Optional[str] = None, coordinate_interval: int = 1,
+                 watchdog=None):
         import signal as _signal
 
         self.save_dir = save_dir
@@ -108,6 +109,12 @@ class PreemptionGuard:
         self._triggered = False
         self._signum: Optional[int] = None
         self._prev: Dict[int, Any] = {}
+        # a bound TrainingWatchdog (runtime/watchdog.py) with
+        # on_violation="exit" requests checkpoint-and-exit through the SAME
+        # boundary protocol a preemption signal uses
+        self.watchdog = watchdog
+        if watchdog is not None and hasattr(watchdog, "bind_guard"):
+            watchdog.bind_guard(self)
         if signals is None:
             signals = (_signal.SIGTERM,)
         for s in signals:
@@ -121,6 +128,16 @@ class PreemptionGuard:
         prev = self._prev.get(signum)
         if callable(prev):  # chain whatever handler was there before
             prev(signum, frame)
+
+    def trigger(self, signum: Optional[int] = None) -> None:
+        """Deliver a SYNTHETIC preemption (no OS signal, no handler
+        chaining) — the entry point `deepspeed_tpu.testing.faults.preempt`
+        uses to exercise the checkpoint-on-SIGTERM path deterministically."""
+        self._triggered = True
+        self._signum = signum
+        log_dist(f"PreemptionGuard: synthetic preemption"
+                 f"{f' (signal {signum})' if signum is not None else ''} — "
+                 f"will checkpoint at the next step boundary")
 
     @property
     def triggered(self) -> bool:
@@ -139,7 +156,10 @@ class PreemptionGuard:
         globally at every boundary: an allgather-OR, synchronous with the
         step's collectives, guarantees every process sees the trigger at the
         SAME boundary and checkpoints the same step."""
-        trig = self._triggered
+        wd_exit = bool(self.watchdog is not None and
+                       getattr(self.watchdog, "restart_requested", False))
+        local = self._triggered or wd_exit
+        trig = local
         self._boundary_count += 1
         if _process_count() > 1 and \
                 self._boundary_count % self.coordinate_interval == 0:
@@ -147,7 +167,7 @@ class PreemptionGuard:
             from jax.experimental import multihost_utils
 
             trig = bool(multihost_utils.process_allgather(
-                _np.asarray(self._triggered)).any())
+                _np.asarray(local)).any())
         elif _process_count() > 1:
             # off-cadence boundaries never act on the LOCAL flag alone —
             # acting would desynchronize the collective save
@@ -156,11 +176,23 @@ class PreemptionGuard:
             return False
         self._triggered = False  # once per trigger — never re-save the
         # checkpoint on later calls inside the preemption grace window
+        if wd_exit:
+            self.watchdog.restart_requested = False
+        self._reliability(engine, "preemption_signal")
         path = engine.save_checkpoint(self.save_dir, tag=self.tag)
+        self._reliability(engine, "preemption_checkpoint")
+        cause = "watchdog exit request" if wd_exit else \
+            f"signal {self._signum or 'on a peer host'}"
         log_dist(f"PreemptionGuard: checkpoint saved to {path} after "
-                 f"signal {self._signum or 'on a peer host'}; exit for "
-                 f"elastic restart")
+                 f"{cause}; exit for elastic restart")
         return True
+
+    @staticmethod
+    def _reliability(engine, name: str) -> None:
+        tel = getattr(engine, "telemetry", None)
+        if tel is not None and hasattr(tel, "reliability_event"):
+            tel.reliability_event(name, 1.0,
+                                  int(getattr(engine, "global_steps", 0)))
 
     def uninstall(self) -> None:
         import signal as _signal

@@ -4,16 +4,27 @@ load_checkpoint :3398`` + checkpoint-engine selection ``:1287``).
 Format: per-tag directory with the full TrainState (fp32 master params,
 optimizer state, loss scaler, counters) written by the configured
 :class:`CheckpointEngine` (sync orbax / fast single-file / async decoupled),
-plus ``meta.json`` and a ``latest`` tag file. Sharded state saves/restores in
-parallel from every host and can be resharded on load — a checkpoint written
-on one mesh/ZeRO stage loads onto another (the universal-checkpoint property;
-the explicit fragment format lives in ``universal.py``).
+plus ``meta.json``, a per-file SHA-256 ``manifest.json``, and a ``latest``
+tag file. Sharded state saves/restores in parallel from every host and can be
+resharded on load — a checkpoint written on one mesh/ZeRO stage loads onto
+another (the universal-checkpoint property; the explicit fragment format
+lives in ``universal.py``).
+
+Crash consistency (``checkpoint.atomic``, default on): saves stage into
+``<tag>.tmp.<pid>``, fsync, manifest, then an atomic rename publishes the tag
+and only afterwards does ``latest`` advance — a SIGTERM or I/O error at ANY
+point leaves the previous checkpoint fully loadable (two-phase commit; the
+protocol primitives live in ``manifest.py``, the whole thing is documented in
+``docs/reliability.md`` and attacked by ``tests/test_fault_tolerance.py``).
+Loads verify the manifest (``checkpoint.verify_on_load``) and walk back to
+the newest verifiable tag instead of crashing on a corrupt/missing one.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import shutil
 from typing import Any, Dict, Optional
 
 import jax
@@ -22,16 +33,44 @@ import numpy as np
 from ...utils.logging import log_dist, logger
 from .engines import (CheckpointEngine, FastCheckpointEngine,
                       SyncCheckpointEngine, get_checkpoint_engine)
+from .manifest import (newest_verifiable_tag, publish_dir, retention_sweep,
+                       fsync_tree, verify_manifest, with_io_retries,
+                       write_latest, write_manifest)
 
 
-def resolve_tag(load_dir: str, tag: Optional[str]) -> str:
+def _reliability(engine, name: str, value: float = 1.0,
+                 step: Optional[int] = None) -> None:
+    """Route a ``Reliability/*`` event through the engine's TelemetryHub
+    (absent on bare/test engines — then this is a no-op)."""
+    tel = getattr(engine, "telemetry", None)
+    if tel is not None and hasattr(tel, "reliability_event"):
+        tel.reliability_event(
+            name, value, step if step is not None
+            else int(getattr(engine, "global_steps", 0)))
+
+
+def resolve_tag(load_dir: str, tag: Optional[str],
+                scan_fallback: bool = True) -> str:
     if tag is not None:
         return tag
     latest = os.path.join(load_dir, "latest")
     if not os.path.exists(latest):
         raise FileNotFoundError(f"no 'latest' file under {load_dir}")
     with open(latest) as f:
-        return f.read().strip()
+        tag = f.read().strip()
+    if scan_fallback and not os.path.isdir(os.path.join(load_dir, tag)):
+        # a deleted/renamed tag must not brick resume: fall back to the
+        # newest checkpoint-shaped dir actually present (verification of its
+        # CONTENTS happens in load_checkpoint)
+        logger.warning(f"'latest' under {load_dir} names missing tag "
+                       f"'{tag}' — scanning for existing checkpoints")
+        alt = newest_verifiable_tag(load_dir, exclude={tag}, verify=False)
+        if alt is None:
+            raise FileNotFoundError(
+                f"'latest' names '{tag}' but no checkpoint directories "
+                f"exist under {load_dir}")
+        return alt
+    return tag
 
 
 def read_state_tree(tag_dir: str) -> Dict[str, Any]:
@@ -56,9 +95,16 @@ def _engine_for(engine) -> CheckpointEngine:
 def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
                     client_state: Optional[Dict[str, Any]] = None) -> str:
     ce = _engine_for(engine)
+    cfg = engine.config.checkpoint
     tag = tag or f"global_step{engine.global_steps}"
-    path = os.path.abspath(os.path.join(save_dir, tag))
-    os.makedirs(path, exist_ok=True)
+    save_dir = os.path.abspath(save_dir)
+    final_path = os.path.join(save_dir, tag)
+    atomic = bool(getattr(cfg, "atomic", True))
+    stage = os.path.join(save_dir, f"{tag}.tmp.{os.getpid()}") if atomic \
+        else final_path
+    if atomic and os.path.isdir(stage):
+        shutil.rmtree(stage)  # stale staging left by a crashed earlier save
+    os.makedirs(stage, exist_ok=True)
 
     state_dict = {
         "params": engine.state.params,
@@ -67,13 +113,13 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
         "step": engine.state.step,
         "skipped_steps": engine.state.skipped_steps,
     }
-    ce.save(state_dict, os.path.join(path, "state"))
 
     # NVMe-streamed optimizer tier: its fp32 masters + moments live in .swp
     # files, not in state.opt_state — stream-copy them into the checkpoint
     nvme = getattr(engine, "_nvme_opt", None)
-    if nvme is not None and jax.process_index() == 0:
-        nvme.save_state_files(os.path.join(path, "nvme_optimizer"))
+    rank0 = jax.process_index() == 0
+    if nvme is not None and rank0:
+        nvme.save_state_files(os.path.join(stage, "nvme_optimizer"))
 
     meta = {
         "global_steps": engine.global_steps,
@@ -84,24 +130,93 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
         "checkpoint_engine": ce.name,
         "framework_version": "0.1.0",
     }
-    if jax.process_index() == 0:
-        with open(os.path.join(path, "meta.json"), "w") as f:
+    # meta lands in the STAGING dir before the state write so the async
+    # engine's deferred finalize sees a complete dir to seal + publish
+    if rank0:
+        with open(os.path.join(stage, "meta.json"), "w") as f:
             json.dump(meta, f, indent=2, default=str)
-        with open(os.path.join(save_dir, "latest"), "w") as f:
-            f.write(tag)
-    log_dist(f"saved checkpoint {path} (engine={ce.name})")
-    return path
+
+    keep_last_n = int(getattr(cfg, "keep_last_n", 0) or 0)
+    retries = int(getattr(cfg, "io_retries", 0) or 0)
+    backoff_s = float(getattr(cfg, "io_backoff_s", 0.5))
+    step_at_save = int(engine.global_steps)
+
+    def _finalize():
+        # two-phase commit, phase 2: runs only once the state bytes are
+        # durable (sync engines: inline; async: in the writer thread). Until
+        # the rename + latest update below, a crash leaves the previous
+        # checkpoint untouched and this save invisible.
+        if not rank0:
+            return
+        if atomic:
+            fsync_tree(stage)
+            write_manifest(stage)
+            publish_dir(stage, final_path)
+        write_latest(save_dir, tag)
+        removed = retention_sweep(save_dir, keep_last_n, protect=(tag,))
+        if removed:
+            _reliability(engine, "checkpoint_gc", value=removed,
+                         step=step_at_save)
+        _reliability(engine, "checkpoint_saved", step=step_at_save)
+        log_dist(f"saved checkpoint {final_path} (engine={ce.name}, "
+                 f"atomic={atomic})")
+
+    state_path = os.path.join(stage, "state")
+
+    def _write():
+        ce.save(state_dict, state_path, on_durable=_finalize)
+        if retries:
+            # the retry policy needs to OBSERVE failures: force the async
+            # engine to confirm this save before returning (io_retries > 0
+            # trades the decoupled return for guaranteed delivery)
+            ce.commit(state_path)
+
+    with_io_retries(
+        _write, retries=retries, backoff_s=backoff_s,
+        what=f"checkpoint save '{tag}'",
+        on_retry=lambda n, e: _reliability(engine, "checkpoint_io_retry",
+                                           step=step_at_save))
+    return final_path
 
 
 def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
                     load_universal: Optional[bool] = None, **kw):
     ce = _engine_for(engine)
+    cfg = engine.config.checkpoint
+    try:
+        # in-flight async saves must land before a tag is chosen — otherwise
+        # 'latest' may still be mid-advance
+        ce.wait_all()
+    except Exception as e:
+        logger.error(f"pending async checkpoint write failed: {e}")
+    explicit_tag = tag is not None
     try:
         tag = resolve_tag(load_dir, tag)
-    except FileNotFoundError:
-        logger.warning(f"no 'latest' file under {load_dir}")
+    except FileNotFoundError as e:
+        logger.warning(str(e))
         return None, {}
     path = os.path.abspath(os.path.join(load_dir, tag))
+
+    verify = bool(getattr(cfg, "verify_on_load", True))
+    if verify:
+        status, detail = verify_manifest(path)
+        if status == "corrupt":
+            logger.warning(f"checkpoint '{tag}' failed verification "
+                           f"({detail}) — walking back to the newest "
+                           f"verifiable tag")
+            _reliability(engine, "checkpoint_rollback")
+            alt = newest_verifiable_tag(load_dir, exclude={tag}, verify=True)
+            if alt is None:
+                if explicit_tag:
+                    raise RuntimeError(
+                        f"checkpoint '{tag}' under {load_dir} is corrupt "
+                        f"({detail}) and no verifiable fallback exists")
+                logger.warning(f"no verifiable checkpoint under {load_dir} "
+                               f"— starting fresh")
+                return None, {}
+            log_dist(f"checkpoint rollback: '{tag}' → '{alt}'")
+            tag = alt
+            path = os.path.abspath(os.path.join(load_dir, tag))
 
     if load_universal is None:
         load_universal = engine.config.checkpoint.load_universal
@@ -131,7 +246,12 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
     }
     # restore with the CURRENT shardings — topology-independent resume: the
     # checkpoint may have been written on a different mesh/ZeRO stage
-    restored = ce.load(os.path.join(path, "state"), template)
+    retries = int(getattr(cfg, "io_retries", 0) or 0)
+    restored = with_io_retries(
+        lambda: ce.load(os.path.join(path, "state"), template),
+        retries=retries, backoff_s=float(getattr(cfg, "io_backoff_s", 0.5)),
+        what=f"checkpoint load '{tag}'",
+        on_retry=lambda n, e: _reliability(engine, "checkpoint_io_retry"))
 
     # scalars (step/loss-scale) must be replicated over the CURRENT mesh —
     # a single-device committed scalar would conflict with sharded params
@@ -164,6 +284,7 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
         engine.micro_steps = meta.get("micro_steps", 0)
         engine.lr_scheduler.load_state_dict(meta.get("lr_scheduler", {"last_step": 0}))
         client_state = meta.get("client_state", {})
+    _reliability(engine, "checkpoint_loaded")
     log_dist(f"loaded checkpoint {path} at step {engine.global_steps}")
     return path, client_state
 

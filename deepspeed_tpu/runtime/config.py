@@ -276,13 +276,54 @@ class MoEConfig(ConfigModel):
 @register_config_model
 @dataclass
 class CheckpointConfig(ConfigModel):
-    """Reference: checkpoint-engine selection + options (``runtime/engine.py:1287``)."""
+    """Reference: checkpoint-engine selection + options (``runtime/engine.py:1287``).
+
+    Crash-consistency knobs (``docs/reliability.md``): ``atomic`` stages each
+    save in ``<tag>.tmp.*`` and publishes it with fsync + manifest + atomic
+    rename before ``latest`` advances; ``verify_on_load`` checks the SHA-256
+    manifest and walks back to the newest verifiable tag on corruption;
+    ``keep_last_n`` garbage-collects old tags (0 = keep all); ``io_retries`` /
+    ``io_backoff_s`` retry transient checkpoint I/O errors with exponential
+    backoff + jitter (0 retries = fail fast, the legacy behavior)."""
     engine: str = "default"  # default | async | fast
     use_node_local_storage: bool = False
     parallel_write_pipeline: bool = False
     tag_validation: str = "Warn"  # Warn | Ignore | Fail
     load_universal: bool = False
     writer_buffer_mb: int = 64
+    atomic: bool = True
+    verify_on_load: bool = True
+    keep_last_n: int = 0
+    io_retries: int = 0
+    io_backoff_s: float = 0.5
+
+
+@register_config_model
+@dataclass
+class WatchdogConfig(ConfigModel):
+    """Training watchdog (``runtime/watchdog.py``): acts on host-visible
+    signals the loop already computes. Every detector defaults OFF so the
+    default step is untouched; ``Reliability/*`` events flow through
+    TelemetryHub (see ``docs/reliability.md``)."""
+    enabled: bool = False
+    # N consecutive overflow-skipped steps → violation (0 = off)
+    max_skipped_steps: int = 0
+    # NaN/Inf host-side loss → violation
+    detect_non_finite: bool = True
+    # loss > k × trailing-median loss → Reliability/loss_spike warning (0 = off)
+    loss_spike_factor: float = 0.0
+    loss_window: int = 32
+    # step time > k × trailing-median step time → stall warning (0 = off)
+    stall_factor: float = 0.0
+    stall_window: int = 16
+    # detectors based on a trailing median stay silent until this many samples
+    min_samples: int = 5
+    # any single step exceeding this wall-clock budget → violation (0 = off)
+    hard_timeout_s: float = 0.0
+    # raise | warn | restore (reload last good checkpoint from restore_dir)
+    # | exit (request a checkpoint-and-exit via PreemptionGuard.step_boundary)
+    on_violation: str = "raise"
+    restore_dir: Optional[str] = None
 
 
 @register_config_model
@@ -326,6 +367,7 @@ class DeepSpeedTPUConfig:
     csv_monitor: MonitorBackendConfig = field(default_factory=MonitorBackendConfig)
     jsonl_monitor: MonitorBackendConfig = field(default_factory=MonitorBackendConfig)
     checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
+    watchdog: WatchdogConfig = field(default_factory=WatchdogConfig)
     aio: AIOConfig = field(default_factory=AIOConfig)
 
     gradient_clipping: float = 0.0
@@ -400,6 +442,7 @@ _SUBCONFIG_KEYS = {
     "csv_monitor": MonitorBackendConfig,
     "jsonl_monitor": MonitorBackendConfig,
     "checkpoint": CheckpointConfig,
+    "watchdog": WatchdogConfig,
     "aio": AIOConfig,
 }
 

@@ -377,6 +377,17 @@ class DeepSpeedTPUEngine:
                                       timers=self.timers,
                                       tput_timer=self.tput_timer)
 
+        # --- training watchdog (runtime/watchdog.py): consecutive-skip /
+        # non-finite-loss / stall detection on host-visible step outputs.
+        # Opt-in: its observe() forces a host sync on the loss, so the
+        # default step must never pay for it ---
+        self.watchdog = None
+        if config.watchdog.enabled:
+            from .watchdog import TrainingWatchdog
+
+            self.watchdog = TrainingWatchdog(config.watchdog,
+                                             telemetry=self.telemetry)
+
         # --- curriculum learning (reference engine hooks :395-408 wire the
         # curriculum scheduler into the forward prologue) ---
         self.curriculum_scheduler = None
@@ -453,6 +464,8 @@ class DeepSpeedTPUEngine:
                 self._nvme_grad_step = jax.jit(grad_fn)
         self.tput_timer.start()
         self.telemetry.step_begin(self.global_steps + 1)
+        if self.watchdog is not None:
+            self.watchdog.step_started()
         breakdown = self.wall_clock_breakdown()
         if self.curriculum_scheduler is not None:
             batch = self.curriculum_scheduler.truncate(batch,
@@ -533,6 +546,8 @@ class DeepSpeedTPUEngine:
                 self.global_steps % cfg.steps_per_print == 0:
             log_dist(f"step={self.global_steps} loss={float(out.loss):.4f} "
                      f"lr={lr_t:.3e} gnorm={grad_norm:.3f} [nvme-opt]")
+        if self.watchdog is not None:
+            self.watchdog.observe(self, out)
         return out
 
     def _log_zero_sharding_summary(self, shapes, opt_specs) -> None:
@@ -1385,6 +1400,8 @@ class DeepSpeedTPUEngine:
             log_dist(f"step={self.global_steps} loss={float(out.loss):.4f} "
                      f"lr={float(out.lr):.3e} gnorm={float(out.grad_norm):.3f} "
                      f"scale={float(out.loss_scale):.0f}")
+        if self.watchdog is not None:
+            self.watchdog.observe(self, out)
         return out
 
     # ------------------------------------------------------------------ #
@@ -1467,6 +1484,8 @@ class DeepSpeedTPUEngine:
             ce.wait_all()
         self._write_monitor_events(out)
         self.telemetry.step_end(self.global_steps)
+        if self.watchdog is not None:
+            self.watchdog.observe(self, out)
         return out
 
     def _write_monitor_events(self, out) -> None:
@@ -1596,9 +1615,21 @@ class DeepSpeedTPUEngine:
     # shutdown (reference engine.destroy :390)
     # ------------------------------------------------------------------ #
     def destroy(self) -> None:
-        """Release observability resources: stop any live profiler trace,
-        flush + close monitor backends (so partial CSV/JSONL rows land on
-        disk). Safe to call more than once; atexit backstops it."""
+        """Release observability resources: drain pending async checkpoint
+        writers (process exit must never truncate an in-flight save), stop
+        any live profiler trace, flush + close monitor backends (so partial
+        CSV/JSONL rows land on disk). Safe to call more than once; atexit
+        backstops it."""
+        ce = getattr(self, "checkpoint_engine", None)
+        if ce is not None and hasattr(ce, "wait_all"):
+            try:
+                ce.wait_all()
+            except Exception as e:
+                # a failed background save must not mask the shutdown path —
+                # log it (the checkpoint was never published, so 'latest'
+                # still points at the previous good tag)
+                logger.error(f"async checkpoint write failed during "
+                             f"shutdown: {e}")
         tel = getattr(self, "telemetry", None)
         if tel is not None:
             tel.close()

@@ -1,0 +1,197 @@
+"""Training watchdog: notice when training has gone off the rails.
+
+Reference lineage: DeepSpeed's fp16 optimizer already *skips* overflowed
+steps and cuts the loss scale, but nothing in the reference loop bounds how
+long that can go on, flags a diverging host-side loss, or notices a stalled
+step. On preemption-prone TPU fleets those are the failure modes that burn
+whole reservations (ZeRO-Infinity assumes resumability, arXiv:2104.07857;
+Gemma-class pod runs assume frequent preempt-and-resume, arXiv:2605.25645).
+
+The watchdog is deliberately *in-band and host-side*: it acts only on
+signals the loop already computes (``StepOutput.overflow`` / ``.loss`` and
+wall-clock time between step boundaries), so it adds zero device work. The
+engine calls :meth:`step_started` / :meth:`observe` around every optimizer
+step when ``watchdog.enabled`` is set; each detector emits ``Reliability/*``
+events through TelemetryHub and, on a violation, applies the configured
+``on_violation`` policy:
+
+- ``raise``   — raise :class:`WatchdogViolation` (abort the run);
+- ``warn``    — log and keep going;
+- ``restore`` — reload the newest good checkpoint from ``restore_dir`` (or
+  the bound :class:`~deepspeed_tpu.elasticity.elastic_agent.PreemptionGuard`
+  save dir) and continue;
+- ``exit``    — set :attr:`restart_requested`, which a bound PreemptionGuard
+  treats exactly like a preemption signal at its next ``step_boundary`` —
+  checkpoint-and-exit for an elastic restart.
+
+Forcing these paths in tests: ``deepspeed_tpu.testing.faults``.
+"""
+
+from __future__ import annotations
+
+import math
+import statistics
+import time
+from collections import deque
+from typing import Optional
+
+from ..utils.logging import log_dist, logger
+
+
+class WatchdogViolation(RuntimeError):
+    """A watchdog detector fired with ``on_violation: raise``."""
+
+    def __init__(self, kind: str, message: str):
+        super().__init__(message)
+        self.kind = kind
+
+
+class TrainingWatchdog:
+    """See module docstring. Construct with a
+    :class:`~deepspeed_tpu.runtime.config.WatchdogConfig`."""
+
+    def __init__(self, config, telemetry=None, guard=None):
+        self.cfg = config
+        self.telemetry = telemetry
+        self.guard = guard
+        self.consecutive_skips = 0
+        self.restart_requested = False
+        self.violations = 0
+        self._loss_window = deque(maxlen=max(2, int(config.loss_window)))
+        self._time_window = deque(maxlen=max(2, int(config.stall_window)))
+        self._step_t0: Optional[float] = None
+
+    # ------------------------------------------------------------------ #
+    def bind_guard(self, guard) -> None:
+        """Attach a PreemptionGuard: ``on_violation: exit`` requests a
+        checkpoint-and-exit through it, and ``restore`` without an explicit
+        ``restore_dir`` restores from the guard's save dir."""
+        self.guard = guard
+
+    def step_started(self) -> None:
+        """Mark the wall-clock start of a step (engine prologue)."""
+        self._step_t0 = time.monotonic()
+
+    # ------------------------------------------------------------------ #
+    def _emit(self, name: str, step: int, value: float = 1.0) -> None:
+        tel = self.telemetry
+        if tel is not None and hasattr(tel, "reliability_event"):
+            tel.reliability_event(name, value, step)
+
+    def observe(self, engine, out, step_time_s: Optional[float] = None) -> None:
+        """Run every detector against one completed optimizer step.
+
+        ``out`` is the engine's StepOutput; reading ``.loss``/``.overflow``
+        here forces a host sync, which is why the watchdog is opt-in — with
+        ``watchdog.enabled: false`` the training step is untouched.
+        """
+        step = int(getattr(engine, "global_steps", 0))
+        now = time.monotonic()
+        if step_time_s is None and self._step_t0 is not None:
+            step_time_s = now - self._step_t0
+        self._step_t0 = None
+
+        cfg = self.cfg
+        overflow = bool(out.overflow)
+        loss = float(out.loss)
+
+        # 1. consecutive overflow-skip limit: the fp16 scaler cutting the
+        # scale forever is divergence wearing a trench coat
+        if overflow:
+            self.consecutive_skips += 1
+            self._emit("overflow_skip", step)
+            if cfg.max_skipped_steps and \
+                    self.consecutive_skips >= int(cfg.max_skipped_steps):
+                self._violate(
+                    engine, "skip_limit", step,
+                    f"{self.consecutive_skips} consecutive overflow-skipped "
+                    f"steps (limit {cfg.max_skipped_steps}) at step {step}")
+                return
+        else:
+            self.consecutive_skips = 0
+
+        # 2. non-finite / spiking host-side loss
+        if not math.isfinite(loss):
+            if cfg.detect_non_finite:
+                self._violate(engine, "non_finite_loss", step,
+                              f"non-finite loss ({loss}) at step {step}")
+                return
+        else:
+            spike = float(cfg.loss_spike_factor or 0.0)
+            if spike > 0 and len(self._loss_window) >= int(cfg.min_samples):
+                med = statistics.median(self._loss_window)
+                if med > 0 and loss > spike * med:
+                    logger.warning(f"watchdog: loss {loss:.4g} > "
+                                   f"{spike:g}x trailing median {med:.4g} "
+                                   f"at step {step}")
+                    self._emit("loss_spike", step, value=loss / med)
+            self._loss_window.append(loss)
+
+        # 3. stall detection on wall-clock step time
+        if step_time_s is not None and step_time_s > 0:
+            stall = float(cfg.stall_factor or 0.0)
+            if stall > 0 and len(self._time_window) >= int(cfg.min_samples):
+                med = statistics.median(self._time_window)
+                if med > 0 and step_time_s > stall * med:
+                    logger.warning(
+                        f"watchdog: step {step} took {step_time_s:.2f}s "
+                        f"(> {stall:g}x trailing median {med:.2f}s)")
+                    self._emit("stall_warning", step,
+                               value=step_time_s / med)
+            hard = float(cfg.hard_timeout_s or 0.0)
+            if hard > 0 and step_time_s > hard:
+                self._violate(
+                    engine, "stall_timeout", step,
+                    f"step {step} took {step_time_s:.2f}s "
+                    f"(hard_timeout_s={hard:g})")
+                return
+            self._time_window.append(step_time_s)
+
+    # convenience alias mirroring PreemptionGuard.step_boundary: run the
+    # detectors and report whether the loop should exit for a restart
+    def step_boundary(self, engine, out,
+                      step_time_s: Optional[float] = None) -> bool:
+        self.observe(engine, out, step_time_s=step_time_s)
+        return self.restart_requested
+
+    # ------------------------------------------------------------------ #
+    def _violate(self, engine, kind: str, step: int, msg: str) -> None:
+        self.violations += 1
+        self._emit(f"violation/{kind}", step)
+        action = (self.cfg.on_violation or "raise").lower()
+        if action == "warn":
+            logger.warning(f"watchdog violation ({kind}): {msg}")
+            return
+        if action == "restore":
+            restore_dir = self.cfg.restore_dir or \
+                getattr(self.guard, "save_dir", None)
+            if restore_dir and hasattr(engine, "load_checkpoint"):
+                logger.warning(f"watchdog violation ({kind}): {msg} — "
+                               f"auto-restoring from {restore_dir}")
+                self._emit("auto_restore", step)
+                path, _ = engine.load_checkpoint(restore_dir)
+                if path is not None:
+                    self._reset_after_restore()
+                    log_dist(f"watchdog: restored {path}, resuming at step "
+                             f"{engine.global_steps}")
+                    return
+                logger.error(f"watchdog: no checkpoint to restore under "
+                             f"{restore_dir}")
+            else:
+                logger.error("watchdog: on_violation=restore but no "
+                             "restore_dir configured and no guard bound")
+            # unable to restore — fall through to raise: silently continuing
+            # a diverged run is the one unacceptable outcome
+        elif action == "exit":
+            logger.warning(f"watchdog violation ({kind}): {msg} — "
+                           f"requesting checkpoint-and-exit at the next "
+                           f"guard boundary")
+            self.restart_requested = True
+            return
+        raise WatchdogViolation(kind, msg)
+
+    def _reset_after_restore(self) -> None:
+        self.consecutive_skips = 0
+        self._loss_window.clear()
+        self._time_window.clear()
+        self._step_t0 = None

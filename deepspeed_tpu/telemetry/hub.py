@@ -21,7 +21,7 @@ step; both are cheap no-ops on non-zero ranks and when nothing is enabled.
 
 from __future__ import annotations
 
-from typing import List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import jax
 
@@ -68,6 +68,23 @@ class TelemetryHub:
                            prof_all=cl.prof_all, prof_ops=list(cl.prof_ops),
                            debug=cl.debug)
         self.comms = dist.get_telemetry()
+        # Reliability/* counters (checkpoint commits/rollbacks, watchdog
+        # trips, preemptions) — counted on every rank for tests/reports,
+        # written through the monitor on rank 0
+        self.reliability_counts: Dict[str, int] = {}
+
+    # ------------------------------------------------------------------ #
+    def reliability_event(self, name: str, value: float = 1.0,
+                          step: int = 0) -> None:
+        """Fan out one ``Reliability/<name>`` event (reliability subsystem:
+        saver two-phase commits, watchdog detectors, PreemptionGuard; see
+        docs/reliability.md). Cheap when no monitor backend is enabled."""
+        if not name.startswith("Reliability/"):
+            name = "Reliability/" + name
+        self.reliability_counts[name] = \
+            self.reliability_counts.get(name, 0) + 1
+        if self.rank0 and self._monitor_on():
+            self.monitor.write_events([(name, float(value), int(step))])
 
     # ------------------------------------------------------------------ #
     @property

@@ -1,0 +1,3 @@
+from .faults import (SimulatedCrash, corrupt_file, crash_after_save,  # noqa: F401
+                     forced_nonfinite, io_errors, preempt, truncated_write,
+                     write_delay)

@@ -1,0 +1,193 @@
+"""Fault-injection harness for the reliability subsystem.
+
+Context managers and helpers that make rare failures deterministic so tier-1
+tests can prove the crash-consistent checkpoint protocol, the training
+watchdog, and the PreemptionGuard actually survive them (see
+``docs/reliability.md``; used throughout ``tests/test_fault_tolerance.py``):
+
+- :func:`io_errors` — a CheckpointEngine's ``save``/``load`` raises
+  ``OSError`` for the first N calls (transient I/O; exercises
+  ``checkpoint.io_retries``);
+- :func:`crash_after_save` — the state write completes, then the "process
+  dies" (:class:`SimulatedCrash`) before commit/manifest/publish — the
+  two-phase-commit hole this subsystem exists to close;
+- :func:`truncated_write` — the write is torn mid-file and the process dies:
+  what a real SIGKILL mid-``write(2)`` leaves on disk;
+- :func:`corrupt_file` — post-hoc bit rot / torn tail on a COMMITTED
+  checkpoint, which ``verify_on_load`` must catch;
+- :func:`write_delay` — slows the (possibly background) writer to widen race
+  windows (e.g. ``engine.destroy()`` draining an in-flight save);
+- :func:`preempt` — delivers a synthetic preemption to a PreemptionGuard
+  without involving the OS signal machinery;
+- :func:`forced_nonfinite` — the next N train steps report overflow (and
+  optionally a NaN loss) so watchdog paths fire without engineering a real
+  fp16 overflow.
+
+Everything patches a specific *instance* and restores it on exit — nothing
+global, nothing left behind.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+from typing import Iterator, Optional
+
+
+class SimulatedCrash(BaseException):
+    """Emulates sudden process death mid-operation. Deliberately a
+    ``BaseException``: no retry loop or ``except Exception`` recovery path
+    may swallow it — exactly like a real SIGKILL."""
+
+
+def _save_host(ce):
+    """The object whose ``save`` actually touches disk: the inner engine for
+    the decoupled/async wrapper, the engine itself otherwise."""
+    return getattr(ce, "inner", None) or ce
+
+
+@contextlib.contextmanager
+def io_errors(ce, fail_times: int = 1, op: str = "save",
+              exc_factory=None) -> Iterator[dict]:
+    """First ``fail_times`` calls of ``ce.<op>`` raise ``OSError``; later
+    calls pass through. Yields a dict with ``calls``/``failures`` counters
+    so tests can assert the retry policy's exact behavior."""
+    target = getattr(ce, op)
+    state = {"calls": 0, "failures": 0}
+
+    def flaky(*args, **kwargs):
+        state["calls"] += 1
+        if state["failures"] < fail_times:
+            state["failures"] += 1
+            raise (exc_factory() if exc_factory is not None
+                   else OSError(f"injected transient I/O error "
+                                f"#{state['failures']}"))
+        return target(*args, **kwargs)
+
+    setattr(ce, op, flaky)
+    try:
+        yield state
+    finally:
+        setattr(ce, op, target)
+
+
+@contextlib.contextmanager
+def crash_after_save(ce) -> Iterator[None]:
+    """The state write completes, then :class:`SimulatedCrash` — the process
+    dies BETWEEN save and commit. ``on_durable`` (the saver's
+    manifest/publish/latest phase) is never invoked, so a crash-consistent
+    saver must leave ``latest`` on the previous good tag."""
+    orig = ce.save
+
+    def dying(tree, path, on_durable=None, **kw):
+        orig(tree, path, **kw)
+        raise SimulatedCrash(f"simulated crash after write of {path}")
+
+    ce.save = dying
+    try:
+        yield
+    finally:
+        ce.save = orig
+
+
+@contextlib.contextmanager
+def truncated_write(ce, keep_bytes: int = 64,
+                    filename: Optional[str] = None) -> Iterator[None]:
+    """The write lands torn — after the inner save returns, the largest file
+    under the save path (or ``filename``) is truncated to ``keep_bytes`` and
+    the process dies (:class:`SimulatedCrash`). No commit/publish happens."""
+    orig = ce.save
+
+    def torn(tree, path, on_durable=None, **kw):
+        orig(tree, path, **kw)
+        corrupt_file(path, keep_bytes=keep_bytes, filename=filename)
+        raise SimulatedCrash(f"simulated crash mid-write of {path}")
+
+    ce.save = torn
+    try:
+        yield
+    finally:
+        ce.save = orig
+
+
+def corrupt_file(root: str, keep_bytes: int = 64,
+                 filename: Optional[str] = None) -> str:
+    """Truncate one file under ``root`` (the largest, or the one named
+    ``filename``) to ``keep_bytes`` — post-hoc corruption of a committed
+    checkpoint that manifest verification must flag. Returns the path."""
+    victim, size = None, -1
+    for dirpath, _dirnames, filenames in os.walk(root):
+        for fn in filenames:
+            full = os.path.join(dirpath, fn)
+            if filename is not None:
+                if fn == filename:
+                    victim = full
+                    break
+            elif os.path.getsize(full) > size:
+                victim, size = full, os.path.getsize(full)
+        if filename is not None and victim is not None:
+            break
+    if victim is None:
+        raise FileNotFoundError(
+            f"no file{f' named {filename}' if filename else ''} under {root}")
+    with open(victim, "r+b") as f:
+        f.truncate(keep_bytes)
+    return victim
+
+
+@contextlib.contextmanager
+def write_delay(ce, seconds: float) -> Iterator[None]:
+    """Every save stalls ``seconds`` before touching disk. For the async
+    engine the delay runs inside the writer THREAD (the inner engine is
+    patched), widening the window between a save's return and its commit."""
+    host = _save_host(ce)
+    orig = host.save
+
+    def slow(tree, path, **kw):
+        time.sleep(seconds)
+        return orig(tree, path, **kw)
+
+    host.save = slow
+    try:
+        yield
+    finally:
+        host.save = orig
+
+
+def preempt(guard, signum: Optional[int] = None) -> None:
+    """Deliver a synthetic preemption to a PreemptionGuard — the SIGTERM
+    the resource manager would send, minus the OS. The guard checkpoints at
+    its next ``step_boundary`` exactly as for a real signal."""
+    guard.trigger(signum)
+
+
+@contextlib.contextmanager
+def forced_nonfinite(engine, steps: int = 1,
+                     nan_loss: bool = False) -> Iterator[dict]:
+    """The next ``steps`` optimizer steps report ``overflow=True`` (and a
+    NaN loss when ``nan_loss``) in their StepOutput, driving the watchdog's
+    skip-limit / non-finite detectors deterministically. The real compiled
+    step still runs; only the host-visible output is rewritten."""
+    import jax.numpy as jnp
+
+    if engine._train_step is None:
+        engine._build_train_step()
+    orig = engine._train_step
+    state = {"remaining": steps, "forced": 0}
+
+    def poisoned(st, batch, lr_override):
+        new_state, out = orig(st, batch, lr_override)
+        if state["remaining"] > 0:
+            state["remaining"] -= 1
+            state["forced"] += 1
+            out = out._replace(
+                overflow=jnp.asarray(True),
+                loss=out.loss * jnp.float32("nan") if nan_loss else out.loss)
+        return new_state, out
+
+    engine._train_step = poisoned
+    try:
+        yield state
+    finally:
+        engine._train_step = orig

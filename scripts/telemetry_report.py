@@ -96,6 +96,44 @@ def comm_efficiency(events: List[dict]) -> str:
     return "\n".join(lines)
 
 
+def reliability(events: List[dict]) -> str:
+    """``--reliability``: skipped steps, watchdog events, and checkpoint
+    save/restore/rollback counts from the ``Reliability/*`` event stream
+    (reliability subsystem — docs/reliability.md). Each event is one
+    occurrence; counts are event-line counts, not value sums."""
+    rel = [e for e in events if e["name"].startswith("Reliability/")]
+    if not rel:
+        return "reliability: no Reliability/* events in this file"
+    counts: Dict[str, int] = {}
+    last_step: Dict[str, int] = {}
+    for e in rel:
+        key = e["name"][len("Reliability/"):]
+        counts[key] = counts.get(key, 0) + 1
+        last_step[key] = max(last_step.get(key, 0), int(e.get("step", 0)))
+    lines = [f"reliability report ({len(rel)} events)"]
+    lines.append(f"  {'event':<28} {'count':>6} {'last step':>10}")
+    for key in sorted(counts):
+        lines.append(f"  {key:<28} {counts[key]:>6} {last_step[key]:>10}")
+    lines.append("")
+
+    def total(*keys: str) -> int:
+        return sum(counts.get(k, 0) for k in keys)
+
+    violations = total(*[k for k in counts if k.startswith("violation/")])
+    lines.append(f"  checkpoint saves:       {total('checkpoint_saved')}")
+    lines.append(f"  checkpoint loads:       {total('checkpoint_loaded')}")
+    lines.append(f"  rollbacks (walk-back):  {total('checkpoint_rollback')}")
+    lines.append(f"  auto-restores:          {total('auto_restore')}")
+    lines.append(f"  I/O retries:            {total('checkpoint_io_retry')}")
+    lines.append(f"  GC'd old tags:          {total('checkpoint_gc')}")
+    lines.append(f"  overflow-skipped steps: {total('overflow_skip')}")
+    lines.append(f"  loss spikes:            {total('loss_spike')}")
+    lines.append(f"  stall warnings:         {total('stall_warning')}")
+    lines.append(f"  watchdog violations:    {violations}")
+    lines.append(f"  preemption checkpoints: {total('preemption_checkpoint')}")
+    return "\n".join(lines)
+
+
 def summarize(events: List[dict], last: int = 0) -> str:
     if last > 0:
         steps = sorted({e.get("step", 0) for e in events})[-last:]
@@ -164,6 +202,10 @@ def main(argv=None) -> int:
     ap.add_argument("--comm-efficiency", action="store_true",
                     help="print collective count / total algorithmic bytes / "
                          "bytes-per-step (comm-volume regression check)")
+    ap.add_argument("--reliability", action="store_true",
+                    help="summarize Reliability/* events: skipped steps, "
+                         "watchdog trips, checkpoint save/restore/rollback "
+                         "counts")
     args = ap.parse_args(argv)
     try:
         events = load_events(args.path)
@@ -175,6 +217,9 @@ def main(argv=None) -> int:
         return 1
     if args.comm_efficiency:
         print(comm_efficiency(events))
+        return 0
+    if args.reliability:
+        print(reliability(events))
         return 0
     print(summarize(events, last=args.last))
     return 0

@@ -1,0 +1,471 @@
+"""Reliability subsystem tests: crash-consistent checkpointing (two-phase
+commit, manifest verification, walk-back, retry/backoff, retention), the
+training watchdog, and the PreemptionGuard — all driven through the
+fault-injection harness ``deepspeed_tpu.testing.faults``.
+
+The failure modes here are the ones that brick preemption-prone TPU-pod runs:
+SIGTERM mid-save, torn writes, bit rot on a committed tag, transient storage
+errors, silent divergence (overflow streaks / NaN loss), and stalled steps.
+"""
+
+import json
+import os
+import shutil
+import signal
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu as dst
+from deepspeed_tpu.runtime.checkpoint import (MANIFEST_NAME,
+                                              newest_verifiable_tag,
+                                              tag_candidates, verify_manifest)
+from deepspeed_tpu.runtime.checkpoint.manifest import (retention_sweep,
+                                                       with_io_retries)
+from deepspeed_tpu.runtime.checkpoint.saver import _engine_for
+from deepspeed_tpu.runtime.engine import ModelSpec
+from deepspeed_tpu.runtime.watchdog import (TrainingWatchdog,
+                                            WatchdogViolation)
+from deepspeed_tpu.testing import faults
+
+
+def _spec():
+    return ModelSpec(
+        loss_fn=lambda p, b: (jnp.sum((p["w"] * b["x"]) ** 2), {}),
+        init_fn=lambda k: {"w": jnp.ones((8,))},
+        pipeline_capable=False)
+
+
+def _mk_engine(ckpt_engine="fast", checkpoint=None, watchdog=None):
+    from deepspeed_tpu.comm import mesh as mesh_lib
+
+    mesh_lib.set_mesh(None)
+    config = {
+        "train_batch_size": 8,
+        "optimizer": {"type": "sgd", "params": {"lr": 0.1}},
+        "steps_per_print": 0,
+        "checkpoint": {"engine": ckpt_engine, **(checkpoint or {})},
+    }
+    if watchdog is not None:
+        config["watchdog"] = {"enabled": True, **watchdog}
+    engine, *_ = dst.initialize(model=_spec(), config=config)
+    return engine
+
+
+_BATCH = {"x": np.ones((8,), np.float32)}
+
+
+def _rel_count(engine, name):
+    return engine.telemetry.reliability_counts.get(f"Reliability/{name}", 0)
+
+
+# --------------------------------------------------------------------------- #
+# crash-consistent save (two-phase commit)
+# --------------------------------------------------------------------------- #
+def test_atomic_save_writes_verified_manifest(devices8, tmp_path):
+    engine = _mk_engine()
+    engine.train_batch(_BATCH)
+    path = engine.save_checkpoint(str(tmp_path), tag="a1")
+    assert path.endswith("a1") and os.path.isdir(path)
+    # staging dirs are gone; manifest lists + hashes the state file
+    assert not [n for n in os.listdir(tmp_path) if ".tmp." in n]
+    with open(os.path.join(path, MANIFEST_NAME)) as f:
+        files = json.load(f)["files"]
+    assert "state/state.bin" in files and "meta.json" in files
+    assert len(files["state/state.bin"]["sha256"]) == 64
+    assert verify_manifest(path)[0] == "verified"
+    with open(tmp_path / "latest") as f:
+        assert f.read().strip() == "a1"
+    assert _rel_count(engine, "checkpoint_saved") == 1
+
+
+@pytest.mark.parametrize("fault", ["crash_after_save", "truncated_write"])
+def test_crash_mid_save_preserves_previous_checkpoint(devices8, tmp_path,
+                                                      fault):
+    """Acceptance: a simulated crash between save and commit leaves the
+    directory loadable — `latest` stays on the previous good tag and resume
+    lands there."""
+    engine = _mk_engine()
+    engine.train_batch(_BATCH)
+    engine.save_checkpoint(str(tmp_path), tag="good")
+    ref_w = np.asarray(engine.state.params["w"])
+    engine.train_batch(_BATCH)  # diverge past the checkpoint
+
+    ce = _engine_for(engine)
+    inject = getattr(faults, fault)
+    with inject(ce):
+        with pytest.raises(faults.SimulatedCrash):
+            engine.save_checkpoint(str(tmp_path), tag="torn")
+
+    with open(tmp_path / "latest") as f:
+        assert f.read().strip() == "good"  # latest never advanced
+    assert tag_candidates(str(tmp_path)) == ["good"]  # staging invisible
+    path, _ = engine.load_checkpoint(str(tmp_path))
+    assert path.endswith("good")
+    assert engine.global_steps == 1
+    np.testing.assert_allclose(np.asarray(engine.state.params["w"]), ref_w,
+                               rtol=1e-6)
+    # a later save of the same tag reclaims the stale staging dir
+    engine.train_batch(_BATCH)
+    engine.save_checkpoint(str(tmp_path), tag="torn")
+    assert verify_manifest(str(tmp_path / "torn"))[0] == "verified"
+
+
+def test_corrupt_state_triggers_walkback_restore(devices8, tmp_path):
+    engine = _mk_engine()
+    engine.train_batch(_BATCH)
+    engine.save_checkpoint(str(tmp_path), tag="t1")
+    w1 = np.asarray(engine.state.params["w"])
+    engine.train_batch(_BATCH)
+    engine.save_checkpoint(str(tmp_path), tag="t2")
+
+    faults.corrupt_file(str(tmp_path / "t2"), filename="state.bin")
+    assert verify_manifest(str(tmp_path / "t2"))[0] == "corrupt"
+
+    path, _ = engine.load_checkpoint(str(tmp_path))  # latest → t2 (corrupt)
+    assert path.endswith("t1")  # walked back, with a logged rollback event
+    assert engine.global_steps == 1
+    np.testing.assert_allclose(np.asarray(engine.state.params["w"]), w1,
+                               rtol=1e-6)
+    assert _rel_count(engine, "checkpoint_rollback") == 1
+    assert _rel_count(engine, "checkpoint_loaded") == 1
+
+
+def test_corrupt_manifest_triggers_walkback(devices8, tmp_path):
+    engine = _mk_engine()
+    engine.train_batch(_BATCH)
+    engine.save_checkpoint(str(tmp_path), tag="m1")
+    engine.train_batch(_BATCH)
+    engine.save_checkpoint(str(tmp_path), tag="m2")
+
+    mpath = tmp_path / "m2" / MANIFEST_NAME
+    with open(mpath) as f:
+        doc = json.load(f)
+    doc["files"]["state/state.bin"]["sha256"] = "0" * 64
+    with open(mpath, "w") as f:
+        json.dump(doc, f)
+
+    path, _ = engine.load_checkpoint(str(tmp_path))
+    assert path.endswith("m1")
+    assert newest_verifiable_tag(str(tmp_path)) == "m1"
+
+
+def test_no_verifiable_checkpoint_returns_fresh_start(devices8, tmp_path):
+    engine = _mk_engine()
+    engine.train_batch(_BATCH)
+    engine.save_checkpoint(str(tmp_path), tag="only")
+    faults.corrupt_file(str(tmp_path / "only"), filename="state.bin")
+    path, client = engine.load_checkpoint(str(tmp_path))
+    assert path is None and client == {}  # warn + fresh start, not a crash
+
+
+def test_missing_latest_tag_dir_falls_back_to_scan(devices8, tmp_path):
+    """Satellite: a deleted tag named by `latest` must not brick resume."""
+    engine = _mk_engine()
+    engine.train_batch(_BATCH)
+    engine.save_checkpoint(str(tmp_path), tag="keep")
+    engine.train_batch(_BATCH)
+    engine.save_checkpoint(str(tmp_path), tag="gone")
+    shutil.rmtree(tmp_path / "gone")
+
+    path, _ = engine.load_checkpoint(str(tmp_path))
+    assert path.endswith("keep")
+    assert engine.global_steps == 1
+
+
+def test_io_retry_backoff_then_success(devices8, tmp_path):
+    engine = _mk_engine(checkpoint={"io_retries": 3, "io_backoff_s": 0.01})
+    engine.train_batch(_BATCH)
+    ce = _engine_for(engine)
+    with faults.io_errors(ce, fail_times=2) as state:
+        engine.save_checkpoint(str(tmp_path), tag="r1")
+    assert state["calls"] == 3 and state["failures"] == 2
+    assert verify_manifest(str(tmp_path / "r1"))[0] == "verified"
+    assert _rel_count(engine, "checkpoint_io_retry") == 2
+
+    # retries exhausted → the OSError propagates (fail fast, not fail silent)
+    with faults.io_errors(ce, fail_times=10):
+        with pytest.raises(OSError):
+            engine.save_checkpoint(str(tmp_path), tag="r2")
+    with open(tmp_path / "latest") as f:
+        assert f.read().strip() == "r1"
+
+
+def test_with_io_retries_backoff_units():
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError("transient")
+        return "ok"
+
+    retried = []
+    assert with_io_retries(flaky, retries=4, backoff_s=0.001,
+                           on_retry=lambda n, e: retried.append(n)) == "ok"
+    assert calls["n"] == 3 and retried == [1, 2]
+    # a SimulatedCrash is NOT retried — it models process death
+    with pytest.raises(faults.SimulatedCrash):
+        with_io_retries(lambda: (_ for _ in ()).throw(
+            faults.SimulatedCrash("boom")), retries=5, backoff_s=0.001)
+
+
+def test_keep_last_n_retention(devices8, tmp_path):
+    engine = _mk_engine(checkpoint={"keep_last_n": 2})
+    for i in range(4):
+        engine.train_batch(_BATCH)
+        engine.save_checkpoint(str(tmp_path), tag=f"s{i}")
+    assert tag_candidates(str(tmp_path)) == ["s3", "s2"]
+    with open(tmp_path / "latest") as f:
+        assert f.read().strip() == "s3"
+    path, _ = engine.load_checkpoint(str(tmp_path))
+    assert path.endswith("s3")
+    assert _rel_count(engine, "checkpoint_gc") == 2  # s0 then s1
+
+
+def test_retention_sweep_protects_latest():
+    # pure-unit: retention never removes the tag `latest` points to
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        for i, tag in enumerate(["a", "b", "c"]):
+            os.makedirs(os.path.join(d, tag, "state"))
+            with open(os.path.join(d, tag, "meta.json"), "w") as f:
+                json.dump({"global_steps": i}, f)
+        with open(os.path.join(d, "latest"), "w") as f:
+            f.write("a")  # stale pointer at the OLDEST tag
+        removed = retention_sweep(d, keep_last_n=1)
+        assert removed == 1  # only 'b' went; 'c' is newest, 'a' is latest
+        assert sorted(os.listdir(d)) == ["a", "c", "latest"]
+
+
+def test_async_engine_commit_before_latest(devices8, tmp_path):
+    """Satellite: with the async engine the two-phase commit runs in the
+    writer thread — `latest` only advances once the bytes are durable, and
+    a background write failure is surfaced (not silently dropped)."""
+    engine = _mk_engine(ckpt_engine="async")
+    engine.train_batch(_BATCH)
+    ce = _engine_for(engine)
+    with faults.write_delay(ce, 0.3):
+        engine.save_checkpoint(str(tmp_path), tag="bg")
+        # save returned while the writer is still sleeping: not published yet
+        assert not os.path.exists(tmp_path / "latest")
+        ce.wait_all()
+    assert verify_manifest(str(tmp_path / "bg"))[0] == "verified"
+    with open(tmp_path / "latest") as f:
+        assert f.read().strip() == "bg"
+
+    # background failure → no publish, error surfaced at the next commit
+    engine.train_batch(_BATCH)
+    with faults.io_errors(ce.inner, fail_times=1):
+        engine.save_checkpoint(str(tmp_path), tag="fail")
+        with pytest.raises(OSError):
+            ce.wait_all()
+    with open(tmp_path / "latest") as f:
+        assert f.read().strip() == "bg"  # still the last good tag
+
+
+def test_engine_destroy_drains_async_writer(devices8, tmp_path):
+    """Satellite: engine.destroy() must drain in-flight async saves so
+    process exit can't truncate one."""
+    engine = _mk_engine(ckpt_engine="async")
+    engine.train_batch(_BATCH)
+    ce = _engine_for(engine)
+    with faults.write_delay(ce, 0.3):
+        engine.save_checkpoint(str(tmp_path), tag="d1")
+        engine.destroy()  # blocks on the writer before closing telemetry
+    assert verify_manifest(str(tmp_path / "d1"))[0] == "verified"
+    with open(tmp_path / "latest") as f:
+        assert f.read().strip() == "d1"
+
+
+# --------------------------------------------------------------------------- #
+# training watchdog
+# --------------------------------------------------------------------------- #
+def test_watchdog_skip_limit_raises(devices8, tmp_path):
+    engine = _mk_engine(watchdog={"max_skipped_steps": 2})
+    engine.train_batch(_BATCH)
+    with faults.forced_nonfinite(engine, steps=3):
+        engine.train_batch(_BATCH)  # skip 1 of 2 — tolerated
+        with pytest.raises(WatchdogViolation) as ei:
+            engine.train_batch(_BATCH)  # skip 2 of 2 — violation
+    assert ei.value.kind == "skip_limit"
+    assert _rel_count(engine, "overflow_skip") == 2
+    assert _rel_count(engine, "violation/skip_limit") == 1
+
+
+def test_watchdog_nonfinite_loss_raises(devices8):
+    engine = _mk_engine(watchdog={})
+    engine.train_batch(_BATCH)
+    with faults.forced_nonfinite(engine, steps=1, nan_loss=True):
+        with pytest.raises(WatchdogViolation) as ei:
+            engine.train_batch(_BATCH)
+    assert ei.value.kind == "non_finite_loss"
+
+
+def test_watchdog_auto_restore_from_checkpoint(devices8, tmp_path):
+    engine = _mk_engine(watchdog={"max_skipped_steps": 1,
+                                  "on_violation": "restore",
+                                  "restore_dir": str(tmp_path)})
+    engine.train_batch(_BATCH)
+    engine.save_checkpoint(str(tmp_path), tag="good")
+    good_w = np.asarray(engine.state.params["w"])
+    with faults.forced_nonfinite(engine, steps=1):
+        engine.train_batch(_BATCH)  # violation → auto-restore, no raise
+    assert engine.global_steps == 1  # back at the checkpoint
+    np.testing.assert_allclose(np.asarray(engine.state.params["w"]), good_w,
+                               rtol=1e-6)
+    assert _rel_count(engine, "auto_restore") == 1
+    assert engine.watchdog.consecutive_skips == 0  # counters reset
+    # training continues cleanly after the restore
+    out = engine.train_batch(_BATCH)
+    assert np.isfinite(float(out.loss))
+
+
+def test_watchdog_stall_and_timeout_detectors():
+    """Pure-unit: stall warning at k× trailing median; hard wall-clock
+    timeout raises."""
+    from types import SimpleNamespace
+
+    events = []
+
+    class Tel:
+        def reliability_event(self, name, value, step):
+            events.append(name)
+
+    cfg = SimpleNamespace(enabled=True, max_skipped_steps=0,
+                          detect_non_finite=True, loss_spike_factor=0.0,
+                          loss_window=8, stall_factor=3.0, stall_window=8,
+                          min_samples=3, hard_timeout_s=5.0,
+                          on_violation="raise", restore_dir=None)
+    wd = TrainingWatchdog(cfg, telemetry=Tel())
+    fake_engine = SimpleNamespace(global_steps=0)
+    ok = SimpleNamespace(loss=1.0, overflow=False)
+    for i in range(4):
+        fake_engine.global_steps = i + 1
+        wd.observe(fake_engine, ok, step_time_s=0.1)
+    assert events == []
+    wd.observe(fake_engine, ok, step_time_s=0.5)  # 5x median → warn only
+    assert events == ["stall_warning"]
+    with pytest.raises(WatchdogViolation) as ei:
+        wd.observe(fake_engine, ok, step_time_s=6.0)  # > hard_timeout_s
+    assert ei.value.kind == "stall_timeout"
+    assert "violation/stall_timeout" in events
+
+
+def test_watchdog_loss_spike_event():
+    from types import SimpleNamespace
+
+    events = []
+
+    class Tel:
+        def reliability_event(self, name, value, step):
+            events.append((name, value))
+
+    cfg = SimpleNamespace(enabled=True, max_skipped_steps=0,
+                          detect_non_finite=True, loss_spike_factor=4.0,
+                          loss_window=8, stall_factor=0.0, stall_window=8,
+                          min_samples=3, hard_timeout_s=0.0,
+                          on_violation="raise", restore_dir=None)
+    wd = TrainingWatchdog(cfg, telemetry=Tel())
+    eng = SimpleNamespace(global_steps=0)
+    for i in range(4):
+        eng.global_steps = i + 1
+        wd.observe(eng, SimpleNamespace(loss=2.0, overflow=False))
+    wd.observe(eng, SimpleNamespace(loss=100.0, overflow=False))
+    names = [n for n, _v in events]
+    assert names == ["loss_spike"]
+    assert events[0][1] == pytest.approx(50.0)  # spike ratio as the value
+
+
+# --------------------------------------------------------------------------- #
+# PreemptionGuard integration
+# --------------------------------------------------------------------------- #
+def test_synthetic_preemption_checkpoint_roundtrip(devices8, tmp_path):
+    """Satellite: checkpoint-on-SIGTERM round-trips — via the harness's
+    synthetic signal, no OS delivery needed."""
+    from deepspeed_tpu.elasticity.elastic_agent import PreemptionGuard
+
+    ckpt = str(tmp_path / "ck")
+    engine = _mk_engine()
+    guard = PreemptionGuard(ckpt, signals=(signal.SIGUSR2,))
+    try:
+        for _ in range(2):
+            engine.train_batch(_BATCH)
+            assert not guard.step_boundary(engine)
+        faults.preempt(guard, signal.SIGTERM)
+        engine.train_batch(_BATCH)
+        assert guard.step_boundary(engine)       # checkpointed, exit now
+        assert not guard.step_boundary(engine)   # once per trigger
+    finally:
+        guard.uninstall()
+    assert _rel_count(engine, "preemption_checkpoint") == 1
+    tag = tag_candidates(ckpt)[0]
+    assert verify_manifest(os.path.join(ckpt, tag))[0] == "verified"
+
+    engine2 = _mk_engine()
+    path, _ = engine2.load_checkpoint(ckpt)
+    assert path is not None and engine2.global_steps == 3
+    np.testing.assert_allclose(np.asarray(engine2.state.params["w"]),
+                               np.asarray(engine.state.params["w"]),
+                               rtol=1e-6)
+
+
+def test_watchdog_exit_requests_guard_checkpoint(devices8, tmp_path):
+    """on_violation=exit: the watchdog requests a checkpoint-and-exit through
+    PreemptionGuard.step_boundary — the same protocol a SIGTERM uses."""
+    from deepspeed_tpu.elasticity.elastic_agent import PreemptionGuard
+
+    ckpt = str(tmp_path / "ck")
+    engine = _mk_engine(watchdog={"max_skipped_steps": 1,
+                                  "on_violation": "exit"})
+    guard = PreemptionGuard(ckpt, signals=(signal.SIGUSR2,),
+                            watchdog=engine.watchdog)
+    try:
+        engine.train_batch(_BATCH)
+        assert not guard.step_boundary(engine)
+        with faults.forced_nonfinite(engine, steps=1):
+            engine.train_batch(_BATCH)  # violation → restart_requested
+        assert engine.watchdog.restart_requested
+        assert guard.step_boundary(engine)  # checkpointed for the restart
+        assert not engine.watchdog.restart_requested
+        assert not guard.step_boundary(engine)
+    finally:
+        guard.uninstall()
+    assert tag_candidates(ckpt)  # the restart has something to resume from
+
+
+# --------------------------------------------------------------------------- #
+# reporting
+# --------------------------------------------------------------------------- #
+def test_telemetry_report_reliability(tmp_path):
+    import subprocess
+    import sys
+
+    from deepspeed_tpu.monitor.monitor import JSONLMonitor
+
+    class Cfg:
+        enabled = True
+        output_path = str(tmp_path)
+        job_name = "job"
+
+    mon = JSONLMonitor(Cfg())
+    mon.write_events([("Reliability/checkpoint_saved", 1.0, 5),
+                      ("Reliability/checkpoint_saved", 1.0, 10),
+                      ("Reliability/overflow_skip", 1.0, 7),
+                      ("Reliability/violation/skip_limit", 1.0, 8),
+                      ("Reliability/checkpoint_rollback", 1.0, 11),
+                      ("Train/Samples/train_loss", 2.5, 10)])
+    mon.close()
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = os.path.join(repo, "scripts", "telemetry_report.py")
+    out = subprocess.run(
+        [sys.executable, script, str(tmp_path / "job" / "events.jsonl"),
+         "--reliability"], capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr
+    assert "checkpoint saves:       2" in out.stdout
+    assert "overflow-skipped steps: 1" in out.stdout
+    assert "watchdog violations:    1" in out.stdout
+    assert "rollbacks (walk-back):  1" in out.stdout
