@@ -41,6 +41,24 @@ def is_staging_name(name: str) -> bool:
     return any(m in name for m in _STAGING_MARKERS)
 
 
+def multihost_barrier(name: str) -> None:
+    """Block until every JAX process reaches this point (no-op when
+    single-process). The saver runs it between the collective state write
+    and rank 0's seal/publish: the orbax save has every host writing shards
+    into the same staging dir, and none of them may still be writing when
+    rank 0 renames it onto the tag dir."""
+    import jax
+
+    if jax.process_count() <= 1:
+        return
+    try:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(name)
+    except Exception as e:  # pragma: no cover — multihost only
+        logger.warning(f"multihost barrier '{name}' failed: {e}")
+
+
 def _sha256(path: str, chunk: int = 1 << 22) -> str:
     h = hashlib.sha256()
     with open(path, "rb") as f:

@@ -11,11 +11,15 @@ another (the universal-checkpoint property; the explicit fragment format
 lives in ``universal.py``).
 
 Crash consistency (``checkpoint.atomic``, default on): saves stage into
-``<tag>.tmp.<pid>``, fsync, manifest, then an atomic rename publishes the tag
-and only afterwards does ``latest`` advance — a SIGTERM or I/O error at ANY
-point leaves the previous checkpoint fully loadable (two-phase commit; the
+``<tag>.tmp.stage`` — the name is rank-INDEPENDENT because the orbax save is
+a multi-process collective where every host writes shards into the same dir —
+then fsync, manifest, and an atomic rename publish the tag and only
+afterwards does ``latest`` advance — a SIGTERM or I/O error at ANY point
+leaves the previous checkpoint fully loadable (two-phase commit; the
 protocol primitives live in ``manifest.py``, the whole thing is documented in
 ``docs/reliability.md`` and attacked by ``tests/test_fault_tolerance.py``).
+On multi-host meshes a barrier separates the state write from rank 0's
+seal/publish so no peer is still writing when the staging dir is renamed.
 Loads verify the manifest (``checkpoint.verify_on_load``) and walk back to
 the newest verifiable tag instead of crashing on a corrupt/missing one.
 """
@@ -25,6 +29,7 @@ from __future__ import annotations
 import json
 import os
 import shutil
+import threading
 from typing import Any, Dict, Optional
 
 import jax
@@ -33,9 +38,26 @@ import numpy as np
 from ...utils.logging import log_dist, logger
 from .engines import (CheckpointEngine, FastCheckpointEngine,
                       SyncCheckpointEngine, get_checkpoint_engine)
-from .manifest import (newest_verifiable_tag, publish_dir, retention_sweep,
-                       fsync_tree, verify_manifest, with_io_retries,
-                       write_latest, write_manifest)
+from .manifest import (multihost_barrier, newest_verifiable_tag, publish_dir,
+                       retention_sweep, fsync_tree, verify_manifest,
+                       with_io_retries, write_latest, write_manifest)
+
+# Finalization (publish + latest + retention) must be serialized per save
+# dir: with the async engine several saves can be in flight at once and their
+# writer threads would otherwise race on `latest` and on retention rmtrees.
+# `_LATEST_STEPS` additionally keeps `latest` monotonic — an OLDER save
+# finalizing after a newer one must not move the pointer backwards.
+_FINALIZE_MUTEX = threading.Lock()
+_FINALIZE_LOCKS: Dict[str, threading.Lock] = {}
+_LATEST_STEPS: Dict[str, int] = {}
+
+
+def _finalize_lock(save_dir: str) -> threading.Lock:
+    with _FINALIZE_MUTEX:
+        lock = _FINALIZE_LOCKS.get(save_dir)
+        if lock is None:
+            lock = _FINALIZE_LOCKS[save_dir] = threading.Lock()
+        return lock
 
 
 def _reliability(engine, name: str, value: float = 1.0,
@@ -100,10 +122,19 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
     save_dir = os.path.abspath(save_dir)
     final_path = os.path.join(save_dir, tag)
     atomic = bool(getattr(cfg, "atomic", True))
-    stage = os.path.join(save_dir, f"{tag}.tmp.{os.getpid()}") if atomic \
+    # staging name is rank-INDEPENDENT: the orbax save is a multi-process
+    # collective — every host must write its shards into the SAME dir (a
+    # per-pid suffix would scatter shards across staging dirs and publish
+    # only rank 0's)
+    stage = os.path.join(save_dir, f"{tag}.tmp.stage") if atomic \
         else final_path
-    if atomic and os.path.isdir(stage):
+    rank0 = jax.process_index() == 0
+    multihost = jax.process_count() > 1
+    if atomic and rank0 and os.path.isdir(stage):
         shutil.rmtree(stage)  # stale staging left by a crashed earlier save
+    if multihost:
+        # the rmtree above must land before any peer starts writing
+        multihost_barrier(f"ckpt_stage:{tag}")
     os.makedirs(stage, exist_ok=True)
 
     state_dict = {
@@ -117,7 +148,6 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
     # NVMe-streamed optimizer tier: its fp32 masters + moments live in .swp
     # files, not in state.opt_state — stream-copy them into the checkpoint
     nvme = getattr(engine, "_nvme_opt", None)
-    rank0 = jax.process_index() == 0
     if nvme is not None and rank0:
         nvme.save_state_files(os.path.join(stage, "nvme_optimizer"))
 
@@ -141,19 +171,38 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
     backoff_s = float(getattr(cfg, "io_backoff_s", 0.5))
     step_at_save = int(engine.global_steps)
 
+    done = {"synced": False, "durable": False, "published": False}
+
     def _finalize():
         # two-phase commit, phase 2: runs only once the state bytes are
         # durable (sync engines: inline; async: in the writer thread). Until
         # the rename + latest update below, a crash leaves the previous
         # checkpoint untouched and this save invisible.
+        if multihost and not done["synced"]:
+            # every host must be done writing its shards before rank 0
+            # seals + renames the staging dir (first attempt only — a
+            # retry must not wait for peers that already left the barrier)
+            multihost_barrier(f"ckpt_seal:{tag}")
+            done["synced"] = True
+        done["durable"] = True
         if not rank0:
             return
-        if atomic:
-            fsync_tree(stage)
-            write_manifest(stage)
-            publish_dir(stage, final_path)
-        write_latest(save_dir, tag)
-        removed = retention_sweep(save_dir, keep_last_n, protect=(tag,))
+        with _finalize_lock(save_dir):
+            if atomic and not done["published"]:
+                fsync_tree(stage)
+                write_manifest(stage)
+                publish_dir(stage, final_path)
+            done["published"] = True
+            prev = _LATEST_STEPS.get(save_dir)
+            if prev is None or step_at_save >= prev:
+                write_latest(save_dir, tag)
+                _LATEST_STEPS[save_dir] = step_at_save
+            else:
+                logger.warning(
+                    f"checkpoint '{tag}' (step {step_at_save}) finalized "
+                    f"after a newer save (step {prev}) — leaving 'latest' "
+                    f"on the newer tag")
+            removed = retention_sweep(save_dir, keep_last_n, protect=(tag,))
         if removed:
             _reliability(engine, "checkpoint_gc", value=removed,
                          step=step_at_save)
@@ -164,11 +213,19 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
     state_path = os.path.join(stage, "state")
 
     def _write():
+        if done["durable"]:
+            # the state bytes landed on an earlier attempt and only the
+            # publish/latest/GC tail failed — re-run just that (a second
+            # ce.save would re-stage over the already-published tag)
+            _finalize()
+            return
         ce.save(state_dict, state_path, on_durable=_finalize)
-        if retries:
-            # the retry policy needs to OBSERVE failures: force the async
-            # engine to confirm this save before returning (io_retries > 0
-            # trades the decoupled return for guaranteed delivery)
+        if retries or multihost:
+            # retries: the policy needs to OBSERVE failures; multihost: the
+            # seal barrier in the writer thread must not interleave with
+            # training-step collectives on the main thread — either way,
+            # confirm this save before returning (trading the decoupled
+            # return for guaranteed delivery)
             ce.commit(state_path)
 
     with_io_retries(
@@ -189,6 +246,10 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
         ce.wait_all()
     except Exception as e:
         logger.error(f"pending async checkpoint write failed: {e}")
+    # re-arm the monotonic-`latest` guard: it orders concurrent in-flight
+    # finalizations (all drained above) — after a restore/rollback, saves on
+    # the restored (earlier-step) timeline must be able to advance `latest`
+    _LATEST_STEPS.pop(os.path.abspath(load_dir), None)
     explicit_tag = tag is not None
     try:
         tag = resolve_tag(load_dir, tag)

@@ -1374,6 +1374,8 @@ class DeepSpeedTPUEngine:
             self._build_train_step()
         self.tput_timer.start()
         self.telemetry.step_begin(self.global_steps + 1)
+        if self.watchdog is not None:
+            self.watchdog.step_started()
         if self.curriculum_scheduler is not None:
             # difficulty = seq length; each bucket is its own cached jit
             batch = self.curriculum_scheduler.truncate(batch, self.global_steps)
@@ -1419,6 +1421,10 @@ class DeepSpeedTPUEngine:
 
             with self.mesh_mgr.activate():
                 self._grad_step = jax.jit(one_micro)
+        if self.watchdog is not None and not self._staged_batches:
+            # first micro-batch of a GAS window: start the stall clock that
+            # the boundary step()'s observe() reads
+            self.watchdog.step_started()
         self._staged_batches.append(self._shard_batch(batch, with_gas_dim=False))
         if self.wall_clock_breakdown():
             self.timers(FORWARD_MICRO_TIMER).start(sync=True)

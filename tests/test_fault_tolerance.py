@@ -267,6 +267,68 @@ def test_async_engine_commit_before_latest(devices8, tmp_path):
         assert f.read().strip() == "bg"  # still the last good tag
 
 
+def test_async_out_of_order_finalize_keeps_latest_monotonic(devices8,
+                                                            tmp_path):
+    """Two async saves in flight: the OLDER one finalizing last must not
+    move `latest` backwards (finalization is serialized + monotonic)."""
+    engine = _mk_engine(ckpt_engine="async")
+    ce = _engine_for(engine)
+    engine.train_batch(_BATCH)
+    with faults.write_delay(ce, 0.5):
+        engine.save_checkpoint(str(tmp_path), tag="old_slow")  # step 1
+        engine.train_batch(_BATCH)
+    # delay patch restored: the newer save's writer runs at full speed
+    engine.save_checkpoint(str(tmp_path), tag="new_fast")      # step 2
+    ce.wait_all()  # both writers done, in whichever order they raced
+    with open(tmp_path / "latest") as f:
+        assert f.read().strip() == "new_fast"
+    # the older save still published its tag dir — just not `latest`
+    assert verify_manifest(str(tmp_path / "old_slow"))[0] == "verified"
+    assert verify_manifest(str(tmp_path / "new_fast"))[0] == "verified"
+
+
+def test_failed_latest_write_retries_without_resave(devices8, tmp_path,
+                                                    monkeypatch):
+    """An OSError in the finalize tail AFTER publish succeeded must retry
+    only the latest/GC portion — never re-stage the state over the
+    already-published tag."""
+    from deepspeed_tpu.runtime.checkpoint import saver as saver_mod
+
+    engine = _mk_engine(checkpoint={"io_retries": 2, "io_backoff_s": 0.01})
+    engine.train_batch(_BATCH)
+    ce = _engine_for(engine)
+
+    saves = {"n": 0}
+    orig_save = ce.save
+
+    def counting_save(*a, **kw):
+        saves["n"] += 1
+        return orig_save(*a, **kw)
+
+    ce.save = counting_save
+    orig_latest = saver_mod.write_latest
+    fails = {"n": 0}
+
+    def flaky_latest(save_dir, tag):
+        if fails["n"] < 1:
+            fails["n"] += 1
+            raise OSError("injected 'latest' write failure")
+        return orig_latest(save_dir, tag)
+
+    monkeypatch.setattr(saver_mod, "write_latest", flaky_latest)
+    try:
+        path = engine.save_checkpoint(str(tmp_path), tag="p1")
+    finally:
+        ce.save = orig_save
+    assert saves["n"] == 1  # the state bytes were written exactly once
+    assert fails["n"] == 1
+    assert verify_manifest(path)[0] == "verified"
+    assert not [n for n in os.listdir(tmp_path) if ".tmp." in n]
+    with open(tmp_path / "latest") as f:
+        assert f.read().strip() == "p1"
+    assert _rel_count(engine, "checkpoint_io_retry") == 1
+
+
 def test_engine_destroy_drains_async_writer(devices8, tmp_path):
     """Satellite: engine.destroy() must drain in-flight async saves so
     process exit can't truncate one."""
@@ -322,6 +384,27 @@ def test_watchdog_auto_restore_from_checkpoint(devices8, tmp_path):
     # training continues cleanly after the restore
     out = engine.train_batch(_BATCH)
     assert np.isfinite(float(out.loss))
+
+
+def test_watchdog_step_timing_wired_on_train_batch(devices8):
+    """step_started() must run on the DEFAULT train_batch path so the
+    stall/timeout detectors see real step times (not just the NVMe path)."""
+    engine = _mk_engine(watchdog={"stall_factor": 100.0})
+    for _ in range(3):
+        engine.train_batch(_BATCH)
+    assert len(engine.watchdog._time_window) == 3
+    assert all(t > 0 for t in engine.watchdog._time_window)
+
+
+def test_watchdog_step_timing_wired_on_gas_api_path(devices8):
+    """The forward/backward/step API path starts the stall clock at the
+    first micro-batch of each GAS window."""
+    engine = _mk_engine(watchdog={"stall_factor": 100.0})
+    for _ in range(2):
+        loss = engine.forward(_BATCH)
+        engine.backward(loss)
+        assert engine.step() is not None
+    assert len(engine.watchdog._time_window) == 2
 
 
 def test_watchdog_stall_and_timeout_detectors():
