@@ -31,6 +31,24 @@ class RaggedConfig:
 
 
 @dataclass
+class PrefixCacheConfig:
+    """Prefix-aware KV-cache reuse for the v2 paged engine (docs/serving.md).
+
+    Default OFF: with ``enabled=False`` the serving path is bit-identical to
+    the cache-less engine. When ON, admissions resolve shared prompt prefixes
+    (system prompts, few-shot templates, multi-turn histories) to existing KV
+    blocks via a chain-hash index and start prefill at the first uncached
+    token; retired sequences' full blocks park in a retained LRU pool and are
+    evicted only under allocation pressure."""
+
+    enabled: bool = False
+    # retained-pool cap: -1 = bounded only by the block pool itself,
+    # 0 = share blocks between live sequences but retain nothing after
+    # retire, >0 = keep at most this many unreferenced blocks
+    max_retained_blocks: int = -1
+
+
+@dataclass
 class QuantConfig:
     """Weight quantization for inference (reference
     ``inference/quantization`` INT4/INT8 + ``GroupQuantizer``)."""
@@ -58,6 +76,7 @@ class InferenceConfig:
     split_prefill_chunk: int = 0
     ragged: RaggedConfig = field(default_factory=RaggedConfig)
     quant: QuantConfig = field(default_factory=QuantConfig)
+    prefix_cache: PrefixCacheConfig = field(default_factory=PrefixCacheConfig)
 
     @classmethod
     def from_dict(cls, d: Optional[Dict[str, Any]]) -> "InferenceConfig":
@@ -67,6 +86,8 @@ class InferenceConfig:
             tp = {"tp_size": tp}
         ragged = d.pop("ragged", {})
         quant = d.pop("quant", {})
+        prefix = d.pop("prefix_cache", {})
         known = {k: v for k, v in d.items() if k in cls.__dataclass_fields__}
         return cls(tensor_parallel=TPConfig(**tp), ragged=RaggedConfig(**ragged),
-                   quant=QuantConfig(**quant), **known)
+                   quant=QuantConfig(**quant),
+                   prefix_cache=PrefixCacheConfig(**prefix), **known)

@@ -33,11 +33,14 @@ class InferenceEngineV2(InferenceEngine):
                  config: Optional[InferenceConfig] = None,
                  mesh_mgr: Optional[MeshManager] = None,
                  init_paged_cache: Optional[Callable] = None,
-                 apply_paged: Optional[Callable] = None):
+                 apply_paged: Optional[Callable] = None,
+                 telemetry_hub=None):
         super().__init__(family, params, config, mesh_mgr)
         rc = self.config.ragged
+        pc = self.config.prefix_cache
         self._apply_paged = apply_paged
         self._init_paged = init_paged_cache
+        self._hub = telemetry_hub
         if self._apply_paged is None:  # resolve from the family's module
             import deepspeed_tpu.models.llama as _llama  # default family
             self._apply_paged = _llama.apply_paged
@@ -46,7 +49,9 @@ class InferenceEngineV2(InferenceEngine):
             2, (self.family.cfg.max_seq_len + rc.block_size - 1) // rc.block_size)
         self.state = StateManager(rc.max_tracked_sequences,
                                   rc.memory_config_blocks, rc.block_size,
-                                  max_blocks_per_seq)
+                                  max_blocks_per_seq,
+                                  prefix_cache=pc.enabled,
+                                  max_retained_blocks=pc.max_retained_blocks)
         self.cache = self._init_paged(self.family.cfg, rc.memory_config_blocks,
                                       rc.block_size)
         self._paged_fns: Dict[Tuple, Callable] = {}
@@ -62,7 +67,8 @@ class InferenceEngineV2(InferenceEngine):
         # uid → (full prompt, SamplingParams from put_split)
         self._pending_prefill: Dict[int, Tuple] = {}
         log_dist(f"InferenceEngineV2: {rc.memory_config_blocks} blocks × "
-                 f"{rc.block_size} tokens, {B} sequence slots")
+                 f"{rc.block_size} tokens, {B} sequence slots, "
+                 f"prefix_cache={'on' if pc.enabled else 'off'}")
 
     # ------------------------------------------------------------------ #
     def _prefill_fn(self, pad_t: int, sp: SamplingParams, n: int = 1):
@@ -145,6 +151,82 @@ class InferenceEngineV2(InferenceEngine):
             self._paged_fns[key] = jax.jit(prefill, donate_argnums=(1,))
         return self._paged_fns[key]
 
+    def _prefill_ctx_fn(self, pad_t: int, sp: SamplingParams, n: int):
+        """Batched prefill starting at a per-ROW context offset — the
+        prefix-cache admission path: row i's tokens are the UNCACHED suffix
+        of its prompt and ``ctx[i]`` counts the tokens already resolved to
+        shared blocks, so positions/attention pick up mid-prompt exactly
+        like a split-prefill chunk does. Compiled only when the cache is
+        enabled AND a batch actually hit — cache-off admissions keep the
+        original zero-offset programs byte for byte."""
+        key = ("prefill_ctx", pad_t, sp, n)
+        if key not in self._paged_fns:
+            fam, ap = self.family, self._apply_paged
+
+            def prefill(params, cache, tokens, lengths, tables, ctx, rng,
+                        uids):
+                valid = jnp.arange(pad_t)[None, :] < lengths[:, None]
+                logits, cache = ap(fam.cfg, self._dq(params), tokens, cache,
+                                   tables, ctx, valid=valid)
+                last = jnp.take_along_axis(
+                    logits, jnp.maximum(lengths - 1, 0)[:, None, None],
+                    axis=1)[:, 0]
+                keys = jax.vmap(lambda u: jax.random.fold_in(rng, u))(uids)
+                toks = jax.vmap(lambda k, l: sample(k, l, sp))(keys, last)
+                return toks.astype(jnp.int32), cache
+
+            self._paged_fns[key] = jax.jit(prefill, donate_argnums=(1,))
+        return self._paged_fns[key]
+
+    def _prefill_ctx_dyn_fn(self, pad_t: int, n: int):
+        """Context-offset prefill with per-row sampling params as traced
+        arrays (the ``_prefill_dyn_fn`` analog of ``_prefill_ctx_fn``)."""
+        key = ("prefill_ctx_dyn", pad_t, n)
+        if key not in self._paged_fns:
+            fam, ap = self.family, self._apply_paged
+
+            def prefill(params, cache, tokens, lengths, tables, ctx, rng,
+                        uids, temp, topk, topp, greedy):
+                valid = jnp.arange(pad_t)[None, :] < lengths[:, None]
+                logits, cache = ap(fam.cfg, self._dq(params), tokens, cache,
+                                   tables, ctx, valid=valid)
+                last = jnp.take_along_axis(
+                    logits, jnp.maximum(lengths - 1, 0)[:, None, None],
+                    axis=1)[:, 0]
+                keys = jax.vmap(lambda u: jax.random.fold_in(rng, u))(uids)
+                toks = jax.vmap(lambda k, l, t, tk, tp, g: sample_batch(
+                    k, l[None], t[None], tk[None], tp[None], g[None])[0])(
+                        keys, last, temp, topk, topp, greedy)
+                return toks.astype(jnp.int32), cache
+
+            self._paged_fns[key] = jax.jit(prefill, donate_argnums=(1,))
+        return self._paged_fns[key]
+
+    def _copy_block_fn(self):
+        """One compiled (src, dst are traced scalars) whole-block copy in the
+        KV pool — the device half of copy-on-write: before a sequence appends
+        into a block it shares, the host allocator hands it a private block
+        and this stamps the shared block's contents into it."""
+        key = ("copy_block",)
+        if key not in self._paged_fns:
+
+            def cp(cache, src, dst):
+                return jax.tree.map(
+                    lambda c: c.at[:, dst].set(c[:, src]), cache)
+
+            self._paged_fns[key] = jax.jit(cp, donate_argnums=(0,))
+        return self._paged_fns[key]
+
+    def _copy_blocks(self, pairs) -> None:
+        """Apply the (src, dst) copies ``StateManager.ensure_writable``
+        scheduled, before the step that writes into dst launches."""
+        if not pairs:
+            return
+        fn = self._copy_block_fn()
+        for src, dst in pairs:
+            self.cache = fn(self.cache, jnp.asarray(src, jnp.int32),
+                            jnp.asarray(dst, jnp.int32))
+
     def _chunk_prefill_fn(self, chunk_t: int, sp: SamplingParams,
                           final: bool):
         """One compiled prefill CHUNK for one sequence at an arbitrary
@@ -203,11 +285,13 @@ class InferenceEngineV2(InferenceEngine):
         if not final:
             self.cache = fn(*args)
             desc.seen_tokens = done + len(chunk)
+            self.state.mark_filled(desc)  # completed chunks become matchable
             return {}
         tok, self.cache = fn(*args)
         tok = int(tok)
         del self._pending_prefill[uid]
         desc.seen_tokens = len(prompt)
+        self.state.mark_filled(desc)
         desc.prefilling = False
         desc.last_token = tok
         desc.generated.append(tok)
@@ -226,9 +310,14 @@ class InferenceEngineV2(InferenceEngine):
         ongoing decodes — so a long prompt never blocks live sequences for
         more than one chunk's compute (the FastGen Dynamic-SplitFuse
         scheduling property). The first sampled token arrives in the step()
-        result that completes the prompt."""
+        result that completes the prompt.
+
+        With the prefix cache enabled, a cached prefix is resolved to shared
+        blocks at admission and chunking starts at the first uncached token —
+        a mostly-cached long prompt may need only one chunk."""
         prompt = np.asarray(prompt_tokens, np.int32)
-        desc = self.state.admit(uid, len(prompt))
+        desc, cached = self.state.admit_prompt(uid, prompt)
+        desc.seen_tokens = cached   # chunk loop starts after the cached hit
         desc.prefilling = True
         self._pending_prefill[uid] = (prompt, sp)
 
@@ -348,57 +437,79 @@ class InferenceEngineV2(InferenceEngine):
         entries are retired before the error propagates (no half-admitted
         descriptors ever become visible to step())."""
         entries = []
+        cached = []
         try:
             for uid, p in uid_prompts:
                 prompt = np.asarray(p, np.int32)
-                entries.append((uid, prompt,
-                                self.state.admit(uid, len(prompt))))
+                desc, hit = self.state.admit_prompt(uid, prompt)
+                entries.append((uid, prompt, desc))
+                cached.append(hit)
         except Exception:
             for uid, _, _ in entries:
                 self.state.retire(uid)
             raise
-        return self._prefill_admitted(entries, [sp] * len(entries), seed)
+        return self._prefill_admitted(entries, [sp] * len(entries), seed,
+                                      cached=cached)
 
-    def _prefill_admitted(self, entries, sps,
-                          seed: int = 0) -> Dict[int, int]:
+    def _prefill_admitted(self, entries, sps, seed: int = 0,
+                          cached=None) -> Dict[int, int]:
         """Batched prefill over already-admitted ``(uid, prompt, desc)``
         entries (callers admit first so capacity accounting stays exact),
         with per-ENTRY sampling params ``sps``. The batch pads to a
         power-of-two row count with masked dummy rows — one compile per
         (pad_t, bucket), not per burst size; an all-greedy burst runs the
         static argmax program, any stochastic entry switches to the
-        per-row-array variant (one compile for every config mix)."""
+        per-row-array variant (one compile for every config mix).
+
+        ``cached[i]`` tokens of entry i were resolved to shared blocks by the
+        prefix cache: the forward pass then runs only over each prompt's
+        uncached SUFFIX at its context offset. A batch with no hits (or with
+        the cache off) takes the original zero-offset programs unchanged."""
         if not entries:
             return {}
+        if cached is None:
+            cached = [0] * len(entries)
         sps = [self._canon_sp(s_) for s_ in sps]
         n = len(entries)
         n_pad = 1 << (n - 1).bit_length()
-        pad_t = _round_up(max(max(len(p) for _, p, _ in entries), 1),
+        pad_t = _round_up(max(max(len(p) - c for (_, p, _), c
+                                  in zip(entries, cached)), 1),
                           self.config.prefill_bucket)
         padded = np.zeros((n_pad, pad_t), np.int32)
         lengths = np.zeros((n_pad,), np.int32)  # dummy rows: length 0
+        ctx = np.zeros((n_pad,), np.int32)
         uids_arr = np.zeros((n_pad,), np.int32)
         tables = np.zeros((n_pad, self._slot_tables.shape[1]), np.int32)
         for i, (uid, prompt, desc) in enumerate(entries):
-            padded[i, :len(prompt)] = prompt
-            lengths[i] = len(prompt)
+            suffix = prompt[cached[i]:]
+            padded[i, :len(suffix)] = suffix
+            lengths[i] = len(suffix)
+            ctx[i] = cached[i]
             uids_arr[i] = uid
             tables[i] = self.state.block_table(desc)
+        with_ctx = any(cached)
         base = (self.params, self.cache, jnp.asarray(padded),
-                jnp.asarray(lengths), jnp.asarray(tables),
-                jax.random.PRNGKey(seed), jnp.asarray(uids_arr))
+                jnp.asarray(lengths), jnp.asarray(tables))
+        if with_ctx:
+            base += (jnp.asarray(ctx),)
+        base += (jax.random.PRNGKey(seed), jnp.asarray(uids_arr))
         greedy_sp = SamplingParams(greedy=True)
         if all(s_ == greedy_sp for s_ in sps):
-            toks, self.cache = self._prefill_fn(pad_t, greedy_sp, n_pad)(*base)
+            fn = (self._prefill_ctx_fn if with_ctx else self._prefill_fn)(
+                pad_t, greedy_sp, n_pad)
+            toks, self.cache = fn(*base)
         else:
             pad_sps = sps + [greedy_sp] * (n_pad - n)  # dummy rows: greedy
-            toks, self.cache = self._prefill_dyn_fn(pad_t, n_pad)(
-                *base, *map(jnp.asarray, sp_arrays(pad_sps)))
+            fn = (self._prefill_ctx_dyn_fn(pad_t, n_pad) if with_ctx
+                  else self._prefill_dyn_fn(pad_t, n_pad))
+            toks, self.cache = fn(*base, *map(jnp.asarray,
+                                              sp_arrays(pad_sps)))
         toks = np.asarray(toks)
         out: Dict[int, int] = {}
         for i, (uid, prompt, desc) in enumerate(entries):
             tok = int(toks[i])
             desc.seen_tokens = len(prompt)
+            self.state.mark_filled(desc)  # full prompt blocks → matchable
             desc.last_token = tok
             desc.generated.append(tok)
             s = desc.slot
@@ -433,9 +544,14 @@ class InferenceEngineV2(InferenceEngine):
             while self._pending_prefill and not out:
                 out.update(self._advance_prefill(seed))
             return out
+        cow = []
         for d in live:
+            # copy-on-write BEFORE extend: only pre-existing blocks can be
+            # shared; the blocks extend allocates are fresh (refcount 1)
+            cow += self.state.ensure_writable(d, d.seen_tokens + 1)
             self.state.extend(d)
             self._slot_tables[d.slot] = self.state.block_table(d)
+        self._copy_blocks(cow)
         base = (self.params, self.cache, jnp.asarray(self._slot_tokens),
                 jnp.asarray(self._slot_lens), jnp.asarray(self._slot_tables),
                 jnp.asarray(self._slot_active), jax.random.PRNGKey(seed))
@@ -448,11 +564,13 @@ class InferenceEngineV2(InferenceEngine):
         nxt = np.asarray(nxt)
         for d in live:
             tok = int(nxt[d.slot])
+            d.tokens.append(d.last_token)  # the id whose KV this step wrote
             d.seen_tokens += 1
             d.last_token = tok
             d.generated.append(tok)
             self._slot_tokens[d.slot] = tok
             self._slot_lens[d.slot] = d.seen_tokens
+            self.state.mark_filled(d)
             out[d.uid] = tok
         return out
 
@@ -484,9 +602,12 @@ class InferenceEngineV2(InferenceEngine):
         k = min(k, self.family.cfg.max_seq_len - max_seen)
         if k <= 0:
             return out
+        cow = []
         for d in live:
+            cow += self.state.ensure_writable(d, d.seen_tokens + k)
             self.state.extend(d, n=k)  # reserve ALL k tokens up front
             self._slot_tables[d.slot] = self.state.block_table(d)
+        self._copy_blocks(cow)
         base = (self.params, self.cache, jnp.asarray(self._slot_tokens),
                 jnp.asarray(self._slot_lens), jnp.asarray(self._slot_tables),
                 jnp.asarray(self._slot_active), jax.random.PRNGKey(seed))
@@ -499,11 +620,15 @@ class InferenceEngineV2(InferenceEngine):
         toks = np.asarray(toks)          # [k, B] — the ONLY host sync
         for d in live:
             seq = [int(t) for t in toks[:, d.slot]]
+            # KV writes this quantum: the previous last_token, then each
+            # sampled token except the newest (still pending its write)
+            d.tokens.extend([d.last_token] + seq[:-1])
             d.seen_tokens += k
             d.last_token = seq[-1]
             d.generated.extend(seq)
             self._slot_tokens[d.slot] = seq[-1]
             self._slot_lens[d.slot] = d.seen_tokens
+            self.state.mark_filled(d)
             out[d.uid] = seq
         return out
 
@@ -517,6 +642,42 @@ class InferenceEngineV2(InferenceEngine):
         self._slot_sp[desc.slot] = SamplingParams(greedy=True)
         self.state.retire(uid)
         return desc.generated
+
+    def fork(self, uid: int, new_uid: int,
+             sp: Optional[SamplingParams] = None):
+        """Fork a live sequence: ``new_uid`` decodes from the SAME context
+        without copying a single KV byte (parallel sampling / best-of-n).
+        Both sequences share every block including the partial tail —
+        whichever appends first gets a private copy via copy-on-write. The
+        child starts with an empty ``generated`` list and, unless ``sp`` is
+        given, the parent's sampling params."""
+        desc = self.state.fork(uid, new_uid)
+        s, parent_slot = desc.slot, self.state.seqs[uid].slot
+        self._slot_tokens[s] = desc.last_token
+        self._slot_lens[s] = desc.seen_tokens
+        self._slot_tables[s] = self.state.block_table(desc)
+        self._slot_active[s] = True
+        self._slot_sp[s] = (self._canon_sp(sp) if sp is not None
+                            else self._slot_sp[parent_slot])
+        return desc
+
+    # ------------------------------------------------------------------ #
+    def prefix_cache_events(self, step: int = 0):
+        """``Serving/prefix_cache/*`` telemetry events (cumulative counters
+        plus the retained-pool occupancy gauge) — written through an attached
+        TelemetryHub by :meth:`publish_prefix_telemetry`, or directly by the
+        serving bench's JSONL sink for ``telemetry_report.py --serving``."""
+        stats = dict(self.state.prefix_stats)
+        stats["retained_blocks"] = self.state.retained_blocks
+        return [(f"Serving/prefix_cache/{k}", float(v), step)
+                for k, v in sorted(stats.items())]
+
+    def publish_prefix_telemetry(self, step: int = 0):
+        events = self.prefix_cache_events(step)
+        if self._hub is not None:
+            for name, value, s in events:
+                self._hub.serving_event(name, value, s)
+        return events
 
     # ------------------------------------------------------------------ #
     def generate(self, prompts, max_new_tokens: int = 64,
@@ -564,6 +725,7 @@ class InferenceEngineV2(InferenceEngine):
         step_i = 0
         while pending or self.state.seqs:
             batch_adm = []
+            batch_cached = []
             split = self.config.split_prefill_chunk
             # a prompt that fits one EFFECTIVE chunk gains nothing from the
             # split path — keep it in the batched one-shot burst
@@ -577,12 +739,13 @@ class InferenceEngineV2(InferenceEngine):
                     self.put_split(uid, prompt, sp_for[uid])
                     continue
                 # admit eagerly so can_admit sees each admission's capacity
-                batch_adm.append((uid, prompt,
-                                  self.state.admit(uid, len(prompt))))
+                desc, hit = self.state.admit_prompt(uid, prompt)
+                batch_adm.append((uid, prompt, desc))
+                batch_cached.append(hit)
             if batch_adm:  # one compiled prefill for the whole burst
                 self._prefill_admitted(
                     batch_adm, [sp_for[uid] for uid, _, _ in batch_adm],
-                    seed=seed)
+                    seed=seed, cached=batch_cached)
             if steps_per_sync > 1:
                 k = max(1, min(steps_per_sync, max_new_tokens))
                 self.step_many(k, seed=seed + step_i)
@@ -610,7 +773,8 @@ class InferenceEngineV2(InferenceEngine):
         return [results[i] for i in range(len(prompts))]
 
 
-def build_engine_v2(model, model_cfg, params, config=None, **kwargs) -> InferenceEngineV2:
+def build_engine_v2(model, model_cfg, params, config=None,
+                    telemetry_hub=None, **kwargs) -> InferenceEngineV2:
     """Counterpart of ``build_hf_engine`` (``inference/v2/engine_factory.py:70``)."""
     if isinstance(config, dict) or config is None:
         config = InferenceConfig.from_dict({**(config or {}), **kwargs})
@@ -618,7 +782,8 @@ def build_engine_v2(model, model_cfg, params, config=None, **kwargs) -> Inferenc
     return InferenceEngineV2(
         family, params, config,
         init_paged_cache=getattr(model, "init_paged_cache", None),
-        apply_paged=getattr(model, "apply_paged", None))
+        apply_paged=getattr(model, "apply_paged", None),
+        telemetry_hub=telemetry_hub)
 
 
 def build_hf_engine(checkpoint: str, config=None,

@@ -72,6 +72,22 @@ class TelemetryHub:
         # trips, preemptions) — counted on every rank for tests/reports,
         # written through the monitor on rank 0
         self.reliability_counts: Dict[str, int] = {}
+        # Serving/* gauges (prefix-cache hit tokens, prefill tokens saved,
+        # retained-pool occupancy, evictions — docs/serving.md); tracked on
+        # every rank for tests/reports, written through the monitor on rank 0
+        self.serving_values: Dict[str, float] = {}
+
+    # ------------------------------------------------------------------ #
+    def serving_event(self, name: str, value: float, step: int = 0) -> None:
+        """Fan out one ``Serving/<name>`` gauge (v2 serving engine counters,
+        e.g. ``Serving/prefix_cache/*``). Unlike ``reliability_event`` these
+        carry cumulative/gauge VALUES, so the last sample per series is the
+        current total. Cheap when no monitor backend is enabled."""
+        if not name.startswith("Serving/"):
+            name = "Serving/" + name
+        self.serving_values[name] = float(value)
+        if self.rank0 and self._monitor_on():
+            self.monitor.write_events([(name, float(value), int(step))])
 
     # ------------------------------------------------------------------ #
     def reliability_event(self, name: str, value: float = 1.0,

@@ -7,7 +7,10 @@ new prompt is admitted — so every measured step interleaves decode with
 periodic prefills exactly the way FastGen's steady-state benchmark does
 (reference blogs/deepspeed-fastgen: throughput at fixed client count).
 
-Reports generated tok/s at 2-3 client counts. ONE JSON line.
+Reports generated tok/s at 2-3 client counts, plus a shared-system-prompt
+workload (N clients sharing a long common prefix) that measures the paged
+engine's prefix cache ON vs OFF: tok/s, hit-rate, and prefill_tokens_saved
+(docs/serving.md). ONE JSON line.
 """
 
 import json
@@ -31,19 +34,23 @@ RESULT = {"metric": "serving_steady_tok_per_sec", "value": 0.0,
 
 
 def run_closed_loop(eng, sp, vocab, batch, prompt_len, gen_len, measure_s,
-                    rng, quantum=1):
+                    rng, quantum=1, make_prompt=None):
     """Keep `batch` sequences live for `measure_s` seconds; count generated
     tokens (decode steps + the first token each prefill produces).
     ``quantum > 1`` uses the fused k-step decode (one host sync per k
-    tokens) with admission at quantum boundaries."""
+    tokens) with admission at quantum boundaries. ``make_prompt(uid)``
+    overrides the default random prompt (shared-prefix workload mode)."""
     import numpy as np
 
     uid = 0
+    if make_prompt is None:
+        def make_prompt(_uid):
+            return rng.integers(0, vocab, (prompt_len,),
+                                dtype=np.int32).tolist()
 
     def admit():
         nonlocal uid
-        eng.put(uid, rng.integers(0, vocab, (prompt_len,),
-                                  dtype=np.int32).tolist(), sp, seed=uid)
+        eng.put(uid, make_prompt(uid), sp, seed=uid)
         uid += 1
 
     def useful_live():
@@ -89,6 +96,75 @@ def run_closed_loop(eng, sp, vocab, batch, prompt_len, gen_len, measure_s,
     lat = {"p50_ms": round(float(np.percentile(tok_ms, 50)), 2),
            "p95_ms": round(float(np.percentile(tok_ms, 95)), 2)}
     return produced / dt, prefills, lat
+
+
+def run_shared_prefix(build, sp, vocab, rng, batch, shared_len, tail_len,
+                      gen_len, measure_s, quantum=1):
+    """Shared-system-prompt workload (docs/serving.md): ``batch`` closed-loop
+    clients whose prompts all start with the SAME ``shared_len``-token prefix
+    (a long system prompt / few-shot template) followed by a unique tail.
+    Runs the loop with the prefix cache OFF then ON and reports tok/s,
+    prefix hit-rate, ``prefill_tokens_saved``, and the saved fraction of the
+    reusable shared-prefix tokens (acceptance: >= 0.9 after warmup — only
+    the first admission must prefill the shared blocks)."""
+    import numpy as np
+
+    shared = rng.integers(0, vocab, (shared_len,), dtype=np.int32).tolist()
+
+    out = {"shared_len": shared_len, "tail_len": tail_len, "gen_len": gen_len}
+    for label, enabled in (("cache_off", False), ("cache_on", True)):
+        # per-mode tail stream so OFF and ON admit the same prompt sequence
+        tail_rng = np.random.default_rng(7)
+
+        def make_prompt(_uid):
+            return shared + tail_rng.integers(
+                0, vocab, (tail_len,), dtype=np.int32).tolist()
+
+        eng = build(enabled)
+        try:
+            tps, prefills, lat = run_closed_loop(
+                eng, sp, vocab, batch, shared_len + tail_len, gen_len,
+                measure_s, rng, quantum=quantum, make_prompt=make_prompt)
+            stats = dict(eng.state.prefix_stats)
+            admissions = batch + prefills
+            bs = eng.state.block_size
+            # tokens the cache could have resolved: every admission after the
+            # first can reuse the shared prefix's full blocks
+            reusable = (shared_len // bs) * bs * max(0, admissions - 1)
+            row = {"tok_per_sec": round(tps, 1),
+                   "prefills_in_window": prefills,
+                   "token_latency": lat,
+                   "prefill_tokens_saved": stats["prefill_tokens_saved"],
+                   "hit_rate": round(stats["hits"] / stats["lookups"], 3)
+                   if stats["lookups"] else 0.0,
+                   "saved_frac_of_shared":
+                   round(stats["prefill_tokens_saved"] / reusable, 3)
+                   if reusable else 0.0,
+                   "evictions": stats["evictions"],
+                   "retained_blocks": eng.state.retained_blocks}
+            out[label] = row
+            sys.stderr.write(f"[serving] shared_prefix {label}: {row}\n")
+            tel_dir = os.environ.get("DSTPU_SERVING_TELEMETRY")
+            if enabled and tel_dir:
+                _dump_serving_telemetry(eng, tel_dir)
+        finally:
+            del eng
+    return out
+
+
+def _dump_serving_telemetry(eng, out_dir):
+    """Write the engine's Serving/prefix_cache/* counters as a TelemetryHub
+    JSONL file for ``scripts/telemetry_report.py --serving``."""
+    from deepspeed_tpu.monitor.monitor import JSONLMonitor
+
+    class _Cfg:
+        enabled = True
+        output_path = out_dir
+        job_name = "serving_bench"
+
+    mon = JSONLMonitor(_Cfg())
+    mon.write_events(eng.prefix_cache_events(step=0))
+    mon.close()
 
 
 def run_longprompt_probe(build, sp, vocab, rng, batch, short_len, long_len,
@@ -212,6 +288,36 @@ def main():
             finally:
                 del eng  # free HBM before the next configuration
     RESULT["value"] = round(best, 1)
+
+    # shared-system-prompt workload: prefix-cache ON vs OFF (docs/serving.md)
+    try:
+        if on_tpu:
+            batch_sp, shared_sp, tail_sp, gen_sp, meas_sp, q_sp = \
+                16, 448, 64, 128, 20.0, 8
+            bs_sp = 32
+        else:
+            batch_sp, shared_sp, tail_sp, gen_sp, meas_sp, q_sp = \
+                4, 64, 16, 8, 5.0, 1
+            bs_sp = 16
+
+        def build_sp(prefix_on):
+            nb = (batch_sp + 1) * ((shared_sp + tail_sp + gen_sp) // bs_sp
+                                   + 3) + 8
+            return build_engine_v2(
+                llama, mcfg, llama.init(mcfg, jax.random.PRNGKey(0)),
+                config={"dtype": "bfloat16",
+                        "prefill_bucket": min(64, shared_sp),
+                        "prefix_cache": {"enabled": prefix_on},
+                        "ragged": {"max_tracked_sequences": batch_sp,
+                                   "max_ragged_batch_size": batch_sp,
+                                   "memory_config_blocks": nb,
+                                   "block_size": bs_sp}})
+
+        RESULT["detail"]["shared_prefix"] = run_shared_prefix(
+            build_sp, sp, mcfg.vocab_size, rng, batch_sp, shared_sp, tail_sp,
+            gen_sp, meas_sp, quantum=q_sp)
+    except Exception as e:
+        RESULT["detail"]["shared_prefix"] = f"error: {str(e)[-200:]}"
 
     # head-of-line probe: long-prompt admission stall, split vs one-shot
     try:

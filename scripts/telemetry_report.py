@@ -134,6 +134,42 @@ def reliability(events: List[dict]) -> str:
     return "\n".join(lines)
 
 
+def serving(events: List[dict]) -> str:
+    """``--serving``: prefix-cache hit-rate, prefill tokens saved, retained-
+    pool occupancy and evictions from the ``Serving/prefix_cache/*`` stream
+    (paged serving engine — docs/serving.md). These series carry CUMULATIVE
+    counter values (gauges for occupancy), so the last sample per series is
+    the run total — unlike ``--reliability``'s one-line-per-occurrence."""
+    srv = [e for e in events if e["name"].startswith("Serving/prefix_cache/")]
+    if not srv:
+        return "serving: no Serving/prefix_cache/* events in this file"
+    last: Dict[str, float] = {}
+    last_step: Dict[str, int] = {}
+    for e in srv:
+        key = e["name"][len("Serving/prefix_cache/"):]
+        last[key] = e["value"]                       # cumulative: last wins
+        last_step[key] = max(last_step.get(key, 0), int(e.get("step", 0)))
+    lines = [f"serving prefix-cache report ({len(srv)} events)"]
+    lines.append(f"  {'counter':<24} {'total':>14} {'last step':>10}")
+    for key in sorted(last):
+        lines.append(f"  {key:<24} {last[key]:>14,.0f} {last_step[key]:>10}")
+    lines.append("")
+    lookups = last.get("lookups", 0.0)
+    hits = last.get("hits", 0.0)
+    lines.append(f"  admissions (lookups):   {lookups:,.0f}")
+    lines.append(f"  prefix hits:            {hits:,.0f}")
+    lines.append(f"  hit rate:               "
+                 f"{hits / lookups * 100 if lookups else 0.0:.1f}%")
+    lines.append(f"  hit tokens:             {last.get('hit_tokens', 0):,.0f}")
+    lines.append(f"  prefill tokens saved:   "
+                 f"{last.get('prefill_tokens_saved', 0):,.0f}")
+    lines.append(f"  copy-on-write copies:   {last.get('cow_copies', 0):,.0f}")
+    lines.append(f"  evictions:              {last.get('evictions', 0):,.0f}")
+    lines.append(f"  retained blocks (now):  "
+                 f"{last.get('retained_blocks', 0):,.0f}")
+    return "\n".join(lines)
+
+
 def summarize(events: List[dict], last: int = 0) -> str:
     if last > 0:
         steps = sorted({e.get("step", 0) for e in events})[-last:]
@@ -206,6 +242,10 @@ def main(argv=None) -> int:
                     help="summarize Reliability/* events: skipped steps, "
                          "watchdog trips, checkpoint save/restore/rollback "
                          "counts")
+    ap.add_argument("--serving", action="store_true",
+                    help="summarize Serving/prefix_cache/* counters: "
+                         "hit-rate, prefill tokens saved, retained-pool "
+                         "occupancy, evictions")
     args = ap.parse_args(argv)
     try:
         events = load_events(args.path)
@@ -220,6 +260,9 @@ def main(argv=None) -> int:
         return 0
     if args.reliability:
         print(reliability(events))
+        return 0
+    if args.serving:
+        print(serving(events))
         return 0
     print(summarize(events, last=args.last))
     return 0

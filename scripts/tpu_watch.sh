@@ -106,8 +106,11 @@ while true; do
     # the three ZERO-evidence round-5 targets capture before the headline
     # (which already has a credible r4 TPU capture) — a short window must
     # prove serving/longctx/MoE first; each probe checks for a mid-cycle
-    # HOLD so an interactive session waits at most one probe
-    hold_requested || run_probe SERVING scripts/serving_bench.py 1800 SERVING_TPU_LIVE.json
+    # HOLD so an interactive session waits at most one probe.
+    # SERVING now also runs the shared-system-prompt prefix-cache workload
+    # (detail.shared_prefix: cache ON vs OFF tok/s + prefill_tokens_saved),
+    # so its budget covers two extra engine builds + measure windows
+    hold_requested || run_probe SERVING scripts/serving_bench.py 2400 SERVING_TPU_LIVE.json
     hold_requested || run_probe LONGCTX scripts/longctx_bench.py 2400 LONGCTX_TPU_LIVE.json
     hold_requested || run_probe MOE scripts/moe_dispatch_bench.py 1200 MOE_TPU_LIVE.json
     # full headline bench incl. shape rows (first compiles are slow)
