@@ -120,11 +120,24 @@ class PreemptionGuard:
         for s in signals:
             self._prev[s] = _signal.signal(s, self._on_signal)
 
+    @staticmethod
+    def _dump_traces(reason: str) -> None:
+        """Preemption may be the last thing this process does — land every
+        live flight recorder NOW (telemetry/trace.py), not at the step
+        boundary the grace window might not reach. Best-effort."""
+        try:
+            from ..telemetry.trace import dump_all
+
+            dump_all(reason)
+        except Exception:
+            pass
+
     def _on_signal(self, signum, frame):
         self._triggered = True
         self._signum = signum
         log_dist(f"PreemptionGuard: received signal {signum} — will "
                  f"checkpoint at the next step boundary")
+        self._dump_traces("preemption_signal")
         prev = self._prev.get(signum)
         if callable(prev):  # chain whatever handler was there before
             prev(signum, frame)
@@ -138,6 +151,7 @@ class PreemptionGuard:
         log_dist(f"PreemptionGuard: synthetic preemption"
                  f"{f' (signal {signum})' if signum is not None else ''} — "
                  f"will checkpoint at the next step boundary")
+        self._dump_traces("preemption_synthetic")
 
     @property
     def triggered(self) -> bool:

@@ -12,6 +12,8 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Any, Dict, Optional
 
+from ..telemetry.trace import TraceConfig
+
 
 @dataclass
 class TPConfig:
@@ -77,6 +79,9 @@ class InferenceConfig:
     ragged: RaggedConfig = field(default_factory=RaggedConfig)
     quant: QuantConfig = field(default_factory=QuantConfig)
     prefix_cache: PrefixCacheConfig = field(default_factory=PrefixCacheConfig)
+    # request-lifecycle tracing + latency SLO stats (telemetry/trace.py;
+    # docs/serving.md). Default OFF → the serving path records nothing.
+    trace: TraceConfig = field(default_factory=TraceConfig)
 
     @classmethod
     def from_dict(cls, d: Optional[Dict[str, Any]]) -> "InferenceConfig":
@@ -87,7 +92,9 @@ class InferenceConfig:
         ragged = d.pop("ragged", {})
         quant = d.pop("quant", {})
         prefix = d.pop("prefix_cache", {})
+        trace = d.pop("trace", {})
         known = {k: v for k, v in d.items() if k in cls.__dataclass_fields__}
         return cls(tensor_parallel=TPConfig(**tp), ragged=RaggedConfig(**ragged),
                    quant=QuantConfig(**quant),
-                   prefix_cache=PrefixCacheConfig(**prefix), **known)
+                   prefix_cache=PrefixCacheConfig(**prefix),
+                   trace=TraceConfig(**trace), **known)

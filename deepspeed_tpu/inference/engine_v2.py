@@ -11,6 +11,7 @@ GEMMs. Prefill runs per-sequence at bucketed lengths (one compile per bucket).
 
 from __future__ import annotations
 
+import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
@@ -18,6 +19,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..comm.mesh import MeshManager
+from ..telemetry.trace import Tracer, percentiles
 from ..utils.logging import log_dist
 from .config import InferenceConfig
 from .engine import InferenceEngine, ModelFamily, _round_up
@@ -66,9 +68,111 @@ class InferenceEngineV2(InferenceEngine):
         self._slot_sp: List[SamplingParams] = [SamplingParams(greedy=True)] * B
         # uid → (full prompt, SamplingParams from put_split)
         self._pending_prefill: Dict[int, Tuple] = {}
+        # --- request-lifecycle tracing + latency SLO stats (trace.py;
+        # docs/serving.md). A hub with an ENABLED tracer shares its flight
+        # recorder (serving spans land next to training/checkpoint spans);
+        # otherwise the engine's own config.trace block governs. Default
+        # OFF: every hook below is a no-op and no timer ever starts.
+        hub_tracer = getattr(telemetry_hub, "tracer", None)
+        if hub_tracer is not None and hub_tracer.enabled:
+            self.tracer = hub_tracer
+        else:
+            self.tracer = Tracer(getattr(self.config, "trace", None),
+                                 name="serving")
+        self._trace_on = self.tracer.enabled
+        self._req: Dict[int, dict] = {}   # uid → open lifecycle record
+        self._lat: Dict[str, List[float]] = {
+            "ttft_ms": [], "itl_ms": [], "queue_ms": [], "e2e_ms": []}
         log_dist(f"InferenceEngineV2: {rc.memory_config_blocks} blocks × "
                  f"{rc.block_size} tokens, {B} sequence slots, "
-                 f"prefix_cache={'on' if pc.enabled else 'off'}")
+                 f"prefix_cache={'on' if pc.enabled else 'off'}, "
+                 f"trace={'on' if self._trace_on else 'off'}")
+
+    # ------------------------------------------------------------------ #
+    # request-lifecycle accounting: admit → queue-wait → prefill (chunks) →
+    # per-decode-token → finish. Each request is one trace id; TTFT, ITL,
+    # queue time, and e2e latency accumulate for the SLO percentiles.
+    # ------------------------------------------------------------------ #
+    def _req_admit(self, uid: int, prompt_len: int,
+                   split: bool = False) -> None:
+        if not self._trace_on or uid in self._req:
+            return
+        now = time.monotonic_ns()
+        tid = self.tracer.new_trace(label=f"request:{uid}")
+        span = self.tracer.begin("request", cat="serving", trace=tid,
+                                 uid=uid, prompt_tokens=prompt_len,
+                                 split=split)
+        queue = self.tracer.begin("queue_wait", cat="serving", trace=tid,
+                                  parent=span.span_id, uid=uid)
+        self._req[uid] = {"trace": tid, "span": span, "queue": queue,
+                          "t_admit": now, "last_ns": None,
+                          "first_done": False}
+
+    def _req_compute_begin(self, uid: int) -> None:
+        """First compute dispatched for this request — queue-wait ends."""
+        rec = self._req.get(uid)
+        if rec is None or rec["queue"] is None:
+            return
+        rec["queue"].end()
+        rec["queue"] = None
+        self._lat["queue_ms"].append(
+            (time.monotonic_ns() - rec["t_admit"]) / 1e6)
+
+    def _req_first_token(self, uid: int, t_ns: int) -> None:
+        rec = self._req.get(uid)
+        if rec is None or rec["first_done"]:
+            return
+        if rec["queue"] is not None:   # fork children never prefill
+            rec["queue"].end()
+            rec["queue"] = None
+            self._lat["queue_ms"].append((t_ns - rec["t_admit"]) / 1e6)
+        rec["first_done"] = True
+        rec["last_ns"] = t_ns
+        self._lat["ttft_ms"].append((t_ns - rec["t_admit"]) / 1e6)
+        self.tracer.instant("first_token", cat="serving", trace=rec["trace"],
+                            parent=rec["span"].span_id, ts_ns=t_ns, uid=uid)
+
+    def _req_tokens(self, uid: int, k: int, t_ns: int) -> None:
+        """``k`` decode tokens for ``uid`` landed at ``t_ns`` (one fused
+        quantum): ITL per token = elapsed / k; per-token instants are
+        interpolated across the quantum."""
+        rec = self._req.get(uid)
+        if rec is None or k <= 0:
+            return
+        start = rec["last_ns"] if rec["last_ns"] is not None \
+            else rec["t_admit"]
+        per = (t_ns - start) / k
+        i0 = 0
+        if not rec["first_done"]:
+            self._req_first_token(uid, int(start + per))
+            i0 = 1
+        for i in range(i0, k):
+            self._lat["itl_ms"].append(per / 1e6)
+            self.tracer.instant("decode_token", cat="serving",
+                                trace=rec["trace"],
+                                parent=rec["span"].span_id,
+                                ts_ns=int(start + per * (i + 1)), uid=uid)
+        rec["last_ns"] = t_ns
+
+    def _req_finish(self, uid: int, **args) -> None:
+        rec = self._req.pop(uid, None)
+        if rec is None:
+            return
+        if rec["queue"] is not None:
+            rec["queue"].end()
+        self._lat["e2e_ms"].append(
+            (time.monotonic_ns() - rec["t_admit"]) / 1e6)
+        rec["span"].end(**args)
+
+    def _req_drop(self, uid: int) -> None:
+        """Admission rolled back — close the spans without latency samples
+        (a cancelled request is not an SLO data point)."""
+        rec = self._req.pop(uid, None)
+        if rec is None:
+            return
+        if rec["queue"] is not None:
+            rec["queue"].end()
+        rec["span"].end(cancelled=True)
 
     # ------------------------------------------------------------------ #
     def _prefill_fn(self, pad_t: int, sp: SamplingParams, n: int = 1):
@@ -278,17 +382,24 @@ class InferenceEngineV2(InferenceEngine):
         padded[0, :len(chunk)] = chunk
         table = self.state.block_table(desc)
         fn = self._chunk_prefill_fn(chunk_tokens, sp, final)
+        if self._trace_on:
+            self._req_compute_begin(uid)   # first chunk ends queue-wait
+            t0 = time.monotonic_ns()
         args = (self.params, self.cache, jnp.asarray(padded),
                 jnp.asarray(len(chunk), jnp.int32),
                 jnp.asarray(done, jnp.int32), jnp.asarray(table),
                 jax.random.PRNGKey(seed), jnp.asarray(uid, jnp.int32))
         if not final:
             self.cache = fn(*args)
+            if self._trace_on:
+                self._trace_chunk(uid, t0, len(chunk), done, final=False)
             desc.seen_tokens = done + len(chunk)
             self.state.mark_filled(desc)  # completed chunks become matchable
             return {}
         tok, self.cache = fn(*args)
         tok = int(tok)
+        if self._trace_on:
+            self._trace_chunk(uid, t0, len(chunk), done, final=True)
         del self._pending_prefill[uid]
         desc.seen_tokens = len(prompt)
         self.state.mark_filled(desc)
@@ -302,6 +413,18 @@ class InferenceEngineV2(InferenceEngine):
         self._slot_active[s] = True
         self._slot_sp[s] = self._canon_sp(sp)
         return {uid: tok}
+
+    def _trace_chunk(self, uid: int, t0_ns: int, tokens: int, ctx: int,
+                     final: bool) -> None:
+        t1 = time.monotonic_ns()
+        rec = self._req.get(uid)
+        self.tracer.complete(
+            "prefill_chunk", t0_ns, t1, cat="serving",
+            trace=rec["trace"] if rec else None,
+            parent=rec["span"].span_id if rec else None,
+            uid=uid, tokens=tokens, ctx=ctx, final=final)
+        if final:
+            self._req_first_token(uid, t1)
 
     def put_split(self, uid: int, prompt_tokens,
                   sp: SamplingParams = SamplingParams(greedy=True)) -> None:
@@ -317,6 +440,7 @@ class InferenceEngineV2(InferenceEngine):
         a mostly-cached long prompt may need only one chunk."""
         prompt = np.asarray(prompt_tokens, np.int32)
         desc, cached = self.state.admit_prompt(uid, prompt)
+        self._req_admit(uid, len(prompt), split=True)
         desc.seen_tokens = cached   # chunk loop starts after the cached hit
         desc.prefilling = True
         self._pending_prefill[uid] = (prompt, sp)
@@ -444,9 +568,11 @@ class InferenceEngineV2(InferenceEngine):
                 desc, hit = self.state.admit_prompt(uid, prompt)
                 entries.append((uid, prompt, desc))
                 cached.append(hit)
+                self._req_admit(uid, len(prompt))
         except Exception:
             for uid, _, _ in entries:
                 self.state.retire(uid)
+                self._req_drop(uid)
             raise
         return self._prefill_admitted(entries, [sp] * len(entries), seed,
                                       cached=cached)
@@ -488,6 +614,11 @@ class InferenceEngineV2(InferenceEngine):
             uids_arr[i] = uid
             tables[i] = self.state.block_table(desc)
         with_ctx = any(cached)
+        if self._trace_on:
+            for uid, prompt, _ in entries:
+                self._req_admit(uid, len(prompt))  # generate() admits direct
+                self._req_compute_begin(uid)
+            t0 = time.monotonic_ns()
         base = (self.params, self.cache, jnp.asarray(padded),
                 jnp.asarray(lengths), jnp.asarray(tables))
         if with_ctx:
@@ -505,6 +636,10 @@ class InferenceEngineV2(InferenceEngine):
             toks, self.cache = fn(*base, *map(jnp.asarray,
                                               sp_arrays(pad_sps)))
         toks = np.asarray(toks)
+        if self._trace_on:
+            t1 = time.monotonic_ns()
+            self.tracer.complete("prefill_batch", t0, t1, cat="serving",
+                                 n=n, pad_t=pad_t)
         out: Dict[int, int] = {}
         for i, (uid, prompt, desc) in enumerate(entries):
             tok = int(toks[i])
@@ -519,6 +654,14 @@ class InferenceEngineV2(InferenceEngine):
             self._slot_active[s] = True
             self._slot_sp[s] = sps[i]
             out[uid] = tok
+            if self._trace_on:
+                rec = self._req.get(uid)
+                if rec is not None:
+                    self.tracer.complete(
+                        "prefill", t0, t1, cat="serving", trace=rec["trace"],
+                        parent=rec["span"].span_id, uid=uid,
+                        tokens=int(lengths[i]), cached=int(ctx[i]))
+                self._req_first_token(uid, t1)
         return out
 
     def step(self, sp: SamplingParams = SamplingParams(greedy=True),
@@ -552,6 +695,8 @@ class InferenceEngineV2(InferenceEngine):
             self.state.extend(d)
             self._slot_tables[d.slot] = self.state.block_table(d)
         self._copy_blocks(cow)
+        if self._trace_on:
+            t0 = time.monotonic_ns()
         base = (self.params, self.cache, jnp.asarray(self._slot_tokens),
                 jnp.asarray(self._slot_lens), jnp.asarray(self._slot_tables),
                 jnp.asarray(self._slot_active), jax.random.PRNGKey(seed))
@@ -562,6 +707,10 @@ class InferenceEngineV2(InferenceEngine):
             nxt, self.cache = self._decode_fn(
                 SamplingParams(greedy=True))(*base)
         nxt = np.asarray(nxt)
+        if self._trace_on:
+            t1 = time.monotonic_ns()
+            self.tracer.complete("decode_step", t0, t1, cat="serving",
+                                 batch=len(live))
         for d in live:
             tok = int(nxt[d.slot])
             d.tokens.append(d.last_token)  # the id whose KV this step wrote
@@ -572,6 +721,8 @@ class InferenceEngineV2(InferenceEngine):
             self._slot_lens[d.slot] = d.seen_tokens
             self.state.mark_filled(d)
             out[d.uid] = tok
+            if self._trace_on:
+                self._req_tokens(d.uid, 1, t1)
         return out
 
     def step_many(self, k: int, sp: SamplingParams = SamplingParams(greedy=True),
@@ -608,6 +759,8 @@ class InferenceEngineV2(InferenceEngine):
             self.state.extend(d, n=k)  # reserve ALL k tokens up front
             self._slot_tables[d.slot] = self.state.block_table(d)
         self._copy_blocks(cow)
+        if self._trace_on:
+            t0 = time.monotonic_ns()
         base = (self.params, self.cache, jnp.asarray(self._slot_tokens),
                 jnp.asarray(self._slot_lens), jnp.asarray(self._slot_tables),
                 jnp.asarray(self._slot_active), jax.random.PRNGKey(seed))
@@ -618,6 +771,10 @@ class InferenceEngineV2(InferenceEngine):
             toks, lens, self.cache = self._decode_many_fn(
                 k, SamplingParams(greedy=True))(*base)
         toks = np.asarray(toks)          # [k, B] — the ONLY host sync
+        if self._trace_on:
+            t1 = time.monotonic_ns()
+            self.tracer.complete("decode_quantum", t0, t1, cat="serving",
+                                 k=k, batch=len(live))
         for d in live:
             seq = [int(t) for t in toks[:, d.slot]]
             # KV writes this quantum: the previous last_token, then each
@@ -630,11 +787,14 @@ class InferenceEngineV2(InferenceEngine):
             self._slot_lens[d.slot] = d.seen_tokens
             self.state.mark_filled(d)
             out[d.uid] = seq
+            if self._trace_on:
+                self._req_tokens(d.uid, k, t1)
         return out
 
     def finish(self, uid: int) -> List[int]:
         """Retire a sequence, free its blocks, return generated tokens."""
         desc = self.state.seqs[uid]
+        self._req_finish(uid, generated=len(desc.generated))
         self._pending_prefill.pop(uid, None)  # cancel an in-flight split
         self._slot_active[desc.slot] = False
         self._slot_lens[desc.slot] = 0
@@ -652,6 +812,7 @@ class InferenceEngineV2(InferenceEngine):
         child starts with an empty ``generated`` list and, unless ``sp`` is
         given, the parent's sampling params."""
         desc = self.state.fork(uid, new_uid)
+        self._req_admit(new_uid, desc.seen_tokens)
         s, parent_slot = desc.slot, self.state.seqs[uid].slot
         self._slot_tokens[s] = desc.last_token
         self._slot_lens[s] = desc.seen_tokens
@@ -678,6 +839,41 @@ class InferenceEngineV2(InferenceEngine):
             for name, value, s in events:
                 self._hub.serving_event(name, value, s)
         return events
+
+    # ------------------------------------------------------------------ #
+    # latency SLOs: TTFT / inter-token latency / queue time / e2e, with
+    # p50/p90/p99 (docs/serving.md). Samples accumulate while tracing is on.
+    # ------------------------------------------------------------------ #
+    def latency_summary(self) -> Dict[str, Dict[str, float]]:
+        """{metric: {"p50", "p90", "p99", "mean", "count"}} in ms."""
+        out: Dict[str, Dict[str, float]] = {}
+        for metric, vals in self._lat.items():
+            stats = percentiles(vals, (50, 90, 99))
+            stats["count"] = float(len(vals))
+            stats["mean"] = (sum(vals) / len(vals)) if vals else 0.0
+            out[metric] = stats
+        return out
+
+    def latency_events(self, step: int = 0):
+        """``Serving/latency/*`` telemetry events (gauges: last sample wins,
+        like the prefix-cache counters)."""
+        events = []
+        for metric, stats in sorted(self.latency_summary().items()):
+            for key in ("p50", "p90", "p99", "count"):
+                events.append((f"Serving/latency/{metric}_{key}",
+                               float(stats[key]), step))
+        return events
+
+    def publish_latency_telemetry(self, step: int = 0):
+        events = self.latency_events(step)
+        if self._hub is not None:
+            for name, value, s in events:
+                self._hub.serving_event(name, value, s)
+        return events
+
+    def export_trace(self, path: str):
+        """Dump the flight recorder as Chrome-trace/Perfetto JSON."""
+        return self.tracer.export(path)
 
     # ------------------------------------------------------------------ #
     def generate(self, prompts, max_new_tokens: int = 64,
@@ -770,6 +966,10 @@ class InferenceEngineV2(InferenceEngine):
                         d.seen_tokens >= self.family.cfg.max_seq_len:
                     d.generated = d.generated[:max_new_tokens]
                     results[uid] = self.finish(uid)
+        if self._trace_on:
+            # a hub-attached run lands its SLO percentiles in the monitor
+            # stream for telemetry_report.py --latency; trace off → no events
+            self.publish_latency_telemetry(step_i)
         return [results[i] for i in range(len(prompts))]
 
 

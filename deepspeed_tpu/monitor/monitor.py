@@ -239,6 +239,10 @@ class JSONLMonitor(MonitorBackend):
         for name, value, step in events:
             self._f.write(json.dumps({"name": name, "value": float(value),
                                       "step": int(step), "ts": now}) + "\n")
+        # flush per batch, not only on close(): a crash/SIGKILL between
+        # steps must not lose the tail of the step log (the flight-recorder
+        # dump and the JSONL stream are the two post-mortem artifacts)
+        self._f.flush()
 
     def flush(self) -> None:
         if self._f:

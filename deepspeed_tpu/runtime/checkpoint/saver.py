@@ -35,6 +35,7 @@ from typing import Any, Dict, Optional
 import jax
 import numpy as np
 
+from ...telemetry.trace import NULL_TRACER
 from ...utils.logging import log_dist, logger
 from .engines import (CheckpointEngine, FastCheckpointEngine,
                       SyncCheckpointEngine, get_checkpoint_engine)
@@ -69,6 +70,13 @@ def _reliability(engine, name: str, value: float = 1.0,
         tel.reliability_event(
             name, value, step if step is not None
             else int(getattr(engine, "global_steps", 0)))
+
+
+def _tracer(engine):
+    """The engine's span tracer (flight recorder) — NULL_TRACER on bare/test
+    engines so checkpoint spans are an unconditional one-liner."""
+    tr = getattr(getattr(engine, "telemetry", None), "tracer", None)
+    return tr if tr is not None else NULL_TRACER
 
 
 def resolve_tag(load_dir: str, tag: Optional[str],
@@ -187,22 +195,31 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
         done["durable"] = True
         if not rank0:
             return
-        with _finalize_lock(save_dir):
-            if atomic and not done["published"]:
-                fsync_tree(stage)
-                write_manifest(stage)
-                publish_dir(stage, final_path)
-            done["published"] = True
-            prev = _LATEST_STEPS.get(save_dir)
-            if prev is None or step_at_save >= prev:
-                write_latest(save_dir, tag)
-                _LATEST_STEPS[save_dir] = step_at_save
-            else:
-                logger.warning(
-                    f"checkpoint '{tag}' (step {step_at_save}) finalized "
-                    f"after a newer save (step {prev}) — leaving 'latest' "
-                    f"on the newer tag")
-            removed = retention_sweep(save_dir, keep_last_n, protect=(tag,))
+        # publish span may run in an async writer thread — begin/end handle
+        # (the tracer ring is thread-safe); a crash mid-publish leaves only
+        # the save span in the flight recorder, which is the truth
+        span = _tracer(engine).begin("checkpoint/publish", cat="checkpoint",
+                                     tag=tag, atomic=atomic)
+        try:
+            with _finalize_lock(save_dir):
+                if atomic and not done["published"]:
+                    fsync_tree(stage)
+                    write_manifest(stage)
+                    publish_dir(stage, final_path)
+                done["published"] = True
+                prev = _LATEST_STEPS.get(save_dir)
+                if prev is None or step_at_save >= prev:
+                    write_latest(save_dir, tag)
+                    _LATEST_STEPS[save_dir] = step_at_save
+                else:
+                    logger.warning(
+                        f"checkpoint '{tag}' (step {step_at_save}) finalized "
+                        f"after a newer save (step {prev}) — leaving 'latest' "
+                        f"on the newer tag")
+                removed = retention_sweep(save_dir, keep_last_n,
+                                          protect=(tag,))
+        finally:
+            span.end()
         if removed:
             _reliability(engine, "checkpoint_gc", value=removed,
                          step=step_at_save)
@@ -219,7 +236,10 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
             # ce.save would re-stage over the already-published tag)
             _finalize()
             return
-        ce.save(state_dict, state_path, on_durable=_finalize)
+        with _tracer(engine).span("checkpoint/save", cat="checkpoint",
+                                  tag=tag, engine=ce.name,
+                                  step=step_at_save):
+            ce.save(state_dict, state_path, on_durable=_finalize)
         if retries or multihost:
             # retries: the policy needs to OBSERVE failures; multihost: the
             # seal barrier in the writer thread must not interleave with

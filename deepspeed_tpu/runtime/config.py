@@ -237,6 +237,28 @@ class ProfilerConfig(ConfigModel):
 
 @register_config_model
 @dataclass
+class TraceTelemetryConfig(ConfigModel):
+    """``telemetry.trace`` block — span tracer + crash flight recorder
+    (``telemetry/trace.py``; docs/observability.md). Default OFF: the step
+    and serving paths record nothing and start no timers."""
+    enabled: bool = False
+    ring_size: int = 4096       # flight-recorder capacity (events retained)
+    export_path: str = ""       # "" → <tmpdir>/dstpu_trace/flight_<pid>.json
+    dump_on_crash: bool = True  # auto-dump on watchdog/fault/preempt/atexit
+
+
+@register_config_model
+@dataclass
+class TelemetryConfig(ConfigModel):
+    """Top-level ``telemetry`` block (currently just the trace sub-block;
+    the older observability gates — ``wall_clock_breakdown``,
+    ``comms_logger``, ``profiler`` — stay where reference configs put
+    them)."""
+    trace: TraceTelemetryConfig = field(default_factory=TraceTelemetryConfig)
+
+
+@register_config_model
+@dataclass
 class MonitorBackendConfig(ConfigModel):
     enabled: bool = False
     output_path: str = ""
@@ -368,6 +390,7 @@ class DeepSpeedTPUConfig:
     jsonl_monitor: MonitorBackendConfig = field(default_factory=MonitorBackendConfig)
     checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
     watchdog: WatchdogConfig = field(default_factory=WatchdogConfig)
+    telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
     aio: AIOConfig = field(default_factory=AIOConfig)
 
     gradient_clipping: float = 0.0
@@ -443,6 +466,7 @@ _SUBCONFIG_KEYS = {
     "jsonl_monitor": MonitorBackendConfig,
     "checkpoint": CheckpointConfig,
     "watchdog": WatchdogConfig,
+    "telemetry": TelemetryConfig,
     "aio": AIOConfig,
 }
 

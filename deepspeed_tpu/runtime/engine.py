@@ -473,8 +473,10 @@ class DeepSpeedTPUEngine:
         batch = self._shard_batch(batch, with_gas_dim=True)
         if breakdown:
             self.timers(BACKWARD_GLOBAL_TIMER).start(sync=True)
-        grads, loss, aux = self._nvme_grad_step(self.state.params, batch,
-                                                self.state.loss_scale)
+        with self.telemetry.tracer.span("train/bwd", cat="train",
+                                        step=self.global_steps + 1):
+            grads, loss, aux = self._nvme_grad_step(self.state.params, batch,
+                                                    self.state.loss_scale)
         if breakdown:
             self.timers(BACKWARD_GLOBAL_TIMER).stop(sync=True)
             self.timers(STEP_GLOBAL_TIMER).start()
@@ -1081,11 +1083,22 @@ class DeepSpeedTPUEngine:
             lambda x: P(None, manual) if gas > 1 else P(manual), batch)
         res_specs = tuple(P(manual) for _ in loco_idx)
 
+        # bucket-flush spans fire at TRACE time (collectives are compile-time
+        # constructs on TPU — one record describes every execution of the
+        # compiled step, like the comms logger's per-trace records)
+        _hub = getattr(self, "telemetry", None)
+        tracer = _hub.tracer if _hub is not None else None
+
         def reduce_all(gleaves, res_leaves):
             """One full explicit reduction of the (local) grad leaves."""
             red: List[Any] = [None] * len(gleaves)
             new_res = list(res_leaves)
             for bucket in buckets:
+                if tracer is not None and tracer.enabled:
+                    tracer.instant(
+                        "overlap/bucket_flush", cat="comm", trace_time=True,
+                        leaves=len(bucket), deferred=deferred, repeats=reps,
+                        bytes=int(sum(gleaves[i].size for i in bucket)) * 4)
                 outs = ov.coalesced_reduce([gleaves[i] for i in bucket],
                                            manual, repeats=reps)
                 for i, o in zip(bucket, outs):
@@ -1293,16 +1306,17 @@ class DeepSpeedTPUEngine:
         if self._bwd_step is None:
             self._build_breakdown_steps()
         t = self.timers
-        with _annotate("fwd"):
+        tracer = self.telemetry.tracer
+        with _annotate("fwd"), tracer.span("train/fwd", cat="train"):
             t(FORWARD_GLOBAL_TIMER).start(sync=True)
             self._fwd_step(self.state.params, batch)
             t(FORWARD_GLOBAL_TIMER).stop(sync=True)
-        with _annotate("bwd"):
+        with _annotate("bwd"), tracer.span("train/bwd", cat="train"):
             t(BACKWARD_GLOBAL_TIMER).start()
             grads, loss, aux = self._bwd_step(self.state.params, batch,
                                               self.state.loss_scale)
             t(BACKWARD_GLOBAL_TIMER).stop(sync=True)
-        with _annotate("step"):
+        with _annotate("step"), tracer.span("train/step", cat="train"):
             t(STEP_GLOBAL_TIMER).start()
             self.state, out = self._apply_step(self.state, grads, loss,
                                                self._lr_override)
@@ -1384,11 +1398,17 @@ class DeepSpeedTPUEngine:
             self._estimate_step_flops(batch)
         if breakdown:
             self.timers(TRAIN_BATCH_TIMER).start()
-            out = self._train_batch_breakdown(batch)
+            with self.telemetry.tracer.span("train/train_batch", cat="train",
+                                            step=self.global_steps + 1):
+                out = self._train_batch_breakdown(batch)
             self.timers(TRAIN_BATCH_TIMER).stop(sync=False)
         else:
-            self.state, out = self._train_step(self.state, batch,
-                                               self._lr_override)
+            # the fused step is ONE XLA program — a single span (the phase
+            # split only exists under wall_clock_breakdown)
+            with self.telemetry.tracer.span("train/train_batch", cat="train",
+                                            step=self.global_steps + 1):
+                self.state, out = self._train_step(self.state, batch,
+                                                   self._lr_override)
         self.global_steps += 1
         self._last_grad_norm = out.grad_norm
         self.lr_scheduler.last_step = self.global_steps
@@ -1428,9 +1448,10 @@ class DeepSpeedTPUEngine:
         self._staged_batches.append(self._shard_batch(batch, with_gas_dim=False))
         if self.wall_clock_breakdown():
             self.timers(FORWARD_MICRO_TIMER).start(sync=True)
-        grads, loss, aux = self._grad_step(self.state.params,
-                                           self._staged_batches[-1],
-                                           self.state.loss_scale)
+        with self.telemetry.tracer.span("train/fwd_micro", cat="train"):
+            grads, loss, aux = self._grad_step(self.state.params,
+                                               self._staged_batches[-1],
+                                               self.state.loss_scale)
         if self.wall_clock_breakdown():
             self.timers(FORWARD_MICRO_TIMER).stop(sync=True)
         self._last_micro = (grads, loss)
@@ -1472,8 +1493,10 @@ class DeepSpeedTPUEngine:
         n = self._pending_count
         grads = jax.tree.map(lambda g: g / n, self._pending_grads)
         loss = self._pending_loss / n
-        self.state, out = self._apply_step(self.state, grads, loss,
-                                           self._lr_override)
+        with self.telemetry.tracer.span("train/step", cat="train",
+                                        step=self.global_steps + 1):
+            self.state, out = self._apply_step(self.state, grads, loss,
+                                               self._lr_override)
         self._pending_grads = None
         self._pending_loss = None
         self._pending_count = 0

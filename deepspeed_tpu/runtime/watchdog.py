@@ -158,6 +158,18 @@ class TrainingWatchdog:
     def _violate(self, engine, kind: str, step: int, msg: str) -> None:
         self.violations += 1
         self._emit(f"violation/{kind}", step)
+        # flight-recorder dump FIRST: whatever the on_violation policy does
+        # next (raise/restore/exit), the spans of the steps that led here
+        # are on disk for the post-mortem (telemetry/trace.py)
+        tel = self.telemetry
+        if tel is not None and hasattr(tel, "trace_dump"):
+            try:
+                path = tel.trace_dump(f"watchdog_{kind}")
+                if path:
+                    logger.warning(
+                        f"watchdog: flight-recorder trace dumped to {path}")
+            except Exception:
+                pass
         action = (self.cfg.on_violation or "raise").lower()
         if action == "warn":
             logger.warning(f"watchdog violation ({kind}): {msg}")

@@ -2,9 +2,13 @@
 
 See ``docs/observability.md`` for the config surface
 (``wall_clock_breakdown``, ``memory_breakdown``, ``comms_logger``,
-``profiler``, monitor backends incl. the JSONL sink).
+``profiler``, ``telemetry.trace``, monitor backends incl. the JSONL sink,
+and the pull-based Prometheus metrics endpoint).
 """
 
 from .hub import TelemetryHub  # noqa: F401
 from .memory import MemoryTelemetry  # noqa: F401
+from .metrics_server import MetricsServer  # noqa: F401
 from .profiler import ProfilerSession, annotate  # noqa: F401
+from .schema import validate_events, validate_jsonl_records  # noqa: F401
+from .trace import TraceConfig, Tracer, dump_all, percentiles  # noqa: F401

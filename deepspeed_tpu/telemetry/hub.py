@@ -34,6 +34,7 @@ from ..utils.timer import (BACKWARD_GLOBAL_TIMER, BACKWARD_MICRO_TIMER,
                            TRAIN_BATCH_TIMER, SynchronizedWallClockTimer)
 from .memory import MemoryTelemetry
 from .profiler import ProfilerSession
+from .trace import Tracer
 
 Event = Tuple[str, float, int]
 
@@ -68,6 +69,12 @@ class TelemetryHub:
                            prof_all=cl.prof_all, prof_ops=list(cl.prof_ops),
                            debug=cl.debug)
         self.comms = dist.get_telemetry()
+        # span tracer + crash flight recorder (telemetry/trace.py), gated by
+        # the telemetry.trace config block; default OFF → a shared null span
+        # and zero ring allocation beyond the deque itself
+        self.tracer = Tracer(
+            getattr(getattr(config, "telemetry", None), "trace", None),
+            name="train")
         # Reliability/* counters (checkpoint commits/rollbacks, watchdog
         # trips, preemptions) — counted on every rank for tests/reports,
         # written through the monitor on rank 0
@@ -101,6 +108,29 @@ class TelemetryHub:
             self.reliability_counts.get(name, 0) + 1
         if self.rank0 and self._monitor_on():
             self.monitor.write_events([(name, float(value), int(step))])
+
+    # ------------------------------------------------------------------ #
+    def trace_dump(self, reason: str) -> Optional[str]:
+        """Dump the flight recorder (watchdog violation, crash path);
+        returns the path written, or None when tracing is off/empty."""
+        if not self.tracer.enabled:
+            return None
+        return self.tracer.dump(reason)
+
+    def metrics_snapshot(self) -> List[Tuple[str, float, str]]:
+        """``(event_name, value, kind)`` rows for the pull-based metrics
+        endpoint (telemetry/metrics_server.py): Reliability/* occurrence
+        counts as counters, Serving/* values as gauges, plus the flight
+        recorder's occupancy."""
+        rows: List[Tuple[str, float, str]] = []
+        for name, count in sorted(self.reliability_counts.items()):
+            rows.append((name, float(count), "counter"))
+        for name, value in sorted(self.serving_values.items()):
+            rows.append((name, float(value), "gauge"))
+        if self.tracer.enabled:
+            rows.append(("Telemetry/trace/ring_events",
+                         float(len(self.tracer)), "gauge"))
+        return rows
 
     # ------------------------------------------------------------------ #
     @property
@@ -198,9 +228,10 @@ class TelemetryHub:
 
     # ------------------------------------------------------------------ #
     def close(self) -> None:
-        """Engine shutdown: stop any live trace session, flush + close the
-        monitor backends. Idempotent."""
+        """Engine shutdown: stop any live trace session, final-dump + close
+        the span tracer, flush + close the monitor backends. Idempotent."""
         self.profiler.close()
+        self.tracer.close()
         if self.monitor is not None:
             try:
                 self.monitor.close()

@@ -1,0 +1,118 @@
+"""Pull-based metrics endpoint: Prometheus text format over stdlib HTTP.
+
+The monitor backends PUSH events to files/SDKs; external watchers (a
+``tpu_watch.sh``-style prober, a fleet dashboard, ``curl`` during an
+incident) want to PULL live state instead. :class:`MetricsServer` serves the
+TelemetryHub's counters and gauges — ``Reliability/*`` counts,
+``Serving/*`` gauges (prefix-cache counters, latency SLO percentiles), and
+the flight-recorder occupancy — as Prometheus exposition text on
+``GET /metrics``, plus a trivial ``GET /healthz``.
+
+stdlib-only (`http.server` on a daemon thread); binds 127.0.0.1 by default
+and ``port=0`` picks a free port (tests, multi-job hosts). Any object with a
+``metrics_snapshot() -> [(event_name, value, kind)]`` works as the source.
+"""
+
+from __future__ import annotations
+
+import http.server
+import re
+import threading
+from typing import List, Optional, Tuple
+
+__all__ = ["MetricsServer", "prometheus_name", "render_prometheus"]
+
+_SANITIZE = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def prometheus_name(event_name: str) -> str:
+    """``Serving/latency/ttft_ms_p50`` → ``dstpu_serving_latency_ttft_ms_p50``
+    (the hub's ``Group/.../metric`` names mapped onto the Prometheus
+    ``[a-zA-Z_][a-zA-Z0-9_]*`` grammar)."""
+    return "dstpu_" + _SANITIZE.sub("_", event_name).lower().strip("_")
+
+
+def render_prometheus(snapshot: List[Tuple[str, float, str]]) -> str:
+    """Prometheus text exposition (v0.0.4) from ``(name, value, kind)``
+    rows; kind is ``counter`` or ``gauge``."""
+    lines: List[str] = []
+    seen_type = set()
+    for name, value, kind in snapshot:
+        pname = prometheus_name(name)
+        if pname not in seen_type:
+            seen_type.add(pname)
+            lines.append(f"# HELP {pname} {name}")
+            lines.append(f"# TYPE {pname} "
+                         f"{'counter' if kind == 'counter' else 'gauge'}")
+        lines.append(f"{pname} {float(value):g}")
+    lines.append("")
+    return "\n".join(lines)
+
+
+class MetricsServer:
+    """Serve ``source.metrics_snapshot()`` on a background daemon thread.
+
+    >>> srv = MetricsServer(hub, port=0)
+    >>> port = srv.start()          # scrape http://127.0.0.1:<port>/metrics
+    >>> srv.stop()
+    """
+
+    def __init__(self, source, host: str = "127.0.0.1", port: int = 0):
+        self.source = source
+        self.host = host
+        self.port = port
+        self._httpd: Optional[http.server.ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------------ #
+    def render(self) -> str:
+        snap = self.source.metrics_snapshot() \
+            if hasattr(self.source, "metrics_snapshot") else []
+        return render_prometheus(list(snap))
+
+    def start(self) -> int:
+        """Bind and serve; returns the bound port (resolves ``port=0``)."""
+        if self._httpd is not None:
+            return self.port
+        outer = self
+
+        class _Handler(http.server.BaseHTTPRequestHandler):
+            server_version = "dstpu-metrics/1.0"
+
+            def do_GET(self):  # noqa: N802 (stdlib API name)
+                if self.path.split("?")[0] in ("/metrics", "/"):
+                    body = outer.render().encode()
+                    ctype = "text/plain; version=0.0.4; charset=utf-8"
+                elif self.path.split("?")[0] == "/healthz":
+                    body, ctype = b"ok\n", "text/plain"
+                else:
+                    self.send_error(404)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # scrapes must not spam the log
+                pass
+
+        self._httpd = http.server.ThreadingHTTPServer(
+            (self.host, self.port), _Handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="dstpu-metrics",
+            daemon=True)
+        self._thread.start()
+        return self.port
+
+    def stop(self) -> None:
+        if self._httpd is None:
+            return
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

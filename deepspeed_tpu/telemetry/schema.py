@@ -1,0 +1,77 @@
+"""Telemetry event-schema contract, checkable in CI.
+
+Every event the framework emits is a ``(name, value, step)`` triple whose
+name follows the ``Group/.../metric`` convention: a capitalized group
+(``Train``, ``Comm``, ``Memory``, ``Reliability``, ``Serving``,
+``Telemetry``), at least one more ``/``-separated segment, and a final
+metric segment. Consumers (``telemetry_report.py``, the Prometheus mapper,
+dashboards) key off this shape, so a malformed name is a silent data loss —
+:func:`validate_events` turns it into a tier-1 test failure instead.
+
+Checked invariants:
+
+- name matches ``^[A-Z][A-Za-z0-9_]*(/[A-Za-z0-9_.\\-]+)+$``;
+- value is a finite number;
+- step is a non-negative integer;
+- steps are monotonically NON-DECREASING per series (a series that jumps
+  backwards breaks every "last sample wins" consumer).
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from typing import Any, Dict, Iterable, List, Tuple
+
+__all__ = ["EVENT_NAME_RE", "validate_events", "validate_jsonl_records"]
+
+EVENT_NAME_RE = re.compile(r"^[A-Z][A-Za-z0-9_]*(/[A-Za-z0-9_.\-]+)+$")
+
+
+def validate_events(events: Iterable[Tuple[str, float, int]]) -> List[str]:
+    """Check ``(name, value, step)`` triples against the schema; returns a
+    list of human-readable problems (empty = clean)."""
+    problems: List[str] = []
+    last_step: Dict[str, int] = {}
+    for i, ev in enumerate(events):
+        try:
+            name, value, step = ev[0], ev[1], ev[2]
+        except (TypeError, IndexError):
+            problems.append(f"event #{i}: not a (name, value, step) triple: "
+                            f"{ev!r}")
+            continue
+        if not isinstance(name, str) or not EVENT_NAME_RE.match(name):
+            problems.append(f"event #{i}: name {name!r} violates the "
+                            f"Group/.../metric convention")
+            continue
+        try:
+            v = float(value)
+        except (TypeError, ValueError):
+            problems.append(f"event #{i} ({name}): non-numeric value "
+                            f"{value!r}")
+            continue
+        if not math.isfinite(v):
+            problems.append(f"event #{i} ({name}): non-finite value {v!r}")
+        if not isinstance(step, int) or isinstance(step, bool) or step < 0:
+            problems.append(f"event #{i} ({name}): step {step!r} is not a "
+                            f"non-negative int")
+            continue
+        prev = last_step.get(name)
+        if prev is not None and step < prev:
+            problems.append(f"event #{i} ({name}): step {step} < previous "
+                            f"step {prev} (series must be monotonic)")
+        last_step[name] = step
+    return problems
+
+
+def validate_jsonl_records(records: Iterable[Dict[str, Any]]) -> List[str]:
+    """Schema-check JSONL monitor records (``{"name","value","step","ts"}``,
+    as loaded by ``telemetry_report.load_events``)."""
+    triples = []
+    problems: List[str] = []
+    for i, r in enumerate(records):
+        if not isinstance(r, dict) or "name" not in r or "value" not in r:
+            problems.append(f"record #{i}: not an event object: {r!r}")
+            continue
+        triples.append((r.get("name"), r.get("value"), r.get("step", 0)))
+    return problems + validate_events(triples)

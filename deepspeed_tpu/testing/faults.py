@@ -41,6 +41,19 @@ class SimulatedCrash(BaseException):
     may swallow it — exactly like a real SIGKILL."""
 
 
+def _dump_flight_recorders(reason: str) -> None:
+    """Injected crashes dump every live flight recorder before the process
+    'dies' — the same trace artifact a real crash leaves behind (see
+    ``telemetry/trace.py``). Best-effort: tracing must never change what a
+    fault test observes."""
+    try:
+        from ..telemetry.trace import dump_all
+
+        dump_all(reason)
+    except Exception:
+        pass
+
+
 def _save_host(ce):
     """The object whose ``save`` actually touches disk: the inner engine for
     the decoupled/async wrapper, the engine itself otherwise."""
@@ -82,6 +95,7 @@ def crash_after_save(ce) -> Iterator[None]:
 
     def dying(tree, path, on_durable=None, **kw):
         orig(tree, path, **kw)
+        _dump_flight_recorders("fault_crash_after_save")
         raise SimulatedCrash(f"simulated crash after write of {path}")
 
     ce.save = dying
@@ -102,6 +116,7 @@ def truncated_write(ce, keep_bytes: int = 64,
     def torn(tree, path, on_durable=None, **kw):
         orig(tree, path, **kw)
         corrupt_file(path, keep_bytes=keep_bytes, filename=filename)
+        _dump_flight_recorders("fault_truncated_write")
         raise SimulatedCrash(f"simulated crash mid-write of {path}")
 
     ce.save = torn

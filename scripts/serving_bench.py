@@ -258,14 +258,18 @@ def main():
     rows = {}
     RESULT["detail"]["rows"] = rows
     best = 0.0
+    # DSTPU_SERVING_TRACE=<out.json>: run ONE configuration with the span
+    # tracer on and dump its flight recorder as a Perfetto/Chrome trace +
+    # latency SLO percentiles (tpu_watch.sh sets this so silicon rounds
+    # capture a trace artifact alongside the BENCH json)
+    trace_path = os.environ.get("DSTPU_SERVING_TRACE")
+    traced = False
     for batch in batches:
         for quantum in (1, 8):
             eng = None
             label = f"{batch}clients_q{quantum}"
             try:
-                eng = build_engine_v2(
-                    llama, mcfg, llama.init(mcfg, jax.random.PRNGKey(0)),
-                    config={"dtype": "bfloat16",
+                cfg_dict = {"dtype": "bfloat16",
                             "prefill_bucket": prompt_len,
                             "ragged": {
                                 "max_tracked_sequences": batch,
@@ -273,7 +277,14 @@ def main():
                                 "memory_config_blocks":
                                     batch * ((prompt_len + gen_len) // 32 + 3)
                                     + 8,
-                                "block_size": 32}})
+                                "block_size": 32}}
+                want_trace = bool(trace_path) and not traced
+                if want_trace:
+                    cfg_dict["trace"] = {"enabled": True, "ring_size": 16384,
+                                         "dump_on_crash": False}
+                eng = build_engine_v2(
+                    llama, mcfg, llama.init(mcfg, jax.random.PRNGKey(0)),
+                    config=cfg_dict)
                 tps, prefills, lat = run_closed_loop(
                     eng, sp, mcfg.vocab_size, batch, prompt_len, gen_len,
                     measure_s, rng, quantum=quantum)
@@ -281,6 +292,13 @@ def main():
                                "prefills_in_window": prefills,
                                "prompt_len": prompt_len, "gen_len": gen_len,
                                "token_latency": lat}
+                if want_trace:
+                    eng.export_trace(trace_path)
+                    rows[label]["latency_slo"] = {
+                        m: {k: round(v, 3) for k, v in s.items()}
+                        for m, s in eng.latency_summary().items()}
+                    RESULT["detail"]["trace_path"] = trace_path
+                    traced = True
                 best = max(best, tps)
                 sys.stderr.write(f"[serving] {label}: {rows[label]}\n")
             except Exception as e:

@@ -19,18 +19,31 @@ from typing import Dict, List
 
 
 def load_events(path: str) -> List[dict]:
+    """Load a JSONL telemetry file, tolerating the torn tail a crash or
+    SIGKILL leaves behind: an unparseable FINAL line is silently dropped
+    (that is what a mid-``write(2)`` kill looks like), unparseable lines
+    elsewhere are dropped with a stderr warning, and undecodable bytes never
+    abort the load. The surviving events still make a full report."""
     events = []
-    with open(path) as f:
-        for line in f:
+    bad: List[int] = []
+    n_lines = 0
+    with open(path, encoding="utf-8", errors="replace") as f:
+        for n_lines, line in enumerate(f, 1):
             line = line.strip()
             if not line:
                 continue
             try:
                 rec = json.loads(line)
             except ValueError:
-                continue  # torn final line from a killed run
+                bad.append(n_lines)
+                continue
             if isinstance(rec, dict) and "name" in rec and "value" in rec:
                 events.append(rec)
+    interior = [n for n in bad if n != n_lines]
+    if interior:
+        print(f"warning: skipped {len(interior)} unparseable interior "
+              f"line(s) in {path} (first at line {interior[0]})",
+              file=sys.stderr)
     return events
 
 
@@ -170,6 +183,83 @@ def serving(events: List[dict]) -> str:
     return "\n".join(lines)
 
 
+def latency(events: List[dict]) -> str:
+    """``--latency``: request-latency SLO percentiles from the
+    ``Serving/latency/*`` stream (TTFT, inter-token latency, queue time,
+    e2e — docs/serving.md). These are gauges: the last sample per series is
+    the run's value."""
+    lat = [e for e in events if e["name"].startswith("Serving/latency/")]
+    if not lat:
+        return "latency: no Serving/latency/* events in this file"
+    last: Dict[str, float] = {}
+    for e in lat:
+        last[e["name"][len("Serving/latency/"):]] = e["value"]
+    metrics = sorted({k.rsplit("_", 1)[0] for k in last})
+    lines = [f"serving latency SLOs ({len(lat)} events)"]
+    lines.append(f"  {'metric':<12} {'count':>7} {'p50':>10} {'p90':>10} "
+                 f"{'p99':>10}")
+    for m in metrics:
+        lines.append(
+            f"  {m:<12} {last.get(m + '_count', 0):>7,.0f} "
+            f"{last.get(m + '_p50', 0):>10.2f} "
+            f"{last.get(m + '_p90', 0):>10.2f} "
+            f"{last.get(m + '_p99', 0):>10.2f}")
+    lines.append("")
+    lines.append("  (ms; ttft = time to first token, itl = inter-token "
+                 "latency, queue = admit→first compute, e2e = admit→finish)")
+    return "\n".join(lines)
+
+
+def trace_report(path: str) -> str:
+    """``--trace <out.json>``: summarize a Chrome-trace / Perfetto JSON file
+    (a flight-recorder dump): span counts + total/mean duration per name,
+    the slowest individual spans, and instant-event counts."""
+    with open(path) as f:
+        doc = json.load(f)
+    evs = doc.get("traceEvents", doc if isinstance(doc, list) else [])
+    spans = [e for e in evs if e.get("ph") == "X"]
+    instants = [e for e in evs if e.get("ph") in ("i", "I")]
+    meta = doc.get("otherData", {}) if isinstance(doc, dict) else {}
+    lines = [f"trace report: {len(spans)} spans, {len(instants)} instants"
+             + (f" (dump reason: {meta['reason']})" if meta.get("reason")
+                else "")]
+    if not spans and not instants:
+        return lines[0]
+    per: Dict[str, List[float]] = {}
+    for e in spans:
+        per.setdefault(e.get("name", "?"), []).append(float(e.get("dur", 0)))
+    if per:
+        lines.append("")
+        lines.append(f"  {'span':<28} {'count':>6} {'total ms':>10} "
+                     f"{'mean ms':>10} {'max ms':>10}")
+        for name, durs in sorted(per.items(),
+                                 key=lambda kv: -sum(kv[1])):
+            lines.append(f"  {name:<28} {len(durs):>6} "
+                         f"{sum(durs) / 1e3:>10.2f} "
+                         f"{sum(durs) / len(durs) / 1e3:>10.3f} "
+                         f"{max(durs) / 1e3:>10.3f}")
+    top = sorted(spans, key=lambda e: -float(e.get("dur", 0)))[:5]
+    if top:
+        lines.append("")
+        lines.append("  slowest spans:")
+        for e in top:
+            args = e.get("args", {})
+            extras = ", ".join(f"{k}={v}" for k, v in args.items()
+                               if k not in ("trace_id", "span_id",
+                                            "parent_id"))
+            lines.append(f"    {e.get('name', '?'):<24} "
+                         f"{float(e.get('dur', 0)) / 1e3:>9.3f} ms"
+                         + (f"  ({extras})" if extras else ""))
+    if instants:
+        per_i: Dict[str, int] = {}
+        for e in instants:
+            per_i[e.get("name", "?")] = per_i.get(e.get("name", "?"), 0) + 1
+        lines.append("")
+        lines.append("  instants: " + ", ".join(
+            f"{n}×{c}" for n, c in sorted(per_i.items())))
+    return "\n".join(lines)
+
+
 def summarize(events: List[dict], last: int = 0) -> str:
     if last > 0:
         steps = sorted({e.get("step", 0) for e in events})[-last:]
@@ -232,7 +322,9 @@ def summarize(events: List[dict], last: int = 0) -> str:
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("path", help="path to an events.jsonl telemetry file")
+    ap.add_argument("path", nargs="?",
+                    help="path to an events.jsonl telemetry file "
+                         "(optional with --trace)")
     ap.add_argument("--last", type=int, default=0,
                     help="restrict to the last N steps")
     ap.add_argument("--comm-efficiency", action="store_true",
@@ -246,7 +338,28 @@ def main(argv=None) -> int:
                     help="summarize Serving/prefix_cache/* counters: "
                          "hit-rate, prefill tokens saved, retained-pool "
                          "occupancy, evictions")
+    ap.add_argument("--latency", action="store_true",
+                    help="summarize Serving/latency/* SLO percentiles: "
+                         "TTFT / inter-token / queue / e2e p50-p90-p99")
+    ap.add_argument("--trace", metavar="TRACE_JSON",
+                    help="summarize a Chrome-trace/Perfetto JSON flight-"
+                         "recorder dump (span durations, slowest spans)")
+    ap.add_argument("--all", action="store_true",
+                    help="run every section (summary, comm efficiency, "
+                         "reliability, serving, latency) in one pass")
     args = ap.parse_args(argv)
+    if args.trace:
+        try:
+            print(trace_report(args.trace))
+        except (OSError, ValueError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+        if args.path is None:
+            return 0
+        print()
+    if args.path is None:
+        ap.error("path to an events.jsonl file is required "
+                 "(or use --trace <out.json>)")
     try:
         events = load_events(args.path)
     except OSError as e:
@@ -255,6 +368,11 @@ def main(argv=None) -> int:
     if not events:
         print(f"error: no telemetry events in {args.path}", file=sys.stderr)
         return 1
+    if args.all:
+        sections = [summarize(events, last=args.last), comm_efficiency(events),
+                    reliability(events), serving(events), latency(events)]
+        print("\n\n".join(sections))
+        return 0
     if args.comm_efficiency:
         print(comm_efficiency(events))
         return 0
@@ -263,6 +381,9 @@ def main(argv=None) -> int:
         return 0
     if args.serving:
         print(serving(events))
+        return 0
+    if args.latency:
+        print(latency(events))
         return 0
     print(summarize(events, last=args.last))
     return 0

@@ -1,0 +1,488 @@
+"""Structured tracing, latency SLOs, flight recorder, metrics endpoint.
+
+Covers the span tracer (`telemetry/trace.py`), the serving engine's
+request-lifecycle instrumentation + TTFT/ITL/queue/e2e percentiles, the
+crash-dump paths (watchdog violation, fault injection, preemption, close),
+the pull-based Prometheus endpoint, the JSONL per-batch flush, the
+telemetry event-schema contract, and the default-OFF zero-event parity.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import urllib.request
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu as dst
+from deepspeed_tpu.models import llama
+from deepspeed_tpu.telemetry.trace import (TraceConfig, Tracer, dump_all,
+                                           percentiles)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+REPORT = os.path.join(REPO, "scripts", "telemetry_report.py")
+
+
+def _load_events_fn():
+    if os.path.join(REPO, "scripts") not in sys.path:
+        sys.path.insert(0, os.path.join(REPO, "scripts"))
+    from telemetry_report import load_events
+
+    return load_events
+
+
+def _chrome(path):
+    with open(path) as f:
+        doc = json.load(f)
+    assert "traceEvents" in doc and isinstance(doc["traceEvents"], list)
+    return doc
+
+
+def _check_nesting(doc):
+    """Every span's parent (same trace) must time-enclose it, and ids must
+    be unique — the 'loads, spans nest, ids consistent' acceptance bit."""
+    spans = {e["args"]["span_id"]: e for e in doc["traceEvents"]
+             if e["ph"] == "X"}
+    assert len(spans) == len([e for e in doc["traceEvents"]
+                              if e["ph"] == "X"]), "duplicate span ids"
+    for e in spans.values():
+        pid = e["args"].get("parent_id")
+        if not pid or pid not in spans:  # parent may have rotated out of the
+            continue                     # ring — that's flight-recorder law
+        p = spans[pid]
+        assert p["args"]["trace_id"] == e["args"]["trace_id"]
+        slack = 1e3  # µs; host timestamps around async dispatch
+        assert p["ts"] - slack <= e["ts"]
+        assert e["ts"] + e["dur"] <= p["ts"] + p["dur"] + slack
+
+
+# --------------------------------------------------------------------------- #
+# Tracer unit behavior
+# --------------------------------------------------------------------------- #
+def test_tracer_spans_nest_and_export(tmp_path):
+    tr = Tracer(TraceConfig(enabled=True, ring_size=256, dump_on_crash=False))
+    with tr.span("outer", cat="t", step=1):
+        with tr.span("inner", cat="t"):
+            tr.instant("marker", cat="t", note="hi")
+    req = tr.new_trace(label="request:7")
+    h = tr.begin("request", cat="serving", trace=req, uid=7)
+    tr.complete("prefill", h.t0_ns, h.t0_ns + 1_000, cat="serving",
+                trace=req, parent=h.span_id, tokens=32)
+    h.end(generated=4)
+    out = tmp_path / "trace.json"
+    assert tr.export(str(out)) == str(out)
+    doc = _chrome(out)
+    names = {e["name"] for e in doc["traceEvents"]}
+    assert {"outer", "inner", "marker", "request", "prefill"} <= names
+    _check_nesting(doc)
+    inner = next(e for e in doc["traceEvents"] if e["name"] == "inner")
+    outer = next(e for e in doc["traceEvents"] if e["name"] == "outer")
+    assert inner["args"]["parent_id"] == outer["args"]["span_id"]
+    # explicit-lifecycle span kept its own trace id
+    reqs = [e for e in doc["traceEvents"] if e["name"] == "request"]
+    assert reqs[0]["args"]["trace_id"] == req
+    assert reqs[0]["args"]["generated"] == 4
+    tr.close(dump=False)
+
+
+def test_tracer_ring_is_bounded_and_disabled_is_free(tmp_path):
+    tr = Tracer(TraceConfig(enabled=True, ring_size=16, dump_on_crash=False))
+    for i in range(100):
+        tr.instant("e", i=i)
+    assert len(tr) == 16
+    # oldest rotated out, newest retained
+    assert tr.events()[-1]["args"]["i"] == 99
+    tr.close(dump=False)
+
+    off = Tracer(TraceConfig(enabled=False))
+    sp = off.span("x")
+    assert sp is off.span("y")  # shared null span, no allocation
+    with sp:
+        off.instant("z")
+    off.complete("c", 0, 10)
+    assert len(off) == 0 and off.dump("why") is None
+    # default-constructed (no config) is also off
+    assert not Tracer(None).enabled
+
+
+def test_dump_all_and_percentiles(tmp_path):
+    out = tmp_path / "flight.json"
+    tr = Tracer(TraceConfig(enabled=True, ring_size=64,
+                            export_path=str(out), dump_on_crash=True))
+    tr.instant("before_crash")
+    paths = dump_all("unit_test")
+    assert str(out) in paths
+    assert _chrome(out)["otherData"]["reason"] == "unit_test"
+    tr.close(dump=False)
+    assert dump_all("after_close") == []  # closed tracer left the registry
+
+    assert percentiles([], (50,)) == {"p50": 0.0}
+    vals = list(range(1, 101))
+    p = percentiles(vals, (50, 90, 99))
+    assert p["p50"] == 50 and p["p90"] == 90 and p["p99"] == 99
+
+
+def test_trace_config_parses():
+    from deepspeed_tpu.runtime.config import parse_config
+
+    cfg = parse_config({"telemetry": {"trace": {
+        "enabled": True, "ring_size": 128, "export_path": "/tmp/t.json",
+        "dump_on_crash": False}}})
+    assert cfg.telemetry.trace.enabled
+    assert cfg.telemetry.trace.ring_size == 128
+    assert cfg.telemetry.trace.export_path == "/tmp/t.json"
+    assert not cfg.telemetry.trace.dump_on_crash
+    # default OFF
+    assert not parse_config({}).telemetry.trace.enabled
+
+
+# --------------------------------------------------------------------------- #
+# training engine spans
+# --------------------------------------------------------------------------- #
+def _train_engine(tmp_path, extra=None):
+    cfg = llama.LlamaConfig.tiny()
+    spec = llama.model_spec(cfg, compute_dtype=jnp.float32)
+    config = {"train_batch_size": 8,
+              "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+              "steps_per_print": 0}
+    config.update(extra or {})
+    engine, *_ = dst.initialize(model=spec, config=config)
+    tokens = np.random.randint(0, cfg.vocab_size, (8, 33)).astype(np.int32)
+    return engine, {"tokens": tokens}
+
+
+def test_training_trace_spans_and_checkpoint(devices8, tmp_path):
+    out = str(tmp_path / "train_trace.json")
+    engine, batch = _train_engine(tmp_path, {
+        "wall_clock_breakdown": True,
+        "telemetry": {"trace": {"enabled": True, "export_path": out,
+                                "dump_on_crash": False}}})
+    for _ in range(2):
+        engine.train_batch(batch)
+    engine.save_checkpoint(str(tmp_path / "ckpt"))
+    assert engine.telemetry.tracer.export(out)
+    doc = _chrome(out)
+    names = [e["name"] for e in doc["traceEvents"]]
+    for want in ("train/train_batch", "train/fwd", "train/bwd", "train/step",
+                 "checkpoint/save", "checkpoint/publish"):
+        assert want in names, f"missing span {want}"
+    assert names.count("train/train_batch") == 2
+    _check_nesting(doc)
+    # phase spans nest under their step's train_batch span
+    fwd = next(e for e in doc["traceEvents"] if e["name"] == "train/fwd")
+    tb = [e for e in doc["traceEvents"] if e["name"] == "train/train_batch"]
+    assert fwd["args"]["parent_id"] in {e["args"]["span_id"] for e in tb}
+    engine.destroy()
+
+
+def test_disabled_telemetry_training_zero_events(devices8, tmp_path):
+    """Default config: no spans, no latency timers, no monitor events —
+    the default-OFF bit-identical contract."""
+    engine, batch = _train_engine(tmp_path)
+    assert not engine.telemetry.tracer.enabled
+    engine.train_batch(batch)
+    engine.save_checkpoint(str(tmp_path / "ckpt"))
+    assert len(engine.telemetry.tracer) == 0
+    assert engine.telemetry.step_end(engine.global_steps) == []
+    assert not engine.timers.has("fwd")
+    engine.destroy()
+
+
+def test_watchdog_violation_dumps_flight_recorder(devices8, tmp_path):
+    from deepspeed_tpu.testing import faults
+
+    out = str(tmp_path / "wd_trace.json")
+    engine, batch = _train_engine(tmp_path, {
+        "watchdog": {"enabled": True, "max_skipped_steps": 2,
+                     "detect_non_finite": False, "on_violation": "warn"},
+        "telemetry": {"trace": {"enabled": True, "export_path": out,
+                                "dump_on_crash": False}}})
+    engine.train_batch(batch)  # a healthy step lands in the ring first
+    with faults.forced_nonfinite(engine, steps=2):
+        engine.train_batch(batch)
+        engine.train_batch(batch)
+    assert engine.watchdog.violations == 1
+    assert os.path.exists(out), "violation must dump the flight recorder"
+    doc = _chrome(out)
+    assert doc["otherData"]["reason"] == "watchdog_skip_limit"
+    # the dump contains the steps PRECEDING the violation
+    tb = [e for e in doc["traceEvents"] if e["name"] == "train/train_batch"]
+    assert len(tb) >= 2
+    engine.destroy()
+
+
+def test_fault_crash_and_preemption_dump_traces(tmp_path):
+    from deepspeed_tpu.elasticity.elastic_agent import PreemptionGuard
+    from deepspeed_tpu.testing import faults
+
+    out = str(tmp_path / "crash_trace.json")
+    tr = Tracer(TraceConfig(enabled=True, export_path=out,
+                            dump_on_crash=True))
+    tr.instant("work_before_crash")
+
+    class _CE:  # minimal checkpoint-engine stand-in
+        def save(self, tree, path, **kw):
+            return path
+
+    ce = _CE()
+    with pytest.raises(faults.SimulatedCrash):
+        with faults.crash_after_save(ce):
+            ce.save({}, str(tmp_path / "state"))
+    assert os.path.exists(out)
+    assert _chrome(out)["otherData"]["reason"] == "fault_crash_after_save"
+
+    os.remove(out)
+    guard = PreemptionGuard(save_dir=str(tmp_path / "pg"))
+    faults.preempt(guard)
+    assert guard.triggered
+    assert os.path.exists(out), "preemption must dump the flight recorder"
+    assert _chrome(out)["otherData"]["reason"] == "preemption_synthetic"
+    tr.close(dump=False)
+
+
+# --------------------------------------------------------------------------- #
+# serving: request lifecycle + latency SLOs
+# --------------------------------------------------------------------------- #
+def _serving_engine(trace=False, hub=None, split=0):
+    from deepspeed_tpu.inference.engine_v2 import build_engine_v2
+
+    cfg = llama.LlamaConfig.tiny()
+    params = llama.init(cfg, __import__("jax").random.PRNGKey(0))
+    config = {"dtype": "float32", "prefill_bucket": 16,
+              "split_prefill_chunk": split,
+              "ragged": {"max_tracked_sequences": 4,
+                         "max_ragged_batch_size": 4,
+                         "memory_config_blocks": 64, "block_size": 16}}
+    if trace:
+        config["trace"] = {"enabled": True, "ring_size": 4096,
+                           "dump_on_crash": False}
+    return cfg, build_engine_v2(llama, cfg, params, config=config,
+                                telemetry_hub=hub)
+
+
+def test_serving_trace_lifecycle_and_latency(devices8, tmp_path):
+    cfg, eng = _serving_engine(trace=True)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, cfg.vocab_size, (24,)).tolist()
+               for _ in range(3)]
+    outs = eng.generate(prompts, max_new_tokens=6, steps_per_sync=2)
+    assert all(len(o) == 6 for o in outs)
+    out = str(tmp_path / "serving_trace.json")
+    assert eng.export_trace(out)
+    doc = _chrome(out)
+    names = [e["name"] for e in doc["traceEvents"]]
+    for want in ("request", "queue_wait", "prefill", "decode_quantum",
+                 "decode_token", "first_token"):
+        assert want in names, f"missing {want}"
+    _check_nesting(doc)
+    # one trace id per request, and its spans share it
+    reqs = [e for e in doc["traceEvents"] if e["name"] == "request"]
+    assert len(reqs) == 3
+    assert len({e["args"]["trace_id"] for e in reqs}) == 3
+    for e in doc["traceEvents"]:
+        if e["name"] == "queue_wait":
+            assert e["args"]["trace_id"] in \
+                {r["args"]["trace_id"] for r in reqs}
+    # latency SLOs populated with sane orderings
+    lat = eng.latency_summary()
+    for metric in ("ttft_ms", "itl_ms", "queue_ms", "e2e_ms"):
+        assert lat[metric]["count"] > 0, metric
+        assert lat[metric]["p50"] <= lat[metric]["p99"]
+    assert lat["e2e_ms"]["count"] == 3
+    # e2e >= ttft for any request population
+    assert lat["e2e_ms"]["p99"] >= lat["ttft_ms"]["p50"]
+    assert eng._req == {}  # every lifecycle closed
+
+
+def test_serving_split_prefill_chunks_traced(devices8):
+    cfg, eng = _serving_engine(trace=True, split=16)
+    rng = np.random.default_rng(1)
+    eng.put_split(0, rng.integers(0, cfg.vocab_size, (40,)).tolist())
+    while 0 in eng._pending_prefill:
+        eng.step()
+    evs = eng.tracer.events()
+    chunks = [e for e in evs if e["name"] == "prefill_chunk"]
+    assert len(chunks) >= 2  # 40 tokens / 16-chunk → 3 chunks
+    assert any(e["args"]["final"] for e in chunks)
+    assert len(eng._lat["ttft_ms"]) == 1
+    eng.finish(0)
+    assert len(eng._lat["e2e_ms"]) == 1
+
+
+def test_serving_disabled_records_nothing(devices8):
+    """Defaults-OFF parity: the serving step path emits zero events and
+    starts zero timers/lifecycles."""
+    cfg, eng = _serving_engine(trace=False)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, cfg.vocab_size, (20,)).tolist()
+               for _ in range(2)]
+    eng.generate(prompts, max_new_tokens=4)
+    assert not eng.tracer.enabled
+    assert len(eng.tracer) == 0
+    assert eng._req == {}
+    assert all(not v for v in eng._lat.values())
+
+
+def test_latency_report_from_jsonl(devices8, tmp_path):
+    """Acceptance: generate() through a hub lands Serving/latency/* in the
+    JSONL stream and `telemetry_report.py --latency` prints the
+    percentiles from the real recorded events."""
+    from deepspeed_tpu.monitor import MonitorMaster
+    from deepspeed_tpu.runtime.config import parse_config
+    from deepspeed_tpu.telemetry import TelemetryHub
+
+    rcfg = parse_config({
+        "telemetry": {"trace": {"enabled": True, "dump_on_crash": False}},
+        "jsonl_monitor": {"enabled": True, "output_path": str(tmp_path),
+                          "job_name": "slo"}})
+    hub = TelemetryHub(rcfg, monitor=MonitorMaster(rcfg))
+    assert hub.tracer.enabled
+    cfg, eng = _serving_engine(hub=hub)
+    assert eng.tracer is hub.tracer  # shared flight recorder
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, cfg.vocab_size, (20,)).tolist()
+               for _ in range(3)]
+    eng.generate(prompts, max_new_tokens=5)
+    hub.close()
+    jsonl = tmp_path / "slo" / "events.jsonl"
+    recs = [json.loads(l) for l in open(jsonl)]
+    names = {r["name"] for r in recs}
+    assert "Serving/latency/ttft_ms_p50" in names
+    assert "Serving/latency/e2e_ms_p99" in names
+    out = subprocess.run([sys.executable, REPORT, str(jsonl), "--latency"],
+                         capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr
+    for token in ("ttft_ms", "itl_ms", "queue_ms", "e2e_ms", "p50", "p99"):
+        assert token in out.stdout
+
+
+# --------------------------------------------------------------------------- #
+# metrics endpoint
+# --------------------------------------------------------------------------- #
+def test_metrics_server_serves_prometheus(tmp_path):
+    from deepspeed_tpu.runtime.config import parse_config
+    from deepspeed_tpu.telemetry import MetricsServer, TelemetryHub
+
+    hub = TelemetryHub(parse_config(
+        {"telemetry": {"trace": {"enabled": True, "dump_on_crash": False}}}))
+    hub.reliability_event("checkpoint_saved", step=3)
+    hub.reliability_event("checkpoint_saved", step=4)
+    hub.serving_event("latency/ttft_ms_p50", 12.5, step=4)
+    srv = MetricsServer(hub)
+    port = srv.start()
+    try:
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10).read().decode()
+        assert "dstpu_reliability_checkpoint_saved 2" in body
+        assert "dstpu_serving_latency_ttft_ms_p50 12.5" in body
+        assert "# TYPE dstpu_reliability_checkpoint_saved counter" in body
+        assert "# TYPE dstpu_serving_latency_ttft_ms_p50 gauge" in body
+        ok = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=10).read()
+        assert ok == b"ok\n"
+    finally:
+        srv.stop()
+    hub.close()
+
+
+# --------------------------------------------------------------------------- #
+# schema contract + report/monitor satellites
+# --------------------------------------------------------------------------- #
+def test_event_schema_on_real_jsonl(devices8, tmp_path):
+    """CI schema check: every event name a real run emits matches the
+    Group/.../metric convention and steps are monotonic per series."""
+    from deepspeed_tpu.telemetry import validate_jsonl_records
+
+    load_events = _load_events_fn()
+    engine, batch = _train_engine(tmp_path, {
+        "wall_clock_breakdown": True,
+        "comms_logger": {"enabled": True},
+        "jsonl_monitor": {"enabled": True, "output_path": str(tmp_path),
+                          "job_name": "schema"}})
+    for _ in range(2):
+        engine.train_batch(batch)
+    engine.telemetry.reliability_event("checkpoint_saved",
+                                       step=engine.global_steps)
+    engine.destroy()
+    from deepspeed_tpu.comm import comm as dist
+    dist.configure(enabled=False)
+    recs = load_events(str(tmp_path / "schema" / "events.jsonl"))
+    assert recs
+    assert validate_jsonl_records(recs) == []
+
+
+def test_event_schema_rejects_bad_events():
+    from deepspeed_tpu.telemetry import validate_events
+
+    good = [("Train/Step/fwd_ms", 1.0, 1), ("Train/Step/fwd_ms", 2.0, 2),
+            ("Serving/latency/ttft_ms_p50", 3.0, 0),
+            ("Reliability/violation/skip_limit", 1.0, 7)]
+    assert validate_events(good) == []
+    assert validate_events([("loss", 1.0, 1)])          # no group
+    assert validate_events([("train/x", 1.0, 1)])       # lowercase group
+    assert validate_events([("Train/x", float("nan"), 1)])
+    assert validate_events([("Train/x", 1.0, -1)])
+    # step going backwards in one series is flagged
+    assert validate_events([("Train/x", 1.0, 5), ("Train/x", 1.0, 3)])
+
+
+def test_jsonl_monitor_flushes_per_batch(tmp_path):
+    """Crash-safety satellite: rows are on disk after write_events, BEFORE
+    any close()/flush() — and close stays idempotent."""
+    from deepspeed_tpu.monitor.monitor import JSONLMonitor
+
+    class Cfg:
+        enabled = True
+        output_path = str(tmp_path)
+        job_name = "job"
+
+    mon = JSONLMonitor(Cfg())
+    mon.write_events([("Train/loss", 1.5, 1)])
+    path = tmp_path / "job" / "events.jsonl"
+    assert len(open(path).readlines()) == 1  # no close, no flush — on disk
+    mon.write_events([("Train/loss", 1.2, 2)])
+    assert len(open(path).readlines()) == 2
+    mon.close()
+    mon.close()  # idempotent
+
+
+def test_report_tolerates_truncation_and_all(tmp_path):
+    load_events = _load_events_fn()
+    path = tmp_path / "events.jsonl"
+    with open(path, "w") as f:
+        for step in (1, 2):
+            f.write(json.dumps({"name": "Train/Step/fwd_ms",
+                                "value": 1.0 * step, "step": step,
+                                "ts": 0.0}) + "\n")
+        f.write(json.dumps({"name": "Serving/latency/ttft_ms_p50",
+                            "value": 9.0, "step": 2, "ts": 0.0}) + "\n")
+        f.write('{"name": "Train/Step/bwd_ms", "val')  # crash-torn tail
+    evs = load_events(str(path))
+    assert len(evs) == 3  # torn final line dropped, report survives
+    out = subprocess.run([sys.executable, REPORT, str(path), "--all"],
+                         capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr
+    for section in ("step time", "comm efficiency", "reliability",
+                    "serving", "latency"):
+        assert section in out.stdout, f"--all missing section {section!r}"
+
+
+def test_report_trace_mode(tmp_path):
+    tr = Tracer(TraceConfig(enabled=True, dump_on_crash=False))
+    with tr.span("train/train_batch", step=1):
+        tr.instant("marker")
+    trace_path = tmp_path / "t.json"
+    tr.export(str(trace_path))
+    tr.close(dump=False)
+    out = subprocess.run([sys.executable, REPORT, "--trace",
+                          str(trace_path)],
+                         capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr
+    assert "train/train_batch" in out.stdout and "marker" in out.stdout
+    # no positional path and no --trace is a usage error
+    bad = subprocess.run([sys.executable, REPORT],
+                         capture_output=True, text=True, timeout=60)
+    assert bad.returncode != 0
