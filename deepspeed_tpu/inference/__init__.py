@@ -1,7 +1,8 @@
 from .config import (InferenceConfig, PrefixCacheConfig,  # noqa: F401
-                     RaggedConfig, TPConfig)
+                     RaggedConfig, SpeculativeConfig, TPConfig)
 from .engine import InferenceEngine, ModelFamily, init_inference  # noqa: F401
-from .engine_v2 import InferenceEngineV2, build_engine_v2  # noqa: F401
+from .engine_v2 import (InferenceEngineV2, build_engine_v2,  # noqa: F401
+                        prompt_lookup_draft)
 from .ragged import (BlockedAllocator, PrefixBlockIndex,  # noqa: F401
                      SequenceDescriptor, StateManager)
 from .sampling import SamplingParams, sample  # noqa: F401

@@ -51,6 +51,26 @@ class PrefixCacheConfig:
 
 
 @dataclass
+class SpeculativeConfig:
+    """Speculative decoding for the v2 paged engine (docs/serving.md).
+
+    Default OFF: with ``enabled=False`` the decode path is bit-identical to
+    the plain engine. When ON, each ``step()`` drafts up to
+    ``max_draft_tokens`` per live sequence with a model-free prompt-lookup
+    (n-gram) drafter — the trailing ``ngram_max``-gram of the request's own
+    prompt+output history is matched against an earlier occurrence and the
+    tokens that followed it are proposed — then ONE batched forward pass over
+    the paged cache verifies every draft position, the longest agreeing
+    prefix is accepted (exact rejection sampling for non-greedy requests),
+    and rejected KV positions are rolled back (``StateManager.truncate``)."""
+
+    enabled: bool = False
+    max_draft_tokens: int = 4    # draft positions verified per step (k)
+    ngram_max: int = 3           # longest trailing n-gram tried first
+    min_match: int = 1           # shortest n-gram that may draft
+
+
+@dataclass
 class QuantConfig:
     """Weight quantization for inference (reference
     ``inference/quantization`` INT4/INT8 + ``GroupQuantizer``)."""
@@ -79,6 +99,7 @@ class InferenceConfig:
     ragged: RaggedConfig = field(default_factory=RaggedConfig)
     quant: QuantConfig = field(default_factory=QuantConfig)
     prefix_cache: PrefixCacheConfig = field(default_factory=PrefixCacheConfig)
+    speculative: SpeculativeConfig = field(default_factory=SpeculativeConfig)
     # request-lifecycle tracing + latency SLO stats (telemetry/trace.py;
     # docs/serving.md). Default OFF → the serving path records nothing.
     trace: TraceConfig = field(default_factory=TraceConfig)
@@ -92,9 +113,11 @@ class InferenceConfig:
         ragged = d.pop("ragged", {})
         quant = d.pop("quant", {})
         prefix = d.pop("prefix_cache", {})
+        spec = d.pop("speculative", {})
         trace = d.pop("trace", {})
         known = {k: v for k, v in d.items() if k in cls.__dataclass_fields__}
         return cls(tensor_parallel=TPConfig(**tp), ragged=RaggedConfig(**ragged),
                    quant=QuantConfig(**quant),
                    prefix_cache=PrefixCacheConfig(**prefix),
+                   speculative=SpeculativeConfig(**spec),
                    trace=TraceConfig(**trace), **known)

@@ -7,6 +7,16 @@ every decode step is one fixed-shape jit program over all sequence slots —
 inactive slots compute into the trash block and are ignored — so continuous
 batching costs zero recompiles and XLA keeps the MXU busy with the batched
 GEMMs. Prefill runs per-sequence at bucketed lengths (one compile per bucket).
+
+Speculative decoding (``inference.speculative.*``, default OFF —
+docs/serving.md): a model-free prompt-lookup drafter proposes up to k tokens
+per live sequence from the request's own prompt+output history; ONE batched
+forward pass over the paged cache verifies every draft position
+(``_verify_fn`` — the ctx-offset prefill machinery reused at decode time);
+the longest agreeing prefix is accepted — exact rejection sampling against
+the ``sampling.py`` distributions for non-greedy requests — and rejected KV
+positions are rolled back with ``StateManager.truncate``. Decode-bound
+serving then emits >1 token per model step without a second model.
 """
 
 from __future__ import annotations
@@ -24,7 +34,35 @@ from ..utils.logging import log_dist
 from .config import InferenceConfig
 from .engine import InferenceEngine, ModelFamily, _round_up
 from .ragged import StateManager
-from .sampling import SamplingParams, sample, sample_batch, sp_arrays
+from .sampling import (SamplingParams, filter_logits_batch, sample,
+                       sample_batch, sp_arrays)
+
+
+def prompt_lookup_draft(history, max_tokens: int, ngram_max: int = 3,
+                        min_match: int = 1) -> List[int]:
+    """Prompt-lookup (n-gram) drafting: match the TRAILING n-gram of
+    ``history`` (n from ``ngram_max`` down to ``min_match``) against an
+    earlier occurrence and propose up to ``max_tokens`` of the tokens that
+    followed it — the most recent occurrence wins. Model-free: the "draft
+    model" is the request's own prompt + generated output, which makes it
+    free to run and strongest exactly where decode is most wasteful
+    (repetitive continuations, quoted context, multi-turn echoes). Returns
+    ``[]`` when nothing matches — the caller falls back to plain decode."""
+    n_hist = len(history)
+    if max_tokens <= 0 or n_hist < max(1, min_match) + 1:
+        return []
+    arr = np.asarray(history, np.int32)
+    for n in range(min(ngram_max, n_hist - 1), max(1, min_match) - 1, -1):
+        pat = arr[n_hist - n:]
+        # windows over arr[:-1]: every match start i has i + n <= n_hist - 1,
+        # so at least one continuation token exists (and the trailing n-gram
+        # can never match itself)
+        win = np.lib.stride_tricks.sliding_window_view(arr[:n_hist - 1], n)
+        hits = np.flatnonzero((win == pat).all(axis=1))
+        if hits.size:
+            start = int(hits[-1]) + n
+            return arr[start:start + max_tokens].tolist()
+    return []
 
 
 class InferenceEngineV2(InferenceEngine):
@@ -68,6 +106,22 @@ class InferenceEngineV2(InferenceEngine):
         self._slot_sp: List[SamplingParams] = [SamplingParams(greedy=True)] * B
         # uid → (full prompt, SamplingParams from put_split)
         self._pending_prefill: Dict[int, Tuple] = {}
+        # --- speculative decoding (docs/serving.md). Default OFF: step()
+        # runs the exact pre-spec programs and none of the hooks below fire.
+        sc = self.config.speculative
+        self._spec_on = bool(sc.enabled)
+        self._spec_k = max(1, int(sc.max_draft_tokens))
+        self._spec_ngram_max = max(1, int(sc.ngram_max))
+        self._spec_min_match = max(1, int(sc.min_match))
+        # cumulative Serving/spec/* counters (spec_events): model steps run
+        # in spec mode split into verify (>=1 draft scored) vs plain decode
+        # fallbacks, plus drafted/accepted/emitted/rolled-back token counts
+        # and verify-batch occupancy (valid positions / batch capacity)
+        self.spec_stats: Dict[str, int] = {
+            "verify_steps": 0, "decode_steps": 0, "step_seqs": 0,
+            "drafted_tokens": 0, "accepted_tokens": 0, "emitted_tokens": 0,
+            "rolled_back_tokens": 0, "verify_positions": 0,
+            "verify_capacity": 0}
         # --- request-lifecycle tracing + latency SLO stats (trace.py;
         # docs/serving.md). A hub with an ENABLED tracer shares its flight
         # recorder (serving spans land next to training/checkpoint spans);
@@ -86,6 +140,7 @@ class InferenceEngineV2(InferenceEngine):
         log_dist(f"InferenceEngineV2: {rc.memory_config_blocks} blocks × "
                  f"{rc.block_size} tokens, {B} sequence slots, "
                  f"prefix_cache={'on' if pc.enabled else 'off'}, "
+                 f"speculative={'on(k=%d)' % self._spec_k if self._spec_on else 'off'}, "
                  f"trace={'on' if self._trace_on else 'off'}")
 
     # ------------------------------------------------------------------ #
@@ -544,6 +599,190 @@ class InferenceEngineV2(InferenceEngine):
         return self._paged_fns[key]
 
     # ------------------------------------------------------------------ #
+    # speculative decoding: prompt-lookup drafting + batched verification +
+    # KV rollback (docs/serving.md)
+    # ------------------------------------------------------------------ #
+    def _verify_fn(self, kp1: int):
+        """ONE compiled forward pass scoring all ``kp1 - 1`` draft positions
+        of every sequence slot against the paged cache — the ctx-offset
+        prefill machinery applied at decode time: row i feeds
+        ``[last_token, draft_1..draft_k]`` at context offset ``lens[i]`` with
+        positions past ``1 + draft_len[i]`` masked to the trash block.
+
+        Acceptance runs on-device so the step has exactly one host sync:
+        greedy rows accept draft j while it equals the argmax of the logits
+        that precede it; stochastic rows accept with probability
+        ``p(draft_j)`` under their own temperature/top-k/top-p-filtered
+        distribution — exact rejection sampling for the DETERMINISTIC
+        prompt-lookup drafter (q = δ), so on rejection the correction is
+        drawn from p with the rejected token removed and renormalized, and
+        the emitted stream is distributed exactly as plain decode. When every
+        draft is accepted the bonus position (scored in the same pass)
+        supplies one extra token. Returns (accepted_len [B], next_token [B],
+        cache)."""
+        key = ("spec_verify", kp1)
+        if key not in self._paged_fns:
+            fam, ap = self.family, self._apply_paged
+
+            def verify(params, cache, tokens, lens, tables, active, nvalid,
+                       drafts, rng, uids, temp, topk, topp, greedy):
+                # tokens [B, kp1]; nvalid [B] = 1 + draft_len;
+                # drafts [B, kp1-1] (zero-padded past draft_len)
+                B = tokens.shape[0]
+                k = kp1 - 1
+                valid = (jnp.arange(kp1)[None, :] < nvalid[:, None]) \
+                    & active[:, None]
+                logits, cache = ap(fam.cfg, self._dq(params), tokens, cache,
+                                   tables, lens, valid=valid)
+                amax = jnp.argmax(logits, axis=-1)                 # [B, kp1]
+                filt = filter_logits_batch(
+                    logits.reshape(B * kp1, -1),
+                    jnp.repeat(temp, kp1), jnp.repeat(topk, kp1),
+                    jnp.repeat(topp, kp1)).reshape(B, kp1, -1)
+                probs = jax.nn.softmax(filt, axis=-1)
+                draft_len = nvalid - 1
+                keys = jax.vmap(lambda u: jax.random.fold_in(rng, u))(uids)
+                accept_u = jax.vmap(
+                    lambda kk: jax.random.uniform(kk, (k,)))(keys)  # [B, k]
+                p_draft = jnp.take_along_axis(
+                    probs[:, :k, :], drafts[..., None], axis=-1)[..., 0]
+                is_greedy = jnp.logical_or(greedy, temp <= 0.0)
+                ok = jnp.where(is_greedy[:, None], drafts == amax[:, :k],
+                               accept_u < p_draft)
+                ok = ok & (jnp.arange(k)[None, :] < draft_len[:, None])
+                # longest agreeing prefix: cumprod zeroes everything after
+                # the first rejection
+                m = jnp.sum(jnp.cumprod(ok.astype(jnp.int32), axis=1),
+                            axis=1)                                 # [B]
+                lm = jnp.take_along_axis(filt, m[:, None, None],
+                                         axis=1)[:, 0]              # [B, V]
+                la = jnp.take_along_axis(amax, m[:, None], axis=1)[:, 0]
+                rejected = m < draft_len
+                d_m = jnp.take_along_axis(
+                    drafts, jnp.minimum(m, k - 1)[:, None], axis=1)[:, 0]
+                vocab = jax.lax.broadcasted_iota(jnp.int32, lm.shape, 1)
+                residual = jnp.where(
+                    rejected[:, None] & (vocab == d_m[:, None]),
+                    -jnp.inf, lm)
+                keys2 = jax.vmap(
+                    lambda kk: jax.random.fold_in(kk, kp1))(keys)
+                sampled = jax.vmap(jax.random.categorical)(keys2, residual)
+                nxt = jnp.where(is_greedy, la, sampled)
+                return m, nxt.astype(jnp.int32), cache
+
+            self._paged_fns[key] = jax.jit(verify, donate_argnums=(1,))
+        return self._paged_fns[key]
+
+    def _draft_tokens(self, desc) -> List[int]:
+        """Prompt-lookup draft for one live sequence, clamped so the verify
+        write window ``[seen, seen + len + 1)`` stays inside max_seq_len and
+        the fixed-width block table."""
+        room = min(self.family.cfg.max_seq_len,
+                   self.state.max_blocks_per_seq * self.state.block_size) \
+            - desc.seen_tokens - 1
+        k = min(self._spec_k, room)
+        if k <= 0:
+            return []
+        return prompt_lookup_draft(desc.tokens + [desc.last_token], k,
+                                   self._spec_ngram_max,
+                                   self._spec_min_match)
+
+    def _spec_step(self, live, seed: int = 0) -> Optional[Dict[int, List[int]]]:
+        """One speculative decode step over ``live``: draft, verify every
+        draft position in one batched forward pass, accept the longest
+        agreeing prefix per sequence, roll back rejected KV. Returns
+        {uid: [emitted tokens]} — at least one token per sequence (the
+        correction/bonus sample), up to ``max_draft_tokens + 1`` — or None
+        when no sequence produced a draft (the caller runs the plain decode
+        program, keeping draft-less steps bit-identical to non-spec
+        serving)."""
+        drafts = {d.uid: self._draft_tokens(d) for d in live}
+        bs = self.state.block_size
+        # capacity guard: verification may need blocks for up to k+1 new
+        # positions per sequence; if the pool (free + evictable) cannot
+        # cover the batch, drop the drafts — a plain decode step needs the
+        # fewest blocks and matches non-spec admission behavior
+        need = 0
+        for d in live:
+            want = d.seen_tokens + len(drafts[d.uid]) + 1
+            need += max(0, (want + bs - 1) // bs - len(d.blocks))
+        if need > self.state.allocator.free_blocks + \
+                self.state.retained_blocks:
+            drafts = {u: [] for u in drafts}
+        if not any(drafts.values()):
+            return None
+        kmax = self._spec_k
+        self.spec_stats["verify_steps"] += 1
+        self.spec_stats["step_seqs"] += len(live)
+        cow = []
+        for d in live:
+            dl = len(drafts[d.uid])
+            cow += self.state.ensure_writable(d, d.seen_tokens + dl + 1)
+            self.state.extend(d, n=dl + 1)
+            self._slot_tables[d.slot] = self.state.block_table(d)
+        self._copy_blocks(cow)
+        B = self._slot_tokens.shape[0]
+        tok_w = np.zeros((B, kmax + 1), np.int32)
+        tok_w[:, 0] = self._slot_tokens
+        dr_arr = np.zeros((B, kmax), np.int32)
+        nvalid = np.ones((B,), np.int32)
+        uids_arr = np.zeros((B,), np.int32)
+        for d in live:
+            dr = drafts[d.uid]
+            dr_arr[d.slot, :len(dr)] = dr
+            tok_w[d.slot, 1:len(dr) + 1] = dr
+            nvalid[d.slot] = 1 + len(dr)
+            uids_arr[d.slot] = d.uid
+        if self._trace_on:
+            t0 = time.monotonic_ns()
+        m, nxt, self.cache = self._verify_fn(kmax + 1)(
+            self.params, self.cache, jnp.asarray(tok_w),
+            jnp.asarray(self._slot_lens), jnp.asarray(self._slot_tables),
+            jnp.asarray(self._slot_active), jnp.asarray(nvalid),
+            jnp.asarray(dr_arr), jax.random.PRNGKey(seed),
+            jnp.asarray(uids_arr), *map(jnp.asarray,
+                                        sp_arrays(self._slot_sp)))
+        m, nxt = np.asarray(m), np.asarray(nxt)
+        if self._trace_on:
+            t1 = time.monotonic_ns()
+            self.tracer.complete(
+                "spec_verify", t0, t1, cat="serving", batch=len(live),
+                drafted=int(sum(len(v) for v in drafts.values())),
+                accepted=int(sum(min(int(m[d.slot]), len(drafts[d.uid]))
+                                 for d in live)))
+        out: Dict[int, List[int]] = {}
+        st = self.spec_stats
+        for d in live:
+            dr = drafts[d.uid]
+            dl = len(dr)
+            mi = min(int(m[d.slot]), dl)
+            tok = int(nxt[d.slot])
+            # KV positions seen..seen+dl now hold [last_token] + drafts;
+            # record them, then un-fill the rejected suffix
+            d.tokens.extend([d.last_token] + dr)
+            d.seen_tokens += dl + 1
+            if mi < dl:
+                pairs = self.state.truncate(d, d.seen_tokens - (dl - mi))
+                self._copy_blocks(pairs)
+                self._slot_tables[d.slot] = self.state.block_table(d)
+            emitted = dr[:mi] + [tok]
+            d.last_token = tok
+            d.generated.extend(emitted)
+            self._slot_tokens[d.slot] = tok
+            self._slot_lens[d.slot] = d.seen_tokens
+            self.state.mark_filled(d)
+            out[d.uid] = emitted
+            st["drafted_tokens"] += dl
+            st["accepted_tokens"] += mi
+            st["emitted_tokens"] += mi + 1
+            st["rolled_back_tokens"] += dl - mi
+            st["verify_positions"] += dl + 1
+            st["verify_capacity"] += kmax + 1
+            if self._trace_on:
+                self._req_tokens(d.uid, mi + 1, t1)
+        return out
+
+    # ------------------------------------------------------------------ #
     def put(self, uid: int, prompt_tokens, sp: SamplingParams = SamplingParams(greedy=True),
             seed: int = 0) -> int:
         """Admit one sequence and run its prefill; returns the first sampled
@@ -672,7 +911,12 @@ class InferenceEngineV2(InferenceEngine):
 
         Sampling uses each sequence's ADMISSION-time params (per-request
         sampling, like the reference v2 engine); the ``sp`` argument is
-        accepted for backward compatibility and ignored."""
+        accepted for backward compatibility and ignored.
+
+        With ``inference.speculative.enabled`` the step drafts + verifies
+        instead (``_spec_step``) and may emit SEVERAL tokens per sequence, so
+        the return type widens to {uid: [tokens]} — every value is a list,
+        including prefill first-tokens and draft-less fallback steps."""
         self._warn_ignored_sp(sp)
         out = self._advance_prefill(seed)
         live = [d for d in self.state.seqs.values()
@@ -686,7 +930,20 @@ class InferenceEngineV2(InferenceEngine):
             # stop: the completed sequence is a live decode to protect again
             while self._pending_prefill and not out:
                 out.update(self._advance_prefill(seed))
-            return out
+            return ({u: [t] for u, t in out.items()} if self._spec_on
+                    else out)
+        if self._spec_on:
+            spec_out = self._spec_step(live, seed)
+            if spec_out is not None:
+                for u, t in out.items():
+                    spec_out[u] = [t]
+                return spec_out
+            # no sequence drafted this step: run the plain decode program
+            # below — bit-identical to a non-spec step, and cheaper than a
+            # k+1-wide verify batch with one valid column
+            self.spec_stats["decode_steps"] += 1
+            self.spec_stats["step_seqs"] += len(live)
+            self.spec_stats["emitted_tokens"] += len(live)
         cow = []
         for d in live:
             # copy-on-write BEFORE extend: only pre-existing blocks can be
@@ -723,7 +980,7 @@ class InferenceEngineV2(InferenceEngine):
             out[d.uid] = tok
             if self._trace_on:
                 self._req_tokens(d.uid, 1, t1)
-        return out
+        return {u: [t] for u, t in out.items()} if self._spec_on else out
 
     def step_many(self, k: int, sp: SamplingParams = SamplingParams(greedy=True),
                   seed: int = 0) -> Dict[int, List[int]]:
@@ -732,7 +989,13 @@ class InferenceEngineV2(InferenceEngine):
         still produced (the caller trims) — the standard multi-step decode
         trade. k is clamped so no live sequence can run past max_seq_len.
         Split-admitted sequences advance one prefill chunk per quantum; a
-        prompt completing here contributes its first token as a 1-list."""
+        prompt completing here contributes its first token as a 1-list.
+
+        Speculative decoding does NOT apply here: the fused k-step scan is
+        the alternative host-sync amortization (fixed k tokens per sync);
+        drafting+verification lives in ``step()``, which emits a variable
+        number of tokens per call. ``generate`` picks ``step()`` when
+        ``inference.speculative.enabled`` is set."""
         self._warn_ignored_sp(sp)
         first = self._advance_prefill(seed)
         live = [d for d in self.state.seqs.values()
@@ -841,6 +1104,37 @@ class InferenceEngineV2(InferenceEngine):
         return events
 
     # ------------------------------------------------------------------ #
+    def spec_events(self, step: int = 0):
+        """``Serving/spec/*`` telemetry events: the cumulative counters plus
+        the derived efficiency gauges — ``accept_rate`` (accepted / drafted),
+        ``mean_accepted_len`` (accepted per verify step), ``tokens_per_step``
+        (emitted tokens per live sequence per model forward pass — the
+        headline: > 1 means decode is beating one-token-per-pass; the
+        per-sequence normalization keeps batch size out of the number), and
+        ``verify_batch_occupancy`` (valid verify positions / batch
+        capacity). All names are registered in ``telemetry/schema.py``."""
+        s = self.spec_stats
+        vals: Dict[str, float] = {k: float(v) for k, v in s.items()}
+        vals["accept_rate"] = (s["accepted_tokens"] / s["drafted_tokens"]
+                               if s["drafted_tokens"] else 0.0)
+        vals["mean_accepted_len"] = (s["accepted_tokens"] / s["verify_steps"]
+                                     if s["verify_steps"] else 0.0)
+        vals["tokens_per_step"] = (s["emitted_tokens"] / s["step_seqs"]
+                                   if s["step_seqs"] else 0.0)
+        vals["verify_batch_occupancy"] = (
+            s["verify_positions"] / s["verify_capacity"]
+            if s["verify_capacity"] else 0.0)
+        return [(f"Serving/spec/{k}", float(v), step)
+                for k, v in sorted(vals.items())]
+
+    def publish_spec_telemetry(self, step: int = 0):
+        events = self.spec_events(step)
+        if self._hub is not None:
+            for name, value, s in events:
+                self._hub.serving_event(name, value, s)
+        return events
+
+    # ------------------------------------------------------------------ #
     # latency SLOs: TTFT / inter-token latency / queue time / e2e, with
     # p50/p90/p99 (docs/serving.md). Samples accumulate while tracing is on.
     # ------------------------------------------------------------------ #
@@ -942,11 +1236,13 @@ class InferenceEngineV2(InferenceEngine):
                 self._prefill_admitted(
                     batch_adm, [sp_for[uid] for uid, _, _ in batch_adm],
                     seed=seed, cached=batch_cached)
-            if steps_per_sync > 1:
+            if steps_per_sync > 1 and not self._spec_on:
                 k = max(1, min(steps_per_sync, max_new_tokens))
                 self.step_many(k, seed=seed + step_i)
                 step_i += k
             else:
+                # spec mode always steps here: a verify step already emits
+                # multiple tokens per host sync, subsuming steps_per_sync
                 self.step(seed=seed + step_i)
                 step_i += 1
             for uid in list(self.state.seqs):
@@ -970,6 +1266,8 @@ class InferenceEngineV2(InferenceEngine):
             # a hub-attached run lands its SLO percentiles in the monitor
             # stream for telemetry_report.py --latency; trace off → no events
             self.publish_latency_telemetry(step_i)
+        if self._spec_on and self._hub is not None:
+            self.publish_spec_telemetry(step_i)
         return [results[i] for i in range(len(prompts))]
 
 

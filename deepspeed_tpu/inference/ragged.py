@@ -18,7 +18,10 @@ cached prefix of a new prompt to existing blocks instead of re-prefilling it;
 retired sequences' indexed blocks park in a retained LRU pool (refcount 0,
 off the free list) and are evicted back to the free list only under
 allocation pressure. Copy-on-write (``ensure_writable``) keeps appends into a
-shared block safe: the writer gets a private copy first. All of it is
+shared block safe: the writer gets a private copy first. ``truncate`` is the
+inverse of ``extend`` — KV rollback for speculative decoding: rejected draft
+positions are un-filled, now-empty tail blocks are released, and a shared
+tail block is copied on write so siblings keep the original. All of it is
 host-side — the paged decode kernel reads arbitrary block tables, so shared
 blocks need zero kernel changes.
 """
@@ -411,6 +414,58 @@ class StateManager:
             desc.block_hashes.append(h)
             self.index.insert(desc.blocks[i], h)
 
+    def truncate(self, desc: SequenceDescriptor,
+                 new_len: int) -> List[Tuple[int, int]]:
+        """KV rollback: un-fill positions ``[new_len, seen_tokens)`` — the
+        speculative-decoding endpoint that discards rejected draft positions
+        after batched verification (docs/serving.md). Host-side only: the
+        device cache keeps the stale KV, but ``seen_tokens`` bounds every
+        read and the positions are rewritten before they are next visible.
+
+        - trailing blocks that no longer cover any kept position are
+          released through the normal refcount protocol (shared blocks lose
+          one holder, indexed blocks park in the retained LRU, the rest go
+          back to the free list);
+        - a now-PARTIAL tail block that is **shared** (prefix-cache match or
+          ``fork``) is copied on write immediately — the rolled-back suffix
+          will be rewritten, and the other holders must keep the original.
+          Returns ``(src, dst)`` pairs exactly like :meth:`ensure_writable`;
+          the caller must stamp the device copies before the next write;
+        - a now-partial tail block that is privately owned but *indexed* is
+          dropped from the prefix index: its content is about to diverge
+          from its chain hash, and a future admission must not resolve to it.
+
+        ``desc.tokens`` and ``desc.block_hashes`` are trimmed to match, so
+        ``debug_check`` invariants hold immediately after the call."""
+        if isinstance(desc, int):
+            desc = self.seqs[desc]
+        if not 0 < new_len <= desc.seen_tokens:
+            raise ValueError(
+                f"truncate(uid={desc.uid}): new_len {new_len} outside "
+                f"(0, {desc.seen_tokens}]")
+        bs = self.block_size
+        n_keep = (new_len + bs - 1) // bs
+        while len(desc.blocks) > n_keep:
+            self._release_block(desc.blocks.pop())
+        del desc.tokens[new_len:]
+        desc.seen_tokens = new_len
+        n_full = new_len // bs
+        if len(desc.block_hashes) > n_full:
+            del desc.block_hashes[n_full:]
+        pairs: List[Tuple[int, int]] = []
+        if new_len % bs:                 # tail block now only partially valid
+            tail = desc.blocks[n_keep - 1]
+            if self.allocator.refcount(tail) > 1:
+                self._reclaim(1)
+                dst = self.allocator.allocate(1)[0]
+                self.allocator.release(tail)   # >= 1 holder remains
+                desc.blocks[n_keep - 1] = dst
+                pairs.append((tail, dst))
+                self.prefix_stats["cow_copies"] += 1
+            elif self.index.is_indexed(tail):
+                self.index.drop(tail)
+        return pairs
+
     def extend(self, desc: SequenceDescriptor, n: int = 1) -> None:
         """Ensure the block table covers ``n`` more tokens (n > 1 is the
         multi-step decode path: capacity is reserved up front so a fused
@@ -483,3 +538,15 @@ class StateManager:
             alloc.num_blocks - 1, "free + live + retained != pool size"
         n_slots = len(self._free_slots) + len(self.seqs)
         assert n_slots == self.max_sequences, "slot accounting broken"
+        bs = self.block_size
+        for d in self.seqs.values():
+            assert len(d.blocks) * bs >= d.seen_tokens, \
+                f"uid {d.uid}: {len(d.blocks)} blocks cannot cover " \
+                f"{d.seen_tokens} seen tokens"
+            assert len(d.block_hashes) <= len(d.blocks), \
+                f"uid {d.uid}: more block hashes than blocks"
+            # hashes only ever cover FULL written-and-recorded chunks
+            # (truncate trims them alongside tokens/seen_tokens)
+            assert len(d.block_hashes) * bs <= max(d.seen_tokens,
+                                                   len(d.tokens)), \
+                f"uid {d.uid}: block hashes past the recorded tokens"

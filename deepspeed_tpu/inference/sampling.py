@@ -3,6 +3,11 @@
 Reference parity: the sampling the reference delegates to HF ``generate``;
 v2 exposes logits and lets the client sample. Here sampling is a pure function
 so it fuses into the decode step.
+
+``filter_logits`` / ``filter_logits_batch`` expose the temperature/top-k/top-p
+filtering WITHOUT the final draw — the speculative-decoding verifier
+(``engine_v2``) needs the filtered distribution itself to accept/reject draft
+tokens by exact rejection sampling.
 """
 
 from __future__ import annotations
@@ -21,40 +26,52 @@ class SamplingParams(NamedTuple):
     greedy: bool = False
 
 
+def filter_logits(logits: jnp.ndarray,
+                  params: SamplingParams) -> jnp.ndarray:
+    """Temperature/top-k/top-p filtered logits (static params), ready for
+    ``jax.random.categorical``. ONE shared descending sort serves both the
+    top-k cutoff and the top-p cumulative scan — the filters used to sort the
+    logits twice per decode step. The top-p stage runs over the top-k-FILTERED
+    order: masking the sorted array below the k-th value is exactly the sort
+    of the filtered logits (ties at the cutoff stay kept, matching the
+    historical `logits < kth` semantics)."""
+    logits = logits / jnp.maximum(params.temperature, 1e-6)
+    srt = None
+    if params.top_k > 0 or params.top_p < 1.0:
+        srt = jnp.sort(logits, axis=-1)[..., ::-1]        # descending, once
+    if params.top_k > 0:
+        k = min(params.top_k, logits.shape[-1])
+        kth = srt[..., k - 1][..., None]                  # k-th largest
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+        srt = jnp.where(srt < kth, -jnp.inf, srt)
+    if params.top_p < 1.0:
+        probs = jax.nn.softmax(srt, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep the smallest set with cumulative prob >= top_p (always keep #1);
+        # the cutoff is the SMALLEST kept logit
+        keep = cum - probs < params.top_p
+        cutoff = jnp.min(jnp.where(keep, srt, jnp.inf), axis=-1,
+                         keepdims=True)
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return logits
+
+
 def sample(rng: jax.Array, logits: jnp.ndarray,
            params: SamplingParams = SamplingParams()) -> jnp.ndarray:
     """logits [..., vocab] → token ids [...]. Static sampling params."""
     if params.greedy or params.temperature == 0.0:
         return jnp.argmax(logits, axis=-1)
-    logits = logits / jnp.maximum(params.temperature, 1e-6)
-    if params.top_k > 0:
-        k = min(params.top_k, logits.shape[-1])
-        kth = jnp.sort(logits, axis=-1)[..., -k][..., None]
-        logits = jnp.where(logits < kth, -jnp.inf, logits)
-    if params.top_p < 1.0:
-        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
-        probs = jax.nn.softmax(sorted_logits, axis=-1)
-        cum = jnp.cumsum(probs, axis=-1)
-        # keep the smallest set with cumulative prob >= top_p (always keep #1);
-        # the cutoff is the SMALLEST kept logit
-        keep = cum - probs < params.top_p
-        cutoff = jnp.min(jnp.where(keep, sorted_logits, jnp.inf), axis=-1,
-                         keepdims=True)
-        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
-    return jax.random.categorical(rng, logits, axis=-1)
+    return jax.random.categorical(rng, filter_logits(logits, params), axis=-1)
 
 
-def sample_batch(rng: jax.Array, logits: jnp.ndarray,
-                 temperature: jnp.ndarray, top_k: jnp.ndarray,
-                 top_p: jnp.ndarray, greedy: jnp.ndarray) -> jnp.ndarray:
-    """Per-ROW sampling params, all traced: logits [B, V]; temperature/top_p
-    f32 [B]; top_k int32 [B] (0 = disabled); greedy bool [B]. One compiled
-    program serves any mix of client sampling configs (the reference's v2
-    engine carries per-request sampling the same way). Rows with greedy or
-    temperature 0 take the argmax; the rest sample through their own
-    temperature/top-k/top-p filter."""
+def filter_logits_batch(logits: jnp.ndarray, temperature: jnp.ndarray,
+                        top_k: jnp.ndarray, top_p: jnp.ndarray) -> jnp.ndarray:
+    """Per-ROW filtered logits, all params traced: logits [B, V];
+    temperature/top_p f32 [B]; top_k int32 [B] (0 = disabled). The traced
+    counterpart of :func:`filter_logits` — one compiled program serves any
+    mix of client sampling configs. Greedy rows are the caller's concern
+    (``sample_batch`` overlays argmax)."""
     B, V = logits.shape
-    argmax = jnp.argmax(logits, axis=-1)
     t = jnp.maximum(temperature, 1e-6)[:, None]
     scaled = logits / t
     srt = jnp.sort(scaled, axis=-1)[:, ::-1]              # descending
@@ -75,7 +92,20 @@ def sample_batch(rng: jax.Array, logits: jnp.ndarray,
     # rounds up could otherwise drop a valid tail column for those rows
     keep = jnp.logical_or(keep, (top_p >= 1.0)[:, None])
     cutoff = jnp.min(jnp.where(keep, srt_k, jnp.inf), axis=-1, keepdims=True)
-    filt = jnp.where(scaled < cutoff, -jnp.inf, filt)
+    return jnp.where(scaled < cutoff, -jnp.inf, filt)
+
+
+def sample_batch(rng: jax.Array, logits: jnp.ndarray,
+                 temperature: jnp.ndarray, top_k: jnp.ndarray,
+                 top_p: jnp.ndarray, greedy: jnp.ndarray) -> jnp.ndarray:
+    """Per-ROW sampling params, all traced: logits [B, V]; temperature/top_p
+    f32 [B]; top_k int32 [B] (0 = disabled); greedy bool [B]. One compiled
+    program serves any mix of client sampling configs (the reference's v2
+    engine carries per-request sampling the same way). Rows with greedy or
+    temperature 0 take the argmax; the rest sample through their own
+    temperature/top-k/top-p filter."""
+    argmax = jnp.argmax(logits, axis=-1)
+    filt = filter_logits_batch(logits, temperature, top_k, top_p)
     sampled = jax.random.categorical(rng, filt, axis=-1)
     pick_greedy = jnp.logical_or(greedy, temperature <= 0.0)
     return jnp.where(pick_greedy, argmax, sampled)

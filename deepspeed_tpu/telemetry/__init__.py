@@ -10,5 +10,6 @@ from .hub import TelemetryHub  # noqa: F401
 from .memory import MemoryTelemetry  # noqa: F401
 from .metrics_server import MetricsServer  # noqa: F401
 from .profiler import ProfilerSession, annotate  # noqa: F401
-from .schema import validate_events, validate_jsonl_records  # noqa: F401
+from .schema import (SERVING_SERIES, validate_events,  # noqa: F401
+                     validate_jsonl_records)
 from .trace import TraceConfig, Tracer, dump_all, percentiles  # noqa: F401

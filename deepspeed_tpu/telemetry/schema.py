@@ -14,7 +14,11 @@ Checked invariants:
 - value is a finite number;
 - step is a non-negative integer;
 - steps are monotonically NON-DECREASING per series (a series that jumps
-  backwards breaks every "last sample wins" consumer).
+  backwards breaks every "last sample wins" consumer);
+- ``Serving/*`` names come from the CLOSED registry below — the serving
+  engine's counter families are enumerated per metric, so a typo'd or
+  unregistered serving series (which ``telemetry_report.py --serving`` and
+  the Prometheus mapper would silently ignore) fails validation instead.
 """
 
 from __future__ import annotations
@@ -23,9 +27,27 @@ import math
 import re
 from typing import Any, Dict, Iterable, List, Tuple
 
-__all__ = ["EVENT_NAME_RE", "validate_events", "validate_jsonl_records"]
+__all__ = ["EVENT_NAME_RE", "SERVING_SERIES", "validate_events",
+           "validate_jsonl_records"]
 
 EVENT_NAME_RE = re.compile(r"^[A-Z][A-Za-z0-9_]*(/[A-Za-z0-9_.\-]+)+$")
+
+# Registered Serving/* series — every counter/gauge the v2 serving engine
+# emits (engine_v2: prefix_cache_events, latency_events, spec_events).
+# Adding an engine counter REQUIRES registering its name here, or the tier-1
+# event-schema tests fail on the first run that emits it.
+SERVING_SERIES = frozenset(
+    ["Serving/prefix_cache/" + m for m in (
+        "lookups", "hits", "hit_tokens", "prefill_tokens_saved",
+        "evictions", "cow_copies", "retained_blocks")]
+    + [f"Serving/latency/{m}_{s}"
+       for m in ("ttft_ms", "itl_ms", "queue_ms", "e2e_ms")
+       for s in ("p50", "p90", "p99", "count")]
+    + ["Serving/spec/" + m for m in (
+        "verify_steps", "decode_steps", "step_seqs", "drafted_tokens",
+        "accepted_tokens", "emitted_tokens", "rolled_back_tokens",
+        "verify_positions", "verify_capacity", "accept_rate",
+        "mean_accepted_len", "tokens_per_step", "verify_batch_occupancy")])
 
 
 def validate_events(events: Iterable[Tuple[str, float, int]]) -> List[str]:
@@ -43,6 +65,10 @@ def validate_events(events: Iterable[Tuple[str, float, int]]) -> List[str]:
         if not isinstance(name, str) or not EVENT_NAME_RE.match(name):
             problems.append(f"event #{i}: name {name!r} violates the "
                             f"Group/.../metric convention")
+            continue
+        if name.startswith("Serving/") and name not in SERVING_SERIES:
+            problems.append(f"event #{i}: serving series {name!r} is not "
+                            f"registered in telemetry.schema.SERVING_SERIES")
             continue
         try:
             v = float(value)

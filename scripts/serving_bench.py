@@ -10,7 +10,10 @@ periodic prefills exactly the way FastGen's steady-state benchmark does
 Reports generated tok/s at 2-3 client counts, plus a shared-system-prompt
 workload (N clients sharing a long common prefix) that measures the paged
 engine's prefix cache ON vs OFF: tok/s, hit-rate, and prefill_tokens_saved
-(docs/serving.md). ONE JSON line.
+(docs/serving.md), plus a decode-heavy workload (short repetitive prompts,
+long generations) that measures speculative decoding ON vs OFF: tok/s,
+accept rate, ITL p50/p99, and model forward passes per generated token.
+ONE JSON line.
 """
 
 import json
@@ -152,19 +155,112 @@ def run_shared_prefix(build, sp, vocab, rng, batch, shared_len, tail_len,
     return out
 
 
-def _dump_serving_telemetry(eng, out_dir):
-    """Write the engine's Serving/prefix_cache/* counters as a TelemetryHub
-    JSONL file for ``scripts/telemetry_report.py --serving``."""
+def _dump_serving_telemetry(eng, out_dir, job="serving_bench", spec=False):
+    """Write the engine's Serving/prefix_cache/* counters (and, for the
+    decode workload, Serving/spec/*) as a TelemetryHub JSONL file for
+    ``scripts/telemetry_report.py --serving``."""
     from deepspeed_tpu.monitor.monitor import JSONLMonitor
 
     class _Cfg:
         enabled = True
         output_path = out_dir
-        job_name = "serving_bench"
+        job_name = job
 
     mon = JSONLMonitor(_Cfg())
     mon.write_events(eng.prefix_cache_events(step=0))
+    if spec:
+        mon.write_events(eng.spec_events(step=0))
     mon.close()
+
+
+def run_decode_heavy(build, sp, vocab, rng, batch, prompt_len, gen_len,
+                     measure_s, pattern_len=6):
+    """Decode-heavy workload (docs/serving.md): short REPETITIVE prompts
+    (a ``pattern_len``-token pattern tiled to ``prompt_len`` — the
+    prompt-lookup drafter's best case, standing in for quoted-context /
+    multi-turn-echo traffic) and long generations, run with speculative
+    decoding OFF then ON. Reports generated tok/s, per-token latency
+    p50/p99 (a spec step emits several tokens, so each token's ITL is the
+    step time divided by the tokens it produced), the accept-rate /
+    tokens-per-step counters, and model forward passes per generated token —
+    the number speculative decoding exists to shrink."""
+    import numpy as np
+
+    out = {"prompt_len": prompt_len, "gen_len": gen_len, "batch": batch}
+    for label, enabled in (("spec_off", False), ("spec_on", True)):
+        prompt_rng = np.random.default_rng(13)
+
+        def make_prompt(_uid):
+            pat = prompt_rng.integers(0, vocab, (pattern_len,),
+                                      dtype=np.int32).tolist()
+            reps = (prompt_len + pattern_len - 1) // pattern_len
+            return (pat * reps)[:prompt_len]
+
+        eng = build(enabled)
+        try:
+            uid = 0
+
+            def admit():
+                nonlocal uid
+                eng.put(uid, make_prompt(uid), sp, seed=uid)
+                uid += 1
+
+            def live_tokens():
+                return sum(min(len(d.generated), gen_len)
+                           for d in eng.state.seqs.values())
+
+            for _ in range(batch):
+                admit()
+            eng.step(sp)                        # warm the compiled programs
+            base = live_tokens()
+            produced_retired = 0
+            model_steps = 0
+            itl_ms = []
+            t0 = time.perf_counter()
+            while time.perf_counter() - t0 < measure_s:
+                before = live_tokens()
+                tc = time.perf_counter()
+                eng.step(sp)
+                dt_ms = (time.perf_counter() - tc) * 1e3
+                model_steps += 1
+                emitted = max(1, live_tokens() - before)
+                itl_ms.extend([dt_ms / emitted] * emitted)
+                for d in list(eng.state.seqs.values()):
+                    if len(d.generated) >= gen_len:
+                        produced_retired += gen_len
+                        eng.finish(d.uid)
+                        admit()
+            dt = time.perf_counter() - t0
+            produced = produced_retired + live_tokens() - base
+            stats = dict(eng.spec_stats)
+            tel_dir = os.environ.get("DSTPU_SERVING_TELEMETRY")
+            if enabled and tel_dir:
+                _dump_serving_telemetry(eng, tel_dir,
+                                        job="serving_bench_spec", spec=True)
+            for d in list(eng.state.seqs.values()):
+                eng.finish(d.uid)
+            arr = np.asarray(itl_ms)
+            steps = stats["verify_steps"] + stats["decode_steps"]
+            row = {"tok_per_sec": round(produced / dt, 1),
+                   "itl_p50_ms": round(float(np.percentile(arr, 50)), 2),
+                   "itl_p99_ms": round(float(np.percentile(arr, 99)), 2),
+                   "model_steps": model_steps,
+                   "fwd_per_token": round(model_steps / max(1, produced), 3)}
+            if enabled:
+                row["accept_rate"] = round(
+                    stats["accepted_tokens"] / stats["drafted_tokens"], 3) \
+                    if stats["drafted_tokens"] else 0.0
+                row["tokens_per_step"] = round(
+                    stats["emitted_tokens"] / stats["step_seqs"], 3) \
+                    if stats["step_seqs"] else 0.0
+                row["verify_steps"] = stats["verify_steps"]
+                row["drafted_tokens"] = stats["drafted_tokens"]
+                row["accepted_tokens"] = stats["accepted_tokens"]
+            out[label] = row
+            sys.stderr.write(f"[serving] decode_heavy {label}: {row}\n")
+        finally:
+            del eng
+    return out
 
 
 def run_longprompt_probe(build, sp, vocab, rng, batch, short_len, long_len,
@@ -336,6 +432,37 @@ def main():
             gen_sp, meas_sp, quantum=q_sp)
     except Exception as e:
         RESULT["detail"]["shared_prefix"] = f"error: {str(e)[-200:]}"
+
+    # decode-heavy workload: speculative decoding ON vs OFF (docs/serving.md)
+    # — short repetitive prompts, long generations; records the decode
+    # trajectory (tok/s, accept rate, ITL p50/p99, fwd passes per token) for
+    # the silicon rounds (BENCH_r06.json onward)
+    try:
+        if on_tpu:
+            batch_sd, plen_sd, glen_sd, meas_sd, k_sd = 16, 64, 256, 20.0, 6
+            bs_sd = 32
+        else:
+            batch_sd, plen_sd, glen_sd, meas_sd, k_sd = 4, 24, 16, 5.0, 4
+            bs_sd = 16
+
+        def build_sd(spec_on):
+            nb = (batch_sd + 1) * ((plen_sd + glen_sd) // bs_sd + 3) + 8
+            return build_engine_v2(
+                llama, mcfg, llama.init(mcfg, jax.random.PRNGKey(0)),
+                config={"dtype": "bfloat16",
+                        "prefill_bucket": min(64, plen_sd),
+                        "speculative": {"enabled": spec_on,
+                                        "max_draft_tokens": k_sd},
+                        "ragged": {"max_tracked_sequences": batch_sd,
+                                   "max_ragged_batch_size": batch_sd,
+                                   "memory_config_blocks": nb,
+                                   "block_size": bs_sd}})
+
+        RESULT["detail"]["decode_heavy"] = run_decode_heavy(
+            build_sd, sp, mcfg.vocab_size, rng, batch_sd, plen_sd, glen_sd,
+            meas_sd)
+    except Exception as e:
+        RESULT["detail"]["decode_heavy"] = f"error: {str(e)[-200:]}"
 
     # head-of-line probe: long-prompt admission stall, split vs one-shot
     try:

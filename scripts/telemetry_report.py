@@ -149,37 +149,74 @@ def reliability(events: List[dict]) -> str:
 
 def serving(events: List[dict]) -> str:
     """``--serving``: prefix-cache hit-rate, prefill tokens saved, retained-
-    pool occupancy and evictions from the ``Serving/prefix_cache/*`` stream
+    pool occupancy and evictions from the ``Serving/prefix_cache/*`` stream,
+    plus the speculative-decoding efficiency counters from ``Serving/spec/*``
     (paged serving engine — docs/serving.md). These series carry CUMULATIVE
-    counter values (gauges for occupancy), so the last sample per series is
-    the run total — unlike ``--reliability``'s one-line-per-occurrence."""
+    counter values (gauges for occupancy/rates), so the last sample per
+    series is the run total — unlike ``--reliability``'s
+    one-line-per-occurrence."""
     srv = [e for e in events if e["name"].startswith("Serving/prefix_cache/")]
-    if not srv:
-        return "serving: no Serving/prefix_cache/* events in this file"
-    last: Dict[str, float] = {}
-    last_step: Dict[str, int] = {}
-    for e in srv:
-        key = e["name"][len("Serving/prefix_cache/"):]
-        last[key] = e["value"]                       # cumulative: last wins
-        last_step[key] = max(last_step.get(key, 0), int(e.get("step", 0)))
-    lines = [f"serving prefix-cache report ({len(srv)} events)"]
-    lines.append(f"  {'counter':<24} {'total':>14} {'last step':>10}")
-    for key in sorted(last):
-        lines.append(f"  {key:<24} {last[key]:>14,.0f} {last_step[key]:>10}")
-    lines.append("")
-    lookups = last.get("lookups", 0.0)
-    hits = last.get("hits", 0.0)
-    lines.append(f"  admissions (lookups):   {lookups:,.0f}")
-    lines.append(f"  prefix hits:            {hits:,.0f}")
-    lines.append(f"  hit rate:               "
-                 f"{hits / lookups * 100 if lookups else 0.0:.1f}%")
-    lines.append(f"  hit tokens:             {last.get('hit_tokens', 0):,.0f}")
-    lines.append(f"  prefill tokens saved:   "
-                 f"{last.get('prefill_tokens_saved', 0):,.0f}")
-    lines.append(f"  copy-on-write copies:   {last.get('cow_copies', 0):,.0f}")
-    lines.append(f"  evictions:              {last.get('evictions', 0):,.0f}")
-    lines.append(f"  retained blocks (now):  "
-                 f"{last.get('retained_blocks', 0):,.0f}")
+    spec = [e for e in events if e["name"].startswith("Serving/spec/")]
+    if not srv and not spec:
+        return ("serving: no Serving/prefix_cache/* or Serving/spec/* "
+                "events in this file")
+    lines: List[str] = []
+    if srv:
+        last: Dict[str, float] = {}
+        last_step: Dict[str, int] = {}
+        for e in srv:
+            key = e["name"][len("Serving/prefix_cache/"):]
+            last[key] = e["value"]                   # cumulative: last wins
+            last_step[key] = max(last_step.get(key, 0), int(e.get("step", 0)))
+        lines.append(f"serving prefix-cache report ({len(srv)} events)")
+        lines.append(f"  {'counter':<24} {'total':>14} {'last step':>10}")
+        for key in sorted(last):
+            lines.append(f"  {key:<24} {last[key]:>14,.0f} "
+                         f"{last_step[key]:>10}")
+        lines.append("")
+        lookups = last.get("lookups", 0.0)
+        hits = last.get("hits", 0.0)
+        lines.append(f"  admissions (lookups):   {lookups:,.0f}")
+        lines.append(f"  prefix hits:            {hits:,.0f}")
+        lines.append(f"  hit rate:               "
+                     f"{hits / lookups * 100 if lookups else 0.0:.1f}%")
+        lines.append(f"  hit tokens:             "
+                     f"{last.get('hit_tokens', 0):,.0f}")
+        lines.append(f"  prefill tokens saved:   "
+                     f"{last.get('prefill_tokens_saved', 0):,.0f}")
+        lines.append(f"  copy-on-write copies:   "
+                     f"{last.get('cow_copies', 0):,.0f}")
+        lines.append(f"  evictions:              "
+                     f"{last.get('evictions', 0):,.0f}")
+        lines.append(f"  retained blocks (now):  "
+                     f"{last.get('retained_blocks', 0):,.0f}")
+    if spec:
+        if lines:
+            lines.append("")
+        sp: Dict[str, float] = {}
+        for e in spec:
+            sp[e["name"][len("Serving/spec/"):]] = e["value"]  # last wins
+        lines.append(f"speculative decoding report ({len(spec)} events)")
+        steps = sp.get("verify_steps", 0.0) + sp.get("decode_steps", 0.0)
+        lines.append(f"  model steps:            {steps:,.0f} "
+                     f"({sp.get('verify_steps', 0):,.0f} verify, "
+                     f"{sp.get('decode_steps', 0):,.0f} plain decode)")
+        lines.append(f"  drafted tokens:         "
+                     f"{sp.get('drafted_tokens', 0):,.0f}")
+        lines.append(f"  accepted tokens:        "
+                     f"{sp.get('accepted_tokens', 0):,.0f}")
+        lines.append(f"  rolled-back tokens:     "
+                     f"{sp.get('rolled_back_tokens', 0):,.0f}")
+        lines.append(f"  emitted tokens:         "
+                     f"{sp.get('emitted_tokens', 0):,.0f}")
+        lines.append(f"  accept rate:            "
+                     f"{sp.get('accept_rate', 0) * 100:.1f}%")
+        lines.append(f"  mean accepted length:   "
+                     f"{sp.get('mean_accepted_len', 0):.2f} tok/verify")
+        lines.append(f"  tokens per model step:  "
+                     f"{sp.get('tokens_per_step', 0):.2f} per sequence")
+        lines.append(f"  verify batch occupancy: "
+                     f"{sp.get('verify_batch_occupancy', 0) * 100:.1f}%")
     return "\n".join(lines)
 
 
@@ -335,9 +372,12 @@ def main(argv=None) -> int:
                          "watchdog trips, checkpoint save/restore/rollback "
                          "counts")
     ap.add_argument("--serving", action="store_true",
-                    help="summarize Serving/prefix_cache/* counters: "
-                         "hit-rate, prefill tokens saved, retained-pool "
-                         "occupancy, evictions")
+                    help="summarize Serving/prefix_cache/* counters "
+                         "(hit-rate, prefill tokens saved, retained-pool "
+                         "occupancy, evictions) and Serving/spec/* "
+                         "speculative-decoding counters (accept rate, mean "
+                         "accepted length, tokens per model step, verify "
+                         "batch occupancy)")
     ap.add_argument("--latency", action="store_true",
                     help="summarize Serving/latency/* SLO percentiles: "
                          "TTFT / inter-token / queue / e2e p50-p90-p99")

@@ -108,13 +108,16 @@ while true; do
     # prove serving/longctx/MoE first; each probe checks for a mid-cycle
     # HOLD so an interactive session waits at most one probe.
     # SERVING now also runs the shared-system-prompt prefix-cache workload
-    # (detail.shared_prefix: cache ON vs OFF tok/s + prefill_tokens_saved),
-    # so its budget covers two extra engine builds + measure windows.
+    # (detail.shared_prefix: cache ON vs OFF tok/s + prefill_tokens_saved)
+    # AND the decode-heavy speculative-decoding workload (detail.decode_heavy:
+    # spec ON vs OFF tok/s, accept rate, ITL p50/p99, fwd passes per token —
+    # the r6 decode-trajectory evidence for ROADMAP item 5), so its budget
+    # covers four extra engine builds + measure windows.
     # DSTPU_SERVING_TRACE: one configuration runs with the span tracer on
     # and leaves a Perfetto flight-recorder dump next to the bench json
     # (open in ui.perfetto.dev; summarize with telemetry_report.py --trace)
     hold_requested || DSTPU_SERVING_TRACE="bench_runs/SERVING_trace_${ts}.json" \
-      run_probe SERVING scripts/serving_bench.py 2400 SERVING_TPU_LIVE.json
+      run_probe SERVING scripts/serving_bench.py 3000 SERVING_TPU_LIVE.json
     hold_requested || run_probe LONGCTX scripts/longctx_bench.py 2400 LONGCTX_TPU_LIVE.json
     hold_requested || run_probe MOE scripts/moe_dispatch_bench.py 1200 MOE_TPU_LIVE.json
     # full headline bench incl. shape rows (first compiles are slow)

@@ -35,6 +35,17 @@ def main(log_path: str) -> int:
     with open(PATH, "w") as f:
         json.dump(durations, f, indent=0, sort_keys=True)
     print(f"merged {n} duration lines -> {PATH} ({len(durations)} entries)")
+    # bookkeeping: a test FILE with no recorded durations never gets its
+    # slow tests marked (conftest tags 'slow' from this file), so flag any
+    # tests/test_*.py the durations file doesn't know about yet
+    recorded = {k.split("::")[0] for k in durations}
+    missing = sorted(
+        f"tests/{name}" for name in os.listdir(os.path.join(HERE, "tests"))
+        if name.startswith("test_") and name.endswith(".py")
+        and f"tests/{name}" not in recorded)
+    if missing:
+        print("WARNING: no recorded durations for: " + ", ".join(missing)
+              + " — run those files with --durations=0 and merge the log")
     return 0
 
 
