@@ -4,5 +4,8 @@ from .engine import InferenceEngine, ModelFamily, init_inference  # noqa: F401
 from .engine_v2 import (InferenceEngineV2, build_engine_v2,  # noqa: F401
                         prompt_lookup_draft)
 from .ragged import (BlockedAllocator, PrefixBlockIndex,  # noqa: F401
-                     SequenceDescriptor, StateManager)
+                     SequenceDescriptor, StateManager, UnknownSequenceError)
 from .sampling import SamplingParams, sample  # noqa: F401
+from .serving import (ReplicaRouter, Request, RequestHandle,  # noqa: F401
+                      RouterConfig, SchedulerConfig, ServingScheduler,
+                      TrafficGenerator, WorkloadConfig)

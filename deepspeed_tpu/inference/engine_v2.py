@@ -33,7 +33,7 @@ from ..telemetry.trace import Tracer, percentiles
 from ..utils.logging import log_dist
 from .config import InferenceConfig
 from .engine import InferenceEngine, ModelFamily, _round_up
-from .ragged import StateManager
+from .ragged import StateManager, UnknownSequenceError  # noqa: F401 (re-export)
 from .sampling import (SamplingParams, filter_logits_batch, sample,
                        sample_batch, sp_arrays)
 
@@ -221,7 +221,12 @@ class InferenceEngineV2(InferenceEngine):
 
     def _req_drop(self, uid: int) -> None:
         """Admission rolled back — close the spans without latency samples
-        (a cancelled request is not an SLO data point)."""
+        (a cancelled request is not an SLO data point). Deliberately
+        TOLERANT of an absent record: with tracing off no record was ever
+        opened, and the rollback paths call this unconditionally. The
+        error-bearing surface for unknown/already-finished uids is
+        ``finish()``/``park()``/``fork()`` via ``StateManager.lookup``
+        (one consistent :class:`UnknownSequenceError`)."""
         rec = self._req.pop(uid, None)
         if rec is None:
             return
@@ -1055,16 +1060,99 @@ class InferenceEngineV2(InferenceEngine):
         return out
 
     def finish(self, uid: int) -> List[int]:
-        """Retire a sequence, free its blocks, return generated tokens."""
-        desc = self.state.seqs[uid]
+        """Retire a sequence, free its blocks, return generated tokens.
+        An unknown or already-finished uid raises
+        :class:`~deepspeed_tpu.inference.ragged.UnknownSequenceError` with
+        the uid in the message (one consistent error, whichever internal
+        structure would have missed first)."""
+        desc = self.state.lookup(uid)
         self._req_finish(uid, generated=len(desc.generated))
         self._pending_prefill.pop(uid, None)  # cancel an in-flight split
-        self._slot_active[desc.slot] = False
-        self._slot_lens[desc.slot] = 0
-        self._slot_tables[desc.slot] = 0
-        self._slot_sp[desc.slot] = SamplingParams(greedy=True)
+        self._clear_slot(desc.slot)
         self.state.retire(uid)
         return desc.generated
+
+    def _clear_slot(self, s: int) -> None:
+        self._slot_active[s] = False
+        self._slot_lens[s] = 0
+        self._slot_tables[s] = 0
+        self._slot_sp[s] = SamplingParams(greedy=True)
+
+    # ------------------------------------------------------------------ #
+    # scheduler seams: KV headroom + decode preemption (park/resume) —
+    # docs/serving.md "Scheduler & router"
+    # ------------------------------------------------------------------ #
+    def kv_headroom(self) -> Dict[str, int]:
+        """Admission-control snapshot for a scheduler: free/retained/total
+        KV blocks and free sequence slots. ``headroom_blocks`` is the number
+        an admission could actually obtain (retained prefix blocks are
+        evicted on demand)."""
+        st = self.state
+        return {"free_blocks": st.allocator.free_blocks,
+                "retained_blocks": st.retained_blocks,
+                "headroom_blocks": st.headroom_blocks,
+                "free_slots": st.free_slots,
+                "total_blocks": st.allocator.num_blocks - 1}
+
+    def park(self, uid: int) -> Dict[str, Any]:
+        """Preempt a sequence: capture everything needed to continue it
+        later, then release its slot and KV blocks. With the prefix cache
+        enabled the victim's full blocks park in the retained LRU pool, so
+        :meth:`resume` re-prefills only what eviction reclaimed in between;
+        with the cache off, resume re-prefills the whole history. The
+        request's trace record stays open (park/resume is invisible to the
+        client except as latency), and an instant marks the gap."""
+        desc = self.state.lookup(uid)
+        self._pending_prefill.pop(uid, None)   # mid-split park: chunks stop
+        history = list(desc.tokens) if desc.prefilling \
+            else list(desc.tokens) + [desc.last_token]
+        parked = {"uid": uid, "history": history,
+                  "generated": list(desc.generated),
+                  "prompt_len": len(history) - len(desc.generated),
+                  "sp": self._slot_sp[desc.slot]}
+        self._clear_slot(desc.slot)
+        self.state.retire(uid)
+        if self._trace_on:
+            rec = self._req.get(uid)
+            self.tracer.instant(
+                "parked", cat="serving",
+                trace=rec["trace"] if rec else None,
+                parent=rec["span"].span_id if rec else None,
+                uid=uid, kv_tokens=len(history))
+        return parked
+
+    def resume(self, parked: Dict[str, Any], seed: int = 0,
+               split: bool = False) -> List[int]:
+        """Re-admit a :meth:`park`-ed sequence and continue its stream:
+        the full history (prompt + every generated token) is re-prefilled —
+        resolving retained blocks through the prefix cache when enabled —
+        and the first token sampled afterwards is exactly the next stream
+        token, so a greedy park/resume cycle is token-identical to an
+        uninterrupted run (pinned by tests). Returns the newly emitted
+        tokens: one for a one-shot resume, ``[]`` when ``split=True``
+        defers the prompt to chunked prefill (the token then arrives from
+        a later ``step()``). ``generated`` continuity is restored, so
+        ``finish()`` returns the complete stream."""
+        uid, sp = parked["uid"], parked["sp"]
+        history = parked["history"]
+        if split:
+            self.put_split(uid, history, sp)
+            self.state.seqs[uid].generated = list(parked["generated"])
+            if self._trace_on:
+                self._resume_instant(uid, split=True)
+            return []
+        tok = self.put(uid, history, sp, seed=seed)
+        self.state.seqs[uid].generated = list(parked["generated"]) + [tok]
+        if self._trace_on:
+            self._resume_instant(uid, split=False)
+        return [tok]
+
+    def _resume_instant(self, uid: int, split: bool) -> None:
+        rec = self._req.get(uid)
+        self.tracer.instant("resumed", cat="serving",
+                            trace=rec["trace"] if rec else None,
+                            parent=rec["span"].span_id if rec else None,
+                            uid=uid, split=split)
 
     def fork(self, uid: int, new_uid: int,
              sp: Optional[SamplingParams] = None):

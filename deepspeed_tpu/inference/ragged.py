@@ -36,6 +36,23 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 
+class UnknownSequenceError(KeyError):
+    """An operation named a uid that is not currently tracked — never
+    admitted, already finished, or parked by the scheduler. One error type
+    with the uid in the message, regardless of which internal structure
+    would have missed first (``seqs``, slot arrays, pending-prefill map);
+    subclasses ``KeyError`` so pre-existing callers keep working."""
+
+    def __init__(self, uid):
+        super().__init__(
+            f"uid {uid} is not a tracked sequence (never admitted, already "
+            f"finished, or parked)")
+        self.uid = uid
+
+    def __str__(self) -> str:          # KeyError.__str__ would repr-quote it
+        return self.args[0]
+
+
 class BlockedAllocator:
     """Ref-counted free-list allocator over a fixed pool of KV blocks
     (reference ``inference/v2/ragged/blocked_allocator.py``). Block 0 is never
@@ -268,6 +285,51 @@ class StateManager:
     def retained_blocks(self) -> int:
         return self.index.retained_blocks
 
+    @property
+    def headroom_blocks(self) -> int:
+        """Blocks an admission or decode extension could obtain right now:
+        the free list plus the retained prefix pool (``_reclaim`` evicts
+        retained blocks on demand, so they are allocatable capacity — the
+        same accounting ``can_admit`` uses)."""
+        return self.allocator.free_blocks + self.index.retained_blocks
+
+    def lookup(self, uid: int) -> SequenceDescriptor:
+        """The descriptor for ``uid``, or :class:`UnknownSequenceError` —
+        the one consistent error surface for unknown/already-finished uids."""
+        try:
+            return self.seqs[uid]
+        except KeyError:
+            raise UnknownSequenceError(uid) from None
+
+    def blocks_needed(self, prompt_len: int) -> int:
+        """Blocks ``admit``/``admit_prompt`` would claim for a prompt of
+        this length (prompt coverage + one pre-reserved decode block) —
+        the admission-control number a scheduler budgets against."""
+        return self._admit_need(prompt_len)
+
+    def growth_blocks_short(self, descs=None, n: int = 1) -> int:
+        """Shortfall (0 = safe) between the blocks the next ``n`` decode
+        tokens of ``descs`` (default: every live, non-prefilling sequence)
+        would claim and the current headroom. Counts both fresh tail blocks
+        (``extend``) and copy-on-write allocations for shared blocks in the
+        write range (``ensure_writable``) — the scheduler preempts until
+        this returns 0, so a decode step can never surface a pool-exhausted
+        error to a request."""
+        if descs is None:
+            descs = [d for d in self.seqs.values()
+                     if not d.finished and not d.prefilling]
+        bs = self.block_size
+        need = 0
+        for d in descs:
+            want = d.seen_tokens + n
+            need += max(0, (want + bs - 1) // bs - len(d.blocks))
+            first = d.seen_tokens // bs
+            last = min((want - 1) // bs, len(d.blocks) - 1)
+            for i in range(first, last + 1):
+                if self.allocator.refcount(d.blocks[i]) > 1:
+                    need += 1          # COW copy before the write lands
+        return max(0, need - self.headroom_blocks)
+
     def _admit_need(self, prompt_len: int) -> int:
         """Blocks for the prompt + one pre-reserved decode block, capped at
         the fixed table width (a prompt near max_seq_len already owns the
@@ -352,7 +414,7 @@ class StateManager:
         """Admit ``new_uid`` sharing ALL of ``uid``'s blocks (parallel
         sampling / best-of-n). Both sequences now share the partial tail
         block; whichever appends first triggers copy-on-write."""
-        parent = self.seqs[uid]
+        parent = self.lookup(uid)
         if parent.prefilling:
             raise ValueError(f"uid {uid} is still prefilling — cannot fork")
         if new_uid in self.seqs:
@@ -438,7 +500,7 @@ class StateManager:
         ``desc.tokens`` and ``desc.block_hashes`` are trimmed to match, so
         ``debug_check`` invariants hold immediately after the call."""
         if isinstance(desc, int):
-            desc = self.seqs[desc]
+            desc = self.lookup(desc)
         if not 0 < new_len <= desc.seen_tokens:
             raise ValueError(
                 f"truncate(uid={desc.uid}): new_len {new_len} outside "
@@ -497,7 +559,8 @@ class StateManager:
             self.allocator.reclaim(b)
 
     def retire(self, uid: int) -> SequenceDescriptor:
-        desc = self.seqs.pop(uid)
+        desc = self.lookup(uid)
+        del self.seqs[uid]
         if not self.prefix_cache:
             self.allocator.free(desc.blocks)
         else:

@@ -1,0 +1,22 @@
+"""Serving layer above ``InferenceEngineV2`` (docs/serving.md):
+
+- :mod:`.scheduler` — Orca/FastGen-style continuous-batching request
+  scheduler: priority/deadline queue, admission control against KV-block
+  headroom, SLO-aware batch composition (chunked prefill interleaved with
+  decode), decode preemption with park/resume, streaming token output;
+- :mod:`.router` — multi-replica front door: prefix-cache-affinity
+  placement via the chain-hash prefix index, load-based fallback, and a
+  drain/remove path for replica loss;
+- :mod:`.workload` — seeded open-loop traffic generation: Poisson/bursty
+  arrivals, multi-turn sessions, mixed prompt/gen-length distributions.
+
+The whole layer drives the engine through its public API (``put``,
+``put_split``, ``step``, ``step_many``, ``park``, ``resume``, ``finish``),
+so serving WITHOUT a scheduler is byte-for-byte the pre-scheduler engine.
+"""
+
+from .scheduler import (QUEUED, RUNNING, PARKED, DONE,  # noqa: F401
+                        REJECTED, Request, RequestHandle, SchedulerConfig,
+                        ServingScheduler)
+from .router import ReplicaRouter, RouterConfig  # noqa: F401
+from .workload import Arrival, TrafficGenerator, WorkloadConfig  # noqa: F401

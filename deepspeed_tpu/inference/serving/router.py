@@ -1,0 +1,188 @@
+"""Multi-replica serving router (docs/serving.md "Scheduler & router").
+
+N engines — each behind its own :class:`~.scheduler.ServingScheduler` —
+behind one front door. Placement is **prefix-cache-affinity first**: the
+router chain-hashes the prompt's full blocks (the same
+``PrefixBlockIndex.chain_hashes`` keys the engines index under) and probes
+every replica's prefix index for the longest cached match, so a follow-up
+turn lands on the replica that already holds its session's KV blocks — the
+hit costs block-table writes instead of prefill compute. When no replica
+holds a usable prefix (or the affinity winner is overloaded past a
+configured slack), placement falls back to least-loaded. ``drain()``
+removes a replica (planned maintenance or loss): its queued AND live
+requests move to the survivors with their handles intact — live sequences
+are parked, and their token histories re-prefill on the new replica (KV
+never crosses engines; host-side history does).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from typing import Callable, Dict, List, Optional, Sequence
+
+from ..ragged import PrefixBlockIndex
+from .scheduler import Request, RequestHandle, ServingScheduler
+
+
+@dataclasses.dataclass
+class RouterConfig:
+    affinity: bool = True          # chain-hash prefix-index placement
+    session_sticky: bool = True    # fall back to the session's last replica
+    # an affinity/sticky winner is honored only while its load (live +
+    # queued) exceeds the least-loaded replica by at most this many requests
+    load_slack: int = 8
+
+
+class ReplicaRouter:
+    """See module docstring. Drive with ``submit()`` + ``step()`` (one
+    scheduler tick per active replica) or ``run()``."""
+
+    def __init__(self, schedulers: Sequence[ServingScheduler],
+                 config: Optional[RouterConfig] = None):
+        if not schedulers:
+            raise ValueError("router needs at least one replica")
+        self.replicas: List[ServingScheduler] = list(schedulers)
+        self.cfg = config or RouterConfig()
+        self._active = [True] * len(self.replicas)
+        self._uids = itertools.count(1)
+        self._session_replica: Dict[int, int] = {}
+        self.stats: Dict[str, int] = {
+            "requests": 0, "affinity_hits": 0, "session_hits": 0,
+            "load_fallbacks": 0, "drains": 0}
+
+    # -- placement -------------------------------------------------------- #
+    def _active_idx(self) -> List[int]:
+        idx = [i for i, a in enumerate(self._active) if a]
+        if not idx:
+            raise RuntimeError("all replicas drained — nowhere to route")
+        return idx
+
+    def load(self, i: int) -> int:
+        sched = self.replicas[i]
+        return sched.live_count + sched.queue_depth
+
+    def affinity_tokens(self, i: int, prompt: Sequence[int]) -> int:
+        """Tokens of ``prompt`` replica ``i`` could resolve from its prefix
+        index right now (0 when its cache is off or nothing matches)."""
+        st = self.replicas[i].engine.state
+        if not st.prefix_cache:
+            return 0
+        bs = st.block_size
+        n = max(0, (len(prompt) - 1) // bs)   # the admit rule: never all
+        if n == 0:
+            return 0
+        hashes = PrefixBlockIndex.chain_hashes(list(prompt), bs, n)
+        return len(st.index.match(hashes)) * bs
+
+    def route(self, request: Request) -> int:
+        """Pick a replica: longest cached prefix wins while its load stays
+        within ``load_slack`` of the least-loaded replica; then session
+        stickiness under the same slack; then least-loaded."""
+        active = self._active_idx()
+        loads = {i: self.load(i) for i in active}
+        least = min(active, key=lambda i: (loads[i], i))
+        if self.cfg.affinity:
+            best, best_tok = least, 0
+            for i in active:
+                tok = self.affinity_tokens(i, request.prompt)
+                if tok > best_tok:
+                    best, best_tok = i, tok
+            if best_tok > 0:
+                if loads[best] - loads[least] <= self.cfg.load_slack:
+                    self.stats["affinity_hits"] += 1
+                    return best
+                self.stats["load_fallbacks"] += 1
+                return least
+        sid = request.session_id
+        if self.cfg.session_sticky and sid is not None:
+            i = self._session_replica.get(sid)
+            if i is not None and self._active[i]:
+                if loads[i] - loads[least] <= self.cfg.load_slack:
+                    self.stats["session_hits"] += 1
+                    return i
+                self.stats["load_fallbacks"] += 1
+        return least
+
+    def submit(self, request: Request,
+               on_token: Optional[Callable[[int], None]] = None
+               ) -> RequestHandle:
+        """Route + submit. uids are router-assigned (globally unique across
+        replicas, so a drain can re-home a request without collisions);
+        the chosen replica index lands on ``handle.replica``."""
+        if request.uid is None:
+            request.uid = next(self._uids)
+        self.stats["requests"] += 1
+        i = self.route(request)
+        handle = self.replicas[i].submit(request, on_token=on_token)
+        handle.replica = i
+        if request.session_id is not None:
+            self._session_replica[request.session_id] = i
+        return handle
+
+    # -- driving ----------------------------------------------------------- #
+    @property
+    def pending(self) -> bool:
+        return any(self.replicas[i].pending for i in range(len(self.replicas))
+                   if self._active[i])
+
+    def step(self) -> None:
+        for i in self._active_idx():
+            self.replicas[i].tick()
+
+    def run(self, max_steps: int = 100000) -> None:
+        steps = 0
+        while self.pending and steps < max_steps:
+            self.step()
+            steps += 1
+        if self.pending:
+            raise RuntimeError(f"router did not drain within {max_steps} "
+                               f"steps")
+
+    # -- replica loss ------------------------------------------------------ #
+    def drain(self, idx: int) -> int:
+        """Remove replica ``idx``: stop placing onto it, park its live
+        sequences, and re-home every queued/parked/live request onto the
+        surviving replicas (same handle objects — streams continue after a
+        re-prefill of each parked history). Returns the number of requests
+        moved."""
+        if not self._active[idx]:
+            raise ValueError(f"replica {idx} is already drained")
+        self._active[idx] = False
+        self.stats["drains"] += 1
+        if not any(self._active):
+            self._active[idx] = True
+            self.stats["drains"] -= 1
+            raise ValueError("cannot drain the last active replica")
+        for sid, i in list(self._session_replica.items()):
+            if i == idx:
+                del self._session_replica[sid]
+        moved = self.replicas[idx].evict_all()
+        for handle, parked in moved:
+            active = self._active_idx()
+            j = min(active, key=lambda i: (self.load(i), i))
+            self.replicas[j].accept(handle, parked=parked)
+            handle.replica = j
+            sid = handle.request.session_id
+            if sid is not None:
+                self._session_replica[sid] = j
+        return len(moved)
+
+    # -- telemetry --------------------------------------------------------- #
+    def router_events(self, step: int = 0):
+        """``Serving/router/*`` telemetry events (registered in
+        ``telemetry/schema.py SERVING_SERIES``)."""
+        vals = {k: float(v) for k, v in self.stats.items()}
+        vals["replicas"] = float(sum(self._active))
+        return [(f"Serving/router/{k}", float(v), step)
+                for k, v in sorted(vals.items())]
+
+    def publish_router_telemetry(self, step: int = 0):
+        events = self.router_events(step)
+        for sched in self.replicas:
+            hub = getattr(sched.engine, "_hub", None)
+            if hub is not None:
+                for name, value, s in events:
+                    hub.serving_event(name, value, s)
+                break
+        return events

@@ -47,7 +47,18 @@ SERVING_SERIES = frozenset(
         "verify_steps", "decode_steps", "step_seqs", "drafted_tokens",
         "accepted_tokens", "emitted_tokens", "rolled_back_tokens",
         "verify_positions", "verify_capacity", "accept_rate",
-        "mean_accepted_len", "tokens_per_step", "verify_batch_occupancy")])
+        "mean_accepted_len", "tokens_per_step", "verify_batch_occupancy")]
+    # continuous-batching scheduler (serving/scheduler.py sched_events)
+    + ["Serving/sched/" + m for m in (
+        "submitted", "admitted", "resumed", "preempted", "rejected",
+        "expired", "completed", "slo_met", "slo_missed", "ticks",
+        "chunked_admissions", "tokens_emitted", "queue_depth",
+        "queue_wait_ms_p50", "queue_wait_ms_p90", "queue_wait_ms_p99",
+        "queue_wait_ms_count", "goodput_frac", "goodput_rps")]
+    # multi-replica router (serving/router.py router_events)
+    + ["Serving/router/" + m for m in (
+        "requests", "affinity_hits", "session_hits", "load_fallbacks",
+        "drains", "replicas")])
 
 
 def validate_events(events: Iterable[Tuple[str, float, int]]) -> List[str]:

@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""Steady-state serving throughput (VERDICT r3 item 5).
+"""Steady-state + open-loop serving benchmarks (VERDICT r3 item 5).
 
 Drives the continuous-batching v2 engine with a mixed prefill/decode workload:
 a closed-loop client keeps `batch` sequences live — whenever one finishes, a
@@ -7,12 +7,18 @@ new prompt is admitted — so every measured step interleaves decode with
 periodic prefills exactly the way FastGen's steady-state benchmark does
 (reference blogs/deepspeed-fastgen: throughput at fixed client count).
 
-Reports generated tok/s at 2-3 client counts, plus a shared-system-prompt
-workload (N clients sharing a long common prefix) that measures the paged
-engine's prefix cache ON vs OFF: tok/s, hit-rate, and prefill_tokens_saved
-(docs/serving.md), plus a decode-heavy workload (short repetitive prompts,
-long generations) that measures speculative decoding ON vs OFF: tok/s,
-accept rate, ITL p50/p99, and model forward passes per generated token.
+Every workload draws its prompts from ``inference.serving.workload``
+(seeded TrafficGenerator), and one shared closed-loop driver
+(``run_closed_loop``) measures them all. Reports generated tok/s at 2-3
+client counts, plus a shared-system-prompt workload (N clients sharing a
+long common prefix) that measures the paged engine's prefix cache ON vs
+OFF: tok/s, hit-rate, and prefill_tokens_saved (docs/serving.md), a
+decode-heavy workload (short repetitive prompts, long generations) that
+measures speculative decoding ON vs OFF: tok/s, accept rate, ITL p50/p99,
+and model forward passes per generated token, and an OPEN-LOOP Poisson
+workload replayed against the continuous-batching scheduler vs the
+hand-rolled FCFS admit loop — goodput-under-SLO, queue-wait percentiles,
+and preemption counts for the tpu_watch SERVING probe.
 ONE JSON line.
 """
 
@@ -36,24 +42,25 @@ RESULT = {"metric": "serving_steady_tok_per_sec", "value": 0.0,
           "unit": "tok/s", "vs_baseline": None, "detail": {}}
 
 
-def run_closed_loop(eng, sp, vocab, batch, prompt_len, gen_len, measure_s,
-                    rng, quantum=1, make_prompt=None):
-    """Keep `batch` sequences live for `measure_s` seconds; count generated
-    tokens (decode steps + the first token each prefill produces).
-    ``quantum > 1`` uses the fused k-step decode (one host sync per k
-    tokens) with admission at quantum boundaries. ``make_prompt(uid)``
-    overrides the default random prompt (shared-prefix workload mode)."""
+def run_closed_loop(eng, sp, traffic, batch, gen_len, measure_s, quantum=1):
+    """THE shared closed-loop driver (admission boilerplate lives here once;
+    the steady-state, shared-prefix, and decode-heavy workloads differ only
+    in the ``traffic`` generator feeding it): keep ``batch`` sequences live
+    for ``measure_s`` seconds, admitting a fresh prompt from ``traffic``
+    whenever one finishes, and count generated tokens (decode steps + the
+    first token each prefill produces). ``quantum > 1`` uses the fused
+    k-step decode (one host sync per k tokens) with admission at quantum
+    boundaries. Returns a row dict: tok/s, prefills-in-window, per-token
+    call latency (call time / quantum — the FastGen-comparable number), and
+    emission-weighted ITL p50/p99 (a speculative step emits several tokens,
+    so each token's ITL is the step time over the tokens it produced)."""
     import numpy as np
 
     uid = 0
-    if make_prompt is None:
-        def make_prompt(_uid):
-            return rng.integers(0, vocab, (prompt_len,),
-                                dtype=np.int32).tolist()
 
     def admit():
         nonlocal uid
-        eng.put(uid, make_prompt(uid), sp, seed=uid)
+        eng.put(uid, traffic.prompt_tokens(), sp, seed=uid)
         uid += 1
 
     def useful_live():
@@ -62,25 +69,29 @@ def run_closed_loop(eng, sp, vocab, batch, prompt_len, gen_len, measure_s,
         return sum(min(len(d.generated), gen_len)
                    for d in eng.state.seqs.values())
 
-    for _ in range(batch):
-        admit()
-    # warm the decode program
-    if quantum > 1:
-        eng.step_many(quantum, sp)
-    else:
-        eng.step(sp)
-    base = useful_live()  # pre-window tokens never count
-    t0 = time.perf_counter()
-    produced_retired = 0
-    prefills = 0
-    call_ms = []  # per-call wall time -> per-token latency percentiles
-    while time.perf_counter() - t0 < measure_s:
-        tc = time.perf_counter()
+    def step():
         if quantum > 1:
             eng.step_many(quantum, sp)
         else:
             eng.step(sp)
-        call_ms.append((time.perf_counter() - tc) * 1e3)
+
+    for _ in range(batch):
+        admit()
+    step()                       # warm the decode program
+    base = useful_live()         # pre-window tokens never count
+    t0 = time.perf_counter()
+    produced_retired = 0
+    prefills = 0
+    call_ms = []                 # per-call wall time → token latency
+    itl_ms = []                  # per-emitted-token latency
+    while time.perf_counter() - t0 < measure_s:
+        before = useful_live()
+        tc = time.perf_counter()
+        step()
+        dt_ms = (time.perf_counter() - tc) * 1e3
+        call_ms.append(dt_ms)
+        emitted = max(1, useful_live() - before)
+        itl_ms.extend([dt_ms / emitted] * emitted)
         for d in list(eng.state.seqs.values()):
             if len(d.generated) >= gen_len:
                 produced_retired += gen_len
@@ -91,17 +102,29 @@ def run_closed_loop(eng, sp, vocab, batch, prompt_len, gen_len, measure_s,
     produced = produced_retired + useful_live() - base
     for d in list(eng.state.seqs.values()):
         eng.finish(d.uid)
-    import numpy as np
-
     # FastGen-comparable per-token latency: a quantum call emits `quantum`
     # tokens per sequence, so token latency = call time / quantum
     tok_ms = np.asarray(call_ms) / max(1, quantum)
-    lat = {"p50_ms": round(float(np.percentile(tok_ms, 50)), 2),
-           "p95_ms": round(float(np.percentile(tok_ms, 95)), 2)}
-    return produced / dt, prefills, lat
+    itl = np.asarray(itl_ms)
+    return {"tok_per_sec": round(produced / dt, 1),
+            "tokens_in_window": int(produced),
+            "prefills_in_window": prefills,
+            "model_steps": len(call_ms),
+            "token_latency": {
+                "p50_ms": round(float(np.percentile(tok_ms, 50)), 2),
+                "p95_ms": round(float(np.percentile(tok_ms, 95)), 2)},
+            "itl_p50_ms": round(float(np.percentile(itl, 50)), 2),
+            "itl_p99_ms": round(float(np.percentile(itl, 99)), 2)}
 
 
-def run_shared_prefix(build, sp, vocab, rng, batch, shared_len, tail_len,
+def _traffic(**kw):
+    from deepspeed_tpu.inference.serving import (TrafficGenerator,
+                                                 WorkloadConfig)
+
+    return TrafficGenerator(WorkloadConfig(**kw))
+
+
+def run_shared_prefix(build, sp, vocab, batch, shared_len, tail_len,
                       gen_len, measure_s, quantum=1):
     """Shared-system-prompt workload (docs/serving.md): ``batch`` closed-loop
     clients whose prompts all start with the SAME ``shared_len``-token prefix
@@ -110,41 +133,32 @@ def run_shared_prefix(build, sp, vocab, rng, batch, shared_len, tail_len,
     prefix hit-rate, ``prefill_tokens_saved``, and the saved fraction of the
     reusable shared-prefix tokens (acceptance: >= 0.9 after warmup — only
     the first admission must prefill the shared blocks)."""
-    import numpy as np
-
-    shared = rng.integers(0, vocab, (shared_len,), dtype=np.int32).tolist()
-
     out = {"shared_len": shared_len, "tail_len": tail_len, "gen_len": gen_len}
     for label, enabled in (("cache_off", False), ("cache_on", True)):
-        # per-mode tail stream so OFF and ON admit the same prompt sequence
-        tail_rng = np.random.default_rng(7)
-
-        def make_prompt(_uid):
-            return shared + tail_rng.integers(
-                0, vocab, (tail_len,), dtype=np.int32).tolist()
-
+        # per-mode generator with the same seed so OFF and ON admit the
+        # identical prompt sequence (shared prefix included)
+        traffic = _traffic(seed=7, vocab_size=vocab,
+                           prompt_kind="shared_prefix",
+                           shared_len=shared_len, prompt_len=tail_len)
         eng = build(enabled)
         try:
-            tps, prefills, lat = run_closed_loop(
-                eng, sp, vocab, batch, shared_len + tail_len, gen_len,
-                measure_s, rng, quantum=quantum, make_prompt=make_prompt)
+            row = run_closed_loop(eng, sp, traffic, batch, gen_len,
+                                  measure_s, quantum=quantum)
             stats = dict(eng.state.prefix_stats)
-            admissions = batch + prefills
+            admissions = batch + row["prefills_in_window"]
             bs = eng.state.block_size
             # tokens the cache could have resolved: every admission after the
             # first can reuse the shared prefix's full blocks
             reusable = (shared_len // bs) * bs * max(0, admissions - 1)
-            row = {"tok_per_sec": round(tps, 1),
-                   "prefills_in_window": prefills,
-                   "token_latency": lat,
-                   "prefill_tokens_saved": stats["prefill_tokens_saved"],
-                   "hit_rate": round(stats["hits"] / stats["lookups"], 3)
-                   if stats["lookups"] else 0.0,
-                   "saved_frac_of_shared":
-                   round(stats["prefill_tokens_saved"] / reusable, 3)
-                   if reusable else 0.0,
-                   "evictions": stats["evictions"],
-                   "retained_blocks": eng.state.retained_blocks}
+            row.update(
+                prefill_tokens_saved=stats["prefill_tokens_saved"],
+                hit_rate=round(stats["hits"] / stats["lookups"], 3)
+                if stats["lookups"] else 0.0,
+                saved_frac_of_shared=round(
+                    stats["prefill_tokens_saved"] / reusable, 3)
+                if reusable else 0.0,
+                evictions=stats["evictions"],
+                retained_blocks=eng.state.retained_blocks)
             out[label] = row
             sys.stderr.write(f"[serving] shared_prefix {label}: {row}\n")
             tel_dir = os.environ.get("DSTPU_SERVING_TELEMETRY")
@@ -155,9 +169,11 @@ def run_shared_prefix(build, sp, vocab, rng, batch, shared_len, tail_len,
     return out
 
 
-def _dump_serving_telemetry(eng, out_dir, job="serving_bench", spec=False):
-    """Write the engine's Serving/prefix_cache/* counters (and, for the
-    decode workload, Serving/spec/*) as a TelemetryHub JSONL file for
+def _dump_serving_telemetry(eng, out_dir, job="serving_bench", spec=False,
+                            extra_events=None):
+    """Write the engine's Serving/prefix_cache/* counters (plus, per
+    workload, Serving/spec/* or the scheduler/router series passed in
+    ``extra_events``) as a TelemetryHub JSONL file for
     ``scripts/telemetry_report.py --serving``."""
     from deepspeed_tpu.monitor.monitor import JSONLMonitor
 
@@ -170,82 +186,37 @@ def _dump_serving_telemetry(eng, out_dir, job="serving_bench", spec=False):
     mon.write_events(eng.prefix_cache_events(step=0))
     if spec:
         mon.write_events(eng.spec_events(step=0))
+    if extra_events:
+        mon.write_events(extra_events)
     mon.close()
 
 
-def run_decode_heavy(build, sp, vocab, rng, batch, prompt_len, gen_len,
+def run_decode_heavy(build, sp, vocab, batch, prompt_len, gen_len,
                      measure_s, pattern_len=6):
     """Decode-heavy workload (docs/serving.md): short REPETITIVE prompts
     (a ``pattern_len``-token pattern tiled to ``prompt_len`` — the
     prompt-lookup drafter's best case, standing in for quoted-context /
     multi-turn-echo traffic) and long generations, run with speculative
     decoding OFF then ON. Reports generated tok/s, per-token latency
-    p50/p99 (a spec step emits several tokens, so each token's ITL is the
-    step time divided by the tokens it produced), the accept-rate /
-    tokens-per-step counters, and model forward passes per generated token —
-    the number speculative decoding exists to shrink."""
-    import numpy as np
-
+    p50/p99, the accept-rate / tokens-per-step counters, and model forward
+    passes per generated token — the number speculative decoding exists to
+    shrink."""
     out = {"prompt_len": prompt_len, "gen_len": gen_len, "batch": batch}
     for label, enabled in (("spec_off", False), ("spec_on", True)):
-        prompt_rng = np.random.default_rng(13)
-
-        def make_prompt(_uid):
-            pat = prompt_rng.integers(0, vocab, (pattern_len,),
-                                      dtype=np.int32).tolist()
-            reps = (prompt_len + pattern_len - 1) // pattern_len
-            return (pat * reps)[:prompt_len]
-
+        traffic = _traffic(seed=13, vocab_size=vocab,
+                           prompt_kind="repetitive", prompt_len=prompt_len,
+                           pattern_len=pattern_len)
         eng = build(enabled)
         try:
-            uid = 0
-
-            def admit():
-                nonlocal uid
-                eng.put(uid, make_prompt(uid), sp, seed=uid)
-                uid += 1
-
-            def live_tokens():
-                return sum(min(len(d.generated), gen_len)
-                           for d in eng.state.seqs.values())
-
-            for _ in range(batch):
-                admit()
-            eng.step(sp)                        # warm the compiled programs
-            base = live_tokens()
-            produced_retired = 0
-            model_steps = 0
-            itl_ms = []
-            t0 = time.perf_counter()
-            while time.perf_counter() - t0 < measure_s:
-                before = live_tokens()
-                tc = time.perf_counter()
-                eng.step(sp)
-                dt_ms = (time.perf_counter() - tc) * 1e3
-                model_steps += 1
-                emitted = max(1, live_tokens() - before)
-                itl_ms.extend([dt_ms / emitted] * emitted)
-                for d in list(eng.state.seqs.values()):
-                    if len(d.generated) >= gen_len:
-                        produced_retired += gen_len
-                        eng.finish(d.uid)
-                        admit()
-            dt = time.perf_counter() - t0
-            produced = produced_retired + live_tokens() - base
+            row = run_closed_loop(eng, sp, traffic, batch, gen_len,
+                                  measure_s, quantum=1)
             stats = dict(eng.spec_stats)
             tel_dir = os.environ.get("DSTPU_SERVING_TELEMETRY")
             if enabled and tel_dir:
                 _dump_serving_telemetry(eng, tel_dir,
                                         job="serving_bench_spec", spec=True)
-            for d in list(eng.state.seqs.values()):
-                eng.finish(d.uid)
-            arr = np.asarray(itl_ms)
-            steps = stats["verify_steps"] + stats["decode_steps"]
-            row = {"tok_per_sec": round(produced / dt, 1),
-                   "itl_p50_ms": round(float(np.percentile(arr, 50)), 2),
-                   "itl_p99_ms": round(float(np.percentile(arr, 99)), 2),
-                   "model_steps": model_steps,
-                   "fwd_per_token": round(model_steps / max(1, produced), 3)}
+            row["fwd_per_token"] = round(
+                row["model_steps"] / max(1, row["tokens_in_window"]), 3)
             if enabled:
                 row["accept_rate"] = round(
                     stats["accepted_tokens"] / stats["drafted_tokens"], 3) \
@@ -260,6 +231,164 @@ def run_decode_heavy(build, sp, vocab, rng, batch, prompt_len, gen_len,
             sys.stderr.write(f"[serving] decode_heavy {label}: {row}\n")
         finally:
             del eng
+    return out
+
+
+def run_open_loop(build, sp, vocab, rate_rps, duration_s, prompt_len,
+                  gen_len, slo_ms, quantum=1):
+    """Open-loop Poisson workload (docs/serving.md "Scheduler & router"):
+    one seeded arrival trace replayed against (a) the continuous-batching
+    SCHEDULER and (b) the hand-rolled FCFS admit/step loop this bench used
+    before the scheduler existed. Identical traffic, identical engine
+    config — the delta is pure scheduling policy. Reports, per mode:
+    goodput-under-SLO (requests completed within their e2e deadline, as a
+    rate and a fraction of completions), queue-wait p50/p99, and the
+    scheduler's preemption count."""
+    import collections
+
+    from deepspeed_tpu.inference.serving import (SchedulerConfig,
+                                                 ServingScheduler)
+    import numpy as np
+
+    def warm(eng, max_batch):
+        """Compile the prefill/decode programs the replay will hit OUTSIDE
+        the measured window (power-of-two admission-burst shapes, the
+        prefix-cache ctx variants, and the decode program). Compiles are a
+        one-time cost the persistent XLA cache absorbs in production;
+        leaving them inside the window would measure compilation, not
+        scheduling policy."""
+        wrng = np.random.default_rng(999)
+        hi = prompt_len if isinstance(prompt_len, int) else prompt_len[1]
+        uid = 10 ** 6
+        n = 1
+        while n <= max_batch:
+            prompt = wrng.integers(0, vocab, (hi,), dtype=np.int32).tolist()
+            for _ in range(2):     # second pass hits the cache → ctx variant
+                pairs = [(uid + j, prompt) for j in range(n)]
+                eng.put_many(pairs, sp, seed=0)
+                if quantum > 1:
+                    eng.step_many(quantum, sp)
+                else:
+                    eng.step(sp)
+                for u, _ in pairs:
+                    eng.finish(u)
+                uid += n
+            n *= 2
+
+    traffic = _traffic(seed=11, vocab_size=vocab, process="poisson",
+                       rate_rps=rate_rps, prompt_len=prompt_len,
+                       gen_len=gen_len, deadline_ms=slo_ms)
+    arrivals = traffic.arrivals(duration_s)
+    out = {"arrivals": len(arrivals), "rate_rps": rate_rps,
+           "duration_s": duration_s, "slo_ms": slo_ms,
+           "prompt_len": list(prompt_len) if not isinstance(prompt_len, int)
+           else prompt_len,
+           "gen_len": list(gen_len) if not isinstance(gen_len, int)
+           else gen_len}
+    if not arrivals:
+        return out
+    time_cap = duration_s * 10 + 60
+
+    def summary(elapsed, e2e_met_tok, qwaits_ms, extra=None):
+        done = len(e2e_met_tok)
+        met = [r for r in e2e_met_tok if r[1]]
+        qw = np.asarray(qwaits_ms) if qwaits_ms else np.zeros((1,))
+        row = {"completed": done, "slo_met": len(met),
+               "goodput_rps": round(len(met) / elapsed, 2),
+               "goodput_frac": round(len(met) / done, 3) if done else 0.0,
+               "goodput_tok_per_sec": round(
+                   sum(r[2] for r in met) / elapsed, 1),
+               "queue_wait_ms": {
+                   "p50": round(float(np.percentile(qw, 50)), 2),
+                   "p99": round(float(np.percentile(qw, 99)), 2)}}
+        row.update(extra or {})
+        return row
+
+    # --- scheduler ON ------------------------------------------------- #
+    eng = build()
+    sched = ServingScheduler(eng, SchedulerConfig(decode_quantum=quantum))
+    warm(eng, eng.state.max_sequences)
+    handles = []
+    i = 0
+    t0 = time.perf_counter()
+    while i < len(arrivals) or sched.pending:
+        now = time.perf_counter() - t0
+        if now > time_cap:
+            break
+        while i < len(arrivals) and arrivals[i].t <= now:
+            handles.append(sched.submit(arrivals[i].request))
+            i += 1
+        if not sched.pending:
+            if i < len(arrivals):
+                time.sleep(min(max(arrivals[i].t - now, 0.0), 0.05))
+            continue
+        sched.tick()
+    elapsed = time.perf_counter() - t0
+    rows = [(h.e2e_ms, bool(h.slo_met), len(h.tokens))
+            for h in handles if h.state == "done"]
+    out["scheduler"] = summary(
+        elapsed, rows, [h.queue_wait_ms for h in handles
+                        if h.queue_wait_ms is not None],
+        extra={"preempted": sched.stats["preempted"],
+               "resumed": sched.stats["resumed"],
+               "chunked_admissions": sched.stats["chunked_admissions"]})
+    sys.stderr.write(f"[serving] open_loop scheduler: {out['scheduler']}\n")
+    tel_dir = os.environ.get("DSTPU_SERVING_TELEMETRY")
+    if tel_dir:
+        _dump_serving_telemetry(eng, tel_dir, job="serving_bench_sched",
+                                extra_events=sched.sched_events(step=0))
+    del sched, eng
+
+    # --- hand-rolled FCFS baseline (the pre-scheduler pattern) --------- #
+    eng = build()
+    warm(eng, 1)                 # the FCFS loop only ever admits one-by-one
+    fifo = collections.deque()   # (arrival, arrival-observed wall time)
+    live = {}                    # uid → {sub, max_new, deadline}
+    results = []                 # (e2e_ms, met, tokens)
+    qwaits = []
+    i = 0
+    next_uid = 0
+    t0 = time.perf_counter()
+    while i < len(arrivals) or fifo or live:
+        now = time.perf_counter() - t0
+        if now > time_cap:
+            break
+        while i < len(arrivals) and arrivals[i].t <= now:
+            fifo.append((arrivals[i], now))
+            i += 1
+        while fifo and eng.state.can_admit(len(fifo[0][0].request.prompt)):
+            arr, t_sub = fifo.popleft()
+            uid = next_uid
+            next_uid += 1
+            eng.put(uid, arr.request.prompt, sp, seed=uid)
+            qwaits.append((time.perf_counter() - t0 - t_sub) * 1e3)
+            live[uid] = {"sub": t_sub,
+                         "max_new": arr.request.max_new_tokens,
+                         "deadline": arr.request.deadline_ms}
+        if not live:
+            if i < len(arrivals):
+                now = time.perf_counter() - t0
+                time.sleep(min(max(arrivals[i].t - now, 0.0), 0.05))
+            continue
+        if quantum > 1:
+            eng.step_many(quantum, sp)
+        else:
+            eng.step(sp)
+        for uid in list(live):
+            d = eng.state.seqs.get(uid)
+            if d is not None and len(d.generated) >= live[uid]["max_new"]:
+                eng.finish(uid)
+                info = live.pop(uid)
+                e2e = (time.perf_counter() - t0 - info["sub"]) * 1e3
+                results.append((e2e, e2e <= info["deadline"],
+                                info["max_new"]))
+    elapsed = time.perf_counter() - t0
+    for d in list(eng.state.seqs.values()):
+        eng.finish(d.uid)
+    out["hand_rolled"] = summary(elapsed, results, qwaits)
+    sys.stderr.write(
+        f"[serving] open_loop hand_rolled: {out['hand_rolled']}\n")
+    del eng
     return out
 
 
@@ -381,13 +510,13 @@ def main():
                 eng = build_engine_v2(
                     llama, mcfg, llama.init(mcfg, jax.random.PRNGKey(0)),
                     config=cfg_dict)
-                tps, prefills, lat = run_closed_loop(
-                    eng, sp, mcfg.vocab_size, batch, prompt_len, gen_len,
-                    measure_s, rng, quantum=quantum)
-                rows[label] = {"tok_per_sec": round(tps, 1),
-                               "prefills_in_window": prefills,
-                               "prompt_len": prompt_len, "gen_len": gen_len,
-                               "token_latency": lat}
+                row = run_closed_loop(
+                    eng, sp, _traffic(seed=0, vocab_size=mcfg.vocab_size,
+                                      prompt_len=prompt_len),
+                    batch, gen_len, measure_s, quantum=quantum)
+                row.update(prompt_len=prompt_len, gen_len=gen_len)
+                rows[label] = row
+                tps = row["tok_per_sec"]
                 if want_trace:
                     eng.export_trace(trace_path)
                     rows[label]["latency_slo"] = {
@@ -428,7 +557,7 @@ def main():
                                    "block_size": bs_sp}})
 
         RESULT["detail"]["shared_prefix"] = run_shared_prefix(
-            build_sp, sp, mcfg.vocab_size, rng, batch_sp, shared_sp, tail_sp,
+            build_sp, sp, mcfg.vocab_size, batch_sp, shared_sp, tail_sp,
             gen_sp, meas_sp, quantum=q_sp)
     except Exception as e:
         RESULT["detail"]["shared_prefix"] = f"error: {str(e)[-200:]}"
@@ -459,10 +588,42 @@ def main():
                                    "block_size": bs_sd}})
 
         RESULT["detail"]["decode_heavy"] = run_decode_heavy(
-            build_sd, sp, mcfg.vocab_size, rng, batch_sd, plen_sd, glen_sd,
+            build_sd, sp, mcfg.vocab_size, batch_sd, plen_sd, glen_sd,
             meas_sd)
     except Exception as e:
         RESULT["detail"]["decode_heavy"] = f"error: {str(e)[-200:]}"
+
+    # open-loop Poisson workload: continuous-batching scheduler vs the
+    # hand-rolled FCFS loop on the SAME seeded arrival trace — goodput under
+    # SLO, queue-wait percentiles, preemption counts (docs/serving.md)
+    try:
+        if on_tpu:
+            rate_ol, dur_ol, plen_ol, glen_ol, slo_ol, q_ol = \
+                24.0, 20.0, (64, 256), (32, 96), 4000.0, 4
+            slots_ol, bs_ol = 16, 32
+        else:
+            rate_ol, dur_ol, plen_ol, glen_ol, slo_ol, q_ol = \
+                20.0, 5.0, (16, 32), (4, 10), 2500.0, 1
+            slots_ol, bs_ol = 8, 16
+        max_tok_ol = plen_ol[1] + glen_ol[1]
+
+        def build_ol():
+            nb = slots_ol * ((max_tok_ol + bs_ol - 1) // bs_ol + 3) + 8
+            return build_engine_v2(
+                llama, mcfg, llama.init(mcfg, jax.random.PRNGKey(0)),
+                config={"dtype": "bfloat16",
+                        "prefill_bucket": min(64, plen_ol[1]),
+                        "prefix_cache": {"enabled": True},
+                        "ragged": {"max_tracked_sequences": slots_ol,
+                                   "max_ragged_batch_size": slots_ol,
+                                   "memory_config_blocks": nb,
+                                   "block_size": bs_ol}})
+
+        RESULT["detail"]["open_loop"] = run_open_loop(
+            build_ol, sp, mcfg.vocab_size, rate_ol, dur_ol, plen_ol,
+            glen_ol, slo_ol, quantum=q_ol)
+    except Exception as e:
+        RESULT["detail"]["open_loop"] = f"error: {str(e)[-200:]}"
 
     # head-of-line probe: long-prompt admission stall, split vs one-shot
     try:

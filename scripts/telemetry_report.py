@@ -150,15 +150,20 @@ def reliability(events: List[dict]) -> str:
 def serving(events: List[dict]) -> str:
     """``--serving``: prefix-cache hit-rate, prefill tokens saved, retained-
     pool occupancy and evictions from the ``Serving/prefix_cache/*`` stream,
-    plus the speculative-decoding efficiency counters from ``Serving/spec/*``
-    (paged serving engine — docs/serving.md). These series carry CUMULATIVE
-    counter values (gauges for occupancy/rates), so the last sample per
-    series is the run total — unlike ``--reliability``'s
-    one-line-per-occurrence."""
+    the speculative-decoding efficiency counters from ``Serving/spec/*``,
+    the continuous-batching scheduler counters from ``Serving/sched/*``
+    (queue depth, admitted/rejected/preempted, queue-wait percentiles,
+    goodput-under-SLO), and the multi-replica router placement counters from
+    ``Serving/router/*`` (paged serving engine — docs/serving.md). These
+    series carry CUMULATIVE counter values (gauges for occupancy/rates), so
+    the last sample per series is the run total — unlike
+    ``--reliability``'s one-line-per-occurrence."""
     srv = [e for e in events if e["name"].startswith("Serving/prefix_cache/")]
     spec = [e for e in events if e["name"].startswith("Serving/spec/")]
-    if not srv and not spec:
-        return ("serving: no Serving/prefix_cache/* or Serving/spec/* "
+    sched = [e for e in events if e["name"].startswith("Serving/sched/")]
+    router = [e for e in events if e["name"].startswith("Serving/router/")]
+    if not srv and not spec and not sched and not router:
+        return ("serving: no Serving/{prefix_cache,spec,sched,router}/* "
                 "events in this file")
     lines: List[str] = []
     if srv:
@@ -217,6 +222,57 @@ def serving(events: List[dict]) -> str:
                      f"{sp.get('tokens_per_step', 0):.2f} per sequence")
         lines.append(f"  verify batch occupancy: "
                      f"{sp.get('verify_batch_occupancy', 0) * 100:.1f}%")
+    if sched:
+        if lines:
+            lines.append("")
+        sc: Dict[str, float] = {}
+        for e in sched:
+            sc[e["name"][len("Serving/sched/"):]] = e["value"]  # last wins
+        lines.append(f"scheduler report ({len(sched)} events)")
+        lines.append(f"  submitted:              {sc.get('submitted', 0):,.0f}"
+                     f"  (admitted {sc.get('admitted', 0):,.0f}, chunked "
+                     f"{sc.get('chunked_admissions', 0):,.0f}, rejected "
+                     f"{sc.get('rejected', 0):,.0f}, expired "
+                     f"{sc.get('expired', 0):,.0f})")
+        lines.append(f"  preempted / resumed:    "
+                     f"{sc.get('preempted', 0):,.0f} / "
+                     f"{sc.get('resumed', 0):,.0f}")
+        lines.append(f"  completed:              "
+                     f"{sc.get('completed', 0):,.0f}  (SLO met "
+                     f"{sc.get('slo_met', 0):,.0f}, missed "
+                     f"{sc.get('slo_missed', 0):,.0f})")
+        lines.append(f"  goodput under SLO:      "
+                     f"{sc.get('goodput_frac', 0) * 100:.1f}% of completions"
+                     f"  ({sc.get('goodput_rps', 0):.2f} req/s)")
+        lines.append(f"  queue depth (now):      "
+                     f"{sc.get('queue_depth', 0):,.0f}")
+        lines.append(f"  queue wait ms p50/p90/p99: "
+                     f"{sc.get('queue_wait_ms_p50', 0):.2f} / "
+                     f"{sc.get('queue_wait_ms_p90', 0):.2f} / "
+                     f"{sc.get('queue_wait_ms_p99', 0):.2f}"
+                     f"  ({sc.get('queue_wait_ms_count', 0):,.0f} samples)")
+        lines.append(f"  scheduler ticks:        {sc.get('ticks', 0):,.0f}"
+                     f"  ({sc.get('tokens_emitted', 0):,.0f} tokens "
+                     f"emitted)")
+    if router:
+        if lines:
+            lines.append("")
+        rt: Dict[str, float] = {}
+        for e in router:
+            rt[e["name"][len("Serving/router/"):]] = e["value"]  # last wins
+        lines.append(f"router report ({len(router)} events)")
+        reqs = rt.get("requests", 0.0)
+        lines.append(f"  requests routed:        {reqs:,.0f} across "
+                     f"{rt.get('replicas', 0):,.0f} active replicas")
+        aff_pct = rt.get("affinity_hits", 0) / reqs * 100 if reqs else 0.0
+        lines.append(f"  prefix-affinity hits:   "
+                     f"{rt.get('affinity_hits', 0):,.0f}  "
+                     f"({aff_pct:.1f}% of placements)")
+        lines.append(f"  session-sticky hits:    "
+                     f"{rt.get('session_hits', 0):,.0f}")
+        lines.append(f"  load fallbacks:         "
+                     f"{rt.get('load_fallbacks', 0):,.0f}")
+        lines.append(f"  drains:                 {rt.get('drains', 0):,.0f}")
     return "\n".join(lines)
 
 
@@ -374,10 +430,13 @@ def main(argv=None) -> int:
     ap.add_argument("--serving", action="store_true",
                     help="summarize Serving/prefix_cache/* counters "
                          "(hit-rate, prefill tokens saved, retained-pool "
-                         "occupancy, evictions) and Serving/spec/* "
+                         "occupancy, evictions), Serving/spec/* "
                          "speculative-decoding counters (accept rate, mean "
                          "accepted length, tokens per model step, verify "
-                         "batch occupancy)")
+                         "batch occupancy), Serving/sched/* scheduler "
+                         "counters (queue depth, admitted/rejected/"
+                         "preempted, queue-wait percentiles, goodput-under-"
+                         "SLO), and Serving/router/* placement counters")
     ap.add_argument("--latency", action="store_true",
                     help="summarize Serving/latency/* SLO percentiles: "
                          "TTFT / inter-token / queue / e2e p50-p90-p99")
