@@ -30,6 +30,21 @@ shared with the engine's qgZ path: one static per-leaf decision — which dim
 scatters over which mesh axes, which axes fall back to a plain psum — made
 once from shapes so the in-region collectives and the out specs can never
 disagree.
+
+5. **Per-layer all-gather prefetch** (``comms_overlap.layer_prefetch``, the
+   T3-style forward/backward overlap for ZeRO-3): instead of letting XLA
+   gather parameters at first use — which serializes layer *i*'s all-gather
+   against layer *i-1*'s last matmul at bucket boundaries — the stacked-layer
+   scan is rewritten (:func:`prefetch_scan`) so the gathered params of layer
+   *i* ride the scan carry while layer *i+1*'s shard slice + gather-to-compute
+   -layout constraint is issued BEFORE layer *i*'s matmuls, data-independent
+   of them. With the async-collective flags (4.) programmed, XLA's
+   latency-hiding scheduler overlaps the in-flight all-gather with the
+   previous layer's compute; ``prefetch_depth`` > 1 keeps a ring of gathered
+   layers in flight. The engine configures this process-wide at init
+   (:func:`configure_layer_prefetch`) and the model families consult
+   :func:`layer_prefetch_active` when choosing their layer scan — numerics
+   are bit-identical to the plain ``lax.scan`` (same slices, same order).
 """
 
 from __future__ import annotations
@@ -240,3 +255,150 @@ def apply_xla_overlap_flags(cfg) -> List[str]:
         logger.debug("comms_overlap flags already set by user: "
                      + " ".join(skipped))
     return applied
+
+
+# --------------------------------------------------------------------------- #
+# per-layer all-gather prefetch (comms_overlap.layer_prefetch, ZeRO-3)
+# --------------------------------------------------------------------------- #
+# Process-wide prefetch configuration, owned by the training engine (same
+# latest-engine-wins contract as activation_checkpointing.configure): the
+# model families are pure functions with no engine handle, so the engine
+# publishes the decision here and the models consult it when choosing
+# between lax.scan and prefetch_scan for their stacked-layer loop.
+_LAYER_PREFETCH: dict = {"enabled": False, "depth": 1, "shardings": None}
+
+
+def configure_layer_prefetch(enabled: bool, depth: int = 1,
+                             shardings=None) -> None:
+    """Publish the engine's per-layer prefetch decision. ``shardings`` is an
+    optional pytree (matching the model's per-layer param subtree, leading
+    stacked dim dropped) of NamedShardings describing the GATHERED
+    (compute/TP-only) layout — the constraint that makes XLA start each
+    layer's all-gather at slice time instead of at first matmul use.
+
+    Takes effect at the next train-step trace; call BEFORE the first
+    ``train_batch`` of the engine that wants it."""
+    _LAYER_PREFETCH["enabled"] = bool(enabled)
+    _LAYER_PREFETCH["depth"] = max(1, int(depth))
+    _LAYER_PREFETCH["shardings"] = shardings
+
+
+def reset_layer_prefetch() -> None:
+    configure_layer_prefetch(False, depth=1, shardings=None)
+
+
+def layer_prefetch_active() -> bool:
+    return bool(_LAYER_PREFETCH["enabled"])
+
+
+def layer_prefetch_depth() -> int:
+    return int(_LAYER_PREFETCH["depth"])
+
+
+@jax.custom_vjp
+def _ordering_barrier(pair):
+    """Differentiable ``optimization_barrier``: pins the issue ORDER of the
+    paired values in the forward program (the prefetched gather must launch
+    no later than the current layer's compute consumes its operand) without
+    creating a data dependence. ``optimization_barrier`` has no built-in
+    differentiation rule, so the backward passes cotangents through
+    untouched — backward-pass overlap is owned by the latency-hiding
+    scheduler (async-collective flags), which sees the same per-layer gather
+    structure."""
+    return jax.lax.optimization_barrier(pair)
+
+
+def _ordering_fwd(pair):
+    return _ordering_barrier(pair), None
+
+
+def _ordering_bwd(_, ct):
+    return (ct,)
+
+
+_ordering_barrier.defvjp(_ordering_fwd, _ordering_bwd)
+
+
+def _constrain_layer(sliced, shardings):
+    """Pin one gathered layer slice to the compute layout (the gather
+    trigger). A structure mismatch (model subtree ≠ engine params subtree,
+    e.g. a hand-rolled ModelSpec) degrades to no constraint — the prefetch
+    ordering barrier still applies, only the explicit gather target is
+    lost."""
+    if shardings is None:
+        return sliced
+    try:
+        return jax.tree.map(
+            lambda t, s: t if s is None
+            else jax.lax.with_sharding_constraint(t, s), sliced, shardings)
+    except (ValueError, TypeError):
+        return sliced
+
+
+def prefetch_scan(body, init, layers, depth: Optional[int] = None,
+                  shardings=None):
+    """``lax.scan`` over stacked ``[L, ...]`` layer params with layer
+    *i+depth*'s shard slice + gather issued while layer *i* computes.
+
+    ``body(carry, layer) -> (carry, y)`` exactly like a scan body; returns
+    ``(carry, ys)``. Per step the NEXT layer's params are sliced from the
+    (ZeRO-sharded) stack, constrained to the gathered compute layout, and
+    ordered AHEAD of the current layer's compute with an
+    ``optimization_barrier`` — data-independent of it, so the latency-hiding
+    scheduler can run the all-gather under the matmuls (T3's per-layer
+    pipelining, replacing gather-at-use bucket-boundary overlap). The math
+    is the plain scan's bit for bit: same slices, same order.
+
+    ``depth`` layers of gathered params stay in flight (1 = double buffer:
+    one computing, one gathering). HBM cost: ``depth`` extra gathered layers
+    resident."""
+    if depth is None:
+        depth = layer_prefetch_depth()
+    if shardings is None:
+        shardings = _LAYER_PREFETCH["shardings"]
+    leaves = jax.tree.leaves(layers)
+    if not leaves:
+        return lax.scan(body, init, layers)
+    n_layers = int(leaves[0].shape[0])
+    depth = max(1, min(int(depth), n_layers))
+
+    def gather(i):
+        sliced = jax.tree.map(
+            lambda a: lax.dynamic_index_in_dim(a, i, 0, keepdims=False),
+            layers)
+        return _constrain_layer(sliced, shardings)
+
+    if depth == 1:
+        first = gather(0)
+
+        def scan_body(carry, i):
+            x, cur = carry
+            # slice + gather layer i+1 BEFORE layer i's compute; the barrier
+            # pins the issue order without creating a data dependence (the
+            # tail repeats the last layer's gather — one wasted slice, no
+            # dynamic trip count)
+            nxt = gather(jnp.minimum(i + 1, n_layers - 1))
+            nxt, x = _ordering_barrier((nxt, x))
+            x, y = body(x, cur)
+            return (x, nxt), y
+
+        (out, _), ys = lax.scan(scan_body, (init, first),
+                                jnp.arange(n_layers))
+        return out, ys
+
+    # depth > 1: ring of gathered layers in the carry, leaves [depth, ...]
+    first = [gather(i) for i in range(depth)]
+    buf = jax.tree.map(lambda *xs: jnp.stack(xs, axis=0), *first)
+
+    def scan_body(carry, i):
+        x, buf = carry
+        cur = jax.tree.map(lambda b: b[0], buf)
+        nxt = gather(jnp.minimum(i + depth, n_layers - 1))
+        nxt, x = _ordering_barrier((nxt, x))
+        x, y = body(x, cur)
+        buf = jax.tree.map(
+            lambda b, n: jnp.concatenate([b[1:], n[None]], axis=0), buf, nxt)
+        return (x, buf), y
+
+    (out, _), ys = lax.scan(scan_body, (init, buf), jnp.arange(n_layers))
+    return out, ys

@@ -19,6 +19,7 @@ from typing import Any, Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.ad_checkpoint import checkpoint_name
 
 from ..ops.attention import attention
 from ._paged import paged_attention_step
@@ -26,6 +27,11 @@ from ..ops.embedding import embedding_lookup
 from ..ops.norms import layer_norm
 
 Params = Dict[str, Any]
+
+# checkpoint names this family's TRAINING block attaches (the selective-
+# remat saveables; no "mlp_gate" — the GPT FFN has no gate projection)
+CHECKPOINT_NAMES_EMITTED = ("qkv_proj", "attn_mix", "attn_out",
+                            "mlp_up", "mlp_out")
 
 
 @dataclass(frozen=True)
@@ -41,6 +47,7 @@ class GPTConfig:
     post_ln: bool = False     # True = original transformer/BLOOM ordering
     activation: str = "gelu"  # "gelu" (GPT-2) | "relu" (OPT)
     remat: bool = False
+    remat_policy: str = "none"  # none | full | dots | any registry policy
 
     def __post_init__(self):
         if self.activation not in ("gelu", "relu"):
@@ -136,7 +143,7 @@ def _attn(cfg: GPTConfig, x: jnp.ndarray, layer: Params,
     """QKV projection + (cached) attention. Returns (out, (k, v))."""
     b, t, h = x.shape
     nh, hd = cfg.num_heads, cfg.head_size
-    qkv = x @ layer["wqkv"] + layer["bqkv"]
+    qkv = checkpoint_name(x @ layer["wqkv"] + layer["bqkv"], "qkv_proj")
     q, k, v = jnp.split(qkv, 3, axis=-1)
     q = q.reshape(b, t, nh, hd)
     k = k.reshape(b, t, nh, hd)
@@ -157,6 +164,7 @@ def _attn(cfg: GPTConfig, x: jnp.ndarray, layer: Params,
         out = attention(q, k_cache, v_cache, causal=False,
                         mask=kv_pos <= q_abs)
         k, v = k_cache, v_cache
+    out = checkpoint_name(out, "attn_mix")
     return out.reshape(b, t, nh * hd) @ layer["wo"] + layer["bo"], (k, v)
 
 
@@ -168,18 +176,24 @@ def _block(cfg: GPTConfig, x, layer, kv=None, cache_len=None,
         attn_call = lambda y: _attn(cfg, y, layer, kv, cache_len)  # noqa: E731
     eps = cfg.layer_norm_eps
     act = jax.nn.relu if cfg.activation == "relu" else jax.nn.gelu
+    # "attn_out"/"mlp_out" mark the selective-remat saveables (identity
+    # outside a targeting jax.checkpoint policy) — see the registry in
+    # runtime/activation_checkpointing/checkpointing.py
     if cfg.post_ln:
         a, kv = attn_call(x)
+        a = checkpoint_name(a, "attn_out")
         x = layer_norm(x + a, layer["ln1_scale"], layer["ln1_bias"], eps)
-        m = act(x @ layer["w_up"] + layer["b_up"]) @ layer["w_down"] \
+        up = checkpoint_name(x @ layer["w_up"] + layer["b_up"], "mlp_up")
+        m = checkpoint_name(act(up) @ layer["w_down"], "mlp_out") \
             + layer["b_down"]
         x = layer_norm(x + m, layer["ln2_scale"], layer["ln2_bias"], eps)
     else:  # pre-LN (GPT-2/OPT)
         y = layer_norm(x, layer["ln1_scale"], layer["ln1_bias"], eps)
         a, kv = attn_call(y)
-        x = x + a
+        x = x + checkpoint_name(a, "attn_out")
         y = layer_norm(x, layer["ln2_scale"], layer["ln2_bias"], eps)
-        x = x + act(y @ layer["w_up"] + layer["b_up"]) @ layer["w_down"] \
+        up = checkpoint_name(y @ layer["w_up"] + layer["b_up"], "mlp_up")
+        x = x + checkpoint_name(act(up) @ layer["w_down"], "mlp_out") \
             + layer["b_down"]
     return x, kv
 
@@ -212,13 +226,25 @@ def apply(cfg: GPTConfig, params: Params, tokens: jnp.ndarray, *,
     layers = _cast_layers(params, compute_dtype)
     block = partial(_block, cfg)
     if cfg.remat:
-        block = jax.checkpoint(block)
+        # route through the shared remat-policy registry (same name map as
+        # models/llama.py) so the config knob and the model agree
+        from ..runtime.activation_checkpointing import checkpointing as ac
+
+        name = {"none": "full", "full": "full",
+                "dots": "dots_saveable"}.get(cfg.remat_policy,
+                                             cfg.remat_policy)
+        block = jax.checkpoint(block, policy=ac.get_policy(name))
 
     def scan_body(x, layer):
         x, _ = block(x, layer)
         return x, None
 
-    x, _ = lax.scan(scan_body, x, layers)
+    from ..comm import overlap as ov
+
+    if ov.layer_prefetch_active():
+        x, _ = ov.prefetch_scan(scan_body, x, layers)
+    else:
+        x, _ = lax.scan(scan_body, x, layers)
     return _head(cfg, params, x, compute_dtype)
 
 

@@ -28,6 +28,7 @@ from typing import Any, Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.ad_checkpoint import checkpoint_name
 
 from ..ops.attention import attention
 from ._paged import paged_attention_step
@@ -36,6 +37,12 @@ from ..ops.norms import rms_norm
 from ..ops.rotary import apply_rotary, rope_frequencies
 
 Params = Dict[str, Any]
+
+# checkpoint names this family's TRAINING block attaches (the selective-
+# remat saveables) — the tier-1 lint test verifies each appears in the
+# traced jaxpr, so a refactor can't silently drop one
+CHECKPOINT_NAMES_EMITTED = ("qkv_proj", "attn_mix", "attn_out",
+                            "mlp_gate", "mlp_up", "mlp_out")
 
 
 @dataclass(frozen=True)
@@ -273,6 +280,11 @@ def _qkv_proj(cfg: LlamaConfig, y: jnp.ndarray, layer: Params):
         q = q + layer["bq"]
         k = k + layer["bk"]
         v = v + layer["bv"]
+    # "qkv_proj": the three projection dot results — selective-remat
+    # saveables (identity outside a targeting policy)
+    q = checkpoint_name(q, "qkv_proj")
+    k = checkpoint_name(k, "qkv_proj")
+    v = checkpoint_name(v, "qkv_proj")
     q = q.reshape(b, s, nh, hd)
     k = k.reshape(b, s, nkv, hd)
     if "q_norm" in layer:
@@ -328,13 +340,19 @@ def _block(cfg: LlamaConfig, x: jnp.ndarray, layer: Params,
     q, k, v = _qkv_proj(cfg, y, layer)
     q = apply_rotary(q, cos, sin, positions)
     k = apply_rotary(k, cos, sin, positions)
-    attn_out = attn_fn(q, k, v, causal=True)
-    x = x + pin(attn_out.reshape(b, s, nh * hd) @ layer["wo"])
+    # checkpoint names mark the selective-remat saveables (identity outside
+    # a jax.checkpoint policy that targets them — see POLICY_SAVED_NAMES in
+    # runtime/activation_checkpointing/checkpointing.py): "attn_mix" = the
+    # pre-projection attention output (what the wo backward consumes),
+    # "attn_out"/"mlp_out" = the residual-branch projections
+    attn_out = checkpoint_name(attn_fn(q, k, v, causal=True), "attn_mix")
+    x = x + pin(checkpoint_name(
+        attn_out.reshape(b, s, nh * hd) @ layer["wo"], "attn_out"))
 
     y = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
-    gate = jax.nn.silu(y @ layer["w_gate"])
-    up = y @ layer["w_up"]
-    x = x + pin((gate * up) @ layer["w_down"])
+    gate = jax.nn.silu(checkpoint_name(y @ layer["w_gate"], "mlp_gate"))
+    up = checkpoint_name(y @ layer["w_up"], "mlp_up")
+    x = x + pin(checkpoint_name((gate * up) @ layer["w_down"], "mlp_out"))
     return x
 
 
@@ -391,7 +409,15 @@ def apply(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray, *,
         def scan_body(x, layer):
             return block(x, layer, cos, sin, positions), None
 
-        x, _ = lax.scan(scan_body, x, layers)
+        from ..comm import overlap as ov
+
+        if ov.layer_prefetch_active():
+            # ZeRO-3 per-layer all-gather prefetch: layer i+1's param shards
+            # gather while layer i's matmuls run (engine-configured; same
+            # slices in the same order → bit-identical to the plain scan)
+            x, _ = ov.prefetch_scan(scan_body, x, layers)
+        else:
+            x, _ = lax.scan(scan_body, x, layers)
     x = rms_norm(x, params["final_norm"].astype(compute_dtype), cfg.rms_norm_eps)
     head = params.get("lm_head")
     if head is None:

@@ -17,6 +17,7 @@ from typing import Any, Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.ad_checkpoint import checkpoint_name
 
 from ..moe.layer import MoELayer, init_moe_ffn, moe_ffn_logical_axes
 from ..ops.attention import attention
@@ -27,6 +28,11 @@ from ..ops.rotary import apply_rotary, rope_frequencies
 from . import llama as llama_mod
 
 Params = Dict[str, Any]
+
+# checkpoint names this family's TRAINING block attaches (the selective-
+# remat saveables; the MoE expert matmuls stay unnamed — their dispatch
+# layout is the compact/einsum implementation's concern)
+CHECKPOINT_NAMES_EMITTED = ("qkv_proj", "attn_mix", "attn_out", "mlp_out")
 
 
 @dataclass(frozen=True)
@@ -47,6 +53,7 @@ class MixtralConfig:
     rope_theta: float = 1000000.0
     rms_norm_eps: float = 1e-5
     remat: bool = False
+    remat_policy: str = "none"  # none | full | dots | any registry policy
     # Qwen2-MoE extensions (reference .../qwen_v2_moe): QKV biases, raw
     # (unnormalized) top-k gates, and a sigmoid-gated shared dense expert
     attention_bias: bool = False
@@ -161,22 +168,40 @@ def apply(cfg: MixtralConfig, params: Params, tokens: jnp.ndarray, *,
         q, k, v = y @ layer["wq"], y @ layer["wk"], y @ layer["wv"]
         if "bq" in layer:
             q, k, v = q + layer["bq"], k + layer["bk"], v + layer["bv"]
+        # selective-remat saveables (identity outside a targeting policy);
+        # see POLICY_SAVED_NAMES in activation_checkpointing/checkpointing
+        q = checkpoint_name(q, "qkv_proj")
+        k = checkpoint_name(k, "qkv_proj")
+        v = checkpoint_name(v, "qkv_proj")
         q = apply_rotary(q.reshape(b, s, nh, hd), cos, sin)
         k = apply_rotary(k.reshape(b, s, nkv, hd), cos, sin)
         v = v.reshape(b, s, nkv, hd)
-        x = x + attention(q, k, v, causal=True).reshape(b, s, nh * hd) @ layer["wo"]
+        x = x + checkpoint_name(
+            checkpoint_name(attention(q, k, v, causal=True), "attn_mix")
+            .reshape(b, s, nh * hd) @ layer["wo"], "attn_out")
         y = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
         ffn_out, aux = moe_layer(layer["moe"], y)
-        return x + ffn_out, aux
+        return x + checkpoint_name(ffn_out, "mlp_out"), aux
 
     if cfg.remat:
-        block = jax.checkpoint(block)
+        # shared remat-policy registry (same name map as models/llama.py)
+        from ..runtime.activation_checkpointing import checkpointing as ac
+
+        name = {"none": "full", "full": "full",
+                "dots": "dots_saveable"}.get(cfg.remat_policy,
+                                             cfg.remat_policy)
+        block = jax.checkpoint(block, policy=ac.get_policy(name))
 
     def scan_body(x, layer):
         x, aux = block(x, layer)
         return x, aux
 
-    x, aux_losses = lax.scan(scan_body, x, layers)
+    from ..comm import overlap as ov
+
+    if ov.layer_prefetch_active():
+        x, aux_losses = ov.prefetch_scan(scan_body, x, layers)
+    else:
+        x, aux_losses = lax.scan(scan_body, x, layers)
     x = rms_norm(x, params["final_norm"].astype(compute_dtype), cfg.rms_norm_eps)
     logits = x @ params["lm_head"].astype(compute_dtype)
     return logits.astype(jnp.float32), jnp.sum(aux_losses)

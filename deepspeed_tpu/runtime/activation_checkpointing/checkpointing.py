@@ -33,8 +33,39 @@ from ...utils.logging import logger
 _config: Optional[Any] = None
 
 # names models may attach via jax.ad_checkpoint.checkpoint_name to mark
-# offloadable / saveable residuals
+# offloadable / saveable residuals. The model families EMIT (training
+# blocks, all O(batch·seq) — never the O(seq²) attention internals):
+#
+#   "qkv_proj" — the q/k/v projection outputs (pre-rotary),
+#   "attn_mix" — the attention output BEFORE the wo projection (what the
+#                wo backward consumes — saving it is what actually spares
+#                the attention recompute),
+#   "attn_out" — the attention output projection,
+#   "mlp_gate"/"mlp_up" — the FFN gate/up projections (pre-activation),
+#   "mlp_out" — the FFN down-projection.
+#
+# A tier-1 lint test pins that every name a registered policy saves is
+# actually emitted by the model families, so a model edit cannot silently
+# turn a policy into a no-op.
 CHECKPOINT_NAMES = ("residual", "attn_out", "mlp_out", "block_out")
+MATMUL_CHECKPOINT_NAMES = ("qkv_proj", "attn_mix", "attn_out",
+                           "mlp_gate", "mlp_up", "mlp_out")
+
+# policy name -> the checkpoint names it saves (name-based policies only;
+# shared with the schema registry + the model-emission lint test)
+POLICY_SAVED_NAMES = {
+    "save_names": CHECKPOINT_NAMES,
+    "offload": CHECKPOINT_NAMES,
+    # break the recompute CHAIN cheaply: with the attention branch output
+    # saved, everything downstream of it (the MLP half) recomputes without
+    # re-running attention — but attention's own backward still replays it
+    "save_attn_out": ("attn_out",),
+    # save EVERY big per-layer MXU dot result: the backward recomputes only
+    # cheap elementwise work (norms, rotary, silu) plus the one QK^T dot
+    # the O(seq²) probs would otherwise cost in memory — the bounded-HBM
+    # analog of dots_saveable (which also saves the quadratic scores)
+    "save_big_matmuls": MATMUL_CHECKPOINT_NAMES,
+}
 
 
 def _host_offload_policy(names: Sequence[str]):
@@ -65,6 +96,13 @@ def _register_policies():
         "dots_saveable": cp.dots_saveable,
         "dots_with_no_batch_dims": cp.checkpoint_dots_with_no_batch_dims,
         "save_names": cp.save_only_these_names(*CHECKPOINT_NAMES),
+        # selective remat (the HBM-vs-step-time middle ground between
+        # "full" — the ~8N-flops-accounted-as-6N tax — and "none"): see
+        # POLICY_SAVED_NAMES for exactly what each saves and why
+        "save_attn_out": cp.save_only_these_names(
+            *POLICY_SAVED_NAMES["save_attn_out"]),
+        "save_big_matmuls": cp.save_only_these_names(
+            *POLICY_SAVED_NAMES["save_big_matmuls"]),
         "offload": _host_offload_policy(CHECKPOINT_NAMES),
         "offload_dots": (cp.offload_dot_with_no_batch_dims("device", "pinned_host")
                          if hasattr(cp, "offload_dot_with_no_batch_dims")
@@ -152,6 +190,39 @@ class CheckpointFunction:
     @staticmethod
     def apply(run_function, *args):
         return checkpoint(run_function, *args)
+
+
+def saved_bytes(function: Callable, *args,
+                policy: Optional[str] = None) -> Optional[int]:
+    """Total bytes of NON-ARGUMENT residuals the backward of ``function``
+    keeps alive under the named ``policy`` — the trace-time, exact
+    measurement behind the HBM-vs-step-time sweep (``bench.py`` remat sweep,
+    ``Train/remat/saved_bytes_<policy>`` telemetry) and the policy-ordering
+    tests: ``none`` (no remat) saves every needed intermediate,
+    ``save_big_matmuls`` ⊇ ``save_attn_out``, ``full`` saves nothing.
+
+    ``policy=None``/``"none"`` measures the un-rematerialized function.
+    Returns None when jax's saved-residuals introspection is unavailable
+    (the sweep then falls back to allocator stats)."""
+    try:
+        from jax.ad_checkpoint import saved_residuals  # newer jax
+    except ImportError:
+        try:
+            from jax._src.ad_checkpoint import saved_residuals
+        except ImportError:  # pragma: no cover - depends on jax version
+            return None
+    wrapped = function
+    if policy not in (None, "none"):
+        wrapped = jax.checkpoint(function, policy=get_policy(policy))
+    total = 0
+    for aval, desc in saved_residuals(wrapped, *args):
+        if "argument" in desc:
+            continue  # inputs are resident either way
+        n = 1
+        for d in aval.shape:
+            n *= int(d)
+        total += n * aval.dtype.itemsize
+    return total
 
 
 def model_parallel_cuda_manual_seed(seed: int):

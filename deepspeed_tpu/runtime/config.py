@@ -163,7 +163,10 @@ class ActivationCheckpointingConfig(ConfigModel):
     number_checkpoints: Optional[int] = None
     synchronize_checkpoint_boundary: bool = False
     profile: bool = False
-    policy: str = "none"  # none | full | dots_saveable | offload
+    # none | full | dots_saveable | save_attn_out | save_big_matmuls |
+    # save_names | offload | ... — the named-policy registry in
+    # runtime/activation_checkpointing/checkpointing.py (POLICIES)
+    policy: str = "none"
 
 
 @register_config_model
@@ -199,8 +202,12 @@ class CommsOverlapConfig(ConfigModel):
     engine reduces gradients with explicit, coalesced collectives under
     shard_map instead of per-leaf sharding-constraint-implied ones.
 
-    Requires ZeRO stage <= 2 (stage 3's gather-on-use parameter sharding
-    conflicts with the manual data-parallel region) and no pipeline axis."""
+    The gradient-reduction engine requires ZeRO stage <= 2 (stage 3's
+    gather-on-use parameter sharding conflicts with the manual data-parallel
+    region) and no pipeline axis. At stage 3, enabling the block requires
+    ``layer_prefetch`` — the ZeRO-3 half of the overlap story: per-layer
+    param all-gather prefetch pipelined against the previous layer's
+    matmuls (T3), with the XLA async-collective flags still applied."""
     enabled: bool = False
     # flatten small grad leaves into flat buckets of ~this size before the
     # reduce-scatter (reference reduce_bucket_size analog); leaves larger
@@ -214,6 +221,13 @@ class CommsOverlapConfig(ConfigModel):
     # all_to_all_loco_quant_reduce; needs zero_quantized_gradients)
     loco: bool = False
     loco_err_beta: float = 0.8
+    # ZeRO-3 per-layer all-gather prefetch (comm/overlap.py prefetch_scan):
+    # the stacked-layer scan gathers layer i+1's param shards while layer
+    # i's matmuls run instead of gathering at first use. prefetch_depth =
+    # layers of gathered params kept in flight (1 = double buffer); each
+    # costs one gathered layer of HBM
+    layer_prefetch: bool = False
+    prefetch_depth: int = 1
     # XLA latency-hiding-scheduler / async-collective programming
     async_collectives: bool = True
     combine_threshold_mb: float = 0.0  # 0 -> leave the XLA default

@@ -215,11 +215,17 @@ class DeepSpeedTPUEngine:
         self.comms_overlap_flags: List[str] = []
         self._overlap_plan_cache = None
         if co.enabled:
-            if config.zero_config.stage >= 3:
+            if config.zero_config.stage >= 3 and not co.layer_prefetch:
                 raise ValueError(
                     "comms_overlap requires ZeRO stage <= 2: stage 3's "
                     "gather-on-use parameter sharding conflicts with the "
-                    "manual data-parallel reduction region")
+                    "manual data-parallel reduction region (set "
+                    "comms_overlap.layer_prefetch for the ZeRO-3 per-layer "
+                    "all-gather prefetch instead)")
+            if config.zero_config.stage >= 3:
+                log_dist("comms_overlap: ZeRO-3 — gradient-reduction overlap "
+                         "engine disabled (params gather on use); per-layer "
+                         "all-gather prefetch + XLA flags active")
             if mesh_mgr.pp_world_size > 1:
                 log_dist("comms_overlap: pipeline axis active — the overlap "
                          "engine is disabled (1F1B owns its own reduction); "
@@ -333,6 +339,29 @@ class DeepSpeedTPUEngine:
             if co.loco and config.zero_config.zero_quantized_gradients:
                 self._init_loco_residuals()
 
+        # --- comms_overlap.layer_prefetch: ZeRO-3 per-layer all-gather
+        # prefetch (T3). Published process-wide (latest engine wins, like
+        # activation_checkpointing.configure) so the model families' stacked
+        # -layer scans pick it up at the next train-step trace. ---
+        from ..comm.overlap import configure_layer_prefetch
+
+        self._layer_prefetch_on = bool(
+            co.enabled and co.layer_prefetch
+            and config.zero_config.stage >= 3
+            and mesh_mgr.pp_world_size <= 1)
+        if co.enabled and co.layer_prefetch and not self._layer_prefetch_on:
+            log_dist("comms_overlap.layer_prefetch has no effect here: it "
+                     "needs ZeRO stage 3 (gather-on-use params) and no "
+                     "pipeline axis — plain scan retained")
+        configure_layer_prefetch(
+            self._layer_prefetch_on,
+            depth=max(1, int(co.prefetch_depth)),
+            shardings=(self._layer_prefetch_shardings()
+                       if self._layer_prefetch_on else None))
+        if self._layer_prefetch_on:
+            log_dist(f"comms_overlap: per-layer all-gather prefetch armed "
+                     f"(depth={max(1, int(co.prefetch_depth))})")
+
         # --- compiled steps ---
         self._train_step = None
         self._grad_step = None
@@ -376,6 +405,24 @@ class DeepSpeedTPUEngine:
         self.telemetry = TelemetryHub(config, monitor=self.monitor,
                                       timers=self.timers,
                                       tput_timer=self.tput_timer)
+
+        # Train/overlap/* gauges (registered in telemetry/schema.py): the
+        # prefetch configuration + per-step gathered bytes, so the comm-
+        # efficiency report can attribute hidden comm to the prefetch
+        if self._layer_prefetch_on:
+            depth = max(1, int(co.prefetch_depth))
+            self.telemetry.train_event("overlap/prefetch_depth", depth)
+            lp = self.state.params.get("layers") \
+                if isinstance(self.state.params, dict) else None
+            if lp is not None:
+                leaves = jax.tree.leaves(lp)
+                if leaves:
+                    itemsize = jnp.dtype(self.precision.compute_dtype).itemsize
+                    self.telemetry.train_event(
+                        "overlap/prefetch_layers", float(leaves[0].shape[0]))
+                    self.telemetry.train_event(
+                        "overlap/prefetch_bytes",
+                        float(sum(l.size for l in leaves) * itemsize))
 
         # --- training watchdog (runtime/watchdog.py): consecutive-skip /
         # non-finite-loss / stall detection on host-visible step outputs.
@@ -971,6 +1018,8 @@ class DeepSpeedTPUEngine:
         co = self.config.comms_overlap
         if not co.enabled:
             return False
+        if self.config.zero_config.stage >= 3:
+            return False  # stage 3: only layer_prefetch + XLA flags apply
         if self.mesh_mgr.pp_world_size > 1:
             return False  # 1F1B owns its reduction (logged at init)
         return any(self.mesh_mgr.axis_size(a) > 1 for a in BATCH_AXES)
@@ -1010,6 +1059,25 @@ class DeepSpeedTPUEngine:
         self._overlap_plan_cache = (manual, n_total, plans, buckets,
                                     bucketed, loco_idx)
         return self._overlap_plan_cache
+
+    def _layer_prefetch_shardings(self):
+        """Per-layer GATHERED-layout shardings for the model's stacked
+        ``layers`` subtree (leading stacked dim dropped from each spec) —
+        the constraint :func:`overlap.prefetch_scan` pins each sliced layer
+        to, so XLA starts the ZeRO all-gather at slice time. Models whose
+        param tree has no ``layers`` dict get no constraint (the prefetch
+        ordering barrier still applies)."""
+        params = self.state.params
+        if not (isinstance(params, dict) and "layers" in params):
+            return None
+        is_p = lambda x: isinstance(x, P)  # noqa: E731
+        sub = self._qw_gather_specs["layers"]
+        mesh = self.mesh_mgr.mesh
+
+        def drop_stacked(spec):
+            return NamedSharding(mesh, P(*list(spec)[1:]))
+
+        return jax.tree.map(drop_stacked, sub, is_leaf=is_p)
 
     def _init_loco_residuals(self) -> None:
         """Allocate the per-leaf LoCo quantization-error residuals into

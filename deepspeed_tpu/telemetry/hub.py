@@ -83,6 +83,23 @@ class TelemetryHub:
         # retained-pool occupancy, evictions — docs/serving.md); tracked on
         # every rank for tests/reports, written through the monitor on rank 0
         self.serving_values: Dict[str, float] = {}
+        # Train/overlap/* + Train/remat/* gauges (layer-prefetch depth/bytes,
+        # per-policy remat saved bytes — docs/performance.md); same contract
+        # as serving_values, names validated against telemetry.schema
+        self.train_values: Dict[str, float] = {}
+
+    # ------------------------------------------------------------------ #
+    def train_event(self, name: str, value: float, step: int = 0) -> None:
+        """Fan out one ``Train/<name>`` gauge (overlap-prefetch and remat-
+        policy series — ``Train/overlap/*``, ``Train/remat/*``; the closed
+        name registry lives in ``telemetry.schema.TRAIN_SERIES``). Last
+        sample per series is the current value. Cheap when no monitor
+        backend is enabled."""
+        if not name.startswith("Train/"):
+            name = "Train/" + name
+        self.train_values[name] = float(value)
+        if self.rank0 and self._monitor_on():
+            self.monitor.write_events([(name, float(value), int(step))])
 
     # ------------------------------------------------------------------ #
     def serving_event(self, name: str, value: float, step: int = 0) -> None:
@@ -126,6 +143,8 @@ class TelemetryHub:
         for name, count in sorted(self.reliability_counts.items()):
             rows.append((name, float(count), "counter"))
         for name, value in sorted(self.serving_values.items()):
+            rows.append((name, float(value), "gauge"))
+        for name, value in sorted(self.train_values.items()):
             rows.append((name, float(value), "gauge"))
         if self.tracer.enabled:
             rows.append(("Telemetry/trace/ring_events",
@@ -222,8 +241,16 @@ class TelemetryHub:
             ref_bw = float(getattr(co, "reference_bw_gbps", 0.0) or 0.0)
             if ref_bw > 0:
                 serial_s = total / (ref_bw * 1e9)
-                events.append(("Comm/total/est_comm_frac",
-                               min(1.0, serial_s / step_time_s), step))
+                frac = min(1.0, serial_s / step_time_s)
+                events.append(("Comm/total/est_comm_frac", frac, step))
+                if getattr(co, "enabled", False):
+                    # overlap-hidden comm fraction: the share of the serial
+                    # comm time the step did NOT pay (1 - unoverlapped upper
+                    # bound — itself a lower bound on what was hidden)
+                    self.train_values["Train/overlap/hidden_comm_frac"] = \
+                        1.0 - frac
+                    events.append(("Train/overlap/hidden_comm_frac",
+                                   1.0 - frac, step))
         return events
 
     # ------------------------------------------------------------------ #

@@ -18,7 +18,11 @@ Checked invariants:
 - ``Serving/*`` names come from the CLOSED registry below — the serving
   engine's counter families are enumerated per metric, so a typo'd or
   unregistered serving series (which ``telemetry_report.py --serving`` and
-  the Prometheus mapper would silently ignore) fails validation instead.
+  the Prometheus mapper would silently ignore) fails validation instead;
+- ``Train/overlap/*`` and ``Train/remat/*`` names come from the closed
+  ``TRAIN_SERIES`` registry (layer-prefetch gauges and per-remat-policy
+  sweep rows); other ``Train/*`` families (``Train/Step``,
+  ``Train/Samples``) stay open.
 """
 
 from __future__ import annotations
@@ -27,8 +31,8 @@ import math
 import re
 from typing import Any, Dict, Iterable, List, Tuple
 
-__all__ = ["EVENT_NAME_RE", "SERVING_SERIES", "validate_events",
-           "validate_jsonl_records"]
+__all__ = ["EVENT_NAME_RE", "SERVING_SERIES", "TRAIN_SERIES",
+           "REMAT_POLICIES", "validate_events", "validate_jsonl_records"]
 
 EVENT_NAME_RE = re.compile(r"^[A-Z][A-Za-z0-9_]*(/[A-Za-z0-9_.\-]+)+$")
 
@@ -60,6 +64,25 @@ SERVING_SERIES = frozenset(
         "requests", "affinity_hits", "session_hits", "load_fallbacks",
         "drains", "replicas")])
 
+# The named remat policies the activation-checkpointing registry ships
+# (runtime/activation_checkpointing/checkpointing.py POLICIES — a tier-1
+# test pins the two lists equal, so a policy added there must be
+# registered here to get its sweep series).
+REMAT_POLICIES = ("none", "full", "dots_saveable",
+                  "dots_with_no_batch_dims", "save_names", "save_attn_out",
+                  "save_big_matmuls", "offload", "offload_dots")
+
+# Registered Train/overlap/* + Train/remat/* series — the training-side
+# fine-grained-overlap gauges (engine layer-prefetch config + hub comm
+# accounting) and the per-policy remat sweep rows (bench.py remat sweep,
+# MemoryTelemetry). Same closed-registry contract as SERVING_SERIES.
+TRAIN_SERIES = frozenset(
+    ["Train/overlap/" + m for m in (
+        "prefetch_depth", "prefetch_layers", "prefetch_bytes",
+        "hidden_comm_frac")]
+    + [f"Train/remat/{m}_{p}" for p in REMAT_POLICIES
+       for m in ("saved_bytes", "peak_bytes", "step_ms")])
+
 
 def validate_events(events: Iterable[Tuple[str, float, int]]) -> List[str]:
     """Check ``(name, value, step)`` triples against the schema; returns a
@@ -80,6 +103,11 @@ def validate_events(events: Iterable[Tuple[str, float, int]]) -> List[str]:
         if name.startswith("Serving/") and name not in SERVING_SERIES:
             problems.append(f"event #{i}: serving series {name!r} is not "
                             f"registered in telemetry.schema.SERVING_SERIES")
+            continue
+        if name.startswith(("Train/overlap/", "Train/remat/")) and \
+                name not in TRAIN_SERIES:
+            problems.append(f"event #{i}: train series {name!r} is not "
+                            f"registered in telemetry.schema.TRAIN_SERIES")
             continue
         try:
             v = float(value)

@@ -106,7 +106,63 @@ def comm_efficiency(events: List[dict]) -> str:
     if frac:
         lines.append(f"  est unoverlapped comm: {frac[-1] * 100:.1f}% "
                      f"of step time (upper bound)")
+    extra = _overlap_remat_sections(events)
+    if extra:
+        lines.append("")
+        lines.extend(extra)
     return "\n".join(lines)
+
+
+def _overlap_remat_sections(events: List[dict]) -> List[str]:
+    """Fine-grained overlap + selective-remat rollup (the ``Train/overlap/*``
+    and ``Train/remat/*`` gauge series — docs/performance.md): layer-prefetch
+    configuration, overlap-hidden comm fraction, and the per-remat-policy
+    saved-bytes / peak-HBM / step-time sweep rows. Gauges: last sample per
+    series wins."""
+    ov = {e["name"][len("Train/overlap/"):]: e["value"] for e in events
+          if e["name"].startswith("Train/overlap/")}
+    remat = {e["name"][len("Train/remat/"):]: e["value"] for e in events
+             if e["name"].startswith("Train/remat/")}
+    lines: List[str] = []
+    if ov:
+        lines.append("fine-grained overlap (layer prefetch)")
+        if "prefetch_depth" in ov:
+            lines.append(f"  prefetch depth:        "
+                         f"{int(ov['prefetch_depth'])} layer(s) in flight")
+        if "prefetch_layers" in ov:
+            lines.append(f"  prefetched layers:     "
+                         f"{int(ov['prefetch_layers'])} per step")
+        if "prefetch_bytes" in ov:
+            lines.append(f"  gathered bytes/step:   "
+                         f"{_fmt_bytes(ov['prefetch_bytes'])}")
+        if "hidden_comm_frac" in ov:
+            lines.append(f"  overlap-hidden comm:   "
+                         f"{ov['hidden_comm_frac'] * 100:.1f}% of serial "
+                         f"comm time (lower bound)")
+    if remat:
+        # names are <metric>_<policy>; metrics are fixed, policies open-ended
+        per_policy: Dict[str, Dict[str, float]] = {}
+        for key, val in remat.items():
+            for metric in ("saved_bytes", "peak_bytes", "step_ms"):
+                if key.startswith(metric + "_"):
+                    per_policy.setdefault(key[len(metric) + 1:],
+                                          {})[metric] = val
+                    break
+        if per_policy:
+            if lines:
+                lines.append("")
+            lines.append("selective remat sweep (per policy)")
+            lines.append(f"  {'policy':<22} {'saved bytes':>14} "
+                         f"{'peak HBM':>14} {'step ms':>10}")
+            for pol, m in sorted(per_policy.items()):
+                saved = (_fmt_bytes(m["saved_bytes"])
+                         if "saved_bytes" in m else "-")
+                peak = (_fmt_bytes(m["peak_bytes"])
+                        if "peak_bytes" in m else "-")
+                step = (f"{m['step_ms']:.2f}" if "step_ms" in m else "-")
+                lines.append(f"  {pol:<22} {saved:>14} {peak:>14} "
+                             f"{step:>10}")
+    return lines
 
 
 def reliability(events: List[dict]) -> str:

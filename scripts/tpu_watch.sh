@@ -120,10 +120,18 @@ while true; do
       run_probe SERVING scripts/serving_bench.py 3000 SERVING_TPU_LIVE.json
     hold_requested || run_probe LONGCTX scripts/longctx_bench.py 2400 LONGCTX_TPU_LIVE.json
     hold_requested || run_probe MOE scripts/moe_dispatch_bench.py 1200 MOE_TPU_LIVE.json
-    # full headline bench incl. shape rows (first compiles are slow)
+    # full headline bench incl. shape rows (first compiles are slow).
+    # Since the overlap/remat round the headline JSON also carries:
+    #  - detail.attn_probe: standalone attention MFU at hd=128/bq=512
+    #    (PERF.md open item — fwd and fwd+bwd rows)
+    #  - detail.remat_sweep: per-remat-policy step time + compiled temp
+    #    bytes + saved-residual bytes (the HBM-vs-step-time trade, measured)
+    #  - detail.overlap_remat: layer-prefetch + save_big_matmuls vs the
+    #    full-remat baseline — the ≥0.65 MFU trajectory evidence
+    # budget 3000→3600 covers the extra engine builds + compiles.
     if ! hold_requested && ! past_deadline; then
       bts=$(date -u +%Y%m%dT%H%M%SZ)
-      DSTPU_BENCH_SHAPES=1 timeout -k 120 3000 python bench.py \
+      DSTPU_BENCH_SHAPES=1 timeout -k 120 3600 python bench.py \
         > "bench_runs/BENCH_tpu_${bts}.json" 2> "bench_runs/bench_${bts}.err"
       rc=$?
       tail -c 300 "bench_runs/BENCH_tpu_${bts}.json" >> "$LOG"
