@@ -530,6 +530,136 @@ def bench_overlap_remat(jax, on_tpu, steps=None) -> dict:
 _DECODE_CHILD: dict = {}
 
 
+def bench_quantized_comm(jax, on_tpu) -> dict:
+    """ZeRO++ trio wire-volume probe (quantized & hierarchical collectives,
+    docs/performance.md): trace-time CommsTelemetry byte accounting for —
+
+    (a) the stage-2 param all-gather, qwZ off vs on: quantized wire bytes vs
+        the fp32 equivalent of the same payload (the >=3.5x acceptance
+        number comes from algo accounting, not from an assertion);
+    (b) gas-composed DP volume: plain stage-2 per-micro reduction vs
+        deferred-GAS + qgZ int8 grads + qwZ int8 weight gather.
+
+    Tiny model, one real step per config — the records are per-trace, so
+    this costs seconds on CPU and TPU alike."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    import deepspeed_tpu as dst
+    from deepspeed_tpu.comm import comm as ds_comm
+    from deepspeed_tpu.comm import mesh as mesh_lib
+    from deepspeed_tpu.models import llama
+
+    mcfg = llama.LlamaConfig.tiny(vocab_size=512, max_seq_len=64,
+                                  use_pipeline=False)
+    n_dev = max(1, len(jax.devices()))
+
+    def run(zero, co=None, gas=1):
+        mesh_lib.set_mesh(None)
+        tel = ds_comm.get_telemetry()
+        tel.reset()
+        config = {
+            "train_batch_size": 2 * n_dev * gas,
+            "gradient_accumulation_steps": gas,
+            "bf16": {"enabled": True},
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 2, **zero},
+            "comms_logger": {"enabled": True},
+            "steps_per_print": 0,
+        }
+        if co:
+            config["comms_overlap"] = co
+        spec = llama.model_spec(mcfg, compute_dtype=jnp.bfloat16)
+        engine, _, _, _ = dst.initialize(model=spec, config=config)
+        tokens = np.random.default_rng(0).integers(
+            0, mcfg.vocab_size, (engine.train_batch_size(), 33),
+            dtype=np.int32)
+        engine.train_batch({"tokens": tokens})
+        summ = tel.summary()
+        gather = {k: v for k, v in summ.items()
+                  if k.startswith("all_gather_params")}
+        return {
+            "gather_wire_bytes": int(sum(s["bytes"] for s in
+                                         gather.values())),
+            "gather_fp32_equiv": int(sum(s["fp32_equiv_bytes"] for s in
+                                         gather.values())),
+            "total_algo_bytes": int(tel.total_algo_bytes()),
+        }
+
+    try:
+        base = run({})
+        qwz = run({"zero_quantized_weights": True})
+        gas = 2
+        dp_base = run({}, gas=gas)
+        dp_q = run({"zero_quantized_weights": True,
+                    "zero_quantized_gradients": True},
+                   co={"enabled": True, "deferred_gradient_reduce": True,
+                       "loco": True, "coalesce_buckets": False}, gas=gas)
+        out = {
+            "ok": True,
+            "allgather": {
+                "fp32_equiv_bytes": qwz["gather_fp32_equiv"],
+                "wire_bytes_base": base["gather_wire_bytes"],
+                "wire_bytes_qwz": qwz["gather_wire_bytes"],
+                # wire reduction of the weight gather vs an fp32 wire
+                "qwz_reduction_vs_fp32": round(
+                    qwz["gather_fp32_equiv"]
+                    / max(qwz["gather_wire_bytes"], 1), 2),
+            },
+            "dp_volume": {
+                "gas": gas,
+                "algo_bytes_base": dp_base["total_algo_bytes"],
+                "algo_bytes_qgz_qwz_deferred": dp_q["total_algo_bytes"],
+                "reduction": round(dp_base["total_algo_bytes"]
+                                   / max(dp_q["total_algo_bytes"], 1), 2),
+            },
+        }
+    except Exception as e:  # must never poison the headline number
+        out = {"ok": False, "error": f"{type(e).__name__}: {e}"[-400:]}
+    return out
+
+
+def run_quant_comm(jax, on_tpu) -> dict:
+    """:func:`bench_quantized_comm`, but on a single-device backend (the CPU
+    fallback) there is no gather boundary to record — rerun the probe in a
+    child on an 8-virtual-device CPU mesh so the wire accounting is real
+    either way. Multi-device backends run in-process."""
+    if len(jax.devices()) > 1:
+        return bench_quantized_comm(jax, on_tpu)
+    import subprocess
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8").strip()
+    try:
+        out = subprocess.run([sys.executable, __file__, "--quant-comm-only"],
+                             capture_output=True, text=True, timeout=560,
+                             env=env)
+        tail = [l for l in out.stdout.strip().splitlines()
+                if l.startswith("QUANT_COMM=")]
+        if out.returncode == 0 and tail:
+            child = json.loads(tail[-1][len("QUANT_COMM="):])
+            child["devices"] = "8-virtual-cpu (single-device parent)"
+            return child
+        return {"ok": False,
+                "error": f"child rc={out.returncode} {out.stderr[-200:]}"}
+    except subprocess.TimeoutExpired:
+        return {"ok": False, "error": "timeout: quant-comm child > 560s"}
+
+
+def quant_comm_only():
+    """Child entry for :func:`run_quant_comm` (env forces the 8-device
+    virtual CPU mesh before jax initializes)."""
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except RuntimeError:
+        pass
+    print("QUANT_COMM=" + json.dumps(bench_quantized_comm(jax, False)))
+
+
 def run_decode_subprocess() -> object:
     """Decode bench in a SUBPROCESS with a hard timeout, BEFORE this process
     initializes its own jax client: a wedged tunnel compile must never hold
@@ -675,6 +805,12 @@ def main():
         RESULT["detail"]["remat_sweep"] = bench_remat_sweep(jax, on_tpu)
         RESULT["detail"]["overlap_remat"] = bench_overlap_remat(jax, on_tpu)
 
+    # ZeRO++ trio wire-volume accounting (qwZ all-gather compression, gas-
+    # composed qgZ+qwZ DP volume) — trace-time byte records, seconds to run.
+    # Skippable via DSTPU_BENCH_QCOMM=0.
+    if os.environ.get("DSTPU_BENCH_QCOMM", "1") not in ("", "0"):
+        RESULT["detail"]["quant_comm"] = run_quant_comm(jax, on_tpu)
+
     # a decode child that fell back to CPU must not masquerade as the
     # accelerator decode number
     if isinstance(decode, dict):
@@ -749,6 +885,9 @@ def bench_decode(jax, mcfg, batch: int = 16, prompt_len: int = None,
 if __name__ == "__main__":
     if "--decode-only" in sys.argv:
         decode_only()
+        sys.exit(0)
+    if "--quant-comm-only" in sys.argv:
+        quant_comm_only()
         sys.exit(0)
     try:
         main()

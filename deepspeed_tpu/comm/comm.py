@@ -36,21 +36,25 @@ AxisName = Union[str, Sequence[str]]
 # telemetry (comms-logger parity)
 # --------------------------------------------------------------------------- #
 def _tree_bytes(x: Any) -> tuple:
-    """Total payload bytes + representative shape(s) for an arbitrary pytree
-    (arrays, scalars, dicts/lists of either). Leaves that carry no countable
-    payload (strings, None) contribute zero instead of poisoning the total."""
+    """Total payload bytes + element count + representative shape(s) for an
+    arbitrary pytree (arrays, scalars, dicts/lists of either). Leaves that
+    carry no countable payload (strings, None) contribute zero instead of
+    poisoning the total. Element count feeds the default fp32-equivalent
+    accounting (what the payload would weigh uncompressed at fp32)."""
     total = 0
+    elems = 0
     shapes = []
     for leaf in jax.tree_util.tree_leaves(x):
         try:
             shp = tuple(np.shape(leaf))
-            total += int(np.prod(shp, dtype=np.int64)) * \
-                jnp.result_type(leaf).itemsize
+            n = int(np.prod(shp, dtype=np.int64))
+            total += n * jnp.result_type(leaf).itemsize
+            elems += n
             shapes.append(shp)
         except Exception:
             continue
     shape = shapes[0] if len(shapes) == 1 else tuple(shapes)
-    return total, shape
+    return total, elems, shape
 
 
 def _axis_world(axis: AxisName) -> int:
@@ -90,6 +94,28 @@ def _algo_bytes(op: str, nbytes: int, world: int) -> float:
         if op.startswith(prefix):
             return f(nbytes, world)
     return float(nbytes)  # broadcast / ppermute / send_recv / scatter
+
+
+def _link_class(axis: AxisName) -> str:
+    """Classify the slowest link tier a collective over ``axis`` crosses:
+    ``"dcn"`` when any named axis is in the installed mesh's ``dcn_axes``
+    (the cross-island tier — multi-slice DCN, or the 2-level ``data`` axis
+    of an hpZ/MiCS carve) with size > 1, else ``"ici"``. Unknown mesh →
+    ``"ici"`` (single-tier)."""
+    names = axis if isinstance(axis, (tuple, list)) else (axis,)
+    try:
+        from . import mesh as _mesh_mod
+
+        mm = _mesh_mod._global_mesh
+        if mm is None:
+            return "ici"
+        dcn = tuple(getattr(mm, "dcn_axes", ()) or ())
+        for a in names:
+            if a in dcn and mm.axis_size(a) > 1:
+                return "dcn"
+    except Exception:
+        pass
+    return "ici"
 
 
 def _trace_site() -> str:
@@ -135,14 +161,23 @@ class CommsTelemetry:
         return any(op == p or op.startswith(p) for p in self.prof_ops)
 
     def record(self, op: str, axis: AxisName, x: Any,
-               repeats: int = 1) -> None:
+               repeats: int = 1, fp32_equiv: Optional[float] = None) -> None:
+        """``fp32_equiv``: bytes the payload would weigh uncompressed at
+        fp32. Defaults to element-count × 4; quantized collectives pass the
+        SOURCE element count explicitly (their int8+scales payload carries
+        more elements than the fp32 tensor it encodes), so the per-op
+        compression ratio fp32_equiv/bytes stays honest."""
         if not self.enabled or not self._profiled(op):
             return
-        nbytes, shape = _tree_bytes(x)
+        nbytes, elems, shape = _tree_bytes(x)
         world = _axis_world(axis)
         rec = {"op": op, "axis": axis, "bytes": nbytes, "shape": shape,
                "world": world, "algo_bytes": _algo_bytes(op, nbytes, world),
-               "repeats": max(int(repeats), 1), "site": _trace_site()}
+               "repeats": max(int(repeats), 1), "site": _trace_site(),
+               "link": _link_class(axis),
+               "fp32_equiv_bytes": (float(fp32_equiv)
+                                    if fp32_equiv is not None
+                                    else float(elems * 4))}
         self.records.append(rec)
         if self.verbose:
             logger.info(f"comm: {op} over {axis}: {nbytes} bytes "
@@ -152,19 +187,29 @@ class CommsTelemetry:
         out: Dict[str, Dict[str, Any]] = {}
         for r in self.records:
             s = out.setdefault(r["op"], {"count": 0, "bytes": 0,
-                                         "algo_bytes": 0.0, "sites": []})
+                                         "algo_bytes": 0.0,
+                                         "algo_bytes_dcn": 0.0,
+                                         "algo_bytes_ici": 0.0,
+                                         "fp32_equiv_bytes": 0.0,
+                                         "sites": []})
             rep = max(int(r.get("repeats", 1)), 1)
             s["count"] += rep
             s["bytes"] += max(r["bytes"], 0) * rep
-            s["algo_bytes"] += max(r.get("algo_bytes", 0.0), 0.0) * rep
+            algo = max(r.get("algo_bytes", 0.0), 0.0) * rep
+            s["algo_bytes"] += algo
+            s["algo_bytes_" + r.get("link", "ici")] += algo
+            s["fp32_equiv_bytes"] += \
+                max(r.get("fp32_equiv_bytes", 0.0), 0.0) * rep
             site = r.get("site")
             if site and site not in s["sites"]:
                 s["sites"].append(site)
         return out
 
-    def total_algo_bytes(self) -> float:
-        """Per-step algorithmic bytes across every recorded collective."""
-        return sum(s["algo_bytes"] for s in self.summary().values())
+    def total_algo_bytes(self, link: Optional[str] = None) -> float:
+        """Per-step algorithmic bytes across every recorded collective;
+        ``link`` = "dcn" | "ici" restricts to that link class."""
+        key = "algo_bytes" if link is None else f"algo_bytes_{link}"
+        return sum(s[key] for s in self.summary().values())
 
     def log_summary(self, step_time_s: Optional[float] = None) -> None:
         """Periodic per-op rollup (reference ``log_summary()``); with a step
@@ -179,14 +224,23 @@ class CommsTelemetry:
             logger.info(msg)
 
     def events(self, step: int) -> List[tuple]:
-        """Monitor events (``Comm/<op>/{bytes,count,algo_bytes}``) for the
-        current trace records — cumulative per trace, constant across
-        executed steps."""
+        """Monitor events (``Comm/<op>/{bytes,count,algo_bytes,
+        algo_bytes_dcn,algo_bytes_ici,fp32_equiv_bytes}``) for the current
+        trace records — cumulative per trace, constant across executed
+        steps. The metric suffixes form the closed ``telemetry.schema.
+        COMM_METRICS`` registry; a new suffix here must be registered
+        there."""
         ev = []
         for op, s in sorted(self.summary().items()):
             ev.append((f"Comm/{op}/bytes", float(s["bytes"]), step))
             ev.append((f"Comm/{op}/count", float(s["count"]), step))
             ev.append((f"Comm/{op}/algo_bytes", float(s["algo_bytes"]), step))
+            ev.append((f"Comm/{op}/algo_bytes_dcn",
+                       float(s["algo_bytes_dcn"]), step))
+            ev.append((f"Comm/{op}/algo_bytes_ici",
+                       float(s["algo_bytes_ici"]), step))
+            ev.append((f"Comm/{op}/fp32_equiv_bytes",
+                       float(s["fp32_equiv_bytes"]), step))
         return ev
 
     def reset(self) -> None:

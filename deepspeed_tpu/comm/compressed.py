@@ -89,16 +89,27 @@ def onebit_all_reduce(x: jnp.ndarray, error: jnp.ndarray, axis_name: str,
     return avg, new_error, new_server_error
 
 
+def _group_quantize(g: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """THE symmetric int8 group quantizer: quantize the trailing (group) dim
+    of an already-grouped array. Single implementation shared by every
+    group-quantized collective in this module (`quantize_int8_groupwise`,
+    `_chunk_quantize`, the quantized all-reduce's gather phase) — a tier-1
+    regression test pins its output bit-identical to the historical inline
+    formulas, so numerical drift here is a test failure, not a silent
+    trajectory change."""
+    scale = jnp.maximum(jnp.max(jnp.abs(g), axis=-1, keepdims=True),
+                        1e-8) / 127.0
+    q = jnp.clip(jnp.round(g / scale), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
 def quantize_int8_groupwise(x: jnp.ndarray, group_size: int = 256
                             ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Symmetric groupwise int8 quantization (reference swizzled_quantize)."""
     flat = x.reshape(-1)
     pad = (-flat.size) % group_size
     flat = jnp.pad(flat, (0, pad))
-    g = flat.reshape(-1, group_size)
-    scale = jnp.maximum(jnp.max(jnp.abs(g), axis=1, keepdims=True), 1e-8) / 127.0
-    q = jnp.clip(jnp.round(g / scale), -127, 127).astype(jnp.int8)
-    return q, scale
+    return _group_quantize(flat.reshape(-1, group_size))
 
 
 def dequantize_int8(q: jnp.ndarray, scale: jnp.ndarray, shape,
@@ -170,9 +181,7 @@ def _chunk_quantize(x: jnp.ndarray, axis_size: int, group_size: int):
     cols = chunks.shape[1]
     pad = (-cols) % group_size
     chunks = jnp.pad(chunks, ((0, 0), (0, pad)))
-    g = chunks.reshape(axis_size, -1, group_size)
-    scale = jnp.maximum(jnp.max(jnp.abs(g), axis=2, keepdims=True), 1e-8) / 127.0
-    q = jnp.clip(jnp.round(g / scale), -127, 127).astype(jnp.int8)
+    q, scale = _group_quantize(chunks.reshape(axis_size, -1, group_size))
     return q, scale, cols
 
 
@@ -182,7 +191,8 @@ def _a2a_sum(q, scale, cols, chunk_shape, axis_name, dtype, repeats=1):
     from . import comm as dist
 
     dist.get_telemetry().record("all_to_all_quant_reduce", axis_name,
-                                (q, scale), repeats=repeats)
+                                (q, scale), repeats=repeats,
+                                fp32_equiv=q.size * 4)
     swapped_q = lax.all_to_all(q, axis_name, split_axis=0, concat_axis=0,
                                tiled=False)
     swapped_s = lax.all_to_all(scale, axis_name, split_axis=0, concat_axis=0,
@@ -230,3 +240,133 @@ def quantized_reduce_scatter_ef(x: jnp.ndarray, axis_name: str,
     return (_a2a_sum(q, scale, cols, chunk_shape, axis_name, x.dtype,
                      repeats=repeats),
             new_residual)
+
+
+# --------------------------------------------------------------------------- #
+# ZeRO++ qwZ: quantized weight all-gather
+# --------------------------------------------------------------------------- #
+def rowwise_quantize_int8(x: jnp.ndarray
+                          ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-row (trailing-dim) symmetric int8 weight quantization — the qwZ
+    block quantizer (reference ``csrc/quantization/swizzled_quantize.cu``
+    analog; one fp32 scale per trailing-dim row). All-zero rows keep scale 1
+    so the dequantized copy is exactly zero."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale),
+                 -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def quantized_gather(x: jnp.ndarray, q_sharding=None, scale_sharding=None):
+    """ZeRO++ qwZ quantized weight all-gather (``zero_quantized_weights``):
+    quantize the SHARDED leaf per row, move the int8 copy (plus tiny fp32
+    scales) across the gather boundary by constraining it to the target
+    layout, dequantize in the gathered layout where XLA fuses it into the
+    consumer. The wire carries ~1/4 the fp32 bytes.
+
+    The ``optimization_barrier`` pins the f32→s8 convert BEFORE the gather —
+    without it XLA commutes the convert past the all-gather and the wire
+    carries full-width again. Backward is a straight-through estimator:
+    ``round()`` has zero derivative, so the cotangent passes through
+    unchanged to the sharded source leaf (SPMD lowers the layout change; the
+    reference's backward also treats the quantized gather as identity)."""
+
+    def impl(v):
+        q, scale = rowwise_quantize_int8(v)
+        q = jax.lax.optimization_barrier(q)
+        if q_sharding is not None:
+            q = jax.lax.with_sharding_constraint(q, q_sharding)
+        if scale_sharding is not None:
+            scale = jax.lax.with_sharding_constraint(scale, scale_sharding)
+        return (q.astype(jnp.float32) * scale).astype(v.dtype)
+
+    qw = jax.custom_vjp(impl)
+    qw.defvjp(lambda v: (impl(v), None),
+              lambda _, g: (g.astype(x.dtype),))
+    return qw(x)
+
+
+# --------------------------------------------------------------------------- #
+# EQuARX-style quantized all-reduce (the non-ZeRO DP reduction path)
+# --------------------------------------------------------------------------- #
+def _ar_rows(x: jnp.ndarray, world: int) -> jnp.ndarray:
+    """Flatten + pad one leaf into the ``[world, k]`` chunk layout the
+    reduce-scatter half of the all-reduce distributes."""
+    flat = x.astype(jnp.float32).reshape(-1)
+    pad = (-flat.size) % world
+    return jnp.pad(flat, (0, pad)).reshape(world, -1)
+
+
+def _quantized_all_reduce(x, axis_names, residual, err_beta, group_size,
+                          repeats):
+    from . import comm as dist
+
+    sizes = [_axis_size(a) for a in axis_names]
+    world = 1
+    for n in sizes:
+        world *= n
+    y = _ar_rows(x, world)
+    first, rest = axis_names[0], axis_names[1:]
+    new_residual = None
+    if residual is not None:
+        r = _ar_rows(residual, world)
+        y, nr = quantized_reduce_scatter_ef(
+            y, first, sizes[0], r, err_beta=err_beta,
+            group_size=group_size, repeats=repeats)
+        new_residual = nr.reshape(-1)[:x.size].reshape(x.shape)
+    else:
+        y = quantized_reduce_scatter(y, first, sizes[0],
+                                     group_size=group_size, repeats=repeats)
+    for a, n in zip(rest, sizes[1:]):
+        y = quantized_reduce_scatter(y, a, n, group_size=group_size,
+                                     repeats=repeats)
+    # y: [1, k] — this member's chunk of the SUM. Re-quantize and all-gather
+    # the int8 chunk (+ scales) back to full shape: the gather half of the
+    # all-reduce also moves int8 on the wire.
+    chunk = y.reshape(-1)
+    k = chunk.size
+    pad = (-k) % group_size
+    g = jnp.pad(chunk, (0, pad)).reshape(-1, group_size)
+    q, scale = _group_quantize(g)
+    dist.get_telemetry().record("all_gather_quant", axis_names, (q, scale),
+                                repeats=repeats, fp32_equiv=q.size * 4)
+    for a in reversed(axis_names):
+        q = lax.all_gather(q, a, axis=0, tiled=True)
+        scale = lax.all_gather(scale, a, axis=0, tiled=True)
+    deq = (q.astype(jnp.float32) * scale).reshape(world, -1)[:, :k]
+    out = deq.reshape(-1)[:x.size].reshape(x.shape).astype(x.dtype)
+    return out, new_residual
+
+
+def quantized_all_reduce(x: jnp.ndarray, axis_names: Tuple[str, ...],
+                         group_size: int = 256,
+                         repeats: int = 1) -> jnp.ndarray:
+    """EQuARX-style quantized all-reduce (arXiv:2306.10209 qgZ composition /
+    EQuARX): the SUM over ``axis_names`` composed as a group-quantized int8
+    reduce-scatter followed by a group-quantized int8 all-gather, so BOTH
+    halves of the all-reduce move ~1/4 the fp32 wire bytes. This is the
+    non-ZeRO data-parallel gradient path (replicated grad layouts, where a
+    reduce-scatter has no sharded destination to land in).
+
+    Use inside shard_map over ``axis_names`` (order = hierarchy order,
+    slowest link first). Returns the SUM (divide for a mean), exact up to
+    two int8 group-quantization roundings."""
+    out, _ = _quantized_all_reduce(x, axis_names, None, 0.0, group_size,
+                                   repeats)
+    return out
+
+
+def quantized_all_reduce_ef(x: jnp.ndarray, axis_names: Tuple[str, ...],
+                            residual: jnp.ndarray, err_beta: float = 0.8,
+                            group_size: int = 256, repeats: int = 1
+                            ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """:func:`quantized_all_reduce` with LoCo error feedback on the
+    reduce-scatter half (the stage whose input magnitude dominates the
+    rounding error, as in :func:`loco_quantized_reduce_scatter_dim`): the
+    carried ``residual`` (same shape as ``x``) is added before the first
+    quantization and the damped fresh quantization error becomes the new
+    residual, so int8 rounding bias does not accumulate across steps.
+    Returns ``(sum, new_residual)``."""
+    return _quantized_all_reduce(x, axis_names, residual, err_beta,
+                                 group_size, repeats)

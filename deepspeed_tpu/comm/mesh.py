@@ -42,7 +42,7 @@ _global_mesh: Optional["MeshManager"] = None
 
 
 def _arrange_devices(devices: Sequence[jax.Device],
-                     sizes: Sequence[int]) -> np.ndarray:
+                     sizes: Sequence[int]) -> Tuple[np.ndarray, Optional[str]]:
     """Physical-topology-aware device→mesh assignment.
 
     The mesh analog of the reference's rank-mapping layer
@@ -56,9 +56,13 @@ def _arrange_devices(devices: Sequence[jax.Device],
     preferably 'data') axis to DCN and keeps every other axis inside a slice.
     CPU / single-device meshes keep the plain reshape (virtual devices have
     no topology, and tests depend on deterministic device order).
+
+    Returns ``(device_array, dcn_axis_name)`` — the second element names the
+    mesh axis confined to DCN on a multi-slice job (None when every axis
+    rides ICI), feeding the CommsTelemetry link-class tagging.
     """
     if len(devices) == 1 or getattr(devices[0], "platform", "cpu") != "tpu":
-        return np.asarray(devices).reshape(sizes)
+        return np.asarray(devices).reshape(sizes), None
     from jax.experimental import mesh_utils
 
     slice_ids = {getattr(d, "slice_index", 0) for d in devices}
@@ -74,6 +78,7 @@ def _arrange_devices(devices: Sequence[jax.Device],
             raise ValueError(
                 f"no mesh axis divisible by slice count {n_slices}: "
                 f"{dict(zip(MESH_AXES, sizes))}")
+    dcn_name = MESH_AXES[dcn_axis] if dcn_axis is not None else None
     try:
         if dcn_axis is not None:
             dcn = [1] * len(sizes)
@@ -81,15 +86,15 @@ def _arrange_devices(devices: Sequence[jax.Device],
             per_slice = list(sizes)
             per_slice[dcn_axis] //= n_slices
             return mesh_utils.create_hybrid_device_mesh(
-                per_slice, dcn, devices=devices)
-        return mesh_utils.create_device_mesh(sizes, devices=devices)
+                per_slice, dcn, devices=devices), dcn_name
+        return mesh_utils.create_device_mesh(sizes, devices=devices), None
     except Exception as e:  # unknown topology (e.g. tunneled sub-slice
         # quirks) — mesh_utils raises plain ValueError for these too, so no
         # exception type is exempt from the fallback
         logger.warning(
             f"topology-aware mesh assignment failed ({e}); falling back to "
             "device-order reshape — inner-axis collectives may cross hosts")
-        return np.asarray(devices).reshape(sizes)
+        return np.asarray(devices).reshape(sizes), dcn_name
 
 
 @dataclass
@@ -102,6 +107,12 @@ class MeshManager:
     """
 
     mesh: Mesh
+    # axes whose collectives cross the slow (DCN) tier: auto-detected on
+    # multi-slice TPU jobs from the hybrid-mesh assignment; set explicitly
+    # (set_dcn_axes) to model a 2-level topology elsewhere — the hpZ/MiCS
+    # zero_shard carve designates 'data' as cross-island. Feeds the
+    # CommsTelemetry per-collective link-class tag.
+    dcn_axes: Tuple[str, ...] = ()
 
     @classmethod
     def create(cls, axis_sizes: Dict[str, int],
@@ -112,11 +123,18 @@ class MeshManager:
         if total != len(devices):
             raise ValueError(f"mesh sizes {dict(zip(MESH_AXES, sizes))} product {total} "
                              f"!= device count {len(devices)}")
-        dev_array = _arrange_devices(devices, sizes)
+        dev_array, dcn_axis = _arrange_devices(devices, sizes)
         mesh = Mesh(dev_array, MESH_AXES)
         log_dist(f"Created mesh {dict(zip(MESH_AXES, sizes))} over {len(devices)} devices "
                  f"({devices[0].platform})")
-        return cls(mesh=mesh)
+        return cls(mesh=mesh,
+                   dcn_axes=(dcn_axis,) if dcn_axis is not None else ())
+
+    def set_dcn_axes(self, axes: Sequence[str]) -> None:
+        """Designate the mesh axes whose collectives cross the slow (DCN)
+        tier. Auto-detected for multi-slice TPU meshes; call explicitly to
+        model a 2-level topology (the hpZ carve, CPU test meshes)."""
+        self.dcn_axes = tuple(axes)
 
     # --- axis sizes (groups.py parity) ---
     def axis_size(self, axis: str) -> int:

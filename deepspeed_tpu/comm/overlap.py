@@ -265,26 +265,38 @@ def apply_xla_overlap_flags(cfg) -> List[str]:
 # model families are pure functions with no engine handle, so the engine
 # publishes the decision here and the models consult it when choosing
 # between lax.scan and prefetch_scan for their stacked-layer loop.
-_LAYER_PREFETCH: dict = {"enabled": False, "depth": 1, "shardings": None}
+_LAYER_PREFETCH: dict = {"enabled": False, "depth": 1, "shardings": None,
+                         "quantize": None, "gather_axes": ()}
 
 
 def configure_layer_prefetch(enabled: bool, depth: int = 1,
-                             shardings=None) -> None:
+                             shardings=None, quantize=None,
+                             gather_axes: Tuple[str, ...] = ()) -> None:
     """Publish the engine's per-layer prefetch decision. ``shardings`` is an
     optional pytree (matching the model's per-layer param subtree, leading
     stacked dim dropped) of NamedShardings describing the GATHERED
     (compute/TP-only) layout — the constraint that makes XLA start each
     layer's all-gather at slice time instead of at first matmul use.
 
+    ``quantize`` (ZeRO++ qwZ): an optional ``(flags, scale_shardings)`` pair
+    of pytrees matching the STACKED layer subtree — leaves flagged True
+    route their gather through ``compressed.quantized_gather`` so the
+    prefetched layer rides the wire as int8 + per-row fp32 scales.
+    ``gather_axes`` names the mesh axes the per-layer gathers resolve over
+    (the hpZ secondary axes, or the full ZeRO axes) — telemetry only.
+
     Takes effect at the next train-step trace; call BEFORE the first
     ``train_batch`` of the engine that wants it."""
     _LAYER_PREFETCH["enabled"] = bool(enabled)
     _LAYER_PREFETCH["depth"] = max(1, int(depth))
     _LAYER_PREFETCH["shardings"] = shardings
+    _LAYER_PREFETCH["quantize"] = quantize
+    _LAYER_PREFETCH["gather_axes"] = tuple(gather_axes or ())
 
 
 def reset_layer_prefetch() -> None:
-    configure_layer_prefetch(False, depth=1, shardings=None)
+    configure_layer_prefetch(False, depth=1, shardings=None, quantize=None,
+                             gather_axes=())
 
 
 def layer_prefetch_active() -> bool:
@@ -319,20 +331,68 @@ def _ordering_bwd(_, ct):
 _ordering_barrier.defvjp(_ordering_fwd, _ordering_bwd)
 
 
-def _constrain_layer(sliced, shardings):
+def _constrain_layer(sliced, shardings, quantize=None):
     """Pin one gathered layer slice to the compute layout (the gather
-    trigger). A structure mismatch (model subtree ≠ engine params subtree,
-    e.g. a hand-rolled ModelSpec) degrades to no constraint — the prefetch
-    ordering barrier still applies, only the explicit gather target is
-    lost."""
+    trigger). With ``quantize`` (qwZ), flagged leaves quantize to int8 in
+    the sharded layout first so the implied all-gather moves int8 + scales
+    (``compressed.quantized_gather``). A structure mismatch (model subtree ≠
+    engine params subtree, e.g. a hand-rolled ModelSpec) degrades to no
+    constraint — the prefetch ordering barrier still applies, only the
+    explicit gather target (and quantization) is lost."""
     if shardings is None:
         return sliced
     try:
-        return jax.tree.map(
-            lambda t, s: t if s is None
-            else jax.lax.with_sharding_constraint(t, s), sliced, shardings)
+        if quantize is None:
+            return jax.tree.map(
+                lambda t, s: t if s is None
+                else jax.lax.with_sharding_constraint(t, s), sliced,
+                shardings)
+        from .compressed import quantized_gather
+
+        flags, scale_shardings = quantize
+
+        def one(t, s, f, sc):
+            if f and s is not None:
+                return quantized_gather(t, s, sc)
+            return t if s is None else jax.lax.with_sharding_constraint(t, s)
+
+        return jax.tree.map(one, sliced, shardings, flags, scale_shardings)
     except (ValueError, TypeError):
         return sliced
+
+
+def _record_prefetch_gathers(layers, n_layers: int, quantize) -> None:
+    """Trace-time comms-logger record of the per-layer prefetch gathers:
+    one representative layer slice, ``repeats=n_layers`` (the scan body
+    executes once per layer). Quantized (qwZ) leaves record their int8 +
+    scale wire payload with the fp32-equivalent byte count, so the
+    compression ratio and the DCN-vs-ICI link split are visible from
+    ``Comm/all_gather_prefetch*`` without asserting them."""
+    axes = tuple(_LAYER_PREFETCH.get("gather_axes") or ())
+    tel = dist.get_telemetry()
+    if not axes or not tel.enabled:
+        return
+    leaves = [l for l in jax.tree.leaves(layers) if hasattr(l, "shape")]
+    flags = [False] * len(leaves)
+    if quantize is not None:
+        try:
+            qf = [bool(f) for f in jax.tree.leaves(quantize[0])]
+            if len(qf) == len(leaves):
+                flags = qf
+        except Exception:
+            pass
+    plain = [jax.ShapeDtypeStruct(l.shape[1:], l.dtype)
+             for l, f in zip(leaves, flags) if not f]
+    quant = [(jax.ShapeDtypeStruct(l.shape[1:], jnp.int8),
+              jax.ShapeDtypeStruct(l.shape[1:-1] + (1,), jnp.float32))
+             for l, f in zip(leaves, flags) if f]
+    if plain:
+        tel.record("all_gather_prefetch", axes, plain, repeats=n_layers)
+    if quant:
+        n_elems = sum(int(np.prod(l.shape[1:])) for l, f in
+                      zip(leaves, flags) if f)
+        tel.record("all_gather_prefetch_q", axes, quant, repeats=n_layers,
+                   fp32_equiv=n_elems * 4)
 
 
 def prefetch_scan(body, init, layers, depth: Optional[int] = None,
@@ -351,22 +411,29 @@ def prefetch_scan(body, init, layers, depth: Optional[int] = None,
 
     ``depth`` layers of gathered params stay in flight (1 = double buffer:
     one computing, one gathering). HBM cost: ``depth`` extra gathered layers
-    resident."""
+    resident.
+
+    With the engine-published qwZ ``quantize`` descriptors
+    (:func:`configure_layer_prefetch`), flagged leaves cross the gather as
+    int8 + per-row fp32 scales — the prefetched layers ride the wire
+    quantized (ZeRO++ qwZ at the ZeRO-3 use-site gather)."""
     if depth is None:
         depth = layer_prefetch_depth()
     if shardings is None:
         shardings = _LAYER_PREFETCH["shardings"]
+    quantize = _LAYER_PREFETCH["quantize"]
     leaves = jax.tree.leaves(layers)
     if not leaves:
         return lax.scan(body, init, layers)
     n_layers = int(leaves[0].shape[0])
     depth = max(1, min(int(depth), n_layers))
+    _record_prefetch_gathers(layers, n_layers, quantize)
 
     def gather(i):
         sliced = jax.tree.map(
             lambda a: lax.dynamic_index_in_dim(a, i, 0, keepdims=False),
             layers)
-        return _constrain_layer(sliced, shardings)
+        return _constrain_layer(sliced, shardings, quantize)
 
     if depth == 1:
         first = gather(0)

@@ -217,10 +217,18 @@ class CommsOverlapConfig(ConfigModel):
     # accumulate micro-batch grads locally and reduce ONCE per optimizer
     # step (gas x less DP comm volume; costs a full-size fp32 accumulator)
     deferred_gradient_reduce: bool = True
-    # LoCo error feedback for the qgZ int8 reduce-scatter (reference
-    # all_to_all_loco_quant_reduce; needs zero_quantized_gradients)
+    # LoCo error feedback for the int8-quantized reduction paths (reference
+    # all_to_all_loco_quant_reduce; needs zero_quantized_gradients or
+    # quantized_all_reduce — without a quantizer there is no error to feed)
     loco: bool = False
     loco_err_beta: float = 0.8
+    # EQuARX-style quantized all-reduce (comm/compressed.py
+    # quantized_all_reduce): the non-ZeRO DP gradient path — leaves whose
+    # grad layout stays replicated (stage 0/1, or indivisible dims) reduce
+    # via int8 quantized reduce-scatter + int8 quantized all-gather instead
+    # of a full-width psum (~4x less wire per half). Composes with loco
+    # error feedback; bucketed small leaves keep their exact fp32 buckets.
+    quantized_all_reduce: bool = False
     # ZeRO-3 per-layer all-gather prefetch (comm/overlap.py prefetch_scan):
     # the stacked-layer scan gathers layer i+1's param shards while layer
     # i's matmuls run instead of gathering at first use. prefetch_depth =

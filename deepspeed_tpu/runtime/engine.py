@@ -209,6 +209,17 @@ class DeepSpeedTPUEngine:
                 "zero_quantized_gradients (qgZ) requires ZeRO stage 2 — the "
                 "quantized reduce-scatter produces grads in the stage-2 "
                 "sharded layout (stage 3 param gathering is a separate path)")
+        if config.zero_config.zero_quantized_weights and \
+                config.zero_config.stage >= 3 and \
+                int(config.zero_config.zero_hpz_partition_size) <= 1 and \
+                not (config.comms_overlap.enabled
+                     and config.comms_overlap.layer_prefetch):
+            logger.warning(
+                "zero_quantized_weights at ZeRO-3 has no quantization "
+                "boundary without comms_overlap.layer_prefetch (per-layer "
+                "quantized gathers) or zero_hpz_partition_size > 1 "
+                "(quantized primary gather) — params gather at use in full "
+                "precision")
 
         # --- comms_overlap: gradient-comm overlap engine (comm/overlap.py) ---
         co = config.comms_overlap
@@ -230,11 +241,12 @@ class DeepSpeedTPUEngine:
                 log_dist("comms_overlap: pipeline axis active — the overlap "
                          "engine is disabled (1F1B owns its own reduction); "
                          "XLA flags still apply")
-            if co.loco and not config.zero_config.zero_quantized_gradients:
+            if co.loco and not (config.zero_config.zero_quantized_gradients
+                                or co.quantized_all_reduce):
                 logger.warning(
                     "comms_overlap.loco has no effect without "
-                    "zero_quantized_gradients (qgZ) — there is no quantizer "
-                    "to error-compensate")
+                    "zero_quantized_gradients (qgZ) or quantized_all_reduce "
+                    "— there is no quantizer to error-compensate")
             from ..comm.overlap import apply_xla_overlap_flags
 
             self.comms_overlap_flags = apply_xla_overlap_flags(co)
@@ -242,13 +254,35 @@ class DeepSpeedTPUEngine:
         from ..comm.mesh import ZERO_AXES as _ZERO_AXES
 
         zero_axes = _ZERO_AXES
+        secondary_axes = None
         if mesh_mgr.mics_shard_size > 1:
-            # MiCS: shard within the 'zero_shard' group, replicate across
-            # 'data' groups (reference runtime/zero/mics.py:63)
-            zero_axes = tuple(a for a in _ZERO_AXES if a != "data")
+            hpz = int(config.zero_config.zero_hpz_partition_size) > 1 and \
+                int(config.zero_config.mics_shard_size) <= 1
+            if hpz and config.zero_config.stage >= 3:
+                # ZeRO++ hpZ: PRIMARY partition (masters / opt state / grad
+                # reduce-scatter) over the full ZeRO axes — no memory is
+                # given back — plus a SECONDARY parameter partition inside
+                # the 'zero_shard' (ICI island) sub-axis, so every fwd/bwd
+                # all-gather resolves intra-island and only the once-per-
+                # step primary gather crosses 'data' (the DCN tier).
+                secondary_axes = tuple(a for a in _ZERO_AXES if a != "data")
+                log_dist(
+                    "ZeRO++ hpZ: secondary param partition over "
+                    f"{secondary_axes} (size {mesh_mgr.mics_shard_size}); "
+                    "primary partition keeps the full ZeRO axes")
+            else:
+                # MiCS: shard within the 'zero_shard' group, replicate
+                # across 'data' groups (reference runtime/zero/mics.py:63).
+                # hpZ below stage 3 also lands here: without gather-on-use
+                # params there is no secondary gather to keep intra-island.
+                zero_axes = tuple(a for a in _ZERO_AXES if a != "data")
+                if hpz:
+                    log_dist("zero_hpz_partition_size below ZeRO stage 3: "
+                             "falling back to MiCS semantics (shard within "
+                             "the group, replicate across 'data')")
         self.partitioner = Partitioner(
             mesh_mgr, zero_stage=config.zero_config.stage,
-            zero_axes=zero_axes,
+            zero_axes=zero_axes, secondary_axes=secondary_axes,
             tensor_parallel=mesh_mgr.tp_world_size > 1,
             pipeline_layers=model.pipeline_capable)
         shapes = shapes_of(params)
@@ -278,14 +312,11 @@ class DeepSpeedTPUEngine:
         self.param_specs = param_specs
         self.grad_specs = grad_specs
         self.opt_param_specs = opt_specs
-        # qwZ gather target: the TP-only layout params take after the ZeRO
-        # all-gather (at stage 3 param_specs stay sharded — gather-on-use —
-        # so the int8 copy must be constrained to THIS layout to put the
-        # quantized bytes on the wire)
+        # gathered (TP-only) layout — the target of the ZeRO all-gather:
+        # feeds the layer-prefetch shardings AND the qwZ per-layer quantize
+        # descriptors (_layer_prefetch_quant)
         self._qw_gather_specs = self.partitioner.gathered_param_specs(
             axes, shapes)
-        self._qw_gather_shardings = self.partitioner.shardings(
-            self._qw_gather_specs)
         self._param_shardings = self.partitioner.shardings(param_specs)
         self._grad_shardings = self.partitioner.shardings(grad_specs)
         self._master_shardings = self.partitioner.shardings(opt_specs)
@@ -336,7 +367,8 @@ class DeepSpeedTPUEngine:
 
         if self._overlap_active():
             self._overlap_setup()  # static routing, cached for engine life
-            if co.loco and config.zero_config.zero_quantized_gradients:
+            if co.loco and (config.zero_config.zero_quantized_gradients
+                            or co.quantized_all_reduce):
                 self._init_loco_residuals()
 
         # --- comms_overlap.layer_prefetch: ZeRO-3 per-layer all-gather
@@ -353,14 +385,28 @@ class DeepSpeedTPUEngine:
             log_dist("comms_overlap.layer_prefetch has no effect here: it "
                      "needs ZeRO stage 3 (gather-on-use params) and no "
                      "pipeline axis — plain scan retained")
+        # the per-layer gathers resolve over the axes the compute-param
+        # layout is sharded on: the hpZ secondary (ICI) axes when set, the
+        # full ZeRO axes otherwise — feeds the prefetch telemetry link class
+        _gaxes = tuple(
+            a for a in (self.partitioner.secondary_axes
+                        if self.partitioner.secondary_axes is not None
+                        else self.partitioner.zero_axes)
+            if mesh_mgr.axis_size(a) > 1)
         configure_layer_prefetch(
             self._layer_prefetch_on,
             depth=max(1, int(co.prefetch_depth)),
             shardings=(self._layer_prefetch_shardings()
-                       if self._layer_prefetch_on else None))
+                       if self._layer_prefetch_on else None),
+            quantize=(self._layer_prefetch_quant()
+                      if self._layer_prefetch_on else None),
+            gather_axes=_gaxes if self._layer_prefetch_on else ())
         if self._layer_prefetch_on:
             log_dist(f"comms_overlap: per-layer all-gather prefetch armed "
-                     f"(depth={max(1, int(co.prefetch_depth))})")
+                     f"(depth={max(1, int(co.prefetch_depth))}"
+                     + (", qwZ int8 gathers"
+                        if config.zero_config.zero_quantized_weights
+                        else "") + ")")
 
         # --- compiled steps ---
         self._train_step = None
@@ -779,80 +825,99 @@ class DeepSpeedTPUEngine:
         wants the TP-only layout — the constraint makes XLA all-gather the
         low-precision copy (the reference's post-step allgather of updated
         partitions, stage_1_and_2.py:2223, moved to gather-on-compute-cast).
-        At stage 3 the constraint keeps params sharded; XLA gathers at use.
+        At stage 3 the constraint keeps params sharded; XLA gathers at use —
+        except under hpZ (``zero_hpz_partition_size``), where the constraint
+        is the once-per-step PRIMARY gather from the full master partition
+        into the intra-island secondary partition (the only collective that
+        crosses the 'data'/DCN tier; fwd/bwd gathers then resolve over the
+        secondary axes only).
 
         ZeRO++ qwZ (``zero_quantized_weights``, reference
         ``runtime/zero/config.py:309`` + ``csrc/quantization/
-        swizzled_quantize.cu``): the tensor that crosses the gather boundary
-        is int8 with per-row fp32 scales — matrix leaves are quantized in
-        the sharded layout, the sharding constraint moves the int8 copy
-        (halving all-gather bytes vs bf16), and dequantization happens in
-        the gathered layout where XLA fuses it into the consumer."""
+        swizzled_quantize.cu``): wherever the master layout differs from the
+        compute-param layout — a real gather boundary — the tensor that
+        crosses it is int8 with per-row fp32 scales
+        (``compressed.quantized_gather``), quartering the fp32 wire bytes.
+        At stage 3 the per-layer use-site gathers quantize through
+        ``overlap.prefetch_scan`` instead (the explicit gather seam)."""
         compute = self.precision.cast_to_compute(params)
+        zc = self.config.zero_config
+        mm = self.mesh_mgr
+        part = self.partitioner
+        secondary = tuple(getattr(part, "secondary_axes", None) or ())
+        qwz = bool(zc.zero_quantized_weights and mm.zero_world_size > 1)
+        is_p = lambda x: isinstance(x, P)  # noqa: E731
+        pspec_leaves = jax.tree.leaves(self.param_specs, is_leaf=is_p)
+        mspec_leaves = jax.tree.leaves(self.opt_param_specs, is_leaf=is_p)
+
+        def quantizes(leaf, pspec, mspec):
+            # quantize only where a gather boundary actually exists (the
+            # master/opt layout differs from the compute-param layout) — at
+            # stage 0, or for leaves ZeRO left unsharded (indivisible dims),
+            # the int8 roundtrip would cost precision and save zero wire
+            # bytes. Plain stage 3 has no boundary HERE (params stay sharded,
+            # gather-at-use); hpZ's primary gather is one.
+            return (qwz and isinstance(leaf, jnp.ndarray)
+                    and jnp.issubdtype(leaf.dtype, jnp.floating)
+                    and leaf.ndim >= 2 and mspec != pspec)
+
         # comms-logger: the constraint below makes XLA all-gather the
         # ZeRO-sharded low-precision params — record that implied collective
-        # at trace time (its bytes are what actually crosses the wire)
+        # at trace time. The gather crosses the primary axes NOT covered by
+        # the secondary partition ('data' only, under hpZ); qwZ leaves ride
+        # the wire as int8 + per-row fp32 scales, recorded as such so
+        # algo_bytes reflects the actual quantized wire volume.
         tel = dist.get_telemetry()
-        if tel.enabled and self.config.zero_config.stage >= 1 and \
-                self.mesh_mgr.zero_world_size > 1:
-            axes = tuple(a for a in self.partitioner.zero_axes
-                         if self.mesh_mgr.axis_size(a) > 1)
-            if axes:
-                tel.record("all_gather_params", axes, compute)
-        zc = self.config.zero_config
-        if not (zc.zero_quantized_weights and
-                self.mesh_mgr.zero_world_size > 1):
+        if tel.enabled and zc.stage >= 1 and mm.zero_world_size > 1:
+            gather_axes = tuple(a for a in part.zero_axes
+                                if mm.axis_size(a) > 1
+                                and a not in secondary)
+            q_leaves, plain = [], []
+            for leaf, ps, ms in zip(jax.tree.leaves(compute), pspec_leaves,
+                                    mspec_leaves):
+                (q_leaves if quantizes(leaf, ps, ms) else plain).append(leaf)
+            if gather_axes:
+                if plain:
+                    tel.record("all_gather_params", gather_axes, plain)
+                if q_leaves:
+                    payload = [
+                        (jax.ShapeDtypeStruct(l.shape, jnp.int8),
+                         jax.ShapeDtypeStruct(l.shape[:-1] + (1,),
+                                              jnp.float32))
+                        for l in q_leaves]
+                    tel.record("all_gather_params_q", gather_axes, payload,
+                               fp32_equiv=sum(l.size for l in q_leaves) * 4)
+            if secondary and zc.stage >= 3 and \
+                    not getattr(self, "_layer_prefetch_on", False):
+                # hpZ: the at-use fwd/bwd gathers resolve inside the
+                # secondary (ICI) island — trace-time estimate of their
+                # volume (with layer_prefetch on, prefetch_scan records the
+                # explicit per-layer gathers instead)
+                tel.record("all_gather_params_secondary", secondary, compute)
+
+        if not qwz:
             return jax.lax.with_sharding_constraint(
                 compute, self._param_shardings)
 
-        def one(leaf, sharding, spec, param_sharding, master_spec):
-            # quantize only where a gather boundary actually exists (the
-            # master/opt layout differs from the gathered layout) — at stage
-            # 0, or for leaves ZeRO left unsharded (indivisible dims), the
-            # int8 roundtrip would cost precision and save zero wire bytes
-            if not (isinstance(leaf, jnp.ndarray)
-                    and jnp.issubdtype(leaf.dtype, jnp.floating)
-                    and leaf.ndim >= 2
-                    and master_spec != spec):
+        from ..comm.compressed import quantized_gather
+
+        def one(leaf, param_sharding, pspec, mspec):
+            if not quantizes(leaf, pspec, mspec):
                 return jax.lax.with_sharding_constraint(leaf, param_sharding)
-            sspec = list(spec)[:leaf.ndim]
+            sspec = list(pspec)[:leaf.ndim]
             sspec += [None] * (leaf.ndim - len(sspec))
             if sspec:
                 sspec[-1] = None  # scales' trailing dim is size 1
-            scale_sharding = self.mesh_mgr.sharding(*sspec)
+            scale_sharding = mm.sharding(*sspec)
+            return quantized_gather(leaf, param_sharding, scale_sharding)
 
-            def impl(x):
-                amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1,
-                               keepdims=True)
-                scale = jnp.where(amax > 0, amax / 127.0, 1.0)
-                q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale),
-                             -127, 127).astype(jnp.int8)
-                # the barrier pins the f32→s8 convert BEFORE the gather —
-                # without it XLA commutes the convert past the all-gather
-                # and the wire carries f32 again
-                q = jax.lax.optimization_barrier(q)
-                q = jax.lax.with_sharding_constraint(q, sharding)
-                scale = jax.lax.with_sharding_constraint(scale,
-                                                         scale_sharding)
-                return (q.astype(jnp.float32) * scale).astype(x.dtype)
-
-            # straight-through estimator: round() has zero derivative, so the
-            # cotangent passes through unchanged to the sharded master leaf
-            # (SPMD lowers the layout change; the reference's backward also
-            # treats the quantized gather as identity)
-            qw = jax.custom_vjp(impl)
-            qw.defvjp(lambda x: (impl(x), None),
-                      lambda _, g: (g.astype(leaf.dtype),))
-            return qw(leaf)
-
-        # tree.map follows `compute`'s structure, so the P leaves of
-        # param_specs are taken whole (not flattened as tuples). Matrix
-        # leaves with a real gather boundary land in the GATHERED (TP-only)
-        # layout via the int8 wire; everything else keeps the normal param
-        # layout (stage-3 gather-on-use included).
-        return jax.tree.map(one, compute, self._qw_gather_shardings,
-                            self._qw_gather_specs, self._param_shardings,
-                            self.opt_param_specs)
+        # tree.map follows `compute`'s structure, so the P leaves of the
+        # spec trees are taken whole (not flattened as tuples). Matrix
+        # leaves with a real gather boundary land in the compute-param
+        # layout via the int8 wire; everything else keeps the normal
+        # constraint (plain stage-3 gather-on-use included).
+        return jax.tree.map(one, compute, self._param_shardings,
+                            self.param_specs, self.opt_param_specs)
 
     def _loss(self, params, batch):
         compute_params = self._cast_gather(params)
@@ -1050,12 +1115,17 @@ class DeepSpeedTPUEngine:
                                       n_total, bucket_bytes)
             bucketed = frozenset(i for b in buckets for i in b)
         loco_idx: Tuple[int, ...] = ()
-        if co.loco and self.config.zero_config.zero_quantized_gradients:
+        if co.loco:
             # error feedback exists where quantization does: the int8
-            # scatter-planned leaves (bucketed small leaves reduce in exact
-            # fp32 and need no compensation)
-            loco_idx = tuple(i for i, p in enumerate(plans)
-                             if p.dim is not None and i not in bucketed)
+            # scatter-planned leaves under qgZ, and the psum-planned leaves
+            # under the EQuARX-style quantized all-reduce (bucketed small
+            # leaves reduce in exact fp32 and need no compensation)
+            qgz_ = self.config.zero_config.zero_quantized_gradients
+            loco_idx = tuple(
+                i for i, p in enumerate(plans) if i not in bucketed
+                and ((p.dim is not None and qgz_)
+                     or (p.dim is None and p.psum_axes
+                         and co.quantized_all_reduce)))
         self._overlap_plan_cache = (manual, n_total, plans, buckets,
                                     bucketed, loco_idx)
         return self._overlap_plan_cache
@@ -1078,6 +1148,44 @@ class DeepSpeedTPUEngine:
             return NamedSharding(mesh, P(*list(spec)[1:]))
 
         return jax.tree.map(drop_stacked, sub, is_leaf=is_p)
+
+    def _layer_prefetch_quant(self):
+        """ZeRO++ qwZ descriptors for the prefetch gathers: a pair of trees
+        matching the model's ``layers`` subtree — per-leaf bool (quantize
+        this leaf's gather) and the per-leaf SCALE sharding in the gathered
+        layout. ``overlap.prefetch_scan`` routes flagged leaves through
+        ``compressed.quantized_gather`` so each per-layer all-gather moves
+        int8 + per-row fp32 scales instead of full-width bytes. None when
+        qwZ is off or the param tree has no ``layers`` dict."""
+        if not self.config.zero_config.zero_quantized_weights:
+            return None
+        params = self.state.params
+        if not (isinstance(params, dict) and "layers" in params):
+            return None
+        is_p = lambda x: isinstance(x, P)  # noqa: E731
+        gathered = self._qw_gather_specs["layers"]
+        sharded = self.param_specs["layers"]
+        mesh = self.mesh_mgr.mesh
+
+        def flag(leaf, gspec, pspec):
+            # a sliced layer leaf drops the stacked dim; quantize where the
+            # stacked (ZeRO-sharded) layout differs from the gathered one —
+            # a real per-layer gather boundary — on float matrix leaves
+            return bool(jnp.issubdtype(leaf.dtype, jnp.floating)
+                        and leaf.ndim - 1 >= 2
+                        and P(*list(gspec)[1:]) != P(*list(pspec)[1:]))
+
+        def scale_shard(leaf, gspec):
+            nd = leaf.ndim - 1  # stacked dim dropped
+            ents = list(gspec)[1:][:nd]
+            ents += [None] * (nd - len(ents))
+            if ents:
+                ents[-1] = None  # scales' trailing dim is size 1
+            return NamedSharding(mesh, P(*ents))
+
+        flags = jax.tree.map(flag, params["layers"], gathered, sharded)
+        scales = jax.tree.map(scale_shard, params["layers"], gathered)
+        return flags, scales
 
     def _init_loco_residuals(self) -> None:
         """Allocate the per-leaf LoCo quantization-error residuals into
@@ -1122,6 +1230,7 @@ class DeepSpeedTPUEngine:
         manual, n_total, plans, buckets, bucketed, loco_idx = \
             self._overlap_setup()
         qgz = self.config.zero_config.zero_quantized_gradients
+        qar = co.quantized_all_reduce
         deferred = co.deferred_gradient_reduce and gas > 1
         err_beta = float(co.loco_err_beta)
         # collectives in a non-deferred scan body run once per micro
@@ -1189,9 +1298,23 @@ class DeepSpeedTPUEngine:
                         g = ov.reduce_scatter_dim(g, plan.dim, plan.scatter,
                                                   repeats=reps)
                 if plan.psum_axes:
-                    dist.get_telemetry().record(
-                        "all_reduce_grads", plan.psum_axes, g, repeats=reps)
-                    g = jax.lax.psum(g, plan.psum_axes)
+                    if qar and plan.dim is None:
+                        # EQuARX-style quantized all-reduce: the non-ZeRO DP
+                        # path (replicated grad layout) — int8 RS + int8 AG
+                        # instead of a full-width psum
+                        if i in res_pos:
+                            g, nr = cc.quantized_all_reduce_ef(
+                                g, plan.psum_axes, new_res[res_pos[i]],
+                                err_beta=err_beta, repeats=reps)
+                            new_res[res_pos[i]] = nr
+                        else:
+                            g = cc.quantized_all_reduce(g, plan.psum_axes,
+                                                        repeats=reps)
+                    else:
+                        dist.get_telemetry().record(
+                            "all_reduce_grads", plan.psum_axes, g,
+                            repeats=reps)
+                        g = jax.lax.psum(g, plan.psum_axes)
                 red[i] = g
             return red, new_res
 
@@ -1802,6 +1925,14 @@ def initialize(args=None, model: Optional[ModelSpec] = None, optimizer=None,
         axis_sizes["data"] = data // mics
     if mesh_mgr is None:
         mesh_mgr = init_mesh(axis_sizes)
+        if mics > 1 and int(axis_sizes.get("data", 1)) > 1 \
+                and not mesh_mgr.dcn_axes:
+            # the zero_shard carve models a 2-level topology: 'zero_shard'
+            # is the intra-island (ICI) tier, 'data' the cross-island tier —
+            # tag it so CommsTelemetry's link-class split can prove which
+            # collectives stay inside the island (real multi-slice meshes
+            # auto-detect this in MeshManager.create)
+            mesh_mgr.set_dcn_axes(("data",))
     dp = int(axis_sizes.get("data", 1)) * int(axis_sizes.get("zero_shard", 1)) \
         * int(axis_sizes.get("expert", 1))
     cfg = parse_config(config, world_size=n_devices, dp_world_size=dp)

@@ -98,11 +98,22 @@ class Partitioner:
                  rules: Optional[Dict[str, Any]] = None,
                  zero_axes: Sequence[str] = ZERO_AXES,
                  tensor_parallel: bool = True,
-                 pipeline_layers: bool = True):
+                 pipeline_layers: bool = True,
+                 secondary_axes: Optional[Sequence[str]] = None):
         self.mm = mesh_mgr
         self.zero_stage = zero_stage
         self.zero_axes = tuple(a for a in zero_axes if mesh_mgr.axis_size(a) > 1)
         self.axis_sizes = {a: mesh_mgr.axis_size(a) for a in self.zero_axes}
+        # ZeRO++ hpZ (zero_hpz_partition_size, arXiv:2306.10209): a SECONDARY
+        # parameter partition over the intra-island axes only. Masters/opt
+        # state/grads keep the full (primary) ZeRO sharding; the stage-3
+        # compute-param layout shards over these axes instead, so fwd/bwd
+        # gathers resolve inside the island and only the once-per-step
+        # primary gather (master -> secondary) crosses the 'data' tier.
+        self.secondary_axes: Optional[Tuple[str, ...]] = None
+        if secondary_axes is not None:
+            self.secondary_axes = tuple(
+                a for a in secondary_axes if mesh_mgr.axis_size(a) > 1)
         self.zero_size = int(np.prod([mesh_mgr.axis_size(a) for a in self.zero_axes])) \
             if self.zero_axes else 1
         self.rules = dict(DEFAULT_RULES)
@@ -118,19 +129,31 @@ class Partitioner:
                     self.rules[k] = None
 
     # --- spec derivation ---
-    def _base_specs(self, logical_axes, shapes, shard_extra: bool):
+    def _base_specs(self, logical_axes, shapes, shard_extra: bool,
+                    zero_axes: Optional[Tuple[str, ...]] = None):
+        axes_set = self.zero_axes if zero_axes is None else zero_axes
+        sizes = (self.axis_sizes if zero_axes is None
+                 else {a: self.mm.axis_size(a) for a in axes_set})
+
         def one(axes, shape):
             spec = logical_to_spec(tuple(axes), self.rules)
             if shard_extra:
                 spec = _add_zero_axes(spec, tuple(axes), tuple(shape),
-                                      self.axis_sizes, self.zero_axes)
+                                      sizes, axes_set)
             return spec
 
         return jax.tree.map(one, logical_axes, shapes,
                             is_leaf=lambda x: isinstance(x, tuple))
 
     def param_specs(self, logical_axes, shapes):
-        """Parameter shardings: TP always; + ZeRO axes at stage 3."""
+        """Parameter shardings: TP always; + ZeRO axes at stage 3. With an
+        hpZ ``secondary_axes`` set, the stage-3 compute layout shards over
+        the secondary (intra-island) axes only — masters keep the full
+        primary sharding (``opt_state_specs``)."""
+        if self.zero_stage >= 3 and self.secondary_axes is not None:
+            return self._base_specs(logical_axes, shapes,
+                                    shard_extra=bool(self.secondary_axes),
+                                    zero_axes=self.secondary_axes)
         return self._base_specs(logical_axes, shapes, shard_extra=self.zero_stage >= 3)
 
     def gathered_param_specs(self, logical_axes, shapes):

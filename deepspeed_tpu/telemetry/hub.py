@@ -234,6 +234,12 @@ class TelemetryHub:
         if total <= 0:
             return []
         events: List[Event] = [("Comm/total/algo_bytes", total, step)]
+        # per-link-class split (quantized/hierarchical collectives story):
+        # DCN-tagged bytes are the scale-out wall hpZ/qwZ/qgZ attack
+        events.append(("Comm/total/algo_bytes_dcn",
+                       self.comms.total_algo_bytes("dcn"), step))
+        events.append(("Comm/total/algo_bytes_ici",
+                       self.comms.total_algo_bytes("ici"), step))
         if step_time_s:
             events.append(("Comm/total/busbw_gbps",
                            total / step_time_s / 1e9, step))

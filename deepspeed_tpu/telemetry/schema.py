@@ -23,6 +23,11 @@ Checked invariants:
   ``TRAIN_SERIES`` registry (layer-prefetch gauges and per-remat-policy
   sweep rows); other ``Train/*`` families (``Train/Step``,
   ``Train/Samples``) stay open.
+- ``Comm/*`` names are closed per METRIC: op names are open-ended (any
+  collective the comms logger observes), but the final metric segment must
+  come from ``COMM_METRICS`` and the ``Comm/total/*`` rollup family from
+  ``COMM_TOTAL_SERIES`` — a typo'd byte-accounting suffix (which the
+  ``--comm-efficiency`` report would silently drop) fails validation.
 """
 
 from __future__ import annotations
@@ -32,6 +37,7 @@ import re
 from typing import Any, Dict, Iterable, List, Tuple
 
 __all__ = ["EVENT_NAME_RE", "SERVING_SERIES", "TRAIN_SERIES",
+           "COMM_METRICS", "COMM_TOTAL_SERIES",
            "REMAT_POLICIES", "validate_events", "validate_jsonl_records"]
 
 EVENT_NAME_RE = re.compile(r"^[A-Z][A-Za-z0-9_]*(/[A-Za-z0-9_.\-]+)+$")
@@ -84,6 +90,21 @@ TRAIN_SERIES = frozenset(
        for m in ("saved_bytes", "peak_bytes", "step_ms")])
 
 
+# Registered Comm/* byte-accounting metrics (comm.CommsTelemetry.events):
+# per-op series are Comm/<op>/<metric> with an OPEN op namespace but a
+# CLOSED metric set — the link-class split (algo_bytes_dcn / algo_bytes_ici)
+# and the quantized-collective fp32-equivalent accounting added for the
+# ZeRO++ trio live here. The Comm/total/* rollup family (TelemetryHub
+# _comm_efficiency_events) is fully enumerated.
+COMM_METRICS = frozenset((
+    "bytes", "count", "algo_bytes", "algo_bytes_dcn", "algo_bytes_ici",
+    "fp32_equiv_bytes"))
+COMM_TOTAL_SERIES = frozenset(
+    "Comm/total/" + m for m in (
+        "algo_bytes", "algo_bytes_dcn", "algo_bytes_ici", "busbw_gbps",
+        "est_comm_frac"))
+
+
 def validate_events(events: Iterable[Tuple[str, float, int]]) -> List[str]:
     """Check ``(name, value, step)`` triples against the schema; returns a
     list of human-readable problems (empty = clean)."""
@@ -108,6 +129,18 @@ def validate_events(events: Iterable[Tuple[str, float, int]]) -> List[str]:
                 name not in TRAIN_SERIES:
             problems.append(f"event #{i}: train series {name!r} is not "
                             f"registered in telemetry.schema.TRAIN_SERIES")
+            continue
+        if name.startswith("Comm/total/"):
+            if name not in COMM_TOTAL_SERIES:
+                problems.append(
+                    f"event #{i}: comm rollup series {name!r} is not "
+                    f"registered in telemetry.schema.COMM_TOTAL_SERIES")
+                continue
+        elif name.startswith("Comm/") and \
+                name.rsplit("/", 1)[-1] not in COMM_METRICS:
+            problems.append(
+                f"event #{i}: comm metric suffix of {name!r} is not "
+                f"registered in telemetry.schema.COMM_METRICS")
             continue
         try:
             v = float(value)

@@ -106,11 +106,57 @@ def comm_efficiency(events: List[dict]) -> str:
     if frac:
         lines.append(f"  est unoverlapped comm: {frac[-1] * 100:.1f}% "
                      f"of step time (upper bound)")
+    quant = _quantized_comm_section(per_op, events)
+    if quant:
+        lines.append("")
+        lines.extend(quant)
     extra = _overlap_remat_sections(events)
     if extra:
         lines.append("")
         lines.extend(extra)
     return "\n".join(lines)
+
+
+def _quantized_comm_section(per_op: Dict[str, Dict[str, float]],
+                            events: List[dict]) -> List[str]:
+    """Quantized & hierarchical collectives rollup (ZeRO++ qwZ/qgZ/hpZ +
+    EQuARX — docs/performance.md): per-path bytes-on-wire vs the fp32
+    equivalent of the same payload (``Comm/<op>/fp32_equiv_bytes``) with the
+    resulting compression ratio, plus the DCN-vs-ICI byte split from the
+    per-collective link-class tag. Only rendered when at least one path
+    actually compressed (ratio > 1.05) or a DCN split exists."""
+    rows = []
+    for op, kinds in sorted(per_op.items()):
+        wire = kinds.get("bytes", 0.0)
+        equiv = kinds.get("fp32_equiv_bytes", 0.0)
+        if wire > 0 and equiv > wire * 1.05:
+            rows.append((op, wire, equiv, equiv / wire))
+    dcn = [e["value"] for e in events
+           if e["name"] == "Comm/total/algo_bytes_dcn"]
+    ici = [e["value"] for e in events
+           if e["name"] == "Comm/total/algo_bytes_ici"]
+    if not dcn:  # fall back to the per-op link split
+        s = sum(k.get("algo_bytes_dcn", 0.0) for k in per_op.values())
+        dcn = [s] if s else []
+        ici = [sum(k.get("algo_bytes_ici", 0.0) for k in per_op.values())]
+    has_dcn = bool(dcn and dcn[-1] > 0)
+    if not rows and not has_dcn:
+        return []
+    lines = ["quantized & hierarchical collectives"]
+    if rows:
+        lines.append(f"  {'path':<28} {'wire bytes':>14} {'fp32 equiv':>14} "
+                     f"{'ratio':>7}")
+        for op, wire, equiv, ratio in rows:
+            lines.append(f"  {op:<28} {_fmt_bytes(wire):>14} "
+                         f"{_fmt_bytes(equiv):>14} {ratio:>6.2f}x")
+    if dcn:
+        total = (dcn[-1] if dcn else 0.0) + (ici[-1] if ici else 0.0)
+        pct = dcn[-1] / total * 100 if total else 0.0
+        lines.append(f"  DCN algo bytes/step:   {_fmt_bytes(dcn[-1])} "
+                     f"({pct:.1f}% of total)")
+        if ici:
+            lines.append(f"  ICI algo bytes/step:   {_fmt_bytes(ici[-1])}")
+    return lines
 
 
 def _overlap_remat_sections(events: List[dict]) -> List[str]:

@@ -73,8 +73,9 @@ def is_subtorus(group, dims):
 def test_tensor_axis_rides_ici():
     dims = (4, 2, 2)
     devs = v5p_cuboid(*dims)
-    arr = _arrange_devices(devs, sizes_for(data=4, tensor=4))
+    arr, dcn = _arrange_devices(devs, sizes_for(data=4, tensor=4))
     assert arr.shape == tuple(sizes_for(data=4, tensor=4))
+    assert dcn is None  # single slice: every axis rides ICI
     assert {d.id for d in arr.flat} == set(range(16))
     grid = arr.reshape(4, 4)  # collapse the size-1 axes
     for ring in grid:  # each TP group is a compact sub-torus
@@ -92,8 +93,8 @@ def test_naive_reshape_would_stride_the_torus():
     naive = np.asarray(devs).reshape(sizes_for(data=4, tensor=4)).reshape(4, 4)
     assert any(not is_subtorus(ring, dims) for ring in naive), \
         "mock order unexpectedly benign — strengthen the mock"
-    arr = _arrange_devices(devs, sizes_for(data=4, tensor=4)).reshape(4, 4)
-    for ring in arr:
+    arr, _ = _arrange_devices(devs, sizes_for(data=4, tensor=4))
+    for ring in arr.reshape(4, 4):
         assert is_subtorus(ring, dims)
 
 
@@ -103,7 +104,8 @@ def test_multislice_puts_data_on_dcn():
             + v5p_cuboid(2, 2, 1, slice_index=1, id0=4))
     for d in devs:
         d.device_kind = "TPU v5e"
-    arr = _arrange_devices(devs, sizes_for(data=2, tensor=4))
+    arr, dcn = _arrange_devices(devs, sizes_for(data=2, tensor=4))
+    assert dcn == "data"  # feeds MeshManager.dcn_axes / link-class tagging
     assert {d.id for d in arr.flat} == set(range(8))
     grid = arr.reshape(2, 4)
     for row in grid:  # a tensor ring stays inside one slice (ICI)
@@ -122,8 +124,8 @@ def test_multislice_no_divisible_axis_raises():
 
 def test_cpu_mesh_order_unchanged():
     devs = jax.devices()
-    arr = _arrange_devices(devs, sizes_for(data=4, tensor=2))
-    assert list(arr.flat) == list(devs)
+    arr, dcn = _arrange_devices(devs, sizes_for(data=4, tensor=2))
+    assert list(arr.flat) == list(devs) and dcn is None
     mm = MeshManager.create({"data": 4, "tensor": 2})
     assert mm.tp_world_size == 2 and mm.dp_world_size == 4
 
@@ -132,5 +134,5 @@ def test_unknown_topology_falls_back(caplog):
     # holes in the cuboid make mesh_utils raise; we must fall back, not die
     devs = v5p_cuboid(4, 2, 2)[:8] + v5p_cuboid(4, 2, 2)[8:]
     devs[3].coords = (17, 9, 5)  # break the cuboid
-    arr = _arrange_devices(devs, sizes_for(data=4, tensor=4))
+    arr, _ = _arrange_devices(devs, sizes_for(data=4, tensor=4))
     assert {d.id for d in arr.flat} == set(range(16))

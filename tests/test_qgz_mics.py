@@ -95,15 +95,55 @@ def test_mics_shards_within_group_replicates_across(devices8):
 
 def test_mics_loss_matches_full_zero(devices8):
     losses = {}
-    for label, extra in (("full", {}), ("mics", {"mics_shard_size": 4}),
-                         ("hpz", {"zero_hpz_partition_size": 2})):
-        engine = _engine(extra, stage=3)
+    for label, extra, stage in (("full", {}, 3),
+                                ("mics", {"mics_shard_size": 4}, 3),
+                                ("hpz", {"zero_hpz_partition_size": 2}, 3),
+                                ("ref2", {}, 2)):
+        engine = _engine(extra, stage=stage)
         losses[label] = [float(engine.train_batch(_batch(s)).loss)
                          for s in range(6)]
-    np.testing.assert_allclose(losses["mics"], losses["full"], rtol=2e-4,
+    # MiCS and hpZ are pure layout changes: both must track the stage-2
+    # (replicated-param) truth tightly. Plain stage-3 gather-at-use drifts
+    # from that truth on this mesh (the pre-existing side discovery pinned
+    # in tests/test_remat_overlap.py — environment-dependent fp
+    # reassociation under the involuntary stage-3 reshard), so "full" is
+    # only sanity-checked loosely, not used as the oracle.
+    np.testing.assert_allclose(losses["mics"], losses["ref2"], rtol=2e-4,
                                atol=2e-4)
-    np.testing.assert_allclose(losses["hpz"], losses["full"], rtol=2e-4,
+    np.testing.assert_allclose(losses["hpz"], losses["ref2"], rtol=2e-4,
                                atol=2e-4)
+    np.testing.assert_allclose(losses["full"], losses["ref2"], rtol=0.05)
+
+
+def test_hpz_masters_primary_params_secondary(devices8):
+    """hpZ ≠ MiCS: masters/opt state shard over the FULL ZeRO product
+    (1/8 per device) while the compute-param layout keeps only the
+    'zero_shard' secondary partition, so fwd/bwd gathers resolve inside the
+    island (MiCS instead replicates masters across the outer groups)."""
+    from jax.sharding import PartitionSpec as P
+
+    e = _engine({"zero_hpz_partition_size": 4}, stage=3)
+    assert e.mesh_mgr.mics_shard_size == 4
+    # primary partition: masters sharded over data×zero_shard = 8
+    wq = e.state.params["layers"]["wq"]
+    assert wq.addressable_shards[0].data.size == wq.size // 8
+    # secondary partition: compute params shard over 'zero_shard' only
+    def axes_of(spec):
+        out = set()
+        for ent in spec:
+            for a in (ent if isinstance(ent, tuple) else (ent,)):
+                if a:
+                    out.add(a)
+        return out
+
+    p_axes = set().union(*[axes_of(s) for s in jax.tree.leaves(
+        e.param_specs, is_leaf=lambda x: isinstance(x, P))])
+    m_axes = set().union(*[axes_of(s) for s in jax.tree.leaves(
+        e.opt_param_specs, is_leaf=lambda x: isinstance(x, P))])
+    assert "data" not in p_axes and "zero_shard" in p_axes, p_axes
+    assert "data" in m_axes and "zero_shard" in m_axes, m_axes
+    # the carve tags 'data' as the cross-island (DCN) tier
+    assert e.mesh_mgr.dcn_axes == ("data",)
 
 
 def test_qwz_quantized_weight_gather_trains(devices8):

@@ -1,0 +1,390 @@
+"""ZeRO++ full trio + EQuARX: qwZ quantized weight all-gather, hpZ
+hierarchical secondary partition, and the EQuARX-style quantized all-reduce
+(docs/performance.md "Quantized & hierarchical collectives").
+
+Covers: the deduped int8 group quantizer (bit-identical regression pin),
+default-OFF bit-identity for all three paths, convergence proxies against
+fp32 comm on the 8-dev CPU mesh, the >=3.5x all-gather wire-byte reduction
+from CommsTelemetry accounting (not assertion), hpZ's zero-DCN-gather
+property on a 2-level mesh, and the Comm/* schema/report surface."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import deepspeed_tpu as dst
+from deepspeed_tpu.comm import comm as dist
+from deepspeed_tpu.comm import compressed as cc
+from deepspeed_tpu.comm import mesh as mesh_lib
+from deepspeed_tpu.models import llama
+from deepspeed_tpu.telemetry import schema
+
+MCFG = llama.LlamaConfig.tiny(use_pipeline=False)
+
+
+def _engine(extra=None, batch=16, gas=1, comms_logger=False):
+    mesh_lib.set_mesh(None)
+    dist.get_telemetry().reset()
+    config = {
+        "train_batch_size": batch,
+        "gradient_accumulation_steps": gas,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": 2},
+        "steps_per_print": 0,
+    }
+    if comms_logger:
+        config["comms_logger"] = {"enabled": True}
+    for key, val in (extra or {}).items():
+        if isinstance(val, dict) and isinstance(config.get(key), dict):
+            config[key] = {**config[key], **val}
+        else:
+            config[key] = val
+    spec = llama.model_spec(MCFG, compute_dtype=jnp.float32)
+    engine, *_ = dst.initialize(model=spec, config=config)
+    return engine
+
+
+def _batch(step, batch=16):
+    rs = np.random.RandomState(100 + step)
+    return {"tokens": rs.randint(0, 256, (batch, 33)).astype(np.int32)}
+
+
+def _losses(engine, steps, batch=16):
+    return [float(engine.train_batch(_batch(s, batch)).loss)
+            for s in range(steps)]
+
+
+def _fixed_losses(engine, steps, batch=16):
+    """Memorization trajectory (same batch every step) — loss must fall,
+    so 'it trains' assertions are meaningful at tiny step counts."""
+    return [float(engine.train_batch(_batch(0, batch)).loss)
+            for _ in range(steps)]
+
+
+# --------------------------------------------------------------------------- #
+# satellite: ONE shared int8 group quantizer, pinned bit-identical
+# --------------------------------------------------------------------------- #
+def test_group_quantize_dedupe_bit_identical():
+    """quantize_int8_groupwise and _chunk_quantize both route through
+    _group_quantize; their outputs must be BIT-identical to the historical
+    inline formulas (any drift silently changes every qgZ trajectory)."""
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.standard_normal(1000), jnp.float32)
+    gs = 256
+    # historical quantize_int8_groupwise formula, inline
+    flat = jnp.pad(x.reshape(-1), (0, (-x.size) % gs))
+    g = flat.reshape(-1, gs)
+    ref_scale = jnp.maximum(jnp.max(jnp.abs(g), axis=1, keepdims=True),
+                            1e-8) / 127.0
+    ref_q = jnp.clip(jnp.round(g / ref_scale), -127, 127).astype(jnp.int8)
+    q, scale = cc.quantize_int8_groupwise(x, group_size=gs)
+    np.testing.assert_array_equal(np.asarray(q), np.asarray(ref_q))
+    np.testing.assert_array_equal(np.asarray(scale), np.asarray(ref_scale))
+
+    # historical _chunk_quantize formula, inline (axis_size=4)
+    y = jnp.asarray(rs.standard_normal((8, 300)), jnp.float32)
+    chunks = y.reshape(4, -1)
+    cols = chunks.shape[1]
+    chunks = jnp.pad(chunks, ((0, 0), (0, (-cols) % gs)))
+    cg = chunks.reshape(4, -1, gs)
+    ref_scale = jnp.maximum(jnp.max(jnp.abs(cg), axis=2, keepdims=True),
+                            1e-8) / 127.0
+    ref_q = jnp.clip(jnp.round(cg / ref_scale), -127, 127).astype(jnp.int8)
+    q, scale, got_cols = cc._chunk_quantize(y, 4, gs)
+    assert got_cols == cols
+    np.testing.assert_array_equal(np.asarray(q), np.asarray(ref_q))
+    np.testing.assert_array_equal(np.asarray(scale), np.asarray(ref_scale))
+
+
+def test_rowwise_quantizer_matches_engine_inline():
+    """The shared qwZ row-wise quantizer reproduces the engine's historical
+    inline formula (per-row amax/127, all-zero rows -> scale 1)."""
+    rs = np.random.RandomState(1)
+    x = jnp.asarray(rs.standard_normal((16, 64)), jnp.float32)
+    x = x.at[3].set(0.0)  # an all-zero row must survive exactly
+    amax = jnp.max(jnp.abs(x), axis=-1, keepdims=True)
+    ref_scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    ref_q = jnp.clip(jnp.round(x / ref_scale), -127, 127).astype(jnp.int8)
+    q, scale = cc.rowwise_quantize_int8(x)
+    np.testing.assert_array_equal(np.asarray(q), np.asarray(ref_q))
+    np.testing.assert_array_equal(np.asarray(scale), np.asarray(ref_scale))
+    assert not np.any(np.asarray(q)[3])
+
+
+# --------------------------------------------------------------------------- #
+# EQuARX-style quantized all-reduce: primitive numerics
+# --------------------------------------------------------------------------- #
+def test_quantized_all_reduce_close_to_psum(devices8):
+    mm = mesh_lib.init_mesh({"data": 8})
+    rs = np.random.RandomState(2)
+    x = jnp.asarray(rs.standard_normal((8, 33, 17)), jnp.float32)
+
+    def exact(v):
+        return jax.lax.psum(v, "data")
+
+    def quant(v):
+        return cc.quantized_all_reduce(v, ("data",))
+
+    run = lambda f: jax.jit(dist.shard_map(  # noqa: E731
+        f, mesh=mm.mesh, in_specs=P("data"), out_specs=P("data"),
+        axis_names={"data"}, check_vma=False))
+    ref = np.asarray(run(exact)(x))
+    got = np.asarray(run(quant)(x))
+    denom = np.abs(ref).max()
+    assert np.abs(got - ref).max() / denom < 0.02, np.abs(got - ref).max()
+
+
+def test_quantized_all_reduce_ef_returns_residual(devices8):
+    """EF variant: residual keeps x's shape; feeding the residual back keeps
+    the running mean error bounded (no accumulation blow-up)."""
+    mm = mesh_lib.init_mesh({"data": 8})
+    rs = np.random.RandomState(3)
+    x = jnp.asarray(rs.standard_normal((8, 257)), jnp.float32)
+
+    def step(v, r):
+        out, nr = cc.quantized_all_reduce_ef(v, ("data",), r)
+        return out, nr
+
+    run = jax.jit(dist.shard_map(step, mesh=mm.mesh,
+                                  in_specs=(P("data"), P("data")),
+                                  out_specs=(P("data"), P("data")),
+                                  axis_names={"data"}, check_vma=False))
+    exact = np.asarray(jax.jit(dist.shard_map(
+        lambda v: jax.lax.psum(v, "data"), mesh=mm.mesh,
+        in_specs=P("data"), out_specs=P("data"),
+        axis_names={"data"}, check_vma=False))(x))
+    r = jnp.zeros_like(x)
+    errs = []
+    for _ in range(6):  # same input each round: EF must not let bias grow
+        out, r = run(x, r)
+        assert r.shape == x.shape
+        errs.append(np.abs(np.asarray(out) - exact).max())
+    assert max(errs) < 0.05 * np.abs(exact).max(), errs
+
+
+# --------------------------------------------------------------------------- #
+# engine integration: default OFF bit-identity + convergence proxies
+# --------------------------------------------------------------------------- #
+def test_trio_default_off_bit_identical(devices8):
+    """The three new paths explicitly OFF must reproduce the default
+    config's trajectory EXACTLY (same compiled program)."""
+    base = _losses(_engine(), 3)
+    off = _losses(_engine({
+        "zero_optimization": {"stage": 2, "zero_quantized_weights": False,
+                              "zero_hpz_partition_size": 1},
+        "comms_overlap": {"enabled": False,
+                          "quantized_all_reduce": False}}), 3)
+    assert base == off, (base, off)
+
+
+def test_qar_trains_close_to_fp32(devices8):
+    """Quantized all-reduce (stage 0, unbucketed so the matrix leaves take
+    the int8 path): trajectory tracks the fp32 overlap baseline; LoCo
+    error feedback composes."""
+    co = {"enabled": True, "coalesce_buckets": False}
+    base = _fixed_losses(_engine({"zero_optimization": {"stage": 0},
+                                  "comms_overlap": co}), 6)
+    qar = _fixed_losses(
+        _engine({"zero_optimization": {"stage": 0},
+                 "comms_overlap": {**co, "quantized_all_reduce": True}}), 6)
+    e_loco = _engine({"zero_optimization": {"stage": 0},
+                      "comms_overlap": {**co, "quantized_all_reduce": True,
+                                        "loco": True}})
+    assert len(e_loco.state.loco_residual) > 0  # residuals armed
+    loco = _fixed_losses(e_loco, 6)
+    assert qar[-1] < qar[0], qar  # it trains (memorization)
+    np.testing.assert_allclose(qar, base, atol=0.02, rtol=0.002)
+    np.testing.assert_allclose(loco, base, atol=0.02, rtol=0.002)
+
+
+def test_qar_wire_is_quantized(devices8):
+    """Both halves of the quantized all-reduce move int8: the a2a reduce and
+    the gather record compressed payloads with >3x fp32-equivalent ratio."""
+    e = _engine({"zero_optimization": {"stage": 0},
+                 "comms_overlap": {"enabled": True,
+                                   "coalesce_buckets": False,
+                                   "quantized_all_reduce": True}},
+                comms_logger=True)
+    _losses(e, 1)
+    summ = dist.get_telemetry().summary()
+    dist.configure(enabled=False)
+    assert "all_to_all_quant_reduce" in summ and "all_gather_quant" in summ
+    for op in ("all_to_all_quant_reduce", "all_gather_quant"):
+        s = summ[op]
+        assert s["fp32_equiv_bytes"] / s["bytes"] > 3.0, (op, s)
+
+
+def test_qwz_stage2_wire_reduction_and_parity(devices8):
+    """qwZ at the stage-2 cast-gather: >=3.5x all-gather wire-byte reduction
+    vs the fp32 equivalent (CommsTelemetry accounting), trajectory within
+    int8 weight-noise tolerance of the fp32 gather."""
+    base = _fixed_losses(_engine(), 6)
+    e = _engine({"zero_optimization": {"stage": 2,
+                                       "zero_quantized_weights": True}},
+                comms_logger=True)
+    qwz = _fixed_losses(e, 6)
+    summ = dist.get_telemetry().summary()
+    dist.configure(enabled=False)
+    s = summ["all_gather_params_q"]
+    assert s["fp32_equiv_bytes"] / s["bytes"] >= 3.5, s
+    assert qwz[-1] < qwz[0], qwz
+    np.testing.assert_allclose(qwz, base, rtol=0.02)
+
+
+# --------------------------------------------------------------------------- #
+# hpZ: 2-level mesh link classes + parity
+# --------------------------------------------------------------------------- #
+def test_hpz_zero_dcn_gather_bytes_at_use(devices8):
+    """On the 2-level (data=2, zero_shard=4) carve the ONLY DCN-tagged
+    gather is the once-per-step primary gather; the at-use fwd/bwd gathers
+    (secondary partition) are entirely ICI-tagged."""
+    e = _engine({"zero_optimization": {"stage": 3,
+                                       "zero_hpz_partition_size": 4}},
+                comms_logger=True)
+    assert e.mesh_mgr.dcn_axes == ("data",)
+    _losses(e, 1)
+    summ = dist.get_telemetry().summary()
+    dist.configure(enabled=False)
+    assert summ["all_gather_params"]["algo_bytes_dcn"] > 0
+    assert summ["all_gather_params"]["algo_bytes_ici"] == 0
+    sec = summ["all_gather_params_secondary"]
+    assert sec["algo_bytes_ici"] > 0 and sec["algo_bytes_dcn"] == 0
+    use_site_dcn = sum(
+        s["algo_bytes_dcn"] for op, s in summ.items()
+        if op.startswith("all_gather") and op != "all_gather_params")
+    assert use_site_dcn == 0, summ
+
+
+def test_hpz_matches_replicated_reference(devices8):
+    """hpZ is a pure layout change: the trajectory matches the stage-2
+    (replicated-param) truth tightly. (Plain stage-3 gather-at-use deviates
+    on this mesh — the pre-existing side discovery pinned in
+    test_remat_overlap — so stage 2 is the honest reference.)"""
+    ref = _losses(_engine(), 4)
+    hpz = _losses(_engine({"zero_optimization": {
+        "stage": 3, "zero_hpz_partition_size": 4}}), 4)
+    np.testing.assert_allclose(hpz, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_qwz_prefetch_rides_wire_quantized(devices8):
+    """qwZ x hpZ x layer_prefetch: the per-layer prefetch gathers move int8
+    (all_gather_prefetch_q, ICI-tagged, >=3.5x vs fp32), the primary gather
+    is quantized AND DCN-tagged, and training still tracks the
+    non-quantized prefetch trajectory."""
+    cfg = {"zero_optimization": {"stage": 3, "zero_hpz_partition_size": 4},
+           "comms_overlap": {"enabled": True, "layer_prefetch": True}}
+    base = _fixed_losses(_engine(cfg), 5)
+    qcfg = {"zero_optimization": {**cfg["zero_optimization"],
+                                  "zero_quantized_weights": True},
+            "comms_overlap": cfg["comms_overlap"]}
+    e = _engine(qcfg, comms_logger=True)
+    qwz = _fixed_losses(e, 5)
+    summ = dist.get_telemetry().summary()
+    dist.configure(enabled=False)
+    pre = summ["all_gather_prefetch_q"]
+    assert pre["fp32_equiv_bytes"] / pre["bytes"] >= 3.5, pre
+    assert pre["algo_bytes_ici"] > 0 and pre["algo_bytes_dcn"] == 0
+    prim = summ["all_gather_params_q"]
+    assert prim["algo_bytes_dcn"] > 0
+    assert qwz[-1] < qwz[0], qwz
+    np.testing.assert_allclose(qwz, base, rtol=0.02)
+
+
+# --------------------------------------------------------------------------- #
+# schema + report surface
+# --------------------------------------------------------------------------- #
+def test_comm_schema_registry():
+    ok = [("Comm/all_gather_params_q/bytes", 1.0, 0),
+          ("Comm/all_gather_params_q/algo_bytes_dcn", 1.0, 0),
+          ("Comm/all_gather_prefetch_q/fp32_equiv_bytes", 4.0, 0),
+          ("Comm/total/algo_bytes_ici", 2.0, 0)]
+    assert schema.validate_events(ok) == []
+    bad_metric = schema.validate_events([("Comm/foo/bogus_metric", 1.0, 0)])
+    assert bad_metric and "COMM_METRICS" in bad_metric[0]
+    bad_total = schema.validate_events([("Comm/total/bogus", 1.0, 0)])
+    assert bad_total and "COMM_TOTAL_SERIES" in bad_total[0]
+
+
+def test_engine_comm_events_validate_and_split(devices8):
+    """The engine's own Comm/* event stream (incl. the new dcn/ici split and
+    fp32-equivalent series) passes the closed-schema validator."""
+    e = _engine({"zero_optimization": {"stage": 3,
+                                       "zero_hpz_partition_size": 4,
+                                       "zero_quantized_weights": True}},
+                comms_logger=True)
+    _losses(e, 1)
+    events = dist.get_telemetry().events(step=1)
+    events += e.telemetry._comm_efficiency_events(1, step_time_s=0.1)
+    dist.configure(enabled=False)
+    assert schema.validate_events(events) == []
+    names = {n for n, _, _ in events}
+    assert "Comm/all_gather_params_q/algo_bytes_dcn" in names
+    assert "Comm/total/algo_bytes_dcn" in names
+    by = {n: v for n, v, _ in events}
+    assert by["Comm/total/algo_bytes_dcn"] + \
+        by["Comm/total/algo_bytes_ici"] == \
+        pytest.approx(by["Comm/total/algo_bytes"])
+
+
+def test_report_quantized_section(tmp_path):
+    """telemetry_report --comm-efficiency renders the quantized-collectives
+    section: per-path wire vs fp32-equivalent ratio + DCN/ICI split."""
+    import json
+    import subprocess
+    import sys
+
+    events = [
+        {"name": "Comm/all_gather_params_q/bytes", "value": 1000.0,
+         "step": 1},
+        {"name": "Comm/all_gather_params_q/count", "value": 1.0, "step": 1},
+        {"name": "Comm/all_gather_params_q/algo_bytes", "value": 7000.0,
+         "step": 1},
+        {"name": "Comm/all_gather_params_q/fp32_equiv_bytes",
+         "value": 3900.0, "step": 1},
+        {"name": "Comm/total/algo_bytes", "value": 9000.0, "step": 1},
+        {"name": "Comm/total/algo_bytes_dcn", "value": 7000.0, "step": 1},
+        {"name": "Comm/total/algo_bytes_ici", "value": 2000.0, "step": 1},
+    ]
+    path = tmp_path / "events.jsonl"
+    path.write_text("".join(json.dumps(e) + "\n" for e in events))
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = os.path.join(repo, "scripts", "telemetry_report.py")
+    out = subprocess.run([sys.executable, script, str(path),
+                          "--comm-efficiency"],
+                         capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr
+    assert "quantized & hierarchical collectives" in out.stdout
+    assert "3.90x" in out.stdout
+    assert "DCN algo bytes/step" in out.stdout
+    assert "ICI algo bytes/step" in out.stdout
+
+
+def test_link_class_unit(devices8):
+    mm = mesh_lib.init_mesh({"data": 2, "zero_shard": 4})
+    assert dist._link_class(("data",)) == "ici"  # not tagged yet
+    mm.set_dcn_axes(("data",))
+    assert dist._link_class(("data",)) == "dcn"
+    assert dist._link_class(("data", "zero_shard")) == "dcn"
+    assert dist._link_class(("zero_shard",)) == "ici"
+    assert dist._link_class("tensor") == "ici"
+    mesh_lib.set_mesh(None)
+    assert dist._link_class(("data",)) == "ici"  # no mesh -> single tier
+
+
+def test_qar_requires_nothing_but_composes_with_buckets(devices8):
+    """quantized_all_reduce + default bucketing: small leaves ride exact
+    fp32 buckets (no quantized AR fires for them), and the trajectory is
+    bit-identical to the plain bucketed overlap (every leaf bucketed on the
+    tiny model -> the qar flag must change nothing)."""
+    co = {"enabled": True}
+    base = _losses(_engine({"zero_optimization": {"stage": 0},
+                            "comms_overlap": co}), 3)
+    qar = _losses(_engine({"zero_optimization": {"stage": 0},
+                           "comms_overlap": {**co,
+                                             "quantized_all_reduce": True}}),
+                  3)
+    assert base == qar, (base, qar)
