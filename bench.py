@@ -527,6 +527,129 @@ def bench_overlap_remat(jax, on_tpu, steps=None) -> dict:
     return out
 
 
+def _bench_result_from_file(path: str):
+    """Extract the bench RESULT object from any BENCH artifact shape: a raw
+    bench stdout capture (the JSON line is last), a promoted *_TPU_LIVE
+    file, or a round wrapper ``{"n", "cmd", "rc", "tail"}`` with the JSON
+    line embedded in ``tail``."""
+    def scan_lines(text):
+        for line in reversed(text.strip().splitlines()):
+            line = line.strip()
+            if line.startswith("{") and '"metric"' in line:
+                try:
+                    d = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(d, dict) and "metric" in d and "detail" in d:
+                    return d
+        return None
+
+    try:
+        with open(path) as f:
+            text = f.read()
+    except OSError:
+        return None
+    try:
+        doc = json.loads(text)
+    except ValueError:
+        return scan_lines(text)
+    if isinstance(doc, dict) and "metric" in doc and "detail" in doc:
+        return doc
+    if isinstance(doc, dict) and "tail" in doc:
+        return scan_lines(str(doc["tail"]))
+    return None
+
+
+def find_newest_bench_artifact(base_dir: str = None):
+    """Newest checked-in round artifact (``BENCH_r<NN>.json`` with the
+    highest round number) — the reference the regression mode compares a
+    fresh run against. Returns a path or None. ``DSTPU_BENCH_REF_DIR``
+    overrides the search directory (tests, out-of-tree comparisons)."""
+    import glob
+    import re
+
+    here = base_dir or os.environ.get("DSTPU_BENCH_REF_DIR") \
+        or os.path.dirname(os.path.abspath(__file__))
+    best_path, best_n = None, -1
+    for p in glob.glob(os.path.join(here, "BENCH_r*.json")):
+        m = re.search(r"BENCH_r(\d+)\.json$", p)
+        if m and int(m.group(1)) > best_n:
+            best_path, best_n = p, int(m.group(1))
+    return best_path
+
+
+def compare_step_time(fresh: dict, ref: dict, pct: float) -> dict:
+    """Pure compare: fresh vs reference ``detail.step_time_s``, matched by
+    backend class (a CPU-degraded run must never be judged against a TPU
+    capture). A TPU-backed fresh run may fall back to the reference's
+    embedded ``detail.tpu_capture``. ``fail`` = fresh step time more than
+    ``pct`` percent above the reference."""
+    def is_tpu(d):
+        return "tpu" in str(d.get("detail", {}).get("backend", ""))
+
+    def step_s(d):
+        try:
+            return float(d["detail"]["step_time_s"])
+        except (KeyError, TypeError, ValueError):
+            return 0.0
+
+    row = {"threshold_pct": pct, "reference": "headline"}
+    ref_d = ref
+    if is_tpu(fresh) != is_tpu(ref):
+        cap = ref.get("detail", {}).get("tpu_capture")
+        if is_tpu(fresh) and isinstance(cap, dict) and is_tpu(cap):
+            ref_d, row["reference"] = cap, "tpu_capture"
+        else:
+            row["status"] = ("skipped: backend mismatch (fresh="
+                             f"{fresh.get('detail', {}).get('backend')} ref="
+                             f"{ref.get('detail', {}).get('backend')})")
+            return row
+    fs, rs = step_s(fresh), step_s(ref_d)
+    if fs <= 0 or rs <= 0:
+        row["status"] = "skipped: missing step_time_s"
+        return row
+    row.update({"fresh_step_s": round(fs, 4), "ref_step_s": round(rs, 4),
+                "delta_pct": round((fs / rs - 1.0) * 100, 1),
+                "fail": fs > rs * (1.0 + pct / 100.0)})
+    row["status"] = "regressed" if row["fail"] else "ok"
+    return row
+
+
+def step_time_regression(base_dir: str = None, fresh: dict = None) -> dict:
+    """Regression row vs the newest ``BENCH_r*.json``. Non-fatal by design:
+    this documents the trajectory inside the artifact (and powers the
+    ``--regression-only`` probe); it never poisons ``detail.ok``."""
+    pct = float(os.environ.get("DSTPU_BENCH_REGRESSION_PCT", 20))
+    ref_path = find_newest_bench_artifact(base_dir)
+    if ref_path is None:
+        return {"status": "skipped: no BENCH_r*.json reference"}
+    ref = _bench_result_from_file(ref_path)
+    if ref is None:
+        return {"status": "skipped: unparseable reference "
+                          + os.path.basename(ref_path)}
+    row = compare_step_time(fresh or RESULT, ref, pct)
+    row["reference_artifact"] = os.path.basename(ref_path)
+    return row
+
+
+def regression_only(fresh_path: str) -> int:
+    """``bench.py --regression-only <fresh.json>``: compare an EXISTING
+    capture (e.g. the cycle's promoted bench JSON) against the newest
+    ``BENCH_r*.json`` without re-running anything. Prints one JSON line;
+    exit 1 on a confirmed >threshold step-time regression (callers treat it
+    as a non-fatal probe row — see scripts/tpu_watch.sh)."""
+    fresh = _bench_result_from_file(fresh_path)
+    if fresh is None:
+        row = {"status": f"skipped: unparseable fresh capture {fresh_path}"}
+    else:
+        row = step_time_regression(fresh=fresh)
+    print(json.dumps({"metric": "bench_step_time_regression",
+                      "value": row.get("delta_pct", 0.0),
+                      "unit": "pct_step_time_delta",
+                      "detail": row}))
+    return 1 if row.get("fail") else 0
+
+
 _DECODE_CHILD: dict = {}
 
 
@@ -811,6 +934,15 @@ def main():
     if os.environ.get("DSTPU_BENCH_QCOMM", "1") not in ("", "0"):
         RESULT["detail"]["quant_comm"] = run_quant_comm(jax, on_tpu)
 
+    # step-time regression vs the newest checked-in BENCH_r*.json —
+    # informational here (the gating form is --regression-only, wired as a
+    # non-fatal tpu_watch.sh probe row). Skippable via DSTPU_BENCH_REGRESSION=0.
+    if os.environ.get("DSTPU_BENCH_REGRESSION", "1") not in ("", "0"):
+        try:
+            RESULT["detail"]["regression"] = step_time_regression()
+        except Exception as e:  # a trajectory note must never kill the run
+            RESULT["detail"]["regression"] = {"status": f"error: {e}"[-200:]}
+
     # a decode child that fell back to CPU must not masquerade as the
     # accelerator decode number
     if isinstance(decode, dict):
@@ -889,6 +1021,13 @@ if __name__ == "__main__":
     if "--quant-comm-only" in sys.argv:
         quant_comm_only()
         sys.exit(0)
+    if "--regression-only" in sys.argv:
+        idx = sys.argv.index("--regression-only")
+        if idx + 1 >= len(sys.argv):
+            print("usage: bench.py --regression-only <fresh_bench.json>",
+                  file=sys.stderr)
+            sys.exit(2)
+        sys.exit(regression_only(sys.argv[idx + 1]))
     try:
         main()
     except Exception:

@@ -12,6 +12,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Any, Dict, Optional
 
+from ..telemetry.compile import CompileMonitorConfig
 from ..telemetry.trace import TraceConfig
 
 
@@ -103,6 +104,11 @@ class InferenceConfig:
     # request-lifecycle tracing + latency SLO stats (telemetry/trace.py;
     # docs/serving.md). Default OFF → the serving path records nothing.
     trace: TraceConfig = field(default_factory=TraceConfig)
+    # recompilation sentinel + per-program MFU attribution
+    # (telemetry/compile.py; docs/observability.md). Default OFF → every
+    # paged program is the plain jax.jit object, byte-identical.
+    compile_monitor: CompileMonitorConfig = field(
+        default_factory=CompileMonitorConfig)
 
     @classmethod
     def from_dict(cls, d: Optional[Dict[str, Any]]) -> "InferenceConfig":
@@ -115,9 +121,11 @@ class InferenceConfig:
         prefix = d.pop("prefix_cache", {})
         spec = d.pop("speculative", {})
         trace = d.pop("trace", {})
+        cmon = d.pop("compile_monitor", {})
         known = {k: v for k, v in d.items() if k in cls.__dataclass_fields__}
         return cls(tensor_parallel=TPConfig(**tp), ragged=RaggedConfig(**ragged),
                    quant=QuantConfig(**quant),
                    prefix_cache=PrefixCacheConfig(**prefix),
                    speculative=SpeculativeConfig(**spec),
-                   trace=TraceConfig(**trace), **known)
+                   trace=TraceConfig(**trace),
+                   compile_monitor=CompileMonitorConfig(**cmon), **known)

@@ -29,6 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..comm.mesh import MeshManager
+from ..telemetry.compile import CompileMonitor
 from ..telemetry.trace import Tracer, percentiles
 from ..utils.logging import log_dist
 from .config import InferenceConfig
@@ -134,6 +135,20 @@ class InferenceEngineV2(InferenceEngine):
             self.tracer = Tracer(getattr(self.config, "trace", None),
                                  name="serving")
         self._trace_on = self.tracer.enabled
+        # --- recompilation sentinel + per-program MFU attribution
+        # (telemetry/compile.py; docs/observability.md). A hub with an
+        # ENABLED monitor is shared — serving programs land in the same
+        # registry as the training entry points; otherwise the engine's own
+        # ``compile_monitor`` config block governs. Default OFF: every
+        # paged program is the plain jax.jit object (bit-identical serving,
+        # pinned by parity tests).
+        hub_cm = getattr(telemetry_hub, "compile", None)
+        if hub_cm is not None and getattr(hub_cm, "enabled", False):
+            self.compile_monitor = hub_cm
+        else:
+            self.compile_monitor = CompileMonitor(
+                getattr(self.config, "compile_monitor", None),
+                tracer=self.tracer)
         self._req: Dict[int, dict] = {}   # uid → open lifecycle record
         self._lat: Dict[str, List[float]] = {
             "ttft_ms": [], "itl_ms": [], "queue_ms": [], "e2e_ms": []}
@@ -235,6 +250,16 @@ class InferenceEngineV2(InferenceEngine):
         rec["span"].end(cancelled=True)
 
     # ------------------------------------------------------------------ #
+    def _jit(self, key, fn, **jit_kwargs):
+        """Every paged program routes through the compile monitor's shared
+        registration helper. ``key[0]`` is the program FAMILY name, so a new
+        bucket/shape of an existing family registers as a recompile — which
+        is exactly what an unbucketed-prompt recompilation storm looks like.
+        Default OFF → the exact ``jax.jit`` object back."""
+        return self.compile_monitor.jit(str(key[0]), fn, group="Serving",
+                                        **jit_kwargs)
+
+    # ------------------------------------------------------------------ #
     def _prefill_fn(self, pad_t: int, sp: SamplingParams, n: int = 1):
         """One compiled prefill over ``n`` admitted sequences at once —
         admission bursts (serving start, high churn) run one program call
@@ -261,7 +286,7 @@ class InferenceEngineV2(InferenceEngine):
                 toks = jax.vmap(lambda k, l: sample(k, l, sp))(keys, last)
                 return toks.astype(jnp.int32), cache
 
-            self._paged_fns[key] = jax.jit(prefill, donate_argnums=(1,))
+            self._paged_fns[key] = self._jit(key, prefill, donate_argnums=(1,))
         return self._paged_fns[key]
 
     _sp_warned = False
@@ -312,7 +337,7 @@ class InferenceEngineV2(InferenceEngine):
                         keys, last, temp, topk, topp, greedy)
                 return toks.astype(jnp.int32), cache
 
-            self._paged_fns[key] = jax.jit(prefill, donate_argnums=(1,))
+            self._paged_fns[key] = self._jit(key, prefill, donate_argnums=(1,))
         return self._paged_fns[key]
 
     def _prefill_ctx_fn(self, pad_t: int, sp: SamplingParams, n: int):
@@ -339,7 +364,7 @@ class InferenceEngineV2(InferenceEngine):
                 toks = jax.vmap(lambda k, l: sample(k, l, sp))(keys, last)
                 return toks.astype(jnp.int32), cache
 
-            self._paged_fns[key] = jax.jit(prefill, donate_argnums=(1,))
+            self._paged_fns[key] = self._jit(key, prefill, donate_argnums=(1,))
         return self._paged_fns[key]
 
     def _prefill_ctx_dyn_fn(self, pad_t: int, n: int):
@@ -363,7 +388,7 @@ class InferenceEngineV2(InferenceEngine):
                         keys, last, temp, topk, topp, greedy)
                 return toks.astype(jnp.int32), cache
 
-            self._paged_fns[key] = jax.jit(prefill, donate_argnums=(1,))
+            self._paged_fns[key] = self._jit(key, prefill, donate_argnums=(1,))
         return self._paged_fns[key]
 
     def _copy_block_fn(self):
@@ -378,7 +403,7 @@ class InferenceEngineV2(InferenceEngine):
                 return jax.tree.map(
                     lambda c: c.at[:, dst].set(c[:, src]), cache)
 
-            self._paged_fns[key] = jax.jit(cp, donate_argnums=(0,))
+            self._paged_fns[key] = self._jit(key, cp, donate_argnums=(0,))
         return self._paged_fns[key]
 
     def _copy_blocks(self, pairs) -> None:
@@ -419,7 +444,7 @@ class InferenceEngineV2(InferenceEngine):
                 return tok.astype(jnp.int32), cache
 
             donate = (1,)
-            self._paged_fns[key] = jax.jit(chunk_prefill,
+            self._paged_fns[key] = self._jit(key, chunk_prefill,
                                            donate_argnums=donate)
         return self._paged_fns[key]
 
@@ -517,7 +542,7 @@ class InferenceEngineV2(InferenceEngine):
                 nxt = sample(rng, logits[:, 0], sp)
                 return nxt.astype(jnp.int32), cache
 
-            self._paged_fns[key] = jax.jit(decode, donate_argnums=(1,))
+            self._paged_fns[key] = self._jit(key, decode, donate_argnums=(1,))
         return self._paged_fns[key]
 
     def _decode_dyn_fn(self):
@@ -534,7 +559,7 @@ class InferenceEngineV2(InferenceEngine):
                 nxt = sample_batch(rng, logits[:, 0], temp, topk, topp, greedy)
                 return nxt.astype(jnp.int32), cache
 
-            self._paged_fns[key] = jax.jit(decode, donate_argnums=(1,))
+            self._paged_fns[key] = self._jit(key, decode, donate_argnums=(1,))
         return self._paged_fns[key]
 
     def _decode_many_dyn_fn(self, k: int):
@@ -560,7 +585,7 @@ class InferenceEngineV2(InferenceEngine):
                     tick, (tokens, lens, cache), keys)
                 return toks, lens, cache  # toks: [k, B]
 
-            self._paged_fns[key] = jax.jit(decode_many, donate_argnums=(1,))
+            self._paged_fns[key] = self._jit(key, decode_many, donate_argnums=(1,))
         return self._paged_fns[key]
 
     def _needs_dynamic_sp(self, live) -> bool:
@@ -600,7 +625,7 @@ class InferenceEngineV2(InferenceEngine):
                     tick, (tokens, lens, cache), keys)
                 return toks, lens, cache  # toks: [k, B]
 
-            self._paged_fns[key] = jax.jit(decode_many, donate_argnums=(1,))
+            self._paged_fns[key] = self._jit(key, decode_many, donate_argnums=(1,))
         return self._paged_fns[key]
 
     # ------------------------------------------------------------------ #
@@ -675,7 +700,7 @@ class InferenceEngineV2(InferenceEngine):
                 nxt = jnp.where(is_greedy, la, sampled)
                 return m, nxt.astype(jnp.int32), cache
 
-            self._paged_fns[key] = jax.jit(verify, donate_argnums=(1,))
+            self._paged_fns[key] = self._jit(key, verify, donate_argnums=(1,))
         return self._paged_fns[key]
 
     def _draft_tokens(self, desc) -> List[int]:
@@ -1253,6 +1278,22 @@ class InferenceEngineV2(InferenceEngine):
                 self._hub.serving_event(name, value, s)
         return events
 
+    def compile_events(self, step: int = 0):
+        """Drain the compile monitor: cumulative ``Compile/*`` counters per
+        paged program (prefill/decode/verify families: compiles, cache
+        hits, RECOMPILES, lower/compile wall time, cost-model flops) plus
+        ``Serving/mfu/<program>`` attribution gauges over the wall window
+        since the previous drain. Names are registered in
+        ``telemetry/schema.py``."""
+        return self.compile_monitor.events(step)
+
+    def publish_compile_telemetry(self, step: int = 0):
+        events = self.compile_events(step)
+        if self._hub is not None:
+            for name, value, s in events:
+                self._hub.compile_event(name, value, s)
+        return events
+
     def export_trace(self, path: str):
         """Dump the flight recorder as Chrome-trace/Perfetto JSON."""
         return self.tracer.export(path)
@@ -1356,6 +1397,8 @@ class InferenceEngineV2(InferenceEngine):
             self.publish_latency_telemetry(step_i)
         if self._spec_on and self._hub is not None:
             self.publish_spec_telemetry(step_i)
+        if self.compile_monitor.enabled and self._hub is not None:
+            self.publish_compile_telemetry(step_i)
         return [results[i] for i in range(len(prompts))]
 
 

@@ -212,14 +212,25 @@ class JSONLMonitor(MonitorBackend):
     per line in ``<output_path>/<job_name>/events.jsonl``. The machine-readable
     counterpart of the CSV backend — a single ordered stream that
     ``scripts/telemetry_report.py`` can replay, and the sink the TelemetryHub
-    acceptance path writes through."""
+    acceptance path writes through.
+
+    Size-capped rotation (``telemetry.jsonl_max_mb``, default off): when the
+    file exceeds the cap it rotates to ``events.jsonl.1`` (one generation —
+    bounded disk for week-long serving runs, and the report can still read
+    the previous window). Reopening after a crash is torn-tail-safe: a final
+    line the dying process tore mid-``write(2)`` is newline-terminated
+    before new records append, so it stays ONE bad interior line instead of
+    gluing onto the next record."""
 
     name = "jsonl"
 
-    def __init__(self, cfg):
+    def __init__(self, cfg, max_mb: Optional[float] = None):
         super().__init__(cfg)
         self._f = None
         self.path: Optional[str] = None
+        if max_mb is None:
+            max_mb = getattr(cfg, "jsonl_max_mb", 0.0)
+        self.max_bytes = int(float(max_mb or 0.0) * 1024 * 1024)
         if not self.enabled:
             return
         try:
@@ -227,10 +238,26 @@ class JSONLMonitor(MonitorBackend):
                                 cfg.job_name)
             os.makedirs(root, exist_ok=True)
             self.path = os.path.join(root, "events.jsonl")
-            self._f = open(self.path, "a")
+            self._f = self._open_append(self.path)
         except Exception as e:
             logger.warning(f"jsonl monitor disabled: {e}")
             self.enabled = False
+
+    @staticmethod
+    def _open_append(path: str):
+        torn = False
+        try:
+            if os.path.getsize(path) > 0:
+                with open(path, "rb") as g:
+                    g.seek(-1, os.SEEK_END)
+                    torn = g.read(1) != b"\n"
+        except OSError:  # no previous file — nothing to repair
+            pass
+        f = open(path, "a")
+        if torn:
+            f.write("\n")
+            f.flush()
+        return f
 
     def write_events(self, events: Sequence[Event]) -> None:
         if not self._f:
@@ -243,6 +270,22 @@ class JSONLMonitor(MonitorBackend):
         # steps must not lose the tail of the step log (the flight-recorder
         # dump and the JSONL stream are the two post-mortem artifacts)
         self._f.flush()
+        if self.max_bytes and self._f.tell() >= self.max_bytes:
+            self._rotate()
+
+    def _rotate(self) -> None:
+        try:
+            self._f.close()
+            os.replace(self.path, self.path + ".1")
+            self._f = open(self.path, "a")
+        except Exception as e:  # rotation is protective, never fatal
+            logger.warning(f"jsonl rotation failed: {e}")
+            if self._f is None or self._f.closed:
+                try:
+                    self._f = self._open_append(self.path)
+                except Exception:
+                    self.enabled = False
+                    self._f = None
 
     def flush(self) -> None:
         if self._f:
@@ -276,7 +319,14 @@ class MonitorMaster(MonitorBackend):
                          (CSVMonitor, getattr(cfg, "csv_monitor", None)),
                          (JSONLMonitor, getattr(cfg, "jsonl_monitor", None))):
             if sub is not None and getattr(sub, "enabled", False):
-                b = cls(sub)
+                if cls is JSONLMonitor:
+                    # rotation cap lives in the telemetry block (the sink's
+                    # own sub-config stays reference-shaped)
+                    b = cls(sub, max_mb=getattr(
+                        getattr(cfg, "telemetry", None), "jsonl_max_mb",
+                        None))
+                else:
+                    b = cls(sub)
                 if b.enabled:
                     self.backends.append(b)
         self.enabled = bool(self.backends)

@@ -271,12 +271,54 @@ class TraceTelemetryConfig(ConfigModel):
 
 @register_config_model
 @dataclass
+class CompileTelemetryConfig(ConfigModel):
+    """``telemetry.compile`` block — recompilation sentinel + analytic
+    cost-model MFU attribution (``telemetry/compile.py``;
+    docs/observability.md). Default OFF: every monitored jit site gets the
+    plain ``jax.jit`` object back and the default program is
+    byte-identical."""
+    enabled: bool = False
+    # distinct signatures per program treated as expected warmup
+    warmup_signatures: int = 1
+    # unexpected recompiles tolerated before on_budget fires (0 = unlimited)
+    recompile_budget: int = 0
+    on_budget: str = "warn"       # warn | raise
+    # pull cost_analysis() flops/bytes per compiled program
+    cost_analysis: bool = True
+
+
+@register_config_model
+@dataclass
+class AnomalyTelemetryConfig(ConfigModel):
+    """``telemetry.anomaly`` block — step-time anomaly detection
+    (``telemetry/anomaly.py``; docs/observability.md). Default OFF: the hub
+    never feeds the detector."""
+    enabled: bool = False
+    window: int = 64              # rolling median/MAD window (samples)
+    min_samples: int = 16         # silence until this many samples
+    spike_mad: float = 6.0        # spike: x > median + spike_mad * MAD
+    mad_floor_frac: float = 0.02  # MAD floor as a fraction of the median
+    drift_frac: float = 0.25      # drift: rolling median vs frozen baseline
+    straggler_frac: float = 0.25  # per-host: above cross-host median by this
+    dump_flight_recorder: bool = True  # trace dump on the first finding
+
+
+@register_config_model
+@dataclass
 class TelemetryConfig(ConfigModel):
-    """Top-level ``telemetry`` block (currently just the trace sub-block;
+    """Top-level ``telemetry`` block (trace + compile + anomaly sub-blocks;
     the older observability gates — ``wall_clock_breakdown``,
     ``comms_logger``, ``profiler`` — stay where reference configs put
     them)."""
     trace: TraceTelemetryConfig = field(default_factory=TraceTelemetryConfig)
+    compile: CompileTelemetryConfig = field(
+        default_factory=CompileTelemetryConfig)
+    anomaly: AnomalyTelemetryConfig = field(
+        default_factory=AnomalyTelemetryConfig)
+    # JSONL monitor sink rotation threshold (MiB): when events.jsonl exceeds
+    # this, it rotates to events.jsonl.1 so long serving runs can't fill the
+    # disk. 0 = no rotation (docs/observability.md).
+    jsonl_max_mb: float = 0.0
 
 
 @register_config_model

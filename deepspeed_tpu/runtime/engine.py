@@ -554,7 +554,8 @@ class DeepSpeedTPUEngine:
                 return self._accumulate(params, b, ls)
 
             with self.mesh_mgr.activate():
-                self._nvme_grad_step = jax.jit(grad_fn)
+                self._nvme_grad_step = self.telemetry.compile.jit(
+                    "nvme_grad_step", grad_fn)
         self.tput_timer.start()
         self.telemetry.step_begin(self.global_steps + 1)
         if self.watchdog is not None:
@@ -1449,8 +1450,12 @@ class DeepSpeedTPUEngine:
             grads, loss, aux = self._accumulate(state.params, batch, state.loss_scale)
             return self._apply_update(state, grads, loss, aux, lr_override)
 
+        # jitted entry points route through the telemetry hub's compile
+        # monitor (the recompilation sentinel + per-program cost model —
+        # telemetry/compile.py). Default OFF → the exact jax.jit object.
         with self.mesh_mgr.activate():
-            self._train_step = jax.jit(step_fn, donate_argnums=(0,))
+            self._train_step = self.telemetry.compile.jit(
+                "train_step", step_fn, donate_argnums=(0,))
         return self._train_step
 
     def _ensure_apply_step(self):
@@ -1458,7 +1463,8 @@ class DeepSpeedTPUEngine:
         step API shims and the wall-clock-breakdown path."""
         if self._apply_step is None:
             with self.mesh_mgr.activate():
-                self._apply_step = jax.jit(
+                self._apply_step = self.telemetry.compile.jit(
+                    "apply_step",
                     lambda state, grads, loss, lro: self._apply_update(
                         state, grads, loss, lr_override=lro),
                     donate_argnums=(0,))
@@ -1481,8 +1487,8 @@ class DeepSpeedTPUEngine:
             return self._accumulate(params, batch, loss_scale)
 
         with self.mesh_mgr.activate():
-            self._fwd_step = jax.jit(fwd_fn)
-            self._bwd_step = jax.jit(bwd_fn)
+            self._fwd_step = self.telemetry.compile.jit("fwd_step", fwd_fn)
+            self._bwd_step = self.telemetry.compile.jit("bwd_step", bwd_fn)
         self._ensure_apply_step()
 
     def _train_batch_breakdown(self, batch) -> StepOutput:
@@ -1631,7 +1637,8 @@ class DeepSpeedTPUEngine:
                     jax.tree.map(lambda g: g.astype(jnp.float32), grads)), loss, aux
 
             with self.mesh_mgr.activate():
-                self._grad_step = jax.jit(one_micro)
+                self._grad_step = self.telemetry.compile.jit(
+                    "grad_step", one_micro)
         if self.watchdog is not None and not self._staged_batches:
             # first micro-batch of a GAS window: start the stall clock that
             # the boundary step()'s observe() reads
@@ -1730,7 +1737,8 @@ class DeepSpeedTPUEngine:
     def eval_batch(self, batch):
         if not hasattr(self, "_eval_step") or self._eval_step is None:
             with self.mesh_mgr.activate():
-                self._eval_step = jax.jit(lambda p, b: self._loss(p, b)[0])
+                self._eval_step = self.telemetry.compile.jit(
+                    "eval_step", lambda p, b: self._loss(p, b)[0])
         batch = self._shard_batch(batch, with_gas_dim=False)
         breakdown = self.wall_clock_breakdown()
         with _annotate("eval_batch"):

@@ -2,14 +2,20 @@
 
 See ``docs/observability.md`` for the config surface
 (``wall_clock_breakdown``, ``memory_breakdown``, ``comms_logger``,
-``profiler``, ``telemetry.trace``, monitor backends incl. the JSONL sink,
-and the pull-based Prometheus metrics endpoint).
+``profiler``, ``telemetry.trace``, ``telemetry.compile`` (recompilation
+sentinel + per-program MFU attribution), ``telemetry.anomaly`` (step-time
+spike/drift/straggler detection), monitor backends incl. the size-rotated
+JSONL sink, and the pull-based Prometheus metrics endpoint).
 """
 
+from .anomaly import AnomalyConfig, AnomalyDetector  # noqa: F401
+from .compile import (CompileMonitor, CompileMonitorConfig,  # noqa: F401
+                      RecompileBudgetExceeded, peak_flops_per_chip)
 from .hub import TelemetryHub  # noqa: F401
 from .memory import MemoryTelemetry  # noqa: F401
 from .metrics_server import MetricsServer  # noqa: F401
 from .profiler import ProfilerSession, annotate  # noqa: F401
-from .schema import (SERVING_SERIES, validate_events,  # noqa: F401
+from .schema import (ANOMALY_SERIES, COMPILE_METRICS,  # noqa: F401
+                     SERVING_SERIES, validate_events,
                      validate_jsonl_records)
 from .trace import TraceConfig, Tracer, dump_all, percentiles  # noqa: F401

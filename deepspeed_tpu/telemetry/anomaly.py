@@ -1,0 +1,179 @@
+"""Step-time anomaly detection: rolling-median/MAD spikes, drift, stragglers.
+
+Slow step-time drift is the other silent killer next to recompilation
+storms: a job that degrades 20% over six hours still "works", costs a fifth
+of the fleet, and no single log line ever looks wrong. This module keeps a
+robust rolling baseline per timing series and flags three failure shapes:
+
+- **spike** — one observation far above the rolling median, measured in
+  MADs (median absolute deviation; robust to the spikes it is hunting);
+- **drift** — the rolling median itself creeping above a frozen early-run
+  baseline by more than ``drift_frac``;
+- **straggler** — on multi-host meshes, one host's step time sitting above
+  the cross-host median by more than ``straggler_frac`` (fed by the hub's
+  per-host gather over the existing comms machinery, or synthetically).
+
+Findings surface as ``Anomaly/*`` events through the TelemetryHub (which
+also fires the flight-recorder dump hook), as counters on the metrics
+endpoint, and offline via ``telemetry_report.py --anomalies``, which replays
+this same detector over a recorded JSONL.
+
+Deliberately stdlib-only (no jax/numpy): ``telemetry_report.py`` loads this
+file by path to analyze telemetry wherever it lands.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass
+from statistics import median
+from typing import Deque, Dict, List, Sequence
+
+__all__ = ["AnomalyConfig", "AnomalyDetector", "Finding"]
+
+
+@dataclass
+class AnomalyConfig:
+    """The ``telemetry.anomaly`` config block (docs/observability.md).
+    Default OFF: the hub never feeds the detector and no state is kept."""
+
+    enabled: bool = False
+    # rolling window (samples) for the per-series median/MAD baseline
+    window: int = 64
+    # detectors stay silent until a series has this many samples
+    min_samples: int = 16
+    # spike: x > median + spike_mad * MAD (MAD floored at mad_floor_frac *
+    # median so a perfectly steady series doesn't flag micro-jitter)
+    spike_mad: float = 6.0
+    mad_floor_frac: float = 0.02
+    # drift: rolling median > frozen early-run baseline * (1 + drift_frac);
+    # flagged once per excursion, re-armed at half the threshold
+    drift_frac: float = 0.25
+    # straggler: a host's time > cross-host median * (1 + straggler_frac)
+    straggler_frac: float = 0.25
+    # dump the flight recorder on the first finding (hub-side hook)
+    dump_flight_recorder: bool = True
+
+
+@dataclass
+class Finding:
+    """One detected anomaly. ``series`` is the event suffix (the emitted
+    name is ``Anomaly/<series>``); ``value`` is the excess ratio vs the
+    baseline (0.5 = 50% above); ``detail`` is a human-readable one-liner."""
+
+    series: str
+    value: float
+    step: int
+    detail: str
+
+
+class _SeriesState:
+    __slots__ = ("window", "count", "baseline", "drift_flagged")
+
+    def __init__(self, maxlen: int):
+        self.window: Deque[float] = deque(maxlen=maxlen)
+        self.count = 0
+        self.baseline: float = 0.0   # frozen early-run median (drift ref)
+        self.drift_flagged = False
+
+
+class AnomalyDetector:
+    """See module docstring. ``cfg`` is any object carrying the
+    :class:`AnomalyConfig` attributes; ``None``/disabled → every observe is
+    a no-op returning no findings."""
+
+    def __init__(self, cfg=None):
+        self.cfg = cfg if cfg is not None else AnomalyConfig()
+        self.enabled = bool(getattr(self.cfg, "enabled", False))
+        self.window = max(8, int(getattr(self.cfg, "window", 64) or 64))
+        self.min_samples = max(
+            4, int(getattr(self.cfg, "min_samples", 16) or 16))
+        self.spike_mad = float(getattr(self.cfg, "spike_mad", 6.0) or 6.0)
+        self.mad_floor_frac = float(
+            getattr(self.cfg, "mad_floor_frac", 0.02) or 0.02)
+        self.drift_frac = float(getattr(self.cfg, "drift_frac", 0.25) or 0.25)
+        self.straggler_frac = float(
+            getattr(self.cfg, "straggler_frac", 0.25) or 0.25)
+        self.dump_flight_recorder = bool(
+            getattr(self.cfg, "dump_flight_recorder", True))
+        self._series: Dict[str, _SeriesState] = {}
+        self.findings_total = 0
+
+    # ------------------------------------------------------------------ #
+    def observe(self, series: str, value_ms: float,
+                step: int = 0) -> List[Finding]:
+        """Feed one timing sample (ms) for ``series`` (``step_time``,
+        ``phase/fwd``, …); returns the findings this sample triggered.
+        The emitted event names are ``Anomaly/<series>/spike`` and
+        ``Anomaly/<series>/drift``."""
+        if not self.enabled:
+            return []
+        st = self._series.get(series)
+        if st is None:
+            st = self._series[series] = _SeriesState(self.window)
+        findings: List[Finding] = []
+        x = float(value_ms)
+        if st.count >= self.min_samples:
+            med = median(st.window)
+            mad = median(abs(v - med) for v in st.window)
+            floor = self.mad_floor_frac * max(med, 1e-9)
+            if med > 0 and x > med + self.spike_mad * max(mad, floor):
+                findings.append(Finding(
+                    series=f"{series}/spike", value=x / med - 1.0, step=step,
+                    detail=(f"{series}: {x:.2f}ms is "
+                            f"{(x / med - 1.0) * 100:.0f}% above the rolling "
+                            f"median {med:.2f}ms at step {step}")))
+        st.window.append(x)
+        st.count += 1
+        # freeze the drift baseline once the first full window has been seen
+        if st.baseline == 0.0 and st.count == self.window:
+            st.baseline = median(st.window)
+        if st.baseline > 0 and st.count >= 2 * self.window:
+            recent = median(st.window)
+            thresh = st.baseline * (1.0 + self.drift_frac)
+            if recent > thresh and not st.drift_flagged:
+                st.drift_flagged = True
+                findings.append(Finding(
+                    series=f"{series}/drift",
+                    value=recent / st.baseline - 1.0, step=step,
+                    detail=(f"{series}: rolling median {recent:.2f}ms has "
+                            f"drifted {(recent / st.baseline - 1) * 100:.0f}%"
+                            f" above the early-run baseline "
+                            f"{st.baseline:.2f}ms by step {step}")))
+            elif recent <= st.baseline * (1.0 + self.drift_frac * 0.5):
+                st.drift_flagged = False   # excursion over — re-arm
+        self.findings_total += len(findings)
+        return findings
+
+    # ------------------------------------------------------------------ #
+    def observe_hosts(self, values_ms: Sequence[float],
+                      step: int = 0) -> List[Finding]:
+        """Feed one cross-host timing vector (``values_ms[i]`` = host i's
+        step time); flags each host sitting ``straggler_frac`` above the
+        cross-host median as ``Anomaly/host/straggler``."""
+        if not self.enabled or len(values_ms) < 2:
+            return []
+        med = median(float(v) for v in values_ms)
+        if med <= 0:
+            return []
+        findings = [
+            Finding(series="host/straggler", value=float(v) / med - 1.0,
+                    step=step,
+                    detail=(f"host {i}: {float(v):.2f}ms is "
+                            f"{(float(v) / med - 1.0) * 100:.0f}% above the "
+                            f"cross-host median {med:.2f}ms at step {step}"))
+            for i, v in enumerate(values_ms)
+            if float(v) > med * (1.0 + self.straggler_frac)]
+        self.findings_total += len(findings)
+        return findings
+
+    # ------------------------------------------------------------------ #
+    def baselines(self) -> Dict[str, Dict[str, float]]:
+        """Current per-series rolling state (tests, reports)."""
+        out: Dict[str, Dict[str, float]] = {}
+        for name, st in self._series.items():
+            out[name] = {
+                "samples": float(st.count),
+                "median": float(median(st.window)) if st.window else 0.0,
+                "baseline": float(st.baseline)}
+        return out

@@ -1,0 +1,397 @@
+"""Compile-aware telemetry: recompilation sentinel + analytic cost model.
+
+The two classic silent killers of JAX/TPU production jobs are invisible to
+wall-clock telemetry: an **unnoticed recompilation storm** (a shape or
+sharding that drifts per step retraces and recompiles the same program over
+and over — each one minutes on real silicon) and a headline MFU number with
+**no decomposition** (one ThroughputTimer scalar says nothing about where
+the flops went). This module answers both:
+
+- :class:`CompileMonitor` is the shared registration helper every jitted
+  entry point in ``runtime/engine.py`` and ``inference/engine_v2.py`` routes
+  through (``monitor.jit(name, fn, **jit_kwargs)``). Default **OFF**: a
+  disabled monitor returns the ``jax.jit`` object untouched, so the default
+  program is byte-identical (pinned by parity tests). Enabled, it dispatches
+  through explicitly lowered+compiled programs, which makes every
+  trace/lower/compile an *observed event*: per-program lowering and compile
+  wall time, the abstract-shape signature that triggered it, cache hits vs
+  misses, and **recompile detection** (same program name, new signature)
+  with a config-gated budget that warns or raises after N unexpected
+  recompiles in steady state.
+- Each compile pulls ``lower(...).compile().cost_analysis()`` flops/bytes
+  (guarded — backends may return ``None``), giving the TelemetryHub an
+  analytic per-program cost model: the headline MFU decomposes into
+  ``Train/mfu/<program>`` and ``Serving/mfu/<program>`` gauges (prefill vs
+  decode vs train-step) instead of one ThroughputTimer number.
+
+Event names (``Compile/<program>/<metric>``, ``Compile/total/*``,
+``<group>/mfu/<program>``) are registered in ``telemetry/schema.py``;
+``telemetry_report.py --compile`` renders the offline summary.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+
+from ..utils.logging import logger
+from .trace import NULL_TRACER
+
+__all__ = ["CompileMonitorConfig", "CompileMonitor", "MonitoredFunction",
+           "ProgramStats", "RecompileBudgetExceeded", "peak_flops_per_chip"]
+
+Event = Tuple[str, float, int]
+
+_NAME_SANITIZE = re.compile(r"[^A-Za-z0-9_]")
+
+
+@dataclass
+class CompileMonitorConfig:
+    """The ``telemetry.compile`` config block (docs/observability.md).
+
+    Default OFF: every monitored jit site gets the plain ``jax.jit`` object
+    back and nothing is recorded — the default program is byte-identical."""
+
+    enabled: bool = False
+    # distinct signatures per program treated as expected warmup (bucketed
+    # serving programs legitimately compile one variant per bucket; raise
+    # this to the bucket count to keep the budget quiet through warmup)
+    warmup_signatures: int = 1
+    # unexpected recompiles (beyond warmup, across all programs) tolerated
+    # before on_budget fires; 0 = unlimited (sentinel records, never acts)
+    recompile_budget: int = 0
+    # warn | raise — what to do when the budget is exhausted
+    on_budget: str = "warn"
+    # pull cost_analysis() flops/bytes per compiled program (feeds the
+    # per-program MFU attribution; None-returning backends degrade to 0)
+    cost_analysis: bool = True
+
+
+class RecompileBudgetExceeded(RuntimeError):
+    """Raised when ``recompile_budget`` is exhausted with ``on_budget:
+    raise`` — a recompilation storm in steady state is a production
+    incident, not a log line."""
+
+
+@dataclass
+class ProgramStats:
+    """Cumulative per-program compile accounting (one registered name)."""
+
+    name: str
+    group: str = "Train"            # event group for the MFU gauges
+    compiles: int = 0               # lower+compile executions (signatures)
+    cache_hits: int = 0             # dispatches served by a compiled program
+    recompiles: int = 0             # compiles beyond the first signature
+    lower_ms: float = 0.0           # cumulative lowering wall time
+    compile_ms: float = 0.0         # cumulative backend-compile wall time
+    cost_flops: float = 0.0         # per-call flops (last compile's analysis)
+    cost_bytes: float = 0.0         # per-call bytes accessed (last compile)
+    calls_since_drain: int = 0      # executions since the last events() drain
+    signatures: List[Any] = field(default_factory=list)
+
+
+def peak_flops_per_chip() -> float:
+    """bf16 peak flops of the local accelerator (mirrors ``bench.py``; CPU
+    gets the same 2e12 smoke-run placeholder so CPU-run MFU gauges stay
+    finite and comparable across runs)."""
+    try:
+        kind = getattr(jax.devices()[0], "device_kind", "").lower()
+    except Exception:
+        return 2e12
+    if "v5 lite" in kind or "v5e" in kind:
+        return 197e12
+    if "v5p" in kind or "v5" in kind:
+        return 459e12
+    if "v4" in kind:
+        return 275e12
+    if "v6" in kind or "trillium" in kind:
+        return 918e12
+    return 2e12
+
+
+def _sharding_signature(x: jax.Array) -> str:
+    """Canonical sharding key. jax's dispatch cache treats these spellings
+    as ONE sharding, so the signature must too — otherwise step 1's
+    explicitly-placed state vs step 2's compiled outputs would read as a
+    phantom recompile:
+
+    - ``PartitionSpec(None, None)`` == ``PartitionSpec()`` (trailing
+      ``None`` entries stripped);
+    - a single-axis tuple entry ``('data',)`` == the bare axis ``'data'``
+      (single-element entry tuples unwrapped)."""
+    sh = getattr(x, "sharding", None)
+    if sh is None:
+        return ""
+    spec = getattr(sh, "spec", None)
+    if spec is not None:
+        entries = tuple(e[0] if isinstance(e, tuple) and len(e) == 1
+                        else tuple(e) if isinstance(e, tuple) else e
+                        for e in spec)
+        while entries and entries[-1] is None:
+            entries = entries[:-1]
+        mesh = getattr(sh, "mesh", None)
+        shape = getattr(mesh, "shape", None)
+        return (f"named:{tuple(shape.items()) if shape else ()}:{entries}:"
+                f"{getattr(sh, 'memory_kind', '')}")
+    return str(sh)
+
+
+def _leaf_signature(x: Any) -> Tuple:
+    """Hashable abstract signature of one argument leaf: shape/dtype (and
+    sharding, which also forces recompiles) for arrays, the python type for
+    everything else (weak-typed scalars of one type share a trace)."""
+    if isinstance(x, jax.Array):
+        return (tuple(x.shape), str(x.dtype), _sharding_signature(x))
+    shape = getattr(x, "shape", None)
+    if shape is not None:  # numpy / duck-typed host arrays
+        return (tuple(shape), str(getattr(x, "dtype", "")), "host")
+    return (type(x).__name__,)
+
+
+def _abstract_signature(args, kwargs) -> Tuple:
+    leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+    return (treedef, tuple(_leaf_signature(x) for x in leaves))
+
+
+def _cost_analysis(compiled) -> Tuple[float, float]:
+    """(flops, bytes_accessed) per call from XLA's cost analysis; 0.0s when
+    the backend returns None/[]/{} or raises (the CPU fallback contract)."""
+    try:
+        cost = compiled.cost_analysis()
+    except Exception:
+        return 0.0, 0.0
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else {}
+    if not isinstance(cost, dict):
+        return 0.0, 0.0
+    try:
+        return (float(cost.get("flops", 0.0) or 0.0),
+                float(cost.get("bytes accessed", 0.0) or 0.0))
+    except (TypeError, ValueError):
+        return 0.0, 0.0
+
+
+class MonitoredFunction:
+    """A jitted entry point dispatching through the monitor's own
+    signature → compiled-program cache. A signature miss runs the explicit
+    ``lower()`` / ``compile()`` phases (timed separately) and records the
+    compile; a hit calls the stored compiled program directly. Unknown
+    attribute access (``.lower``, ``.trace``) passes through to the
+    underlying ``jax.jit`` object so AOT consumers keep working."""
+
+    def __init__(self, monitor: "CompileMonitor", name: str, jitted,
+                 group: str):
+        self._monitor = monitor
+        self._name = name
+        self._jitted = jitted
+        self._group = group
+        self._compiled: Dict[Tuple, Any] = {}
+        self._fallback = False
+
+    def __getattr__(self, attr):  # .lower()/.trace()/… of the jitted fn
+        return getattr(self._jitted, attr)
+
+    def __call__(self, *args, **kwargs):
+        if self._fallback:
+            return self._jitted(*args, **kwargs)
+        try:
+            sig = _abstract_signature(args, kwargs)
+            entry = self._compiled.get(sig)
+        except Exception as e:  # unhashable static arg etc. — degrade once
+            self._degrade(f"signature: {e}")
+            return self._jitted(*args, **kwargs)
+        if entry is not None:
+            self._monitor._record_hit(self._name)
+            try:
+                return entry(*args, **kwargs)
+            except Exception as e:
+                self._degrade(f"AOT dispatch: {e}")
+                return self._jitted(*args, **kwargs)
+        try:
+            t0 = time.perf_counter()
+            lowered = self._jitted.lower(*args, **kwargs)
+            t1 = time.perf_counter()
+            compiled = lowered.compile()
+            t2 = time.perf_counter()
+        except Exception as e:
+            self._degrade(f"lower/compile: {e}")
+            return self._jitted(*args, **kwargs)
+        self._compiled[sig] = compiled
+        # budget enforcement may raise — record AFTER caching the program so
+        # a caller that catches RecompileBudgetExceeded can still proceed
+        self._monitor._record_compile(
+            self._name, self._group, sig, lower_ms=(t1 - t0) * 1e3,
+            compile_ms=(t2 - t1) * 1e3, compiled=compiled)
+        return compiled(*args, **kwargs)
+
+    def _degrade(self, why: str) -> None:
+        if not self._fallback:
+            self._fallback = True
+            logger.warning(f"compile monitor: program '{self._name}' fell "
+                           f"back to plain jit dispatch ({why})")
+
+
+class CompileMonitor:
+    """See module docstring. ``cfg`` is any object carrying the
+    :class:`CompileMonitorConfig` attributes; ``None`` or ``enabled: false``
+    yields a disabled monitor whose :meth:`jit` returns plain ``jax.jit``
+    objects and whose every other operation is a cheap no-op."""
+
+    def __init__(self, cfg=None, tracer=None):
+        self.cfg = cfg if cfg is not None else CompileMonitorConfig()
+        self.enabled = bool(getattr(self.cfg, "enabled", False))
+        self.warmup_signatures = max(
+            1, int(getattr(self.cfg, "warmup_signatures", 1) or 1))
+        self.recompile_budget = int(
+            getattr(self.cfg, "recompile_budget", 0) or 0)
+        self.on_budget = str(getattr(self.cfg, "on_budget", "warn") or "warn")
+        self.cost_analysis = bool(getattr(self.cfg, "cost_analysis", True))
+        self.tracer = tracer if tracer is not None else NULL_TRACER
+        self.stats: Dict[str, ProgramStats] = {}
+        self.unexpected_recompiles = 0
+        self._budget_tripped = False
+        self._lock = threading.Lock()
+        self._last_drain = time.monotonic()
+
+    # ------------------------------------------------------------------ #
+    def jit(self, name: str, fn: Callable, group: str = "Train",
+            **jit_kwargs):
+        """The shared registration helper: ``jax.jit(fn, **jit_kwargs)``,
+        wrapped for monitoring when enabled. Disabled → the exact jit object
+        (default program byte-identical)."""
+        jitted = jax.jit(fn, **jit_kwargs)
+        if not self.enabled:
+            return jitted
+        return self.wrap(name, jitted, group=group)
+
+    def wrap(self, name: str, jitted, group: str = "Train"):
+        """Wrap an already-jitted callable (for call sites that need jit
+        options the helper doesn't forward)."""
+        if not self.enabled:
+            return jitted
+        name = _NAME_SANITIZE.sub("_", name).lower() or "program"
+        with self._lock:
+            self.stats.setdefault(name, ProgramStats(name=name, group=group))
+        return MonitoredFunction(self, name, jitted, group)
+
+    # ------------------------------------------------------------------ #
+    def _record_hit(self, name: str) -> None:
+        with self._lock:
+            st = self.stats[name]
+            st.cache_hits += 1
+            st.calls_since_drain += 1
+
+    def _record_compile(self, name: str, group: str, sig, lower_ms: float,
+                        compile_ms: float, compiled) -> None:
+        flops = bytes_ = 0.0
+        if self.cost_analysis:
+            flops, bytes_ = _cost_analysis(compiled)
+        with self._lock:
+            st = self.stats[name]
+            recompile = len(st.signatures) >= 1
+            unexpected = len(st.signatures) >= self.warmup_signatures
+            st.signatures.append(sig)
+            st.compiles += 1
+            st.calls_since_drain += 1
+            st.recompiles += int(recompile)
+            st.lower_ms += lower_ms
+            st.compile_ms += compile_ms
+            if flops > 0:
+                st.cost_flops = flops
+            if bytes_ > 0:
+                st.cost_bytes = bytes_
+            if unexpected:
+                self.unexpected_recompiles += 1
+            over = (self.recompile_budget > 0 and not self._budget_tripped
+                    and self.unexpected_recompiles > self.recompile_budget)
+            if over:
+                self._budget_tripped = True
+        self.tracer.instant("compile", cat="compile", program=name,
+                            lower_ms=round(lower_ms, 3),
+                            compile_ms=round(compile_ms, 3),
+                            recompile=recompile)
+        if recompile:
+            logger.warning(
+                f"recompilation detected: program '{name}' compiled a new "
+                f"signature (#{len(st.signatures)}; {lower_ms:.1f}ms lower + "
+                f"{compile_ms:.1f}ms compile) — steady-state shapes should "
+                f"be stable")
+        if over:
+            msg = (f"recompile budget exhausted: {self.unexpected_recompiles}"
+                   f" unexpected recompiles > budget {self.recompile_budget}"
+                   f" (last: program '{name}') — a recompilation storm is "
+                   f"burning step time")
+            if self.on_budget == "raise":
+                raise RecompileBudgetExceeded(msg)
+            logger.warning(msg)
+
+    # ------------------------------------------------------------------ #
+    def program_flops(self, name: str) -> float:
+        st = self.stats.get(name)
+        return float(st.cost_flops) if st is not None else 0.0
+
+    def summary(self) -> Dict[str, Dict[str, float]]:
+        """Per-program accounting snapshot (tests, reports)."""
+        with self._lock:
+            return {n: {"compiles": st.compiles, "cache_hits": st.cache_hits,
+                        "recompiles": st.recompiles,
+                        "lower_ms": st.lower_ms, "compile_ms": st.compile_ms,
+                        "cost_flops": st.cost_flops,
+                        "cost_bytes": st.cost_bytes,
+                        "signatures": len(st.signatures)}
+                    for n, st in self.stats.items()}
+
+    def events(self, step: int = 0,
+               window_s: Optional[float] = None) -> List[Event]:
+        """Drain: cumulative ``Compile/*`` series plus per-program
+        ``<group>/mfu/<name>`` gauges attributing the calls executed since
+        the previous drain over ``window_s`` (the hub passes its measured
+        per-step time; serving drains default to the wall window). Resets
+        the per-drain call counters."""
+        if not self.enabled:
+            return []
+        now = time.monotonic()
+        events: List[Event] = []
+        peak_total = peak_flops_per_chip() * max(1, jax.device_count())
+        with self._lock:
+            window = float(window_s) if window_s and window_s > 0 \
+                else max(now - self._last_drain, 1e-9)
+            self._last_drain = now
+            tot = {"programs": 0, "compiles": 0, "cache_hits": 0,
+                   "recompiles": 0, "lower_ms": 0.0, "compile_ms": 0.0}
+            for name in sorted(self.stats):
+                st = self.stats[name]
+                tot["programs"] += 1
+                tot["compiles"] += st.compiles
+                tot["cache_hits"] += st.cache_hits
+                tot["recompiles"] += st.recompiles
+                tot["lower_ms"] += st.lower_ms
+                tot["compile_ms"] += st.compile_ms
+                events += [
+                    (f"Compile/{name}/compiles", float(st.compiles), step),
+                    (f"Compile/{name}/cache_hits", float(st.cache_hits),
+                     step),
+                    (f"Compile/{name}/recompiles", float(st.recompiles),
+                     step),
+                    (f"Compile/{name}/lower_ms", st.lower_ms, step),
+                    (f"Compile/{name}/compile_ms", st.compile_ms, step)]
+                if st.cost_flops > 0:
+                    events.append((f"Compile/{name}/cost_flops",
+                                   st.cost_flops, step))
+                if st.cost_bytes > 0:
+                    events.append((f"Compile/{name}/cost_bytes",
+                                   st.cost_bytes, step))
+                if st.cost_flops > 0 and st.calls_since_drain > 0:
+                    mfu = (st.cost_flops * st.calls_since_drain
+                           / (window * peak_total))
+                    events.append((f"{st.group}/mfu/{name}", mfu, step))
+                st.calls_since_drain = 0
+            for key in ("programs", "compiles", "cache_hits", "recompiles",
+                        "lower_ms", "compile_ms"):
+                events.append((f"Compile/total/{key}", float(tot[key]), step))
+        return events

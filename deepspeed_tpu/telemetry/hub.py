@@ -32,6 +32,8 @@ from ..utils.timer import (BACKWARD_GLOBAL_TIMER, BACKWARD_MICRO_TIMER,
                            FORWARD_GLOBAL_TIMER, FORWARD_MICRO_TIMER,
                            STEP_GLOBAL_TIMER, STEP_MICRO_TIMER,
                            TRAIN_BATCH_TIMER, SynchronizedWallClockTimer)
+from .anomaly import AnomalyDetector
+from .compile import CompileMonitor, peak_flops_per_chip
 from .memory import MemoryTelemetry
 from .profiler import ProfilerSession
 from .trace import Tracer
@@ -87,6 +89,20 @@ class TelemetryHub:
         # per-policy remat saved bytes — docs/performance.md); same contract
         # as serving_values, names validated against telemetry.schema
         self.train_values: Dict[str, float] = {}
+        # compile-aware perf explainability (docs/observability.md): the
+        # recompilation sentinel + per-program cost model the engines route
+        # their jitted entry points through, and the step-time anomaly
+        # detector step_end feeds. Both default OFF — a disabled monitor
+        # hands back plain jax.jit objects (default program byte-identical)
+        # and a disabled detector keeps no state.
+        tel = getattr(config, "telemetry", None)
+        self.compile = CompileMonitor(getattr(tel, "compile", None),
+                                      tracer=self.tracer)
+        self.anomaly = AnomalyDetector(getattr(tel, "anomaly", None))
+        # Compile/* counters + {Train,Serving}/mfu/* gauges (last drain) and
+        # Anomaly/* occurrence counts, for metrics_snapshot and tests
+        self.compile_values: Dict[str, float] = {}
+        self.anomaly_counts: Dict[str, int] = {}
 
     # ------------------------------------------------------------------ #
     def train_event(self, name: str, value: float, step: int = 0) -> None:
@@ -127,6 +143,103 @@ class TelemetryHub:
             self.monitor.write_events([(name, float(value), int(step))])
 
     # ------------------------------------------------------------------ #
+    def compile_event(self, name: str, value: float, step: int = 0) -> None:
+        """Fan out one ``Compile/*`` counter or ``{Train,Serving}/mfu/*``
+        gauge (CompileMonitor drains — the serving engine publishes through
+        here; the training side drains inside ``step_end``)."""
+        self.compile_values[name] = float(value)
+        if self.rank0 and self._monitor_on():
+            self.monitor.write_events([(name, float(value), int(step))])
+
+    # ------------------------------------------------------------------ #
+    def _compile_events(self, step: int,
+                        step_time_s: Optional[float]) -> List[Event]:
+        """Drain the compile monitor: cumulative ``Compile/*`` series plus
+        the per-program MFU attribution over the measured step time, the
+        ``Train/mfu/total`` rollup, and — when the ThroughputTimer has a
+        flops estimate — the ``Train/mfu/headline`` number the attribution
+        should sum to."""
+        events = self.compile.events(step, window_s=step_time_s)
+        if not events:
+            return []
+        # the analytic cost model doubles as the ThroughputTimer's flops
+        # source when the flops profiler didn't run
+        if self.tput_timer is not None and \
+                not getattr(self.tput_timer, "flops_per_step", None):
+            fl = max((st.cost_flops for st in self.compile.stats.values()
+                      if st.group == "Train"), default=0.0)
+            if fl > 0:
+                self.tput_timer.set_flops_per_step(fl)
+        mfu_total = sum(v for n, v, _ in events
+                        if n.startswith("Train/mfu/"))
+        if mfu_total > 0:
+            events.append(("Train/mfu/total", mfu_total, step))
+        if self.tput_timer is not None and \
+                getattr(self.tput_timer, "flops_per_step", None):
+            tf = self.tput_timer.avg_tflops_per_sec()
+            if tf > 0:
+                peak_total = peak_flops_per_chip() * \
+                    max(1, jax.device_count())
+                events.append(("Train/mfu/headline",
+                               tf * 1e12 / peak_total, step))
+        for n, v, _ in events:
+            self.compile_values[n] = float(v)
+        return events
+
+    # ------------------------------------------------------------------ #
+    def observe_step_anomalies(self, step: int,
+                               step_time_s: Optional[float] = None,
+                               phase_ms: Optional[Dict[str, float]] = None,
+                               _write: bool = True) -> List[Event]:
+        """Feed one step's timings to the anomaly detector; returns (and,
+        by default, writes) the ``Anomaly/*`` events any finding produced.
+        Fires the flight-recorder dump hook on findings when configured."""
+        if not self.anomaly.enabled:
+            return []
+        findings = []
+        if step_time_s:
+            findings += self.anomaly.observe("step_time",
+                                             float(step_time_s) * 1e3, step)
+        for key, ms in (phase_ms or {}).items():
+            findings += self.anomaly.observe(f"phase/{key}", ms, step)
+        findings += self._host_straggler_findings(step, step_time_s)
+        if not findings:
+            return []
+        events: List[Event] = []
+        for f in findings:
+            name = "Anomaly/" + f.series
+            self.anomaly_counts[name] = self.anomaly_counts.get(name, 0) + 1
+            events.append((name, float(f.value), step))
+            self.tracer.instant("anomaly", cat="anomaly", series=f.series,
+                                value=round(float(f.value), 4),
+                                detail=f.detail)
+            log_dist("anomaly: " + f.detail)
+        if self.anomaly.dump_flight_recorder and self.tracer.enabled:
+            self.trace_dump("anomaly")
+        if _write and self.rank0 and self._monitor_on():
+            self.monitor.write_events(events)
+        return events
+
+    def _host_straggler_findings(self, step: int,
+                                 step_time_s: Optional[float]) -> List:
+        """Multi-host straggler check: gather every host's step time and
+        flag outliers. Single-host (and any gather failure) is silent; the
+        synthetic path is ``anomaly.observe_hosts`` directly."""
+        if not step_time_s or self.anomaly.straggler_frac <= 0 or \
+                jax.process_count() <= 1:
+            return []
+        try:
+            import numpy as np
+            from jax.experimental import multihost_utils
+
+            times = np.asarray(multihost_utils.process_allgather(
+                np.float64(float(step_time_s) * 1e3))).ravel()
+            return self.anomaly.observe_hosts([float(t) for t in times],
+                                              step)
+        except Exception:
+            return []
+
+    # ------------------------------------------------------------------ #
     def trace_dump(self, reason: str) -> Optional[str]:
         """Dump the flight recorder (watchdog violation, crash path);
         returns the path written, or None when tracing is off/empty."""
@@ -134,18 +247,35 @@ class TelemetryHub:
             return None
         return self.tracer.dump(reason)
 
-    def metrics_snapshot(self) -> List[Tuple[str, float, str]]:
-        """``(event_name, value, kind)`` rows for the pull-based metrics
-        endpoint (telemetry/metrics_server.py): Reliability/* occurrence
-        counts as counters, Serving/* values as gauges, plus the flight
-        recorder's occupancy."""
-        rows: List[Tuple[str, float, str]] = []
+    def metrics_snapshot(self) -> List[Tuple]:
+        """``(event_name, value, kind[, labels])`` rows for the pull-based
+        metrics endpoint (telemetry/metrics_server.py): Reliability/* and
+        Anomaly/* occurrence counts as counters, Serving/* values as gauges,
+        per-program Compile/* counters and MFU gauges carrying a
+        ``program=`` label, plus the flight recorder's occupancy."""
+        rows: List[Tuple] = []
         for name, count in sorted(self.reliability_counts.items()):
             rows.append((name, float(count), "counter"))
         for name, value in sorted(self.serving_values.items()):
             rows.append((name, float(value), "gauge"))
         for name, value in sorted(self.train_values.items()):
             rows.append((name, float(value), "gauge"))
+        for name, count in sorted(self.anomaly_counts.items()):
+            rows.append((name, float(count), "counter"))
+        for name, value in sorted(self.compile_values.items()):
+            parts = name.split("/")
+            if name.startswith("Compile/total/"):
+                rows.append((name, float(value), "counter"))
+            elif name.startswith("Compile/") and len(parts) == 3:
+                # per-program series fold onto one metric with a program
+                # label — the Prometheus-native shape for open program sets
+                rows.append((f"Compile/{parts[2]}", float(value), "counter",
+                             {"program": parts[1]}))
+            elif len(parts) == 3 and parts[1] == "mfu":
+                rows.append((f"{parts[0]}/mfu", float(value), "gauge",
+                             {"program": parts[2]}))
+            else:
+                rows.append((name, float(value), "gauge"))
         if self.tracer.enabled:
             rows.append(("Telemetry/trace/ring_events",
                          float(len(self.tracer)), "gauge"))
@@ -177,6 +307,7 @@ class TelemetryHub:
         events: List[Event] = []
         mon_on = self._monitor_on()
         breakdown = self.wall_clock_breakdown
+        phase_ms: Dict[str, float] = {}
 
         if breakdown:
             # drain (and reset) the phase timers whether or not a monitor
@@ -192,6 +323,7 @@ class TelemetryHub:
                     if ms == 0.0 and name not in core:
                         continue
                     events.append((f"Train/Step/{key}_ms", ms, step))
+                    phase_ms[key] = ms
 
         if mon_on or breakdown:
             if self.comms.enabled:
@@ -203,6 +335,13 @@ class TelemetryHub:
                 tf = self.tput_timer.avg_tflops_per_sec()
                 if tf > 0:
                     events.append(("Train/Step/tflops", tf, step))
+
+        if self.compile.enabled:
+            events += self._compile_events(step, step_time_s)
+        if self.anomaly.enabled:
+            # written below with the rest of this step's events
+            events += self.observe_step_anomalies(step, step_time_s,
+                                                  phase_ms, _write=False)
 
         spp = int(getattr(self.cfg, "steps_per_print", 0) or 0)
         if spp and step % spp == 0:

@@ -3,14 +3,17 @@
 The monitor backends PUSH events to files/SDKs; external watchers (a
 ``tpu_watch.sh``-style prober, a fleet dashboard, ``curl`` during an
 incident) want to PULL live state instead. :class:`MetricsServer` serves the
-TelemetryHub's counters and gauges — ``Reliability/*`` counts,
-``Serving/*`` gauges (prefix-cache counters, latency SLO percentiles), and
-the flight-recorder occupancy — as Prometheus exposition text on
-``GET /metrics``, plus a trivial ``GET /healthz``.
+TelemetryHub's counters and gauges — ``Reliability/*`` and ``Anomaly/*``
+counts, ``Serving/*`` gauges (prefix-cache counters, latency SLO
+percentiles), per-program ``Compile/*`` counters and MFU-attribution gauges
+(``program=`` labels), and the flight-recorder occupancy — as Prometheus
+exposition text on ``GET /metrics``, plus a trivial ``GET /healthz``.
 
 stdlib-only (`http.server` on a daemon thread); binds 127.0.0.1 by default
 and ``port=0`` picks a free port (tests, multi-job hosts). Any object with a
-``metrics_snapshot() -> [(event_name, value, kind)]`` works as the source.
+``metrics_snapshot() -> [(event_name, value, kind[, labels])]`` works as the
+source; the optional 4th element is a ``{label: value}`` dict rendered as
+``name{label="value"}`` with spec-compliant escaping.
 """
 
 from __future__ import annotations
@@ -18,9 +21,10 @@ from __future__ import annotations
 import http.server
 import re
 import threading
-from typing import List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
-__all__ = ["MetricsServer", "prometheus_name", "render_prometheus"]
+__all__ = ["MetricsServer", "prometheus_name", "escape_label_value",
+           "render_prometheus"]
 
 _SANITIZE = re.compile(r"[^a-zA-Z0-9_]")
 
@@ -32,19 +36,44 @@ def prometheus_name(event_name: str) -> str:
     return "dstpu_" + _SANITIZE.sub("_", event_name).lower().strip("_")
 
 
-def render_prometheus(snapshot: List[Tuple[str, float, str]]) -> str:
-    """Prometheus text exposition (v0.0.4) from ``(name, value, kind)``
-    rows; kind is ``counter`` or ``gauge``."""
+def escape_label_value(value: str) -> str:
+    """Prometheus label-value escaping: backslash, double-quote, and
+    newline must be escaped or a hostile value (a program name, a path)
+    silently corrupts the whole exposition."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _escape_help(text: str) -> str:
+    """HELP-line escaping (backslash and newline per the text format)."""
+    return str(text).replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _render_labels(labels: Optional[Dict[str, str]]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(
+        f'{_SANITIZE.sub("_", str(k))}="{escape_label_value(v)}"'
+        for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+def render_prometheus(snapshot: List[Tuple]) -> str:
+    """Prometheus text exposition (v0.0.4) from ``(name, value, kind)`` or
+    ``(name, value, kind, labels)`` rows; kind is ``counter`` or
+    ``gauge``."""
     lines: List[str] = []
     seen_type = set()
-    for name, value, kind in snapshot:
+    for row in snapshot:
+        name, value, kind = row[0], row[1], row[2]
+        labels = row[3] if len(row) > 3 else None
         pname = prometheus_name(name)
         if pname not in seen_type:
             seen_type.add(pname)
-            lines.append(f"# HELP {pname} {name}")
+            lines.append(f"# HELP {pname} {_escape_help(name)}")
             lines.append(f"# TYPE {pname} "
                          f"{'counter' if kind == 'counter' else 'gauge'}")
-        lines.append(f"{pname} {float(value):g}")
+        lines.append(f"{pname}{_render_labels(labels)} {float(value):g}")
     lines.append("")
     return "\n".join(lines)
 

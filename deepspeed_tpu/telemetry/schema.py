@@ -28,6 +28,15 @@ Checked invariants:
   come from ``COMM_METRICS`` and the ``Comm/total/*`` rollup family from
   ``COMM_TOTAL_SERIES`` — a typo'd byte-accounting suffix (which the
   ``--comm-efficiency`` report would silently drop) fails validation.
+- ``Compile/*`` names follow the same shape: program names are open-ended
+  (any entry point registered with the CompileMonitor), but the metric
+  suffix must come from ``COMPILE_METRICS`` and the ``Compile/total/*``
+  rollup family from ``COMPILE_TOTAL_SERIES``;
+- ``Anomaly/*`` names come from the CLOSED ``ANOMALY_SERIES`` registry (the
+  step-time/per-phase spike+drift series and the per-host straggler);
+- ``Train/mfu/*`` and ``Serving/mfu/*`` carry one lowercase snake_case
+  program segment (``MFU_SEGMENT_RE``) — the per-program MFU attribution
+  gauges, plus the ``total``/``headline`` rollups.
 """
 
 from __future__ import annotations
@@ -38,6 +47,8 @@ from typing import Any, Dict, Iterable, List, Tuple
 
 __all__ = ["EVENT_NAME_RE", "SERVING_SERIES", "TRAIN_SERIES",
            "COMM_METRICS", "COMM_TOTAL_SERIES",
+           "COMPILE_METRICS", "COMPILE_TOTAL_SERIES", "ANOMALY_SERIES",
+           "MFU_SEGMENT_RE", "ANOMALY_PHASES",
            "REMAT_POLICIES", "validate_events", "validate_jsonl_records"]
 
 EVENT_NAME_RE = re.compile(r"^[A-Z][A-Za-z0-9_]*(/[A-Za-z0-9_.\-]+)+$")
@@ -105,6 +116,38 @@ COMM_TOTAL_SERIES = frozenset(
         "est_comm_frac"))
 
 
+# Registered Compile/* metrics (telemetry/compile.py CompileMonitor.events):
+# per-program series are Compile/<program>/<metric> with an OPEN program
+# namespace (any jitted entry point registered with the monitor) but a
+# CLOSED metric set; the Compile/total/* rollup family is fully enumerated.
+COMPILE_METRICS = frozenset((
+    "compiles", "cache_hits", "recompiles", "lower_ms", "compile_ms",
+    "cost_flops", "cost_bytes"))
+COMPILE_TOTAL_SERIES = frozenset(
+    "Compile/total/" + m for m in (
+        "programs", "compiles", "cache_hits", "recompiles", "lower_ms",
+        "compile_ms"))
+
+# The phase keys the hub's step-breakdown timers can emit (hub._STEP_TIMERS
+# event suffixes) — the anomaly detector tracks one series per phase.
+ANOMALY_PHASES = ("fwd", "bwd", "step", "train_batch", "fwd_micro",
+                  "bwd_micro", "step_micro", "eval")
+
+# Registered Anomaly/* series (telemetry/anomaly.py via the hub): CLOSED —
+# an emitted-but-unregistered anomaly name fails tier-1 validation.
+ANOMALY_SERIES = frozenset(
+    [f"Anomaly/step_time/{k}" for k in ("spike", "drift")]
+    + [f"Anomaly/phase/{p}/{k}" for p in ANOMALY_PHASES
+       for k in ("spike", "drift")]
+    + ["Anomaly/host/straggler"])
+
+# Per-program MFU attribution gauges (Train/mfu/<program>,
+# Serving/mfu/<program>, plus the total/headline rollups): the program
+# segment is open-ended but must be one lowercase snake_case token — the
+# CompileMonitor sanitizes registered names onto this grammar.
+MFU_SEGMENT_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+
+
 def validate_events(events: Iterable[Tuple[str, float, int]]) -> List[str]:
     """Check ``(name, value, step)`` triples against the schema; returns a
     list of human-readable problems (empty = clean)."""
@@ -121,7 +164,15 @@ def validate_events(events: Iterable[Tuple[str, float, int]]) -> List[str]:
             problems.append(f"event #{i}: name {name!r} violates the "
                             f"Group/.../metric convention")
             continue
-        if name.startswith("Serving/") and name not in SERVING_SERIES:
+        if name.startswith(("Train/mfu/", "Serving/mfu/")):
+            seg = name.split("/", 2)[2]
+            if "/" in seg or not MFU_SEGMENT_RE.match(seg):
+                problems.append(
+                    f"event #{i}: mfu series {name!r} does not carry one "
+                    f"snake_case program segment "
+                    f"(telemetry.schema.MFU_SEGMENT_RE)")
+                continue
+        elif name.startswith("Serving/") and name not in SERVING_SERIES:
             problems.append(f"event #{i}: serving series {name!r} is not "
                             f"registered in telemetry.schema.SERVING_SERIES")
             continue
@@ -130,6 +181,24 @@ def validate_events(events: Iterable[Tuple[str, float, int]]) -> List[str]:
             problems.append(f"event #{i}: train series {name!r} is not "
                             f"registered in telemetry.schema.TRAIN_SERIES")
             continue
+        if name.startswith("Anomaly/") and name not in ANOMALY_SERIES:
+            problems.append(f"event #{i}: anomaly series {name!r} is not "
+                            f"registered in telemetry.schema.ANOMALY_SERIES")
+            continue
+        if name.startswith("Compile/total/"):
+            if name not in COMPILE_TOTAL_SERIES:
+                problems.append(
+                    f"event #{i}: compile rollup series {name!r} is not "
+                    f"registered in telemetry.schema.COMPILE_TOTAL_SERIES")
+                continue
+        elif name.startswith("Compile/"):
+            parts = name.split("/")
+            if len(parts) != 3 or parts[2] not in COMPILE_METRICS:
+                problems.append(
+                    f"event #{i}: compile series {name!r} is not a "
+                    f"Compile/<program>/<metric> name with a metric from "
+                    f"telemetry.schema.COMPILE_METRICS")
+                continue
         if name.startswith("Comm/total/"):
             if name not in COMM_TOTAL_SERIES:
                 problems.append(

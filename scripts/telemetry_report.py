@@ -12,7 +12,9 @@ Usage: python scripts/telemetry_report.py runs/job/events.jsonl [--last N]
 from __future__ import annotations
 
 import argparse
+import importlib.util
 import json
+import os
 import sys
 from collections import OrderedDict
 from typing import Dict, List
@@ -209,6 +211,152 @@ def _overlap_remat_sections(events: List[dict]) -> List[str]:
                 lines.append(f"  {pol:<22} {saved:>14} {peak:>14} "
                              f"{step:>10}")
     return lines
+
+
+def compile_report(events: List[dict]) -> str:
+    """``--compile``: recompilation-sentinel counters per jitted program
+    (compiles, cache hits, RECOMPILES, lowering/compile wall time, analytic
+    cost-model flops) from the ``Compile/*`` stream, plus the per-program
+    MFU attribution from ``Train/mfu/*`` / ``Serving/mfu/*`` — the
+    decomposition of the ThroughputTimer headline (docs/observability.md).
+    Cumulative counters and gauges: last sample per series wins."""
+    comp = [e for e in events if e["name"].startswith("Compile/")]
+    mfu = [e for e in events
+           if e["name"].startswith(("Train/mfu/", "Serving/mfu/"))]
+    if not comp and not mfu:
+        return "compile: no Compile/* or */mfu/* events in this file"
+    lines: List[str] = []
+    if comp:
+        per: Dict[str, Dict[str, float]] = {}
+        for e in comp:
+            _, prog, metric = e["name"].split("/", 2)
+            per.setdefault(prog, {})[metric] = e["value"]   # last wins
+        tot = per.pop("total", {})
+        lines.append(f"compile report ({len(comp)} events)")
+        lines.append(f"  {'program':<18} {'compiles':>8} {'hits':>8} "
+                     f"{'recompiles':>10} {'compile ms':>11} "
+                     f"{'cost flops':>12}")
+        for prog in sorted(per):
+            m = per[prog]
+            fl = m.get("cost_flops", 0.0)
+            fl_s = f"{fl:>12.3e}" if fl else f"{'-':>12}"
+            lines.append(
+                f"  {prog:<18} {int(m.get('compiles', 0)):>8} "
+                f"{int(m.get('cache_hits', 0)):>8} "
+                f"{int(m.get('recompiles', 0)):>10} "
+                f"{m.get('compile_ms', 0.0):>11.1f} {fl_s}")
+        lines.append("")
+        recompiles = int(tot.get("recompiles", 0))
+        lines.append(f"  programs:               "
+                     f"{int(tot.get('programs', len(per)))}")
+        lines.append(f"  total compiles:         "
+                     f"{int(tot.get('compiles', 0))}")
+        lines.append(f"  total recompiles:       {recompiles}"
+                     + ("  <-- recompilation storm suspect"
+                        if recompiles > int(tot.get("programs", 0)) else ""))
+        lines.append(f"  compile wall time:      "
+                     f"{tot.get('compile_ms', 0.0) / 1e3:.2f} s "
+                     f"(+ {tot.get('lower_ms', 0.0) / 1e3:.2f} s lowering)")
+    if mfu:
+        last: Dict[str, float] = {}
+        for e in mfu:
+            last[e["name"]] = e["value"]                     # last wins
+        if lines:
+            lines.append("")
+        lines.append("per-program MFU attribution (fraction of peak)")
+        total = last.pop("Train/mfu/total", None)
+        headline = last.pop("Train/mfu/headline", None)
+        for name in sorted(last):
+            prog = name.split("/", 2)[2]
+            group = name.split("/", 1)[0].lower()
+            lines.append(f"  {group + '/' + prog:<26} {last[name]:>8.4f}")
+        if total is not None:
+            lines.append(f"  {'TOTAL (attributed)':<26} {total:>8.4f}")
+        if headline is not None:
+            lines.append(f"  {'ThroughputTimer headline':<26} "
+                         f"{headline:>8.4f}")
+        if total and headline:
+            lines.append(f"  attribution covers      "
+                         f"{total / headline * 100:.1f}% of the headline")
+    return "\n".join(lines)
+
+
+def _load_anomaly_module():
+    """Load ``deepspeed_tpu/telemetry/anomaly.py`` by file path (it is
+    stdlib-only) so the offline replay needs no jax/numpy import; None when
+    the report runs detached from the repo tree."""
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "deepspeed_tpu", "telemetry", "anomaly.py")
+    try:
+        spec = importlib.util.spec_from_file_location("_dstpu_anomaly", path)
+        mod = importlib.util.module_from_spec(spec)
+        # dataclass construction resolves string annotations through
+        # sys.modules — a by-path module must be registered first
+        sys.modules["_dstpu_anomaly"] = mod
+        spec.loader.exec_module(mod)
+        return mod
+    except Exception:
+        sys.modules.pop("_dstpu_anomaly", None)
+        return None
+
+
+def anomalies(events: List[dict]) -> str:
+    """``--anomalies``: live ``Anomaly/*`` findings recorded by the hub's
+    detector (spikes, drift, stragglers — count, worst excess, last step),
+    plus an OFFLINE replay of the same rolling-median/MAD detector over the
+    file's ``Train/Step/*_ms`` series, so a run recorded without the
+    detector enabled can still be screened post-hoc."""
+    rec = [e for e in events if e["name"].startswith("Anomaly/")]
+    lines: List[str] = []
+    if rec:
+        per: Dict[str, Dict[str, float]] = {}
+        for e in rec:
+            d = per.setdefault(e["name"][len("Anomaly/"):],
+                               {"count": 0, "worst": 0.0, "last_step": 0})
+            d["count"] += 1
+            d["worst"] = max(d["worst"], float(e["value"]))
+            d["last_step"] = max(d["last_step"], int(e.get("step", 0)))
+        lines.append(f"anomaly report ({len(rec)} recorded findings)")
+        lines.append(f"  {'finding':<28} {'count':>6} {'worst excess':>13} "
+                     f"{'last step':>10}")
+        for key in sorted(per):
+            d = per[key]
+            lines.append(f"  {key:<28} {d['count']:>6} "
+                         f"{d['worst'] * 100:>12.0f}% {d['last_step']:>10}")
+    else:
+        lines.append("anomaly report: no recorded Anomaly/* findings")
+    mod = _load_anomaly_module()
+    phase = OrderedDict()
+    for e in events:
+        n = e["name"]
+        if n.startswith("Train/Step/") and n.endswith("_ms"):
+            phase.setdefault(n[len("Train/Step/"):-len("_ms")],
+                             []).append(e)
+    if mod is None:
+        lines.append("  (offline replay unavailable: telemetry/anomaly.py "
+                     "not found next to this script)")
+        return "\n".join(lines)
+    if not phase:
+        lines.append("  (no Train/Step/*_ms series to replay — record with "
+                     "wall_clock_breakdown: true)")
+        return "\n".join(lines)
+    det = mod.AnomalyDetector(mod.AnomalyConfig(enabled=True))
+    findings = []
+    for key, recs in phase.items():
+        series = "step_time" if key == "train_batch" else f"phase/{key}"
+        for r in recs:
+            findings += det.observe(series, float(r["value"]),
+                                    int(r.get("step", 0)))
+    n_samples = sum(len(v) for v in phase.values())
+    lines.append("")
+    lines.append(f"offline replay over {len(phase)} step-time series "
+                 f"({n_samples} samples): {len(findings)} finding(s)")
+    for f in findings[:20]:
+        lines.append(f"  [{f.series}] {f.detail}")
+    if len(findings) > 20:
+        lines.append(f"  ... {len(findings) - 20} more")
+    return "\n".join(lines)
 
 
 def reliability(events: List[dict]) -> str:
@@ -542,12 +690,23 @@ def main(argv=None) -> int:
     ap.add_argument("--latency", action="store_true",
                     help="summarize Serving/latency/* SLO percentiles: "
                          "TTFT / inter-token / queue / e2e p50-p90-p99")
+    ap.add_argument("--compile", action="store_true", dest="compile_",
+                    help="summarize Compile/* recompilation-sentinel "
+                         "counters (compiles, cache hits, recompiles, "
+                         "compile wall time) and the per-program MFU "
+                         "attribution from Train/mfu/* + Serving/mfu/*")
+    ap.add_argument("--anomalies", action="store_true",
+                    help="summarize recorded Anomaly/* findings (spikes, "
+                         "drift, stragglers) and replay the rolling-median/"
+                         "MAD detector offline over the Train/Step/*_ms "
+                         "series")
     ap.add_argument("--trace", metavar="TRACE_JSON",
                     help="summarize a Chrome-trace/Perfetto JSON flight-"
                          "recorder dump (span durations, slowest spans)")
     ap.add_argument("--all", action="store_true",
                     help="run every section (summary, comm efficiency, "
-                         "reliability, serving, latency) in one pass")
+                         "reliability, serving, latency, compile, "
+                         "anomalies) in one pass")
     args = ap.parse_args(argv)
     if args.trace:
         try:
@@ -571,8 +730,15 @@ def main(argv=None) -> int:
         return 1
     if args.all:
         sections = [summarize(events, last=args.last), comm_efficiency(events),
-                    reliability(events), serving(events), latency(events)]
+                    reliability(events), serving(events), latency(events),
+                    compile_report(events), anomalies(events)]
         print("\n\n".join(sections))
+        return 0
+    if args.compile_:
+        print(compile_report(events))
+        return 0
+    if args.anomalies:
+        print(anomalies(events))
         return 0
     if args.comm_efficiency:
         print(comm_efficiency(events))

@@ -142,6 +142,15 @@ while true; do
         CYCLE_OK=0
         echo "[watch] $bts bench rc=$rc NOT promoted" >> "$LOG"
       fi
+      # step-time regression probe (compile-aware perf explainability):
+      # compare the fresh capture against the newest checked-in
+      # BENCH_r*.json. NON-FATAL by design — a flagged regression logs a
+      # row for the round driver but never gates CYCLE_OK or promotion.
+      if python bench.py --regression-only "bench_runs/BENCH_tpu_${bts}.json" >> "$LOG" 2>&1; then
+        echo "[watch] $bts REGRESSION probe ok" >> "$LOG"
+      else
+        echo "[watch] $bts REGRESSION probe FLAGGED step-time regression (non-fatal)" >> "$LOG"
+      fi
     fi
     hold_requested || run_probe QUANT scripts/quant_linear_bench.py 1200 QUANT_TPU_LIVE.json
     # attention block sweep LAST: it may write .dstpu_tuned.json, which the
