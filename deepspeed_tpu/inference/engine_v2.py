@@ -1283,9 +1283,11 @@ class InferenceEngineV2(InferenceEngine):
         paged program (prefill/decode/verify families: compiles, cache
         hits, RECOMPILES, lower/compile wall time, cost-model flops) plus
         ``Serving/mfu/<program>`` attribution gauges over the wall window
-        since the previous drain. Names are registered in
-        ``telemetry/schema.py``."""
-        return self.compile_monitor.events(step)
+        since this caller's previous drain. The drain is scoped to the
+        ``Serving`` group so a hub-shared monitor keeps its training-side
+        counters and step-time windows intact (and vice versa). Names are
+        registered in ``telemetry/schema.py``."""
+        return self.compile_monitor.events(step, group="Serving")
 
     def publish_compile_telemetry(self, step: int = 0):
         events = self.compile_events(step)

@@ -209,7 +209,14 @@ class MonitoredFunction:
             self._monitor._record_hit(self._name)
             try:
                 return entry(*args, **kwargs)
-            except Exception as e:
+            except (TypeError, ValueError) as e:
+                # argument/signature mismatches the AOT executable raises
+                # BEFORE execution starts — safe to degrade and re-dispatch
+                # (donated buffers are untouched). Runtime execution errors
+                # (XLA OOM, nan-checks, io_callback failures) propagate: a
+                # silent re-execution would mask the failure, double-run
+                # side effects, and with donated inputs already consumed
+                # die with a confusing secondary error instead.
                 self._degrade(f"AOT dispatch: {e}")
                 return self._jitted(*args, **kwargs)
         try:
@@ -256,7 +263,13 @@ class CompileMonitor:
         self.unexpected_recompiles = 0
         self._budget_tripped = False
         self._lock = threading.Lock()
-        self._last_drain = time.monotonic()
+        # per-caller drain timestamps and first-dispatch marks, keyed by
+        # event group ('' = an unscoped drain over every group). A drain's
+        # first wall window is anchored at the group's first POST-compile
+        # dispatch, not monitor construction — engine setup and compile
+        # wall time must not dilute the first MFU window.
+        self._last_drain: Dict[str, float] = {}
+        self._dispatch_t0: Dict[str, float] = {}
 
     # ------------------------------------------------------------------ #
     def jit(self, name: str, fn: Callable, group: str = "Train",
@@ -285,6 +298,7 @@ class CompileMonitor:
             st = self.stats[name]
             st.cache_hits += 1
             st.calls_since_drain += 1
+            self._dispatch_t0.setdefault(st.group, time.monotonic())
 
     def _record_compile(self, name: str, group: str, sig, lower_ms: float,
                         compile_ms: float, compiled) -> None:
@@ -311,6 +325,9 @@ class CompileMonitor:
                     and self.unexpected_recompiles > self.recompile_budget)
             if over:
                 self._budget_tripped = True
+            # _record_compile runs after lower+compile finished, so this
+            # marks the start of the group's executed window
+            self._dispatch_t0.setdefault(group, time.monotonic())
         self.tracer.instant("compile", cat="compile", program=name,
                             lower_ms=round(lower_ms, 3),
                             compile_ms=round(compile_ms, 3),
@@ -346,22 +363,39 @@ class CompileMonitor:
                         "signatures": len(st.signatures)}
                     for n, st in self.stats.items()}
 
-    def events(self, step: int = 0,
-               window_s: Optional[float] = None) -> List[Event]:
+    def events(self, step: int = 0, window_s: Optional[float] = None,
+               group: Optional[str] = None) -> List[Event]:
         """Drain: cumulative ``Compile/*`` series plus per-program
         ``<group>/mfu/<name>`` gauges attributing the calls executed since
-        the previous drain over ``window_s`` (the hub passes its measured
-        per-step time; serving drains default to the wall window). Resets
-        the per-drain call counters."""
+        THIS CALLER's previous drain over ``window_s`` (the hub passes its
+        measured per-step time; serving drains default to the wall window).
+
+        ``group`` scopes the drain to one event group: a hub-shared monitor
+        is drained by both the training hub (``group='Train'``, step-time
+        window) and the serving engine (``group='Serving'``, wall window),
+        and per-group call counters + drain timestamps keep the two
+        attributions independent — an unscoped drain over a shared monitor
+        would attribute serving calls over the train-step window (and vice
+        versa). ``Compile/total/*`` stays cumulative over EVERY program
+        regardless of the filter: one monotone series whichever caller
+        drains."""
         if not self.enabled:
             return []
         now = time.monotonic()
         events: List[Event] = []
         peak_total = peak_flops_per_chip() * max(1, jax.device_count())
+        gkey = group if group is not None else ""
         with self._lock:
+            last = self._last_drain.get(gkey)
+            if last is None:
+                # first drain for this caller: anchor the wall window at the
+                # group's first post-compile dispatch (see _dispatch_t0)
+                t0s = [t for g, t in self._dispatch_t0.items()
+                       if group is None or g == group]
+                last = min(t0s) if t0s else now
+            self._last_drain[gkey] = now
             window = float(window_s) if window_s and window_s > 0 \
-                else max(now - self._last_drain, 1e-9)
-            self._last_drain = now
+                else max(now - last, 1e-9)
             tot = {"programs": 0, "compiles": 0, "cache_hits": 0,
                    "recompiles": 0, "lower_ms": 0.0, "compile_ms": 0.0}
             for name in sorted(self.stats):
@@ -372,6 +406,8 @@ class CompileMonitor:
                 tot["recompiles"] += st.recompiles
                 tot["lower_ms"] += st.lower_ms
                 tot["compile_ms"] += st.compile_ms
+                if group is not None and st.group != group:
+                    continue
                 events += [
                     (f"Compile/{name}/compiles", float(st.compiles), step),
                     (f"Compile/{name}/cache_hits", float(st.cache_hits),

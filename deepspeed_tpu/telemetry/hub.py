@@ -159,7 +159,8 @@ class TelemetryHub:
         ``Train/mfu/total`` rollup, and — when the ThroughputTimer has a
         flops estimate — the ``Train/mfu/headline`` number the attribution
         should sum to."""
-        events = self.compile.events(step, window_s=step_time_s)
+        events = self.compile.events(step, window_s=step_time_s,
+                                     group="Train")
         if not events:
             return []
         # the analytic cost model doubles as the ThroughputTimer's flops
@@ -190,10 +191,15 @@ class TelemetryHub:
     def observe_step_anomalies(self, step: int,
                                step_time_s: Optional[float] = None,
                                phase_ms: Optional[Dict[str, float]] = None,
+                               host_times: Optional[List[float]] = None,
                                _write: bool = True) -> List[Event]:
         """Feed one step's timings to the anomaly detector; returns (and,
         by default, writes) the ``Anomaly/*`` events any finding produced.
-        Fires the flight-recorder dump hook on findings when configured."""
+        Fires the flight-recorder dump hook on findings when configured.
+        ``host_times`` is the per-host step-time vector (ms) from
+        ``_gather_host_step_times`` — gathered by ``step_end`` on every
+        process BEFORE its rank-0 gate, since the gather is a collective;
+        this method itself never communicates."""
         if not self.anomaly.enabled:
             return []
         findings = []
@@ -202,7 +208,8 @@ class TelemetryHub:
                                              float(step_time_s) * 1e3, step)
         for key, ms in (phase_ms or {}).items():
             findings += self.anomaly.observe(f"phase/{key}", ms, step)
-        findings += self._host_straggler_findings(step, step_time_s)
+        if host_times:
+            findings += self.anomaly.observe_hosts(host_times, step)
         if not findings:
             return []
         events: List[Event] = []
@@ -220,24 +227,27 @@ class TelemetryHub:
             self.monitor.write_events(events)
         return events
 
-    def _host_straggler_findings(self, step: int,
-                                 step_time_s: Optional[float]) -> List:
-        """Multi-host straggler check: gather every host's step time and
-        flag outliers. Single-host (and any gather failure) is silent; the
-        synthetic path is ``anomaly.observe_hosts`` directly."""
-        if not step_time_s or self.anomaly.straggler_frac <= 0 or \
+    def _gather_host_step_times(
+            self, step_time_s: Optional[float]) -> Optional[List[float]]:
+        """Gather every host's step time (ms) for the straggler check.
+        ``process_allgather`` is a COLLECTIVE requiring all processes, so
+        ``step_end`` calls this on EVERY rank before its rank-0 gate —
+        outlier detection itself runs on rank 0 only. Single-host, disabled
+        detector, and gather failure all return None; the synthetic path is
+        ``anomaly.observe_hosts`` directly."""
+        if not step_time_s or not self.anomaly.enabled or \
+                self.anomaly.straggler_frac <= 0 or \
                 jax.process_count() <= 1:
-            return []
+            return None
         try:
             import numpy as np
             from jax.experimental import multihost_utils
 
             times = np.asarray(multihost_utils.process_allgather(
                 np.float64(float(step_time_s) * 1e3))).ravel()
-            return self.anomaly.observe_hosts([float(t) for t in times],
-                                              step)
+            return [float(t) for t in times]
         except Exception:
-            return []
+            return None
 
     # ------------------------------------------------------------------ #
     def trace_dump(self, reason: str) -> Optional[str]:
@@ -272,8 +282,15 @@ class TelemetryHub:
                 rows.append((f"Compile/{parts[2]}", float(value), "counter",
                              {"program": parts[1]}))
             elif len(parts) == 3 and parts[1] == "mfu":
-                rows.append((f"{parts[0]}/mfu", float(value), "gauge",
-                             {"program": parts[2]}))
+                if parts[2] in ("total", "headline"):
+                    # the rollups stay distinct unlabeled metrics
+                    # (dstpu_train_mfu_total/_headline) — folded into the
+                    # program label they'd double-count any Prometheus
+                    # aggregation over the per-program gauges
+                    rows.append((name, float(value), "gauge"))
+                else:
+                    rows.append((f"{parts[0]}/mfu", float(value), "gauge",
+                                 {"program": parts[2]}))
             else:
                 rows.append((name, float(value), "gauge"))
         if self.tracer.enabled:
@@ -302,6 +319,10 @@ class TelemetryHub:
         from every enabled source, writes them through the monitor, emits the
         periodic log summaries, and advances the profiler window. Returns the
         events (for tests and callers that want them)."""
+        # the straggler gather is a collective over every process — it must
+        # run before the rank-0 gate or the first monitored step on a
+        # multi-process job deadlocks waiting for the non-zero ranks
+        host_times = self._gather_host_step_times(step_time_s)
         if not self.rank0:
             return []
         events: List[Event] = []
@@ -341,7 +362,9 @@ class TelemetryHub:
         if self.anomaly.enabled:
             # written below with the rest of this step's events
             events += self.observe_step_anomalies(step, step_time_s,
-                                                  phase_ms, _write=False)
+                                                  phase_ms,
+                                                  host_times=host_times,
+                                                  _write=False)
 
         spp = int(getattr(self.cfg, "steps_per_print", 0) or 0)
         if spp and step % spp == 0:

@@ -187,6 +187,66 @@ def test_cost_analysis_fallback():
     assert not any("/mfu/" in n for n, _, _ in mon.events())
 
 
+def test_runtime_errors_propagate_dispatch_errors_degrade():
+    """The cached-program call path must NOT swallow runtime execution
+    failures (XLA OOM, nan-checks, io_callback errors) — a silent re-run
+    via plain jit would mask the failure and double-execute side effects.
+    Only pre-dispatch signature mismatches (TypeError/ValueError) degrade
+    to the fallback path."""
+    mon = CompileMonitor(CompileMonitorConfig(enabled=True))
+    f = mon.jit("r", lambda a: a * 2)
+    x = jnp.ones((4,))
+    f(x)                                   # compile + cache the program
+    sig = next(iter(f._compiled))
+
+    class _Boom:
+        def __init__(self, exc):
+            self.exc = exc
+
+        def __call__(self, *a, **k):
+            raise self.exc
+
+    f._compiled[sig] = _Boom(RuntimeError("RESOURCE_EXHAUSTED: OOM"))
+    with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+        f(x)
+    assert not f._fallback                 # no silent re-execution
+    f._compiled[sig] = _Boom(TypeError("argument mismatch"))
+    out = f(x)                             # pre-dispatch error → fall back
+    assert f._fallback
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(x * 2))
+
+
+def test_shared_monitor_group_scoped_drains():
+    """A hub-shared monitor is drained by BOTH the training hub (Train
+    group, step-time window) and the serving engine (Serving group, wall
+    window): each drain must only emit and reset its own group, or the
+    interleaving corrupts both attributions. Compile/total/* stays
+    cumulative over every program whichever caller drains."""
+    mon = CompileMonitor(CompileMonitorConfig(enabled=True))
+    tr = mon.jit("train_step", lambda a, b: a @ b, group="Train")
+    sv = mon.jit("decode", lambda a, b: a @ b + 1, group="Serving")
+    x = jnp.ones((16, 16))
+    tr(x, x)
+    tr(x, x)
+    sv(x, x)
+    train = dict((n, v) for n, v, _
+                 in mon.events(window_s=0.01, group="Train"))
+    assert train["Compile/train_step/compiles"] == 1
+    assert train["Train/mfu/train_step"] > 0
+    assert not any(n.startswith(("Compile/decode/", "Serving/"))
+                   for n in train)
+    assert train["Compile/total/programs"] == 2     # totals stay global
+    # the train drain did not consume the serving window's calls
+    serving = dict((n, v) for n, v, _ in mon.events(group="Serving"))
+    assert serving["Compile/decode/compiles"] == 1
+    assert serving["Serving/mfu/decode"] > 0
+    assert not any(n.startswith(("Compile/train_step/", "Train/"))
+                   for n in serving)
+    # and each group's per-window counters reset only on ITS drain
+    assert not any("/mfu/" in n for n, _, _
+                   in mon.events(window_s=0.01, group="Train"))
+
+
 # --------------------------------------------------------------------------- #
 # schema registries
 # --------------------------------------------------------------------------- #
@@ -503,6 +563,38 @@ def test_anomaly_through_hub_dump_and_metrics(devices8, tmp_path):
     assert "phase/fwd/spike" in out.stdout
 
 
+def test_straggler_gather_runs_on_every_process(monkeypatch):
+    """The per-host gather is a collective (process_allgather requires ALL
+    processes), so step_end must reach it on every rank BEFORE the rank-0
+    gate — a rank-0-only gather deadlocks the first monitored step of any
+    multi-process job. Non-zero ranks gather and return nothing; rank 0
+    gathers and emits the straggler finding."""
+    from jax.experimental import multihost_utils
+
+    from deepspeed_tpu.runtime.config import parse_config
+    from deepspeed_tpu.telemetry import TelemetryHub
+
+    calls = []
+
+    def fake_allgather(x):
+        calls.append(float(x))
+        return np.array([10.0, 10.2, 9.9, 14.0])
+
+    hub = TelemetryHub(parse_config(
+        {"telemetry": {"anomaly": {"enabled": True}}}))
+    monkeypatch.setattr(jax, "process_count", lambda: 4)
+    monkeypatch.setattr(multihost_utils, "process_allgather",
+                        fake_allgather)
+    hub.rank0 = False
+    assert hub.step_end(1, step_time_s=0.010) == []
+    assert len(calls) == 1        # the collective ran despite the gate
+    hub.rank0 = True
+    evs = hub.step_end(2, step_time_s=0.010)
+    assert len(calls) == 2
+    assert any(n == "Anomaly/host/straggler" for n, _, _ in evs)
+    hub.close()
+
+
 def test_anomaly_report_offline_replay(tmp_path):
     """--anomalies replays the detector over Train/Step/*_ms series from a
     run that never enabled it (post-hoc screening)."""
@@ -591,10 +683,21 @@ def test_prometheus_label_escaping():
     hub.compile_event("Compile/train_step/recompiles", 4.0)
     hub.compile_event("Compile/total/recompiles", 4.0)
     hub.compile_event("Serving/mfu/decode", 0.25)
+    hub.compile_event("Train/mfu/train_step", 0.4)
+    hub.compile_event("Train/mfu/total", 0.5)
+    hub.compile_event("Train/mfu/headline", 0.55)
     body = render_prometheus(hub.metrics_snapshot())
     assert 'dstpu_compile_recompiles{program="train_step"} 4' in body
     assert "dstpu_compile_total_recompiles 4" in body
     assert 'dstpu_serving_mfu{program="decode"} 0.25' in body
+    # the total/headline rollups export as distinct unlabeled metrics — as
+    # program labels they'd double-count any aggregation over the program
+    # label against the per-program gauges
+    assert 'dstpu_train_mfu{program="train_step"} 0.4' in body
+    assert "dstpu_train_mfu_total 0.5" in body
+    assert "dstpu_train_mfu_headline 0.55" in body
+    assert 'program="total"' not in body
+    assert 'program="headline"' not in body
 
 
 def test_bench_step_time_regression_mode(tmp_path):
