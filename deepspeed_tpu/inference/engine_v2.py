@@ -1119,6 +1119,19 @@ class InferenceEngineV2(InferenceEngine):
                 "free_slots": st.free_slots,
                 "total_blocks": st.allocator.num_blocks - 1}
 
+    def set_speculative(self, enabled: bool) -> bool:
+        """Runtime toggle for speculative decoding — the overload
+        degradation ladder's level-2 action (docs/serving.md "Fleet fault
+        tolerance"): under KV pressure the verify window's extra positions
+        stop competing for blocks. Safe between steps (speculation never
+        spans a step); turning it off routes ``step()`` through the exact
+        plain decode programs. Cannot enable what the config never
+        configured. Returns the previous setting so the caller can restore
+        it exactly."""
+        prev = self._spec_on
+        self._spec_on = bool(enabled) and bool(self.config.speculative.enabled)
+        return prev
+
     def park(self, uid: int) -> Dict[str, Any]:
         """Preempt a sequence: capture everything needed to continue it
         later, then release its slot and KV blocks. With the prefix cache

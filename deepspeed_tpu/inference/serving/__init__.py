@@ -8,7 +8,11 @@
   placement via the chain-hash prefix index, load-based fallback, and a
   drain/remove path for replica loss;
 - :mod:`.workload` — seeded open-loop traffic generation: Poisson/bursty
-  arrivals, multi-turn sessions, mixed prompt/gen-length distributions.
+  arrivals, multi-turn sessions, mixed prompt/gen-length distributions;
+- :mod:`.fleet` — fleet resilience (``serving.fleet`` config block,
+  default OFF): per-replica circuit breakers over tick faults/hangs,
+  crash failover with token-exact stream replay, and a hysteresis-guarded
+  overload degradation ladder (shed → spec off → clamp).
 
 The whole layer drives the engine through its public API (``put``,
 ``put_split``, ``step``, ``step_many``, ``park``, ``resume``, ``finish``),
@@ -18,5 +22,7 @@ so serving WITHOUT a scheduler is byte-for-byte the pre-scheduler engine.
 from .scheduler import (QUEUED, RUNNING, PARKED, DONE,  # noqa: F401
                         REJECTED, Request, RequestHandle, SchedulerConfig,
                         ServingScheduler)
+from .fleet import (CircuitBreaker, DegradationLadder,  # noqa: F401
+                    FleetConfig)
 from .router import ReplicaRouter, RouterConfig  # noqa: F401
 from .workload import Arrival, TrafficGenerator, WorkloadConfig  # noqa: F401

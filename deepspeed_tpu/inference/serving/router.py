@@ -1,4 +1,5 @@
-"""Multi-replica serving router (docs/serving.md "Scheduler & router").
+"""Multi-replica serving router (docs/serving.md "Scheduler & router",
+"Fleet fault tolerance").
 
 N engines — each behind its own :class:`~.scheduler.ServingScheduler` —
 behind one front door. Placement is **prefix-cache-affinity first**: the
@@ -9,10 +10,20 @@ turn lands on the replica that already holds its session's KV blocks — the
 hit costs block-table writes instead of prefill compute. When no replica
 holds a usable prefix (or the affinity winner is overloaded past a
 configured slack), placement falls back to least-loaded. ``drain()``
-removes a replica (planned maintenance or loss): its queued AND live
-requests move to the survivors with their handles intact — live sequences
-are parked, and their token histories re-prefill on the new replica (KV
-never crosses engines; host-side history does).
+removes a replica (planned maintenance): its queued AND live requests move
+to the survivors with their handles intact — live sequences are parked, and
+their token histories re-prefill on the new replica (KV never crosses
+engines; host-side history does).
+
+With the ``serving.fleet`` block enabled (:class:`~.fleet.FleetConfig`,
+default OFF — the no-fleet path is byte-identical to pre-fleet behavior),
+the router also survives the *ungraceful* exits: per-replica circuit
+breakers open after consecutive tick faults (crashes or deadline-blowing
+hangs) and ``fail_over()`` re-homes the failed replica's requests onto
+survivors by replaying prompt + already-emitted tokens through the
+park/resume seam — token-identical greedy streams, exactly-once delivery —
+while a hysteresis-guarded degradation ladder sheds load under KV/queue
+pressure instead of letting the pool collapse.
 """
 
 from __future__ import annotations
@@ -22,7 +33,8 @@ import itertools
 from typing import Callable, Dict, List, Optional, Sequence
 
 from ..ragged import PrefixBlockIndex
-from .scheduler import Request, RequestHandle, ServingScheduler
+from .fleet import CLOSED, OPEN, CircuitBreaker, DegradationLadder, FleetConfig
+from .scheduler import REJECTED, Request, RequestHandle, ServingScheduler
 
 
 @dataclasses.dataclass
@@ -32,6 +44,25 @@ class RouterConfig:
     # an affinity/sticky winner is honored only while its load (live +
     # queued) exceeds the least-loaded replica by at most this many requests
     load_slack: int = 8
+    # fleet resilience (circuit breakers, failover, overload degradation) —
+    # default OFF: the router behaves exactly as before this block existed
+    fleet: FleetConfig = dataclasses.field(default_factory=FleetConfig)
+
+    @classmethod
+    def from_dict(cls, d) -> "RouterConfig":
+        """Build from a config-tree dict, e.g. ``{"load_slack": 4,
+        "fleet": {"enabled": true, "failure_threshold": 2}}`` — the
+        ``serving.fleet`` block lands on :attr:`fleet`."""
+        if isinstance(d, cls):
+            return d
+        d = dict(d or {})
+        fleet = FleetConfig.from_dict(d.pop("fleet", {}))
+        known = {k: v for k, v in d.items() if k in cls.__dataclass_fields__}
+        unknown = set(d) - set(known)
+        if unknown:
+            raise ValueError(f"unknown serving router key(s): "
+                             f"{sorted(unknown)}")
+        return cls(fleet=fleet, **known)
 
 
 class ReplicaRouter:
@@ -49,7 +80,20 @@ class ReplicaRouter:
         self._session_replica: Dict[int, int] = {}
         self.stats: Dict[str, int] = {
             "requests": 0, "affinity_hits": 0, "session_hits": 0,
-            "load_fallbacks": 0, "drains": 0}
+            "load_fallbacks": 0, "reject_fallbacks": 0, "drains": 0}
+        # fleet resilience state: one breaker + one degradation ladder per
+        # replica. Constructed unconditionally (cheap) but consulted ONLY
+        # when cfg.fleet.enabled — the disabled router never reads them.
+        fc = self.cfg.fleet
+        self._health: List[CircuitBreaker] = [
+            CircuitBreaker(fc) for _ in self.replicas]
+        self._ladders: List[DegradationLadder] = [
+            DegradationLadder(fc, s, on_shed=self._count_shed)
+            for s in self.replicas]
+        self.fleet_stats: Dict[str, int] = {
+            "failovers": 0, "replayed_tokens": 0, "tick_faults": 0,
+            "slow_ticks": 0, "probe_ticks": 0, "circuit_open": 0,
+            "circuit_half_open": 0, "circuit_closed": 0, "shed_requests": 0}
 
     # -- placement -------------------------------------------------------- #
     def _active_idx(self) -> List[int]:
@@ -57,6 +101,15 @@ class ReplicaRouter:
         if not idx:
             raise RuntimeError("all replicas drained — nowhere to route")
         return idx
+
+    def _placeable_idx(self) -> List[int]:
+        """Active replicas that may take NEW work: all of them pre-fleet;
+        with fleet health tracking on, only those whose circuit breaker is
+        CLOSED (an open/half-open replica must pass its probe first)."""
+        active = self._active_idx()
+        if not self.cfg.fleet.enabled:
+            return active
+        return [i for i in active if self._health[i].state == CLOSED]
 
     def load(self, i: int) -> int:
         sched = self.replicas[i]
@@ -75,16 +128,21 @@ class ReplicaRouter:
         hashes = PrefixBlockIndex.chain_hashes(list(prompt), bs, n)
         return len(st.index.match(hashes)) * bs
 
-    def route(self, request: Request) -> int:
+    def route(self, request: Request) -> Optional[int]:
         """Pick a replica: longest cached prefix wins while its load stays
         within ``load_slack`` of the least-loaded replica; then session
-        stickiness under the same slack; then least-loaded."""
-        active = self._active_idx()
-        loads = {i: self.load(i) for i in active}
-        least = min(active, key=lambda i: (loads[i], i))
+        stickiness under the same slack; then least-loaded. Returns ``None``
+        only when fleet health tracking has every active replica's breaker
+        open — the caller sheds instead of placing onto a known-dead
+        replica."""
+        placeable = self._placeable_idx()
+        if not placeable:
+            return None
+        loads = {i: self.load(i) for i in placeable}
+        least = min(placeable, key=lambda i: (loads[i], i))
         if self.cfg.affinity:
             best, best_tok = least, 0
-            for i in active:
+            for i in placeable:
                 tok = self.affinity_tokens(i, request.prompt)
                 if tok > best_tok:
                     best, best_tok = i, tok
@@ -97,23 +155,55 @@ class ReplicaRouter:
         sid = request.session_id
         if self.cfg.session_sticky and sid is not None:
             i = self._session_replica.get(sid)
-            if i is not None and self._active[i]:
+            if i is not None and i in loads:
                 if loads[i] - loads[least] <= self.cfg.load_slack:
                     self.stats["session_hits"] += 1
                     return i
                 self.stats["load_fallbacks"] += 1
         return least
 
+    def _reject(self, request: Request, reason: str,
+                on_token: Optional[Callable[[int], None]]) -> RequestHandle:
+        """A router-level terminal rejection (no scheduler ever saw it)."""
+        handle = RequestHandle(request, on_token=on_token)
+        handle.state = REJECTED
+        handle.error = reason
+        handle.slo_met = False
+        self.fleet_stats["shed_requests"] += 1
+        return handle
+
     def submit(self, request: Request,
                on_token: Optional[Callable[[int], None]] = None
                ) -> RequestHandle:
         """Route + submit. uids are router-assigned (globally unique across
-        replicas, so a drain can re-home a request without collisions);
-        the chosen replica index lands on ``handle.replica``."""
+        replicas, so a drain/failover can re-home a request without
+        collisions); the chosen replica index lands on ``handle.replica``.
+        If the chosen scheduler would reject the request at admission
+        (footprint vs ITS pool) while another healthy replica has the
+        capacity, placement falls over to the next-best replica instead of
+        surfacing the rejection to the caller."""
         if request.uid is None:
             request.uid = next(self._uids)
         self.stats["requests"] += 1
         i = self.route(request)
+        if i is None:
+            return self._reject(request,
+                                "no healthy replica (all circuit-open)",
+                                on_token)
+        fc = self.cfg.fleet
+        if fc.enabled and fc.degrade and self._ladders[i].level >= 1 and \
+                request.priority >= fc.shed_priority:
+            return self._reject(
+                request, f"shed by overload degradation "
+                f"(level {self._ladders[i].level})", on_token)
+        reason = self.replicas[i]._reject_reason(request)
+        if reason is not None:
+            for j in sorted((k for k in self._placeable_idx() if k != i),
+                            key=lambda k: (self.load(k), k)):
+                if self.replicas[j]._reject_reason(request) is None:
+                    i = j
+                    self.stats["reject_fallbacks"] += 1
+                    break
         handle = self.replicas[i].submit(request, on_token=on_token)
         handle.replica = i
         if request.session_id is not None:
@@ -127,8 +217,54 @@ class ReplicaRouter:
                    if self._active[i])
 
     def step(self) -> None:
-        for i in self._active_idx():
+        active = self._active_idx()
+        if not self.cfg.fleet.enabled:
+            for i in active:            # the exact pre-fleet loop: no
+                self.replicas[i].tick()  # wrapping, timing, or catching —
+            return                       # a tick error propagates unchanged
+        for i in active:
+            self._step_replica(i)
+
+    def _step_replica(self, i: int) -> None:
+        """One health-tracked tick of replica ``i``: honor the breaker
+        (skip while OPEN; run the half-open probe when due), drive the
+        degradation ladder, then tick with fault + deadline accounting. A
+        fault that opens the breaker triggers :meth:`fail_over`."""
+        fc = self.cfg.fleet
+        br = self._health[i]
+        if br.state == OPEN:
+            if not br.allow_probe():
+                return
+            self.fleet_stats["circuit_half_open"] += 1
+            self.fleet_stats["probe_ticks"] += 1
+        if fc.degrade:
+            self._ladders[i].update()
+        t0 = fc.clock()
+        try:
             self.replicas[i].tick()
+        except Exception as e:
+            self._on_fault(i, f"tick raised {type(e).__name__}: {e}")
+            return
+        dt = fc.clock() - t0
+        if fc.tick_deadline_s > 0 and dt > fc.tick_deadline_s:
+            self._on_fault(i, f"tick took {dt * 1e3:.0f} ms "
+                           f"(> {fc.tick_deadline_s * 1e3:.0f} ms deadline)")
+            return
+        if fc.slow_tick_s > 0 and dt > fc.slow_tick_s:
+            self.fleet_stats["slow_ticks"] += 1
+        if br.record_success():
+            self.fleet_stats["circuit_closed"] += 1
+            self._instant("circuit_closed", replica=i)
+
+    def _on_fault(self, i: int, reason: str) -> None:
+        self.fleet_stats["tick_faults"] += 1
+        if self._health[i].record_failure():
+            self.fleet_stats["circuit_open"] += 1
+            self._instant("circuit_open", replica=i, reason=reason)
+            self.fail_over(i, reason=reason)
+
+    def _count_shed(self, handles: List[RequestHandle]) -> None:
+        self.fleet_stats["shed_requests"] += len(handles)
 
     def run(self, max_steps: int = 100000) -> None:
         steps = 0
@@ -140,12 +276,38 @@ class ReplicaRouter:
                                f"steps")
 
     # -- replica loss ------------------------------------------------------ #
+    def _rehome(self, moved, exclude: int, reason: str) -> int:
+        """Place ``(handle, parked)`` pairs on the best surviving replicas
+        (same handle objects — streams continue after the re-prefill of each
+        parked history). Prefers breaker-CLOSED survivors, falls back to any
+        active survivor, and — failover only — re-queues on the failed
+        replica itself when it is the sole member (its breaker probe may
+        recover it; nothing is silently dropped)."""
+        targets = [i for i in self._placeable_idx() if i != exclude]
+        fallback = [i for i in self._active_idx() if i != exclude]
+        n = 0
+        for handle, parked in moved:
+            pool = targets or fallback
+            if not pool and self._active[exclude]:
+                pool = [exclude]        # sole replica: wait for recovery
+            j = min(pool, key=lambda k: (self.load(k), k))
+            self.replicas[j].accept(handle, parked=parked)
+            handle.replica = j
+            n += 1
+            if parked is not None:
+                self.fleet_stats["replayed_tokens"] += len(parked["history"])
+            sid = handle.request.session_id
+            if sid is not None:
+                self._session_replica[sid] = j
+        if n:
+            self._instant("rehome", replica=exclude, moved=n, reason=reason)
+        return n
+
     def drain(self, idx: int) -> int:
-        """Remove replica ``idx``: stop placing onto it, park its live
-        sequences, and re-home every queued/parked/live request onto the
-        surviving replicas (same handle objects — streams continue after a
-        re-prefill of each parked history). Returns the number of requests
-        moved."""
+        """Remove replica ``idx`` PERMANENTLY (planned maintenance or
+        scale-down): stop placing onto it, park its live sequences through
+        the engine, and re-home every queued/parked/live request onto the
+        surviving replicas. Returns the number of requests moved."""
         if not self._active[idx]:
             raise ValueError(f"replica {idx} is already drained")
         self._active[idx] = False
@@ -158,17 +320,39 @@ class ReplicaRouter:
             if i == idx:
                 del self._session_replica[sid]
         moved = self.replicas[idx].evict_all()
-        for handle, parked in moved:
-            active = self._active_idx()
-            j = min(active, key=lambda i: (self.load(i), i))
-            self.replicas[j].accept(handle, parked=parked)
-            handle.replica = j
-            sid = handle.request.session_id
-            if sid is not None:
-                self._session_replica[sid] = j
-        return len(moved)
+        return self._rehome(moved, exclude=idx, reason="drain")
+
+    def fail_over(self, idx: int, reason: str = "replica fault") -> int:
+        """Crash/hang failover — :meth:`drain` generalized to a replica
+        whose engine can no longer be trusted: re-home its queued AND live
+        requests onto survivors WITHOUT the failed engine's cooperation
+        (``scheduler.abandon_all`` reconstructs each live stream from the
+        handle's prompt + already-emitted tokens; ``resume`` on the survivor
+        re-prefills that history, chunked when the destination runs
+        SplitFuse). Greedy streams continue token-identically with
+        exactly-once delivery (parity-pinned). Unlike ``drain``, the replica
+        stays registered: its circuit breaker's half-open probe re-admits it
+        for new placements once it recovers. Returns the requests moved."""
+        if not self._active[idx]:
+            raise ValueError(f"replica {idx} is already drained")
+        for sid, i in list(self._session_replica.items()):
+            if i == idx:
+                del self._session_replica[sid]
+        moved = self.replicas[idx].abandon_all()
+        self.fleet_stats["failovers"] += 1
+        n = self._rehome(moved, exclude=idx, reason=reason)
+        self._instant("failover", replica=idx, moved=n, reason=reason)
+        return n
 
     # -- telemetry --------------------------------------------------------- #
+    def _instant(self, name: str, **kw) -> None:
+        """Failover/degradation instants land in the first enabled tracer
+        (replicas sharing a hub share one flight recorder)."""
+        for sched in self.replicas:
+            if sched.tracer.enabled:
+                sched.tracer.instant(name, cat="serving", **kw)
+                return
+
     def router_events(self, step: int = 0):
         """``Serving/router/*`` telemetry events (registered in
         ``telemetry/schema.py SERVING_SERIES``)."""
@@ -177,8 +361,25 @@ class ReplicaRouter:
         return [(f"Serving/router/{k}", float(v), step)
                 for k, v in sorted(vals.items())]
 
-    def publish_router_telemetry(self, step: int = 0):
-        events = self.router_events(step)
+    def fleet_events(self, step: int = 0):
+        """``Serving/fleet/*`` telemetry events: failover/replay counters,
+        circuit-breaker transition counts, shed requests, and the live
+        degradation-level / broken-replica gauges. Empty with the fleet
+        block disabled (no-events parity pin)."""
+        if not self.cfg.fleet.enabled:
+            return []
+        vals = {k: float(v) for k, v in self.fleet_stats.items()}
+        vals["degrade_level"] = float(max(
+            (lad.level for lad in self._ladders), default=0))
+        vals["degrade_shifts"] = float(sum(
+            lad.shifts for lad in self._ladders))
+        vals["broken_replicas"] = float(sum(
+            1 for i, a in enumerate(self._active)
+            if a and self._health[i].state != CLOSED))
+        return [(f"Serving/fleet/{k}", float(v), step)
+                for k, v in sorted(vals.items())]
+
+    def _publish(self, events):
         for sched in self.replicas:
             hub = getattr(sched.engine, "_hub", None)
             if hub is not None:
@@ -186,3 +387,9 @@ class ReplicaRouter:
                     hub.serving_event(name, value, s)
                 break
         return events
+
+    def publish_router_telemetry(self, step: int = 0):
+        return self._publish(self.router_events(step))
+
+    def publish_fleet_telemetry(self, step: int = 0):
+        return self._publish(self.fleet_events(step))

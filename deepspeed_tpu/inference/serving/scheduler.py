@@ -159,6 +159,11 @@ class ServingScheduler:
         self._queue_wait_ms: List[float] = []
         self._e2e_ms: List[float] = []
         self._t0 = self._clock()
+        # overload degradation (fleet.DegradationLadder level 3): when set,
+        # every admission's max_new_tokens is clamped to this many tokens
+        # (never below what the stream already emitted). None = no clamp —
+        # the default path never consults it.
+        self.degrade_max_new_tokens: Optional[int] = None
 
     # -- queue ----------------------------------------------------------- #
     @property
@@ -258,10 +263,75 @@ class ServingScheduler:
     def accept(self, handle: RequestHandle,
                parked: Optional[Dict[str, Any]] = None) -> None:
         """Enqueue a request that already has a handle (router re-homing
-        after a drain). Keeps the original submit time and deadline."""
+        after a drain or failover). Keeps the original submit time and
+        deadline."""
         handle.state = QUEUED
         self.handles[handle.request.uid] = handle
         self._push(handle, parked=parked)
+
+    def abandon_all(self) -> List[Tuple[RequestHandle,
+                                        Optional[Dict[str, Any]]]]:
+        """Evict every request WITHOUT engine cooperation — the crash/hang
+        failover counterpart of :meth:`evict_all` (docs/serving.md "Fleet
+        fault tolerance"). Live continuations are reconstructed from each
+        handle's CLIENT-VISIBLE stream (prompt + the tokens already emitted)
+        instead of ``engine.park``, so a crashed or wedged engine is never
+        asked to do anything on the failover path; its host bookkeeping is
+        cleaned best-effort so a recovered replica starts empty. Streams
+        that already emitted their full budget finalize as DONE here.
+        Exactly-once delivery: the parked ``generated`` list carries every
+        token the handle emitted, so ``engine.resume`` on the survivor
+        continues the stream without re-emitting any of them — and a greedy
+        replay of prompt + emitted history regenerates exactly the next
+        stream token (token-identical failover, parity-pinned)."""
+        out: List[Tuple[RequestHandle, Optional[Dict[str, Any]]]] = []
+        for uid, h in list(self._live.items()):
+            del self._live[uid]
+            self.handles.pop(uid, None)
+            try:
+                self.engine.finish(uid)   # frees slot + blocks when the
+            except Exception:             # engine still works (hang/slow);
+                pass                      # a truly crashed engine may leak
+            if h.finished_stream:         # until the breaker re-probes it
+                self._finalize(h)
+                continue
+            h.state = PARKED
+            h.preemptions += 1
+            out.append((h, {"uid": uid,
+                            "history": list(h.request.prompt)
+                            + list(h.tokens),
+                            "generated": list(h.tokens),
+                            "prompt_len": len(h.request.prompt),
+                            "sp": h.request.sp}))
+        while self._heap:
+            *_, entry = heapq.heappop(self._heap)
+            if not entry["valid"]:
+                continue
+            h = entry["handle"]
+            self.handles.pop(h.request.uid, None)
+            out.append((h, entry["parked"]))
+        return out
+
+    def shed(self, min_priority: int, reason: str) -> List[RequestHandle]:
+        """Reject every QUEUED, not-yet-started request whose priority is
+        ``min_priority`` or lower-urgency (higher number) — the degradation
+        ladder's level-1 action. Requests that already consumed compute
+        (parked/preempted histories) are spared: shedding admissions first
+        loses the least work. Returns the shed handles."""
+        out: List[RequestHandle] = []
+        for *_, entry in self._heap:
+            h = entry["handle"]
+            if not entry["valid"] or entry["parked"] is not None or \
+                    h.request.priority < min_priority:
+                continue
+            entry["valid"] = False
+            self.handles.pop(h.request.uid, None)
+            h.state = REJECTED
+            h.error = reason
+            h.slo_met = False
+            self.stats["rejected"] += 1
+            out.append(h)
+        return out
 
     # -- the scheduling loop --------------------------------------------- #
     def tick(self, seed: Optional[int] = None) -> Dict[int, List[int]]:
@@ -345,6 +415,13 @@ class ServingScheduler:
                 continue
             h = entry["handle"]
             parked = entry["parked"]
+            if self.degrade_max_new_tokens is not None:
+                # overload clamp (degradation level 3): shorten what this
+                # admission may generate, never below what it already
+                # emitted — the stream stays exactly-once, just shorter
+                h.request.max_new_tokens = min(
+                    h.request.max_new_tokens,
+                    max(self.degrade_max_new_tokens, len(h.tokens)))
             tokens = parked["history"] if parked else h.request.prompt
             need = st.blocks_needed(len(tokens))
             if need > budget:
@@ -469,12 +546,18 @@ class ServingScheduler:
                 self.engine.finish(uid)
                 del self._live[uid]
                 self.handles.pop(uid, None)
-                h.state = DONE
-                h.e2e_ms = (self._clock() - h._submit_t) * 1e3
-                h.slo_met = h.e2e_ms <= h.request.deadline_ms
-                self._e2e_ms.append(h.e2e_ms)
-                self.stats["completed"] += 1
-                self.stats["slo_met" if h.slo_met else "slo_missed"] += 1
+                self._finalize(h)
+
+    def _finalize(self, h: RequestHandle) -> None:
+        """Mark a stream complete: terminal state, e2e latency, SLO and
+        goodput accounting (shared by :meth:`_retire` and
+        :meth:`abandon_all`)."""
+        h.state = DONE
+        h.e2e_ms = (self._clock() - h._submit_t) * 1e3
+        h.slo_met = h.e2e_ms <= h.request.deadline_ms
+        self._e2e_ms.append(h.e2e_ms)
+        self.stats["completed"] += 1
+        self.stats["slo_met" if h.slo_met else "slo_missed"] += 1
 
     # -- telemetry -------------------------------------------------------- #
     def sched_events(self, step: int = 0):

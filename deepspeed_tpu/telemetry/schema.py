@@ -79,7 +79,15 @@ SERVING_SERIES = frozenset(
     # multi-replica router (serving/router.py router_events)
     + ["Serving/router/" + m for m in (
         "requests", "affinity_hits", "session_hits", "load_fallbacks",
-        "drains", "replicas")])
+        "reject_fallbacks", "drains", "replicas")]
+    # fleet resilience (serving/router.py fleet_events — circuit breakers,
+    # crash failover, overload degradation; docs/serving.md "Fleet fault
+    # tolerance")
+    + ["Serving/fleet/" + m for m in (
+        "failovers", "replayed_tokens", "tick_faults", "slow_ticks",
+        "probe_ticks", "circuit_open", "circuit_half_open", "circuit_closed",
+        "shed_requests", "degrade_level", "degrade_shifts",
+        "broken_replicas")])
 
 # The named remat policies the activation-checkpointing registry ships
 # (runtime/activation_checkpointing/checkpointing.py POLICIES — a tier-1

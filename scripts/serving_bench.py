@@ -18,7 +18,10 @@ measures speculative decoding ON vs OFF: tok/s, accept rate, ITL p50/p99,
 and model forward passes per generated token, and an OPEN-LOOP Poisson
 workload replayed against the continuous-batching scheduler vs the
 hand-rolled FCFS admit loop — goodput-under-SLO, queue-wait percentiles,
-and preemption counts for the tpu_watch SERVING probe.
+and preemption counts for the tpu_watch SERVING probe — plus a fleet
+CHAOS probe (``detail.chaos``): the same trace on a two-replica fleet,
+fault-free vs with a mid-trace replica crash, reporting the goodput delta
+that failover + circuit-breaker re-admission leave behind.
 ONE JSON line.
 """
 
@@ -122,6 +125,34 @@ def _traffic(**kw):
                                                  WorkloadConfig)
 
     return TrafficGenerator(WorkloadConfig(**kw))
+
+
+def _warm_engine(eng, sp, vocab, lengths, max_batch, quantum=1):
+    """Compile the prefill/decode programs a replay will hit OUTSIDE the
+    measured window (power-of-two admission-burst shapes at each prompt
+    length, the prefix-cache ctx variants via a second pass, and the decode
+    program). Compiles are a one-time cost the persistent XLA cache absorbs
+    in production; inside the window they would measure compilation, not
+    scheduling or fault-handling policy."""
+    import numpy as np
+
+    wrng = np.random.default_rng(999)
+    uid = 10 ** 6
+    for hi in lengths:
+        n = 1
+        while n <= max_batch:
+            prompt = wrng.integers(0, vocab, (hi,), dtype=np.int32).tolist()
+            for _ in range(2):   # second pass hits the cache → ctx variant
+                pairs = [(uid + j, prompt) for j in range(n)]
+                eng.put_many(pairs, sp, seed=0)
+                if quantum > 1:
+                    eng.step_many(quantum, sp)
+                else:
+                    eng.step(sp)
+                for u, _ in pairs:
+                    eng.finish(u)
+                uid += n
+            n *= 2
 
 
 def run_shared_prefix(build, sp, vocab, batch, shared_len, tail_len,
@@ -251,29 +282,8 @@ def run_open_loop(build, sp, vocab, rate_rps, duration_s, prompt_len,
     import numpy as np
 
     def warm(eng, max_batch):
-        """Compile the prefill/decode programs the replay will hit OUTSIDE
-        the measured window (power-of-two admission-burst shapes, the
-        prefix-cache ctx variants, and the decode program). Compiles are a
-        one-time cost the persistent XLA cache absorbs in production;
-        leaving them inside the window would measure compilation, not
-        scheduling policy."""
-        wrng = np.random.default_rng(999)
         hi = prompt_len if isinstance(prompt_len, int) else prompt_len[1]
-        uid = 10 ** 6
-        n = 1
-        while n <= max_batch:
-            prompt = wrng.integers(0, vocab, (hi,), dtype=np.int32).tolist()
-            for _ in range(2):     # second pass hits the cache → ctx variant
-                pairs = [(uid + j, prompt) for j in range(n)]
-                eng.put_many(pairs, sp, seed=0)
-                if quantum > 1:
-                    eng.step_many(quantum, sp)
-                else:
-                    eng.step(sp)
-                for u, _ in pairs:
-                    eng.finish(u)
-                uid += n
-            n *= 2
+        _warm_engine(eng, sp, vocab, (hi,), max_batch, quantum=quantum)
 
     traffic = _traffic(seed=11, vocab_size=vocab, process="poisson",
                        rate_rps=rate_rps, prompt_len=prompt_len,
@@ -389,6 +399,114 @@ def run_open_loop(build, sp, vocab, rate_rps, duration_s, prompt_len,
     sys.stderr.write(
         f"[serving] open_loop hand_rolled: {out['hand_rolled']}\n")
     del eng
+    return out
+
+
+def run_chaos(build, sp, vocab, rate_rps, duration_s, prompt_len, gen_len,
+              slo_ms):
+    """``detail.chaos`` (docs/serving.md "Fleet fault tolerance"): one seeded
+    open-loop Poisson trace served by a TWO-replica fleet with the
+    ``serving.fleet`` block enabled, run fault-free and again with a
+    mid-trace replica crash + recovery (``testing.faults.replica_crash``
+    covering ~20% of the trace). Reports per mode: goodput-under-SLO,
+    queue-wait p99, lost requests (must be 0 — every request reaches a
+    terminal state), and the failover / circuit-breaker counters; the
+    headline is the fault-free goodput delta — what one replica crash costs
+    once failover and breaker re-admission do their jobs."""
+    import numpy as np
+
+    from deepspeed_tpu.inference.serving import (FleetConfig, ReplicaRouter,
+                                                 RouterConfig,
+                                                 SchedulerConfig,
+                                                 ServingScheduler)
+    from deepspeed_tpu.testing.faults import replica_crash
+
+    out = {"rate_rps": rate_rps, "duration_s": duration_s, "slo_ms": slo_ms,
+           "replicas": 2}
+    time_cap = duration_s * 10 + 60
+    for label, crash in (("fault_free", False), ("with_crash", True)):
+        # per-mode generator with the same seed: both modes see the
+        # identical arrival trace — the delta is pure fault handling
+        traffic = _traffic(seed=17, vocab_size=vocab, process="poisson",
+                           rate_rps=rate_rps, prompt_len=prompt_len,
+                           gen_len=gen_len, deadline_ms=slo_ms)
+        arrivals = traffic.arrivals(duration_s)
+        scheds = [ServingScheduler(build(),
+                                   SchedulerConfig(max_admissions_per_tick=4))
+                  for _ in range(2)]
+        router = ReplicaRouter(scheds, RouterConfig(fleet=FleetConfig(
+            enabled=True, failure_threshold=1, probe_backoff_ticks=25)))
+        hi = prompt_len if isinstance(prompt_len, int) else prompt_len[1]
+        ghi = gen_len if isinstance(gen_len, int) else gen_len[1]
+        for s in scheds:        # prefill bursts n=1,2,4 + failover-replay
+            _warm_engine(s.engine, sp, vocab, (hi, hi + ghi), 4)
+        handles = []
+        i = 0
+        crash_cm = None
+        crashed = False
+        crash_steps_left = 0
+        t0 = time.perf_counter()
+        while i < len(arrivals) or router.pending:
+            now = time.perf_counter() - t0
+            if now > time_cap:
+                break
+            while i < len(arrivals) and arrivals[i].t <= now:
+                handles.append(router.submit(arrivals[i].request))
+                i += 1
+            # mid-trace crash: replica 0 dies once half the arrivals are in,
+            # stays dead for a fixed number of router steps, then recovers
+            # (the breaker's half-open probe re-admits it)
+            if crash and not crashed and i >= len(arrivals) // 2:
+                crash_cm = replica_crash(scheds[0])
+                crash_cm.__enter__()
+                crashed = True
+                crash_steps_left = 40
+            if crash_cm is not None:
+                crash_steps_left -= 1
+                if crash_steps_left <= 0:
+                    crash_cm.__exit__(None, None, None)  # replica recovers
+                    crash_cm = None
+            if not router.pending:
+                if i < len(arrivals):
+                    time.sleep(min(max(arrivals[i].t - now, 0.0), 0.05))
+                continue
+            router.step()
+        if crash_cm is not None:
+            crash_cm.__exit__(None, None, None)
+        while router.pending and time.perf_counter() - t0 < time_cap:
+            router.step()                     # breaker probes need idle steps
+        elapsed = time.perf_counter() - t0
+        done = [h for h in handles if h.state == "done"]
+        met = [h for h in done if h.slo_met]
+        qw = np.asarray([h.queue_wait_ms for h in handles
+                         if h.queue_wait_ms is not None] or [0.0])
+        fs = router.fleet_stats
+        row = {"arrivals": len(handles), "completed": len(done),
+               "slo_met": len(met),
+               "goodput_rps": round(len(met) / elapsed, 2),
+               "goodput_frac": round(len(met) / len(done), 3)
+               if done else 0.0,
+               "queue_wait_p99_ms": round(float(np.percentile(qw, 99)), 2),
+               "lost_requests": sum(1 for h in handles if not h.done),
+               "failovers": fs["failovers"],
+               "replayed_tokens": fs["replayed_tokens"],
+               "shed_requests": fs["shed_requests"],
+               "circuit_open": fs["circuit_open"],
+               "circuit_closed": fs["circuit_closed"]}
+        out[label] = row
+        sys.stderr.write(f"[serving] chaos {label}: {row}\n")
+        tel_dir = os.environ.get("DSTPU_SERVING_TELEMETRY")
+        if crash and tel_dir:
+            _dump_serving_telemetry(
+                scheds[0].engine, tel_dir, job="serving_bench_fleet",
+                extra_events=router.fleet_events(step=0)
+                + router.router_events(step=0))
+        del router, scheds
+    ff, wc = out.get("fault_free"), out.get("with_crash")
+    if isinstance(ff, dict) and isinstance(wc, dict):
+        # the headline: goodput a crash costs AFTER failover does its job
+        out["goodput_frac_delta"] = round(
+            ff["goodput_frac"] - wc["goodput_frac"], 3)
     return out
 
 
@@ -624,6 +742,39 @@ def main():
             glen_ol, slo_ol, quantum=q_ol)
     except Exception as e:
         RESULT["detail"]["open_loop"] = f"error: {str(e)[-200:]}"
+
+    # fleet chaos probe: goodput-under-SLO and queue-wait p99 with vs
+    # without a mid-trace replica crash on a two-replica fleet — the
+    # failover / circuit-breaker trajectory row (docs/serving.md "Fleet
+    # fault tolerance"); non-fatal in tpu_watch.sh
+    try:
+        if on_tpu:
+            rate_ch, dur_ch, plen_ch, glen_ch, slo_ch = \
+                16.0, 16.0, (64, 192), (16, 48), 4000.0
+            slots_ch, bs_ch = 12, 32
+        else:
+            rate_ch, dur_ch, plen_ch, glen_ch, slo_ch = \
+                16.0, 4.0, (12, 24), (3, 8), 2500.0
+            slots_ch, bs_ch = 6, 16
+        max_tok_ch = plen_ch[1] + glen_ch[1]
+
+        def build_ch():
+            nb = slots_ch * ((max_tok_ch + bs_ch - 1) // bs_ch + 3) + 8
+            return build_engine_v2(
+                llama, mcfg, llama.init(mcfg, jax.random.PRNGKey(0)),
+                config={"dtype": "bfloat16",
+                        "prefill_bucket": min(64, plen_ch[1]),
+                        "prefix_cache": {"enabled": True},
+                        "ragged": {"max_tracked_sequences": slots_ch,
+                                   "max_ragged_batch_size": slots_ch,
+                                   "memory_config_blocks": nb,
+                                   "block_size": bs_ch}})
+
+        RESULT["detail"]["chaos"] = run_chaos(
+            build_ch, sp, mcfg.vocab_size, rate_ch, dur_ch, plen_ch,
+            glen_ch, slo_ch)
+    except Exception as e:
+        RESULT["detail"]["chaos"] = f"error: {str(e)[-200:]}"
 
     # head-of-line probe: long-prompt admission stall, split vs one-shot
     try:

@@ -403,8 +403,10 @@ def serving(events: List[dict]) -> str:
     the speculative-decoding efficiency counters from ``Serving/spec/*``,
     the continuous-batching scheduler counters from ``Serving/sched/*``
     (queue depth, admitted/rejected/preempted, queue-wait percentiles,
-    goodput-under-SLO), and the multi-replica router placement counters from
-    ``Serving/router/*`` (paged serving engine — docs/serving.md). These
+    goodput-under-SLO), the multi-replica router placement counters from
+    ``Serving/router/*``, and the fleet-resilience counters from
+    ``Serving/fleet/*`` (failovers, replayed tokens, circuit-breaker
+    transitions, shed requests, degradation level — docs/serving.md). These
     series carry CUMULATIVE counter values (gauges for occupancy/rates), so
     the last sample per series is the run total — unlike
     ``--reliability``'s one-line-per-occurrence."""
@@ -412,9 +414,10 @@ def serving(events: List[dict]) -> str:
     spec = [e for e in events if e["name"].startswith("Serving/spec/")]
     sched = [e for e in events if e["name"].startswith("Serving/sched/")]
     router = [e for e in events if e["name"].startswith("Serving/router/")]
-    if not srv and not spec and not sched and not router:
-        return ("serving: no Serving/{prefix_cache,spec,sched,router}/* "
-                "events in this file")
+    fleet = [e for e in events if e["name"].startswith("Serving/fleet/")]
+    if not srv and not spec and not sched and not router and not fleet:
+        return ("serving: no Serving/{prefix_cache,spec,sched,router,fleet}/*"
+                " events in this file")
     lines: List[str] = []
     if srv:
         last: Dict[str, float] = {}
@@ -522,7 +525,34 @@ def serving(events: List[dict]) -> str:
                      f"{rt.get('session_hits', 0):,.0f}")
         lines.append(f"  load fallbacks:         "
                      f"{rt.get('load_fallbacks', 0):,.0f}")
+        lines.append(f"  admission fallbacks:    "
+                     f"{rt.get('reject_fallbacks', 0):,.0f}")
         lines.append(f"  drains:                 {rt.get('drains', 0):,.0f}")
+    if fleet:
+        if lines:
+            lines.append("")
+        fl: Dict[str, float] = {}
+        for e in fleet:
+            fl[e["name"][len("Serving/fleet/"):]] = e["value"]  # last wins
+        lines.append(f"fleet resilience report ({len(fleet)} events)")
+        lines.append(f"  failovers:              "
+                     f"{fl.get('failovers', 0):,.0f}  "
+                     f"({fl.get('replayed_tokens', 0):,.0f} tokens replayed)")
+        lines.append(f"  tick faults:            "
+                     f"{fl.get('tick_faults', 0):,.0f}  (slow ticks "
+                     f"{fl.get('slow_ticks', 0):,.0f}, probes "
+                     f"{fl.get('probe_ticks', 0):,.0f})")
+        lines.append(f"  circuit transitions:    "
+                     f"{fl.get('circuit_open', 0):,.0f} open / "
+                     f"{fl.get('circuit_half_open', 0):,.0f} half-open / "
+                     f"{fl.get('circuit_closed', 0):,.0f} closed")
+        lines.append(f"  shed requests:          "
+                     f"{fl.get('shed_requests', 0):,.0f}")
+        lines.append(f"  degrade level (now):    "
+                     f"{fl.get('degrade_level', 0):,.0f}  "
+                     f"({fl.get('degrade_shifts', 0):,.0f} shifts)")
+        lines.append(f"  broken replicas (now):  "
+                     f"{fl.get('broken_replicas', 0):,.0f}")
     return "\n".join(lines)
 
 
@@ -686,7 +716,10 @@ def main(argv=None) -> int:
                          "batch occupancy), Serving/sched/* scheduler "
                          "counters (queue depth, admitted/rejected/"
                          "preempted, queue-wait percentiles, goodput-under-"
-                         "SLO), and Serving/router/* placement counters")
+                         "SLO), Serving/router/* placement counters, and "
+                         "Serving/fleet/* resilience counters (failovers, "
+                         "circuit-breaker transitions, shed requests, "
+                         "degradation level)")
     ap.add_argument("--latency", action="store_true",
                     help="summarize Serving/latency/* SLO percentiles: "
                          "TTFT / inter-token / queue / e2e p50-p90-p99")

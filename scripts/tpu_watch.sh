@@ -118,6 +118,32 @@ while true; do
     # (open in ui.perfetto.dev; summarize with telemetry_report.py --trace)
     hold_requested || DSTPU_SERVING_TRACE="bench_runs/SERVING_trace_${ts}.json" \
       run_probe SERVING scripts/serving_bench.py 3000 SERVING_TPU_LIVE.json
+    # fleet-chaos row (NON-FATAL by design — it never gates CYCLE_OK or
+    # promotion): goodput-under-SLO with vs without a mid-trace replica
+    # crash from the SERVING capture's detail.chaos (two-replica fleet,
+    # serving.fleet enabled). Growth in the delta, a nonzero lost count, or
+    # zero failovers under crash means the failover / circuit-breaker
+    # re-admission path regressed.
+    python - >> "$LOG" 2>&1 <<'EOF' || true
+import glob, json
+try:
+    src = sorted(glob.glob("bench_runs/SERVING_[0-9]*.json"))[-1]
+    d = json.loads(open(src).read().strip().splitlines()[-1])
+    ch = d.get("detail", {}).get("chaos")
+    if isinstance(ch, dict) and isinstance(ch.get("with_crash"), dict):
+        print("[watch] CHAOS probe: goodput_frac fault_free=%s with_crash=%s "
+              "delta=%s lost=%s failovers=%s queue_p99_ms=%s"
+              % (ch["fault_free"]["goodput_frac"],
+                 ch["with_crash"]["goodput_frac"],
+                 ch.get("goodput_frac_delta"),
+                 ch["with_crash"]["lost_requests"],
+                 ch["with_crash"]["failovers"],
+                 ch["with_crash"]["queue_wait_p99_ms"]))
+    else:
+        print("[watch] CHAOS probe: no detail.chaos in %s (%r)" % (src, ch))
+except Exception as e:
+    print("[watch] CHAOS probe: unreadable:", e)
+EOF
     hold_requested || run_probe LONGCTX scripts/longctx_bench.py 2400 LONGCTX_TPU_LIVE.json
     hold_requested || run_probe MOE scripts/moe_dispatch_bench.py 1200 MOE_TPU_LIVE.json
     # full headline bench incl. shape rows (first compiles are slow).

@@ -1,0 +1,650 @@
+"""Fleet fault tolerance tests (docs/serving.md "Fleet fault tolerance"):
+circuit-breaker health tracking around scheduler ticks, crash/hang failover
+with token-exact exactly-once stream replay, the hysteresis-guarded overload
+degradation ladder, the chaos soak (zero lost requests under seeded
+crash/hang injection), the submit-time admission fallback and
+mid-split-prefill re-home satellites, and the Serving/fleet telemetry
+surface — plus parity pins that the ``serving.fleet``-disabled router is
+byte-identical to pre-fleet behavior."""
+
+import math
+import os
+import subprocess
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+from deepspeed_tpu.comm import mesh as mesh_lib
+from deepspeed_tpu.inference import (FleetConfig, ReplicaRouter, Request,
+                                     RouterConfig, SchedulerConfig,
+                                     ServingScheduler, TrafficGenerator,
+                                     WorkloadConfig, build_engine_v2)
+from deepspeed_tpu.inference.serving import DONE, REJECTED
+from deepspeed_tpu.inference.serving.fleet import (CLOSED, HALF_OPEN, OPEN,
+                                                   CircuitBreaker)
+from deepspeed_tpu.telemetry.schema import SERVING_SERIES, validate_events
+from deepspeed_tpu.testing import faults
+
+
+class FakeClock:
+    """Injectable ``FleetConfig.clock``: only the fault harness advances it
+    (``advance`` doubles as the hang injector's sleep), so hang/slow
+    detection is deterministic — a healthy tick, even one paying a first
+    jit compile, costs zero fake time."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, seconds: float) -> None:
+        self.t += seconds
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    from deepspeed_tpu.models import llama
+    cfg = llama.LlamaConfig.tiny(max_seq_len=256)
+    params = llama.init(cfg, jax.random.PRNGKey(0))
+    return llama, cfg, params
+
+
+def build(tiny, blocks=64, block_size=16, slots=4, **kw):
+    llama, cfg, params = tiny
+    mesh_lib.set_mesh(None)
+    return build_engine_v2(
+        llama, cfg, params,
+        config=dict({"dtype": "float32", "prefill_bucket": 16,
+                     "prefix_cache": {"enabled": True},
+                     "ragged": {"max_tracked_sequences": slots,
+                                "max_ragged_batch_size": slots,
+                                "memory_config_blocks": blocks,
+                                "block_size": block_size}}, **kw))
+
+
+def _requests(cfg, n, seed=5, gen_len=8, prompt_len=(10, 28), prios=(0,)):
+    gen = TrafficGenerator(WorkloadConfig(
+        seed=seed, vocab_size=cfg.vocab_size, prompt_len=prompt_len,
+        gen_len=gen_len, priorities=prios, deadline_ms=60000.0))
+    return [gen.request() for _ in range(n)]
+
+
+@pytest.fixture(scope="module")
+def oracle_sched(tiny):
+    """ONE shared default-config scheduler for fault-free reference runs:
+    greedy outputs depend only on the prompt (batch composition and prefix
+    reuse are parity-pinned elsewhere), so every test's oracle can run on
+    the same warm engine."""
+    return ServingScheduler(build(tiny))
+
+
+def _oracle_tokens(oracle_sched, requests):
+    """Fault-free reference streams for ``requests`` (fresh copies): the
+    token-identity oracle for any placement/failover history."""
+    handles = [oracle_sched.submit(Request(prompt=list(r.prompt),
+                                           max_new_tokens=r.max_new_tokens,
+                                           priority=r.priority))
+               for r in requests]
+    oracle_sched.run()
+    assert all(h.state == DONE for h in handles)
+    return [h.tokens for h in handles]
+
+
+# --------------------------------------------------------------------------- #
+# config + breaker units
+# --------------------------------------------------------------------------- #
+def test_fleet_config_from_dict():
+    rc = RouterConfig.from_dict({"load_slack": 4,
+                                 "fleet": {"enabled": True,
+                                           "failure_threshold": 2,
+                                           "tick_deadline_s": 0.5}})
+    assert rc.load_slack == 4 and rc.fleet.enabled
+    assert rc.fleet.failure_threshold == 2
+    assert rc.fleet.tick_deadline_s == 0.5
+    assert RouterConfig.from_dict(None).fleet.enabled is False
+    assert FleetConfig.from_dict(None).enabled is False
+    with pytest.raises(ValueError, match="serving.fleet"):
+        FleetConfig.from_dict({"failure_treshold": 2})
+    with pytest.raises(ValueError, match="router"):
+        RouterConfig.from_dict({"load_slak": 1})
+
+
+def test_circuit_breaker_state_machine():
+    """CLOSED → OPEN after N consecutive faults (interleaved successes
+    reset the count), half-open probe after the backoff, CLOSED on probe
+    success, re-OPEN with doubled backoff on probe failure."""
+    br = CircuitBreaker(FleetConfig(failure_threshold=3,
+                                    probe_backoff_ticks=2,
+                                    backoff_multiplier=2.0,
+                                    max_backoff_ticks=8))
+    assert br.state == CLOSED
+    br.record_failure()
+    br.record_failure()
+    br.record_success()                      # success resets the streak
+    br.record_failure()
+    assert not br.record_failure()
+    assert br.state == CLOSED
+    assert br.record_failure() and br.state == OPEN and br.opens == 1
+    assert not br.allow_probe()              # cooldown tick 1 of 2
+    assert br.allow_probe() and br.state == HALF_OPEN
+    # probe fails → immediate re-open, backoff doubled to 4
+    assert br.record_failure() and br.state == OPEN and br.opens == 2
+    for _ in range(3):
+        assert not br.allow_probe()
+    assert br.allow_probe() and br.state == HALF_OPEN
+    # probe passes → closed, backoff reset to the configured base
+    assert br.record_success() and br.state == CLOSED
+    for _ in range(3):
+        br.record_failure()
+    assert br.state == OPEN
+    assert not br.allow_probe() and br.allow_probe()  # base backoff again
+
+
+# --------------------------------------------------------------------------- #
+# default-OFF parity: the no-fleet router is the pre-fleet router
+# --------------------------------------------------------------------------- #
+def test_fleet_default_off_parity(tiny, oracle_sched):
+    """With ``serving.fleet`` disabled (the default): a replica tick error
+    propagates to the caller exactly as pre-fleet (nothing catches it),
+    no breaker/ladder state is ever touched, no Serving/fleet events exist,
+    and the served token streams equal a plain single-scheduler run."""
+    _, cfg, _ = tiny
+    reqs = _requests(cfg, 5)
+    want = _oracle_tokens(oracle_sched, reqs)
+    scheds = [ServingScheduler(build(tiny)) for _ in range(2)]
+    router = ReplicaRouter(scheds)               # default config: fleet off
+    assert router.cfg.fleet.enabled is False
+    handles = [router.submit(r) for r in reqs]
+    with faults.replica_crash(scheds[0]):
+        with pytest.raises(faults.ReplicaCrash):
+            router.step()                        # propagates, pre-fleet
+    router.run()
+    assert [h.tokens for h in handles] == want
+    assert router.fleet_events() == []           # no-events parity pin
+    assert all(v == 0 for v in router.fleet_stats.values())
+    assert all(b.state == CLOSED and b.opens == 0 for b in router._health)
+    assert all(lad.level == 0 and lad.shifts == 0 for lad in router._ladders)
+    assert all(s.degrade_max_new_tokens is None for s in scheds)
+
+
+# --------------------------------------------------------------------------- #
+# crash / hang failover
+# --------------------------------------------------------------------------- #
+def test_crash_failover_token_exact_exactly_once(tiny, oracle_sched):
+    """Acceptance: a replica crash mid-decode fails its queued AND live
+    requests over to the survivor; every stream completes, greedy outputs
+    are token-identical to a fault-free run, and no token is ever delivered
+    twice (on_token stream == handle.tokens)."""
+    _, cfg, _ = tiny
+    reqs = _requests(cfg, 6, seed=11)
+    want = _oracle_tokens(oracle_sched, reqs)
+    scheds = [ServingScheduler(build(tiny)) for _ in range(2)]
+    router = ReplicaRouter(scheds, RouterConfig(fleet=FleetConfig(
+        enabled=True, failure_threshold=2, probe_backoff_ticks=50)))
+    streams = [[] for _ in reqs]
+    handles = [router.submit(r, on_token=streams[k].append)
+               for k, r in enumerate(reqs)]
+    for _ in range(2):
+        router.step()                    # some streams go live on both
+    victim = handles[0].replica
+    assert any(h.replica == victim for h in handles)
+    with faults.replica_crash(scheds[victim]) as st:
+        router.run()
+    assert st["crashes"] >= 2            # threshold faults actually fired
+    assert all(h.state == DONE for h in handles)
+    assert [h.tokens for h in handles] == want
+    assert [list(s) for s in streams] == [h.tokens for h in handles]
+    assert router.fleet_stats["failovers"] >= 1
+    assert router.fleet_stats["circuit_open"] >= 1
+    assert router.fleet_stats["replayed_tokens"] > 0
+    assert router._health[victim].state != CLOSED
+    # survivors' allocator invariants hold after the replays
+    scheds[1 - victim].engine.state.debug_check()
+
+
+def test_hang_failover_tick_deadline(tiny, oracle_sched):
+    """A replica whose ticks complete but blow ``tick_deadline_s`` is
+    treated as hung: the breaker opens and its requests fail over — streams
+    still complete token-identically (the slow ticks DID make progress;
+    replay continues from the client-visible stream)."""
+    _, cfg, _ = tiny
+    reqs = _requests(cfg, 4, seed=13)
+    want = _oracle_tokens(oracle_sched, reqs)
+    clock = FakeClock()
+    scheds = [ServingScheduler(build(tiny)) for _ in range(2)]
+    router = ReplicaRouter(scheds, RouterConfig(fleet=FleetConfig(
+        enabled=True, failure_threshold=2, tick_deadline_s=0.01,
+        probe_backoff_ticks=100, clock=clock)))
+    handles = [router.submit(r) for r in reqs]
+    router.step()
+    victim = handles[0].replica
+    with faults.replica_hang(scheds[victim], seconds=0.03,
+                             advance=clock.advance) as st:
+        for _ in range(3):
+            router.step()
+    assert st["hangs"] >= 2
+    assert router.fleet_stats["tick_faults"] >= 2
+    assert router._health[victim].state == OPEN
+    assert router.fleet_stats["failovers"] == 1
+    router.run()
+    assert all(h.state == DONE for h in handles)
+    assert [h.tokens for h in handles] == want
+    assert all(h.replica == 1 - victim for h in handles)
+
+
+def test_breaker_half_open_probe_readmits_recovered_replica(tiny):
+    """After the crash window ends, the half-open probe finds tick healthy,
+    the breaker closes, and NEW work is placed on the recovered replica
+    again."""
+    _, cfg, _ = tiny
+    scheds = [ServingScheduler(build(tiny)) for _ in range(2)]
+    router = ReplicaRouter(scheds, RouterConfig(fleet=FleetConfig(
+        enabled=True, failure_threshold=1, probe_backoff_ticks=3)))
+    h0 = router.submit(_requests(cfg, 1, seed=17)[0])
+    router.step()
+    victim = h0.replica
+    with faults.replica_crash(scheds[victim]):
+        router.step()                    # fault → open + failover
+    assert router._health[victim].state == OPEN
+    # placement avoids the broken replica while open
+    h1 = router.submit(_requests(cfg, 1, seed=18)[0])
+    assert h1.replica == 1 - victim
+    for _ in range(5):                   # cooldown + probe + close
+        router.step()
+    assert router._health[victim].state == CLOSED
+    assert router.fleet_stats["circuit_closed"] == 1
+    assert router.fleet_stats["probe_ticks"] >= 1
+    # load the survivor so least-loaded placement returns to the recovered
+    for _ in range(3):
+        router.submit(_requests(cfg, 1, seed=19)[0])
+    h2 = router.submit(_requests(cfg, 1, seed=20)[0])
+    assert any(h.replica == victim for h in (h2,)) or \
+        router.load(victim) > 0
+    router.run()
+    assert all(h.state == DONE for h in (h0, h1, h2))
+
+
+def test_flaky_and_slow_replicas_do_not_open_breaker(tiny, oracle_sched):
+    """Interleaved transient faults (flaky tick below the consecutive
+    threshold) and persistently slow-but-under-deadline ticks degrade
+    telemetry, not availability: the breaker stays closed and every stream
+    completes in place."""
+    _, cfg, _ = tiny
+    reqs = _requests(cfg, 4, seed=23, gen_len=6)
+    want = _oracle_tokens(oracle_sched, reqs)
+    clock = FakeClock()
+    scheds = [ServingScheduler(build(tiny)) for _ in range(2)]
+    router = ReplicaRouter(scheds, RouterConfig(fleet=FleetConfig(
+        enabled=True, failure_threshold=3, tick_deadline_s=0.5,
+        slow_tick_s=0.001, clock=clock)))
+    handles = [router.submit(r) for r in reqs]
+    with faults.flaky_tick(scheds[0], fail_every=3) as fl, \
+            faults.slow_replica(scheds[1], seconds=0.005,
+                                advance=clock.advance) as sl:
+        router.run()
+    assert fl["failures"] >= 1 and sl["slow"] >= 1
+    assert all(b.state == CLOSED for b in router._health)
+    assert router.fleet_stats["circuit_open"] == 0
+    assert router.fleet_stats["failovers"] == 0
+    assert router.fleet_stats["tick_faults"] >= fl["failures"]
+    assert router.fleet_stats["slow_ticks"] >= 1
+    assert all(h.state == DONE for h in handles)
+    assert [h.tokens for h in handles] == want
+
+
+def test_single_replica_fleet_requeues_and_recovers(tiny, oracle_sched):
+    """Sole-replica failover has nowhere to go: requests re-queue on the
+    failed replica awaiting its breaker probe; submits while everything is
+    circuit-open are REJECTED with a message (controlled shedding, not an
+    exception); after recovery the queue drains and nothing is lost."""
+    _, cfg, _ = tiny
+    sched = ServingScheduler(build(tiny))
+    router = ReplicaRouter([sched], RouterConfig(fleet=FleetConfig(
+        enabled=True, failure_threshold=1, probe_backoff_ticks=2)))
+    reqs = _requests(cfg, 3, seed=29, gen_len=5)
+    want = _oracle_tokens(oracle_sched, reqs)
+    handles = [router.submit(r) for r in reqs]
+    router.step()
+    with faults.replica_crash(sched):
+        router.step()                        # open + requeue on itself
+        assert router._health[0].state == OPEN
+        dark = router.submit(_requests(cfg, 1, seed=31)[0])
+        assert dark.state == REJECTED and "no healthy replica" in dark.error
+    router.run()                             # probe recovers, queue drains
+    assert router._health[0].state == CLOSED
+    assert all(h.state == DONE for h in handles)
+    assert [h.tokens for h in handles] == want
+    sched.engine.state.debug_check()
+
+
+# --------------------------------------------------------------------------- #
+# overload degradation ladder
+# --------------------------------------------------------------------------- #
+def test_degradation_ladder_sheds_then_recovers(tiny):
+    """Acceptance: under queue/KV pressure the ladder escalates with
+    hysteresis — shed lowest-priority admissions first (level 1), disable
+    speculative decoding (level 2), clamp max_new_tokens (level 3) — then
+    eases back to level 0 as pressure clears, restoring the spec setting
+    and lifting the clamp. Urgent (priority 0) requests all complete; pool
+    pressure never surfaces an error."""
+    _, cfg, _ = tiny
+    rng = np.random.default_rng(7)
+    sched = ServingScheduler(build(
+        tiny, blocks=20, slots=3,
+        speculative={"enabled": True, "max_draft_tokens": 3}))
+    eng = sched.engine
+    assert eng._spec_on
+    fc = FleetConfig(enabled=True, queue_high=4, queue_low=1, up_ticks=1,
+                     down_ticks=3, shed_priority=2, clamp_max_new_tokens=4)
+    router = ReplicaRouter([sched], RouterConfig(fleet=fc))
+    handles = []
+    for k in range(16):
+        handles.append(router.submit(Request(
+            prompt=rng.integers(0, cfg.vocab_size, (20,)).tolist(),
+            max_new_tokens=30, priority=0 if k % 2 == 0 else 3)))
+    levels = []
+    for _ in range(6):
+        router.step()
+        levels.append(router._ladders[0].level)
+    assert levels[0] >= 1 and max(levels) == 3      # escalated through L3
+    assert eng._spec_on is False                    # level 2 in force
+    assert sched.degrade_max_new_tokens == 4        # level 3 in force
+    # incoming low-priority traffic is shed at the door while degraded
+    late = router.submit(Request(prompt=[1, 2, 3, 4], max_new_tokens=4,
+                                 priority=5))
+    assert late.state == REJECTED and "overload degradation" in late.error
+    router.run()
+    assert all(h.done for h in handles)
+    assert all(h.state == DONE for h in handles if h.request.priority == 0)
+    shed = [h for h in handles if h.state == REJECTED]
+    assert shed and all(h.request.priority >= fc.shed_priority for h in shed)
+    assert all("overload degradation" in h.error for h in shed)
+    assert router.fleet_stats["shed_requests"] >= len(shed)
+    # clamped admissions generated at most clamp tokens; the pre-overload
+    # batch kept its full budget
+    done_lens = {len(h.tokens) for h in handles if h.state == DONE}
+    assert 4 in done_lens
+    # idle ticks clear the pressure: ladder eases fully, effects lifted
+    for _ in range(4 * fc.down_ticks):
+        router.step()
+    assert router._ladders[0].level == 0
+    assert eng._spec_on is True                     # restored exactly
+    assert sched.degrade_max_new_tokens is None
+    ev = router.fleet_events(step=3)
+    vals = dict((n, v) for n, v, _ in ev)
+    assert vals["Serving/fleet/degrade_level"] == 0.0
+    assert vals["Serving/fleet/degrade_shifts"] >= 6.0
+    eng.state.debug_check()
+
+
+# --------------------------------------------------------------------------- #
+# satellite: submit-time admission fallback across replicas
+# --------------------------------------------------------------------------- #
+def test_submit_falls_back_when_chosen_replica_rejects(tiny):
+    """A request the load-chosen replica must reject (footprint vs ITS
+    pool) is placed on the next-best replica that CAN serve it instead of
+    surfacing the rejection — and still rejects when no replica fits."""
+    _, cfg, _ = tiny
+    rng = np.random.default_rng(9)
+    small = ServingScheduler(build(tiny, blocks=8))
+    big = ServingScheduler(build(tiny, blocks=64))
+    router = ReplicaRouter([small, big])
+    # queue work on the big replica so least-loaded placement prefers small
+    for k in range(2):
+        big.submit(Request(prompt=rng.integers(
+            0, cfg.vocab_size, (10,)).tolist(), max_new_tokens=2,
+            uid=900 + k))
+    h = router.submit(Request(prompt=rng.integers(
+        0, cfg.vocab_size, (120,)).tolist(), max_new_tokens=4))
+    assert h.state != REJECTED and h.replica == 1
+    assert router.stats["reject_fallbacks"] == 1
+    # nowhere fits → the original rejection surfaces with its message
+    h2 = router.submit(Request(prompt=list(range(300)), max_new_tokens=4))
+    assert h2.state == REJECTED and h2.error
+    router.run()
+    assert h.state == DONE and len(h.tokens) == 4
+
+
+# --------------------------------------------------------------------------- #
+# satellite: drain/failover of a mid-split-prefill request re-enters the
+# chunked-admission path on the destination
+# --------------------------------------------------------------------------- #
+@pytest.fixture(scope="module")
+def split_case(tiny):
+    """The shared mid-split scenario + its fault-free oracle: two short
+    decodes (one per replica keeps SplitFuse to one chunk per tick) and one
+    long prompt, with the long request's reference stream computed once on
+    a plain SplitFuse scheduler."""
+    _, cfg, _ = tiny
+    rng = np.random.default_rng(33)
+    shorts = [rng.integers(0, cfg.vocab_size, (10,)).tolist()
+              for _ in range(2)]
+    prompt = rng.integers(0, cfg.vocab_size, (64,)).tolist()
+    oracle = ServingScheduler(build(tiny, split_prefill_chunk=16))
+    oracle.submit(Request(prompt=list(shorts[0]), max_new_tokens=8))
+    oh = oracle.submit(Request(prompt=list(prompt), max_new_tokens=4))
+    oracle.run()
+    return shorts, prompt, oh.tokens
+
+
+@pytest.mark.parametrize("mode", ["drain", "fail_over"])
+def test_rehome_mid_split_prefill_reenters_chunked_path(tiny, split_case,
+                                                        mode):
+    """Regression: re-homing a request parked MID-split-prefill onto a
+    SplitFuse-enabled destination must re-enter chunked admission
+    (``put_split`` via ``resume(split=True)``) — live decodes on the
+    destination never stall for the whole re-prefill — and the stream stays
+    token-identical to a fault-free run."""
+    shorts, prompt, want = split_case
+    scheds = [ServingScheduler(build(tiny, split_prefill_chunk=16))
+              for _ in range(2)]
+    router = ReplicaRouter(scheds, RouterConfig(
+        load_slack=100, fleet=FleetConfig(
+            enabled=True, failure_threshold=1, probe_backoff_ticks=100)))
+    # one live decode per replica keeps split prefill to one chunk per tick
+    for p in shorts:
+        router.submit(Request(prompt=list(p), max_new_tokens=8))
+    h = router.submit(Request(prompt=list(prompt), max_new_tokens=4))
+    router.step()
+    src = h.replica
+    d = scheds[src].engine.state.seqs[h.uid]
+    assert d.prefilling and 0 < d.seen_tokens < len(prompt)
+    if mode == "drain":
+        router.drain(src)
+    else:
+        with faults.replica_crash(scheds[src]):
+            router.step()
+        assert router.fleet_stats["failovers"] == 1
+    dst = h.replica
+    assert dst == 1 - src
+    router.step()
+    dd = scheds[dst].engine.state.seqs.get(h.uid)
+    # chunked re-entry: the history is prefilling chunk-by-chunk on the
+    # destination, NOT whole-prompt put (which would have seen==len(prompt))
+    assert dd is not None and dd.prefilling
+    assert dd.seen_tokens < len(prompt)
+    router.run()
+    assert h.state == DONE
+    assert h.tokens == want
+    scheds[dst].engine.state.debug_check()
+
+
+# --------------------------------------------------------------------------- #
+# satellite: seeded chaos soak — crash + hang + overload, zero lost
+# --------------------------------------------------------------------------- #
+def test_chaos_soak_zero_lost_and_token_exact(tiny, oracle_sched):
+    """Acceptance: one seeded TrafficGenerator trace replayed under
+    randomized replica crash/hang injection — every submitted request
+    reaches a terminal state (completed or explicitly rejected), every
+    completed greedy stream is token-identical to the fault-free run, and
+    no token is delivered twice."""
+    _, cfg, _ = tiny
+    wl = WorkloadConfig(seed=41, vocab_size=cfg.vocab_size,
+                        prompt_len=(8, 24), gen_len=(3, 8),
+                        deadline_ms=math.inf)
+    reqs = [TrafficGenerator(wl).request() for _ in range(30)]
+    oracle = _oracle_tokens(oracle_sched, [TrafficGenerator(wl).request()
+                                   for _ in range(30)])
+    clock = FakeClock()
+    scheds = [ServingScheduler(build(tiny)) for _ in range(2)]
+    router = ReplicaRouter(scheds, RouterConfig(fleet=FleetConfig(
+        enabled=True, failure_threshold=1, probe_backoff_ticks=4,
+        tick_deadline_s=0.02, degrade=False, clock=clock)))
+    streams = [[] for _ in reqs]
+    submitted = []
+
+    class _Tap:
+        def __init__(self, k):
+            self.k = k
+
+        def __call__(self, tok):
+            streams[self.k].append(tok)
+
+    orig_submit = router.submit
+    idx = iter(range(len(reqs)))
+
+    def submit(req):
+        k = next(idx)
+        h = orig_submit(req, on_token=_Tap(k))
+        submitted.append((k, h))
+        return h
+
+    router.submit = submit
+    report = faults.chaos_soak(router, reqs, seed=7, submits_per_step=2,
+                               fault_rate=0.10, crash_ticks=(3, 8),
+                               hang_s=0.05, advance=clock.advance)
+    assert report["faults"], "the seeded schedule must inject something"
+    kinds = {f["kind"] for f in report["faults"]}
+    assert "crash" in kinds          # the seed injects both fault flavors
+    handles = report["handles"]
+    assert len(handles) == len(reqs)
+    # zero lost: every request reaches a terminal state — and with the soak
+    # keeping at most one replica unhealthy, that state is DONE for all
+    assert all(h.done for h in handles)
+    assert all(h.state == DONE for h in handles)
+    assert router.fleet_stats["failovers"] >= 1
+    # token-exact + exactly-once for every stream
+    for k, h in submitted:
+        assert h.tokens == oracle[k], f"request {k} diverged"
+        assert streams[k] == h.tokens, f"request {k} double-delivered"
+    for s in scheds:
+        s.engine.state.debug_check()
+
+
+def test_overload_burst_is_controlled_shedding_not_errors(tiny):
+    """Pool exhaustion + queue collapse under a burst far past capacity:
+    nothing raises, nothing wedges — every request is completed or
+    explicitly shed, and the allocator survives with clean invariants."""
+    _, cfg, _ = tiny
+    rng = np.random.default_rng(43)
+    sched = ServingScheduler(build(tiny, blocks=14, slots=3))
+    router = ReplicaRouter([sched], RouterConfig(fleet=FleetConfig(
+        enabled=True, queue_high=3, queue_low=1, up_ticks=1, down_ticks=4,
+        shed_priority=1, clamp_max_new_tokens=3)))
+    handles = [router.submit(Request(
+        prompt=rng.integers(0, cfg.vocab_size, (16,)).tolist(),
+        max_new_tokens=24, priority=k % 3))
+        for k in range(18)]
+    router.run()
+    assert all(h.done for h in handles)
+    done = [h for h in handles if h.state == DONE]
+    shed = [h for h in handles if h.state == REJECTED]
+    assert done and shed
+    assert all(h.request.priority >= 1 for h in shed)
+    assert router.fleet_stats["shed_requests"] == len(shed)
+    sched.engine.state.debug_check()
+    assert not sched.engine.state.seqs
+
+
+# --------------------------------------------------------------------------- #
+# telemetry surface
+# --------------------------------------------------------------------------- #
+def test_fleet_events_schema_and_hub(tiny, tmp_path):
+    from deepspeed_tpu.monitor.monitor import JSONLMonitor
+    from deepspeed_tpu.telemetry import TelemetryHub
+
+    class MonCfg:
+        enabled = True
+        output_path = str(tmp_path)
+        job_name = "fleet"
+
+    class HubCfg:
+        pass
+
+    llama, cfg, params = tiny
+    mon = JSONLMonitor(MonCfg())
+    hub = TelemetryHub(HubCfg(), monitor=mon)
+    mesh_lib.set_mesh(None)
+    eng = build_engine_v2(
+        llama, cfg, params, telemetry_hub=hub,
+        config={"dtype": "float32", "prefill_bucket": 16,
+                "prefix_cache": {"enabled": True},
+                "ragged": {"max_tracked_sequences": 4,
+                           "max_ragged_batch_size": 4,
+                           "memory_config_blocks": 64, "block_size": 16}})
+    scheds = [ServingScheduler(eng, SchedulerConfig()),
+              ServingScheduler(build(tiny))]
+    router = ReplicaRouter(scheds, RouterConfig(fleet=FleetConfig(
+        enabled=True, failure_threshold=1, probe_backoff_ticks=100)))
+    h = router.submit(_requests(cfg, 1, seed=47)[0])
+    router.step()
+    with faults.replica_crash(scheds[h.replica]):
+        router.step()
+    router.run()
+    assert h.state == DONE
+    fevents = router.publish_fleet_telemetry(step=2)
+    revents = router.publish_router_telemetry(step=2)
+    assert fevents and validate_events(fevents + revents) == []
+    names = {n for n, _, _ in fevents + revents}
+    assert names <= SERVING_SERIES
+    assert hub.serving_values["Serving/fleet/failovers"] >= 1.0
+    assert hub.serving_values["Serving/fleet/circuit_open"] >= 1.0
+    assert hub.serving_values["Serving/fleet/broken_replicas"] == 1.0
+    assert hub.serving_values["Serving/router/reject_fallbacks"] == 0.0
+    # the closed registry rejects an unregistered fleet series
+    assert validate_events([("Serving/fleet/bogus", 1.0, 0)])
+    mon.close()
+    assert (tmp_path / "fleet" / "events.jsonl").exists()
+
+
+def test_telemetry_report_fleet_section(tmp_path):
+    from deepspeed_tpu.monitor.monitor import JSONLMonitor
+
+    class Cfg:
+        enabled = True
+        output_path = str(tmp_path)
+        job_name = "job"
+
+    mon = JSONLMonitor(Cfg())
+    mon.write_events([
+        ("Serving/fleet/failovers", 2.0, 5),
+        ("Serving/fleet/replayed_tokens", 180.0, 5),
+        ("Serving/fleet/tick_faults", 4.0, 5),
+        ("Serving/fleet/slow_ticks", 1.0, 5),
+        ("Serving/fleet/probe_ticks", 3.0, 5),
+        ("Serving/fleet/circuit_open", 2.0, 5),
+        ("Serving/fleet/circuit_half_open", 3.0, 5),
+        ("Serving/fleet/circuit_closed", 1.0, 5),
+        ("Serving/fleet/shed_requests", 6.0, 5),
+        ("Serving/fleet/degrade_level", 1.0, 5),
+        ("Serving/fleet/degrade_shifts", 4.0, 5),
+        ("Serving/fleet/broken_replicas", 1.0, 5),
+        ("Serving/router/requests", 20.0, 5),
+        ("Serving/router/reject_fallbacks", 2.0, 5)])
+    mon.close()
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = os.path.join(repo, "scripts", "telemetry_report.py")
+    out = subprocess.run(
+        [sys.executable, script, str(tmp_path / "job" / "events.jsonl"),
+         "--serving"], capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr
+    assert "fleet resilience report" in out.stdout
+    assert "failovers:              2  (180 tokens replayed)" in out.stdout
+    assert "circuit transitions:    2 open / 3 half-open / 1 closed" \
+        in out.stdout
+    assert "shed requests:          6" in out.stdout
+    assert "degrade level (now):    1  (4 shifts)" in out.stdout
+    assert "admission fallbacks:    2" in out.stdout
