@@ -783,6 +783,143 @@ def quant_comm_only():
     print("QUANT_COMM=" + json.dumps(bench_quantized_comm(jax, False)))
 
 
+def bench_tiered_mem(jax, on_tpu, steps: int = None) -> dict:
+    """``detail.tiered_mem`` — the tiered-memory acceptance probe
+    (docs/memory.md): (a) optimizer host-offload step time vs the in-HBM
+    baseline on the SAME model, with the store's measured transfer-overlap
+    fraction (``Memory/tier/overlap_frac``: the share of transfer wall time
+    hidden under compute — the ≥0.5 acceptance) and the device-resident
+    byte delta between steps (host-tier opt state leaves the device
+    allocator); (b) KV host-spill restore latency: admission of a fully
+    spilled prefix (restore path) vs a cold admission of the same prompt.
+    Non-fatal: failures return status and never poison the headline."""
+    import numpy as np
+
+    try:
+        import jax.numpy as jnp
+
+        import deepspeed_tpu as dst
+        from deepspeed_tpu.comm import mesh as mesh_lib
+        from deepspeed_tpu.models import llama
+        from deepspeed_tpu.telemetry.memory import MemoryTelemetry
+
+        if steps is None:
+            steps = 8 if on_tpu else 5
+        mcfg = bench_model_config(on_tpu)
+        seqlen = 512 if on_tpu else 128
+        out: dict = {"ok": True}
+
+        def run(tiered: bool):
+            mesh_lib.set_mesh(None)
+            config = {
+                "train_batch_size": 8 * max(1, len(jax.devices())),
+                "bf16": {"enabled": True},
+                "optimizer": {"type": "adamw", "params": {"lr": 3e-4}},
+                "zero_optimization": {"stage": 2},
+                "steps_per_print": 0,
+            }
+            if tiered:
+                config["memory"] = {"tiering": {"enabled": True,
+                                                "optimizer_tier": "host"}}
+            spec = llama.model_spec(mcfg, compute_dtype=jnp.bfloat16)
+            engine, _, _, _ = dst.initialize(model=spec, config=config)
+            rng = np.random.default_rng(0)
+
+            def batch():
+                return {"tokens": rng.integers(
+                    0, mcfg.vocab_size,
+                    (engine.train_batch_size(), seqlen + 1), dtype=np.int32)}
+
+            float(engine.train_batch(batch()).loss)  # compile + warm
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                o = engine.train_batch(batch())
+            float(o.loss)
+            dt = (time.perf_counter() - t0) / steps
+            import gc
+
+            gc.collect()  # drop freed buffers before the live-array census
+            resident = MemoryTelemetry().snapshot()["bytes_in_use"]
+            return engine, dt, resident
+
+        e0, dt0, res0 = run(False)
+        opt_bytes = sum(getattr(l, "nbytes", 0)
+                        for l in jax.tree.leaves(e0.state.opt_state))
+        del e0
+        e1, dt1, res1 = run(True)
+        store = e1.tiered_store
+        out["optimizer_offload"] = {
+            "step_time_s_baseline": round(dt0, 4),
+            "step_time_s_offload": round(dt1, 4),
+            "slowdown": round(dt1 / dt0, 3) if dt0 > 0 else None,
+            "opt_state_bytes": int(opt_bytes),
+            "device_bytes_between_steps_baseline": int(res0),
+            "device_bytes_between_steps_offload": int(res1),
+            "device_bytes_delta": int(res0 - res1),
+            "host_tier_resident_bytes": store.resident_bytes("host"),
+            "overlap_frac": round(store.overlap_frac(), 3),
+            "prefetch_hits": int(store.stats["prefetch_hits"]),
+            "prefetch_misses": int(store.stats["prefetch_misses"]),
+        }
+        e1.destroy()
+        del e1
+
+        # --- (b) KV host-spill restore latency ---
+        from deepspeed_tpu.inference.engine_v2 import build_engine_v2
+        from deepspeed_tpu.inference.sampling import SamplingParams
+
+        mesh_lib.set_mesh(None)
+        icfg = llama.LlamaConfig.tiny(max_seq_len=256) if not on_tpu else mcfg
+        params = llama.init(icfg, jax.random.PRNGKey(0))
+        eng = build_engine_v2(
+            llama, icfg, params,
+            config={"dtype": "float32", "prefill_bucket": 16,
+                    "prefix_cache": {"enabled": True,
+                                     "max_retained_blocks": 2,
+                                     "host_spill": True},
+                    "ragged": {"max_tracked_sequences": 4,
+                               "max_ragged_batch_size": 4,
+                               "memory_config_blocks": 64,
+                               "block_size": 16}})
+        sp = SamplingParams(greedy=True)
+        rng = np.random.default_rng(1)
+        prompts = [rng.integers(0, icfg.vocab_size, (64,),
+                                dtype=np.int32).tolist() for _ in range(3)]
+        for i, p in enumerate(prompts):   # fill, decode, retire → spills
+            eng.put(i, p, sp)
+            eng.step(sp)
+            eng.finish(i)
+        # warm the restore path (the spill-write program compiles once)
+        eng.put(80, prompts[1], sp)
+        eng.step(sp)
+        eng.finish(80)
+        # cold admission (novel prompt) vs restore admission (spilled prefix)
+        cold = rng.integers(0, icfg.vocab_size, (64,), dtype=np.int32).tolist()
+        t0 = time.perf_counter()
+        eng.put(90, cold, sp)
+        eng.step(sp)
+        t_cold = time.perf_counter() - t0
+        eng.finish(90)
+        t0 = time.perf_counter()
+        eng.put(91, prompts[0], sp)       # restores spilled blocks
+        eng.step(sp)
+        t_restore = time.perf_counter() - t0
+        eng.finish(91)
+        st = eng.state.prefix_stats
+        out["kv_spill"] = {
+            "spills": int(st["spills"]),
+            "restores": int(st["restores"]),
+            "restored_tokens": int(st["restored_tokens"]),
+            "admit_cold_s": round(t_cold, 4),
+            "admit_restore_s": round(t_restore, 4),
+            "restore_speedup": (round(t_cold / t_restore, 2)
+                                if t_restore > 0 else None),
+        }
+        return out
+    except Exception as e:
+        return {"ok": False, "status": f"error: {e}"[-300:]}
+
+
 def run_decode_subprocess() -> object:
     """Decode bench in a SUBPROCESS with a hard timeout, BEFORE this process
     initializes its own jax client: a wedged tunnel compile must never hold
@@ -933,6 +1070,13 @@ def main():
     # Skippable via DSTPU_BENCH_QCOMM=0.
     if os.environ.get("DSTPU_BENCH_QCOMM", "1") not in ("", "0"):
         RESULT["detail"]["quant_comm"] = run_quant_comm(jax, on_tpu)
+
+    # tiered-memory acceptance probe (docs/memory.md): optimizer host-
+    # offload step time + measured transfer-overlap fraction vs the in-HBM
+    # baseline, and KV host-spill restore latency. Non-fatal; skippable via
+    # DSTPU_BENCH_TIERED=0.
+    if os.environ.get("DSTPU_BENCH_TIERED", "1") not in ("", "0"):
+        RESULT["detail"]["tiered_mem"] = bench_tiered_mem(jax, on_tpu)
 
     # step-time regression vs the newest checked-in BENCH_r*.json —
     # informational here (the gating form is --regression-only, wired as a

@@ -266,12 +266,14 @@ def apply_xla_overlap_flags(cfg) -> List[str]:
 # publishes the decision here and the models consult it when choosing
 # between lax.scan and prefetch_scan for their stacked-layer loop.
 _LAYER_PREFETCH: dict = {"enabled": False, "depth": 1, "shardings": None,
-                         "quantize": None, "gather_axes": ()}
+                         "quantize": None, "gather_axes": (),
+                         "host_tier": False}
 
 
 def configure_layer_prefetch(enabled: bool, depth: int = 1,
                              shardings=None, quantize=None,
-                             gather_axes: Tuple[str, ...] = ()) -> None:
+                             gather_axes: Tuple[str, ...] = (),
+                             host_tier: bool = False) -> None:
     """Publish the engine's per-layer prefetch decision. ``shardings`` is an
     optional pytree (matching the model's per-layer param subtree, leading
     stacked dim dropped) of NamedShardings describing the GATHERED
@@ -285,6 +287,13 @@ def configure_layer_prefetch(enabled: bool, depth: int = 1,
     ``gather_axes`` names the mesh axes the per-layer gathers resolve over
     (the hpZ secondary axes, or the full ZeRO axes) — telemetry only.
 
+    ``host_tier`` (``memory.tiering.param_tier=host``; docs/memory.md): the
+    stacked layer shards are parked in HOST memory and each per-layer slice
+    is routed through ``memory.placement.to_device`` BEFORE the gather
+    constraint — the host→HBM copy-in rides the same ahead-of-compute
+    pipeline as the all-gather (identity on single-memory backends, so the
+    math stays the plain scan's bit for bit everywhere).
+
     Takes effect at the next train-step trace; call BEFORE the first
     ``train_batch`` of the engine that wants it."""
     _LAYER_PREFETCH["enabled"] = bool(enabled)
@@ -292,11 +301,12 @@ def configure_layer_prefetch(enabled: bool, depth: int = 1,
     _LAYER_PREFETCH["shardings"] = shardings
     _LAYER_PREFETCH["quantize"] = quantize
     _LAYER_PREFETCH["gather_axes"] = tuple(gather_axes or ())
+    _LAYER_PREFETCH["host_tier"] = bool(host_tier)
 
 
 def reset_layer_prefetch() -> None:
     configure_layer_prefetch(False, depth=1, shardings=None, quantize=None,
-                             gather_axes=())
+                             gather_axes=(), host_tier=False)
 
 
 def layer_prefetch_active() -> bool:
@@ -429,10 +439,20 @@ def prefetch_scan(body, init, layers, depth: Optional[int] = None,
     depth = max(1, min(int(depth), n_layers))
     _record_prefetch_gathers(layers, n_layers, quantize)
 
+    host_tier = bool(_LAYER_PREFETCH.get("host_tier"))
+
     def gather(i):
         sliced = jax.tree.map(
             lambda a: lax.dynamic_index_in_dim(a, i, 0, keepdims=False),
             layers)
+        if host_tier:
+            # host-parked layer stack (memory.tiering.param_tier=host): the
+            # slice's host→HBM copy-in is issued here, a layer ahead of its
+            # compute — the same pipeline slot as the all-gather. Identity
+            # on single-memory backends.
+            from ..memory.placement import tree_to_device
+
+            sliced = tree_to_device(sliced)
         return _constrain_layer(sliced, shardings, quantize)
 
     if depth == 1:

@@ -49,6 +49,13 @@ class PrefixCacheConfig:
     # 0 = share blocks between live sequences but retain nothing after
     # retire, >0 = keep at most this many unreferenced blocks
     max_retained_blocks: int = -1
+    # host-spill tier (docs/memory.md): evicted unreferenced blocks copy to
+    # a host pool keyed by their chain hash instead of being dropped, and
+    # admissions restore spilled blocks on a prefix hit — the retained pool
+    # multiplies past HBM. OFF → the pre-spill eviction path, byte-identical.
+    host_spill: bool = False
+    # host-pool cap in blocks: -1 = unbounded (host RAM is the budget)
+    max_spilled_blocks: int = -1
 
 
 @dataclass

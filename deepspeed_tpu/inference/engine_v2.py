@@ -96,6 +96,23 @@ class InferenceEngineV2(InferenceEngine):
         self.cache = self._init_paged(self.family.cfg, rc.memory_config_blocks,
                                       rc.block_size)
         self._paged_fns: Dict[Tuple, Callable] = {}
+        # --- host-spill tier for evicted prefix-cache blocks
+        # (inference.prefix_cache.host_spill; docs/memory.md). Default OFF →
+        # the eviction path is exactly the pre-spill one. When ON, evicted
+        # unreferenced blocks copy D2H (async, on the tier transfer worker)
+        # into a HostKVPool keyed by chain hash, and admit_prompt restores
+        # them into fresh device blocks on a prefix hit.
+        self._kv_spill = None
+        if pc.enabled and getattr(pc, "host_spill", False):
+            from ..memory import HostKVPool, TransferWorker
+
+            self._tier_worker = TransferWorker(name="dstpu-kv-spill")
+            self._kv_spill = HostKVPool(
+                max_blocks=int(getattr(pc, "max_spilled_blocks", -1)),
+                worker=self._tier_worker)
+            self.state.enable_host_spill(self._kv_spill,
+                                         self._spill_read_block,
+                                         self._spill_write_block)
         # persistent device-side slot state
         B = rc.max_tracked_sequences
         self._slot_tokens = np.zeros((B,), np.int32)
@@ -405,6 +422,38 @@ class InferenceEngineV2(InferenceEngine):
 
             self._paged_fns[key] = self._jit(key, cp, donate_argnums=(0,))
         return self._paged_fns[key]
+
+    def _spill_read_block(self, b: int):
+        """One block's per-cache-leaf contents as PRIVATE device slices —
+        the eviction path hands these to the HostKVPool, whose transfer
+        worker materializes the host copies asynchronously (the slice is a
+        fresh buffer, so the source block may be reclaimed and rewritten
+        immediately)."""
+        return [leaf[:, b] for leaf in jax.tree.leaves(self.cache)]
+
+    def _spill_write_fn(self):
+        """One compiled whole-block write into the KV pool — the device
+        half of a host-spill restore (dst is a traced scalar; one compile
+        total, like ``_copy_block_fn``)."""
+        key = ("spill_write",)
+        if key not in self._paged_fns:
+
+            def wr(cache, dst, data):
+                leaves, tdef = jax.tree_util.tree_flatten(cache)
+                new = [c.at[:, dst].set(d.astype(c.dtype))
+                       for c, d in zip(leaves, data)]
+                return jax.tree_util.tree_unflatten(tdef, new)
+
+            self._paged_fns[key] = self._jit(key, wr, donate_argnums=(0,))
+        return self._paged_fns[key]
+
+    def _spill_write_block(self, b: int, data) -> None:
+        """Stamp spilled host contents into freshly allocated block ``b``
+        before the admission that restored it dispatches."""
+        fn = self._spill_write_fn()
+        leaves = jax.tree.leaves(self.cache)
+        dev = [jnp.asarray(d) for d, _ in zip(data, leaves)]
+        self.cache = fn(self.cache, jnp.asarray(b, jnp.int32), dev)
 
     def _copy_blocks(self, pairs) -> None:
         """Apply the (src, dst) copies ``StateManager.ensure_writable``
@@ -1219,6 +1268,8 @@ class InferenceEngineV2(InferenceEngine):
         serving bench's JSONL sink for ``telemetry_report.py --serving``."""
         stats = dict(self.state.prefix_stats)
         stats["retained_blocks"] = self.state.retained_blocks
+        if self._kv_spill is not None:
+            stats["spilled_blocks"] = self._kv_spill.spilled_blocks
         return [(f"Serving/prefix_cache/{k}", float(v), step)
                 for k, v in sorted(stats.items())]
 
@@ -1227,6 +1278,16 @@ class InferenceEngineV2(InferenceEngine):
         if self._hub is not None:
             for name, value, s in events:
                 self._hub.serving_event(name, value, s)
+            if self._kv_spill is not None:
+                # the host pool is a memory TIER — its occupancy also lands
+                # in the closed Memory/tier/* family beside the training
+                # store's gauges (telemetry_report.py --memory)
+                pool = self._kv_spill
+                for k, v in (("kv_spilled_blocks", pool.spilled_blocks),
+                             ("kv_spilled_bytes", pool.spilled_bytes),
+                             ("kv_spills", pool.stats["spills"]),
+                             ("kv_restores", pool.stats["restores"])):
+                    self._hub.memory_tier_event(k, float(v), step)
         return events
 
     # ------------------------------------------------------------------ #

@@ -200,6 +200,11 @@ class PrefixBlockIndex:
     def is_indexed(self, block: int) -> bool:
         return block in self._hash_of
 
+    def hash_of(self, block: int) -> Optional[bytes]:
+        """The chain key ``block`` is indexed under (None if unindexed) —
+        the host-spill path reads it BEFORE eviction drops the entry."""
+        return self._hash_of.get(block)
+
     def drop(self, block: int) -> None:
         """Forget a block entirely (it is being freed / reallocated)."""
         h = self._hash_of.pop(block, None)
@@ -275,7 +280,16 @@ class StateManager:
         self.index = PrefixBlockIndex(max_retained_blocks)
         self.prefix_stats: Dict[str, int] = {
             "lookups": 0, "hits": 0, "hit_tokens": 0,
-            "prefill_tokens_saved": 0, "evictions": 0, "cow_copies": 0}
+            "prefill_tokens_saved": 0, "evictions": 0, "cow_copies": 0,
+            "spills": 0, "restores": 0, "restored_tokens": 0}
+        # host-spill tier (inference.prefix_cache.host_spill; docs/memory.md):
+        # evicted unreferenced blocks copy to a HostKVPool keyed by their
+        # chain hash instead of being dropped, and admit_prompt restores
+        # spilled blocks on a prefix hit. Wired by the engine via
+        # enable_host_spill; None → the pre-spill eviction path, unchanged.
+        self.spill_pool = None
+        self._spill_read = None      # block id → per-cache-leaf host copies
+        self._spill_write = None     # (block id, data) → device write
 
     @property
     def free_slots(self) -> int:
@@ -346,10 +360,36 @@ class StateManager:
         avail = self.allocator.free_blocks + self.index.retained_blocks
         return bool(self._free_slots) and avail >= self._admit_need(prompt_len)
 
+    def enable_host_spill(self, pool, reader, writer) -> None:
+        """Arm the host-spill tier: ``pool`` is a
+        :class:`~deepspeed_tpu.memory.HostKVPool`, ``reader(block)`` returns
+        the block's per-cache-leaf contents (host-materializable), and
+        ``writer(block, data)`` stamps spilled contents into a freshly
+        allocated device block. Called by the engine when
+        ``inference.prefix_cache.host_spill`` is on."""
+        self.spill_pool = pool
+        self._spill_read = reader
+        self._spill_write = writer
+
+    def _evict_retained(self) -> Optional[int]:
+        """Evict the LRU retained block — the ONE spot every eviction path
+        funnels through. With the spill tier armed, the block's KV copies to
+        the host pool under its chain hash BEFORE ``pop_lru`` drops the
+        index entry (read the hash first: pop_lru is the single point that
+        removes it, so the entry is dropped exactly once)."""
+        if self.spill_pool is not None:
+            b = next(iter(self.index._lru), None)
+            if b is not None:
+                h = self.index.hash_of(b)
+                if h is not None and h not in self.spill_pool:
+                    self.spill_pool.put(h, self._spill_read(b))
+                    self.prefix_stats["spills"] += 1
+        return self.index.pop_lru()
+
     def _reclaim(self, n_needed: int) -> None:
         """Evict retained LRU blocks until ``n_needed`` are allocatable."""
         while self.allocator.free_blocks < n_needed:
-            b = self.index.pop_lru()
+            b = self._evict_retained()
             if b is None:
                 break
             self.allocator.reclaim(b)
@@ -358,11 +398,15 @@ class StateManager:
     def admit(self, uid: int, prompt_len: int) -> SequenceDescriptor:
         if uid in self.seqs:
             raise ValueError(f"uid {uid} already tracked")
+        if not self._free_slots:
+            raise MemoryError("no free sequence slots")
         need = self._admit_need(prompt_len)
         self._reclaim(need)
-        slot = self._free_slots.pop()
-        desc = SequenceDescriptor(uid=uid, slot=slot,
-                                  blocks=self.allocator.allocate(need))
+        # allocate BEFORE popping the slot: a pool-exhausted MemoryError
+        # must not leak a sequence slot (debug_check-pinned)
+        blocks = self.allocator.allocate(need)
+        desc = SequenceDescriptor(uid=uid, slot=self._free_slots.pop(),
+                                  blocks=blocks)
         self.seqs[uid] = desc
         return desc
 
@@ -382,6 +426,8 @@ class StateManager:
             return desc, 0
         if uid in self.seqs:
             raise ValueError(f"uid {uid} already tracked")
+        if not self._free_slots:
+            raise MemoryError("no free sequence slots")
         bs = self.block_size
         need = self._admit_need(len(prompt))
         hashes = PrefixBlockIndex.chain_hashes(
@@ -391,6 +437,29 @@ class StateManager:
         for b in matched:               # reactivate/share before any eviction
             self.allocator.incref(b)    # can evict them out from under us
             self.index.lru_remove(b)
+        if self.spill_pool is not None and self._spill_write is not None:
+            # extend the resident match through the host-spill tier: each
+            # spilled chain hash restores into a freshly allocated device
+            # block (capacity via the NORMAL eviction path — _reclaim — so
+            # a full pool degrades to a miss instead of over-committing)
+            # and rejoins the index as the canonical block. A restored
+            # block covers a block `fresh` would otherwise allocate, so
+            # total blocks claimed never exceeds the plain admission's.
+            for h in hashes[len(matched):]:
+                data = self.spill_pool.get(h)
+                if data is None:
+                    break
+                self._reclaim(1)
+                if self.allocator.free_blocks < 1:
+                    break               # every block is live — normal miss
+                blk = self.allocator.allocate(1)[0]
+                self._spill_write(blk, data)
+                self.index.insert(blk, h)
+                self.spill_pool.pop(h)  # the device copy is canonical again
+                self.spill_pool.note_restore()
+                matched.append(blk)
+                self.prefix_stats["restores"] += 1
+                self.prefix_stats["restored_tokens"] += bs
         try:
             self._reclaim(need - len(matched))
             fresh = self.allocator.allocate(need - len(matched))
@@ -474,7 +543,11 @@ class StateManager:
             h = PrefixBlockIndex.chunk_hash(parent,
                                             desc.tokens[i * bs:(i + 1) * bs])
             desc.block_hashes.append(h)
-            self.index.insert(desc.blocks[i], h)
+            if self.index.insert(desc.blocks[i], h) and \
+                    self.spill_pool is not None:
+                # a resident block just became canonical for this prefix —
+                # any host copy under the same chain hash is redundant
+                self.spill_pool.pop(h)
 
     def truncate(self, desc: SequenceDescriptor,
                  new_len: int) -> List[Tuple[int, int]]:
@@ -551,7 +624,7 @@ class StateManager:
         if self.prefix_cache and cap != 0 and self.index.is_indexed(b):
             self.index.lru_add(b)
             while cap >= 0 and self.index.retained_blocks > cap:
-                evicted = self.index.pop_lru()
+                evicted = self._evict_retained()
                 self.allocator.reclaim(evicted)
                 self.prefix_stats["evictions"] += 1
         else:
@@ -597,6 +670,12 @@ class StateManager:
                 f"block {b} state invalid (free/live/retained = {states})"
         for b in retained:
             assert self.index.is_indexed(b), f"retained block {b} not indexed"
+        if self.spill_pool is not None:
+            # spill-then-evict drops the resident index entry exactly once:
+            # a chain hash is resident-canonical OR host-spilled, never both
+            inter = set(self.spill_pool.keys()) & set(self.index._by_hash)
+            assert not inter, \
+                f"{len(inter)} chain hashes both spilled and resident"
         assert len(free) + len(live_refs) + len(retained) == \
             alloc.num_blocks - 1, "free + live + retained != pool size"
         n_slots = len(self._free_slots) + len(self.seqs)

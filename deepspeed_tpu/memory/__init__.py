@@ -1,0 +1,45 @@
+"""Tiered memory subsystem — host RAM (and disk) as explicit capacity tiers.
+
+The ZeRO-Infinity direction (PAPERS.md, arXiv:2104.07857) for TPU: model
+state larger than HBM-per-chip trains and serves by placing cold pytree
+leaves on an explicit tier — HBM (``device``), pinned host RAM (``host``),
+or a host-file "nvme" tier (``file``) — with asynchronous, double-buffered
+device↔host transfers driven from a background transfer worker so the copies
+hide behind compute.
+
+Three layers:
+
+- :mod:`placement` — memory-space capability probing and the in-jit /
+  eager placement primitives (``to_host``/``to_device``/``move_tree``).
+  On backends with real separate memory spaces (TPU ``pinned_host``) these
+  lower to XLA host-memory annotations; on single-space backends (the CPU
+  test mesh) eager moves fall back to :class:`~placement.HostBuffer` numpy
+  residency and in-jit annotations are identity — same API, no branches in
+  caller code.
+- :mod:`tiered_store` — :class:`~tiered_store.TieredStore`: pytree
+  offload/restore/prefetch across tiers, the shared
+  :class:`~tiered_store.TransferWorker`, byte accounting per tier, and the
+  ``Memory/tier/*`` telemetry series (transfer overlap fraction, prefetch
+  hit/miss — telemetry/schema.py ``MEMORY_TIER_SERIES``).
+- :mod:`kv_spill` — :class:`~kv_spill.HostKVPool`: the serving consumer's
+  host pool for evicted prefix-cache KV blocks, keyed by the prefix index's
+  chain hashes (``inference/ragged.py``; docs/memory.md).
+
+Consumers: ``runtime/offload_states.py`` (the ``offload_states`` /
+``reload_states`` engine API), the engine's ``memory.tiering``
+optimizer-offload train path, ``runtime/superoffload.py``, and the v2
+serving engine's ``inference.prefix_cache.host_spill`` path.
+"""
+
+from .kv_spill import HostKVPool
+from .placement import (HostBuffer, default_memory_kind, host_memory_kind,
+                        move_tree, offloaded_memory_kinds,
+                        supports_memory_kind, to_device, to_host)
+from .tiered_store import (PrefetchHandle, TieredStore, TransferWorker)
+
+__all__ = [
+    "HostBuffer", "HostKVPool", "PrefetchHandle", "TieredStore",
+    "TransferWorker", "default_memory_kind", "host_memory_kind",
+    "move_tree", "offloaded_memory_kinds", "supports_memory_kind",
+    "to_device", "to_host",
+]

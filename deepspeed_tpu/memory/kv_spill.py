@@ -1,0 +1,125 @@
+"""HostKVPool — host-RAM spill tier for evicted prefix-cache KV blocks.
+
+The serving consumer of the tiered memory subsystem (docs/memory.md,
+docs/serving.md): when the paged allocator's retained prefix pool evicts an
+unreferenced block under allocation pressure, the block's KV contents are
+copied to this host pool KEYED BY ITS EXISTING CHAIN HASH
+(``inference/ragged.py PrefixBlockIndex`` — the key already proves the whole
+token prefix, so a host entry is exactly as matchable as a resident block).
+``admit_prompt`` extends its longest-resident-prefix match through the pool:
+spilled blocks restore into freshly allocated device blocks and rejoin the
+index, multiplying the retained pool past HBM.
+
+Entries are ``(chain_hash → list of per-cache-leaf block arrays)``; the
+device→host copy may ride a :class:`~tiered_store.TransferWorker` (async,
+overlapped with serving compute) — ``get`` resolves any in-flight copy.
+LRU-bounded by block count (``max_blocks``) with byte accounting.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+class HostKVPool:
+    def __init__(self, max_blocks: int = -1, worker: Any = None):
+        self.max_blocks = int(max_blocks)
+        self.worker = worker
+        self._lock = threading.Lock()
+        # hash → list-of-arrays OR a Future resolving to one
+        self._entries: "OrderedDict[bytes, Any]" = OrderedDict()
+        self._bytes: Dict[bytes, int] = {}
+        self.stats: Dict[str, int] = {
+            "spills": 0, "restores": 0, "spill_evictions": 0,
+            "spilled_bytes": 0}
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def __contains__(self, h: bytes) -> bool:
+        with self._lock:
+            return h in self._entries
+
+    @property
+    def spilled_blocks(self) -> int:
+        return len(self)
+
+    @property
+    def spilled_bytes(self) -> int:
+        return int(self.stats["spilled_bytes"])
+
+    @staticmethod
+    def _nbytes(data: List[Any]) -> int:
+        return int(sum(getattr(d, "nbytes", 0) for d in data))
+
+    def put(self, h: bytes, block_data: List[Any]) -> None:
+        """Store one evicted block's per-leaf KV arrays under its chain
+        hash. ``block_data`` may be device arrays; the host materialization
+        runs on the transfer worker when one is attached (the snapshot
+        slices are already private copies, so the source block may be
+        reused immediately). Over-cap inserts evict the pool's own LRU."""
+        nbytes = self._nbytes(block_data)
+        if self.worker is not None:
+            entry = self.worker.submit(
+                lambda data=block_data: [np.asarray(d) for d in data])
+        else:
+            entry = [np.asarray(d) for d in block_data]
+        with self._lock:
+            if h in self._entries:       # same prefix re-spilled: refresh
+                self.stats["spilled_bytes"] -= self._bytes.pop(h, 0)
+                self._entries.pop(h)
+            self._entries[h] = entry
+            self._bytes[h] = nbytes
+            self.stats["spills"] += 1
+            self.stats["spilled_bytes"] += nbytes
+            while self.max_blocks >= 0 and len(self._entries) > self.max_blocks:
+                old, _ = self._entries.popitem(last=False)
+                self.stats["spilled_bytes"] -= self._bytes.pop(old, 0)
+                self.stats["spill_evictions"] += 1
+
+    def _resolve(self, h: bytes, entry: Any) -> Optional[List[np.ndarray]]:
+        if hasattr(entry, "result"):     # in-flight D2H copy
+            entry = entry.result()
+            with self._lock:
+                if h in self._entries:
+                    self._entries[h] = entry
+        return entry
+
+    def get(self, h: bytes) -> Optional[List[np.ndarray]]:
+        """The spilled block data for ``h`` (LRU-touched), or None."""
+        with self._lock:
+            entry = self._entries.get(h)
+            if entry is not None:
+                self._entries.move_to_end(h)
+        if entry is None:
+            return None
+        return self._resolve(h, entry)
+
+    def pop(self, h: bytes) -> Optional[List[np.ndarray]]:
+        """Remove and return the entry for ``h`` (restore consumed it, or a
+        resident canonical block makes the host copy redundant)."""
+        with self._lock:
+            entry = self._entries.pop(h, None)
+            if entry is None:
+                return None
+            self.stats["spilled_bytes"] -= self._bytes.pop(h, 0)
+        return self._resolve(h, entry)
+
+    def note_restore(self) -> None:
+        with self._lock:
+            self.stats["restores"] += 1
+
+    def keys(self):
+        with self._lock:
+            return list(self._entries.keys())
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._bytes.clear()
+            self.stats["spilled_bytes"] = 0

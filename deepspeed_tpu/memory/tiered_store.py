@@ -1,0 +1,455 @@
+"""TieredStore — pytree leaves on explicit memory tiers with async transfers.
+
+The core of the tiered memory subsystem (docs/memory.md): place the array
+leaves of a pytree on ``device`` (HBM), ``host`` (pinned host RAM), or
+``file`` (the host-file "nvme" tier, backed by the ``swap_tensor`` aio
+stack), and move them with asynchronous transfers driven from ONE background
+:class:`TransferWorker` so device↔host copies hide behind compute.
+
+Overlap accounting is measured, not asserted: the consumer brackets its
+device compute with :meth:`TieredStore.compute_window`, the worker records
+every transfer's wall interval, and ``overlap_frac`` is the measured
+fraction of total transfer time that intersected a compute window — the
+``Memory/tier/overlap_frac`` series the bench acceptance reads. The clock is
+injectable for deterministic ordering tests.
+
+Double-buffered prefetch: :meth:`prefetch` enqueues the host→device copies
+for a tree and returns a :class:`PrefetchHandle`; ``handle.wait()`` that
+finds every transfer already finished counts a prefetch HIT (the copy was
+fully hidden), otherwise a MISS (the consumer blocked on the tail of the
+transfer) — ``Memory/tier/prefetch_{hits,misses}``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from concurrent.futures import Future
+from contextlib import contextmanager
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ..utils.logging import log_dist
+from . import placement
+from .placement import HostBuffer
+
+Event = Tuple[str, float, int]
+
+TIERS = ("device", "host", "file")
+
+
+class TransferWorker:
+    """One daemon thread draining a FIFO of transfer jobs, with wall-clock
+    accounting of how much transfer time was hidden under compute windows.
+
+    Jobs are plain callables; :meth:`submit` returns a Future. The thread
+    starts lazily on the first submit, so constructing a store (every engine
+    does) costs nothing until a tier is actually used."""
+
+    def __init__(self, clock: Optional[Callable[[], float]] = None,
+                 name: str = "dstpu-tier-xfer"):
+        self.clock = clock or time.monotonic
+        self.name = name
+        self._lock = threading.Lock()
+        self._jobs: List[Tuple[Callable, Future]] = []
+        self._wake = threading.Condition(self._lock)
+        self._thread: Optional[threading.Thread] = None
+        self._closed = False
+        # accounting (under _lock)
+        self.busy_s = 0.0          # total transfer wall time
+        self.overlap_s = 0.0       # transfer time inside compute windows
+        self.jobs_done = 0
+        self._win_open: Optional[float] = None   # open compute window start
+        self._windows: List[Tuple[float, float]] = []  # closed, undrained
+
+    # -- compute windows ------------------------------------------------- #
+    def compute_begin(self) -> None:
+        with self._lock:
+            if self._win_open is None:
+                self._win_open = self.clock()
+
+    def compute_end(self) -> None:
+        with self._lock:
+            if self._win_open is not None:
+                self._windows.append((self._win_open, self.clock()))
+                if len(self._windows) > 256:
+                    del self._windows[:-256]
+                self._win_open = None
+
+    def _overlap_of(self, t0: float, t1: float) -> float:
+        """Intersection of [t0, t1] with the recorded compute windows (call
+        under _lock)."""
+        ov = 0.0
+        for w0, w1 in self._windows:
+            ov += max(0.0, min(t1, w1) - max(t0, w0))
+        if self._win_open is not None:
+            ov += max(0.0, t1 - max(t0, self._win_open))
+        return ov
+
+    # -- job loop -------------------------------------------------------- #
+    def _ensure_thread(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(target=self._loop, daemon=True,
+                                            name=self.name)
+            self._thread.start()
+
+    def _loop(self) -> None:
+        while True:
+            with self._wake:
+                while not self._jobs and not self._closed:
+                    self._wake.wait(timeout=1.0)
+                if self._closed and not self._jobs:
+                    return
+                fn, fut = self._jobs.pop(0)
+            t0 = self.clock()
+            try:
+                result = fn()
+            except BaseException as e:  # delivered at .result()
+                fut.set_exception(e)
+            else:
+                fut.set_result(result)
+            t1 = self.clock()
+            with self._lock:
+                self.busy_s += t1 - t0
+                self.overlap_s += self._overlap_of(t0, t1)
+                self.jobs_done += 1
+
+    def submit(self, fn: Callable[[], Any]) -> Future:
+        fut: Future = Future()
+        with self._wake:
+            if self._closed:
+                raise RuntimeError("TransferWorker is closed")
+            self._jobs.append((fn, fut))
+            self._wake.notify()
+        self._ensure_thread()
+        return fut
+
+    def drain(self) -> None:
+        """Block until every previously submitted job has run (a sentinel
+        job is the fence; FIFO order guarantees it runs last)."""
+        if self._thread is not None and self._thread.is_alive():
+            self.submit(lambda: None).result()
+
+    def overlap_frac(self) -> float:
+        with self._lock:
+            return self.overlap_s / self.busy_s if self.busy_s > 0 else 0.0
+
+    def close(self) -> None:
+        with self._wake:
+            self._closed = True
+            self._wake.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+
+class PrefetchHandle:
+    """Futures for one prefetched pytree. ``wait()`` assembles the restored
+    tree; it counts a HIT on the owning store when every transfer had
+    already finished (the copy was fully hidden behind compute)."""
+
+    def __init__(self, store: "TieredStore", treedef, futures: List[Future],
+                 passthrough: List[Any], mask: List[bool]):
+        self._store = store
+        self._treedef = treedef
+        self._futures = futures
+        self._passthrough = passthrough
+        self._mask = mask
+        self._done = False
+
+    def ready(self) -> bool:
+        return all(f.done() for f in self._futures)
+
+    def wait(self):
+        if self._done:
+            raise RuntimeError("PrefetchHandle.wait() called twice")
+        self._done = True
+        hit = self.ready()
+        self._store._note_prefetch(hit)
+        leaves, fi = [], 0
+        for is_fut, leaf in zip(self._mask, self._passthrough):
+            if is_fut:
+                leaves.append(self._futures[fi].result())
+                fi += 1
+            else:
+                leaves.append(leaf)
+        return jax.tree_util.tree_unflatten(self._treedef, leaves)
+
+
+class TieredStore:
+    """Explicit-tier placement for pytrees with async double-buffered
+    transfers and closed ``Memory/tier/*`` telemetry.
+
+    ``host`` tier: real memory-kind arrays where the backend has a host
+    space, :class:`HostBuffer` numpy residency otherwise (see
+    :mod:`placement`). ``file`` tier: one ``.swp`` file per leaf through the
+    ``swap_tensor`` aio stack (``AsyncTensorSwapper``) — leaves become
+    ``SwappedTensorMeta`` records. Byte accounting per tier feeds
+    ``Memory/tier/resident_bytes_{host,file}`` / ``spilled_bytes``;
+    transfers feed ``transfer_{d2h,h2d}_bytes`` and the worker's measured
+    ``overlap_frac`` (see module docstring)."""
+
+    def __init__(self, config: Any = None, *,
+                 nvme_dir: Optional[str] = None,
+                 pin_memory: Optional[bool] = None,
+                 clock: Optional[Callable[[], float]] = None,
+                 worker: Optional[TransferWorker] = None):
+        self.cfg = config
+        self.pin = bool(getattr(config, "pin_memory", True)
+                        if pin_memory is None else pin_memory)
+        self.nvme_dir = nvme_dir or getattr(config, "nvme_path", None)
+        self.worker = worker or TransferWorker(clock=clock)
+        self._swappers: Dict[str, Any] = {}
+        self._lock = threading.Lock()
+        self.stats: Dict[str, float] = {
+            "resident_bytes_host": 0.0, "resident_bytes_file": 0.0,
+            "transfer_d2h_bytes": 0.0, "transfer_h2d_bytes": 0.0,
+            "prefetch_hits": 0.0, "prefetch_misses": 0.0,
+            "offloads": 0.0, "restores": 0.0,
+        }
+
+    # -- accounting ------------------------------------------------------ #
+    def _track(self, key: str, delta: float) -> None:
+        with self._lock:
+            self.stats[key] = self.stats.get(key, 0.0) + delta
+
+    def _note_prefetch(self, hit: bool) -> None:
+        self._track("prefetch_hits" if hit else "prefetch_misses", 1.0)
+
+    def resident_bytes(self, tier: str) -> int:
+        return int(self.stats.get(f"resident_bytes_{tier}", 0.0))
+
+    def overlap_frac(self) -> float:
+        return self.worker.overlap_frac()
+
+    @contextmanager
+    def compute_window(self):
+        """Bracket device compute so transfer overlap can be measured."""
+        self.worker.compute_begin()
+        try:
+            yield
+        finally:
+            self.worker.compute_end()
+
+    # -- host tier ------------------------------------------------------- #
+    @staticmethod
+    def _leaf_bytes(leaf) -> int:
+        nbytes = getattr(leaf, "nbytes", None)
+        if nbytes is not None:
+            return int(nbytes)
+        try:
+            return int(np.prod(leaf.shape, dtype=np.int64)
+                       * np.dtype(leaf.dtype).itemsize)
+        except Exception:
+            return 0
+
+    def offload(self, tree: Any, tier: str = "host", *,
+                name: str = "tree", async_: bool = True) -> Any:
+        """Move the array leaves of ``tree`` to ``tier``; returns the
+        replaced tree immediately. Host-tier D2H copies run on the transfer
+        worker when ``async_`` (single-memory backends — the copies are real
+        numpy materializations there); leaves carry futures transparently:
+        the returned tree's ``HostBuffer`` data fields are filled when the
+        worker finishes, and :meth:`restore`/:meth:`prefetch` synchronize.
+        ``file`` tier writes through the aio swapper (bounded, synchronous
+        publish so the ``.swp`` files exist on return)."""
+        if tier == "file":
+            return self._offload_file(tree, name)
+        if tier != "host":
+            raise ValueError(f"offload tier {tier!r} not in ('host', 'file')")
+        kind = placement.host_memory_kind(pin=self.pin)
+
+        def one(leaf):
+            if not isinstance(leaf, jax.Array):
+                return leaf
+            n = self._leaf_bytes(leaf)
+            self._track("transfer_d2h_bytes", n)
+            self._track("resident_bytes_host", n)
+            if kind is not None:
+                # real host memory space: device_put is itself async DMA
+                sh = leaf.sharding
+                if getattr(sh, "memory_kind", None) == kind:
+                    return leaf
+                return jax.device_put(leaf, sh.with_memory_kind(kind))
+            buf = HostBuffer(None, placement.PINNED if self.pin
+                             else placement.UNPINNED, sharding=leaf.sharding)
+            if async_:
+                fut = self.worker.submit(lambda l=leaf: np.asarray(l))
+                buf.data = _LazyArray(fut, leaf.shape, leaf.dtype)
+            else:
+                buf.data = np.asarray(leaf)
+            return buf
+
+        out = jax.tree.map(one, tree)
+        self._track("offloads", 1.0)
+        return out
+
+    def restore(self, tree: Any, shardings: Any = None) -> Any:
+        """Bring every offloaded leaf of ``tree`` back to device memory,
+        synchronously (prefetch + wait). ``shardings``: optional pytree of
+        target shardings overriding each leaf's recorded one."""
+        return self.prefetch(tree, shardings).wait()
+
+    def prefetch(self, tree: Any, shardings: Any = None) -> PrefetchHandle:
+        """Enqueue host→device copies for every offloaded leaf; returns a
+        :class:`PrefetchHandle` (``wait()`` → restored tree). File-tier
+        leaves issue their aio reads first, then device_put on the worker."""
+        leaves, treedef = jax.tree_util.tree_flatten(
+            tree, is_leaf=_is_tier_leaf)
+        sh_leaves = [None] * len(leaves)
+        if shardings is not None:
+            sh_flat = jax.tree_util.tree_flatten(shardings)[0]
+            if len(sh_flat) == len(leaves):
+                sh_leaves = sh_flat
+        futures: List[Future] = []
+        mask: List[bool] = []
+        for leaf, sh in zip(leaves, sh_leaves):
+            job = self._restore_job(leaf, sh)
+            if job is None:
+                mask.append(False)
+            else:
+                futures.append(self.worker.submit(job))
+                mask.append(True)
+        self._track("restores", 1.0)
+        return PrefetchHandle(self, treedef, futures, leaves, mask)
+
+    def _restore_job(self, leaf, sharding) -> Optional[Callable[[], Any]]:
+        from ..runtime.swap_tensor.swapper import SwappedTensorMeta
+
+        if isinstance(leaf, HostBuffer):
+            n = self._leaf_bytes(leaf)
+
+            def job(buf=leaf, sh=sharding, n=n):
+                data = buf.data
+                if isinstance(data, _LazyArray):
+                    data = data.resolve()
+                self._track("transfer_h2d_bytes", n)
+                self._track("resident_bytes_host", -n)
+                target = sh if sh is not None else buf.sharding
+                return jax.device_put(data, target) if target is not None \
+                    else jax.device_put(data)
+
+            return job
+        if isinstance(leaf, SwappedTensorMeta):
+            swapper = self._swapper_for(leaf)
+            buf = swapper.start_swap_in(leaf)  # aio read issued NOW
+            n = leaf.nbytes()
+
+            def job(meta=leaf, buf=buf, sw=swapper, sh=sharding, n=n):
+                sw.wait()
+                self._track("transfer_h2d_bytes", n)
+                self._track("resident_bytes_file", -n)
+                sw.remove(meta)
+                return jax.device_put(buf, sh) if sh is not None \
+                    else jax.device_put(buf)
+
+            return job
+        if isinstance(leaf, jax.Array):
+            kind = getattr(leaf.sharding, "memory_kind", None)
+            default = placement.default_memory_kind()
+            if kind is not None and kind != default:
+                n = self._leaf_bytes(leaf)
+
+                def job(l=leaf, n=n):
+                    self._track("transfer_h2d_bytes", n)
+                    self._track("resident_bytes_host", -n)
+                    return jax.device_put(
+                        l, l.sharding.with_memory_kind(default))
+
+                return job
+        return None
+
+    # -- file tier ------------------------------------------------------- #
+    def _file_dir(self, name: str) -> str:
+        import tempfile
+
+        base = self.nvme_dir or os.path.join(tempfile.gettempdir(),
+                                             "dstpu_tier_file")
+        return os.path.join(base, name)
+
+    def _swapper_for(self, meta) -> Any:
+        from ..runtime.swap_tensor.swapper import AsyncTensorSwapper
+
+        d = os.path.dirname(meta.path)
+        if d not in self._swappers:
+            self._swappers[d] = AsyncTensorSwapper(d)
+        return self._swappers[d]
+
+    def _offload_file(self, tree: Any, name: str) -> Any:
+        from ..runtime.swap_tensor.swapper import AsyncTensorSwapper
+
+        swap_dir = self._file_dir(name)
+        swapper = self._swappers.get(swap_dir)
+        if swapper is None:
+            swapper = self._swappers[swap_dir] = AsyncTensorSwapper(swap_dir)
+        flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+        from ..utils.tree import path_to_str
+
+        metas = []
+        for i, (path, leaf) in enumerate(flat):
+            if not isinstance(leaf, (jax.Array, np.ndarray, HostBuffer)):
+                metas.append(leaf)
+                continue
+            arr = np.asarray(leaf)
+            n = int(arr.nbytes)
+            self._track("transfer_d2h_bytes", n)
+            self._track("resident_bytes_file", n)
+            metas.append(swapper.swap_out(
+                f"{i:05d}_{path_to_str(path, '_') or 'leaf'}", arr))
+        swapper.wait()
+        self._track("offloads", 1.0)
+        log_dist(f"TieredStore: {len(metas)} leaves -> file tier ({swap_dir})")
+        return jax.tree_util.tree_unflatten(treedef, metas)
+
+    # -- telemetry ------------------------------------------------------- #
+    def events(self, step: int = 0) -> List[Event]:
+        """Closed ``Memory/tier/*`` series (telemetry/schema.py
+        MEMORY_TIER_SERIES) for one drain point."""
+        with self._lock:
+            snap = dict(self.stats)
+        with self.worker._lock:
+            busy, ov = self.worker.busy_s, self.worker.overlap_s
+        evs = [(f"Memory/tier/{k}", float(v), step)
+               for k, v in sorted(snap.items())]
+        evs.append(("Memory/tier/transfer_busy_ms", busy * 1e3, step))
+        evs.append(("Memory/tier/overlap_ms", ov * 1e3, step))
+        evs.append(("Memory/tier/overlap_frac",
+                    ov / busy if busy > 0 else 0.0, step))
+        return evs
+
+    def close(self) -> None:
+        self.worker.close()
+
+
+class _LazyArray:
+    """A numpy-array-to-be: the D2H copy is still on the worker. Resolves
+    (and caches) on first use; ``HostBuffer.__array__`` reaches it through
+    ``np.asarray``."""
+
+    __slots__ = ("_fut", "shape", "dtype", "_value")
+
+    def __init__(self, fut: Future, shape, dtype):
+        self._fut = fut
+        self.shape = tuple(shape)
+        self.dtype = np.dtype(dtype)
+        self._value = None
+
+    def resolve(self) -> np.ndarray:
+        if self._value is None:
+            self._value = self._fut.result()
+        return self._value
+
+    @property
+    def nbytes(self) -> int:
+        return int(np.prod(self.shape, dtype=np.int64) * self.dtype.itemsize)
+
+    def __array__(self, dtype=None):
+        return np.asarray(self.resolve(), dtype)
+
+
+def _is_tier_leaf(x) -> bool:
+    from ..runtime.swap_tensor.swapper import SwappedTensorMeta
+
+    return isinstance(x, (HostBuffer, SwappedTensorMeta))

@@ -169,6 +169,12 @@ def checkpoint(function: Callable, *args, policy: Optional[str] = None,
     wrapped = jax.checkpoint(function, policy=get_policy(name),
                              prevent_cse=prevent_cse,
                              static_argnums=static_argnums)
+    # Bare remat executes its body (and the backward's replay) as ONE fused
+    # XLA computation, whose scheduling can differ from op-by-op eager
+    # dispatch by float-noise; the jit wrapper makes checkpoint() grads
+    # match plain jax.grad exactly, eagerly and under autodiff traces, and
+    # is a semantic no-op (inlined pjit) under an outer jit.
+    wrapped = jax.jit(wrapped, static_argnums=static_argnums)
     return wrapped(*args)
 
 

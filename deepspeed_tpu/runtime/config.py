@@ -414,6 +414,41 @@ class WatchdogConfig(ConfigModel):
 
 @register_config_model
 @dataclass
+class MemoryTieringConfig(ConfigModel):
+    """``memory.tiering`` block — the tiered memory subsystem
+    (``deepspeed_tpu/memory``; docs/memory.md). Default OFF: the training
+    step is the exact pre-tiering program, byte-identical (pinned by parity
+    tests in tests/test_tiered_memory.py).
+
+    ``optimizer_tier='host'`` keeps the optimizer state (fp32 masters'
+    moments) host-resident between steps: the H2D restore prefetches on the
+    transfer worker UNDER the fwd/bwd grad computation and the D2H
+    writeback of the updated state overlaps the NEXT step — measured via
+    ``Memory/tier/overlap_frac``. ``optimizer_tier='nvme'`` is the
+    ZeRO-Infinity disk tier (``zero_optimization.offload_optimizer
+    device=nvme`` is the streamed equivalent and remains supported).
+
+    ``param_tier='host'`` parks cold ZeRO-3 stacked layer shards in host
+    memory; the per-layer host→HBM copy-in rides the SAME pipeline as
+    ``comms_overlap.layer_prefetch`` (the gather-to-compute constraint is
+    issued a layer ahead — compose rule in docs/memory.md). Real on
+    backends with a host memory space (TPU); identity on the CPU mesh."""
+    enabled: bool = False
+    optimizer_tier: str = "none"   # none | host | nvme
+    param_tier: str = "none"       # none | host (needs layer_prefetch)
+    pin_memory: bool = True
+    nvme_path: Optional[str] = None
+
+
+@register_config_model
+@dataclass
+class MemoryConfig(ConfigModel):
+    """Top-level ``memory`` block (tiering sub-block; docs/memory.md)."""
+    tiering: MemoryTieringConfig = field(default_factory=MemoryTieringConfig)
+
+
+@register_config_model
+@dataclass
 class AIOConfig(ConfigModel):
     """Reference: ``runtime/swap_tensor/aio_config.py``."""
     block_size: int = 1048576
@@ -455,6 +490,7 @@ class DeepSpeedTPUConfig:
     checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
     watchdog: WatchdogConfig = field(default_factory=WatchdogConfig)
     telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
+    memory: MemoryConfig = field(default_factory=MemoryConfig)
     aio: AIOConfig = field(default_factory=AIOConfig)
 
     gradient_clipping: float = 0.0
@@ -531,6 +567,7 @@ _SUBCONFIG_KEYS = {
     "checkpoint": CheckpointConfig,
     "watchdog": WatchdogConfig,
     "telemetry": TelemetryConfig,
+    "memory": MemoryConfig,
     "aio": AIOConfig,
 }
 

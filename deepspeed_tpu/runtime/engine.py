@@ -323,10 +323,26 @@ class DeepSpeedTPUEngine:
         self._log_zero_sharding_summary(shapes, opt_specs)
 
         # --- ZeRO-Infinity: NVMe-streamed optimizer tier (reference
-        # stage3.py:2412 sub-group swap cycle; offload_config device=nvme) ---
+        # stage3.py:2412 sub-group swap cycle; offload_config device=nvme,
+        # also reachable via memory.tiering.optimizer_tier=nvme) ---
+        mt = config.memory.tiering
         self._nvme_opt = None
-        if config.zero_config.offload_optimizer.device == "nvme":
+        if config.zero_config.offload_optimizer.device == "nvme" or \
+                (mt.enabled and mt.optimizer_tier == "nvme"):
             self._configure_nvme_optimizer(params)
+        # --- tiered memory: host-resident optimizer state with prefetch
+        # overlapped under fwd/bwd (memory.tiering; docs/memory.md) ---
+        self._tiered_opt = bool(mt.enabled and mt.optimizer_tier == "host")
+        self._tiered_grad_step = None
+        if self._tiered_opt:
+            if self._nvme_opt is not None:
+                raise ValueError("memory.tiering.optimizer_tier=host and an "
+                                 "nvme optimizer tier are mutually exclusive")
+            if jax.process_count() > 1:
+                raise ValueError(
+                    "memory.tiering.optimizer_tier=host is single-host for "
+                    "now: the host tier materializes full numpy leaves, "
+                    "which fails on non-addressable multi-host arrays")
 
         with mesh_mgr.activate():
             if self._nvme_opt is not None:
@@ -365,6 +381,23 @@ class DeepSpeedTPUEngine:
                 skipped_steps=skipped0,
             )
 
+        # --- tiered store (deepspeed_tpu/memory): owns the transfer worker,
+        # tier byte accounting and the Memory/tier/* telemetry. Cheap when
+        # tiering is off (no thread until a tier is used); offload_states()
+        # routes through it either way. ---
+        from ..memory import TieredStore
+
+        self.tiered_store = TieredStore(mt)
+        if self._tiered_opt:
+            # the optimizer state leaves the device between steps from the
+            # very first train_batch (restored under the step's grad phase)
+            self.state = self.state._replace(
+                opt_state=self.tiered_store.offload(
+                    self.state.opt_state, "host", name="optim_states"))
+            log_dist("memory.tiering: optimizer state host-resident "
+                     f"(pin_memory={mt.pin_memory}); H2D prefetch overlaps "
+                     "fwd/bwd, D2H writeback overlaps the next step")
+
         if self._overlap_active():
             self._overlap_setup()  # static routing, cached for engine life
             if co.loco and (config.zero_config.zero_quantized_gradients
@@ -393,6 +426,16 @@ class DeepSpeedTPUEngine:
                         if self.partitioner.secondary_axes is not None
                         else self.partitioner.zero_axes)
             if mesh_mgr.axis_size(a) > 1)
+        # memory.tiering.param_tier=host composes here: the stacked layer
+        # shards park in host memory and each layer's host→HBM copy-in is
+        # issued by the SAME prefetch pipeline as the all-gather (identity
+        # on single-memory backends — docs/memory.md compose rules)
+        _param_host = bool(mt.enabled and mt.param_tier == "host"
+                           and self._layer_prefetch_on)
+        if mt.enabled and mt.param_tier == "host" and not _param_host:
+            log_dist("memory.tiering.param_tier=host has no effect here: it "
+                     "rides the comms_overlap.layer_prefetch pipeline "
+                     "(ZeRO stage 3 + layer_prefetch required)")
         configure_layer_prefetch(
             self._layer_prefetch_on,
             depth=max(1, int(co.prefetch_depth)),
@@ -400,7 +443,8 @@ class DeepSpeedTPUEngine:
                        if self._layer_prefetch_on else None),
             quantize=(self._layer_prefetch_quant()
                       if self._layer_prefetch_on else None),
-            gather_axes=_gaxes if self._layer_prefetch_on else ())
+            gather_axes=_gaxes if self._layer_prefetch_on else (),
+            host_tier=_param_host)
         if self._layer_prefetch_on:
             log_dist(f"comms_overlap: per-layer all-gather prefetch armed "
                      f"(depth={max(1, int(co.prefetch_depth))}"
@@ -530,6 +574,7 @@ class DeepSpeedTPUEngine:
                 f"optimizer type '{opt_type}'")
         hp = dict(cfg.optimizer.params)
         swap_dir = cfg.zero_config.offload_optimizer.nvme_path or \
+            cfg.memory.tiering.nvme_path or \
             os.path.join(tempfile.gettempdir(), "dstpu_nvme_opt")
         leaves, self._nvme_treedef = jax.tree_util.tree_flatten(params)
         # leaves pass through unconverted — the optimizer converts to fp32
@@ -642,6 +687,77 @@ class DeepSpeedTPUEngine:
                 self.global_steps % cfg.steps_per_print == 0:
             log_dist(f"step={self.global_steps} loss={float(out.loss):.4f} "
                      f"lr={lr_t:.3e} gnorm={grad_norm:.3f} [nvme-opt]")
+        if self.watchdog is not None:
+            self.watchdog.observe(self, out)
+        return out
+
+    def _train_batch_tiered(self, batch) -> StepOutput:
+        """train_batch when the optimizer state lives on the HOST tier
+        (``memory.tiering.optimizer_tier=host``; docs/memory.md).
+
+        Between steps the opt-state leaves are host-resident (off the device
+        allocator). Per step: (1) the H2D restore is enqueued on the
+        transfer worker FIRST, (2) the grad computation dispatches — the
+        copies stream under it, (3) the jitted apply consumes the restored
+        state, (4) the updated state's D2H writeback is enqueued and
+        overlaps the NEXT step's compute. The store's compute window
+        brackets (2)-(3) so ``Memory/tier/overlap_frac`` measures how much
+        of the transfer time was actually hidden."""
+        store = self.tiered_store
+        if self._tiered_grad_step is None:
+            def grad_fn(params, b, ls):
+                return self._accumulate(params, b, ls)
+
+            with self.mesh_mgr.activate():
+                self._tiered_grad_step = self.telemetry.compile.jit(
+                    "tiered_grad_step", grad_fn)
+            self._ensure_apply_step()
+        self.tput_timer.start()
+        self.telemetry.step_begin(self.global_steps + 1)
+        if self.watchdog is not None:
+            self.watchdog.step_started()
+        if self.curriculum_scheduler is not None:
+            batch = self.curriculum_scheduler.truncate(batch,
+                                                       self.global_steps)
+        batch = self._shard_batch(batch, with_gas_dim=True)
+        with self.telemetry.tracer.span("train/train_batch", cat="train",
+                                        step=self.global_steps + 1):
+            store.worker.compute_begin()
+            try:
+                # (1) H2D prefetch of the host-resident optimizer state —
+                # HostBuffer leaves carry their exact shardings, so no
+                # override tree is needed
+                handle = store.prefetch(self.state.opt_state)
+                # (2) grads dispatch; the prefetch copies run under them
+                grads, loss, aux = self._tiered_grad_step(
+                    self.state.params, batch, self.state.loss_scale)
+                opt_dev = handle.wait()
+                # (3) optimizer apply over the restored state
+                new_state, out = self._apply_step(
+                    self.state._replace(opt_state=opt_dev), grads, loss,
+                    self._lr_override)
+                jax.block_until_ready(out.loss)
+            finally:
+                store.worker.compute_end()
+        # (4) async D2H writeback — overlaps the next step's compute
+        self.state = new_state._replace(
+            opt_state=store.offload(new_state.opt_state, "host",
+                                    name="optim_states"))
+        self.global_steps += 1
+        self._last_grad_norm = out.grad_norm
+        self.lr_scheduler.last_step = self.global_steps
+        self.tput_timer.stop()
+        self._write_monitor_events(out)
+        self.telemetry.memory_tier_events(store, self.global_steps)
+        self.telemetry.step_end(self.global_steps,
+                                step_time_s=self.tput_timer.avg_step_time()
+                                or None)
+        if self.config.steps_per_print and \
+                self.global_steps % self.config.steps_per_print == 0:
+            log_dist(f"step={self.global_steps} loss={float(out.loss):.4f} "
+                     f"lr={float(out.lr):.3e} "
+                     f"gnorm={float(out.grad_norm):.3f} [tiered-opt "
+                     f"overlap={store.overlap_frac():.2f}]")
         if self.watchdog is not None:
             self.watchdog.observe(self, out)
         return out
@@ -1580,6 +1696,8 @@ class DeepSpeedTPUEngine:
         stacked in the leading dim)."""
         if self._nvme_opt is not None:
             return self._train_batch_nvme(batch)
+        if self._tiered_opt:
+            return self._train_batch_tiered(batch)
         breakdown = self.wall_clock_breakdown()
         if self._train_step is None and not breakdown:
             self._build_train_step()
@@ -1861,6 +1979,9 @@ class DeepSpeedTPUEngine:
         tel = getattr(self, "telemetry", None)
         if tel is not None:
             tel.close()
+        store = getattr(self, "tiered_store", None)
+        if store is not None:
+            store.close()
         mon = getattr(self, "monitor", None)
         if mon is not None:
             mon.close()

@@ -6,11 +6,15 @@ ZeRO-1/2 implementation ``runtime/zero/stage_1_and_2.py:2725``): move selected
 engine-owned state tensors out of accelerator memory between steps and bring
 them back on demand.
 
-TPU-first: there is no ``.to('cpu')`` — arrays move by ``jax.device_put`` onto
-the SAME sharding with ``memory_kind='pinned_host'``; the transfer is async
-DMA over PCIe, sharding (ZeRO partitioning) is preserved, and a subsequent
-donated-jit step can consume host-resident inputs with XLA streaming them
-back. ``pin_memory=False`` selects ``unpinned_host``.
+Since PR 12 this module is a thin consumer of the tiered memory subsystem
+(``deepspeed_tpu/memory``; docs/memory.md): ``device='cpu'`` places the
+selected trees on the TieredStore's HOST tier (real ``pinned_host`` memory
+kinds where the backend has a host space, ``HostBuffer`` numpy residency on
+the single-memory CPU mesh — same API, and host-tier leaves leave the device
+allocator either way), ``device='nvme'`` spills through the FILE tier (the
+``swap_tensor`` aio stack; leaves become ``SwappedTensorMeta`` records).
+Reload restores the exact sharded device state through the store's
+prefetch/restore path — transfers ride the shared transfer worker.
 """
 
 from __future__ import annotations
@@ -19,8 +23,7 @@ import enum
 import os
 from typing import Any, Iterable, Optional, Set
 
-import jax
-
+from ..memory.placement import offloaded_memory_kinds  # noqa: F401 (re-export)
 from ..utils.logging import log_dist
 
 
@@ -43,44 +46,50 @@ class OffloadDeviceEnum(str, enum.Enum):
     nvme = "nvme"
 
 
-def _move_tree(tree: Any, memory_kind: str) -> Any:
-    """device_put every array leaf onto its own sharding with a new memory
-    kind — a no-op for leaves already there."""
+def _engine_store(engine):
+    """The engine's TieredStore (created by engine init when the
+    ``memory.tiering`` block is on; lazily here otherwise)."""
+    store = getattr(engine, "tiered_store", None)
+    if store is None:
+        from ..memory import TieredStore
 
-    def move(leaf):
-        if not isinstance(leaf, jax.Array):
-            return leaf
-        sh = leaf.sharding
-        if getattr(sh, "memory_kind", None) == memory_kind:
-            return leaf
-        return jax.device_put(leaf, sh.with_memory_kind(memory_kind))
-
-    return jax.tree.map(move, tree)
+        store = TieredStore(getattr(getattr(engine, "config", None),
+                                    "memory", None) and
+                            engine.config.memory.tiering)
+        engine.tiered_store = store
+    return store
 
 
-def offloaded_memory_kinds(tree: Any) -> Set[str]:
-    kinds: Set[str] = set()
-    for leaf in jax.tree.leaves(tree):
-        if isinstance(leaf, jax.Array):
-            kinds.add(getattr(leaf.sharding, "memory_kind", "device"))
-    return kinds
+def _nvme_dir(engine) -> str:
+    import tempfile
+
+    zc = getattr(engine, "config", None)
+    swap_dir = None
+    if zc is not None:
+        oo = getattr(zc.zero_config, "offload_optimizer", None)
+        swap_dir = getattr(oo, "nvme_path", None)
+        mt = getattr(getattr(zc, "memory", None), "tiering", None)
+        swap_dir = swap_dir or getattr(mt, "nvme_path", None)
+    return swap_dir or os.path.join(tempfile.gettempdir(),
+                                    "dstpu_offload_states")
 
 
 def offload_engine_states(engine, include: Optional[Iterable] = None,
                           device: str = "cpu", pin_memory: bool = True,
                           non_blocking: bool = False) -> None:
-    """Move the selected state groups to host memory.
+    """Move the selected state groups to the host (or file) tier.
 
-    ``non_blocking`` keeps parity with the reference signature; device_put is
-    always async in JAX (dispatch returns immediately), so it is accepted and
-    ignored.
+    ``non_blocking`` keeps parity with the reference signature; the tiered
+    store's transfers are asynchronous either way (device_put DMA on
+    host-space backends, transfer-worker copies on the CPU mesh), so it is
+    accepted and ignored.
     """
     if device == OffloadDeviceEnum.none:
         return
-    if getattr(engine, "_nvme_swappers", None):
-        # nvme offload is NOT idempotent (a second pass would try to swap the
-        # meta trees themselves and leak the first swapper's files)
-        log_dist("offload_states: states already nvme-offloaded; skipping")
+    if getattr(engine, "_offloaded_tiers", None):
+        # offload is NOT idempotent across tiers (a second pass would try to
+        # move the already-replaced leaf trees themselves)
+        log_dist("offload_states: states already offloaded; skipping")
         return
     if include is None:
         include = {OffloadStateTypeEnum.optim_states,
@@ -88,91 +97,68 @@ def offload_engine_states(engine, include: Optional[Iterable] = None,
     else:
         include = {OffloadStateTypeEnum(s) for s in include}
     st = engine.state
+    store = _engine_store(engine)
 
     if device == OffloadDeviceEnum.nvme:
-        # disk tier: spill through the swap_tensor stack (ZeRO-Infinity
-        # analog — reference routes offload_states device='nvme' to the
-        # partitioned swappers). The live leaves are replaced by their
-        # SwappedTensorMeta trees; reload streams them back and re-shards.
-        import tempfile
+        # disk tier: spill through the store's FILE tier (ZeRO-Infinity
+        # analog — the swap_tensor aio stack underneath). The live leaves
+        # are replaced by SwappedTensorMeta trees; reload streams them back
+        # and re-shards.
+        store.nvme_dir = store.nvme_dir or _nvme_dir(engine)
+        tier = "file"
+    else:
+        tier = "host"
+    store.pin = bool(pin_memory)
 
-        from .swap_tensor.swapper import PartitionedOptimizerSwapper
-
-        zc = getattr(engine, "config", None)
-        swap_dir = None
-        if zc is not None:
-            oo = getattr(zc.zero_config, "offload_optimizer", None)
-            swap_dir = getattr(oo, "nvme_path", None)
-        swap_dir = swap_dir or os.path.join(tempfile.gettempdir(),
-                                            "dstpu_offload_states")
-        engine._nvme_swappers = {}
-        if OffloadStateTypeEnum.optim_states in include:
-            sw = PartitionedOptimizerSwapper(os.path.join(swap_dir, "opt"))
-            st = st._replace(opt_state=sw.swap_out_optimizer(st.opt_state))
-            engine._nvme_swappers["optim_states"] = sw
-        if OffloadStateTypeEnum.hp_params in include:
-            sw = PartitionedOptimizerSwapper(os.path.join(swap_dir, "params"))
-            st = st._replace(params=sw.swap_out_optimizer(st.params))
-            engine._nvme_swappers["hp_params"] = sw
-        engine.state = st
-        engine._states_offloaded = True
-        log_dist(f"offloaded {sorted(s.value for s in include)} -> nvme "
-                 f"({swap_dir})")
-        return
-
-    kind = "pinned_host" if pin_memory else "unpinned_host"
     if OffloadStateTypeEnum.optim_states in include:
-        st = st._replace(opt_state=_move_tree(st.opt_state, kind))
+        st = st._replace(opt_state=store.offload(
+            st.opt_state, tier, name="optim_states"))
     if OffloadStateTypeEnum.hp_params in include:
-        st = st._replace(params=_move_tree(st.params, kind))
+        st = st._replace(params=store.offload(
+            st.params, tier, name="hp_params"))
     # lp_params / lp_grads / contiguous_grad_buffer: the compiled step neither
     # keeps low-precision shadows nor a persistent grad buffer between steps
     # (grads live only inside the jit step), so these are vacuously offloaded.
     engine.state = st
+    engine._offloaded_tiers = {s.value: tier for s in include}
     engine._states_offloaded = True
-    log_dist(f"offloaded {sorted(s.value for s in include)} -> {kind}")
-
-
-def _nvme_reload(engine, st):
-    """Stream swapped trees back from disk and restore device shardings."""
-    from jax.sharding import NamedSharding
-    from jax.sharding import PartitionSpec as P
-
-    swappers = engine._nvme_swappers
-
-    def shardings_for(specs):
-        return jax.tree.map(
-            lambda s: NamedSharding(engine.mesh_mgr.mesh, s), specs,
-            is_leaf=lambda x: isinstance(x, P))
-
-    if "optim_states" in swappers:
-        sw = swappers.pop("optim_states")
-        host = sw.swap_in_optimizer(device_put=False)
-        sh = shardings_for(engine.opt_state_specs)
-        st = st._replace(opt_state=jax.tree.map(jax.device_put, host, sh))
-        sw.purge()
-    if "hp_params" in swappers:
-        sw = swappers.pop("hp_params")
-        host = sw.swap_in_optimizer(device_put=False)
-        st = st._replace(params=jax.tree.map(
-            jax.device_put, host, engine._master_shardings))
-        sw.purge()
-    return st
+    log_dist(f"offloaded {sorted(s.value for s in include)} -> {tier} tier"
+             + (f" ({store.nvme_dir})" if tier == "file" else ""))
 
 
 def reload_engine_states(engine, non_blocking: bool = False) -> None:
-    """Reference ``reload_states``: bring everything back to device memory."""
+    """Reference ``reload_states``: bring everything back to device memory.
+    Both trees prefetch FIRST (every transfer in flight on the worker)
+    before either waits — the double-buffered restore."""
     st = engine.state
-    if getattr(engine, "_nvme_swappers", None):
-        st = _nvme_reload(engine, st)
-        engine.state = st._replace(
-            params=_move_tree(st.params, "device"),
-            opt_state=_move_tree(st.opt_state, "device"))
-        engine._states_offloaded = False
-        log_dist("reloaded nvme-offloaded states -> device")
-        return
-    engine.state = st._replace(
-        params=_move_tree(st.params, "device"),
-        opt_state=_move_tree(st.opt_state, "device"))
+    store = _engine_store(engine)
+    tiers = getattr(engine, "_offloaded_tiers", None) or {}
+
+    handles = {}
+    if "optim_states" in tiers or tiers == {}:
+        sh = None
+        if "optim_states" in tiers and hasattr(engine, "opt_state_specs"):
+            import jax
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+
+            try:
+                sh = jax.tree.map(
+                    lambda s: NamedSharding(engine.mesh_mgr.mesh, s),
+                    engine.opt_state_specs,
+                    is_leaf=lambda x: isinstance(x, P))
+            except Exception:
+                sh = None
+        handles["opt_state"] = store.prefetch(st.opt_state, sh)
+    if "hp_params" in tiers or tiers == {}:
+        sh = getattr(engine, "_master_shardings", None) \
+            if "hp_params" in tiers else None
+        handles["params"] = store.prefetch(st.params, sh)
+    if "opt_state" in handles:
+        st = st._replace(opt_state=handles["opt_state"].wait())
+    if "params" in handles:
+        st = st._replace(params=handles["params"].wait())
+    engine.state = st
+    engine._offloaded_tiers = None
     engine._states_offloaded = False
     log_dist("reloaded offloaded states -> device")

@@ -13,6 +13,13 @@ TPU-first: the host worker runs the SIMD C++ ``DeepSpeedCPUAdam``; gradients
 stream D2H once per step; the speculative update keeps a pre-update snapshot
 of the host masters, and ``step()`` issues a rollback+replay with the scaled
 gradients when the device-computed norm exceeds ``clip_norm``.
+
+Host residency and per-step D2H gradient traffic are accounted through the
+tiered memory subsystem (``deepspeed_tpu/memory``; docs/memory.md): pass a
+``TieredStore`` (or let one be created) and the masters/moments register as
+host-tier resident bytes, every gradient stream lands in
+``transfer_d2h_bytes``, and the async update window is bracketed as device
+compute so ``Memory/tier/overlap_frac`` covers this optimizer too.
 """
 
 from __future__ import annotations
@@ -33,9 +40,18 @@ class SuperOffloadOptimizer:
     def __init__(self, params: Any, *, lr: float = 1e-3,
                  betas=(0.9, 0.999), weight_decay: float = 0.0,
                  clip_norm: Optional[float] = None,
-                 max_inflight: int = 2):
+                 max_inflight: int = 2, store: Optional[Any] = None):
+        if store is None:
+            from ..memory import TieredStore
+
+            store = TieredStore()
+        self.store = store
         leaves, self.treedef = jax.tree_util.tree_flatten(params)
         self.host = [np.array(l, np.float32, copy=True) for l in leaves]
+        # masters + both moment buffers live host-side for the optimizer's
+        # lifetime — register them on the store's host tier
+        self._host_bytes = 3 * sum(h.nbytes for h in self.host)
+        store._track("resident_bytes_host", self._host_bytes)
         self.cpu_adam = DeepSpeedCPUAdam(self.host, lr=lr, betas=betas,
                                          weight_decay=weight_decay)
         self.clip_norm = clip_norm
@@ -92,6 +108,8 @@ class SuperOffloadOptimizer:
         lr = self.lr if lr is None else lr
         g_leaves = [np.array(g, np.float32, copy=True)
                     for g in jax.tree_util.tree_flatten(grads)[0]]
+        self.store._track("transfer_d2h_bytes",
+                          sum(g.nbytes for g in g_leaves))
         self.step_count += 1
         self._drain(block=False)
 
@@ -143,3 +161,5 @@ class SuperOffloadOptimizer:
     def close(self):
         self._q.put(None)
         self._worker.join(timeout=5)
+        self.store._track("resident_bytes_host", -self._host_bytes)
+        self._host_bytes = 0

@@ -42,6 +42,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..memory.placement import to_device, to_host
 from ..ops.attention import repeat_kv
 from ..ops.pallas.flash_attention import _flash_bwd, _flash_fwd
 from .tiled import tiled_fused_logits_loss, tiled_mlp
@@ -62,10 +63,11 @@ def _from_bh(x, B, H):
 
 
 def _fetch(buf, idx, offload):
-    """One chunk → device memory (async copy-in on TPU when host-parked)."""
+    """One chunk → device memory (async copy-in on TPU when host-parked;
+    ``memory.placement.to_device`` is identity on single-memory backends)."""
     blk = lax.dynamic_index_in_dim(buf, idx, 0, keepdims=False)
     if offload:
-        blk = jax.device_put(blk, jax.memory.Space.Device)
+        blk = to_device(blk)
     return blk
 
 
@@ -190,8 +192,7 @@ def _fpdt_core(q_t, k_t, v_t, causal, scale, offload, offload_kv):
 def _fpdt_core_fwd(q_t, k_t, v_t, causal, scale, offload, offload_kv):
     o_t, lse_t = _fwd_impl(q_t, k_t, v_t, causal, scale, offload_kv)
     if offload:  # park forward residuals host-side until the backward
-        res = tuple(jax.device_put(x, jax.memory.Space.Host)
-                    for x in (q_t, o_t, lse_t))
+        res = tuple(to_host(x) for x in (q_t, o_t, lse_t))
     else:
         res = (q_t, o_t, lse_t)
     return o_t, res + (k_t, v_t)
@@ -274,8 +275,8 @@ def fpdt_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
     k_t = k.reshape(B, chunks, c, Hkv, D).transpose(1, 0, 2, 3, 4)
     v_t = v.reshape(B, chunks, c, Hkv, D).transpose(1, 0, 2, 3, 4)
     if offload_kv:
-        k_t = jax.device_put(k_t, jax.memory.Space.Host)
-        v_t = jax.device_put(v_t, jax.memory.Space.Host)
+        k_t = to_host(k_t)
+        v_t = to_host(v_t)
 
     out_t = _fpdt_core(q_t, k_t, v_t, bool(causal), scale, bool(offload),
                        bool(offload_kv))

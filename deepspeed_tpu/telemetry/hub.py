@@ -103,6 +103,11 @@ class TelemetryHub:
         # Anomaly/* occurrence counts, for metrics_snapshot and tests
         self.compile_values: Dict[str, float] = {}
         self.anomaly_counts: Dict[str, int] = {}
+        # Memory/tier/* gauges (tiered memory subsystem — TieredStore /
+        # HostKVPool drains; docs/memory.md). Closed registry in
+        # telemetry.schema.MEMORY_TIER_SERIES; same contract as
+        # serving_values.
+        self.memory_tier_values: Dict[str, float] = {}
 
     # ------------------------------------------------------------------ #
     def train_event(self, name: str, value: float, step: int = 0) -> None:
@@ -128,6 +133,32 @@ class TelemetryHub:
         self.serving_values[name] = float(value)
         if self.rank0 and self._monitor_on():
             self.monitor.write_events([(name, float(value), int(step))])
+
+    # ------------------------------------------------------------------ #
+    def memory_tier_event(self, name: str, value: float,
+                          step: int = 0) -> None:
+        """Fan out one ``Memory/tier/<name>`` gauge (tiered memory
+        subsystem: per-tier resident/spilled bytes, transfer overlap,
+        prefetch hit/miss — closed registry in
+        ``telemetry.schema.MEMORY_TIER_SERIES``). Last sample per series is
+        the current value."""
+        if not name.startswith("Memory/tier/"):
+            name = "Memory/tier/" + name.removeprefix("Memory/").removeprefix(
+                "tier/")
+        self.memory_tier_values[name] = float(value)
+        if self.rank0 and self._monitor_on():
+            self.monitor.write_events([(name, float(value), int(step))])
+
+    def memory_tier_events(self, store, step: int = 0) -> List[Event]:
+        """Drain one TieredStore's ``Memory/tier/*`` snapshot through the
+        hub (the engine calls this per tiered step; the serving engine
+        publishes its KV-spill gauges via :meth:`memory_tier_event`)."""
+        events = list(store.events(step))
+        for n, v, _ in events:
+            self.memory_tier_values[n] = float(v)
+        if self.rank0 and self._monitor_on() and events:
+            self.monitor.write_events(events)
+        return events
 
     # ------------------------------------------------------------------ #
     def reliability_event(self, name: str, value: float = 1.0,
@@ -269,6 +300,8 @@ class TelemetryHub:
         for name, value in sorted(self.serving_values.items()):
             rows.append((name, float(value), "gauge"))
         for name, value in sorted(self.train_values.items()):
+            rows.append((name, float(value), "gauge"))
+        for name, value in sorted(self.memory_tier_values.items()):
             rows.append((name, float(value), "gauge"))
         for name, count in sorted(self.anomaly_counts.items()):
             rows.append((name, float(count), "counter"))

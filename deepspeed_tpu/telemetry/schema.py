@@ -48,7 +48,7 @@ from typing import Any, Dict, Iterable, List, Tuple
 __all__ = ["EVENT_NAME_RE", "SERVING_SERIES", "TRAIN_SERIES",
            "COMM_METRICS", "COMM_TOTAL_SERIES",
            "COMPILE_METRICS", "COMPILE_TOTAL_SERIES", "ANOMALY_SERIES",
-           "MFU_SEGMENT_RE", "ANOMALY_PHASES",
+           "MEMORY_TIER_SERIES", "MFU_SEGMENT_RE", "ANOMALY_PHASES",
            "REMAT_POLICIES", "validate_events", "validate_jsonl_records"]
 
 EVENT_NAME_RE = re.compile(r"^[A-Z][A-Za-z0-9_]*(/[A-Za-z0-9_.\-]+)+$")
@@ -60,7 +60,9 @@ EVENT_NAME_RE = re.compile(r"^[A-Z][A-Za-z0-9_]*(/[A-Za-z0-9_.\-]+)+$")
 SERVING_SERIES = frozenset(
     ["Serving/prefix_cache/" + m for m in (
         "lookups", "hits", "hit_tokens", "prefill_tokens_saved",
-        "evictions", "cow_copies", "retained_blocks")]
+        "evictions", "cow_copies", "retained_blocks",
+        # host-spill tier (inference.prefix_cache.host_spill; docs/memory.md)
+        "spills", "restores", "restored_tokens", "spilled_blocks")]
     + [f"Serving/latency/{m}_{s}"
        for m in ("ttft_ms", "itl_ms", "queue_ms", "e2e_ms")
        for s in ("p50", "p90", "p99", "count")]
@@ -149,6 +151,22 @@ ANOMALY_SERIES = frozenset(
        for k in ("spike", "drift")]
     + ["Anomaly/host/straggler"])
 
+# Registered Memory/tier/* series (the tiered memory subsystem —
+# memory/tiered_store.py TieredStore.events + the serving engine's KV
+# host-spill gauges; docs/memory.md): CLOSED — an emitted-but-unregistered
+# tier series fails tier-1 validation. Other Memory/* families
+# (Memory/bytes_in_use, Memory/peak_bytes) stay open.
+MEMORY_TIER_SERIES = frozenset(
+    "Memory/tier/" + m for m in (
+        # TieredStore byte accounting + transfer/overlap measurement
+        "resident_bytes_host", "resident_bytes_file",
+        "transfer_d2h_bytes", "transfer_h2d_bytes",
+        "transfer_busy_ms", "overlap_ms", "overlap_frac",
+        "prefetch_hits", "prefetch_misses", "offloads", "restores",
+        # serving KV host-spill pool (engine_v2.publish_prefix_telemetry)
+        "kv_spilled_blocks", "kv_spilled_bytes", "kv_spills",
+        "kv_restores"))
+
 # Per-program MFU attribution gauges (Train/mfu/<program>,
 # Serving/mfu/<program>, plus the total/headline rollups): the program
 # segment is open-ended but must be one lowercase snake_case token — the
@@ -188,6 +206,12 @@ def validate_events(events: Iterable[Tuple[str, float, int]]) -> List[str]:
                 name not in TRAIN_SERIES:
             problems.append(f"event #{i}: train series {name!r} is not "
                             f"registered in telemetry.schema.TRAIN_SERIES")
+            continue
+        if name.startswith("Memory/tier/") and \
+                name not in MEMORY_TIER_SERIES:
+            problems.append(f"event #{i}: memory-tier series {name!r} is not "
+                            f"registered in "
+                            f"telemetry.schema.MEMORY_TIER_SERIES")
             continue
         if name.startswith("Anomaly/") and name not in ANOMALY_SERIES:
             problems.append(f"event #{i}: anomaly series {name!r} is not "

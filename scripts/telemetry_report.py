@@ -397,6 +397,62 @@ def reliability(events: List[dict]) -> str:
     return "\n".join(lines)
 
 
+def memory_report(events: List[dict]) -> str:
+    """``--memory``: the tiered memory subsystem's ``Memory/tier/*`` stream
+    (docs/memory.md) — per-tier resident bytes, transfer volume and the
+    measured compute-overlap fraction, prefetch hit/miss, and the serving
+    KV host-spill pool occupancy — plus the open ``Memory/{bytes_in_use,
+    peak_bytes}`` allocator gauges. Tier series carry gauge/cumulative
+    values, so the last sample per series is current."""
+    tier = [e for e in events if e["name"].startswith("Memory/tier/")]
+    alloc = [e for e in events if e["name"].startswith("Memory/")
+             and not e["name"].startswith("Memory/tier/")]
+    if not tier and not alloc:
+        return "memory: no Memory/* events in this file"
+    lines = []
+
+    def last(evs: List[dict], name: str) -> float:
+        vals = [e["value"] for e in evs if e["name"] == name]
+        return float(vals[-1]) if vals else 0.0
+
+    if tier:
+        t = lambda m: last(tier, f"Memory/tier/{m}")  # noqa: E731
+        lines.append(f"tiered memory ({len(tier)} Memory/tier/* events)")
+        lines.append(f"  host tier resident:   "
+                     f"{_fmt_bytes(t('resident_bytes_host'))}")
+        lines.append(f"  file tier resident:   "
+                     f"{_fmt_bytes(t('resident_bytes_file'))}")
+        lines.append(f"  transfers:            "
+                     f"{_fmt_bytes(t('transfer_d2h_bytes'))} D2H / "
+                     f"{_fmt_bytes(t('transfer_h2d_bytes'))} H2D "
+                     f"({t('offloads'):.0f} offloads, "
+                     f"{t('restores'):.0f} restores)")
+        busy, ov = t("transfer_busy_ms"), t("overlap_ms")
+        lines.append(f"  transfer wall time:   {busy:.1f} ms "
+                     f"({ov:.1f} ms hidden under compute → "
+                     f"overlap_frac {t('overlap_frac'):.2f})")
+        hits, misses = t("prefetch_hits"), t("prefetch_misses")
+        tot = hits + misses
+        lines.append(f"  prefetch:             {hits:.0f} hits / "
+                     f"{misses:.0f} misses"
+                     + (f" ({hits / tot:.1%} fully hidden)" if tot else ""))
+        if any(e["name"].startswith("Memory/tier/kv_") for e in tier):
+            lines.append(f"  KV host-spill pool:   "
+                         f"{t('kv_spilled_blocks'):.0f} blocks "
+                         f"({_fmt_bytes(t('kv_spilled_bytes'))}); "
+                         f"{t('kv_spills'):.0f} spills, "
+                         f"{t('kv_restores'):.0f} restores")
+    if alloc:
+        if tier:
+            lines.append("")
+        lines.append(f"device allocator")
+        lines.append(f"  bytes in use:         "
+                     f"{_fmt_bytes(last(alloc, 'Memory/bytes_in_use'))}")
+        lines.append(f"  peak bytes:           "
+                     f"{_fmt_bytes(last(alloc, 'Memory/peak_bytes'))}")
+    return "\n".join(lines)
+
+
 def serving(events: List[dict]) -> str:
     """``--serving``: prefix-cache hit-rate, prefill tokens saved, retained-
     pool occupancy and evictions from the ``Serving/prefix_cache/*`` stream,
@@ -723,6 +779,12 @@ def main(argv=None) -> int:
     ap.add_argument("--latency", action="store_true",
                     help="summarize Serving/latency/* SLO percentiles: "
                          "TTFT / inter-token / queue / e2e p50-p90-p99")
+    ap.add_argument("--memory", action="store_true",
+                    help="summarize the tiered memory subsystem's "
+                         "Memory/tier/* stream (per-tier resident bytes, "
+                         "transfer volume, measured compute-overlap "
+                         "fraction, prefetch hit/miss, KV host-spill pool) "
+                         "plus the Memory/* allocator gauges")
     ap.add_argument("--compile", action="store_true", dest="compile_",
                     help="summarize Compile/* recompilation-sentinel "
                          "counters (compiles, cache hits, recompiles, "
@@ -764,7 +826,8 @@ def main(argv=None) -> int:
     if args.all:
         sections = [summarize(events, last=args.last), comm_efficiency(events),
                     reliability(events), serving(events), latency(events),
-                    compile_report(events), anomalies(events)]
+                    memory_report(events), compile_report(events),
+                    anomalies(events)]
         print("\n\n".join(sections))
         return 0
     if args.compile_:
@@ -784,6 +847,9 @@ def main(argv=None) -> int:
         return 0
     if args.latency:
         print(latency(events))
+        return 0
+    if args.memory:
+        print(memory_report(events))
         return 0
     print(summarize(events, last=args.last))
     return 0

@@ -177,6 +177,27 @@ EOF
       else
         echo "[watch] $bts REGRESSION probe FLAGGED step-time regression (non-fatal)" >> "$LOG"
       fi
+      # tiered-memory probe row (docs/memory.md acceptance): optimizer
+      # host-offload step time vs in-HBM + measured transfer-overlap
+      # fraction, and the KV host-spill restore latency — parsed from the
+      # headline capture's detail.tiered_mem. NON-FATAL by design.
+      python - "bench_runs/BENCH_tpu_${bts}.json" >> "$LOG" 2>&1 <<'PYEOF' || \
+        echo "[watch] $bts TIERED probe: unreadable (non-fatal)" >> "$LOG"
+import json, sys
+raw = open(sys.argv[1]).read()
+line = [l for l in raw.splitlines() if l.strip().startswith("{")]
+d = json.loads(line[-1]) if line else {}
+tm = (d.get("detail") or {}).get("tiered_mem") or {}
+if not tm.get("ok"):
+    print("[watch] TIERED probe: not ok (%r)" % tm.get("status"))
+else:
+    oo, kv = tm.get("optimizer_offload", {}), tm.get("kv_spill", {})
+    print("[watch] TIERED probe: opt-offload slowdown=%s overlap_frac=%s "
+          "device_bytes_delta=%s | kv restore=%ss cold=%ss restores=%s"
+          % (oo.get("slowdown"), oo.get("overlap_frac"),
+             oo.get("device_bytes_delta"), kv.get("admit_restore_s"),
+             kv.get("admit_cold_s"), kv.get("restores")))
+PYEOF
     fi
     hold_requested || run_probe QUANT scripts/quant_linear_bench.py 1200 QUANT_TPU_LIVE.json
     # attention block sweep LAST: it may write .dstpu_tuned.json, which the
