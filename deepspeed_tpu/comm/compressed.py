@@ -89,18 +89,14 @@ def onebit_all_reduce(x: jnp.ndarray, error: jnp.ndarray, axis_name: str,
     return avg, new_error, new_server_error
 
 
-def _group_quantize(g: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """THE symmetric int8 group quantizer: quantize the trailing (group) dim
-    of an already-grouped array. Single implementation shared by every
-    group-quantized collective in this module (`quantize_int8_groupwise`,
-    `_chunk_quantize`, the quantized all-reduce's gather phase) — a tier-1
-    regression test pins its output bit-identical to the historical inline
-    formulas, so numerical drift here is a test failure, not a silent
-    trajectory change."""
-    scale = jnp.maximum(jnp.max(jnp.abs(g), axis=-1, keepdims=True),
-                        1e-8) / 127.0
-    q = jnp.clip(jnp.round(g / scale), -127, 127).astype(jnp.int8)
-    return q, scale
+# THE symmetric int8 group quantizer now lives in ops/quantization.py
+# (shared with the quantized KV-cache fill path — docs/serving.md); this
+# alias keeps every group-quantized collective in this module
+# (`quantize_int8_groupwise`, `_chunk_quantize`, the quantized all-reduce's
+# gather phase) on the single implementation. A tier-1 regression test pins
+# its output bit-identical to the historical inline formulas, so numerical
+# drift here is a test failure, not a silent trajectory change.
+from ..ops.quantization import group_quantize_int8 as _group_quantize  # noqa: E402
 
 
 def quantize_int8_groupwise(x: jnp.ndarray, group_size: int = 256

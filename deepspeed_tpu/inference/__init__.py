@@ -1,5 +1,6 @@
-from .config import (InferenceConfig, PrefixCacheConfig,  # noqa: F401
-                     RaggedConfig, SpeculativeConfig, TPConfig)
+from .config import (InferenceConfig, KVQuantConfig,  # noqa: F401
+                     PrefixCacheConfig, RaggedConfig, SpeculativeConfig,
+                     TPConfig)
 from .engine import InferenceEngine, ModelFamily, init_inference  # noqa: F401
 from .engine_v2 import (InferenceEngineV2, build_engine_v2,  # noqa: F401
                         prompt_lookup_draft)

@@ -89,6 +89,32 @@ class QuantConfig:
 
 
 @dataclass
+class KVQuantConfig:
+    """Quantized KV cache for the v2 paged engine (docs/serving.md
+    "Quantized KV cache").
+
+    Default OFF: with ``enabled=False`` the block pools, every compiled
+    paged program, and the token streams are byte-identical to the bf16
+    engine (pinned by parity tests). When ON, the paged allocator's K/V
+    block pools store int8 codes with fp32 per-block-per-group scales
+    living beside them in the cache pytree — halving (bf16→int8) KV bytes
+    per block, so ~2× sequences fit at the same pool size — and dequant is
+    FUSED into the attention kernels (in-register in the Pallas paged
+    decode kernel, into the gather consumer on the prefill path) rather
+    than run as a standalone XLA convert pass: QUANT_TPU_LIVE.json shows
+    naive int8→bf16 casts before the MXU are 1.02–1.21× SLOWER than bf16,
+    so the win must come from storage, not compute. Scales ride the cache
+    pytree, so copy-on-write, fork, spec-decode truncate, prefix-cache
+    matching, and host-spill all carry codes AND scales automatically."""
+
+    enabled: bool = False
+    dtype: str = "int8"    # the only wired code dtype (fp8 is future work)
+    # tokens' head-dim group per fp32 scale; clamped to head_size (the
+    # default therefore gives ONE scale per (token, kv-head) at hd <= 128)
+    group_size: int = 128
+
+
+@dataclass
 class InferenceConfig:
     dtype: str = "bfloat16"
     tensor_parallel: TPConfig = field(default_factory=TPConfig)
@@ -106,6 +132,9 @@ class InferenceConfig:
     split_prefill_chunk: int = 0
     ragged: RaggedConfig = field(default_factory=RaggedConfig)
     quant: QuantConfig = field(default_factory=QuantConfig)
+    # int8 KV-cache blocks with fused dequant (docs/serving.md). Default
+    # OFF → serving byte-identical, pinned.
+    kv_quant: KVQuantConfig = field(default_factory=KVQuantConfig)
     prefix_cache: PrefixCacheConfig = field(default_factory=PrefixCacheConfig)
     speculative: SpeculativeConfig = field(default_factory=SpeculativeConfig)
     # request-lifecycle tracing + latency SLO stats (telemetry/trace.py;
@@ -125,6 +154,7 @@ class InferenceConfig:
             tp = {"tp_size": tp}
         ragged = d.pop("ragged", {})
         quant = d.pop("quant", {})
+        kvq = d.pop("kv_quant", {})
         prefix = d.pop("prefix_cache", {})
         spec = d.pop("speculative", {})
         trace = d.pop("trace", {})
@@ -132,6 +162,7 @@ class InferenceConfig:
         known = {k: v for k, v in d.items() if k in cls.__dataclass_fields__}
         return cls(tensor_parallel=TPConfig(**tp), ragged=RaggedConfig(**ragged),
                    quant=QuantConfig(**quant),
+                   kv_quant=KVQuantConfig(**kvq),
                    prefix_cache=PrefixCacheConfig(**prefix),
                    speculative=SpeculativeConfig(**spec),
                    trace=TraceConfig(**trace),

@@ -93,8 +93,43 @@ class InferenceEngineV2(InferenceEngine):
                                   max_blocks_per_seq,
                                   prefix_cache=pc.enabled,
                                   max_retained_blocks=pc.max_retained_blocks)
-        self.cache = self._init_paged(self.family.cfg, rc.memory_config_blocks,
-                                      rc.block_size)
+        # --- quantized KV cache (inference.kv_quant; docs/serving.md
+        # "Quantized KV cache"). Default OFF → the cache pytree, every
+        # compiled paged program, and the token streams are byte-identical
+        # to the bf16 engine (pinned by parity tests). When ON, the block
+        # pools store int8 codes + fp32 per-block-per-group scales; the
+        # scales are cache LEAVES with the block axis in the same position,
+        # so COW copies (_copy_block_fn), host spill (_spill_read_block /
+        # _spill_write_fn), fork, and spec-decode truncate all carry codes
+        # AND scales through the existing block-lifecycle machinery.
+        kq = getattr(self.config, "kv_quant", None)
+        self._kvq_on = bool(kq is not None and kq.enabled)
+        self._kvq_group = 0
+        if self._kvq_on:
+            if kq.dtype != "int8":
+                raise ValueError(
+                    f"inference.kv_quant.dtype {kq.dtype!r} is not wired — "
+                    f"only 'int8' is supported")
+            hd = self.family.cfg.head_size
+            eff = min(int(kq.group_size), hd)
+            if eff < 1 or hd % eff:
+                raise ValueError(
+                    f"inference.kv_quant.group_size {kq.group_size} does "
+                    f"not divide head_size {hd}")
+            self._kvq_group = eff
+            try:
+                self.cache = self._init_paged(
+                    self.family.cfg, rc.memory_config_blocks, rc.block_size,
+                    kv_quant_group=eff)
+            except TypeError:
+                raise ValueError(
+                    "this model's init_paged_cache does not accept "
+                    "kv_quant_group — the family has no quantized KV path; "
+                    "disable inference.kv_quant") from None
+        else:
+            self.cache = self._init_paged(self.family.cfg,
+                                          rc.memory_config_blocks,
+                                          rc.block_size)
         self._paged_fns: Dict[Tuple, Callable] = {}
         # --- host-spill tier for evicted prefix-cache blocks
         # (inference.prefix_cache.host_spill; docs/memory.md). Default OFF →
@@ -171,6 +206,7 @@ class InferenceEngineV2(InferenceEngine):
             "ttft_ms": [], "itl_ms": [], "queue_ms": [], "e2e_ms": []}
         log_dist(f"InferenceEngineV2: {rc.memory_config_blocks} blocks × "
                  f"{rc.block_size} tokens, {B} sequence slots, "
+                 f"kv_quant={'int8(g=%d)' % self._kvq_group if self._kvq_on else 'off'}, "
                  f"prefix_cache={'on' if pc.enabled else 'off'}, "
                  f"speculative={'on(k=%d)' % self._spec_k if self._spec_on else 'off'}, "
                  f"trace={'on' if self._trace_on else 'off'}")
@@ -1291,6 +1327,81 @@ class InferenceEngineV2(InferenceEngine):
         return events
 
     # ------------------------------------------------------------------ #
+    def kv_quant_events(self, step: int = 0):
+        """``Serving/kv_quant/*`` telemetry events (quantized-KV mode only;
+        docs/serving.md "Quantized KV cache"):
+
+        - ``blocks_quantized``: blocks currently resident holding int8 KV
+          (live + retained — everything off the free list);
+        - ``bytes_saved``: device bytes those blocks DON'T occupy vs a bf16
+          pool of the same block count (int8 codes + fp32 scales vs 2-byte
+          codes);
+        - ``max_abs_err``: upper bound on the per-element dequantization
+          error over the whole pool — symmetric rounding errs by at most
+          half a quantization step, so ``max(scale) / 2`` (unwritten
+          positions hold zero scales and cannot inflate it);
+        - ``dequant_fused``: 1.0 — asserts the serving programs dequantize
+          inside the attention kernels, never as a standalone convert pass
+          (the QUANT_TPU_LIVE-losing path)."""
+        if not self._kvq_on:
+            return []
+        import jax.numpy as jnp_
+
+        resident = (self.state.allocator.num_blocks - 1
+                    - self.state.allocator.free_blocks)
+        code_elems = scale_elems = 0
+        max_scale = 0.0
+        for name in ("k", "v"):
+            c = self.cache[name]
+            code_elems += c.size // c.shape[1]          # per-block elements
+            s = self.cache[name + "_scale"]
+            scale_elems += s.size // s.shape[1]
+            max_scale = max(max_scale, float(jnp_.max(s)))
+        saved_per_block = 2 * code_elems - (code_elems + 4 * scale_elems)
+        vals = {"blocks_quantized": float(resident),
+                "bytes_saved": float(saved_per_block * resident),
+                "max_abs_err": 0.5 * max_scale,
+                "dequant_fused": 1.0}
+        return [(f"Serving/kv_quant/{k}", float(v), step)
+                for k, v in sorted(vals.items())]
+
+    def publish_kv_quant_telemetry(self, step: int = 0):
+        events = self.kv_quant_events(step)
+        if self._hub is not None:
+            for name, value, s in events:
+                self._hub.serving_event(name, value, s)
+        return events
+
+    def debug_check_cache(self) -> None:
+        """Cache-pytree invariants beside ``StateManager.debug_check`` —
+        in quantized-KV mode the scale tables must stay consistent with the
+        code pools through every block-lifecycle op (COW, fork, truncate,
+        spill/restore): int8 codes, fp32 scales, one scale vector per
+        (block, head, token) with ``head_size // group_size`` groups, all
+        finite and non-negative. Raises AssertionError on violation."""
+        keys = set(self.cache.keys())
+        if not self._kvq_on:
+            assert keys == {"k", "v"}, \
+                f"unquantized cache has unexpected leaves {keys}"
+            return
+        import jax.numpy as jnp_
+
+        assert keys == {"k", "v", "k_scale", "v_scale"}, \
+            f"quantized cache has unexpected leaves {keys}"
+        hd = self.family.cfg.head_size
+        ng = hd // self._kvq_group
+        for name in ("k", "v"):
+            c, s = self.cache[name], self.cache[name + "_scale"]
+            assert c.dtype == jnp_.int8, f"{name} codes are {c.dtype}"
+            assert s.dtype == jnp_.float32, f"{name} scales are {s.dtype}"
+            assert s.shape == c.shape[:-1] + (ng,), \
+                f"{name}_scale shape {s.shape} inconsistent with codes " \
+                f"{c.shape} at group_size {self._kvq_group}"
+            smin, smax = float(jnp_.min(s)), float(jnp_.max(s))
+            assert np.isfinite(smax) and smin >= 0.0, \
+                f"{name}_scale range [{smin}, {smax}] invalid"
+
+    # ------------------------------------------------------------------ #
     def spec_events(self, step: int = 0):
         """``Serving/spec/*`` telemetry events: the cumulative counters plus
         the derived efficiency gauges — ``accept_rate`` (accepted / drafted),
@@ -1473,6 +1584,8 @@ class InferenceEngineV2(InferenceEngine):
             self.publish_latency_telemetry(step_i)
         if self._spec_on and self._hub is not None:
             self.publish_spec_telemetry(step_i)
+        if self._kvq_on and self._hub is not None:
+            self.publish_kv_quant_telemetry(step_i)
         if self.compile_monitor.enabled and self._hub is not None:
             self.publish_compile_telemetry(step_i)
         return [results[i] for i in range(len(prompts))]

@@ -36,6 +36,7 @@ class AttentionConfig(ModuleConfig):
     num_kv_heads: int = 0
     head_size: int = 0
     paged: bool = False          # block-table (ragged decode) layout
+    kv_quant: bool = False       # int8 KV pools + fused in-kernel dequant
 
 
 @dataclass(frozen=True)
@@ -141,6 +142,25 @@ def _paged_attention(cfg: AttentionConfig):
     from ..ops.pallas.paged_attention import paged_decode_attention
 
     return paged_decode_attention
+
+
+@registry.register("attention", "paged_pallas_int8kv",
+                   supports=lambda c: c.paged and c.kv_quant, priority=20)
+def _paged_attention_quant(cfg: AttentionConfig):
+    """Quantized-KV paged decode (inference.kv_quant; docs/serving.md):
+    int8 code pools + per-block-per-group scale pools, dequant fused
+    in-register ahead of the MXU dots — the caller MUST pass
+    ``k_scale``/``v_scale`` (enforced here so a mis-wired engine fails
+    loudly instead of attending over raw int8 codes)."""
+    from ..ops.pallas.paged_attention import paged_decode_attention
+
+    def quant_attention(q, k_pool, v_pool, block_tables, context_lens, *,
+                        k_scale, v_scale, **kw):
+        return paged_decode_attention(q, k_pool, v_pool, block_tables,
+                                      context_lens, k_scale=k_scale,
+                                      v_scale=v_scale, **kw)
+
+    return quant_attention
 
 
 @registry.register("norm", "rms", supports=lambda c: c.kind == "rms")

@@ -7,6 +7,18 @@ decode kernel's per-block tile — TPU tiling legal), block tables are
 fixed-width ``[b, max_blocks]`` indices into the pool, block 0 is the trash
 block that absorbs writes for padded tokens, and ``positions`` are absolute
 token positions (``context_lens + arange(t)``).
+
+Quantized KV mode (``inference.kv_quant``, docs/serving.md "Quantized KV
+cache"): the cache dict additionally carries ``k_scale``/``v_scale`` pools
+``[num_blocks, kv_heads, block_size, ngroups]`` fp32, K/V pools hold int8
+codes, and :func:`paged_attention_step` receives each pool as a
+``(codes, scales)`` tuple (:func:`split_kv`). Fill-time quantization is
+fused into the cache-update scatter (per-token groupwise scales — a token's
+write never touches another position's scale), and dequant is fused into
+the attention reads: in-register inside the Pallas paged-decode kernel, and
+into the gather consumer on the multi-token prefill path. There is NO
+standalone int8→bf16 convert pass over the pool — QUANT_TPU_LIVE.json shows
+that path losing to bf16 outright.
 """
 
 from __future__ import annotations
@@ -16,6 +28,64 @@ from typing import Optional, Tuple
 import jax.numpy as jnp
 
 from ..ops.attention import attention
+from ..ops.quantization import kv_dequantize_int8, kv_quantize_int8
+
+
+def init_paged_pools(num_layers: int, num_blocks: int, num_kv_heads: int,
+                     block_size: int, head_size: int, dtype=jnp.bfloat16,
+                     kv_quant_group: Optional[int] = None):
+    """The one cache-pool constructor every family's ``init_paged_cache``
+    delegates to. Plain mode returns the historical ``{"k", "v"}`` dict;
+    with ``kv_quant_group`` set (``inference.kv_quant.group_size``, clamped
+    to ``head_size``) the pools hold int8 codes plus fp32
+    ``[L, num_blocks, nkv, bs, ngroups]`` scale pools beside them — the
+    per-block scale table that every block-lifecycle op (COW copy, fork,
+    spill, truncate) carries automatically because it is part of the cache
+    pytree. Scales init to ZERO so unwritten positions and the trash block
+    dequantize to exactly the bf16 pool's zeros."""
+    shape = (num_layers, num_blocks, num_kv_heads, block_size, head_size)
+    if kv_quant_group is None:
+        return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+    gs = min(int(kv_quant_group), head_size)
+    if gs < 1 or head_size % gs:
+        raise ValueError(
+            f"kv_quant.group_size {kv_quant_group} does not divide "
+            f"head_size {head_size}")
+    sshape = shape[:-1] + (head_size // gs,)
+    return {"k": jnp.zeros(shape, jnp.int8),
+            "v": jnp.zeros(shape, jnp.int8),
+            "k_scale": jnp.zeros(sshape, jnp.float32),
+            "v_scale": jnp.zeros(sshape, jnp.float32)}
+
+
+def split_kv(cache):
+    """The per-family adapter from the cache dict to
+    :func:`paged_attention_step`'s K/V entries: plain pools stay arrays;
+    quantized pools (``k_scale`` present) become ``(codes, scales)`` tuples
+    so ``lax.scan`` threads codes AND scales per layer with no per-family
+    plumbing. Returns ``(k_entry, v_entry)``."""
+    if "k_scale" in cache:
+        return ((cache["k"], cache["k_scale"]),
+                (cache["v"], cache["v_scale"]))
+    return cache["k"], cache["v"]
+
+
+def join_kv(k_entry, v_entry):
+    """Inverse of :func:`split_kv`: rebuild the cache dict from the scan's
+    stacked per-layer outputs."""
+    if isinstance(k_entry, tuple):
+        return {"k": k_entry[0], "k_scale": k_entry[1],
+                "v": v_entry[0], "v_scale": v_entry[1]}
+    return {"k": k_entry, "v": v_entry}
+
+
+def _gathered_view(pool, block_tables):
+    """Dense [b, S, nkv, *] view of the pool rows the tables reference —
+    the multi-token (prefill) read path's gather."""
+    b, max_blocks = block_tables.shape
+    g = pool[block_tables]                     # [b, mb, nkv, bs, *]
+    g = g.swapaxes(2, 3)                       # [b, mb, bs, nkv, *]
+    return g.reshape((b, max_blocks * g.shape[2]) + g.shape[3:])
 
 
 def paged_attention_step(q, k, v, k_cache, v_cache, block_tables,
@@ -23,14 +93,25 @@ def paged_attention_step(q, k, v, k_cache, v_cache, block_tables,
                          window=None) -> Tuple:
     """Scatter this step's K/V into the block pool, then attend over it.
 
-    q [b, t, nh, hd]; k/v [b, t, nkv, hd]. ``window``: optional per-layer
-    sliding-window length (int or traced scalar — exaone4 scans per-layer
-    windows). Single-token decode dispatches the paged flash-decode kernel
-    (windowed or plain-causal); multi-token prefill takes the gathered-view
-    mask path. Returns (attn_out [b, t, nh, hd], k_cache, v_cache)."""
+    q [b, t, nh, hd]; k/v [b, t, nkv, hd]. ``k_cache``/``v_cache`` are
+    either plain pools or ``(codes, scales)`` tuples (:func:`split_kv` —
+    quantized KV mode). ``window``: optional per-layer sliding-window length
+    (int or traced scalar — exaone4 scans per-layer windows). Single-token
+    decode dispatches the paged flash-decode kernel (windowed, plain-causal,
+    or the fused-dequant quantized variant); multi-token prefill takes the
+    gathered-view mask path (dequant fusing into the gather consumer).
+    Returns (attn_out [b, t, nh, hd], k_cache, v_cache) with the cache
+    entries in the same representation they arrived in."""
     b, t = q.shape[0], q.shape[1]
     nkv, hd = k.shape[-2], k.shape[-1]
-    bs = k_cache.shape[2]
+    quant = isinstance(k_cache, tuple)
+    if quant:
+        k_codes, k_scales = k_cache
+        v_codes, v_scales = v_cache
+        bs = k_codes.shape[2]
+        group_size = hd // k_scales.shape[-1]
+    else:
+        bs = k_cache.shape[2]
     max_blocks = block_tables.shape[1]
 
     blk_idx = jnp.take_along_axis(block_tables, positions // bs, axis=1)
@@ -38,24 +119,51 @@ def paged_attention_step(q, k, v, k_cache, v_cache, block_tables,
     off = positions % bs
     # advanced indices (blk_idx, off) straddle the kv-head slice, so the
     # result dims land in front: [b, t, nkv, hd] — exactly k's layout
-    k_cache = k_cache.at[blk_idx, :, off].set(k.astype(k_cache.dtype))
-    v_cache = v_cache.at[blk_idx, :, off].set(v.astype(v_cache.dtype))
+    if quant:
+        # fill-time quantization fused into the cache-update: codes and the
+        # per-(token, head, group) scales scatter in the same program
+        qk, sk = kv_quantize_int8(k, group_size)
+        qv, sv = kv_quantize_int8(v, group_size)
+        k_codes = k_codes.at[blk_idx, :, off].set(qk)
+        v_codes = v_codes.at[blk_idx, :, off].set(qv)
+        k_scales = k_scales.at[blk_idx, :, off].set(sk)
+        v_scales = v_scales.at[blk_idx, :, off].set(sv)
+    else:
+        k_cache = k_cache.at[blk_idx, :, off].set(k.astype(k_cache.dtype))
+        v_cache = v_cache.at[blk_idx, :, off].set(v.astype(v_cache.dtype))
 
     if t == 1:
         from ..ops import pallas as _pallas_ops  # noqa: F401 (registers)
         from ..ops.registry import get_op
 
-        out = get_op("paged_decode_attention")(
-            q[:, 0], k_cache, v_cache, block_tables, context_lens,
-            window=window)[:, None]
+        if quant:
+            out = get_op("paged_decode_attention")(
+                q[:, 0], k_codes, v_codes, block_tables, context_lens,
+                window=window, k_scale=k_scales, v_scale=v_scales)[:, None]
+        else:
+            out = get_op("paged_decode_attention")(
+                q[:, 0], k_cache, v_cache, block_tables, context_lens,
+                window=window)[:, None]
     else:
+        if quant:
+            # dequant fuses into the gather consumer — the gathered view is
+            # materialized either way, so the convert rides the same pass
+            kg = kv_dequantize_int8(_gathered_view(k_codes, block_tables),
+                                    _gathered_view(k_scales, block_tables),
+                                    q.dtype)
+            vg = kv_dequantize_int8(_gathered_view(v_codes, block_tables),
+                                    _gathered_view(v_scales, block_tables),
+                                    q.dtype)
+        else:
+            kg = _gathered_view(k_cache, block_tables)
+            vg = _gathered_view(v_cache, block_tables)
         S = max_blocks * bs
-        kg = k_cache[block_tables].swapaxes(2, 3).reshape(b, S, nkv, hd)
-        vg = v_cache[block_tables].swapaxes(2, 3).reshape(b, S, nkv, hd)
         kv_pos = jnp.arange(S)[None, None, None, :]
         q_abs = positions[:, None, :, None]
         mask = kv_pos <= q_abs
         if window is not None:
             mask = mask & (q_abs - kv_pos < window)
         out = attention(q, kg, vg, causal=False, mask=mask)
+    if quant:
+        return out, (k_codes, k_scales), (v_codes, v_scales)
     return out, k_cache, v_cache

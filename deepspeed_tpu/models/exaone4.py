@@ -27,7 +27,8 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..ops.attention import attention
-from ._paged import paged_attention_step
+from ._paged import join_kv, paged_attention_step, split_kv
+from ._paged import init_paged_pools as _init_paged_pools
 from ..ops.embedding import embedding_lookup
 from ..ops.norms import rms_norm
 from ..ops.rotary import apply_rotary, rope_frequencies
@@ -307,10 +308,11 @@ def model_spec(cfg: Exaone4Config, compute_dtype=jnp.bfloat16):
 # mask; block-table layout as in models/llama.py (block 0 = trash).
 # --------------------------------------------------------------------------- #
 def init_paged_cache(cfg: Exaone4Config, num_blocks: int, block_size: int,
-                     dtype=jnp.bfloat16) -> Params:
-    shape = (cfg.num_layers, num_blocks, cfg.num_kv_heads, block_size,
-             cfg.head_size)
-    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+                     dtype=jnp.bfloat16,
+                     kv_quant_group: Optional[int] = None) -> Params:
+    return _init_paged_pools(cfg.num_layers, num_blocks, cfg.num_kv_heads,
+                             block_size, cfg.head_size, dtype,
+                             kv_quant_group)
 
 
 def apply_paged(cfg: Exaone4Config, params: Params, tokens: jnp.ndarray,
@@ -347,5 +349,5 @@ def apply_paged(cfg: Exaone4Config, params: Params, tokens: jnp.ndarray,
         return x, (k_c, v_c)
 
     x, (nk, nv) = lax.scan(
-        scan_body, x, (layers, cache["k"], cache["v"], windows, use_rope))
-    return _head(cfg, params, x, compute_dtype), {"k": nk, "v": nv}
+        scan_body, x, (layers,) + split_kv(cache) + (windows, use_rope))
+    return _head(cfg, params, x, compute_dtype), join_kv(nk, nv)

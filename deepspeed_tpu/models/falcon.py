@@ -22,7 +22,8 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..ops.attention import attention
-from ._paged import paged_attention_step
+from ._paged import join_kv, paged_attention_step, split_kv
+from ._paged import init_paged_pools as _init_paged_pools
 from ..ops.embedding import embedding_lookup
 from ..ops.norms import layer_norm
 from ..ops.rotary import apply_rotary, rope_frequencies
@@ -291,10 +292,11 @@ def model_spec(cfg: FalconConfig, compute_dtype=jnp.bfloat16):
 # models/llama.py: fixed-width tables, block 0 is the trash block)
 # --------------------------------------------------------------------------- #
 def init_paged_cache(cfg: FalconConfig, num_blocks: int, block_size: int,
-                     dtype=jnp.bfloat16) -> Params:
-    shape = (cfg.num_layers, num_blocks, cfg.num_kv_heads, block_size,
-             cfg.head_size)
-    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+                     dtype=jnp.bfloat16,
+                     kv_quant_group: Optional[int] = None) -> Params:
+    return _init_paged_pools(cfg.num_layers, num_blocks, cfg.num_kv_heads,
+                             block_size, cfg.head_size, dtype,
+                             kv_quant_group)
 
 
 def apply_paged(cfg: FalconConfig, params: Params, tokens: jnp.ndarray,
@@ -340,5 +342,5 @@ def apply_paged(cfg: FalconConfig, params: Params, tokens: jnp.ndarray,
                 @ layer["w_down"]
         return x, (k_c, v_c)
 
-    x, (nk, nv) = lax.scan(scan_body, x, (layers, cache["k"], cache["v"]))
-    return _head(cfg, params, x, compute_dtype), {"k": nk, "v": nv}
+    x, (nk, nv) = lax.scan(scan_body, x, (layers,) + split_kv(cache))
+    return _head(cfg, params, x, compute_dtype), join_kv(nk, nv)

@@ -22,7 +22,8 @@ from jax import lax
 from jax.ad_checkpoint import checkpoint_name
 
 from ..ops.attention import attention
-from ._paged import paged_attention_step
+from ._paged import join_kv, paged_attention_step, split_kv
+from ._paged import init_paged_pools as _init_paged_pools
 from ..ops.embedding import embedding_lookup
 from ..ops.norms import layer_norm
 
@@ -286,10 +287,11 @@ def apply_cached(cfg: GPTConfig, params: Params, tokens: jnp.ndarray,
 # block-table layout: fixed-width tables, block 0 is the trash block)
 # --------------------------------------------------------------------------- #
 def init_paged_cache(cfg: GPTConfig, num_blocks: int, block_size: int,
-                     dtype=jnp.bfloat16) -> Params:
-    shape = (cfg.num_layers, num_blocks, cfg.num_heads, block_size,
-             cfg.head_size)
-    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+                     dtype=jnp.bfloat16,
+                     kv_quant_group: Optional[int] = None) -> Params:
+    return _init_paged_pools(cfg.num_layers, num_blocks, cfg.num_heads,
+                             block_size, cfg.head_size, dtype,
+                             kv_quant_group)
 
 
 
@@ -337,8 +339,8 @@ def apply_paged(cfg: GPTConfig, params: Params, tokens: jnp.ndarray,
         x, kv = _block(cfg, x, layer, attn_call=attn_call)
         return x, kv
 
-    x, (nk, nv) = lax.scan(scan_body, x, (layers, cache["k"], cache["v"]))
-    return _head(cfg, params, x, compute_dtype), {"k": nk, "v": nv}
+    x, (nk, nv) = lax.scan(scan_body, x, (layers,) + split_kv(cache))
+    return _head(cfg, params, x, compute_dtype), join_kv(nk, nv)
 
 
 def loss_fn(cfg: GPTConfig, params: Params, batch: Dict[str, jnp.ndarray], *,

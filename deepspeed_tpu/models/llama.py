@@ -31,7 +31,8 @@ from jax import lax
 from jax.ad_checkpoint import checkpoint_name
 
 from ..ops.attention import attention
-from ._paged import paged_attention_step
+from ._paged import join_kv, paged_attention_step, split_kv
+from ._paged import init_paged_pools as _init_paged_pools
 from ..ops.embedding import embedding_lookup
 from ..ops.norms import rms_norm
 from ..ops.rotary import apply_rotary, rope_frequencies
@@ -523,13 +524,16 @@ def apply_cached(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,
 # decode kernels. Block tables are fixed-width; block 0 is the trash block.
 # --------------------------------------------------------------------------- #
 def init_paged_cache(cfg: LlamaConfig, num_blocks: int, block_size: int,
-                     dtype=jnp.bfloat16) -> Params:
-    L, nkv, hd = cfg.num_layers, cfg.num_kv_heads, cfg.head_size
+                     dtype=jnp.bfloat16,
+                     kv_quant_group: Optional[int] = None) -> Params:
     # [*, nkv, block_size, hd]: the decode kernel's per-block tile is then
     # (block_size, hd) — legal TPU tiling (second-to-last %8; a squeezed kv
-    # head in the last two positions is rejected by the Mosaic lowering)
-    shape = (L, num_blocks, nkv, block_size, hd)
-    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+    # head in the last two positions is rejected by the Mosaic lowering).
+    # kv_quant_group (inference.kv_quant): int8 code pools + fp32 scale
+    # pools instead — see models/_paged.py.
+    return _init_paged_pools(cfg.num_layers, num_blocks, cfg.num_kv_heads,
+                             block_size, cfg.head_size, dtype,
+                             kv_quant_group)
 
 
 
@@ -586,13 +590,14 @@ def apply_paged(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,
                                    context_lens, valid, cos, sin, positions)
         return x, (k_c, v_c)
 
-    x, (new_k, new_v) = lax.scan(scan_body, x, (layers, cache["k"], cache["v"]))
+    # quantized-KV mode threads (codes, scales) tuples per pool (split_kv)
+    x, (new_k, new_v) = lax.scan(scan_body, x, (layers,) + split_kv(cache))
     x = rms_norm(x, params["final_norm"].astype(compute_dtype), cfg.rms_norm_eps)
     head = params.get("lm_head")
     if head is None:
         head = params["embed"].T
     logits = x @ head.astype(compute_dtype)
-    return logits.astype(jnp.float32), {"k": new_k, "v": new_v}
+    return logits.astype(jnp.float32), join_kv(new_k, new_v)
 
 
 def model_spec(cfg: LlamaConfig, compute_dtype=jnp.bfloat16):

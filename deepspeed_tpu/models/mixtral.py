@@ -21,7 +21,8 @@ from jax.ad_checkpoint import checkpoint_name
 
 from ..moe.layer import MoELayer, init_moe_ffn, moe_ffn_logical_axes
 from ..ops.attention import attention
-from ._paged import paged_attention_step
+from ._paged import join_kv, paged_attention_step, split_kv
+from ._paged import init_paged_pools as _init_paged_pools
 from ..ops.embedding import embedding_lookup
 from ..ops.norms import rms_norm
 from ..ops.rotary import apply_rotary, rope_frequencies
@@ -301,10 +302,11 @@ def model_spec(cfg: MixtralConfig, compute_dtype=jnp.bfloat16):
 # models/llama.py: fixed-width tables, block 0 is the trash block)
 # --------------------------------------------------------------------------- #
 def init_paged_cache(cfg: MixtralConfig, num_blocks: int, block_size: int,
-                     dtype=jnp.bfloat16) -> Params:
-    shape = (cfg.num_layers, num_blocks, cfg.num_kv_heads, block_size,
-             cfg.head_size)
-    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+                     dtype=jnp.bfloat16,
+                     kv_quant_group: Optional[int] = None) -> Params:
+    return _init_paged_pools(cfg.num_layers, num_blocks, cfg.num_kv_heads,
+                             block_size, cfg.head_size, dtype,
+                             kv_quant_group)
 
 
 def apply_paged(cfg: MixtralConfig, params: Params, tokens: jnp.ndarray,
@@ -345,8 +347,8 @@ def apply_paged(cfg: MixtralConfig, params: Params, tokens: jnp.ndarray,
         ffn_out, _aux = moe_layer(layer["moe"], y)
         return x + ffn_out, (k_c, v_c)
 
-    x, (nk, nv) = lax.scan(scan_body, x, (layers, cache["k"], cache["v"]))
+    x, (nk, nv) = lax.scan(scan_body, x, (layers,) + split_kv(cache))
     x = rms_norm(x, params["final_norm"].astype(compute_dtype),
                  cfg.rms_norm_eps)
     logits = x @ params["lm_head"].astype(compute_dtype)
-    return logits.astype(jnp.float32), {"k": nk, "v": nv}
+    return logits.astype(jnp.float32), join_kv(nk, nv)

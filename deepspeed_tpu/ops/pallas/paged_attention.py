@@ -21,6 +21,17 @@ Decode layout: one query token per sequence.
                length is context_lens + 1).
 Grid: (B, nkv, max_blocks), KV-block loop innermost/sequential; the GQA query
 group (g = nh/nkv rows) rides the MXU sublanes.
+
+Quantized KV mode (``inference.kv_quant``, docs/serving.md "Quantized KV
+cache"): ``k_pool``/``v_pool`` hold int8 codes and ``k_scale``/``v_scale``
+``[num_blocks, nkv, bs, ngroups]`` fp32 per-block-per-group scales ride the
+same block-table-indexed BlockSpecs. The kernel loads the int8 tile plus its
+scale tile and dequantizes IN-REGISTER (a lane broadcast at ngroups == 1 —
+the default ``group_size >= hd`` config — or a grouped reshape-multiply
+otherwise) immediately before the bf16 MXU dots. No standalone XLA
+int8→bf16 convert pass over the pool ever runs: QUANT_TPU_LIVE.json pins
+that path at 1.02–1.21× SLOWER than bf16, so the entire win is int8 HBM
+traffic + residency with the convert hidden inside the flash loop.
 """
 
 from __future__ import annotations
@@ -44,8 +55,32 @@ from ._common import interpret as _interpret
 NEG_INF = -1e30
 
 
-def _decode_kernel(*refs, bs, scale, nblk, gpad, has_window):
-    if has_window:
+def _dequant_tile(codes_ref, scale_ref, dtype):
+    """In-register dequant of one [bs, hd] int8 KV tile with its [bs, ng]
+    fp32 scale tile, emitted right before the MXU dot. ng == 1 (the default
+    ``group_size >= hd`` config) is a pure lane broadcast; ng > 1 groups the
+    lanes (blocked layout, matching ``ops.quantization.kv_quantize_int8``)."""
+    x = codes_ref[...].astype(jnp.float32)
+    s = scale_ref[...]
+    ng = s.shape[1]
+    if ng == 1:
+        x = x * s
+    else:
+        bs_, hd_ = x.shape
+        x = (x.reshape(bs_, ng, hd_ // ng) * s[:, :, None]).reshape(bs_, hd_)
+    return x.astype(dtype)
+
+
+def _decode_kernel(*refs, bs, scale, nblk, gpad, has_window, quant=False):
+    if quant:
+        if has_window:
+            (tables_ref, ctx_ref, wnd_ref, q_ref, k_ref, v_ref, ks_ref,
+             vs_ref, o_ref, m_scr, l_scr, acc_scr) = refs
+        else:
+            (tables_ref, ctx_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref,
+             o_ref, m_scr, l_scr, acc_scr) = refs
+            wnd_ref = None
+    elif has_window:
         (tables_ref, ctx_ref, wnd_ref, q_ref, k_ref, v_ref, o_ref,
          m_scr, l_scr, acc_scr) = refs
     else:
@@ -75,8 +110,12 @@ def _decode_kernel(*refs, bs, scale, nblk, gpad, has_window):
     @pl.when(live)
     def _compute():
         q = q_ref[...]                     # [gpad, hd]
-        k = k_ref[...]                     # [bs, hd]
-        v = v_ref[...]                     # [bs, hd]
+        if quant:                          # int8 tile → q.dtype, in-register
+            k = _dequant_tile(k_ref, ks_ref, q_ref.dtype)
+            v = _dequant_tile(v_ref, vs_ref, q_ref.dtype)
+        else:
+            k = k_ref[...]                 # [bs, hd]
+            v = v_ref[...]                 # [bs, hd]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         pos = j * bs + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
@@ -108,11 +147,15 @@ def paged_decode_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
                            v_pool: jnp.ndarray, block_tables: jnp.ndarray,
                            context_lens: jnp.ndarray, *,
                            scale: float = None,
-                           window=None) -> jnp.ndarray:
+                           window=None, k_scale=None,
+                           v_scale=None) -> jnp.ndarray:
     """See module docstring. Returns [B, nh, hd]. ``window``: optional
     sliding-window length (int or traced scalar — exaone4 scans per-layer
     windows): only the last ``window`` positions are attended; blocks
-    entirely outside the window skip their compute."""
+    entirely outside the window skip their compute. ``k_scale``/``v_scale``:
+    per-block-per-group fp32 scale pools ``[num_blocks, nkv, bs, ngroups]``
+    for int8 code pools — the quantized-KV mode with dequant fused into the
+    flash loop (both or neither must be given)."""
     B, nh, hd = q.shape
     nblocks, nkv, bs, _ = k_pool.shape
     max_blocks = block_tables.shape[1]
@@ -120,6 +163,9 @@ def paged_decode_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
     gpad = max(8, 1 << (g - 1).bit_length())  # sublane-pad the query group
     scale = hd ** -0.5 if scale is None else scale
     has_window = window is not None
+    quant = k_scale is not None
+    assert quant == (v_scale is not None), \
+        "k_scale and v_scale must be given together"
     if has_window:
         # window <= 0 is nonsensical: every score masks to NEG_INF and the
         # all-masked softmax degenerates to a uniform average over a garbage
@@ -134,7 +180,7 @@ def paged_decode_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
 
     kernel = functools.partial(_decode_kernel, bs=bs, scale=float(scale),
                                nblk=max_blocks, gpad=gpad,
-                               has_window=has_window)
+                               has_window=has_window, quant=quant)
 
     # index maps are called positionally with one trailing arg per
     # prefetched scalar array — varargs serves both arities. Dead grid
@@ -152,15 +198,24 @@ def paged_decode_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
         j_eff = jnp.clip(j, lo_blk, hi_blk)
         return (jnp.clip(tables[b, j_eff], 0, nblocks - 1), h, 0, 0)
 
+    in_specs = [
+        pl.BlockSpec((None, None, gpad, hd), qmap),
+        # the paged read: pool block chosen by the table
+        pl.BlockSpec((None, None, bs, hd), kvmap),
+        pl.BlockSpec((None, None, bs, hd), kvmap),
+    ]
+    operands = [qg, k_pool, v_pool]
+    if quant:
+        # scale tiles ride the SAME block-table-indexed map as their code
+        # tiles, so a dead grid step elides both DMAs together
+        ng = k_scale.shape[-1]
+        in_specs += [pl.BlockSpec((None, None, bs, ng), kvmap),
+                     pl.BlockSpec((None, None, bs, ng), kvmap)]
+        operands += [k_scale, v_scale]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2 + int(has_window),
         grid=(B, nkv, max_blocks),
-        in_specs=[
-            pl.BlockSpec((None, None, gpad, hd), qmap),
-            # the paged read: pool block chosen by the table
-            pl.BlockSpec((None, None, bs, hd), kvmap),
-            pl.BlockSpec((None, None, bs, hd), kvmap),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((None, None, gpad, hd), qmap),
         scratch_shapes=[
             pltpu.VMEM((gpad, 128), jnp.float32),
@@ -177,7 +232,7 @@ def paged_decode_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
         out_shape=jax.ShapeDtypeStruct((B, nkv, gpad, hd), q.dtype),
         compiler_params=_dim_semantics("parallel", "parallel", "arbitrary"),
         interpret=_interpret(),
-    )(*prefetch, qg, k_pool, v_pool)
+    )(*prefetch, *operands)
     return out[:, :, :g].reshape(B, nh, hd)
 
 
@@ -185,9 +240,13 @@ def paged_decode_attention_xla(q: jnp.ndarray, k_pool: jnp.ndarray,
                                v_pool: jnp.ndarray, block_tables: jnp.ndarray,
                                context_lens: jnp.ndarray, *,
                                scale: float = None,
-                               window=None) -> jnp.ndarray:
+                               window=None, k_scale=None,
+                               v_scale=None) -> jnp.ndarray:
     """Dense-gather fallback with identical semantics (compiled XLA — the
-    right choice off-TPU, where the Pallas path runs interpreted)."""
+    right choice off-TPU, where the Pallas path runs interpreted).
+    ``k_scale``/``v_scale``: the quantized-KV reference path — int8 code
+    pools dequantize on the gathered view (the convert rides the gather
+    consumer, matching the fused-kernel semantics bit-for-bit in fp32)."""
     from ..attention import attention_xla
 
     B, nh, hd = q.shape
@@ -196,14 +255,46 @@ def paged_decode_attention_xla(q: jnp.ndarray, k_pool: jnp.ndarray,
     S = max_blocks * bs
     kg = k_pool[block_tables].swapaxes(2, 3).reshape(B, S, nkv, hd)
     vg = v_pool[block_tables].swapaxes(2, 3).reshape(B, S, nkv, hd)
-    kv_pos = jnp.arange(S)[None, None, None, :]
-    cl = context_lens[:, None, None, None]
-    mask = kv_pos <= cl
     if window is not None:
         # same window >= 1 contract as the Pallas kernel
         if isinstance(window, (int, np.integer)):
             assert window >= 1, f"sliding window must be >= 1, got {window}"
         window = jnp.maximum(jnp.asarray(window, jnp.int32), 1)
+    if k_scale is not None and k_scale.shape[-1] == 1:
+        # one scale per (block, head, token) — the default group_size >= hd
+        # config. Fold the scales into SCORE space instead of dequantizing
+        # the [B, S, nkv, hd] gathered views: s_pos = (q · codes_pos) ·
+        # k_scale_pos and out = (p · v_scale) @ v_codes, so the per-step
+        # dequant work drops from O(S · hd) multiplies per head to O(S)
+        sc = hd ** -0.5 if scale is None else scale
+        g = nh // nkv
+        qg = q.reshape(B, nkv, g, hd).astype(jnp.float32)
+        ksg = k_scale[block_tables].swapaxes(2, 3).reshape(B, S, nkv)
+        vsg = v_scale[block_tables].swapaxes(2, 3).reshape(B, S, nkv)
+        s = jnp.einsum("bngh,bsnh->bngs", qg, kg.astype(jnp.float32)) * sc
+        s = s * ksg.transpose(0, 2, 1)[:, :, None, :]       # [B, nkv, g, S]
+        kv_pos = jnp.arange(S)[None, None, None, :]
+        cl = context_lens[:, None, None, None]
+        mask = kv_pos <= cl
+        if window is not None:
+            mask = mask & (kv_pos > cl - window)
+        s = jnp.where(mask, s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        p = p * vsg.transpose(0, 2, 1)[:, :, None, :]
+        out = jnp.einsum("bngs,bsnh->bngh", p, vg.astype(jnp.float32))
+        return out.reshape(B, nh, hd).astype(q.dtype)
+    if k_scale is not None:
+        from ..quantization import kv_dequantize_int8
+
+        ng = k_scale.shape[-1]
+        ksg = k_scale[block_tables].swapaxes(2, 3).reshape(B, S, nkv, ng)
+        vsg = v_scale[block_tables].swapaxes(2, 3).reshape(B, S, nkv, ng)
+        kg = kv_dequantize_int8(kg, ksg, q.dtype)
+        vg = kv_dequantize_int8(vg, vsg, q.dtype)
+    kv_pos = jnp.arange(S)[None, None, None, :]
+    cl = context_lens[:, None, None, None]
+    mask = kv_pos <= cl
+    if window is not None:
         mask = mask & (kv_pos > cl - window)
     out = attention_xla(q[:, None], kg, vg, causal=False, mask=mask,
                         scale=scale)

@@ -7,13 +7,60 @@ faster TPU implementations under the same op names
 (``ops/pallas/quantize.py``); these XLA versions are the guaranteed fallback
 on any backend. Quantized-collective compositions (ZeRO++-style qwZ/qgZ)
 build on these ops in ``deepspeed_tpu/comm``.
+
+:func:`group_quantize_int8` is THE shared symmetric int8 group quantizer —
+one formula serving both the quantized collectives (``comm/compressed.py``:
+qgZ reduce-scatter, EQuARX all-reduce, LoCo error feedback) and the
+quantized KV cache (``models/_paged.py`` fill-time quantization +
+``ops/pallas/paged_attention.py`` fused dequant; docs/serving.md "Quantized
+KV cache"). A tier-1 regression test pins its output bit-identical to the
+historical inline formulas, so numerical drift here is a test failure, not a
+silent trajectory change.
 """
 
 from __future__ import annotations
 
+from typing import Tuple
+
 import jax.numpy as jnp
 
 from .registry import op, register
+
+
+def group_quantize_int8(g: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Symmetric int8 quantization of the trailing (group) dim of an
+    already-grouped array: ``g [..., group]`` → ``(codes int8 same shape,
+    scales fp32 [..., 1])`` with ``scale = max(max|g|, 1e-8) / 127``."""
+    scale = jnp.maximum(jnp.max(jnp.abs(g), axis=-1, keepdims=True),
+                        1e-8) / 127.0
+    q = jnp.clip(jnp.round(g / scale), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def kv_quantize_int8(x: jnp.ndarray, group_size: int
+                     ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Groupwise int8 quantization of KV vectors along the trailing (head)
+    dim: ``x [..., hd]`` → ``(codes int8 [..., hd], scales fp32 [..., ng])``
+    with ``ng = hd // group_size`` groups per vector. Each token's vector is
+    quantized independently, so incremental cache fills never touch already
+    written positions' scales. Routes through :func:`group_quantize_int8`."""
+    hd = x.shape[-1]
+    assert hd % group_size == 0, (hd, group_size)
+    g = x.astype(jnp.float32).reshape(
+        x.shape[:-1] + (hd // group_size, group_size))
+    q, scale = group_quantize_int8(g)
+    return q.reshape(x.shape), scale[..., 0]
+
+
+def kv_dequantize_int8(codes: jnp.ndarray, scales: jnp.ndarray,
+                       dtype=jnp.float32) -> jnp.ndarray:
+    """Inverse of :func:`kv_quantize_int8`: ``codes [..., hd]`` int8 +
+    ``scales [..., ng]`` → ``[..., hd]`` in ``dtype`` (group size inferred
+    as ``hd // ng``)."""
+    hd, ng = codes.shape[-1], scales.shape[-1]
+    gs = hd // ng
+    x = codes.astype(jnp.float32).reshape(codes.shape[:-1] + (ng, gs))
+    return (x * scales[..., None]).reshape(codes.shape).astype(dtype)
 
 
 @register("quantize_int8", backend="xla")

@@ -51,7 +51,8 @@ from jax.sharding import PartitionSpec as P
 
 from ...comm import comm as dist
 from ...comm.mesh import get_mesh
-from .module import one_f_one_b_predicates, one_f_one_b_ticks, ring_perms
+from .module import (one_f_one_b_predicates, one_f_one_b_ticks, ring_perms,
+                     stage_ids)
 
 
 # --------------------------------------------------------------------------- #
@@ -440,8 +441,8 @@ def hetero_pipeline_value_and_grad(
         lambda b, x: first_fn(unpack_stage(stage_rows(b, 0), layouts[0]), x),
         buffers, jax.tree.map(lambda x: x[0], micro_in))
 
-    def pipelined(bufs, micro_in, micro_lab, probe_shape):
-        stage = lax.axis_index(pipe_axis)
+    def pipelined(stage_arr, bufs, micro_in, micro_lab, probe_shape):
+        stage = stage_arr[0]   # sharded iota — see module.stage_ids
         # each rank's packed row IS its stage's params (P('pipe') in_spec)
         rows = {dt: b[0] for dt, b in bufs.items()}
         stash = jnp.zeros((S,) + probe_shape.shape, probe_shape.dtype)
@@ -562,11 +563,15 @@ def hetero_pipeline_value_and_grad(
         return loss, {dt: g[None, :] for dt, g in g_rows.items()}
 
     probe_shape = jnp.zeros(probe.shape, probe.dtype)
+    # fully-manual region: partial-manual ppermute CHECK-fails this
+    # jax/XLA's SPMD partitioner — see module.pipeline_apply
     loss, grads = dist.shard_map(
-        pipelined, mesh=mm.mesh, axis_names={pipe_axis},
-        in_specs=({dt: P(pipe_axis) for dt in buffers}, P(), P(), P()),
+        pipelined, mesh=mm.mesh, axis_names=None,
+        in_specs=(P(pipe_axis),
+                  {dt: P(pipe_axis) for dt in buffers}, P(), P(), P()),
         out_specs=(P(), {dt: P(pipe_axis) for dt in buffers}),
-        check_vma=False)(buffers, micro_in, micro_lab, probe_shape)
+        check_vma=False)(stage_ids(S), buffers, micro_in, micro_lab,
+                         probe_shape)
     return loss, grads
 
 

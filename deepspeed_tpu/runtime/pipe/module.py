@@ -45,6 +45,19 @@ def psum_f32(x, axis_name: str):
     return lax.psum(x, axis_name)
 
 
+def stage_ids(S: int) -> jnp.ndarray:
+    """``[S]`` int32 stage indices, passed through shard_map with in_spec
+    ``P(pipe_axis)`` so each stage reads its own index from its shard
+    (``stage_arr[0]``). This replaces ``lax.axis_index`` inside the
+    pipeline regions: under a PARTIAL-manual shard_map (manual over 'pipe'
+    only, data/tensor/... still automatic) axis_index lowers to a
+    ``PartitionId`` HLO op that the SPMD partitioner rejects outright
+    ("meaning is ambiguous"), which failed every pipeline schedule at jit
+    time. An explicitly sharded iota carries the same information with no
+    partition-dependent instruction."""
+    return jnp.arange(S, dtype=jnp.int32)
+
+
 def ring_perms(S: int):
     """(forward, backward) neighbor rings over the pipe axis — the
     SendActivation/RecvActivation and SendGrad/RecvGrad channels."""
@@ -126,10 +139,10 @@ def pipeline_apply(block_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
         out, _ = lax.scan(scan_body, h, stage_layers)
         return out
 
-    def pipelined(staged_layers, micro_local):
+    def pipelined(stage_arr, staged_layers, micro_local):
         """Inside shard_map over 'pipe': staged_layers are THIS stage's layer
         params [1, L/S, ...]; micro_local: all microbatches (replicated)."""
-        stage = lax.axis_index(pipe_axis)
+        stage = stage_arr[0]
         my_layers = jax.tree.map(lambda l: l[0], staged_layers)
         mb_shape = micro_local.shape[1:]
         state = jnp.zeros(mb_shape, micro_local.dtype)   # rotating buffer
@@ -156,11 +169,18 @@ def pipeline_apply(block_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
         # non-last stages hold zeros; psum over 'pipe' broadcasts the results
         return psum_f32(outputs, pipe_axis)
 
-    # Manual ONLY over 'pipe' (axis_names): data/tensor/seq/expert stay under
-    # the automatic partitioner, so TP-sharded layer weights remain sharded
-    # inside each stage and the batch keeps its dp sharding.
+    # FULLY manual region (axis_names=None): partial-manual (manual over
+    # 'pipe' only, auto= on 0.4-era jax) fatally CHECK-fails XLA's SPMD
+    # partitioner on every ppermute in this jax/XLA version
+    # ("target.IsManualSubgroup() == sharding().IsManualSubgroup()"), and
+    # lax.axis_index lowers to an unpartitionable PartitionId there — the
+    # pipeline schedule never compiled. Fully manual, P() inputs replicate
+    # over the non-pipe axes (each data shard computes every microbatch —
+    # redundant on CPU test meshes, identical results) and the stage index
+    # arrives as a sharded iota (stage_ids).
     out = dist.shard_map(
-        pipelined, mesh=mm.mesh, axis_names={pipe_axis},
-        in_specs=(jax.tree.map(lambda _: P(pipe_axis), staged), P()),
-        out_specs=P(), check_vma=False)(staged, micro)
+        pipelined, mesh=mm.mesh, axis_names=None,
+        in_specs=(P(pipe_axis),
+                  jax.tree.map(lambda _: P(pipe_axis), staged), P()),
+        out_specs=P(), check_vma=False)(stage_ids(S), staged, micro)
     return out.reshape((B,) + out.shape[2:])

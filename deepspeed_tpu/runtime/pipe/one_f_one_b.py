@@ -43,7 +43,7 @@ from jax.sharding import PartitionSpec as P
 from ...comm import comm as dist
 from ...comm.mesh import get_mesh
 from .module import (_stage_params, one_f_one_b_predicates,
-                     one_f_one_b_ticks, psum_f32, ring_perms)
+                     one_f_one_b_ticks, psum_f32, ring_perms, stage_ids)
 
 
 def pipeline_value_and_grad(embed_fn: Callable[[Any, Any], jnp.ndarray],
@@ -98,8 +98,9 @@ def pipeline_value_and_grad(embed_fn: Callable[[Any, Any], jnp.ndarray],
         out, _ = lax.scan(body, h, my_layers)
         return out
 
-    def pipelined(staged_layers, E, H, micro_in, micro_lab, probe_shape):
-        stage = lax.axis_index(pipe_axis)
+    def pipelined(stage_arr, staged_layers, E, H, micro_in, micro_lab,
+                  probe_shape):
+        stage = stage_arr[0]   # sharded iota — see module.stage_ids
         is_first = stage == 0
         is_last = stage == S - 1
         my_layers = jax.tree.map(lambda l: l[0], staged_layers)
@@ -220,13 +221,17 @@ def pipeline_value_and_grad(embed_fn: Callable[[Any, Any], jnp.ndarray],
                            jax.tree.map(lambda x: x[0], micro_in))
     probe_shape = jnp.zeros(probe.shape, probe.dtype)
 
+    # fully-manual region: partial-manual ppermute CHECK-fails this
+    # jax/XLA's SPMD partitioner — see module.pipeline_apply
     loss, g_staged, g_embed, g_head = dist.shard_map(
-        pipelined, mesh=mm.mesh, axis_names={pipe_axis},
-        in_specs=(jax.tree.map(lambda _: P(pipe_axis), staged),
+        pipelined, mesh=mm.mesh, axis_names=None,
+        in_specs=(P(pipe_axis),
+                  jax.tree.map(lambda _: P(pipe_axis), staged),
                   P(), P(), P(), P(), P()),
         out_specs=(P(), jax.tree.map(lambda _: P(pipe_axis), staged),
                    P(), P()),
-        check_vma=False)(staged, E, H, micro_in, micro_lab, probe_shape)
+        check_vma=False)(stage_ids(S), staged, E, H, micro_in, micro_lab,
+                         probe_shape)
 
     L = jax.tree.leaves(layers)[0].shape[0]
     g_layers = jax.tree.map(

@@ -66,6 +66,10 @@ SERVING_SERIES = frozenset(
     + [f"Serving/latency/{m}_{s}"
        for m in ("ttft_ms", "itl_ms", "queue_ms", "e2e_ms")
        for s in ("p50", "p90", "p99", "count")]
+    # quantized KV cache (inference.kv_quant; docs/serving.md "Quantized
+    # KV cache" — engine_v2.kv_quant_events)
+    + ["Serving/kv_quant/" + m for m in (
+        "blocks_quantized", "bytes_saved", "max_abs_err", "dequant_fused")]
     + ["Serving/spec/" + m for m in (
         "verify_steps", "decode_steps", "step_seqs", "drafted_tokens",
         "accepted_tokens", "emitted_tokens", "rolled_back_tokens",

@@ -21,7 +21,11 @@ hand-rolled FCFS admit loop — goodput-under-SLO, queue-wait percentiles,
 and preemption counts for the tpu_watch SERVING probe — plus a fleet
 CHAOS probe (``detail.chaos``): the same trace on a two-replica fleet,
 fault-free vs with a mid-trace replica crash, reporting the goodput delta
-that failover + circuit-breaker re-admission leave behind.
+that failover + circuit-breaker re-admission leave behind, and a
+quantized-KV workload (``detail.kvquant``, gate ``DSTPU_BENCH_KVQUANT=0``):
+int8 KV blocks at EQUAL pool bytes vs bf16 — resident sequences, decode
+tok/s, ITL p50/p99, per-token logit MAE, greedy stream identity
+(docs/serving.md "Quantized KV cache").
 ONE JSON line.
 """
 
@@ -262,6 +266,129 @@ def run_decode_heavy(build, sp, vocab, batch, prompt_len, gen_len,
             sys.stderr.write(f"[serving] decode_heavy {label}: {row}\n")
         finally:
             del eng
+    return out
+
+
+def run_kvquant(llama_mod, mcfg, sp, vocab, batch, prompt_len, gen_len,
+                measure_s, block_size, group_size=128):
+    """Quantized-KV workload (docs/serving.md "Quantized KV cache"):
+    prefix cache ON, ``kv_quant`` OFF vs ON **at equal KV pool bytes** —
+    the bf16 engine gets ``nb_bf16`` blocks, the int8 engine gets however
+    many blocks the SAME byte budget buys once codes are int8 + fp32
+    per-group scales (per-block bytes measured from the actual cache
+    leaves, not assumed). Reports:
+
+    - ``resident_ratio``: max concurrently admittable sequences at the
+      byte budget, quant over bf16 — the density headline (>= 1.8x
+      acceptance at group_size <= 128 on hd >= 64 models);
+    - decode tok/s + ITL p50/p99 both modes (regression <= 10% accepted);
+    - ``logit_mae`` / ``argmax_agree``: per-token logit MAE and greedy
+      argmax agreement of the quantized forward vs bf16 on one prompt
+      (direct ``apply_paged`` probe — the engines never expose logits);
+    - ``greedy_identical``: fraction of greedy streams token-identical
+      between the two engines on the measured workload."""
+    import jax
+    import numpy as np
+
+    from deepspeed_tpu.inference.engine_v2 import build_engine_v2
+
+    params = llama_mod.init(mcfg, jax.random.PRNGKey(0))
+
+    def build_eng(quant_on, nb):
+        return build_engine_v2(
+            llama_mod, mcfg, params,
+            config={"dtype": "bfloat16",
+                    "prefill_bucket": min(64, prompt_len),
+                    "prefix_cache": {"enabled": True},
+                    "kv_quant": {"enabled": quant_on,
+                                 "group_size": group_size},
+                    "ragged": {"max_tracked_sequences": batch * 4,
+                               "max_ragged_batch_size": batch * 4,
+                               "memory_config_blocks": nb,
+                               "block_size": block_size}})
+
+    def pool_bytes(eng):
+        return sum(leaf.size * leaf.dtype.itemsize
+                   for leaf in jax.tree.leaves(eng.cache))
+
+    def resident_capacity(eng):
+        """Sequences of this workload's footprint the pool admits at once."""
+        n = 0
+        while eng.state.can_admit(prompt_len + gen_len) and \
+                n < eng.state.max_sequences:
+            eng.state.admit(10 ** 7 + n, prompt_len + gen_len)
+            n += 1
+        for i in range(n):
+            eng.state.retire(10 ** 7 + i)
+        return n
+
+    need = (prompt_len + gen_len) // block_size + 3
+    nb_bf16 = batch * need + 8
+    out = {"prompt_len": prompt_len, "gen_len": gen_len, "batch": batch,
+           "group_size": group_size, "block_size": block_size}
+    eng_off = build_eng(False, nb_bf16)
+    per_block_bf16 = pool_bytes(eng_off) // (nb_bf16)
+    budget = nb_bf16 * per_block_bf16
+    # how many int8+scales blocks the SAME bytes buy (measure, don't assume)
+    probe = build_eng(True, nb_bf16)
+    per_block_q = pool_bytes(probe) // nb_bf16
+    del probe
+    nb_q = int(budget // per_block_q)
+    out["pool_bytes"] = int(budget)
+    out["blocks"] = {"bf16": nb_bf16, "int8": nb_q}
+    eng_on = build_eng(True, nb_q)
+    out["resident_seqs"] = {"bf16": resident_capacity(eng_off),
+                            "int8": resident_capacity(eng_on)}
+    out["resident_ratio"] = round(
+        out["resident_seqs"]["int8"] / max(1, out["resident_seqs"]["bf16"]),
+        2)
+
+    streams = {}
+    for label, eng in (("quant_off", eng_off), ("quant_on", eng_on)):
+        traffic = _traffic(seed=31, vocab_size=vocab, prompt_len=prompt_len)
+        row = run_closed_loop(eng, sp, traffic, batch, gen_len, measure_s,
+                              quantum=1)
+        out[label] = row
+        # greedy stream comparison on a fixed prompt set (outside the
+        # measured window)
+        grng = np.random.default_rng(77)
+        prompts = [grng.integers(0, vocab, prompt_len).tolist()
+                   for _ in range(min(batch, 4))]
+        streams[label] = eng.generate(prompts, max_new_tokens=gen_len,
+                                      seed=0)
+        if label == "quant_on":
+            eng.debug_check_cache()
+            eng.state.debug_check()
+            tel_dir = os.environ.get("DSTPU_SERVING_TELEMETRY")
+            if tel_dir:
+                _dump_serving_telemetry(eng, tel_dir,
+                                        job="serving_bench_kvquant")
+        sys.stderr.write(f"[serving] kvquant {label}: {row}\n")
+    out["greedy_identical"] = round(
+        sum(a == b for a, b in zip(streams["quant_off"],
+                                   streams["quant_on"]))
+        / max(1, len(streams["quant_off"])), 3)
+    out["decode_tok_s_ratio"] = round(
+        out["quant_on"]["tok_per_sec"]
+        / max(1e-9, out["quant_off"]["tok_per_sec"]), 3)
+    del eng_off, eng_on
+
+    # per-token logit error probe: one prompt through apply_paged on a
+    # bf16 cache vs an int8+scales cache (identical tables/positions)
+    import jax.numpy as jnp
+    prng = np.random.default_rng(5)
+    toks = jnp.asarray(prng.integers(0, vocab, (1, prompt_len)), jnp.int32)
+    nb_p = prompt_len // block_size + 3
+    tables = jnp.arange(1, nb_p + 1, dtype=jnp.int32)[None]
+    ctx = jnp.zeros((1,), jnp.int32)
+    c_bf = llama_mod.init_paged_cache(mcfg, nb_p + 2, block_size)
+    c_q = llama_mod.init_paged_cache(mcfg, nb_p + 2, block_size,
+                                     kv_quant_group=group_size)
+    lo_bf, _ = llama_mod.apply_paged(mcfg, params, toks, c_bf, tables, ctx)
+    lo_q, _ = llama_mod.apply_paged(mcfg, params, toks, c_q, tables, ctx)
+    out["logit_mae"] = round(float(jnp.mean(jnp.abs(lo_q - lo_bf))), 5)
+    out["argmax_agree"] = round(float(jnp.mean(
+        (jnp.argmax(lo_q, -1) == jnp.argmax(lo_bf, -1)))), 3)
     return out
 
 
@@ -710,6 +837,32 @@ def main():
             meas_sd)
     except Exception as e:
         RESULT["detail"]["decode_heavy"] = f"error: {str(e)[-200:]}"
+
+    # quantized-KV workload: prefix cache ON, kv_quant OFF vs ON at EQUAL
+    # pool bytes — resident sequences, decode tok/s, ITL p50/p99, per-token
+    # logit MAE (docs/serving.md "Quantized KV cache"); non-fatal KVQUANT
+    # row in tpu_watch.sh, gated by DSTPU_BENCH_KVQUANT=0
+    if os.environ.get("DSTPU_BENCH_KVQUANT", "1") != "0":
+        try:
+            if on_tpu:
+                mcfg_kq = mcfg          # 235M, hd=128
+                batch_kq, plen_kq, glen_kq, meas_kq, bs_kq = \
+                    16, 256, 64, 20.0, 32
+            else:
+                # hd=64 (not tiny's 16): the fp32 scale sidecar is 4/hd of
+                # the code bytes, so small heads understate the density win
+                # the serving models (hd >= 64) actually get
+                mcfg_kq = llama.LlamaConfig(
+                    vocab_size=512, hidden_size=128, intermediate_size=256,
+                    num_layers=2, num_heads=2, num_kv_heads=2,
+                    max_seq_len=512)
+                batch_kq, plen_kq, glen_kq, meas_kq, bs_kq = \
+                    4, 32, 8, 5.0, 16
+            RESULT["detail"]["kvquant"] = run_kvquant(
+                llama, mcfg_kq, sp, mcfg_kq.vocab_size, batch_kq, plen_kq,
+                glen_kq, meas_kq, bs_kq)
+        except Exception as e:
+            RESULT["detail"]["kvquant"] = f"error: {str(e)[-200:]}"
 
     # open-loop Poisson workload: continuous-batching scheduler vs the
     # hand-rolled FCFS loop on the SAME seeded arrival trace — goodput under

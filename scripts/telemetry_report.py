@@ -462,7 +462,10 @@ def serving(events: List[dict]) -> str:
     goodput-under-SLO), the multi-replica router placement counters from
     ``Serving/router/*``, and the fleet-resilience counters from
     ``Serving/fleet/*`` (failovers, replayed tokens, circuit-breaker
-    transitions, shed requests, degradation level — docs/serving.md). These
+    transitions, shed requests, degradation level — docs/serving.md), and
+    the quantized-KV-cache gauges from ``Serving/kv_quant/*`` (resident
+    quantized blocks, bytes saved vs bf16, dequant-error bound, fused-
+    dequant flag — docs/serving.md "Quantized KV cache"). These
     series carry CUMULATIVE counter values (gauges for occupancy/rates), so
     the last sample per series is the run total — unlike
     ``--reliability``'s one-line-per-occurrence."""
@@ -471,11 +474,31 @@ def serving(events: List[dict]) -> str:
     sched = [e for e in events if e["name"].startswith("Serving/sched/")]
     router = [e for e in events if e["name"].startswith("Serving/router/")]
     fleet = [e for e in events if e["name"].startswith("Serving/fleet/")]
-    if not srv and not spec and not sched and not router and not fleet:
-        return ("serving: no Serving/{prefix_cache,spec,sched,router,fleet}/*"
-                " events in this file")
+    kvq = [e for e in events if e["name"].startswith("Serving/kv_quant/")]
+    if not srv and not spec and not sched and not router and not fleet \
+            and not kvq:
+        return ("serving: no Serving/{prefix_cache,spec,sched,router,fleet,"
+                "kv_quant}/* events in this file")
     lines: List[str] = []
+    if kvq:
+        kq: Dict[str, float] = {}
+        for e in kvq:
+            kq[e["name"][len("Serving/kv_quant/"):]] = e["value"]  # last wins
+        lines.append(f"KV quantization report ({len(kvq)} events)")
+        lines.append(f"  quantized blocks (now): "
+                     f"{kq.get('blocks_quantized', 0):,.0f}")
+        lines.append(f"  bytes saved vs bf16:    "
+                     f"{_fmt_bytes(kq.get('bytes_saved', 0))}")
+        lines.append(f"  max abs dequant error:  "
+                     f"{kq.get('max_abs_err', 0):.6f} (<= scale/2 bound)")
+        fused = kq.get("dequant_fused", 0) >= 1.0
+        lines.append(f"  dequant fused in-kernel: {'yes' if fused else 'NO'}"
+                     + ("" if fused else
+                        "  <-- standalone int8 casts LOSE on the MXU "
+                        "(QUANT_TPU_LIVE.json)"))
     if srv:
+        if lines:
+            lines.append("")
         last: Dict[str, float] = {}
         last_step: Dict[str, int] = {}
         for e in srv:

@@ -144,6 +144,35 @@ try:
 except Exception as e:
     print("[watch] CHAOS probe: unreadable:", e)
 EOF
+    # quantized-KV row (NON-FATAL — never gates CYCLE_OK or promotion):
+    # int8 KV blocks at equal pool bytes from the SERVING capture's
+    # detail.kvquant (gate with DSTPU_BENCH_KVQUANT=0). resident_ratio
+    # below ~1.9 (hd=128 → scale sidecar is 1/32 of code bytes), a decode
+    # tok/s ratio below 0.9, or greedy_identical below 1.0 means the
+    # quantized serving path regressed (docs/serving.md "Quantized KV
+    # cache").
+    python - >> "$LOG" 2>&1 <<'EOF' || true
+import glob, json
+try:
+    src = sorted(glob.glob("bench_runs/SERVING_[0-9]*.json"))[-1]
+    d = json.loads(open(src).read().strip().splitlines()[-1])
+    kq = d.get("detail", {}).get("kvquant")
+    if isinstance(kq, dict) and isinstance(kq.get("quant_on"), dict):
+        print("[watch] KVQUANT probe: resident %s->%s (x%s) tok/s %s->%s "
+              "(x%s) itl_p99 %s->%s ms greedy_identical=%s logit_mae=%s"
+              % (kq["resident_seqs"]["bf16"], kq["resident_seqs"]["int8"],
+                 kq.get("resident_ratio"),
+                 kq["quant_off"]["tok_per_sec"],
+                 kq["quant_on"]["tok_per_sec"],
+                 kq.get("decode_tok_s_ratio"),
+                 kq["quant_off"]["itl_p99_ms"], kq["quant_on"]["itl_p99_ms"],
+                 kq.get("greedy_identical"), kq.get("logit_mae")))
+    else:
+        print("[watch] KVQUANT probe: no detail.kvquant in %s (%r)"
+              % (src, kq))
+except Exception as e:
+    print("[watch] KVQUANT probe: unreadable:", e)
+EOF
     hold_requested || run_probe LONGCTX scripts/longctx_bench.py 2400 LONGCTX_TPU_LIVE.json
     hold_requested || run_probe MOE scripts/moe_dispatch_bench.py 1200 MOE_TPU_LIVE.json
     # full headline bench incl. shape rows (first compiles are slow).
