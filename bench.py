@@ -271,10 +271,25 @@ def bench_attention_probe(jax) -> dict:
     the PERF.md open item ("not yet re-measured standalone"; expected ~2×
     the hd=64 rows). fwd and fwd+bwd, amortized inside one jit (same recipe
     as scripts/attn_sweep.py; flops: causal fwd = 2·B·H·S²·D, fwd+bwd =
-    3.5×). Runs in every tpu_watch.sh window via the headline bench."""
+    3.5×). Runs in every tpu_watch.sh window via the headline bench.
+
+    GQA sweep (ISSUE 14; docs/performance.md "Native GQA attention"):
+    kv_heads ∈ {1, 4, 8, nq} ∩ divisors(nq) at the same shape, widened vs
+    ``attention.gqa_native`` narrow kernels, with per-step attention KV HBM
+    bytes accounted (bytes of the K/V operands the kernels stream; the
+    widened path's are nq/nkv× larger in fwd AND bwd). The native rows
+    additionally assert — by counting ``ops.attention.repeat_kv`` widening
+    calls at trace time — that no q-width KV copy exists, so
+    ``kv_bytes_saved`` is measured program structure, not an assumption.
+    ``Train/attn/{kv_bytes_saved,gqa_ratio}`` gauges ride a TelemetryHub."""
     import jax.numpy as jnp
     from jax import lax
 
+    import importlib
+
+    # the ops package re-exports the `attention` dispatcher under the same
+    # name, shadowing the submodule on attribute access
+    attn_mod = importlib.import_module("deepspeed_tpu.ops.attention")
     from deepspeed_tpu.ops.pallas import flash_attention as fa
 
     on_tpu = "tpu" in str(RESULT["detail"].get("backend", ""))
@@ -285,47 +300,112 @@ def bench_attention_probe(jax) -> dict:
     rows = {"shape": f"B{B}_H{H}_S{S}_hd{D}_bq{blk}"}
     old_blk = os.environ.get("DSTPU_FLASH_BLOCK")
     os.environ["DSTPU_FLASH_BLOCK"] = str(blk)
+
+    def measure(q, k, v, mode):
+        """(ms, mfu) for one config — chained reps inside one jit."""
+        fwd_flops = 2 * B * H * S * S * D
+        if mode == "fwd":
+            flops = fwd_flops
+
+            def op(k, v, q):
+                return fa.flash_attention(q, k, v, causal=True)
+        else:
+            flops = int(3.5 * fwd_flops)
+
+            def loss(q, k, v):
+                o = fa.flash_attention(q, k, v, causal=True)
+                return jnp.sum(o.astype(jnp.float32) ** 2)
+
+            def op(k, v, q):
+                return jax.grad(lambda q: loss(q, k, v))(q)
+
+        reps, steps = (10, 3) if on_tpu else (2, 1)
+
+        def chained(k, v, q0):
+            def body(carry, _):
+                return op(k, v, carry), ()
+
+            out, _ = lax.scan(body, q0, None, length=reps)
+            return out
+
+        f = jax.jit(chained)
+        out = f(k, v, q)
+        float(jnp.sum(out.astype(jnp.float32)))  # compile + sync
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            out = f(k, v, q)
+        float(jnp.sum(out.astype(jnp.float32)))
+        dt = (time.perf_counter() - t0) / (steps * reps)
+        return round(dt * 1e3, 3), round(flops / dt / peak, 4)
+
     try:
         q = jax.random.normal(jax.random.PRNGKey(0), (B, S, H, D),
                               jnp.bfloat16)
         k = jax.random.normal(jax.random.PRNGKey(1), (B, S, H, D),
                               jnp.bfloat16)
-        fwd_flops = 2 * B * H * S * S * D
         for mode in ("fwd", "fwdbwd"):
-            if mode == "fwd":
-                flops = fwd_flops
+            ms, mfu = measure(q, k, k, mode)
+            rows[mode] = {"ms": ms, "mfu": mfu}
 
-                def op(k, q):
-                    return fa.flash_attention(q, k, k, causal=True)
-            else:
-                flops = int(3.5 * fwd_flops)
+        # --- GQA sweep: same q, kv-head-narrow K/V, widened vs native ---
+        gqa = {}
+        rows["gqa"] = gqa
+        elem = 2  # bf16 K/V
+        passes = {"fwd": 1, "fwdbwd": 3}  # fwd + dq + dkv each stream K/V
+        real_repeat = attn_mod.repeat_kv
+        best = None
+        for kvh in sorted(x for x in {1, 4, 8, H} if H % x == 0 and x <= H):
+            kn = jax.random.normal(jax.random.PRNGKey(2), (B, S, kvh, D),
+                                   jnp.bfloat16)
+            vn = jax.random.normal(jax.random.PRNGKey(3), (B, S, kvh, D),
+                                   jnp.bfloat16)
+            row = {"ratio": H // kvh}
+            for native in (False, True):
+                prev = attn_mod.configure_gqa_native(native)
+                widens = [0]
 
-                def loss(q, k):
-                    o = fa.flash_attention(q, k, k, causal=True)
-                    return jnp.sum(o.astype(jnp.float32) ** 2)
+                def counting_repeat(x, nq):
+                    if x.shape[-2] != nq:
+                        widens[0] += 1
+                    return real_repeat(x, nq)
 
-                def op(k, q):
-                    return jax.grad(lambda q: loss(q, k))(q)
+                attn_mod.repeat_kv = counting_repeat
+                try:
+                    sub = {}
+                    for mode in ("fwd", "fwdbwd"):
+                        widens[0] = 0
+                        ms, mfu = measure(q, kn, vn, mode)
+                        kvh_eff = kvh if native and kvh != H else H
+                        sub[mode] = {
+                            "ms": ms, "mfu": mfu,
+                            "kv_bytes": 2 * B * S * kvh_eff * D * elem
+                            * passes[mode],
+                            "widen_calls": widens[0]}
+                    if native and kvh != H:
+                        # measured program structure: the narrow path must
+                        # contain ZERO q-width KV widenings
+                        assert sub["fwd"]["widen_calls"] == 0 and \
+                            sub["fwdbwd"]["widen_calls"] == 0, \
+                            f"native kv{kvh}: widen leaked {sub}"
+                    row["native" if native else "widened"] = sub
+                finally:
+                    attn_mod.repeat_kv = real_repeat
+                    attn_mod.configure_gqa_native(prev)
+            saved = (row["widened"]["fwdbwd"]["kv_bytes"]
+                     - row["native"]["fwdbwd"]["kv_bytes"])
+            row["kv_bytes_saved_fwdbwd"] = saved
+            gqa[f"kv{kvh}"] = row
+            if kvh != H and (best is None or saved > best[0]):
+                best = (saved, H // kvh)
+        if best is not None:
+            try:  # Train/attn/* gauges (closed TRAIN_SERIES registry)
+                from deepspeed_tpu.telemetry.hub import TelemetryHub
 
-            reps, steps = (10, 3) if on_tpu else (2, 1)
-
-            def chained(k, q0):
-                def body(carry, _):
-                    return op(k, carry), ()
-
-                out, _ = lax.scan(body, q0, None, length=reps)
-                return out
-
-            f = jax.jit(chained)
-            out = f(k, q)
-            float(jnp.sum(out.astype(jnp.float32)))  # compile + sync
-            t0 = time.perf_counter()
-            for _ in range(steps):
-                out = f(k, q)
-            float(jnp.sum(out.astype(jnp.float32)))
-            dt = (time.perf_counter() - t0) / (steps * reps)
-            rows[mode] = {"ms": round(dt * 1e3, 3),
-                          "mfu": round(flops / dt / peak, 4)}
+                hub = TelemetryHub(None)
+                hub.train_event("attn/kv_bytes_saved", float(best[0]))
+                hub.train_event("attn/gqa_ratio", float(best[1]))
+            except Exception:
+                pass
     except Exception as e:  # a failed probe must not kill the headline
         rows["error"] = str(e)[-300:]
     finally:

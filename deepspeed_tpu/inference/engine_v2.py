@@ -166,6 +166,13 @@ class InferenceEngineV2(InferenceEngine):
         self._spec_k = max(1, int(sc.max_draft_tokens))
         self._spec_ngram_max = max(1, int(sc.ngram_max))
         self._spec_min_match = max(1, int(sc.min_match))
+        # fused verification (inference.speculative.fused_verify;
+        # docs/serving.md "Fused verification"): the verify program's
+        # multi-token attention dispatches the paged spec-verify kernel
+        # instead of the prefill-shaped gathered-view path. OFF → the
+        # exact pre-fuse verify programs (pinned).
+        self._spec_fused = bool(self._spec_on
+                                and getattr(sc, "fused_verify", False))
         # cumulative Serving/spec/* counters (spec_events): model steps run
         # in spec mode split into verify (>=1 draft scored) vs plain decode
         # fallbacks, plus drafted/accepted/emitted/rolled-back token counts
@@ -174,7 +181,7 @@ class InferenceEngineV2(InferenceEngine):
             "verify_steps": 0, "decode_steps": 0, "step_seqs": 0,
             "drafted_tokens": 0, "accepted_tokens": 0, "emitted_tokens": 0,
             "rolled_back_tokens": 0, "verify_positions": 0,
-            "verify_capacity": 0}
+            "verify_capacity": 0, "fused_verify_steps": 0}
         # --- request-lifecycle tracing + latency SLO stats (trace.py;
         # docs/serving.md). A hub with an ENABLED tracer shares its flight
         # recorder (serving spans land next to training/checkpoint spans);
@@ -204,11 +211,15 @@ class InferenceEngineV2(InferenceEngine):
         self._req: Dict[int, dict] = {}   # uid → open lifecycle record
         self._lat: Dict[str, List[float]] = {
             "ttft_ms": [], "itl_ms": [], "queue_ms": [], "e2e_ms": []}
+        spec_lbl = "off"
+        if self._spec_on:
+            spec_lbl = "on(k=%d%s)" % (self._spec_k,
+                                       ",fused" if self._spec_fused else "")
         log_dist(f"InferenceEngineV2: {rc.memory_config_blocks} blocks × "
                  f"{rc.block_size} tokens, {B} sequence slots, "
                  f"kv_quant={'int8(g=%d)' % self._kvq_group if self._kvq_on else 'off'}, "
                  f"prefix_cache={'on' if pc.enabled else 'off'}, "
-                 f"speculative={'on(k=%d)' % self._spec_k if self._spec_on else 'off'}, "
+                 f"speculative={spec_lbl}, "
                  f"trace={'on' if self._trace_on else 'off'}")
 
     # ------------------------------------------------------------------ #
@@ -734,10 +745,21 @@ class InferenceEngineV2(InferenceEngine):
         the emitted stream is distributed exactly as plain decode. When every
         draft is accepted the bonus position (scored in the same pass)
         supplies one extra token. Returns (accepted_len [B], next_token [B],
-        cache)."""
-        key = ("spec_verify", kp1)
+        cache).
+
+        With ``inference.speculative.fused_verify`` the forward pass traces
+        under ``models/_paged.fused_verify_scope``: every layer's
+        multi-token attention dispatches the paged spec-verify kernel
+        (block-table reads, dequant-in-register in kv_quant mode) instead
+        of the prefill-shaped dense-gather path — a distinct program
+        family (``spec_verify_fused``) so the compile monitor and the
+        serving bench can count prefill-shaped dispatches per accepted
+        token."""
+        fused = self._spec_fused
+        key = ("spec_verify_fused" if fused else "spec_verify", kp1)
         if key not in self._paged_fns:
             fam, ap = self.family, self._apply_paged
+            from ..models import _paged as _paged_mod
 
             def verify(params, cache, tokens, lens, tables, active, nvalid,
                        drafts, rng, uids, temp, topk, topp, greedy):
@@ -747,8 +769,13 @@ class InferenceEngineV2(InferenceEngine):
                 k = kp1 - 1
                 valid = (jnp.arange(kp1)[None, :] < nvalid[:, None]) \
                     & active[:, None]
-                logits, cache = ap(fam.cfg, self._dq(params), tokens, cache,
-                                   tables, lens, valid=valid)
+                if fused:
+                    with _paged_mod.fused_verify_scope():
+                        logits, cache = ap(fam.cfg, self._dq(params), tokens,
+                                           cache, tables, lens, valid=valid)
+                else:
+                    logits, cache = ap(fam.cfg, self._dq(params), tokens,
+                                       cache, tables, lens, valid=valid)
                 amax = jnp.argmax(logits, axis=-1)                 # [B, kp1]
                 filt = filter_logits_batch(
                     logits.reshape(B * kp1, -1),
@@ -828,6 +855,10 @@ class InferenceEngineV2(InferenceEngine):
             return None
         kmax = self._spec_k
         self.spec_stats["verify_steps"] += 1
+        if self._spec_fused:
+            # verification rode the paged-decode kernel family, not a
+            # prefill-shaped dense-gather dispatch
+            self.spec_stats["fused_verify_steps"] += 1
         self.spec_stats["step_seqs"] += len(live)
         cow = []
         for d in live:

@@ -23,12 +23,40 @@ that path losing to bf16 outright.
 
 from __future__ import annotations
 
+from contextlib import contextmanager
 from typing import Optional, Tuple
 
 import jax.numpy as jnp
 
 from ..ops.attention import attention
 from ..ops.quantization import kv_dequantize_int8, kv_quantize_int8
+
+# --------------------------------------------------------------------------- #
+# fused speculative verification (inference.speculative.fused_verify;
+# docs/serving.md "Fused verification"). Trace-time gate: the engine's
+# verify program wraps its apply_paged call in :func:`fused_verify_scope`,
+# so ONLY that program's multi-token attention dispatches the
+# block-table-walking spec-verify kernel — prefill keeps the gathered-view
+# path, and with the gate off every program is byte-identical to before.
+# --------------------------------------------------------------------------- #
+_FUSED_VERIFY = {"on": False}
+
+
+def fused_verify_active() -> bool:
+    return _FUSED_VERIFY["on"]
+
+
+@contextmanager
+def fused_verify_scope():
+    """Arm the fused-verify dispatch for the duration of one trace (the
+    flag is consulted at trace time only — compiled programs keep whatever
+    path they were traced with)."""
+    prev = _FUSED_VERIFY["on"]
+    _FUSED_VERIFY["on"] = True
+    try:
+        yield
+    finally:
+        _FUSED_VERIFY["on"] = prev
 
 
 def init_paged_pools(num_layers: int, num_blocks: int, num_kv_heads: int,
@@ -144,6 +172,22 @@ def paged_attention_step(q, k, v, k_cache, v_cache, block_tables,
             out = get_op("paged_decode_attention")(
                 q[:, 0], k_cache, v_cache, block_tables, context_lens,
                 window=window)[:, None]
+    elif fused_verify_active():
+        # speculative verification rides the paged-decode kernel family:
+        # t = 1 + max_draft_tokens rows per sequence score against the
+        # block-table-indexed pools (dequant-in-register in quant mode) —
+        # never the dense [B, max_blocks*bs, ...] gather below
+        from ..ops import pallas as _pallas_ops  # noqa: F401 (registers)
+        from ..ops.registry import get_op
+
+        if quant:
+            out = get_op("paged_spec_verify_attention")(
+                q, k_codes, v_codes, block_tables, context_lens,
+                window=window, k_scale=k_scales, v_scale=v_scales)
+        else:
+            out = get_op("paged_spec_verify_attention")(
+                q, k_cache, v_cache, block_tables, context_lens,
+                window=window)
     else:
         if quant:
             # dequant fuses into the gather consumer — the gathered view is

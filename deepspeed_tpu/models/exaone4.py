@@ -169,6 +169,11 @@ def _block(cfg: Exaone4Config, x, layer, cos, sin, positions,
         # dense mask would force the XLA fallback on every layer)
         attn_out = attention(q, k, v, causal=True)
     else:
+        # per-layer windows are SCANNED traced scalars, so the static
+        # flash `window=` fast path can't apply — the dense mask routes to
+        # the XLA reference, which under attention.gqa_native computes
+        # grouped einsums on the NARROW K/V (no q-width repeat; the
+        # gqa-native lint traces this apply)
         q_pos = jnp.arange(s)[:, None]
         kv_pos = jnp.arange(s)[None, :]
         mask = (q_pos >= kv_pos) & (q_pos - kv_pos < window)

@@ -129,6 +129,9 @@ def _attn_part(cfg: FalconConfig, y: jnp.ndarray, layer: Params,
     v = (y @ layer["wv"]).reshape(b, s, nkv, hd)
     q = apply_rotary(q, cos, sin, positions)
     k = apply_rotary(k, cos, sin, positions)
+    # K/V pass NARROW (classic Falcon MQA: ONE kv head) into the attention
+    # op — under attention.gqa_native the flash kernels keep them narrow
+    # end to end (nq× less KV HBM traffic; the gqa-native lint traces this)
     out = attention(q, k, v, causal=True)
     return out.reshape(b, s, nh * hd) @ layer["wo"]
 

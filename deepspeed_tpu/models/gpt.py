@@ -147,6 +147,10 @@ def _attn(cfg: GPTConfig, x: jnp.ndarray, layer: Params,
     qkv = checkpoint_name(x @ layer["wqkv"] + layer["bqkv"], "qkv_proj")
     q, k, v = jnp.split(qkv, 3, axis=-1)
     q = q.reshape(b, t, nh, hd)
+    # GPT-2/OPT are MHA (kv heads == query heads): K/V enter the attention
+    # op already at query width, so attention.gqa_native is a no-op here —
+    # the gqa-native lint still traces this apply to pin that no widening
+    # ever appears
     k = k.reshape(b, t, nh, hd)
     v = v.reshape(b, t, nh, hd)
     if kv is None:

@@ -215,8 +215,6 @@ def _resolve_attention(cfg: LlamaConfig, in_pipeline: bool = False):
                 return chunked(q, k, v, causal=causal)
 
             return chunked_plain
-        import math
-
         from jax.sharding import PartitionSpec as P
 
         from ..comm.mesh import BATCH_AXES, get_mesh
@@ -240,16 +238,21 @@ def _resolve_attention(cfg: LlamaConfig, in_pipeline: bool = False):
             nkv = k.shape[-2]
             if nkv % group != 0:
                 # GQA-narrow KV can't shard over the head group — widen by
-                # the SMALLEST factor that aligns (full q-width only as a
-                # last resort), keeping the host-offload stream as narrow as
-                # possible (fpdt fetches narrow and widens after)
-                r = group // math.gcd(nkv, group)
-                target = nkv * r
-                if target > n or n % target != 0 or (n // nkv) % r != 0:
-                    target = n
-                from ..ops.attention import repeat_kv
+                # the SMALLEST factor that aligns (lcm(nkv, group) — the
+                # ONE alignment policy, ops.attention.kv_alignment_heads),
+                # keeping the host-offload stream as narrow as possible
+                # (fpdt fetches narrow; under attention.gqa_native it runs
+                # the native kernel on the aligned-narrow K/V directly).
+                from ..ops.attention import (gqa_native_active,
+                                             kv_alignment_heads, widen_kv)
 
-                k, v = repeat_kv(k, target), repeat_kv(v, target)
+                target = kv_alignment_heads(nkv, n, group)
+                if target == n and gqa_native_active():
+                    # misaligned lcm would force FULL q-width — with the
+                    # native kernel that widening is pure waste; gather the
+                    # sequence instead and keep K/V narrow
+                    return chunked(q, k, v, causal=causal)
+                k, v = widen_kv(k, v, target)
             spec = P(BATCH_AXES, None, axes, None)
             from ..comm import comm as dist
             return dist.shard_map(

@@ -177,6 +177,9 @@ def apply(cfg: MixtralConfig, params: Params, tokens: jnp.ndarray, *,
         q = apply_rotary(q.reshape(b, s, nh, hd), cos, sin)
         k = apply_rotary(k.reshape(b, s, nkv, hd), cos, sin)
         v = v.reshape(b, s, nkv, hd)
+        # K/V pass NARROW (nkv heads) into the attention op: widening —
+        # when the gqa_native kernels are off — happens inside the op,
+        # never here (the gqa-native lint traces this apply)
         x = x + checkpoint_name(
             checkpoint_name(attention(q, k, v, causal=True), "attn_mix")
             .reshape(b, s, nh * hd) @ layer["wo"], "attn_out")

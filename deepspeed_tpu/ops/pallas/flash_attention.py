@@ -15,6 +15,24 @@ Grid design (forward): (BH, num_q_blocks, num_kv_blocks) with the kv loop as
 the innermost (sequential on TPU) dimension; running max / sum / accumulator
 live in VMEM scratch that persists across kv steps. Backward uses two kernels:
 one accumulating dQ over kv blocks, one accumulating dK/dV over q blocks.
+
+Native GQA mode (``attention.gqa_native``; docs/performance.md "Native GQA
+attention"): the same three kernels run on a KV-HEAD grid —
+q ``[B*nkv, g, Sq, d]``, K/V ``[B*nkv, Skv, d]`` — with the query-head group
+``g = nh/nkv`` folded into the kernel's ROW axis, so every score matmul is
+``[g*bq, d] x [d, bkv]`` against ONE narrow K/V tile in VMEM. K/V are never
+materialized at query width: fwd and bwd HBM traffic for K/V drops by g×
+(up to 8× for Llama-3/Mistral shapes), and the dK/dV kernel accumulates the
+query-head group's contributions onto the NARROW grads for free (the group
+rides the contracted row axis). Enabled per-process via
+``ops.attention.configure_gqa_native``; default OFF keeps every program
+byte-identical to the widening path.
+
+Sliding window (static ``window=``): causal attention additionally masks kv
+positions older than ``q_pos - window + 1``; blocks entirely outside the
+window skip their compute AND their DMA (the fold maps clamp dead block
+indices onto the live band from BOTH sides, matching the paged decode
+kernel's dead-step fold).
 """
 
 from __future__ import annotations
@@ -43,7 +61,8 @@ NEG_INF = -1e30
 # VMEM reads in the backward kernels.
 
 
-def _mask_split(qi, ki, *, causal, bq, bkv, kv_len, q_offset, nkv):
+def _mask_split(qi, ki, *, causal, bq, bkv, kv_len, q_offset, nkv,
+                window=None):
     """Disjoint (no_mask, masked) block predicates for the causal/pad mask.
 
     Only diagonal-band blocks and the ragged last KV block need the
@@ -51,13 +70,26 @@ def _mask_split(qi, ki, *, causal, bq, bkv, kv_len, q_offset, nkv):
     and skip that VPU work entirely (at bq=bkv=512 the mask build costs
     about as much VPU time as the block's two MXU matmuls take — the
     official TPU flash kernels specialize the same way). Returns None when
-    NO block ever needs a mask (non-causal, no KV padding)."""
+    NO block ever needs a mask (non-causal, no KV padding). With a sliding
+    ``window`` the band has a LOWER edge too: blocks entirely older than
+    the oldest q row's window are dead, and blocks straddling that edge
+    are masked."""
     has_pad = (nkv * bkv) != kv_len
     if not causal and not has_pad:
         return None
     if causal:
         participates = ki * bkv <= qi * bq + (bq - 1) + q_offset
         fully_visible = ki * bkv + (bkv - 1) <= qi * bq + q_offset
+        if window is not None:
+            # newest kv in block must be inside the OLDEST q row's window;
+            # fully visible additionally needs the oldest kv inside the
+            # NEWEST q row's window
+            participates = jnp.logical_and(
+                participates,
+                ki * bkv + (bkv - 1) > qi * bq + q_offset - window)
+            fully_visible = jnp.logical_and(
+                fully_visible,
+                ki * bkv > qi * bq + (bq - 1) + q_offset - window)
     else:
         participates = jnp.bool_(True)
         fully_visible = jnp.bool_(True)
@@ -71,9 +103,12 @@ def _mask_split(qi, ki, *, causal, bq, bkv, kv_len, q_offset, nkv):
     return no_mask, masked
 
 
-def _block_mask(qi, ki, *, causal, bq, bkv, kv_len, q_offset):
-    """The [bq, bkv] validity mask for a masked block — ONE definition
-    shared by fwd/dq/dkv so the three kernels cannot drift."""
+def _block_mask(qi, ki, *, causal, bq, bkv, kv_len, q_offset, g=1,
+                window=None):
+    """The [g*bq, bkv] validity mask for a masked block — ONE definition
+    shared by fwd/dq/dkv so the three kernels cannot drift. ``g`` is the
+    native-GQA query-head group folded into the row axis: all g groups
+    share the same bq query positions, so the [bq, bkv] pattern tiles."""
     q_idx = qi * bq + jax.lax.broadcasted_iota(
         jnp.int32, (bq, bkv), 0) + q_offset
     kv_idx = ki * bkv + jax.lax.broadcasted_iota(
@@ -81,56 +116,78 @@ def _block_mask(qi, ki, *, causal, bq, bkv, kv_len, q_offset):
     mask = kv_idx < kv_len
     if causal:
         mask = jnp.logical_and(mask, kv_idx <= q_idx)
+        if window is not None:
+            mask = jnp.logical_and(mask, q_idx - kv_idx < window)
+    if g > 1:
+        mask = jnp.broadcast_to(mask[None], (g, bq, bkv)) \
+            .reshape(g * bq, bkv)
     return mask
 
 
-def _fold_kv(qi, ki, *, bq, bkv, q_offset):
+def _fold_kv(qi, ki, *, bq, bkv, q_offset, window=None):
     """Clamp a causal-dead kv block index onto the diagonal band: blocks
     strictly above the diagonal compute nothing, so their BlockSpec index
     folds to the last participating block — consecutive grid steps then
     map to the same block and Pallas elides the DMA. Halves causal K/V
-    HBM traffic (same trick as the paged kernel's dead-step fold)."""
+    HBM traffic (same trick as the paged kernel's dead-step fold). With a
+    sliding ``window`` the clamp is two-sided: blocks entirely older than
+    the window fold onto the first live one."""
     j_max = jnp.maximum((qi * bq + (bq - 1) + q_offset) // bkv, 0)
-    return jnp.minimum(ki, j_max)
+    if window is None:
+        return jnp.minimum(ki, j_max)
+    j_min = jnp.maximum((qi * bq + q_offset - window + 1) // bkv, 0)
+    return jnp.clip(ki, jnp.minimum(j_min, j_max), j_max)
 
 
-def _fold_q(qi, ki, *, bq, bkv, q_offset, nq):
+def _fold_q(qi, ki, *, bq, bkv, q_offset, nq, window=None):
     """dkv-kernel counterpart: clamp a dead Q block index up to the first
     participating one for kv block ki (qi*bq+bq-1+q_offset >= ki*bkv).
     Upper clamp to nq-1: with kv_len > sq (legal — trailing keys are fully
     masked) a kv block past the last q row has NO participant and the
-    unclamped first-participant index would run off the q array."""
+    unclamped first-participant index would run off the q array. With a
+    sliding ``window`` q blocks entirely NEWER than the block's window
+    (qi*bq+q_offset > ki*bkv+bkv-1+window-1) are dead too — clamp down."""
     q_min = jnp.maximum((ki * bkv - q_offset) // bq, 0)
-    return jnp.minimum(jnp.maximum(qi, q_min), nq - 1)
+    q_hi = nq - 1
+    if window is not None:
+        q_hi = jnp.minimum(
+            q_hi, jnp.maximum(
+                (ki * bkv + (bkv - 1) + window - 1 - q_offset) // bq, 0))
+        q_min = jnp.minimum(q_min, q_hi)
+    return jnp.minimum(jnp.maximum(qi, q_min), q_hi)
 
 
-def _fold_maps(*, causal, bq, bkv, q_offset):
+def _fold_maps(*, causal, bq, bkv, q_offset, window=None):
     """(kvmap, biasmap) for the q-major grids (b, qi, ki) — ONE builder
     shared by _flash_fwd and the dq backward so the fold cannot drift."""
     if not causal:
         return (lambda b, i, j: (b, j, 0)), (lambda b, i, j: (b, i, j))
 
     def kvmap(b, i, j):
-        return (b, _fold_kv(i, j, bq=bq, bkv=bkv, q_offset=q_offset), 0)
+        return (b, _fold_kv(i, j, bq=bq, bkv=bkv, q_offset=q_offset,
+                            window=window), 0)
 
     def biasmap(b, i, j):
-        return (b, i, _fold_kv(i, j, bq=bq, bkv=bkv, q_offset=q_offset))
+        return (b, i, _fold_kv(i, j, bq=bq, bkv=bkv, q_offset=q_offset,
+                               window=window))
 
     return kvmap, biasmap
 
 
-def _fold_maps_dkv(*, causal, bq, bkv, q_offset, nq):
+def _fold_maps_dkv(*, causal, bq, bkv, q_offset, nq, window=None):
     """(qmap, biasmap) for the kv-major dkv grid (b, ki, qi); qmap also
     serves the do/lse/delta specs."""
     if not causal:
         return (lambda b, j, i: (b, i, 0)), (lambda b, j, i: (b, i, j))
 
     def qmap(b, j, i):
-        return (b, _fold_q(i, j, bq=bq, bkv=bkv, q_offset=q_offset, nq=nq),
+        return (b, _fold_q(i, j, bq=bq, bkv=bkv, q_offset=q_offset, nq=nq,
+                           window=window),
                 0)
 
     def biasmap(b, j, i):
-        return (b, _fold_q(i, j, bq=bq, bkv=bkv, q_offset=q_offset, nq=nq),
+        return (b, _fold_q(i, j, bq=bq, bkv=bkv, q_offset=q_offset, nq=nq,
+                           window=window),
                 j)
 
     return qmap, biasmap
@@ -139,25 +196,38 @@ def _fold_maps_dkv(*, causal, bq, bkv, q_offset, nq):
 _TUNED_CACHE: dict = {}
 
 
-def _tuned_default() -> int:
-    """Best measured block size, if `scripts/attn_sweep.py` has run on this
-    machine: read ONCE from `.dstpu_tuned.json` at the repo root (two dirs
-    above the package). Falls back to 512 — large enough to amortize MXU
-    issue + VPU overhead; VMEM at bq=bkv=512, d<=128 stays well under
-    budget. Env/`pref` still override."""
-    if "flash_block" not in _TUNED_CACHE:
-        _TUNED_CACHE["flash_block"] = 512
+def _tuned_json() -> dict:
+    """`.dstpu_tuned.json` at the repo root (two dirs above the package),
+    read ONCE. Keys: ``flash_block`` (the MHA q/kv block), plus optional
+    per-GQA-group q blocks ``flash_block_g<g>`` written by
+    ``scripts/attn_sweep.py``'s kv_heads sweep dimension."""
+    if "tuned" not in _TUNED_CACHE:
+        _TUNED_CACHE["tuned"] = {}
         path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                             "..", "..", "..", ".dstpu_tuned.json")
         try:
             import json
 
             with open(path) as f:
-                v = int(json.load(f).get("flash_block", 512))
+                _TUNED_CACHE["tuned"] = dict(json.load(f))
+        except Exception:
+            pass  # no sweep artifact — compiled-in defaults
+    return _TUNED_CACHE["tuned"]
+
+
+def _tuned_default() -> int:
+    """Best measured block size, if `scripts/attn_sweep.py` has run on this
+    machine. Falls back to 512 — large enough to amortize MXU issue + VPU
+    overhead; VMEM at bq=bkv=512, d<=128 stays well under budget.
+    Env/`pref` still override."""
+    if "flash_block" not in _TUNED_CACHE:
+        _TUNED_CACHE["flash_block"] = 512
+        try:
+            v = int(_tuned_json().get("flash_block", 512))
             if v > 0 and v % 8 == 0:
                 _TUNED_CACHE["flash_block"] = v
         except Exception:
-            pass  # no sweep artifact — compiled-in default
+            pass
     return _TUNED_CACHE["flash_block"]
 
 
@@ -180,6 +250,26 @@ def _block(n: int, pref: Optional[int] = None) -> int:
     return min(pref, max(8, 1 << (n - 1).bit_length())) if n < pref else pref
 
 
+def _block_gqa(n: int, g: int) -> int:
+    """Per-GROUP q block for the native-GQA kernels: the kernel's row axis
+    carries g*bq rows, so the default scales the tuned/env block down by g
+    (total rows ≈ the MHA block → same VMEM/score-tile budget). A measured
+    ``flash_block_g<g>`` in `.dstpu_tuned.json` overrides directly (it IS
+    the per-group bq — the autotune key gained the kv_heads dimension)."""
+    raw = os.environ.get("DSTPU_FLASH_BLOCK")
+    if raw is None:
+        try:
+            v = int(_tuned_json().get(f"flash_block_g{g}", 0))
+        except Exception:
+            v = 0
+        if v > 0 and v % 8 == 0:
+            return _block(n, v)
+        base = _tuned_default()
+    else:
+        base = _block(max(n * g, 8))  # env names TOTAL kernel rows
+    return _block(n, max(8, (base // g) // 8 * 8))
+
+
 def _pad_to(x: jnp.ndarray, axis: int, mult: int) -> jnp.ndarray:
     n = x.shape[axis]
     pad = (-n) % mult
@@ -194,7 +284,7 @@ def _pad_to(x: jnp.ndarray, axis: int, mult: int) -> jnp.ndarray:
 # forward
 # --------------------------------------------------------------------------- #
 def _fwd_kernel(*refs, scale, causal, bq, bkv, kv_len, q_offset, nkv,
-                has_bias):
+                has_bias, g=1, window=None):
     if has_bias:
         (q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
          m_scr, l_scr, acc_scr) = refs
@@ -216,7 +306,10 @@ def _fwd_kernel(*refs, scale, causal, bq, bkv, kv_len, q_offset, nkv,
     def _compute(masked):
         # keep q/k in input dtype (bf16): the MXU runs bf16xbf16->fp32 at full
         # rate; casting inputs to fp32 first would drop to ~1/8 peak.
-        q = q_ref[0]                              # [bq, d]
+        if g > 1:
+            q = q_ref[0].reshape(g * bq, q_ref.shape[-1])  # [g*bq, d]
+        else:
+            q = q_ref[0]                          # [bq, d]
         k = k_ref[0]                              # [bkv, d]
         v = v_ref[0]                              # [bkv, d]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
@@ -226,15 +319,16 @@ def _fwd_kernel(*refs, scale, causal, bq, bkv, kv_len, q_offset, nkv,
 
         if masked:
             s = jnp.where(_block_mask(qi, ki, causal=causal, bq=bq, bkv=bkv,
-                                      kv_len=kv_len, q_offset=q_offset),
+                                      kv_len=kv_len, q_offset=q_offset,
+                                      g=g, window=window),
                           s, NEG_INF)
 
-        m_prev = m_scr[...]                       # [bq, 128] (lane-replicated)
+        m_prev = m_scr[...]                  # [g*bq, 128] (lane-replicated)
         l_prev = l_scr[...]
-        m_curr = jnp.max(s, axis=1, keepdims=True)            # [bq, 1]
+        m_curr = jnp.max(s, axis=1, keepdims=True)            # [g*bq, 1]
         m_new = jnp.maximum(m_prev, jnp.broadcast_to(m_curr, m_prev.shape))
-        alpha = jnp.exp(m_prev - m_new)                        # [bq, 128]
-        p = jnp.exp(s - m_new[:, :1])                          # [bq, bkv]
+        alpha = jnp.exp(m_prev - m_new)                        # [g*bq, 128]
+        p = jnp.exp(s - m_new[:, :1])                          # [g*bq, bkv]
         l_new = l_prev * alpha + jnp.broadcast_to(
             jnp.sum(p, axis=1, keepdims=True), l_prev.shape)
         acc_scr[...] = acc_scr[...] * alpha[:, :1] + jax.lax.dot_general(
@@ -244,7 +338,7 @@ def _fwd_kernel(*refs, scale, causal, bq, bkv, kv_len, q_offset, nkv,
         l_scr[...] = l_new
 
     split = _mask_split(qi, ki, causal=causal, bq=bq, bkv=bkv, kv_len=kv_len,
-                        q_offset=q_offset, nkv=nkv)
+                        q_offset=q_offset, nkv=nkv, window=window)
     if split is None:
         _compute(masked=False)
     else:
@@ -256,30 +350,71 @@ def _fwd_kernel(*refs, scale, causal, bq, bkv, kv_len, q_offset, nkv,
     def _finish():
         l = l_scr[...]
         l_safe = jnp.where(l == 0.0, 1.0, l)
-        o_ref[0] = (acc_scr[...] / l_safe[:, :1]).astype(o_ref.dtype)
-        lse_ref[0] = m_scr[...] + jnp.log(l_safe)
+        if g > 1:
+            d = o_ref.shape[-1]
+            o_ref[0] = (acc_scr[...] / l_safe[:, :1]) \
+                .reshape(g, bq, d).astype(o_ref.dtype)
+            lse_ref[0] = (m_scr[...] + jnp.log(l_safe)).reshape(g, bq, 128)
+        else:
+            o_ref[0] = (acc_scr[...] / l_safe[:, :1]).astype(o_ref.dtype)
+            lse_ref[0] = m_scr[...] + jnp.log(l_safe)
 
 
-def _flash_fwd(q, k, v, bias=None, *, causal, scale, q_offset):
-    """q/k/v: [BH, S, d] (+ optional bias [BH, Sq, Skv]) →
-    (o [BH, Sq, d], lse [BH, Sq, 128])."""
-    bh, sq, d = q.shape
+def _flash_fwd(q, k, v, bias=None, *, causal, scale, q_offset, g=1,
+               window=None):
+    """MHA/widened layout (g == 1): q/k/v [BH, S, d] (+ optional bias
+    [BH, Sq, Skv]) → (o [BH, Sq, d], lse [BH, Sq, 128]).
+
+    Native-GQA layout (g > 1): q [B*nkv, g, Sq, d], k/v [B*nkv, Skv, d]
+    (narrow — never widened) → (o [B*nkv, g, Sq, d],
+    lse [B*nkv, g, Sq, 128]); bias unsupported there."""
+    if g > 1:
+        assert bias is None, "native-GQA kernel does not take a bias"
+        bh, _, sq, d = q.shape
+        q_axis = 2
+    else:
+        bh, sq, d = q.shape
+        q_axis = 1
     kv_len = k.shape[1]
-    bq = _block(sq)
+    bq = _block_gqa(sq, g) if g > 1 else _block(sq)
     bkv = _block(kv_len)
-    qp = _pad_to(q, 1, bq)
+    qp = _pad_to(q, q_axis, bq)
     kp = _pad_to(k, 1, bkv)
     vp = _pad_to(v, 1, bkv)
-    nq = qp.shape[1] // bq
+    nq = qp.shape[q_axis] // bq
     nkv = kp.shape[1] // bkv
 
     kvmap, biasmap = _fold_maps(causal=causal, bq=bq, bkv=bkv,
-                                q_offset=q_offset)
-    in_specs = [
-        pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-        pl.BlockSpec((1, bkv, d), kvmap),
-        pl.BlockSpec((1, bkv, d), kvmap),
-    ]
+                                q_offset=q_offset, window=window)
+    if g > 1:
+        qspec = pl.BlockSpec((1, g, bq, d), lambda b, i, j: (b, 0, i, 0))
+        in_specs = [
+            qspec,
+            pl.BlockSpec((1, bkv, d), kvmap),
+            pl.BlockSpec((1, bkv, d), kvmap),
+        ]
+        out_specs = [
+            qspec,
+            pl.BlockSpec((1, g, bq, 128), lambda b, i, j: (b, 0, i, 0)),
+        ]
+        out_shape = [
+            jax.ShapeDtypeStruct((bh, g, qp.shape[2], d), q.dtype),
+            jax.ShapeDtypeStruct((bh, g, qp.shape[2], 128), jnp.float32),
+        ]
+    else:
+        in_specs = [
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bkv, d), kvmap),
+            pl.BlockSpec((1, bkv, d), kvmap),
+        ]
+        out_specs = [
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, 128), lambda b, i, j: (b, i, 0)),
+        ]
+        out_shape = [
+            jax.ShapeDtypeStruct((bh, qp.shape[1], d), q.dtype),
+            jax.ShapeDtypeStruct((bh, qp.shape[1], 128), jnp.float32),
+        ]
     args = [qp, kp, vp]
     if bias is not None:
         bp = _pad_to(_pad_to(bias, 1, bq), 2, bkv)
@@ -288,27 +423,24 @@ def _flash_fwd(q, k, v, bias=None, *, causal, scale, q_offset):
 
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, bq=bq, bkv=bkv,
-        kv_len=kv_len, q_offset=q_offset, nkv=nkv, has_bias=bias is not None)
+        kv_len=kv_len, q_offset=q_offset, nkv=nkv, has_bias=bias is not None,
+        g=g, window=window)
     o, lse = pl.pallas_call(
         kernel,
         grid=(bh, nq, nkv),
         in_specs=in_specs,
-        out_specs=[
-            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bq, 128), lambda b, i, j: (b, i, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((bh, qp.shape[1], d), q.dtype),
-            jax.ShapeDtypeStruct((bh, qp.shape[1], 128), jnp.float32),
-        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=[
-            pltpu.VMEM((bq, 128), jnp.float32),
-            pltpu.VMEM((bq, 128), jnp.float32),
-            pltpu.VMEM((bq, d), jnp.float32),
+            pltpu.VMEM((g * bq, 128), jnp.float32),
+            pltpu.VMEM((g * bq, 128), jnp.float32),
+            pltpu.VMEM((g * bq, d), jnp.float32),
         ],
         compiler_params=_dim_semantics("parallel", "parallel", "arbitrary"),
         interpret=_interpret(),
     )(*args)
+    if g > 1:
+        return o[:, :, :sq], lse[:, :, :sq]
     return o[:, :sq], lse[:, :sq]
 
 
@@ -316,7 +448,7 @@ def _flash_fwd(q, k, v, bias=None, *, causal, scale, q_offset):
 # backward
 # --------------------------------------------------------------------------- #
 def _bwd_dq_kernel(*refs, scale, causal, bq, bkv, kv_len, q_offset, nkv,
-                   has_bias):
+                   has_bias, g=1, window=None):
     if has_bias:
         (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref,
          dq_ref, dbias_ref, dq_scr) = refs
@@ -332,12 +464,19 @@ def _bwd_dq_kernel(*refs, scale, causal, bq, bkv, kv_len, q_offset, nkv,
 
     qi = pl.program_id(1)
     def _compute(masked):
-        q = q_ref[0]
+        if g > 1:
+            d = q_ref.shape[-1]
+            q = q_ref[0].reshape(g * bq, d)
+            do = do_ref[0].reshape(g * bq, d)
+            lse = lse_ref[0].reshape(g * bq, 128)[:, :1]   # [g*bq, 1]
+            delta = delta_ref[0].reshape(g * bq, 128)[:, :1]
+        else:
+            q = q_ref[0]
+            do = do_ref[0]
+            lse = lse_ref[0][:, :1]                   # [bq, 1]
+            delta = delta_ref[0][:, :1]
         k = k_ref[0]
         v = v_ref[0]
-        do = do_ref[0]
-        lse = lse_ref[0][:, :1]                   # [bq, 1]
-        delta = delta_ref[0][:, :1]
 
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
@@ -345,8 +484,9 @@ def _bwd_dq_kernel(*refs, scale, causal, bq, bkv, kv_len, q_offset, nkv,
             s = s + bias_ref[0].astype(jnp.float32)
         if masked:
             p = jnp.where(_block_mask(qi, ki, causal=causal, bq=bq, bkv=bkv,
-                                      kv_len=kv_len, q_offset=q_offset),
-                          jnp.exp(s - lse), 0.0)              # [bq, bkv]
+                                      kv_len=kv_len, q_offset=q_offset,
+                                      g=g, window=window),
+                          jnp.exp(s - lse), 0.0)              # [g*bq, bkv]
         else:
             p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
@@ -359,7 +499,7 @@ def _bwd_dq_kernel(*refs, scale, causal, bq, bkv, kv_len, q_offset, nkv,
                                            preferred_element_type=jnp.float32)
 
     split = _mask_split(qi, ki, causal=causal, bq=bq, bkv=bkv, kv_len=kv_len,
-                        q_offset=q_offset, nkv=nkv)
+                        q_offset=q_offset, nkv=nkv, window=window)
     if split is None:
         _compute(masked=False)
     else:
@@ -375,11 +515,15 @@ def _bwd_dq_kernel(*refs, scale, causal, bq, bkv, kv_len, q_offset, nkv,
 
     @pl.when(ki == nkv - 1)
     def _finish():
-        dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
+        if g > 1:
+            d = dq_ref.shape[-1]
+            dq_ref[0] = dq_scr[...].reshape(g, bq, d).astype(dq_ref.dtype)
+        else:
+            dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(*refs, scale, causal, bq, bkv, kv_len, q_offset, nq,
-                    nkv, has_bias):
+                    nkv, has_bias, g=1, window=None):
     if has_bias:
         (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref,
          dk_ref, dv_ref, dk_scr, dv_scr) = refs
@@ -396,12 +540,19 @@ def _bwd_dkv_kernel(*refs, scale, causal, bq, bkv, kv_len, q_offset, nq,
 
     ki = pl.program_id(1)
     def _compute(masked):
-        q = q_ref[0]
+        if g > 1:
+            d = q_ref.shape[-1]
+            q = q_ref[0].reshape(g * bq, d)
+            do = do_ref[0].reshape(g * bq, d)
+            lse = lse_ref[0].reshape(g * bq, 128)[:, :1]
+            delta = delta_ref[0].reshape(g * bq, 128)[:, :1]
+        else:
+            q = q_ref[0]
+            do = do_ref[0]
+            lse = lse_ref[0][:, :1]
+            delta = delta_ref[0][:, :1]
         k = k_ref[0]
         v = v_ref[0]
-        do = do_ref[0]
-        lse = lse_ref[0][:, :1]
-        delta = delta_ref[0][:, :1]
 
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
@@ -409,13 +560,16 @@ def _bwd_dkv_kernel(*refs, scale, causal, bq, bkv, kv_len, q_offset, nq,
             s = s + bias_ref[0].astype(jnp.float32)
         if masked:
             p = jnp.where(_block_mask(qi, ki, causal=causal, bq=bq, bkv=bkv,
-                                      kv_len=kv_len, q_offset=q_offset),
+                                      kv_len=kv_len, q_offset=q_offset,
+                                      g=g, window=window),
                           jnp.exp(s - lse), 0.0)
         else:
             p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = (p * (dp - delta) * scale).astype(q.dtype)
+        # contraction over the ROW axis (g*bq): the query-head group's
+        # contributions accumulate onto the NARROW dk/dv tile for free
         dv_scr[...] += jax.lax.dot_general(p.astype(do.dtype), do,
                                            (((0,), (0,)), ((), ())),
                                            preferred_element_type=jnp.float32)
@@ -423,7 +577,7 @@ def _bwd_dkv_kernel(*refs, scale, causal, bq, bkv, kv_len, q_offset, nq,
                                            preferred_element_type=jnp.float32)
 
     split = _mask_split(qi, ki, causal=causal, bq=bq, bkv=bkv, kv_len=kv_len,
-                        q_offset=q_offset, nkv=nkv)
+                        q_offset=q_offset, nkv=nkv, window=window)
     if split is None:
         _compute(masked=False)
     else:
@@ -437,40 +591,62 @@ def _bwd_dkv_kernel(*refs, scale, causal, bq, bkv, kv_len, q_offset, nq,
         dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
 
 
-def _flash_bwd(q, k, v, o, lse, do, bias=None, *, causal, scale, q_offset):
-    bh, sq, d = q.shape
+def _flash_bwd(q, k, v, o, lse, do, bias=None, *, causal, scale, q_offset,
+               g=1, window=None):
+    if g > 1:
+        assert bias is None, "native-GQA kernel does not take a bias"
+        bh, _, sq, d = q.shape
+        q_axis = 2
+    else:
+        bh, sq, d = q.shape
+        q_axis = 1
     kv_len = k.shape[1]
-    bq = _block(sq)
+    bq = _block_gqa(sq, g) if g > 1 else _block(sq)
     bkv = _block(kv_len)
-    qp = _pad_to(q, 1, bq)
+    qp = _pad_to(q, q_axis, bq)
     kp = _pad_to(k, 1, bkv)
     vp = _pad_to(v, 1, bkv)
-    dop = _pad_to(do, 1, bq)
-    nq = qp.shape[1] // bq
+    dop = _pad_to(do, q_axis, bq)
+    nq = qp.shape[q_axis] // bq
     nkv = kp.shape[1] // bkv
     has_bias = bias is not None
 
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
     delta = jnp.broadcast_to(delta[..., None], delta.shape + (128,))
-    delta = _pad_to(delta, 1, bq)
-    lsep = _pad_to(lse, 1, bq)
+    delta = _pad_to(delta, q_axis, bq)
+    lsep = _pad_to(lse, q_axis, bq)
 
     # causal: fold dead (above-diagonal) steps' INPUT fetches onto the
     # diagonal band so their DMA is elided; output specs never fold (dead
     # dbias blocks must still write their zeros to the right slot)
     kvmap_dq, biasmap_dq = _fold_maps(causal=causal, bq=bq, bkv=bkv,
-                                      q_offset=q_offset)
-    dq_in_specs = [
-        pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-        pl.BlockSpec((1, bkv, d), kvmap_dq),
-        pl.BlockSpec((1, bkv, d), kvmap_dq),
-        pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-        pl.BlockSpec((1, bq, 128), lambda b, i, j: (b, i, 0)),
-        pl.BlockSpec((1, bq, 128), lambda b, i, j: (b, i, 0)),
-    ]
+                                      q_offset=q_offset, window=window)
+    if g > 1:
+        def qmap4(b, i, j):
+            return (b, 0, i, 0)
+
+        dq_in_specs = [
+            pl.BlockSpec((1, g, bq, d), qmap4),
+            pl.BlockSpec((1, bkv, d), kvmap_dq),
+            pl.BlockSpec((1, bkv, d), kvmap_dq),
+            pl.BlockSpec((1, g, bq, d), qmap4),
+            pl.BlockSpec((1, g, bq, 128), qmap4),
+            pl.BlockSpec((1, g, bq, 128), qmap4),
+        ]
+        dq_out_specs = pl.BlockSpec((1, g, bq, d), qmap4)
+        dq_out_shape = jax.ShapeDtypeStruct((bh, g, qp.shape[2], d), q.dtype)
+    else:
+        dq_in_specs = [
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bkv, d), kvmap_dq),
+            pl.BlockSpec((1, bkv, d), kvmap_dq),
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, 128), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, 128), lambda b, i, j: (b, i, 0)),
+        ]
+        dq_out_specs = pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0))
+        dq_out_shape = jax.ShapeDtypeStruct((bh, qp.shape[1], d), q.dtype)
     dq_args = [qp, kp, vp, dop, lsep, delta]
-    dq_out_specs = pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0))
-    dq_out_shape = jax.ShapeDtypeStruct((bh, qp.shape[1], d), q.dtype)
     if has_bias:
         bp = _pad_to(_pad_to(bias, 1, bq), 2, bkv)
         dq_in_specs.append(pl.BlockSpec((1, bq, bkv), biasmap_dq))
@@ -483,12 +659,12 @@ def _flash_bwd(q, k, v, o, lse, do, bias=None, *, causal, scale, q_offset):
     dq_out = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal, bq=bq,
                           bkv=bkv, kv_len=kv_len, q_offset=q_offset, nkv=nkv,
-                          has_bias=has_bias),
+                          has_bias=has_bias, g=g, window=window),
         grid=(bh, nq, nkv),
         in_specs=dq_in_specs,
         out_specs=dq_out_specs,
         out_shape=dq_out_shape,
-        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((g * bq, d), jnp.float32)],
         compiler_params=_dim_semantics("parallel", "parallel", "arbitrary"),
         interpret=_interpret(),
     )(*dq_args)
@@ -501,15 +677,29 @@ def _flash_bwd(q, k, v, o, lse, do, bias=None, *, causal, scale, q_offset):
     # dkv mirror: dead steps are q blocks ABOVE kv block j's band — clamp
     # the q-side fetches (q/do/lse/delta/bias) up to the first participant
     qmap_dkv, biasmap_dkv = _fold_maps_dkv(causal=causal, bq=bq, bkv=bkv,
-                                           q_offset=q_offset, nq=nq)
-    dkv_in_specs = [
-        pl.BlockSpec((1, bq, d), qmap_dkv),
-        pl.BlockSpec((1, bkv, d), lambda b, j, i: (b, j, 0)),
-        pl.BlockSpec((1, bkv, d), lambda b, j, i: (b, j, 0)),
-        pl.BlockSpec((1, bq, d), qmap_dkv),
-        pl.BlockSpec((1, bq, 128), qmap_dkv),
-        pl.BlockSpec((1, bq, 128), qmap_dkv),
-    ]
+                                           q_offset=q_offset, nq=nq,
+                                           window=window)
+    if g > 1:
+        def qmap4_dkv(b, j, i):
+            return (b, 0) + qmap_dkv(b, j, i)[1:]
+
+        dkv_in_specs = [
+            pl.BlockSpec((1, g, bq, d), qmap4_dkv),
+            pl.BlockSpec((1, bkv, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bkv, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, g, bq, d), qmap4_dkv),
+            pl.BlockSpec((1, g, bq, 128), qmap4_dkv),
+            pl.BlockSpec((1, g, bq, 128), qmap4_dkv),
+        ]
+    else:
+        dkv_in_specs = [
+            pl.BlockSpec((1, bq, d), qmap_dkv),
+            pl.BlockSpec((1, bkv, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bkv, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bq, d), qmap_dkv),
+            pl.BlockSpec((1, bq, 128), qmap_dkv),
+            pl.BlockSpec((1, bq, 128), qmap_dkv),
+        ]
     dkv_args = [qp, kp, vp, dop, lsep, delta]
     if has_bias:
         dkv_in_specs.append(pl.BlockSpec((1, bq, bkv), biasmap_dkv))
@@ -518,7 +708,7 @@ def _flash_bwd(q, k, v, o, lse, do, bias=None, *, causal, scale, q_offset):
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal, bq=bq,
                           bkv=bkv, kv_len=kv_len, q_offset=q_offset, nq=nq,
-                          nkv=nkv, has_bias=has_bias),
+                          nkv=nkv, has_bias=has_bias, g=g, window=window),
         grid=(bh, nkv, nq),
         in_specs=dkv_in_specs,
         out_specs=[
@@ -536,31 +726,63 @@ def _flash_bwd(q, k, v, o, lse, do, bias=None, *, causal, scale, q_offset):
         compiler_params=_dim_semantics("parallel", "parallel", "arbitrary"),
         interpret=_interpret(),
     )(*dkv_args)
+    if g > 1:
+        return dq[:, :, :sq], dk[:, :kv_len], dv[:, :kv_len], dbias
     return dq[:, :sq], dk[:, :kv_len], dv[:, :kv_len], dbias
 
 
 # --------------------------------------------------------------------------- #
-# differentiable wrapper ([BH, S, d] layout)
+# differentiable wrappers ([BH, S, d] widened layout, and the native-GQA
+# [B*nkv, g, S, d] / narrow [B*nkv, S, d] layout)
 # --------------------------------------------------------------------------- #
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _flash(q, k, v, causal, scale, q_offset):
-    o, _ = _flash_fwd(q, k, v, causal=causal, scale=scale, q_offset=q_offset)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, scale, q_offset, window=None):
+    o, _ = _flash_fwd(q, k, v, causal=causal, scale=scale, q_offset=q_offset,
+                      window=window)
     return o
 
 
-def _flash_vjp_fwd(q, k, v, causal, scale, q_offset):
-    o, lse = _flash_fwd(q, k, v, causal=causal, scale=scale, q_offset=q_offset)
+def _flash_vjp_fwd(q, k, v, causal, scale, q_offset, window=None):
+    o, lse = _flash_fwd(q, k, v, causal=causal, scale=scale,
+                        q_offset=q_offset, window=window)
     return o, (q, k, v, o, lse)
 
 
-def _flash_vjp_bwd(causal, scale, q_offset, res, do):
+def _flash_vjp_bwd(causal, scale, q_offset, window, res, do):
     q, k, v, o, lse = res
     dq, dk, dv, _ = _flash_bwd(q, k, v, o, lse, do, causal=causal,
-                               scale=scale, q_offset=q_offset)
+                               scale=scale, q_offset=q_offset, window=window)
     return dq, dk, dv
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_gqa(q, k, v, causal, scale, q_offset, window=None):
+    """Native-GQA flash: q [B*nkv, g, Sq, d]; k/v NARROW [B*nkv, Skv, d].
+    dK/dV come back narrow — the dkv kernel contracts the query-head group
+    on its row axis, so no widen/sum-back pair ever exists."""
+    o, _ = _flash_fwd(q, k, v, causal=causal, scale=scale, q_offset=q_offset,
+                      g=q.shape[1], window=window)
+    return o
+
+
+def _flash_gqa_vjp_fwd(q, k, v, causal, scale, q_offset, window=None):
+    o, lse = _flash_fwd(q, k, v, causal=causal, scale=scale,
+                        q_offset=q_offset, g=q.shape[1], window=window)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_gqa_vjp_bwd(causal, scale, q_offset, window, res, do):
+    q, k, v, o, lse = res
+    dq, dk, dv, _ = _flash_bwd(q, k, v, o, lse, do, causal=causal,
+                               scale=scale, q_offset=q_offset,
+                               g=q.shape[1], window=window)
+    return dq, dk, dv
+
+
+_flash_gqa.defvjp(_flash_gqa_vjp_fwd, _flash_gqa_vjp_bwd)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
@@ -590,25 +812,46 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
                     causal: bool = True, scale: Optional[float] = None,
                     mask: Optional[jnp.ndarray] = None,
                     bias: Optional[jnp.ndarray] = None,
-                    q_offset: int = 0) -> jnp.ndarray:
+                    q_offset: int = 0,
+                    window: Optional[int] = None) -> jnp.ndarray:
     """Drop-in for ``ops.attention.attention_xla``: [B, S, H, D] layout, GQA
-    K/V broadcast, fp32 accumulation. Supports an ADDITIVE bias
-    (broadcastable to [B, H, Sq, Skv]; differentiable — dbias flows through
-    the backward kernel; the evoformer pair-bias path). Boolean masks fall
-    back to the XLA implementation (the kernel handles causal + length
-    masking natively)."""
-    if mask is not None:
+    K/V broadcast (or native-narrow under ``attention.gqa_native``), fp32
+    accumulation. Supports an ADDITIVE bias (broadcastable to
+    [B, H, Sq, Skv]; differentiable — dbias flows through the backward
+    kernel; the evoformer pair-bias path) and a STATIC causal sliding
+    ``window`` (blocks outside the window skip compute and DMA). Boolean
+    masks — and the window+bias combination — fall back to the XLA
+    implementation (the kernel handles causal + length masking natively)."""
+    if mask is not None or (window is not None and bias is not None):
         from ..attention import attention_xla
 
         return attention_xla(q, k, v, causal=causal, scale=scale, mask=mask,
-                             bias=bias, q_offset=q_offset)
-    from ..attention import repeat_kv
+                             bias=bias, q_offset=q_offset, window=window)
+    from ..attention import gqa_native_active, widen_kv
 
     b, sq, h, d = q.shape
-    k = repeat_kv(k, h)
-    v = repeat_kv(v, h)
-    kv_len = k.shape[1]
+    kvh = k.shape[2]
     scale = scale if scale is not None else d ** -0.5
+    if window is not None:
+        assert causal, "window requires causal attention"
+        assert window >= 1, f"sliding window must be >= 1, got {window}"
+
+    if gqa_native_active() and kvh != h and bias is None:
+        # native-GQA path: K/V stay narrow; query head h = kv*g + gi rides
+        # the kernel's row axis with its kv head's tile
+        g = h // kvh
+        kv_len = k.shape[1]
+        q4 = q.reshape(b, sq, kvh, g, d).transpose(0, 2, 3, 1, 4) \
+            .reshape(b * kvh, g, sq, d)
+        k3 = k.transpose(0, 2, 1, 3).reshape(b * kvh, kv_len, d)
+        v3 = v.transpose(0, 2, 1, 3).reshape(b * kvh, kv_len, d)
+        o = _flash_gqa(q4, k3, v3, causal, float(scale), int(q_offset),
+                       None if window is None else int(window))
+        return o.reshape(b, kvh, g, sq, d).transpose(0, 3, 1, 2, 4) \
+            .reshape(b, sq, h, d)
+
+    k, v = widen_kv(k, v, h)
+    kv_len = k.shape[1]
 
     def to_bh(x):
         return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
@@ -618,6 +861,9 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
             .reshape(b * h, sq, kv_len)
         o = _flash_b(to_bh(q), to_bh(k), to_bh(v), bias, causal,
                      float(scale), int(q_offset))
+    elif window is not None:
+        o = _flash(to_bh(q), to_bh(k), to_bh(v), causal, float(scale),
+                   int(q_offset), int(window))
     else:
         o = _flash(to_bh(q), to_bh(k), to_bh(v), causal, float(scale),
                    int(q_offset))

@@ -301,7 +301,225 @@ def paged_decode_attention_xla(q: jnp.ndarray, k_pool: jnp.ndarray,
     return out[:, 0]
 
 
+# --------------------------------------------------------------------------- #
+# fused speculative verification (inference.speculative.fused_verify;
+# docs/serving.md "Fused verification"): score the [last_token, draft_1..k]
+# rows of every sequence against the SAME block-table-indexed KV pools the
+# decode kernel walks — t query rows per (sequence, kv-head) grid cell
+# instead of one, row ti attending positions <= ctx + ti. Replaces the
+# prefill-shaped ctx-offset dispatch (`engine_v2._verify_fn`), which
+# re-materialized a dense [B, max_blocks*bs, ...] KV view of the WHOLE
+# context at prefill width for every verify step. Composes with the int8
+# dequant-in-register path exactly like the decode kernel.
+# --------------------------------------------------------------------------- #
+def _spec_verify_kernel(*refs, bs, scale, nblk, t, rpad, has_window,
+                        quant=False):
+    if quant:
+        if has_window:
+            (tables_ref, ctx_ref, wnd_ref, q_ref, k_ref, v_ref, ks_ref,
+             vs_ref, o_ref, m_scr, l_scr, acc_scr) = refs
+        else:
+            (tables_ref, ctx_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref,
+             o_ref, m_scr, l_scr, acc_scr) = refs
+            wnd_ref = None
+    elif has_window:
+        (tables_ref, ctx_ref, wnd_ref, q_ref, k_ref, v_ref, o_ref,
+         m_scr, l_scr, acc_scr) = refs
+    else:
+        (tables_ref, ctx_ref, q_ref, k_ref, v_ref, o_ref,
+         m_scr, l_scr, acc_scr) = refs
+        wnd_ref = None
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    ctx = ctx_ref[b]
+    # block j is live if ANY of the t rows can see it: the newest row
+    # attends up to ctx + t - 1, the oldest row's window reaches back to
+    # ctx - window + 1 (rows are g-major/t-minor: row r verifies draft
+    # position r % t)
+    if has_window:
+        lo = ctx - wnd_ref[0]
+        live = jnp.logical_and(j * bs < ctx + t, j * bs + bs - 1 > lo)
+    else:
+        live = j * bs < ctx + t
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[...]                     # [rpad, hd]
+        if quant:                          # int8 tile → q.dtype, in-register
+            k = _dequant_tile(k_ref, ks_ref, q_ref.dtype)
+            v = _dequant_tile(v_ref, vs_ref, q_ref.dtype)
+        else:
+            k = k_ref[...]                 # [bs, hd]
+            v = v_ref[...]                 # [bs, hd]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        pos = j * bs + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        ti = jax.lax.rem(jax.lax.broadcasted_iota(jnp.int32, s.shape, 0),
+                         t)
+        valid = pos <= ctx + ti            # row ti attends itself too
+        if has_window:
+            valid = jnp.logical_and(valid, pos > ctx + ti - wnd_ref[0])
+        s = jnp.where(valid, s, NEG_INF)
+
+        m_prev, l_prev = m_scr[...], l_scr[...]
+        m_curr = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, jnp.broadcast_to(m_curr, m_prev.shape))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new[:, :1])
+        l_scr[...] = l_prev * alpha + jnp.broadcast_to(
+            jnp.sum(p, axis=1, keepdims=True), l_prev.shape)
+        acc_scr[...] = acc_scr[...] * alpha[:, :1] + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[...] = m_new
+
+    @pl.when(j == nblk - 1)
+    def _finish():
+        l = l_scr[...]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[...] = (acc_scr[...] / l_safe[:, :1]).astype(o_ref.dtype)
+
+
+def paged_spec_verify_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
+                                v_pool: jnp.ndarray,
+                                block_tables: jnp.ndarray,
+                                context_lens: jnp.ndarray, *,
+                                scale: float = None,
+                                window=None, k_scale=None,
+                                v_scale=None) -> jnp.ndarray:
+    """Fused speculative-verification attention over the paged pools.
+
+    q ``[B, t, nh, hd]`` — row ti of sequence b sits at absolute position
+    ``context_lens[b] + ti`` (the verify window ``[last_token,
+    draft_1..t-1]``; its K/V must already be scattered into the pool, like
+    the decode kernel's current token). Returns ``[B, t, nh, hd]``.
+    ``window``/``k_scale``/``v_scale`` as in :func:`paged_decode_attention`.
+    HBM traffic is exactly the live context per kv head — never a dense
+    [B, max_blocks*bs, ...] gather."""
+    B, t, nh, hd = q.shape
+    nblocks, nkv, bs, _ = k_pool.shape
+    max_blocks = block_tables.shape[1]
+    g = nh // nkv
+    # rows are g-major/t-minor, sublane-padded: row r = gi*t + ti
+    rpad = max(8, -(-(g * t) // 8) * 8)
+    scale = hd ** -0.5 if scale is None else scale
+    has_window = window is not None
+    quant = k_scale is not None
+    assert quant == (v_scale is not None), \
+        "k_scale and v_scale must be given together"
+    if has_window:
+        # same window >= 1 contract as the decode kernel
+        if isinstance(window, (int, np.integer)):
+            assert window >= 1, f"sliding window must be >= 1, got {window}"
+        window = jnp.maximum(jnp.asarray(window, jnp.int32), 1)
+
+    # [B, nkv, rpad, hd] row-folded query groups (head h = kv*g + gi)
+    qg = q.reshape(B, t, nkv, g, hd).transpose(0, 2, 3, 1, 4) \
+        .reshape(B, nkv, g * t, hd)
+    qg = jnp.pad(qg, ((0, 0), (0, 0), (0, rpad - g * t), (0, 0)))
+
+    kernel = functools.partial(_spec_verify_kernel, bs=bs,
+                               scale=float(scale), nblk=max_blocks, t=t,
+                               rpad=rpad, has_window=has_window, quant=quant)
+
+    def qmap(b, h, j, *_):
+        return (b, h, 0, 0)
+
+    def kvmap(b, h, j, tables, ctx, *rest):
+        # the newest verify row writes/reads position ctx + t - 1
+        hi_blk = (ctx[b] + t - 1) // bs
+        lo_blk = (jnp.maximum(ctx[b] - rest[0][0] + 1, 0) // bs
+                  if rest else 0)
+        j_eff = jnp.clip(j, lo_blk, hi_blk)
+        return (jnp.clip(tables[b, j_eff], 0, nblocks - 1), h, 0, 0)
+
+    in_specs = [
+        pl.BlockSpec((None, None, rpad, hd), qmap),
+        pl.BlockSpec((None, None, bs, hd), kvmap),
+        pl.BlockSpec((None, None, bs, hd), kvmap),
+    ]
+    operands = [qg, k_pool, v_pool]
+    if quant:
+        ng = k_scale.shape[-1]
+        in_specs += [pl.BlockSpec((None, None, bs, ng), kvmap),
+                     pl.BlockSpec((None, None, bs, ng), kvmap)]
+        operands += [k_scale, v_scale]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2 + int(has_window),
+        grid=(B, nkv, max_blocks),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((None, None, rpad, hd), qmap),
+        scratch_shapes=[
+            pltpu.VMEM((rpad, 128), jnp.float32),
+            pltpu.VMEM((rpad, 128), jnp.float32),
+            pltpu.VMEM((rpad, hd), jnp.float32),
+        ],
+    )
+    prefetch = [block_tables.astype(jnp.int32),
+                context_lens.astype(jnp.int32)]
+    if has_window:
+        prefetch.append(jnp.asarray(window, jnp.int32).reshape(1))
+    out = pl.pallas_call(
+        kernel, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, nkv, rpad, hd), q.dtype),
+        compiler_params=_dim_semantics("parallel", "parallel", "arbitrary"),
+        interpret=_interpret(),
+    )(*prefetch, *operands)
+    return out[:, :, :g * t].reshape(B, nkv, g, t, hd) \
+        .transpose(0, 3, 1, 2, 4).reshape(B, t, nh, hd)
+
+
+def paged_spec_verify_attention_xla(q: jnp.ndarray, k_pool: jnp.ndarray,
+                                    v_pool: jnp.ndarray,
+                                    block_tables: jnp.ndarray,
+                                    context_lens: jnp.ndarray, *,
+                                    scale: float = None,
+                                    window=None, k_scale=None,
+                                    v_scale=None) -> jnp.ndarray:
+    """Dense-gather fallback with identical semantics — deliberately the
+    SAME expressions as the multi-token prefill read path
+    (``models/_paged.paged_attention_step``), so on CPU the fused-verify
+    programs match the unfused ones and greedy streams stay
+    token-identical."""
+    from ..attention import attention_xla
+    from ..quantization import kv_dequantize_int8
+
+    B, t, nh, hd = q.shape
+    _, nkv, bs, _ = k_pool.shape
+    max_blocks = block_tables.shape[1]
+    S = max_blocks * bs
+    kg = k_pool[block_tables].swapaxes(2, 3).reshape(B, S, nkv, hd)
+    vg = v_pool[block_tables].swapaxes(2, 3).reshape(B, S, nkv, hd)
+    if k_scale is not None:
+        ng = k_scale.shape[-1]
+        ksg = k_scale[block_tables].swapaxes(2, 3).reshape(B, S, nkv, ng)
+        vsg = v_scale[block_tables].swapaxes(2, 3).reshape(B, S, nkv, ng)
+        kg = kv_dequantize_int8(kg, ksg, q.dtype)
+        vg = kv_dequantize_int8(vg, vsg, q.dtype)
+    positions = context_lens[:, None] + jnp.arange(t)[None, :]
+    kv_pos = jnp.arange(S)[None, None, None, :]
+    q_abs = positions[:, None, :, None]
+    mask = kv_pos <= q_abs
+    if window is not None:
+        if isinstance(window, (int, np.integer)):
+            assert window >= 1, f"sliding window must be >= 1, got {window}"
+        window = jnp.maximum(jnp.asarray(window, jnp.int32), 1)
+        mask = mask & (q_abs - kv_pos < window)
+    return attention_xla(q, kg, vg, causal=False, mask=mask, scale=scale)
+
+
 from ..registry import register  # noqa: E402
 
 register("paged_decode_attention", backend="pallas")(paged_decode_attention)
 register("paged_decode_attention", backend="xla")(paged_decode_attention_xla)
+register("paged_spec_verify_attention",
+         backend="pallas")(paged_spec_verify_attention)
+register("paged_spec_verify_attention",
+         backend="xla")(paged_spec_verify_attention_xla)

@@ -267,11 +267,10 @@ def sparse_flash_attention_fwd(q: jnp.ndarray, k: jnp.ndarray,
     """q/k/v [B, S, H, D]; layout [S/bs, S/bs] (static bool). Returns o.
     Grid runs over the compacted active-block lists, so BOTH compute and
     DMA scale with layout density."""
-    from ..attention import repeat_kv
+    from ..attention import widen_kv
 
     b, s, h, d = q.shape
-    k = repeat_kv(k, h)
-    v = repeat_kv(v, h)
+    k, v = widen_kv(k, v, h)
     scale = d ** -0.5 if scale is None else scale
     o, _ = _sparse_fwd_lse(q, k, v, layout, block_size, causal=causal,
                            scale=scale)

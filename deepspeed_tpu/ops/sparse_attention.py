@@ -116,7 +116,7 @@ def _kernel_vjp(layout_bytes: bytes, nb: int, block_size: int, causal: bool,
     backward run the skipping Pallas kernels (round 5): the backward
     streams the same compacted block lists with the forward's saved lse,
     so sparse training cost scales with layout density, not S²."""
-    from .attention import repeat_kv
+    from .attention import widen_kv
     from .pallas.sparse_attention import (_sparse_fwd_lse,
                                           sparse_flash_attention_bwd)
 
@@ -125,7 +125,7 @@ def _kernel_vjp(layout_bytes: bytes, nb: int, block_size: int, causal: bool,
     def _widened(q, k, v):
         h = q.shape[2]
         sc = q.shape[-1] ** -0.5 if scale is None else scale
-        kw, vw = repeat_kv(k, h), repeat_kv(v, h)
+        kw, vw = widen_kv(k, v, h)
         o, lse = _sparse_fwd_lse(q, kw, vw, lay, block_size, causal=causal,
                                  scale=sc)
         return o, lse, kw, vw, sc
@@ -136,7 +136,7 @@ def _kernel_vjp(layout_bytes: bytes, nb: int, block_size: int, causal: bool,
 
     def _fwd(q, k, v):
         o, lse, _, _, _ = _widened(q, k, v)
-        # residuals stay NARROW: k/v re-widen in _bwd (repeat_kv is cheap,
+        # residuals stay NARROW: k/v re-widen in _bwd (widen_kv is cheap,
         # the widened copies are h/hkv× the memory) and lse keeps one lane
         # of its 128-replicated layout
         return o, (q, k, v, o, lse[..., :1])
@@ -145,7 +145,7 @@ def _kernel_vjp(layout_bytes: bytes, nb: int, block_size: int, causal: bool,
         q, k, v, o, lse1 = res
         h, hkv = q.shape[2], k.shape[2]
         sc = q.shape[-1] ** -0.5 if scale is None else scale
-        kw, vw = repeat_kv(k, h), repeat_kv(v, h)
+        kw, vw = widen_kv(k, v, h)
         lse = jnp.broadcast_to(lse1, lse1.shape[:-1] + (128,))
         dq, dk, dv = sparse_flash_attention_bwd(
             q, kw, vw, o, lse, g, lay, block_size, causal=causal, scale=sc)

@@ -154,6 +154,23 @@ class TensorParallelConfig(ConfigModel):
 
 @register_config_model
 @dataclass
+class AttentionOpsConfig(ConfigModel):
+    """``attention`` block — attention-kernel behavior knobs
+    (docs/performance.md "Native GQA attention").
+
+    ``gqa_native: false`` (the default) keeps every attention program
+    byte-identical to the historical widening path (K/V broadcast to the
+    query head count before the kernel). ``true`` arms the native-GQA flash
+    kernels process-wide (``ops.attention.configure_gqa_native``, published
+    at engine init like the remat-policy registry): K/V stay kv-head-narrow
+    through forward AND backward — up to nq/nkv× less KV HBM traffic —
+    with ``repeat_kv`` surviving only as the XLA-fallback reference and
+    the Ulysses head-sharding alignment widener."""
+    gqa_native: bool = False
+
+
+@register_config_model
+@dataclass
 class ActivationCheckpointingConfig(ConfigModel):
     """Reference: ``runtime/activation_checkpointing/checkpointing.py`` flags.
     On TPU these select a ``jax.checkpoint`` (remat) policy."""
@@ -476,6 +493,7 @@ class DeepSpeedTPUConfig:
     tensor_parallel: TensorParallelConfig = field(default_factory=TensorParallelConfig)
     pipeline: PipelineConfig = field(default_factory=PipelineConfig)
     moe: MoEConfig = field(default_factory=MoEConfig)
+    attention: AttentionOpsConfig = field(default_factory=AttentionOpsConfig)
     activation_checkpointing: ActivationCheckpointingConfig = field(
         default_factory=ActivationCheckpointingConfig)
     flops_profiler: FlopsProfilerConfig = field(default_factory=FlopsProfilerConfig)
@@ -554,6 +572,7 @@ _SUBCONFIG_KEYS = {
     "tensor_parallel": TensorParallelConfig,
     "pipeline": PipelineConfig,
     "moe": MoEConfig,
+    "attention": AttentionOpsConfig,
     "activation_checkpointing": ActivationCheckpointingConfig,
     "flops_profiler": FlopsProfilerConfig,
     "comms_logger": CommsLoggerConfig,

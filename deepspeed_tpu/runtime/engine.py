@@ -474,6 +474,18 @@ class DeepSpeedTPUEngine:
 
             _ac.configure(deepspeed_config=config)
 
+        # --- attention.gqa_native: publish the native-GQA kernel gate
+        # process-wide (latest engine wins, same contract as the remat
+        # registry above; docs/performance.md "Native GQA attention").
+        # Default OFF → every attention program stays byte-identical to
+        # the K/V-widening path.
+        from ..ops.attention import configure_gqa_native
+
+        configure_gqa_native(bool(config.attention.gqa_native))
+        if config.attention.gqa_native:
+            log_dist("attention.gqa_native: narrow-KV flash kernels armed "
+                     "(KV HBM traffic scales with kv_heads, not num_heads)")
+
         self.tput_timer = ThroughputTimer(
             batch_size=self.train_batch_size(),
             steps_per_output=config.steps_per_print)

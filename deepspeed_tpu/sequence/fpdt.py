@@ -43,7 +43,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..memory.placement import to_device, to_host
-from ..ops.attention import repeat_kv
+from ..ops.attention import gqa_native_active, widen_kv
 from ..ops.pallas.flash_attention import _flash_bwd, _flash_fwd
 from .tiled import tiled_fused_logits_loss, tiled_mlp
 
@@ -71,6 +71,18 @@ def _fetch(buf, idx, offload):
     return blk
 
 
+def _gqa_pair(q_bh, k_blk, H):
+    """Native-GQA layout adapters for one (q-chunk, kv-chunk) pair: BH rows
+    are b-major/head-minor and query head h = kv*g + gi, so the reshape to
+    [B*Hkv, g, c, D] / [B*Hkv, c, D] lines each query group up with its kv
+    head's tile. Returns (q4, g, B)."""
+    B, c, Hkv, D = k_blk.shape
+    g = H // Hkv
+    q4 = q_bh.reshape(B, Hkv, g, q_bh.shape[1], D).reshape(
+        B * Hkv, g, q_bh.shape[1], D)
+    return q4, g, B
+
+
 def _pair_fwd(q_bh, k_blk, v_blk, diag, causal, scale, H):
     """Flash forward over one (q-chunk, kv-chunk) pair → (o fp32, lse [BH,c]).
 
@@ -78,9 +90,29 @@ def _pair_fwd(q_bh, k_blk, v_blk, diag, causal, scale, H):
     causally; off-diagonal pairs are fully visible (j < qi are the only
     others that run). q_offset is static in the kernel, so the two cases are
     two branches of a ``lax.cond`` rather than a traced offset.
-    """
-    kw = _to_bh(repeat_kv(k_blk, H))
-    vw = _to_bh(repeat_kv(v_blk, H))
+
+    Under ``attention.gqa_native`` the pair runs the native-GQA kernel on
+    NARROW K/V — the per-chunk widening disappears entirely, so K/V stay
+    narrow from the host-offload stream all the way into VMEM."""
+    Hkv = k_blk.shape[2]
+    if gqa_native_active() and Hkv != H:
+        q4, g, B = _gqa_pair(q_bh, k_blk, H)
+        kn = _to_bh(k_blk)
+        vn = _to_bh(v_blk)
+
+        def _diag():
+            return _flash_fwd(q4, kn, vn, causal=True, scale=scale,
+                              q_offset=0, g=g)
+
+        def _full():
+            return _flash_fwd(q4, kn, vn, causal=False, scale=scale,
+                              q_offset=0, g=g)
+
+        o4, lse4 = lax.cond(diag, _diag, _full) if causal else _full()
+        c, D = q_bh.shape[1], q_bh.shape[2]
+        return (o4.reshape(B * H, c, D).astype(jnp.float32),
+                lse4.reshape(B * H, c, 128)[..., 0])
+    kw, vw = (_to_bh(x) for x in widen_kv(k_blk, v_blk, H))
 
     def _diag():
         return _flash_fwd(q_bh, kw, vw, causal=True, scale=scale, q_offset=0)
@@ -103,13 +135,39 @@ def _merge(o_run, l_run, o_j, lse_j):
 def _pair_bwd(q_bh, k_blk, v_blk, o_bh, lse128, do_bh, diag, causal, scale):
     """Flash backward over one pair with the GLOBAL (merged) lse/out →
     (dq [BH,c,D] f32, dk/dv narrow [B,c,Hkv,D] f32). See ``_pair_fwd`` for
-    the diag/full branching; ``repeat_kv``'s head widening is inverted by
-    summing each query-head group back onto its KV head."""
+    the diag/full branching. Gate off: ``widen_kv``'s head widening is
+    inverted by summing each query-head group back onto its KV head; gate
+    on (``attention.gqa_native``): the dkv kernel contracts the group on
+    its row axis and dK/dV come back narrow directly — no widen/sum pair,
+    g× less K/V traffic in the backward too."""
     B, c, Hkv, D = k_blk.shape
     H = q_bh.shape[0] // B
     g = H // Hkv
-    kw = _to_bh(repeat_kv(k_blk, H))
-    vw = _to_bh(repeat_kv(v_blk, H))
+    if gqa_native_active() and Hkv != H:
+        q4, _, _ = _gqa_pair(q_bh, k_blk, H)
+        kn = _to_bh(k_blk)
+        vn = _to_bh(v_blk)
+        o4 = o_bh.reshape(B * Hkv, g, c, D)
+        do4 = do_bh.reshape(B * Hkv, g, c, D)
+        lse4 = lse128.reshape(B * Hkv, g, c, 128)
+
+        def _diag():
+            return _flash_bwd(q4, kn, vn, o4, lse4, do4, causal=True,
+                              scale=scale, q_offset=0, g=g)
+
+        def _full():
+            return _flash_bwd(q4, kn, vn, o4, lse4, do4, causal=False,
+                              scale=scale, q_offset=0, g=g)
+
+        dq4, dkn, dvn, _ = lax.cond(diag, _diag, _full) \
+            if causal else _full()
+
+        def narrow(d_bh):
+            return _from_bh(d_bh.astype(jnp.float32), B, Hkv)
+
+        return (dq4.reshape(B * H, c, D).astype(jnp.float32),
+                narrow(dkn), narrow(dvn))
+    kw, vw = (_to_bh(x) for x in widen_kv(k_blk, v_blk, H))
 
     def _diag():
         return _flash_bwd(q_bh, kw, vw, o_bh, lse128, do_bh,

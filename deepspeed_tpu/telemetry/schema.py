@@ -19,10 +19,10 @@ Checked invariants:
   engine's counter families are enumerated per metric, so a typo'd or
   unregistered serving series (which ``telemetry_report.py --serving`` and
   the Prometheus mapper would silently ignore) fails validation instead;
-- ``Train/overlap/*`` and ``Train/remat/*`` names come from the closed
-  ``TRAIN_SERIES`` registry (layer-prefetch gauges and per-remat-policy
-  sweep rows); other ``Train/*`` families (``Train/Step``,
-  ``Train/Samples``) stay open.
+- ``Train/overlap/*``, ``Train/remat/*`` and ``Train/attn/*`` names come
+  from the closed ``TRAIN_SERIES`` registry (layer-prefetch gauges,
+  per-remat-policy sweep rows, and the native-GQA KV-traffic accounting);
+  other ``Train/*`` families (``Train/Step``, ``Train/Samples``) stay open.
 - ``Comm/*`` names are closed per METRIC: op names are open-ended (any
   collective the comms logger observes), but the final metric segment must
   come from ``COMM_METRICS`` and the ``Comm/total/*`` rollup family from
@@ -74,7 +74,11 @@ SERVING_SERIES = frozenset(
         "verify_steps", "decode_steps", "step_seqs", "drafted_tokens",
         "accepted_tokens", "emitted_tokens", "rolled_back_tokens",
         "verify_positions", "verify_capacity", "accept_rate",
-        "mean_accepted_len", "tokens_per_step", "verify_batch_occupancy")]
+        "mean_accepted_len", "tokens_per_step", "verify_batch_occupancy",
+        # verify steps that rode the paged-decode kernel family instead of
+        # a prefill-shaped dispatch (inference.speculative.fused_verify;
+        # docs/serving.md "Fused verification")
+        "fused_verify_steps")]
     # continuous-batching scheduler (serving/scheduler.py sched_events)
     + ["Serving/sched/" + m for m in (
         "submitted", "admitted", "resumed", "preempted", "rejected",
@@ -112,7 +116,12 @@ TRAIN_SERIES = frozenset(
         "prefetch_depth", "prefetch_layers", "prefetch_bytes",
         "hidden_comm_frac")]
     + [f"Train/remat/{m}_{p}" for p in REMAT_POLICIES
-       for m in ("saved_bytes", "peak_bytes", "step_ms")])
+       for m in ("saved_bytes", "peak_bytes", "step_ms")]
+    # native-GQA attention accounting (attention.gqa_native; bench.py
+    # detail.attn_probe GQA sweep — docs/performance.md "Native GQA
+    # attention"): per-step K/V HBM bytes the narrow kernels avoid, and
+    # the query/kv head ratio they avoid it by
+    + ["Train/attn/" + m for m in ("kv_bytes_saved", "gqa_ratio")])
 
 
 # Registered Comm/* byte-accounting metrics (comm.CommsTelemetry.events):
@@ -206,7 +215,8 @@ def validate_events(events: Iterable[Tuple[str, float, int]]) -> List[str]:
             problems.append(f"event #{i}: serving series {name!r} is not "
                             f"registered in telemetry.schema.SERVING_SERIES")
             continue
-        if name.startswith(("Train/overlap/", "Train/remat/")) and \
+        if name.startswith(("Train/overlap/", "Train/remat/",
+                            "Train/attn/")) and \
                 name not in TRAIN_SERIES:
             problems.append(f"event #{i}: train series {name!r} is not "
                             f"registered in telemetry.schema.TRAIN_SERIES")

@@ -2,12 +2,20 @@
 """Flash-attention block-size sweep (VERDICT r4 item 6: attention MFU is
 the gap between headline 0.58 and the 0.7+ matmul ceiling).
 
-Measures the Pallas flash kernel fwd+bwd at hd=128 over a block × seq
-matrix (plus an s=8192 forward row and an hd=64 contrast row), picks the
-block size with the best mean train-MFU, and — when it beats the current
-default by >3% on the real chip — persists it to `.dstpu_tuned.json` at
-the repo root, which `ops/pallas/flash_attention._block` reads as its
-default. The next watcher cycle's headline bench then runs tuned.
+Measures the Pallas flash kernel fwd+bwd at hd=128 over a block × seq ×
+kv_heads matrix (plus an s=8192 forward row and an hd=64 contrast row),
+picks the block size with the best mean train-MFU PER GQA GROUP, and —
+when it beats the current default by >3% on the real chip — persists it to
+`.dstpu_tuned.json` at the repo root:
+
+- ``flash_block``: the MHA (kv_heads == nq) q/kv block, read by
+  ``ops/pallas/flash_attention._block`` as its default;
+- ``flash_block_g<g>``: the per-group q block for the native-GQA kernels
+  at query/kv ratio g (``_block_gqa`` reads these directly — the autotune
+  key gained the kv_heads dimension with ISSUE 14's native-GQA kernels).
+
+The next watcher cycle's headline bench then runs tuned. GQA rows measure
+with ``attention.gqa_native`` armed (narrow K/V through the kernel).
 
 Flops accounting: causal fwd = 2·B·H·S²·D (two matmuls, causal half);
 bwd = 2.5× fwd (five matmuls) → fwd+bwd = 3.5× fwd. ONE JSON line.
@@ -41,6 +49,11 @@ def main():
     except Exception:
         pass
 
+    import importlib
+
+    # the ops package re-exports the `attention` dispatcher under the same
+    # name, shadowing the submodule on attribute access
+    attn_mod = importlib.import_module("deepspeed_tpu.ops.attention")
     from bench import peak_flops_per_chip
     from deepspeed_tpu.ops.pallas import flash_attention as fa
 
@@ -51,20 +64,25 @@ def main():
     B, H = (8, 8) if on_tpu else (1, 2)
     blocks = (256, 512, 1024) if on_tpu else (128,)
     seqs = (2048, 4096) if on_tpu else (256,)
+    # kv_heads dimension (ISSUE 14): the MHA row plus the native-GQA
+    # ratios the serving/training models actually use
+    kv_heads = tuple(sorted(x for x in {1, 4, 8, H} if H % x == 0))
     rows = {}
     RESULT["detail"]["rows"] = rows
     budget_s = float(os.environ.get("DSTPU_ATTN_BUDGET_S", 1500))
     t_start = time.perf_counter()
 
-    def measure(blk, S, D, mode):
+    def measure(blk, S, D, mode, kvh=None):
         """One config → (ms, mfu). Chained reps inside one jit so the
-        tunnel's per-dispatch latency is excluded (profile_ops recipe)."""
+        tunnel's per-dispatch latency is excluded (profile_ops recipe).
+        ``kvh < H`` measures the native-GQA kernel on narrow K/V."""
         from jax import lax
 
+        kvh = H if kvh is None else kvh
         os.environ["DSTPU_FLASH_BLOCK"] = str(blk)
         q = jax.random.normal(jax.random.PRNGKey(0), (B, S, H, D),
                               jnp.bfloat16)
-        k = jax.random.normal(jax.random.PRNGKey(1), (B, S, H, D),
+        k = jax.random.normal(jax.random.PRNGKey(1), (B, S, kvh, D),
                               jnp.bfloat16)
         fwd_flops = 2 * B * H * S * S * D
         if mode == "fwd":
@@ -92,40 +110,53 @@ def main():
             out, _ = lax.scan(body, q0, None, length=reps)
             return out
 
-        f = jax.jit(chained)
-        out = f(k, q)
-        float(jnp.sum(out.astype(jnp.float32)))  # compile + sync
-        t0 = time.perf_counter()
-        for _ in range(steps):
+        prev = attn_mod.configure_gqa_native(kvh != H)
+        try:
+            f = jax.jit(chained)
             out = f(k, q)
-        float(jnp.sum(out.astype(jnp.float32)))
+            float(jnp.sum(out.astype(jnp.float32)))  # compile + sync
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                out = f(k, q)
+            float(jnp.sum(out.astype(jnp.float32)))
+        finally:
+            attn_mod.configure_gqa_native(prev)
         dt = (time.perf_counter() - t0) / (steps * reps)
         return round(dt * 1e3, 3), round(flops / dt / peak, 4)
 
-    per_block_mfu = {}
+    # per_group_mfu[g][blk] = mean fwdbwd mfu over seqs (g = H // kvh;
+    # blk is the DSTPU_FLASH_BLOCK value — total kernel rows)
+    per_group_mfu = {H // kvh: {} for kvh in kv_heads}
     for blk in blocks:
-        vals = []
-        for S in seqs:
-            if time.perf_counter() - t_start > budget_s:
-                rows[f"blk{blk}_s{S}"] = "skipped: budget exhausted"
-                continue
-            try:
-                ms, mfu = measure(blk, S, 128, "fwdbwd")
-                rows[f"blk{blk}_s{S}_hd128_fwdbwd"] = {"ms": ms, "mfu": mfu}
-                vals.append(mfu)
-                sys.stderr.write(f"[attn] blk={blk} S={S}: mfu={mfu}\n")
-            except Exception as e:
-                rows[f"blk{blk}_s{S}_hd128_fwdbwd"] = \
-                    f"error: {str(e)[-200:]}"
-        if vals:
-            per_block_mfu[blk] = sum(vals) / len(vals)
+        for kvh in kv_heads:
+            g = H // kvh
+            vals = []
+            for S in seqs:
+                label = f"blk{blk}_s{S}_hd128_kv{kvh}_fwdbwd"
+                if time.perf_counter() - t_start > budget_s:
+                    rows[label] = "skipped: budget exhausted"
+                    continue
+                try:
+                    ms, mfu = measure(blk, S, 128, "fwdbwd", kvh=kvh)
+                    rows[label] = {"ms": ms, "mfu": mfu}
+                    vals.append(mfu)
+                    sys.stderr.write(
+                        f"[attn] blk={blk} S={S} kv={kvh}: mfu={mfu}\n")
+                except Exception as e:
+                    rows[label] = f"error: {str(e)[-200:]}"
+            if vals:
+                per_group_mfu[g][blk] = sum(vals) / len(vals)
 
-    if per_block_mfu:
-        best_blk = max(per_block_mfu, key=per_block_mfu.get)
+    mha = per_group_mfu.get(1, {})
+    if mha:
+        best_blk = max(mha, key=mha.get)
         RESULT["detail"]["best_block"] = best_blk
         RESULT["detail"]["per_block_mean_mfu"] = {
-            str(b): round(v, 4) for b, v in per_block_mfu.items()}
-        RESULT["value"] = round(per_block_mfu[best_blk], 4)
+            str(b): round(v, 4) for b, v in mha.items()}
+        RESULT["detail"]["per_group_mean_mfu"] = {
+            str(g): {str(b): round(v, 4) for b, v in m.items()}
+            for g, m in per_group_mfu.items() if m}
+        RESULT["value"] = round(mha[best_blk], 4)
         # contrast rows at the winning block (budget-guarded)
         for label, S, D, mode in (("s8192_hd128_fwd", 8192, 128, "fwd"),
                                   ("s2048_hd64_fwdbwd", 2048, 64, "fwdbwd")):
@@ -136,12 +167,13 @@ def main():
                 rows[f"blk{best_blk}_{label}"] = {"ms": ms, "mfu": mfu}
             except Exception as e:
                 rows[f"blk{best_blk}_{label}"] = f"error: {str(e)[-200:]}"
-        # persist the winner for the kernel's default — real-chip data only.
-        # Compared against the CURRENTLY persisted value (or 512) so a later
-        # sweep can also revert a stale tuning; the file is deliberately
-        # committable (the target hardware IS v5e — the driver bench should
-        # run tuned). Atomic replace: a SIGTERM mid-write must never leave a
-        # partial file that readers silently ignore forever.
+        # persist the winners for the kernel's defaults — real-chip data
+        # only. Compared against the CURRENTLY persisted value (or the
+        # compiled-in default) so a later sweep can also revert a stale
+        # tuning; the file is deliberately committable (the target hardware
+        # IS v5e — the driver bench should run tuned). Atomic replace: a
+        # SIGTERM mid-write must never leave a partial file that readers
+        # silently ignore forever.
         path = os.path.join(os.path.dirname(os.path.dirname(
             os.path.abspath(__file__))), ".dstpu_tuned.json")
         tuned = {}
@@ -150,18 +182,35 @@ def main():
                 tuned = json.load(f)
         except Exception:
             pass
+        wrote = []
         current = int(tuned.get("flash_block", 512))
-        cur_mfu = per_block_mfu.get(current)
-        should_write = on_tpu and best_blk != current and (
-            cur_mfu is None  # current value wasn't even measurable
-            or per_block_mfu[best_blk] > cur_mfu * 1.03)
-        if should_write:
+        cur_mfu = mha.get(current)
+        if on_tpu and best_blk != current and (
+                cur_mfu is None  # current value wasn't even measurable
+                or mha[best_blk] > cur_mfu * 1.03):
             tuned["flash_block"] = best_blk
+            wrote.append("flash_block")
+        for g, m in per_group_mfu.items():
+            if g == 1 or not m:
+                continue
+            best_total = max(m, key=m.get)
+            # the tuned key stores the PER-GROUP q block the native kernel
+            # reads directly (_block_gqa): total kernel rows / g
+            best_bq = max(8, (best_total // g) // 8 * 8)
+            cur_bq = int(tuned.get(f"flash_block_g{g}", 0))
+            cur_total_mfu = m.get(cur_bq * g) if cur_bq else None
+            if on_tpu and best_bq != cur_bq and (
+                    cur_total_mfu is None
+                    or m[best_total] > cur_total_mfu * 1.03):
+                tuned[f"flash_block_g{g}"] = best_bq
+                wrote.append(f"flash_block_g{g}")
+        if wrote:
             tmp = path + ".tmp"
             with open(tmp, "w") as f:
                 json.dump(tuned, f)
             os.replace(tmp, path)
-            RESULT["detail"]["tuned_written"] = best_blk
+            RESULT["detail"]["tuned_written"] = {
+                k: tuned[k] for k in wrote}
     os.environ.pop("DSTPU_FLASH_BLOCK", None)
     finalize(RESULT)
 

@@ -79,6 +79,11 @@ def comm_efficiency(events: List[dict]) -> str:
         _, op, kind = name.split("/", 2)
         per_op.setdefault(op, {})[kind] = e["value"]  # last sample wins
     if not per_op:
+        # no collectives recorded — the overlap/remat/attn gauge sections
+        # can still render (bench probes emit them without a comms logger)
+        extra = _overlap_remat_sections(events)
+        if extra:
+            return "\n".join(extra)
         return "comm efficiency: no Comm/* events in this file"
     lines = [f"comm efficiency ({n_steps} steps)"]
     lines.append(f"  {'op':<28} {'count/step':>10} {'bytes/step':>14} "
@@ -162,16 +167,29 @@ def _quantized_comm_section(per_op: Dict[str, Dict[str, float]],
 
 
 def _overlap_remat_sections(events: List[dict]) -> List[str]:
-    """Fine-grained overlap + selective-remat rollup (the ``Train/overlap/*``
-    and ``Train/remat/*`` gauge series — docs/performance.md): layer-prefetch
-    configuration, overlap-hidden comm fraction, and the per-remat-policy
-    saved-bytes / peak-HBM / step-time sweep rows. Gauges: last sample per
-    series wins."""
+    """Fine-grained overlap + selective-remat + native-GQA rollup (the
+    ``Train/overlap/*``, ``Train/remat/*`` and ``Train/attn/*`` gauge
+    series — docs/performance.md): layer-prefetch configuration,
+    overlap-hidden comm fraction, the per-remat-policy saved-bytes /
+    peak-HBM / step-time sweep rows, and the narrow-KV attention traffic
+    accounting. Gauges: last sample per series wins."""
     ov = {e["name"][len("Train/overlap/"):]: e["value"] for e in events
           if e["name"].startswith("Train/overlap/")}
     remat = {e["name"][len("Train/remat/"):]: e["value"] for e in events
              if e["name"].startswith("Train/remat/")}
+    attn = {e["name"][len("Train/attn/"):]: e["value"] for e in events
+            if e["name"].startswith("Train/attn/")}
     lines: List[str] = []
+    if attn:
+        lines.append("native GQA attention (attention.gqa_native)")
+        if "gqa_ratio" in attn:
+            lines.append(f"  query/kv head ratio:   "
+                         f"{attn['gqa_ratio']:.0f}x")
+        if "kv_bytes_saved" in attn:
+            lines.append(f"  KV bytes saved/step:   "
+                         f"{_fmt_bytes(attn['kv_bytes_saved'])} "
+                         f"(fwd+bwd, vs widened kernels)")
+        lines.append("")
     if ov:
         lines.append("fine-grained overlap (layer prefetch)")
         if "prefetch_depth" in ov:
@@ -554,6 +572,12 @@ def serving(events: List[dict]) -> str:
                      f"{sp.get('tokens_per_step', 0):.2f} per sequence")
         lines.append(f"  verify batch occupancy: "
                      f"{sp.get('verify_batch_occupancy', 0) * 100:.1f}%")
+        if sp.get("fused_verify_steps"):
+            lines.append(f"  fused verify steps:     "
+                         f"{sp.get('fused_verify_steps', 0):,.0f} of "
+                         f"{sp.get('verify_steps', 0):,.0f} rode the "
+                         f"paged-decode kernel (zero prefill-shaped "
+                         f"dispatches)")
     if sched:
         if lines:
             lines.append("")

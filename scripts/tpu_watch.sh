@@ -206,6 +206,30 @@ EOF
       else
         echo "[watch] $bts REGRESSION probe FLAGGED step-time regression (non-fatal)" >> "$LOG"
       fi
+      # native-GQA probe row (docs/performance.md "Native GQA attention"):
+      # per-kv-head-count widened-vs-native MFU + the measured KV-byte
+      # reduction from the headline capture's detail.attn_probe.gqa.
+      # NON-FATAL by design.
+      python - "bench_runs/BENCH_tpu_${bts}.json" >> "$LOG" 2>&1 <<'PYEOF' || \
+        echo "[watch] $bts GQA probe: unreadable (non-fatal)" >> "$LOG"
+import json, sys
+raw = open(sys.argv[1]).read()
+line = [l for l in raw.splitlines() if l.strip().startswith("{")]
+d = json.loads(line[-1]) if line else {}
+gqa = ((d.get("detail") or {}).get("attn_probe") or {}).get("gqa") or {}
+if not gqa:
+    print("[watch] GQA probe: no detail.attn_probe.gqa")
+else:
+    for key, row in sorted(gqa.items()):
+        if not isinstance(row, dict):
+            continue
+        w = (row.get("widened") or {}).get("fwdbwd") or {}
+        n = (row.get("native") or {}).get("fwdbwd") or {}
+        print("[watch] GQA probe %s (ratio %s): mfu widened=%s native=%s "
+              "kv_bytes_saved=%s"
+              % (key, row.get("ratio"), w.get("mfu"), n.get("mfu"),
+                 row.get("kv_bytes_saved_fwdbwd")))
+PYEOF
       # tiered-memory probe row (docs/memory.md acceptance): optimizer
       # host-offload step time vs in-HBM + measured transfer-overlap
       # fraction, and the KV host-spill restore latency — parsed from the
