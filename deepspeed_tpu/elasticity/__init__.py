@@ -1,4 +1,6 @@
 from .elastic_agent import (PreemptionGuard, elastic_train_config,  # noqa: F401
-                            run_elastic)
-from .elasticity import (compute_elastic_config, ElasticityError,  # noqa: F401
+                            read_reshard_hint, run_elastic,
+                            write_reshard_hint)
+from .elasticity import (best_chips_at_most, compute_elastic_config,  # noqa: F401
+                         ElasticityError, ElasticityIncompatibleWorldSize,
                          get_compatible_chip_counts)

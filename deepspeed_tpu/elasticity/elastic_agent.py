@@ -6,22 +6,85 @@ checkpoint). On TPU there is no in-job rendezvous to subclass — scale changes
 arrive as a NEW set of hosts/chips (the resource manager restarts the job),
 so the agent's work is the RESUME protocol:
 
-1. at startup, read the elastic config and the current chip count;
-2. pick the (micro_batch, gas) the elastic math assigns to this scale —
-   the GLOBAL batch is invariant across restarts (``compute_elastic_config``);
-3. load the latest (universal) checkpoint onto the new topology.
+1. at startup, read the elastic config, the current chip count, and — when
+   the previous incarnation left one — the machine-readable **reshard hint**
+   (``reshard_hint.json``: why the job exited, at what step, and the batch
+   invariants to preserve);
+2. pick the (chips, micro_batch, gas) triple the elastic math assigns to the
+   available capacity — the GLOBAL batch is invariant across restarts
+   (``compute_elastic_config`` / ``best_chips_at_most``);
+3. rebuild the engine at the new topology (a device SUBSET when capacity
+   shrank) and restore from the latest **universal** checkpoint
+   (``runtime/checkpoint/universal.py`` — fragments reshard onto any mesh /
+   ZeRO stage / optimizer tier), falling back to a regular checkpoint when
+   the tag predates the elastic runtime.
 
 ``run_elastic`` packages those steps around ``deepspeed_tpu.initialize``.
+The in-job half — preemption signals, watchdog host-loss detection — is
+:class:`PreemptionGuard`, which with ``universal=True`` answers every exit
+cause with a durable universal save plus the reshard hint the next
+incarnation consumes. See docs/reliability.md "Elastic training & universal
+checkpoint"; the whole cycle is drilled by
+``deepspeed_tpu.testing.drill.elastic_drill``.
 """
 
 from __future__ import annotations
 
+import json
+import os
+import time
 from typing import Any, Dict, Optional, Tuple
 
 import jax
 
 from ..utils.logging import log_dist
-from .elasticity import compute_elastic_config
+from .elasticity import best_chips_at_most, compute_elastic_config
+
+RESHARD_HINT_NAME = "reshard_hint.json"
+
+
+# --------------------------------------------------------------------------- #
+# reshard hint — the machine-readable handoff between incarnations
+# --------------------------------------------------------------------------- #
+def write_reshard_hint(save_dir: str, hint: Dict[str, Any]) -> str:
+    """Durably publish ``reshard_hint.json`` next to the checkpoint tags
+    (write-tmp + fsync + atomic rename, like the ``latest`` pointer)."""
+    os.makedirs(save_dir, exist_ok=True)
+    path = os.path.join(save_dir, RESHARD_HINT_NAME)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(hint, f, indent=2, default=str)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return path
+
+
+def read_reshard_hint(save_dir: str) -> Optional[Dict[str, Any]]:
+    path = os.path.join(save_dir, RESHARD_HINT_NAME)
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _hint_from_engine(engine, reason: str, tag: Optional[str],
+                      signum: Optional[int] = None) -> Dict[str, Any]:
+    return {
+        "reason": reason,
+        "signum": signum,
+        "step": int(engine.global_steps),
+        "tag": tag,
+        "global_batch": int(engine.train_batch_size()),
+        "micro_batch": int(engine.train_micro_batch_size_per_gpu()),
+        "gas": int(engine.gradient_accumulation_steps()),
+        "chips": int(engine.mesh_mgr.world_size),
+        "mesh": {k: int(v) for k, v in engine.mesh_mgr.mesh.shape.items()},
+        "zero_stage": int(engine.config.zero_config.stage),
+        "elasticity": dict(engine.config.elasticity or {}),
+        "time": time.time(),
+    }
 
 
 def elastic_train_config(base_config: Dict[str, Any],
@@ -46,23 +109,88 @@ def elastic_train_config(base_config: Dict[str, Any],
 
 def run_elastic(model_spec, base_config: Dict[str, Any],
                 checkpoint_dir: Optional[str] = None,
-                n_chips: Optional[int] = None, **init_kw) -> Tuple[Any, ...]:
+                n_chips: Optional[int] = None, devices=None,
+                **init_kw) -> Tuple[Any, ...]:
     """Bring up an engine at the current scale and resume state if a
-    checkpoint exists (reference: elastic agent restart path)."""
+    checkpoint exists (reference: elastic agent restart path).
+
+    With an ``elasticity`` block in ``base_config``, the (chips, micro, gas)
+    triple comes from the elastic math for the AVAILABLE capacity — and when
+    the previous incarnation left a reshard hint under ``checkpoint_dir``,
+    the chosen scale is validated against it (the global batch must be the
+    one the trajectory was trained at). Universal checkpoint tags restore
+    through ``engine.load_universal_checkpoint`` (reshard onto the new
+    topology, dataloader/RNG fast-forward); legacy tags through the regular
+    loader."""
     import deepspeed_tpu as dst
 
-    config = elastic_train_config(base_config, n_chips)
-    engine, opt, loader, sched = dst.initialize(model=model_spec,
-                                                config=config, **init_kw)
+    devices = list(devices) if devices is not None else list(jax.devices())
+    available = len(devices) if n_chips is None else int(n_chips)
+    hint = read_reshard_hint(checkpoint_dir) if checkpoint_dir else None
+    ec = base_config.get("elasticity", {})
+    chips = available
+    if ec.get("enabled"):
+        # the available capacity may not be a compatible scale — come back
+        # at the largest compatible chip count that fits (reference
+        # _invoke_run restart-at-new-world-size semantics)
+        chips = best_chips_at_most(ec, available)
+        if chips != available:
+            log_dist(f"elastic: {available} chip(s) available but {chips} is "
+                     f"the largest compatible scale — running at {chips}")
+    config = elastic_train_config(base_config, chips)
+    if hint is not None and ec.get("enabled"):
+        gb = int(hint.get("global_batch", 0) or 0)
+        mb = int(config.get("train_micro_batch_size_per_gpu", 0) or 0)
+        gas = int(config.get("gradient_accumulation_steps", 1) or 1)
+        if gb and mb * gas * chips != gb:
+            raise RuntimeError(
+                f"elastic resume would change the global batch: hint says "
+                f"{gb}, the new topology gives {mb}*{gas}*{chips}="
+                f"{mb * gas * chips} — the elasticity block no longer "
+                f"matches the checkpointed run")
+    sub = devices[:chips]
+    engine, opt, loader, sched = dst.initialize(
+        model=model_spec, config=config,
+        devices=None if sub == list(jax.devices()) else sub, **init_kw)
     if checkpoint_dir is not None:
-        try:
-            path, _ = engine.load_checkpoint(checkpoint_dir)
-            if path:
-                log_dist(f"elastic resume from {path} at step "
+        resumed = _resume(engine, checkpoint_dir)
+        if resumed and hint is not None:
+            old_mesh = hint.get("mesh") or {}
+            new_mesh = {k: int(v) for k, v in engine.mesh_mgr.mesh.shape.items()}
+            if old_mesh != new_mesh or \
+                    int(hint.get("zero_stage", -1)) != \
+                    int(engine.config.zero_config.stage):
+                tel = getattr(engine, "telemetry", None)
+                if tel is not None and hasattr(tel, "reliability_event"):
+                    tel.reliability_event("elastic/reshards", 1.0,
+                                          int(engine.global_steps))
+                log_dist(f"elastic: resharded {old_mesh} (stage "
+                         f"{hint.get('zero_stage')}) → {new_mesh} (stage "
+                         f"{engine.config.zero_config.stage}) at step "
                          f"{engine.global_steps}")
-        except FileNotFoundError:
-            log_dist("elastic: no checkpoint yet — fresh start")
     return engine, opt, loader, sched
+
+
+def _resume(engine, checkpoint_dir: str) -> bool:
+    """Restore from the newest tag under ``checkpoint_dir`` — universal
+    (fragment) tags via the elastic loader, legacy tags via the regular
+    one. Returns True when a checkpoint was loaded."""
+    from ..runtime.checkpoint.saver import resolve_tag
+    from ..runtime.checkpoint.universal import is_universal_tag
+
+    try:
+        tag = resolve_tag(checkpoint_dir, None)
+    except FileNotFoundError:
+        log_dist("elastic: no checkpoint yet — fresh start")
+        return False
+    if is_universal_tag(os.path.join(checkpoint_dir, tag)):
+        path, _ = engine.load_universal_checkpoint(checkpoint_dir, tag=tag)
+    else:
+        path, _ = engine.load_checkpoint(checkpoint_dir, tag=tag)
+    if path:
+        log_dist(f"elastic resume from {path} at step {engine.global_steps}")
+        return True
+    return False
 
 
 # --------------------------------------------------------------------------- #
@@ -83,9 +211,16 @@ class PreemptionGuard:
     incarnation resumes at its (possibly different) scale via
     :func:`run_elastic`.
 
+    ``universal=True`` makes the exit ELASTIC: the boundary save is a
+    topology-free universal checkpoint (``engine.save_universal_checkpoint``)
+    and a machine-readable ``reshard_hint.json`` lands beside it — the
+    restart can come back at ANY compatible chip count. The same protocol
+    answers watchdog ``on_violation: exit`` requests and heartbeat host-loss
+    detection (``runtime/watchdog.py HostHeartbeat``).
+
     Usage::
 
-        guard = PreemptionGuard(save_dir="ckpts")
+        guard = PreemptionGuard(save_dir="ckpts", universal=True)
         engine, *_ = run_elastic(spec, config, checkpoint_dir="ckpts")
         for batch in loader:
             engine.train_batch(batch)
@@ -95,11 +230,12 @@ class PreemptionGuard:
 
     def __init__(self, save_dir: str, *, signals: Tuple[int, ...] = None,
                  tag: Optional[str] = None, coordinate_interval: int = 1,
-                 watchdog=None):
+                 watchdog=None, universal: bool = False):
         import signal as _signal
 
         self.save_dir = save_dir
         self.tag = tag
+        self.universal = bool(universal)
         # multi-host flag agreement runs every Nth boundary (all ranks share
         # the same counter so they agree on WHICH boundaries coordinate);
         # raise it to amortize the per-step allgather on big pods — the
@@ -145,7 +281,8 @@ class PreemptionGuard:
     def trigger(self, signum: Optional[int] = None) -> None:
         """Deliver a SYNTHETIC preemption (no OS signal, no handler
         chaining) — the entry point `deepspeed_tpu.testing.faults.preempt`
-        uses to exercise the checkpoint-on-SIGTERM path deterministically."""
+        uses to exercise the checkpoint-on-SIGTERM path deterministically;
+        the watchdog's host-loss handler calls it too."""
         self._triggered = True
         self._signum = signum
         log_dist(f"PreemptionGuard: synthetic preemption"
@@ -190,12 +327,24 @@ class PreemptionGuard:
             return False
         self._triggered = False  # once per trigger — never re-save the
         # checkpoint on later calls inside the preemption grace window
+        wd_reason = getattr(self.watchdog, "restart_reason", None) \
+            if wd_exit else None
         if wd_exit:
             self.watchdog.restart_requested = False
         self._reliability(engine, "preemption_signal")
-        path = engine.save_checkpoint(self.save_dir, tag=self.tag)
+        reason = wd_reason or ("watchdog exit request" if wd_exit else
+                               "preemption")
+        if self.universal:
+            path = engine.save_universal_checkpoint(self.save_dir,
+                                                    tag=self.tag,
+                                                    reason=reason)
+            write_reshard_hint(self.save_dir, _hint_from_engine(
+                engine, reason, tag=os.path.basename(path),
+                signum=self._signum))
+        else:
+            path = engine.save_checkpoint(self.save_dir, tag=self.tag)
         self._reliability(engine, "preemption_checkpoint")
-        cause = "watchdog exit request" if wd_exit else \
+        cause = reason if wd_exit else \
             f"signal {self._signum or 'on a peer host'}"
         log_dist(f"PreemptionGuard: checkpoint saved to {path} after "
                  f"{cause}; exit for elastic restart")

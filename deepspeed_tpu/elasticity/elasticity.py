@@ -58,14 +58,45 @@ def _valid_chip_counts(batch: int, micro_batches: Sequence[int],
 def get_compatible_chip_counts(micro_batches: Sequence[int], max_batch: int,
                                min_chips: int = 1, max_chips: int = 1024,
                                prefer_larger: bool = True) -> Dict[int, List[Tuple[int, int, int]]]:
-    """batch size → feasible (chips, micro_batch, gas) list."""
+    """batch size → feasible (chips, micro_batch, gas) list.
+
+    Raises :class:`ElasticityError` naming the infeasible inputs instead of
+    returning an empty dict (``max_batch`` below the smallest micro-batch —
+    or chip bounds that admit no split — previously produced ``{}`` with no
+    diagnostic and the caller crashed later on an empty table)."""
+    candidates = _candidate_batch_sizes(micro_batches, max_batch)
+    if not candidates:
+        raise ElasticityError(
+            f"no attainable global batch size: max_train_batch_size="
+            f"{max_batch} is below the smallest micro-batch candidate "
+            f"{min(micro_batches) if micro_batches else '<empty>'} "
+            f"(micro_batch_sizes={list(micro_batches)})")
     result = {}
-    for b in _candidate_batch_sizes(micro_batches, max_batch):
+    for b in candidates:
         triples = _valid_chip_counts(b, micro_batches, min_chips, max_chips,
                                      prefer_larger)
         if triples:
             result[b] = triples
+    if not result:
+        raise ElasticityError(
+            f"no feasible (chips, micro_batch, gas) split: "
+            f"micro_batch_sizes={list(micro_batches)}, "
+            f"max_train_batch_size={max_batch}, chip bounds "
+            f"[{min_chips}, {max_chips}]")
     return result
+
+
+def best_chips_at_most(elastic_config: Dict, available: int) -> int:
+    """Largest compatible chip count not exceeding ``available`` — the scale
+    an elastic restart should come back at after capacity loss (global batch
+    invariant; reshard-hint consumption in ``elastic_agent.run_elastic``)."""
+    _, cfg = compute_elastic_config(elastic_config)
+    usable = [c for c in cfg.compatible_chip_counts if c <= int(available)]
+    if not usable:
+        raise ElasticityIncompatibleWorldSize(
+            f"no compatible chip count fits the {available} available "
+            f"chip(s); compatible counts: {cfg.compatible_chip_counts}")
+    return max(usable)
 
 
 @dataclasses.dataclass

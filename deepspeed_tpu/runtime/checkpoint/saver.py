@@ -299,6 +299,15 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
             tag = alt
             path = os.path.abspath(os.path.join(load_dir, tag))
 
+    from .universal import is_universal_tag
+
+    if is_universal_tag(path):
+        # the resolved tag is an elastic (fragment-layout) checkpoint —
+        # route to the universal loader (reshards onto this topology)
+        from .universal import load_universal_checkpoint
+
+        return load_universal_checkpoint(engine, load_dir, tag=tag)
+
     if load_universal is None:
         load_universal = engine.config.checkpoint.load_universal
     if load_universal:

@@ -14,6 +14,33 @@ re-serialization into the explicit universal layout:
 
 Any (mesh, ZeRO stage, TP/PP/SP degree) can load these fragments — placement
 onto the current topology is a ``jax.device_put`` with the current shardings.
+
+**Universal checkpoint v2** (the elastic training runtime;
+``docs/reliability.md`` "Elastic training & universal checkpoint"):
+:func:`save_universal_checkpoint` / :func:`load_universal_checkpoint` are the
+ENGINE-level entry points that make "train at N chips, resume at M chips with
+a different mesh/ZeRO layout, continue the exact trajectory" a tested
+guarantee. They ride PR 3's two-phase commit — staged ``<tag>.tmp.stage`` dir
++ fsync of every fragment file and parent dir + per-fragment SHA-256 (in both
+``meta.json`` and a standard ``manifest.json``) + multihost barrier before
+the atomic publish + ``latest`` advance — and the fragment set grows
+everything a resume actually needs:
+
+- step/token counters, skipped steps, loss-scaler state, LR-scheduler state;
+- the base RNG seed, from which per-host streams are RE-DERIVED
+  deterministically for the NEW topology (:func:`derive_host_rng`);
+- LoCo error-feedback residuals (stored topology-free as the per-leaf SUM
+  over the device dim, redistributed across the new DP world on load);
+- the GAS phase (a mid-window save records it; resume restarts the window);
+- a checkpointable dataloader cursor so data order fast-forwards exactly.
+
+Loading reshards onto any (mesh shape, ZeRO stage, hpZ partition, host/NVMe
+optimizer tier): placement goes through the current engine's shardings
+(``Partitioner`` specs) and the ``memory/`` tier (HostBuffer leaves rebuilt
+in place; NVMe masters/moments streamed back into the swap files), never
+materializing more than O(largest shard) per host. Verified loads walk back
+to the newest verifiable universal tag; ``checkpoint.io_retries`` backoff
+applies to both directions.
 """
 
 from __future__ import annotations
@@ -32,6 +59,7 @@ from ...utils.logging import log_dist, logger
 from ...utils.tree import path_to_str
 
 UNIVERSAL_DIR = "universal"
+UNIVERSAL_FORMAT = "universal2"
 
 
 def _path_str(path) -> str:
@@ -63,6 +91,8 @@ def _dump_leaf(leaf, fn: str) -> None:
     target = np.float32 if is_float else np.dtype(str(dtype))
     shape = tuple(leaf.shape) if hasattr(leaf, "shape") else np.shape(leaf)
     if not hasattr(leaf, "addressable_shards"):
+        # numpy / scalar / HostBuffer (tiered host residency) leaves land
+        # whole — they are host-resident already
         np.save(fn, np.asarray(leaf).astype(target))
         return
     if jax.process_index() == 0:
@@ -90,17 +120,43 @@ def _dump_leaf(leaf, fn: str) -> None:
 
 
 def _dump_tree(tree: Any, root: str) -> Dict[str, Dict]:
+    from .manifest import _fsync_path, _sha256
+
     index: Dict[str, Dict] = {}
     flat = jax.tree_util.tree_flatten_with_path(tree)[0]
     for path, leaf in flat:
         name = _safe(_path_str(path))
         d = os.path.join(root, name)
         os.makedirs(d, exist_ok=True)
-        _dump_leaf(leaf, os.path.join(d, "fp32.npy"))
+        fn = os.path.join(d, "fp32.npy")
+        _dump_leaf(leaf, fn)
+        # durability + integrity: fsync the fragment file and its dir entry,
+        # and record the per-fragment SHA-256 so verified loads can tell a
+        # complete fragment from a torn one (previously there was neither —
+        # a crash after the rename could still publish un-synced bytes)
+        _fsync_path(fn)
+        _fsync_path(d)
         index[name] = {"shape": list(np.shape(leaf)),
                        "dtype": str(getattr(leaf, "dtype",
-                                            np.asarray(leaf).dtype))}
+                                            np.asarray(leaf).dtype)),
+                       "sha256": _sha256(fn),
+                       "bytes": os.path.getsize(fn)}
+    if flat:
+        _fsync_path(root)
     return index
+
+
+class _FragmentWriter:
+    """The object whose ``save`` writes a fragment tree to disk — a seam the
+    fault harness can patch (``faults.crash_after_save(FRAGMENT_WRITER)``
+    models process death between the fragment write and the seal/publish,
+    ``faults.io_errors`` exercises ``checkpoint.io_retries``)."""
+
+    def save(self, tree: Any, root: str) -> Dict[str, Dict]:
+        return _dump_tree(tree, root)
+
+
+FRAGMENT_WRITER = _FragmentWriter()
 
 
 def _load_tree_like(template: Any, root: str, *, place: bool = True) -> Any:
@@ -119,9 +175,11 @@ def _load_tree_like(template: Any, root: str, *, place: bool = True) -> Any:
         if arr.shape != tuple(getattr(leaf, "shape", arr.shape)):
             raise ValueError(f"fragment {name}: shape {arr.shape} != "
                              f"expected {leaf.shape}")
-        if place and hasattr(leaf, "sharding"):
+        sharding = getattr(leaf, "sharding", None)
+        if place and sharding is not None and \
+                hasattr(sharding, "addressable_devices"):
             leaves.append(jax.make_array_from_callback(
-                arr.shape, leaf.sharding,
+                arr.shape, sharding,
                 # astype always copies -> contiguous; np.asarray (NOT
                 # ascontiguousarray) keeps 0-d scalars 0-d
                 lambda idx, a=arr, dt=dtype: np.asarray(a[idx]).astype(dt)))
@@ -130,14 +188,32 @@ def _load_tree_like(template: Any, root: str, *, place: bool = True) -> Any:
     return jax.tree.unflatten(treedef, leaves)
 
 
+def derive_host_rng(seed: int, step: int, process_index: int,
+                    process_count: int) -> jax.Array:
+    """Re-derive this host's RNG stream for the CURRENT topology: a pure
+    function of (base seed, resume step, host index, host count), so a
+    restart at ANY scale gets per-host streams that are deterministic,
+    distinct per host, and independent of the topology the checkpoint was
+    written on (the reference re-seeds torch generators per rank on elastic
+    restart; here the fold-in chain is the whole story)."""
+    key = jax.random.PRNGKey(int(seed))
+    for v in (int(step), int(process_count), int(process_index)):
+        key = jax.random.fold_in(key, v)
+    return key
+
+
 def save_universal(state, out_dir: str, *, meta: Optional[Dict] = None,
                    subdir: bool = True) -> str:
     """Write a TrainState (or any {'params':..., 'opt_state':...} mapping) as a
     universal checkpoint. Atomic: writes to a temp dir then renames.
 
     Multi-process (shared FS): rank 0 owns the tmp-dir lifecycle and the
-    final rename; every rank writes its addressable shards and drops a
-    ``.done`` marker; rank 0 renames only after all markers arrive."""
+    final rename; every rank writes its addressable shards, fsyncs them, and
+    drops a ``.done`` marker; rank 0 renames only after all markers arrive
+    AND a multihost barrier confirms every rank left the write phase (the
+    ``.done`` file alone races a peer's in-flight fsync — a torn dir could
+    otherwise publish). A failure on any rank GCs the staging dir instead of
+    stranding it forever."""
     params = state.params if hasattr(state, "params") else state["params"]
     opt_state = state.opt_state if hasattr(state, "opt_state") else state.get("opt_state")
     out_dir = os.path.normpath(out_dir)  # trailing '/' would nest tmp in final
@@ -155,23 +231,47 @@ def save_universal(state, out_dir: str, *, meta: Optional[Dict] = None,
         os.makedirs(tmp, exist_ok=True)
     else:
         _wait_for(tmp)
-    index = {"param": _dump_tree(params, os.path.join(tmp, "param"))}
-    if opt_state is not None:
-        index["optim"] = _dump_tree(opt_state, os.path.join(tmp, "optim"))
-    with open(os.path.join(tmp, f".rank{rank}.done"), "w") as f:
-        f.write("ok")
-    if rank != 0:
-        _wait_for(final)  # rank 0 renames once everyone is done
-        return final
-    for r in range(1, nproc):
-        _wait_for(os.path.join(tmp, f".rank{r}.done"))
-    info = dict(meta or {})
-    info["index"] = index
-    with open(os.path.join(tmp, "meta.json"), "w") as f:
-        json.dump(info, f, indent=2, default=str)
-    if os.path.exists(final):
-        shutil.rmtree(final)
-    os.replace(tmp, final)
+    try:
+        index = {"param": FRAGMENT_WRITER.save(params,
+                                               os.path.join(tmp, "param"))}
+        if opt_state is not None:
+            index["optim"] = FRAGMENT_WRITER.save(opt_state,
+                                                  os.path.join(tmp, "optim"))
+        with open(os.path.join(tmp, f".rank{rank}.done"), "w") as f:
+            f.write("ok")
+        if rank != 0:
+            from .manifest import multihost_barrier
+
+            multihost_barrier(f"universal_seal:{os.path.basename(final)}")
+            _wait_for(final)  # rank 0 renames once everyone is done
+            return final
+        for r in range(1, nproc):
+            _wait_for(os.path.join(tmp, f".rank{r}.done"))
+        from .manifest import _fsync_path, multihost_barrier
+
+        # all ranks must have LEFT the write phase (not just dropped their
+        # marker) before the dir is sealed and renamed
+        multihost_barrier(f"universal_seal:{os.path.basename(final)}")
+        info = dict(meta or {})
+        info["format"] = UNIVERSAL_FORMAT
+        info["index"] = index
+        mp = os.path.join(tmp, "meta.json")
+        with open(mp, "w") as f:
+            json.dump(info, f, indent=2, default=str)
+            f.flush()
+            os.fsync(f.fileno())
+        if os.path.exists(final):
+            shutil.rmtree(final)
+        os.replace(tmp, final)
+        _fsync_path(os.path.dirname(final))
+    except Exception:
+        # stage-dir GC: a straggler-rank timeout / I/O error must not strand
+        # the .tmp dir forever (process death — SimulatedCrash, a
+        # BaseException — can't run this, and the stage stays invisible to
+        # loads either way)
+        if rank == 0:
+            shutil.rmtree(tmp, ignore_errors=True)
+        raise
     log_dist(f"wrote universal checkpoint {final} "
              f"({len(index['param'])} params)")
     return final
@@ -185,6 +285,7 @@ def load_universal(universal_dir: str, params_template: Any,
     fragments are cast and device_put accordingly."""
     root = universal_dir
     if os.path.basename(root) != UNIVERSAL_DIR and \
+            not os.path.isdir(os.path.join(root, "param")) and \
             os.path.isdir(os.path.join(root, UNIVERSAL_DIR)):
         root = os.path.join(root, UNIVERSAL_DIR)
     params = _load_tree_like(params_template, os.path.join(root, "param"),
@@ -199,6 +300,380 @@ def load_universal(universal_dir: str, params_template: Any,
         with open(mp) as f:
             meta = json.load(f)
     return params, opt_state, meta
+
+
+# --------------------------------------------------------------------------- #
+# universal checkpoint v2 — engine-level elastic save/load
+# --------------------------------------------------------------------------- #
+def is_universal_tag(tag_dir: str) -> bool:
+    """A tag dir written by :func:`save_universal_checkpoint` (fragment
+    layout), as opposed to a regular engine checkpoint (``state/`` dir)."""
+    return os.path.isdir(os.path.join(tag_dir, "param"))
+
+
+def _reliability(engine, name: str, value: float = 1.0) -> None:
+    tel = getattr(engine, "telemetry", None)
+    if tel is not None and hasattr(tel, "reliability_event"):
+        tel.reliability_event(name, value,
+                              int(getattr(engine, "global_steps", 0)))
+
+
+def _nvme_state_trees(engine):
+    """(fp32 master params tree, AdamState-shaped opt tree) materialized from
+    the NVMe swap files — the SAME fragment layout a non-NVMe adamw engine
+    writes, so universal checkpoints convert freely between tiers."""
+    from ...ops.optimizers import AdamState
+
+    ps, ms, vs = engine._nvme_opt.state_leaves()
+    unflat = lambda ls: jax.tree_util.tree_unflatten(  # noqa: E731
+        engine._nvme_treedef, [np.asarray(l, np.float32) for l in ls])
+    opt = AdamState(np.asarray(engine._nvme_opt.step_count, np.int32),
+                    unflat(ms), unflat(vs))
+    return unflat(ps), opt
+
+
+def _engine_universal_trees(engine):
+    """(params, opt_state) as dumped into fragments, normalized across the
+    optimizer tiers: fp32 masters for params, the optimizer's state pytree
+    for optim (HostBuffer leaves under ``optimizer_tier=host`` dump their
+    host-resident numpy directly)."""
+    if getattr(engine, "_nvme_opt", None) is not None:
+        return _nvme_state_trees(engine)
+    return engine.state.params, engine.state.opt_state
+
+
+def save_universal_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
+                              client_state: Optional[Dict] = None,
+                              reason: Optional[str] = None) -> str:
+    """Elastic (topology-free) engine checkpoint, two-phase-committed.
+
+    Protocol (shared with ``saver.py``; primitives in ``manifest.py``):
+    stage fragments into ``<tag>.tmp.stage`` (fsync per fragment + dirs, GC
+    on failure) → multihost barrier → seal (``manifest.json`` over the full
+    dir) → atomic publish → advance ``latest``. ``checkpoint.io_retries``
+    backoff wraps the whole write."""
+    from .manifest import (fsync_tree, multihost_barrier, publish_dir,
+                           with_io_retries, write_latest, write_manifest)
+
+    cfg = engine.config.checkpoint
+    tag = tag or f"universal_step{engine.global_steps}"
+    save_dir = os.path.abspath(save_dir)
+    os.makedirs(save_dir, exist_ok=True)
+    final = os.path.join(save_dir, tag)
+    stage = os.path.join(save_dir, f"{tag}.tmp.stage")
+    rank0 = jax.process_index() == 0
+    multihost = jax.process_count() > 1
+
+    state = engine.state
+    params, opt_state = _engine_universal_trees(engine)
+    meta: Dict[str, Any] = {
+        "format": UNIVERSAL_FORMAT,
+        "global_steps": int(engine.global_steps),
+        "micro_steps": int(engine.micro_steps),
+        "global_tokens": int(getattr(engine, "global_tokens", 0)),
+        "skipped_steps": int(np.asarray(state.skipped_steps)),
+        "seed": int(engine.config.seed),
+        "loss_scale": [float(np.asarray(l))
+                       for l in jax.tree.leaves(state.loss_scale)],
+        "lr_scheduler": engine.lr_scheduler.state_dict(),
+        # a mid-GAS-window save records the phase; the partial window's
+        # staged device grads are NOT portable across topologies, so resume
+        # restarts the window (documented in docs/reliability.md)
+        "gas_phase": {"pending_micros": int(getattr(engine, "_pending_count",
+                                                    0) or 0)},
+        "topology": {
+            "mesh": {k: int(v) for k, v in engine.mesh_mgr.mesh.shape.items()},
+            "processes": int(jax.process_count()),
+            "zero_stage": int(engine.config.zero_config.stage),
+            "hpz": int(engine.config.zero_config.zero_hpz_partition_size),
+            "optimizer_tier": (
+                "nvme" if getattr(engine, "_nvme_opt", None) is not None
+                else "host" if getattr(engine, "_tiered_opt", False)
+                else "none"),
+        },
+        "batch": {"global": int(engine.train_batch_size()),
+                  "micro": int(engine.train_micro_batch_size_per_gpu()),
+                  "gas": int(engine.gradient_accumulation_steps())},
+        "client_state": client_state or {},
+        "config": engine.config.raw,
+        "reason": reason,
+    }
+    loader = getattr(engine, "training_dataloader", None)
+    if loader is not None and hasattr(loader, "state_dict"):
+        meta["dataloader"] = loader.state_dict()
+    # LoCo residuals: topology-free as the per-leaf SUM over the device dim
+    # (the total un-applied quantization error); load redistributes it
+    # uniformly over the new DP world
+    loco = tuple(getattr(state, "loco_residual", ()) or ())
+    loco_tree = {f"r{i}": jnp.sum(r, axis=0) for i, r in enumerate(loco)}
+
+    def _write():
+        if rank0:
+            if os.path.isdir(stage):
+                shutil.rmtree(stage)  # stale stage from a crashed earlier save
+            os.makedirs(stage, exist_ok=True)
+        else:
+            _wait_for(stage)
+        if multihost:
+            multihost_barrier(f"universal_stage:{tag}")
+        try:
+            index = {"param": FRAGMENT_WRITER.save(
+                params, os.path.join(stage, "param"))}
+            if opt_state is not None and jax.tree.leaves(opt_state):
+                index["optim"] = FRAGMENT_WRITER.save(
+                    opt_state, os.path.join(stage, "optim"))
+            if loco_tree:
+                index["loco"] = FRAGMENT_WRITER.save(
+                    loco_tree, os.path.join(stage, "loco"))
+                meta["loco_leaves"] = len(loco)
+            with open(os.path.join(stage, f".rank{jax.process_index()}.done"),
+                      "w") as f:
+                f.write("ok")
+            if multihost:
+                # every rank must have LEFT the write phase before rank 0
+                # seals + renames (a .done marker alone races in-flight I/O)
+                multihost_barrier(f"universal_seal:{tag}")
+            if not rank0:
+                _wait_for(final)
+                return final
+            for r in range(1, jax.process_count()):
+                _wait_for(os.path.join(stage, f".rank{r}.done"))
+            for name in os.listdir(stage):  # markers never publish
+                if name.startswith(".rank") and name.endswith(".done"):
+                    os.unlink(os.path.join(stage, name))
+            meta["index"] = index
+            mp = os.path.join(stage, "meta.json")
+            with open(mp, "w") as f:
+                json.dump(meta, f, indent=2, default=str)
+                f.flush()
+                os.fsync(f.fileno())
+            fsync_tree(stage)
+            write_manifest(stage)
+            publish_dir(stage, final)
+            write_latest(save_dir, tag)
+        except Exception:
+            # stage-dir GC on failure (Exception only: a SimulatedCrash /
+            # real process death leaves the stage, which is invisible to
+            # loads and reclaimed by the next save of this tag)
+            if rank0:
+                shutil.rmtree(stage, ignore_errors=True)
+            raise
+        return final
+
+    retries = int(getattr(cfg, "io_retries", 0) or 0)
+    with_io_retries(
+        _write, retries=retries,
+        backoff_s=float(getattr(cfg, "io_backoff_s", 0.5)),
+        what=f"universal checkpoint save '{tag}'",
+        on_retry=lambda n, e: _reliability(engine, "checkpoint_io_retry"))
+    _reliability(engine, "elastic/saves")
+    log_dist(f"saved UNIVERSAL checkpoint {final} (step "
+             f"{engine.global_steps}, reason={reason or 'scheduled'})")
+    return final
+
+
+def _newest_universal_tag(load_dir: str, exclude=()) -> Optional[str]:
+    """Walk-back target among UNIVERSAL tags: newest tag dir that has the
+    fragment layout and passes manifest verification."""
+    from .manifest import tag_candidates, verify_manifest
+
+    excluded = set(exclude)
+    for name in tag_candidates(load_dir):
+        if name in excluded:
+            continue
+        full = os.path.join(load_dir, name)
+        if not is_universal_tag(full):
+            continue
+        status, detail = verify_manifest(full)
+        if status == "corrupt":
+            logger.warning(f"walk-back: skipping corrupt universal "
+                           f"checkpoint '{name}' ({detail})")
+            continue
+        return name
+    return None
+
+
+def _restore_opt_state(engine, path: str, meta: Dict) -> Any:
+    """Load the optim fragments onto the engine's CURRENT optimizer tier."""
+    from ...memory.placement import HostBuffer
+
+    optim_root = os.path.join(path, "optim")
+    if not os.path.isdir(optim_root):
+        return None
+    if getattr(engine, "_nvme_opt", None) is not None:
+        # stream masters + moments back into the NVMe swap files; the
+        # template is the ABSTRACT adamw state (fragment names match any
+        # adamw engine's opt_state layout)
+        tpl_params = jax.tree.map(
+            lambda l: jax.ShapeDtypeStruct(l.shape, jnp.float32),
+            engine.state.params)
+        opt_tpl = jax.eval_shape(engine.optimizer.init, tpl_params)
+        opt_np = _load_tree_like(opt_tpl, optim_root, place=False)
+        ps = _load_tree_like(tpl_params, os.path.join(path, "param"),
+                             place=False)
+        ps_leaves = jax.tree.leaves(ps)
+        ms_leaves = jax.tree.leaves(opt_np.mu)
+        vs_leaves = jax.tree.leaves(opt_np.nu)
+        engine._nvme_opt.load_state_leaves(
+            ps_leaves, ms_leaves, vs_leaves,
+            step=int(np.asarray(opt_np.step)))
+        return ()  # the engine's in-TrainState opt slot stays empty
+    template = engine.state.opt_state
+    if getattr(engine, "_tiered_opt", False):
+        # host tier: rebuild the HostBuffer leaves in place (numpy residency
+        # + the template's exact restore sharding) — no allocator traffic
+        flat_np = _load_tree_like(template, optim_root, place=False)
+
+        def rebuild(tpl, arr):
+            if isinstance(tpl, HostBuffer):
+                return HostBuffer(np.asarray(arr, tpl.dtype),
+                                  tpl.memory_kind, tpl.sharding)
+            return arr
+        return jax.tree.map(rebuild, template, flat_np,
+                            is_leaf=lambda x: isinstance(x, HostBuffer))
+    return _load_tree_like(template, optim_root, place=True)
+
+
+def _restore_loco(engine, path: str, meta: Dict):
+    """Redistribute the saved (summed) LoCo residuals over the new DP world;
+    drops them with a log when the leaf count no longer matches."""
+    current = tuple(getattr(engine.state, "loco_residual", ()) or ())
+    n_saved = int(meta.get("loco_leaves", 0) or 0)
+    if not n_saved:
+        return None
+    if len(current) != n_saved:
+        logger.warning(
+            f"universal checkpoint carries {n_saved} LoCo residual leaves "
+            f"but this engine has {len(current)} — residuals reset to zero "
+            f"(error feedback re-warms within a few steps)")
+        return None
+    loco_root = os.path.join(path, "loco")
+    tpl = {f"r{i}": jax.ShapeDtypeStruct(r.shape[1:], jnp.float32)
+           for i, r in enumerate(current)}
+    summed = _load_tree_like(tpl, loco_root, place=False)
+    out = []
+    for i, r in enumerate(current):
+        world = int(r.shape[0])
+        dist = np.broadcast_to(
+            np.asarray(summed[f"r{i}"], np.float32) / world, r.shape)
+        out.append(jax.device_put(dist, r.sharding))
+    return tuple(out)
+
+
+def load_universal_checkpoint(engine, load_dir: str,
+                              tag: Optional[str] = None):
+    """Restore an engine — at ANY topology — from a universal checkpoint tag.
+
+    Verified load with walk-back: a corrupt (or non-universal) ``latest`` tag
+    falls back to the newest verifiable universal tag instead of crashing.
+    Returns ``(path, client_state)`` like ``engine.load_checkpoint``."""
+    from .manifest import verify_manifest, with_io_retries
+    from .saver import jnp_step, resolve_tag
+
+    cfg = engine.config.checkpoint
+    explicit = tag is not None
+    try:
+        tag = resolve_tag(load_dir, tag)
+    except FileNotFoundError as e:
+        logger.warning(str(e))
+        return None, {}
+    path = os.path.abspath(os.path.join(load_dir, tag))
+    verify = bool(getattr(cfg, "verify_on_load", True))
+    problem = None
+    if not is_universal_tag(path):
+        problem = "not a universal (fragment) checkpoint"
+    elif verify:
+        status, detail = verify_manifest(path)
+        if status == "corrupt":
+            problem = detail
+    if problem is not None:
+        logger.warning(f"universal checkpoint '{tag}' unusable ({problem}) "
+                       f"— walking back to the newest verifiable universal "
+                       f"tag")
+        _reliability(engine, "checkpoint_rollback")
+        alt = _newest_universal_tag(load_dir, exclude={tag})
+        if alt is None:
+            if explicit:
+                raise RuntimeError(
+                    f"universal checkpoint '{tag}' under {load_dir} is "
+                    f"unusable ({problem}) and no verifiable universal "
+                    f"fallback exists")
+            logger.warning(f"no verifiable universal checkpoint under "
+                           f"{load_dir} — starting fresh")
+            return None, {}
+        log_dist(f"universal checkpoint rollback: '{tag}' → '{alt}'")
+        tag = alt
+        path = os.path.abspath(os.path.join(load_dir, tag))
+
+    with open(os.path.join(path, "meta.json")) as f:
+        meta = json.load(f)
+
+    retries = int(getattr(cfg, "io_retries", 0) or 0)
+    backoff = float(getattr(cfg, "io_backoff_s", 0.5))
+
+    def _read():
+        params = _load_tree_like(engine.state.params,
+                                 os.path.join(path, "param"), place=True)
+        opt_state = _restore_opt_state(engine, path, meta)
+        return params, opt_state
+
+    params, opt_state = with_io_retries(
+        _read, retries=retries, backoff_s=backoff,
+        what=f"universal checkpoint load '{tag}'",
+        on_retry=lambda n, e: _reliability(engine, "checkpoint_io_retry"))
+
+    rep = engine.mesh_mgr.replicated()
+    small = lambda x, d: jax.device_put(np.asarray(x, d), rep)  # noqa: E731
+    gstep = int(meta.get("global_steps", 0))
+    ls_vals = meta.get("loss_scale")
+    loss_scale = engine.state.loss_scale
+    if ls_vals is not None:
+        tpl_leaves = jax.tree.leaves(loss_scale)
+        if len(ls_vals) == len(tpl_leaves):
+            loss_scale = jax.tree.unflatten(
+                jax.tree.structure(loss_scale),
+                [small(v, np.asarray(t).dtype)
+                 for v, t in zip(ls_vals, tpl_leaves)])
+    loco = _restore_loco(engine, path, meta)
+    engine.state = engine.state._replace(
+        params=params,
+        opt_state=(opt_state if opt_state is not None
+                   else engine.state.opt_state),
+        step=jnp_step(engine, gstep),
+        skipped_steps=small(int(meta.get("skipped_steps", 0)),
+                            np.asarray(engine.state.skipped_steps).dtype),
+        loss_scale=loss_scale,
+        loco_residual=(loco if loco is not None
+                       else engine.state.loco_residual))
+    engine.global_steps = gstep
+    engine.micro_steps = int(meta.get("micro_steps", 0))
+    engine.global_tokens = int(meta.get("global_tokens", 0))
+    if "lr_scheduler" in meta:
+        engine.lr_scheduler.load_state_dict(meta["lr_scheduler"])
+    # GAS phase: a partial accumulation window cannot be restored across
+    # topologies — the window restarts (its micro grads recompute)
+    pending = int(meta.get("gas_phase", {}).get("pending_micros", 0) or 0)
+    if pending:
+        logger.warning(f"universal checkpoint was taken mid-GAS-window "
+                       f"({pending} staged micro(s)) — the window restarts "
+                       f"on resume")
+    engine._pending_grads = None
+    engine._pending_loss = None
+    engine._pending_count = 0
+    engine._staged_batches = []
+    # per-host RNG stream, RE-DERIVED for the new topology
+    engine.host_rng = derive_host_rng(
+        int(meta.get("seed", engine.config.seed)), gstep,
+        jax.process_index(), jax.process_count())
+    loader = getattr(engine, "training_dataloader", None)
+    if loader is not None and hasattr(loader, "load_state_dict") and \
+            meta.get("dataloader") is not None:
+        loader.load_state_dict(meta["dataloader"])
+    _reliability(engine, "elastic/resumes")
+    _reliability(engine, "checkpoint_loaded")
+    log_dist(f"loaded UNIVERSAL checkpoint {path} at step "
+             f"{engine.global_steps}")
+    return path, meta.get("client_state", {})
 
 
 def ds_to_universal(ckpt_dir: str, tag: Optional[str] = None,

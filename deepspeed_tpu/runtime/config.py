@@ -427,6 +427,17 @@ class WatchdogConfig(ConfigModel):
     # | exit (request a checkpoint-and-exit via PreemptionGuard.step_boundary)
     on_violation: str = "raise"
     restore_dir: Optional[str] = None
+    # ---- multi-host heartbeat (host-loss detection → elastic exit; see
+    # docs/reliability.md "Elastic training & universal checkpoint") ----
+    # run an allgather-based liveness round after optimizer steps
+    heartbeat: bool = False
+    # min seconds between liveness gathers (0 = every observed step)
+    heartbeat_interval_s: float = 0.0
+    # consecutive gathers a peer may miss / stall before it is declared dead
+    heartbeat_max_missed: int = 3
+    # wall-clock deadline on the liveness collective itself: a gather stuck
+    # past this records a hung-collective host loss (0 = off)
+    collective_deadline_s: float = 0.0
 
 
 @register_config_model

@@ -146,6 +146,9 @@ class DeepSpeedTPUEngine:
         self.global_steps = 0
         self.skipped_steps = 0
         self.micro_steps = 0
+        # host-side token counter (universal checkpoint v2 carries it so an
+        # elastic resume keeps the token budget accounting exact)
+        self.global_tokens = 0
         self._staged_batches: List[Any] = []
         self._staged_loss: Optional[jnp.ndarray] = None
         self.training_dataloader = None
@@ -1703,9 +1706,27 @@ class DeepSpeedTPUEngine:
 
         return jax.tree.map(lambda x: jax.device_put(x, spec_for(x)), batch)
 
+    @staticmethod
+    def _count_batch_tokens(batch) -> int:
+        """Host-side token estimate for one global batch: the size of the
+        ``tokens`` leaf when the batch carries one, the leading (sample) dim
+        of the first leaf otherwise. Shape math only — never touches device
+        data."""
+        try:
+            if isinstance(batch, dict) and "tokens" in batch:
+                return int(np.prod(np.shape(batch["tokens"])))
+            leaves = jax.tree.leaves(batch)
+            if leaves:
+                shape = np.shape(leaves[0])
+                return int(shape[0]) if shape else 1
+        except Exception:
+            pass
+        return 0
+
     def train_batch(self, batch) -> StepOutput:
         """One full optimizer step from one global batch (all GAS micro-batches
         stacked in the leading dim)."""
+        self.global_tokens += self._count_batch_tokens(batch)
         if self._nvme_opt is not None:
             return self._train_batch_nvme(batch)
         if self._tiered_opt:
@@ -1954,6 +1975,24 @@ class DeepSpeedTPUEngine:
 
         return _load(self, load_dir, tag=tag)
 
+    # --- universal checkpoint v2: elastic, topology-free save/load
+    # (runtime/checkpoint/universal.py; docs/reliability.md "Elastic
+    # training & universal checkpoint") ---
+    def save_universal_checkpoint(self, save_dir: str,
+                                  tag: Optional[str] = None,
+                                  client_state: Optional[dict] = None,
+                                  reason: Optional[str] = None) -> str:
+        from .checkpoint.universal import save_universal_checkpoint as _save
+
+        return _save(self, save_dir, tag=tag, client_state=client_state,
+                     reason=reason)
+
+    def load_universal_checkpoint(self, load_dir: str,
+                                  tag: Optional[str] = None):
+        from .checkpoint.universal import load_universal_checkpoint as _load
+
+        return _load(self, load_dir, tag=tag)
+
     # ------------------------------------------------------------------ #
     # state offload (reference runtime/engine.py:4533 offload_states)
     # ------------------------------------------------------------------ #
@@ -2006,9 +2045,13 @@ def initialize(args=None, model: Optional[ModelSpec] = None, optimizer=None,
                model_parameters=None, training_data=None, lr_scheduler=None,
                config=None, config_params=None, mesh_mgr: Optional[MeshManager] = None,
                rng: Optional[jax.Array] = None, dist_init_required: bool = True,
-               **kwargs):
+               devices=None, **kwargs):
     """Returns ``(engine, optimizer, training_dataloader, lr_scheduler)`` —
-    the reference's 4-tuple."""
+    the reference's 4-tuple.
+
+    ``devices``: build the mesh over this device subset instead of every
+    visible device — the elastic runtime (``elasticity/run_elastic``) uses
+    it to bring an engine up at a REDUCED chip count after capacity loss."""
     if config is None:
         config = config_params
     if config is None and args is not None:
@@ -2032,7 +2075,9 @@ def initialize(args=None, model: Optional[ModelSpec] = None, optimizer=None,
     if dist_init_required:
         dist.init_distributed()
 
-    n_devices = len(jax.devices())
+    devices = list(devices) if devices is not None else None
+    n_devices = len(devices) if devices is not None else \
+        (mesh_mgr.world_size if mesh_mgr is not None else len(jax.devices()))
     # resolve mesh first so batch math can use the true dp size
     pre = parse_config(config, world_size=n_devices, resolve_batch=False)
     if hf_model is not None:
@@ -2065,7 +2110,7 @@ def initialize(args=None, model: Optional[ModelSpec] = None, optimizer=None,
         axis_sizes["zero_shard"] = mics
         axis_sizes["data"] = data // mics
     if mesh_mgr is None:
-        mesh_mgr = init_mesh(axis_sizes)
+        mesh_mgr = init_mesh(axis_sizes, devices)
         if mics > 1 and int(axis_sizes.get("data", 1)) > 1 \
                 and not mesh_mgr.dcn_axes:
             # the zero_shard carve models a 2-level topology: 'zero_shard'

@@ -29,11 +29,15 @@ Forcing these paths in tests: ``deepspeed_tpu.testing.faults``.
 
 from __future__ import annotations
 
+import contextlib
 import math
 import statistics
+import threading
 import time
 from collections import deque
-from typing import Optional
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
 
 from ..utils.logging import log_dist, logger
 
@@ -46,20 +50,167 @@ class WatchdogViolation(RuntimeError):
         self.kind = kind
 
 
+class HostHeartbeat:
+    """Multi-host liveness: convert a dead peer or hung collective into a
+    clean elastic exit instead of an indefinite hang (the elastic training
+    runtime — docs/reliability.md "Elastic training & universal checkpoint").
+
+    Two detection paths, both deterministic under the fault harness
+    (``faults.host_loss``):
+
+    - **liveness allgather** — every ``beat()`` gathers ``(host, beat
+      counter, step)`` from all processes (``multihost_utils
+      .process_allgather`` by default — the same collective lane PR 10's
+      straggler gather rides, so the heartbeat adds no new comm pattern).
+      A peer whose row is missing or whose counter stops advancing for
+      ``heartbeat_max_missed`` consecutive gathers is declared dead.
+    - **per-collective deadline** — the gather itself runs under a wall-
+      clock deadline (``collective_deadline_s``): a peer that died mid-step
+      leaves the survivors stuck *inside* the collective, which no amount of
+      post-hoc checking can see. The deadline timer fires off-thread,
+      records the hang, and the caller observes it as a host loss the moment
+      the collective unblocks (or, on a real fleet, the process manager
+      reaps the stuck process while the recorded hint explains why).
+
+    Detection is sticky: once a host loss is recorded, ``beat()`` keeps
+    returning it so every layer (watchdog → PreemptionGuard → elastic
+    restart) sees the same verdict. Injectable ``gather_fn`` / ``clock`` /
+    ``process_count`` make single-process tests exact.
+    """
+
+    def __init__(self, config, telemetry=None,
+                 gather_fn: Optional[Callable[[np.ndarray], Any]] = None,
+                 process_index: Optional[int] = None,
+                 process_count: Optional[int] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        import jax
+
+        self.cfg = config
+        self.telemetry = telemetry
+        self._gather = gather_fn
+        self._clock = clock
+        self._idx = (jax.process_index() if process_index is None
+                     else int(process_index))
+        self._n = (jax.process_count() if process_count is None
+                   else int(process_count))
+        self._beats = 0
+        self._last_t: Optional[float] = None
+        self._last_seen: Dict[int, int] = {}
+        self._stale: Dict[int, int] = {}
+        self.detected: Optional[Dict[str, Any]] = None
+        self.hung: Optional[Dict[str, Any]] = None
+
+    # ------------------------------------------------------------------ #
+    def _emit(self, name: str, step: int, value: float = 1.0) -> None:
+        tel = self.telemetry
+        if tel is not None and hasattr(tel, "reliability_event"):
+            tel.reliability_event(name, value, step)
+
+    def _do_gather(self, payload: np.ndarray) -> np.ndarray:
+        if self._gather is not None:
+            return np.atleast_2d(np.asarray(self._gather(payload)))
+        from jax.experimental import multihost_utils
+
+        return np.atleast_2d(np.asarray(
+            multihost_utils.process_allgather(payload)))
+
+    @contextlib.contextmanager
+    def _deadline(self, what: str, step: int):
+        """Arm a wall-clock deadline around one collective. The timer thread
+        only RECORDS the hang (``self.hung``) — the caller turns it into a
+        host-loss verdict when (if) the collective returns; on a real fleet
+        a collective that never returns leaves the recorded hang as the
+        post-mortem."""
+        d = float(getattr(self.cfg, "collective_deadline_s", 0.0) or 0.0)
+        if d <= 0:
+            yield
+            return
+        t0 = self._clock()
+
+        def fire():
+            self.hung = {"kind": "hung_collective", "what": what,
+                         "deadline_s": d, "step": step}
+            logger.error(
+                f"heartbeat: collective '{what}' blew its {d:g}s deadline — "
+                f"a peer is likely dead; recording host loss")
+
+        timer = threading.Timer(d, fire)
+        timer.daemon = True
+        timer.start()
+        try:
+            yield
+        finally:
+            timer.cancel()
+            # fake clocks (tests) never tick the Timer thread — check the
+            # injected clock too so deadline detection is deterministic
+            if self.hung is None and self._clock() - t0 > d:
+                fire()
+
+    # ------------------------------------------------------------------ #
+    def beat(self, step: int = 0, force: bool = False) -> Optional[Dict]:
+        """One liveness round. Returns the (sticky) host-loss verdict dict
+        or None; throttled to ``heartbeat_interval_s`` unless ``force``."""
+        if self.detected is not None:
+            return self.detected
+        now = self._clock()
+        interval = float(getattr(self.cfg, "heartbeat_interval_s", 0.0) or 0)
+        if not force and self._last_t is not None and \
+                now - self._last_t < interval:
+            return None
+        self._last_t = now
+        self._beats += 1
+        payload = np.asarray([self._idx, self._beats, int(step)], np.int64)
+        with self._deadline("heartbeat_allgather", int(step)):
+            rows = self._do_gather(payload)
+        if self.hung is not None:
+            return self._detect(dict(self.hung), int(step))
+        seen = {int(r[0]): int(r[1]) for r in rows}
+        dead = []
+        for peer in range(self._n):
+            if peer == self._idx:
+                continue
+            b = seen.get(peer)
+            if b is None or b <= self._last_seen.get(peer, -1):
+                self._stale[peer] = self._stale.get(peer, 0) + 1
+            else:
+                self._stale[peer] = 0
+                self._last_seen[peer] = b
+            if self._stale[peer] >= max(1, int(getattr(
+                    self.cfg, "heartbeat_max_missed", 3))):
+                dead.append(peer)
+        if dead:
+            return self._detect({"kind": "dead_peer", "peers": dead,
+                                 "step": int(step)}, int(step))
+        return None
+
+    def _detect(self, info: Dict[str, Any], step: int) -> Dict[str, Any]:
+        self.detected = info
+        self._emit("elastic/host_loss_detected", step)
+        logger.error(f"heartbeat: host loss detected: {info}")
+        return info
+
+
 class TrainingWatchdog:
     """See module docstring. Construct with a
     :class:`~deepspeed_tpu.runtime.config.WatchdogConfig`."""
 
-    def __init__(self, config, telemetry=None, guard=None):
+    def __init__(self, config, telemetry=None, guard=None, heartbeat=None):
         self.cfg = config
         self.telemetry = telemetry
         self.guard = guard
         self.consecutive_skips = 0
         self.restart_requested = False
+        self.restart_reason: Optional[str] = None
         self.violations = 0
         self._loss_window = deque(maxlen=max(2, int(config.loss_window)))
         self._time_window = deque(maxlen=max(2, int(config.stall_window)))
         self._step_t0: Optional[float] = None
+        # multi-host heartbeat (host-loss detection → elastic exit): built
+        # from the config's heartbeat keys, or injected for tests
+        self.heartbeat = heartbeat
+        if heartbeat is None and bool(getattr(config, "heartbeat", False)):
+            self.heartbeat = HostHeartbeat(config, telemetry=telemetry)
+        self._host_loss_handled = False
 
     # ------------------------------------------------------------------ #
     def bind_guard(self, guard) -> None:
@@ -90,6 +241,16 @@ class TrainingWatchdog:
         if step_time_s is None and self._step_t0 is not None:
             step_time_s = now - self._step_t0
         self._step_t0 = None
+
+        # 0. multi-host liveness: a dead peer or hung collective routes
+        # through the elastic-exit protocol (durable universal save + clean
+        # exit at the guard's next boundary) rather than any on_violation
+        # policy — there is nothing to "warn and continue" past when a host
+        # is gone, and raising would skip the checkpoint
+        if self.heartbeat is not None and not self._host_loss_handled:
+            det = self.heartbeat.beat(step=step)
+            if det is not None:
+                self._host_loss(engine, det, step)
 
         cfg = self.cfg
         overflow = bool(out.overflow)
@@ -153,6 +314,29 @@ class TrainingWatchdog:
                       step_time_s: Optional[float] = None) -> bool:
         self.observe(engine, out, step_time_s=step_time_s)
         return self.restart_requested
+
+    # ------------------------------------------------------------------ #
+    def _host_loss(self, engine, det: Dict[str, Any], step: int) -> None:
+        """Host loss always takes the elastic-exit path: flag the restart,
+        trigger a bound PreemptionGuard (durable save + reshard hint at the
+        next step boundary), dump the flight recorder — never hang, never
+        silently continue."""
+        self._host_loss_handled = True
+        self.violations += 1
+        self._emit("violation/host_loss", step)
+        tel = self.telemetry
+        if tel is not None and hasattr(tel, "trace_dump"):
+            try:
+                tel.trace_dump("watchdog_host_loss")
+            except Exception:
+                pass
+        self.restart_requested = True
+        self.restart_reason = "host_loss"
+        logger.error(f"watchdog: host loss ({det}) at step {step} — "
+                     f"requesting durable save + elastic exit at the next "
+                     f"guard boundary")
+        if self.guard is not None and hasattr(self.guard, "trigger"):
+            self.guard.trigger()
 
     # ------------------------------------------------------------------ #
     def _violate(self, engine, kind: str, step: int, msg: str) -> None:

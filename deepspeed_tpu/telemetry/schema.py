@@ -48,7 +48,8 @@ from typing import Any, Dict, Iterable, List, Tuple
 __all__ = ["EVENT_NAME_RE", "SERVING_SERIES", "TRAIN_SERIES",
            "COMM_METRICS", "COMM_TOTAL_SERIES",
            "COMPILE_METRICS", "COMPILE_TOTAL_SERIES", "ANOMALY_SERIES",
-           "MEMORY_TIER_SERIES", "MFU_SEGMENT_RE", "ANOMALY_PHASES",
+           "MEMORY_TIER_SERIES", "RELIABILITY_ELASTIC_SERIES",
+           "MFU_SEGMENT_RE", "ANOMALY_PHASES",
            "REMAT_POLICIES", "validate_events", "validate_jsonl_records"]
 
 EVENT_NAME_RE = re.compile(r"^[A-Z][A-Za-z0-9_]*(/[A-Za-z0-9_.\-]+)+$")
@@ -180,6 +181,16 @@ MEMORY_TIER_SERIES = frozenset(
         "kv_spilled_blocks", "kv_spilled_bytes", "kv_spills",
         "kv_restores"))
 
+# Registered Reliability/elastic/* series (the elastic training runtime —
+# universal checkpoint saves/resumes/reshards, heartbeat host-loss
+# detection, and the drill verdict; docs/reliability.md "Elastic training &
+# universal checkpoint"): CLOSED — an emitted-but-unregistered elastic
+# series fails tier-1 validation. Other Reliability/* families (the PR-3
+# checkpoint/watchdog counters, violation/<kind>) stay open.
+RELIABILITY_ELASTIC_SERIES = frozenset(
+    "Reliability/elastic/" + m for m in (
+        "saves", "resumes", "reshards", "host_loss_detected", "drill_pass"))
+
 # Per-program MFU attribution gauges (Train/mfu/<program>,
 # Serving/mfu/<program>, plus the total/headline rollups): the program
 # segment is open-ended but must be one lowercase snake_case token — the
@@ -226,6 +237,13 @@ def validate_events(events: Iterable[Tuple[str, float, int]]) -> List[str]:
             problems.append(f"event #{i}: memory-tier series {name!r} is not "
                             f"registered in "
                             f"telemetry.schema.MEMORY_TIER_SERIES")
+            continue
+        if name.startswith("Reliability/elastic/") and \
+                name not in RELIABILITY_ELASTIC_SERIES:
+            problems.append(
+                f"event #{i}: elastic reliability series {name!r} is not "
+                f"registered in "
+                f"telemetry.schema.RELIABILITY_ELASTIC_SERIES")
             continue
         if name.startswith("Anomaly/") and name not in ANOMALY_SERIES:
             problems.append(f"event #{i}: anomaly series {name!r} is not "

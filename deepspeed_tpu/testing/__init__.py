@@ -1,3 +1,5 @@
-from .faults import (SimulatedCrash, corrupt_file, crash_after_save,  # noqa: F401
-                     forced_nonfinite, io_errors, preempt, truncated_write,
+from .faults import (SimulatedCrash, corrupt_file, corrupt_fragment,  # noqa: F401
+                     crash_after_save, forced_nonfinite, host_loss,
+                     io_errors, preempt, preempt_at_step, truncated_write,
                      write_delay)
+from .drill import DrillPhase, elastic_drill  # noqa: F401

@@ -1,0 +1,294 @@
+"""Elastic preempt→reshard→resume drill (the elastic training runtime's
+acceptance harness; docs/reliability.md "Elastic training & universal
+checkpoint").
+
+``elastic_drill`` proves the tentpole guarantee end to end, on the CPU mesh,
+with seeded determinism: train a reference run uninterrupted, then replay the
+SAME run through a sequence of topology phases — train, get killed (a
+scheduled preemption or an injected host loss), save a universal checkpoint
+with a reshard hint, come back at a DIFFERENT (chips, ZeRO stage, optimizer
+tier), fast-forward the dataloader, and keep going — asserting the drilled
+loss trajectory equals the uninterrupted one to ``tol`` at every step. Each
+phase is one (topology, stage, tier) combination, so a 3-phase drill covers
+3 matrix cells.
+
+Also runnable standalone (the ``tpu_watch.sh`` non-fatal ELASTIC row)::
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python -m deepspeed_tpu.testing.drill
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from . import faults
+
+
+@dataclasses.dataclass
+class DrillPhase:
+    """One incarnation of the job: its topology and how it ends."""
+
+    chips: int
+    zero_stage: int = 0
+    optimizer_tier: str = "none"   # none | host
+    hpz: int = 1                   # zero_hpz_partition_size (stage 3 only)
+    steps: int = 2                 # steps before the injected kill
+    fault: str = "preempt"         # preempt | host_loss
+
+    def label(self) -> str:
+        t = f"/{self.optimizer_tier}" if self.optimizer_tier != "none" else ""
+        h = f"/hpz{self.hpz}" if self.hpz > 1 else ""
+        return f"chips{self.chips}/z{self.zero_stage}{t}{h}"
+
+
+def _drill_spec(dim: int = 8):
+    """A tiny deterministic regression model whose loss is a mean over the
+    batch dim — so every (micro, gas, dp) split of the same global batch
+    computes the identical trajectory up to fp reassociation."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..runtime.engine import ModelSpec
+
+    def loss_fn(p, b):
+        pred = b["x"] @ p["w"]
+        return jnp.mean(jnp.sum((pred - b["y"]) ** 2, axis=-1)), {}
+
+    def init_fn(key):
+        return {"w": jax.random.normal(key, (dim, dim), jnp.float32) * 0.3}
+
+    return ModelSpec(loss_fn=loss_fn, init_fn=init_fn,
+                     pipeline_capable=False, name="drill")
+
+
+def _drill_dataset(n: int, dim: int = 8, seed: int = 0) -> List[Dict]:
+    rng = np.random.default_rng(seed)
+    return [{"x": rng.standard_normal(dim).astype(np.float32),
+             "y": rng.standard_normal(dim).astype(np.float32)}
+            for _ in range(n)]
+
+
+def _phase_config(phase: DrillPhase, elastic: Dict, seed: int) -> Dict:
+    cfg: Dict[str, Any] = {
+        "elasticity": dict(elastic),
+        "optimizer": {"type": "adamw", "params": {"lr": 0.05}},
+        "zero_optimization": {"stage": int(phase.zero_stage)},
+        "checkpoint": {"engine": "fast"},
+        "steps_per_print": 0,
+        "seed": int(seed),
+    }
+    if phase.hpz > 1:
+        cfg["zero_optimization"]["zero_hpz_partition_size"] = int(phase.hpz)
+    if phase.optimizer_tier == "host":
+        cfg["memory"] = {"tiering": {"enabled": True,
+                                     "optimizer_tier": "host"}}
+    if phase.fault == "host_loss":
+        cfg["watchdog"] = {"enabled": True, "heartbeat": True,
+                           "heartbeat_max_missed": 2}
+    return cfg
+
+
+def _reset_process_state() -> None:
+    """Engines publish process-wide state (global mesh, layer-prefetch
+    routing); a drill builds several in one process, so each phase starts
+    from a clean slate exactly like a fresh incarnation would."""
+    from ..comm import mesh as mesh_mod
+
+    mesh_mod.set_mesh(None)
+
+
+def elastic_drill(workdir: str, phases: Optional[Sequence[DrillPhase]] = None,
+                  total_steps: int = 6, seed: int = 0, global_batch: int = 8,
+                  micro_batch_sizes: Sequence[int] = (1, 2, 4),
+                  dim: int = 8, tol: float = 1e-6,
+                  assert_equal: bool = True) -> Dict[str, Any]:
+    """Run the seeded train→kill→reshard→resume cycle and compare against an
+    uninterrupted run. Returns a result dict; with ``assert_equal`` (the
+    default) an out-of-tolerance trajectory raises ``AssertionError``."""
+    import jax
+
+    from ..elasticity import PreemptionGuard, read_reshard_hint, run_elastic
+
+    if phases is None:
+        # the default matrix: shrink with a stage change, then grow with
+        # another — three (topology, stage, tier) cells in one drill
+        phases = [DrillPhase(chips=8, zero_stage=2, steps=2),
+                  DrillPhase(chips=4, zero_stage=1, steps=2),
+                  DrillPhase(chips=8, zero_stage=3)]
+    phases = list(phases)
+    if len(phases) < 2:
+        raise ValueError("elastic_drill needs >= 2 phases (train → resume)")
+    n_avail = len(jax.devices())
+    if any(p.chips > n_avail for p in phases):
+        raise ValueError(f"drill phase wants more chips than the "
+                         f"{n_avail}-device mesh provides")
+    elastic = {"enabled": True, "max_train_batch_size": int(global_batch),
+               "micro_batch_sizes": [int(m) for m in micro_batch_sizes],
+               "min_gpus": 1, "max_gpus": n_avail,
+               "prefer_larger_batch": True}
+    spec = _drill_spec(dim)
+    dataset = _drill_dataset(global_batch * (total_steps + 2), dim, seed)
+    ckpt = os.path.join(workdir, "elastic_ckpt")
+
+    def _train(engine, loader, guard, budget, fault, hb_cm):
+        losses = []
+        exited = False
+        cm = faults.preempt_at_step(guard, engine.global_steps + budget) \
+            if fault == "preempt" else None
+        try:
+            if cm is not None:
+                cm.__enter__()
+            for batch in loader:
+                out = engine.train_batch(batch)
+                losses.append(float(out.loss))
+                if guard.step_boundary(engine):
+                    exited = True
+                    break
+                if fault is None and len(losses) >= budget:
+                    break
+                if len(losses) >= budget + 5:
+                    break  # injected fault never fired — fail below, no hang
+        finally:
+            if cm is not None:
+                cm.__exit__(None, None, None)
+            if hb_cm is not None:
+                hb_cm.__exit__(None, None, None)
+        return losses, exited
+
+    # ---- uninterrupted reference at the FIRST phase's topology ----
+    _reset_process_state()
+    engine, _, loader, _ = run_elastic(spec, _phase_config(
+        phases[0], elastic, seed), checkpoint_dir=None,
+        n_chips=phases[0].chips, training_data=dataset)
+    baseline: List[float] = []
+    for batch in loader:
+        baseline.append(float(engine.train_batch(batch).loss))
+        if len(baseline) >= total_steps:
+            break
+    engine.destroy()
+
+    # ---- the drill: kill → reshard → resume through the phases ----
+    drill: List[float] = []
+    phase_meta: List[Dict[str, Any]] = []
+    events: Dict[str, int] = {}
+    for i, ph in enumerate(phases):
+        _reset_process_state()
+        engine, _, loader, _ = run_elastic(
+            spec, _phase_config(ph, elastic, seed), checkpoint_dir=ckpt,
+            n_chips=ph.chips, training_data=dataset)
+        guard = PreemptionGuard(ckpt, signals=(), universal=True,
+                                watchdog=engine.watchdog)
+        if i > 0 and engine.global_steps != len(drill):
+            raise AssertionError(
+                f"phase {i} resumed at step {engine.global_steps}, expected "
+                f"{len(drill)}")
+        last = i == len(phases) - 1
+        budget = (total_steps - len(drill)) if last else ph.steps
+        fault = None if last else ph.fault
+        hb_cm = None
+        if fault == "host_loss":
+            hb = getattr(engine.watchdog, "heartbeat", None)
+            if hb is None:
+                raise RuntimeError("host_loss phase needs watchdog.heartbeat")
+            # heartbeat_max_missed=2: the peer freezes so its second stale
+            # gather — and the exit — lands exactly at step `budget`
+            hb_cm = faults.host_loss(hb, peer=1, world=2,
+                                     after_beats=max(0, budget - 2))
+            hb_cm.__enter__()
+        try:
+            losses, exited = _train(engine, loader, guard, budget, fault,
+                                    hb_cm)
+        finally:
+            guard.uninstall()
+        if fault is not None and not exited:
+            raise AssertionError(
+                f"phase {i} ({ph.label()}) never exited on its injected "
+                f"{fault}")
+        drill.extend(losses)
+        phase_meta.append({"phase": ph.label(), "steps": len(losses),
+                           "fault": fault,
+                           "resumed_at": engine.global_steps - len(losses)})
+        if not last:
+            tel = getattr(engine, "telemetry", None)
+            if tel is not None:
+                for k, v in getattr(tel, "reliability_counts", {}).items():
+                    events[k] = events.get(k, 0) + int(v)
+            engine.destroy()
+
+    hint = read_reshard_hint(ckpt)
+    base = np.asarray(baseline)
+    got = np.asarray(drill)
+    ok = len(got) == len(base)
+    max_err = float("inf")
+    if ok:
+        denom = np.maximum(1.0, np.abs(base))
+        max_err = float(np.max(np.abs(got - base) / denom)) if len(base) \
+            else 0.0
+        ok = max_err <= tol
+    # the verdict itself is telemetry (Reliability/elastic/drill_pass) —
+    # emitted through the final incarnation's hub before it closes
+    tel = getattr(engine, "telemetry", None)
+    if tel is not None and hasattr(tel, "reliability_event"):
+        tel.reliability_event("elastic/drill_pass", 1.0 if ok else 0.0,
+                              int(engine.global_steps))
+        for k, v in getattr(tel, "reliability_counts", {}).items():
+            events[k] = events.get(k, 0) + int(v)
+    engine.destroy()
+    _reset_process_state()
+    result = {
+        "pass": bool(ok),
+        "max_rel_err": max_err,
+        "tol": tol,
+        "steps": len(got),
+        "baseline_losses": baseline,
+        "drill_losses": drill,
+        "phases": phase_meta,
+        "reshard_hint": hint,
+        "reliability_events": events,
+    }
+    if assert_equal and not ok:
+        raise AssertionError(
+            f"elastic drill trajectory diverged: max_rel_err={max_err:.3e} "
+            f"(tol={tol:g}) over {len(got)}/{len(base)} steps; phases="
+            f"{[p['phase'] for p in phase_meta]}")
+    return result
+
+
+def main(argv=None) -> int:
+    """Standalone entry (the ``tpu_watch.sh`` ELASTIC row): run the default
+    drill on a temp dir and print a one-line verdict."""
+    import argparse
+    import json
+    import tempfile
+
+    p = argparse.ArgumentParser(prog="python -m deepspeed_tpu.testing.drill")
+    p.add_argument("--steps", type=int, default=6)
+    p.add_argument("--tol", type=float, default=1e-6)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--json", action="store_true",
+                   help="dump the full result dict as JSON")
+    args = p.parse_args(argv)
+    with tempfile.TemporaryDirectory() as d:
+        try:
+            res = elastic_drill(d, total_steps=args.steps, seed=args.seed,
+                                tol=args.tol, assert_equal=False)
+        except Exception as e:  # a crash is a failed drill, not a traceback
+            print(f"[drill] pass=False error={type(e).__name__}: {e}")
+            return 1
+    print(f"[drill] pass={res['pass']} steps={res['steps']} "
+          f"max_rel_err={res['max_rel_err']:.3e} tol={res['tol']:g} "
+          f"phases={[p['phase'] for p in res['phases']]} "
+          f"saves={res['reliability_events'].get('Reliability/elastic/saves', 0)} "
+          f"resumes={res['reliability_events'].get('Reliability/elastic/resumes', 0)}")
+    if args.json:
+        print(json.dumps(res, indent=2, default=str))
+    return 0 if res["pass"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

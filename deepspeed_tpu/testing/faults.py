@@ -19,9 +19,20 @@ watchdog, and the PreemptionGuard actually survive them (see
   windows (e.g. ``engine.destroy()`` draining an in-flight save);
 - :func:`preempt` — delivers a synthetic preemption to a PreemptionGuard
   without involving the OS signal machinery;
+- :func:`preempt_at_step` — schedules that preemption at an exact global
+  step (the elastic drill's deterministic kill point);
+- :func:`host_loss` — injects a dead peer (or a hung liveness collective)
+  into a ``HostHeartbeat`` so host-loss detection → durable universal save
+  → clean exit is testable on one process;
+- :func:`corrupt_fragment` — post-hoc bit rot on a committed UNIVERSAL
+  checkpoint fragment, which the verified elastic load must walk back from;
 - :func:`forced_nonfinite` — the next N train steps report overflow (and
   optionally a NaN loss) so watchdog paths fire without engineering a real
   fp16 overflow.
+
+The full preempt→reshard→resume cycle is exercised by the seeded
+``deepspeed_tpu.testing.drill.elastic_drill`` harness, which composes these
+injectors (docs/reliability.md "Elastic training & universal checkpoint").
 
 Serving-fleet chaos (docs/serving.md "Fleet fault tolerance"; used by
 ``tests/test_serving_fleet.py``) — all patch one ``ServingScheduler``
@@ -192,6 +203,95 @@ def preempt(guard, signum: Optional[int] = None) -> None:
     the resource manager would send, minus the OS. The guard checkpoints at
     its next ``step_boundary`` exactly as for a real signal."""
     guard.trigger(signum)
+
+
+@contextlib.contextmanager
+def preempt_at_step(guard, step: int) -> Iterator[dict]:
+    """Arm a PreemptionGuard to self-trigger the first time its
+    ``step_boundary`` runs with ``engine.global_steps >= step`` — a
+    preemption scheduled at an exact trajectory point, which is what the
+    elastic drill's seeded train→kill→resume cycle needs (a wall-clock
+    SIGTERM would land at a different step every run). Yields
+    ``{"fired": step or None}``."""
+    orig = guard.step_boundary
+    state = {"fired": None}
+
+    def boundary(engine):
+        if state["fired"] is None and \
+                int(getattr(engine, "global_steps", 0)) >= int(step):
+            state["fired"] = int(engine.global_steps)
+            guard.trigger()
+        return orig(engine)
+
+    guard.step_boundary = boundary
+    try:
+        yield state
+    finally:
+        guard.step_boundary = orig
+
+
+@contextlib.contextmanager
+def host_loss(heartbeat, peer: int = 1, world: Optional[int] = None,
+              after_beats: int = 1, hang_s: float = 0.0,
+              advance=None) -> Iterator[dict]:
+    """Inject a dead peer into a ``HostHeartbeat`` (runtime/watchdog.py).
+
+    Patches the heartbeat's gather so that after ``after_beats`` healthy
+    liveness rounds, ``peer``'s row disappears from the gathered liveness
+    data (the dead host stops participating); the heartbeat declares it
+    dead after ``heartbeat_max_missed`` consecutive missing/stale rounds.
+    With ``hang_s`` > 0 the gather additionally stalls that long
+    (``advance`` substitutes a fake clock's advance, the same clock
+    injected into the heartbeat) so the per-collective deadline path fires
+    instead. ``world`` overrides the heartbeat's process count —
+    single-process tests model an N-host fleet exactly."""
+    orig_gather = heartbeat._gather
+    orig_n = heartbeat._n
+    if world is not None:
+        heartbeat._n = int(world)
+    state = {"beats": 0, "dropped": 0}
+
+    def gather(payload):
+        import numpy as np
+
+        state["beats"] += 1
+        beats = int(payload[1])
+        dead = state["beats"] > after_beats
+        rows = []
+        for idx in range(heartbeat._n):
+            if idx == peer and dead:
+                state["dropped"] += 1
+                continue  # the dead host's row never arrives
+            rows.append([idx, beats, int(payload[2])])
+        if dead and hang_s > 0:
+            (advance or time.sleep)(hang_s)  # stuck collective
+        return np.asarray(rows, np.int64)
+
+    heartbeat._gather = gather
+    try:
+        yield state
+    finally:
+        heartbeat._gather = orig_gather
+        heartbeat._n = orig_n
+
+
+def corrupt_fragment(universal_dir: str, name: Optional[str] = None,
+                     keep_bytes: int = 16) -> str:
+    """Truncate one fp32 fragment of a COMMITTED universal checkpoint tag
+    (the named ``param/<name>`` fragment, or the largest one) — post-hoc bit
+    rot that the verified elastic load must convert into a walk-back, never
+    a resume from torn state. Returns the path of the corrupted file."""
+    root = os.path.join(universal_dir, "param")
+    if not os.path.isdir(root):
+        root = universal_dir
+    if name is not None:
+        target = os.path.join(root, name, "fp32.npy")
+        if not os.path.exists(target):
+            raise FileNotFoundError(f"no fragment named {name} under {root}")
+        with open(target, "r+b") as f:
+            f.truncate(keep_bytes)
+        return target
+    return corrupt_file(root, keep_bytes=keep_bytes, filename="fp32.npy")
 
 
 # --------------------------------------------------------------------------- #

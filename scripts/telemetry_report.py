@@ -412,6 +412,19 @@ def reliability(events: List[dict]) -> str:
     lines.append(f"  stall warnings:         {total('stall_warning')}")
     lines.append(f"  watchdog violations:    {violations}")
     lines.append(f"  preemption checkpoints: {total('preemption_checkpoint')}")
+    # elastic training runtime (Reliability/elastic/* — the closed registry
+    # in telemetry/schema.py; docs/reliability.md "Elastic training &
+    # universal checkpoint")
+    if any(k.startswith("elastic/") for k in counts):
+        lines.append("")
+        lines.append("  elastic runtime:")
+        lines.append(f"    universal saves:      {total('elastic/saves')}")
+        lines.append(f"    elastic resumes:      {total('elastic/resumes')}")
+        lines.append(f"    topology reshards:    {total('elastic/reshards')}")
+        lines.append(f"    host losses detected: "
+                     f"{total('elastic/host_loss_detected')}")
+        lines.append(f"    drill passes:         "
+                     f"{total('elastic/drill_pass')}")
     return "\n".join(lines)
 
 

@@ -173,6 +173,20 @@ try:
 except Exception as e:
     print("[watch] KVQUANT probe: unreadable:", e)
 EOF
+    # elastic-drill row (NON-FATAL — never gates CYCLE_OK or promotion):
+    # the preempt→reshard→resume drill on the CPU lane of this host
+    # (deepspeed_tpu/testing/drill.py; docs/reliability.md "Elastic
+    # training & universal checkpoint"). pass=False — the drilled loss
+    # trajectory no longer matches the uninterrupted run to 1e-6, or a
+    # save/resume/host-loss leg broke — means the elastic runtime
+    # regressed; the one-line verdict carries max_rel_err and the
+    # universal save/resume counts.
+    if JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+        timeout -k 60 900 python -m deepspeed_tpu.testing.drill >> "$LOG" 2>&1; then
+      echo "[watch] $ts ELASTIC drill ok" >> "$LOG"
+    else
+      echo "[watch] $ts ELASTIC drill FAILED (non-fatal)" >> "$LOG"
+    fi
     hold_requested || run_probe LONGCTX scripts/longctx_bench.py 2400 LONGCTX_TPU_LIVE.json
     hold_requested || run_probe MOE scripts/moe_dispatch_bench.py 1200 MOE_TPU_LIVE.json
     # full headline bench incl. shape rows (first compiles are slow).

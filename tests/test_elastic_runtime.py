@@ -1,0 +1,581 @@
+"""Elastic training runtime tests (docs/reliability.md "Elastic training &
+universal checkpoint"): universal checkpoint v2 roundtrips across (mesh,
+ZeRO stage, optimizer tier), hardened two-phase fragment commit (crash /
+corruption walk-back, stage-dir GC), dataloader/RNG fast-forward, heartbeat
+host-loss detection → durable save + clean exit, reshard-hint consumption by
+``run_elastic``, the preempt→reshard→resume drill itself, and the pinned
+default-path inertness (no elasticity → byte-identical checkpoint
+artifacts)."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu as dst
+from deepspeed_tpu.comm import mesh as mesh_mod
+from deepspeed_tpu.elasticity import (PreemptionGuard, read_reshard_hint,
+                                      run_elastic)
+from deepspeed_tpu.runtime.checkpoint import (is_universal_tag,
+                                              tag_candidates,
+                                              verify_manifest)
+from deepspeed_tpu.runtime.checkpoint import universal as uni
+from deepspeed_tpu.runtime.dataloader import DeepSpeedTPUDataLoader
+from deepspeed_tpu.runtime.engine import ModelSpec
+from deepspeed_tpu.runtime.watchdog import HostHeartbeat
+from deepspeed_tpu.testing import faults
+from deepspeed_tpu.testing.drill import DrillPhase, elastic_drill
+
+DIM = 8
+
+
+def _spec():
+    def loss_fn(p, b):
+        pred = b["x"] @ p["w"]
+        return jnp.mean(jnp.sum((pred - b["y"]) ** 2, axis=-1)), {}
+
+    return ModelSpec(
+        loss_fn=loss_fn,
+        init_fn=lambda k: {"w": jax.random.normal(k, (DIM, DIM),
+                                                  jnp.float32) * 0.3},
+        pipeline_capable=False)
+
+
+def _mk_engine(stage=2, tier="none", chips=8, hpz=1, seed=42, nvme_dir=None,
+               watchdog=None):
+    mesh_mod.set_mesh(None)
+    cfg = {
+        "train_batch_size": 8,
+        "optimizer": {"type": "adamw", "params": {"lr": 0.05}},
+        "zero_optimization": {"stage": stage},
+        "checkpoint": {"engine": "fast"},
+        "steps_per_print": 0,
+        "seed": seed,
+    }
+    if hpz > 1:
+        cfg["zero_optimization"]["zero_hpz_partition_size"] = hpz
+    if tier == "host":
+        cfg["memory"] = {"tiering": {"enabled": True,
+                                     "optimizer_tier": "host"}}
+    if tier == "nvme":
+        cfg["zero_optimization"]["offload_optimizer"] = {
+            "device": "nvme", "nvme_path": str(nvme_dir)}
+    if watchdog is not None:
+        cfg["watchdog"] = {"enabled": True, **watchdog}
+    devices = jax.devices()[:chips] if chips != len(jax.devices()) else None
+    engine, *_ = dst.initialize(model=_spec(), config=cfg, devices=devices)
+    return engine
+
+
+_RNG = np.random.default_rng(0)
+
+
+def _batch(seed=None):
+    rng = np.random.default_rng(seed) if seed is not None else _RNG
+    return {"x": rng.standard_normal((8, DIM)).astype(np.float32),
+            "y": rng.standard_normal((8, DIM)).astype(np.float32)}
+
+
+def _assert_bitwise(a, b):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+# --------------------------------------------------------------------------- #
+# universal checkpoint v2: reshard roundtrip matrix
+# --------------------------------------------------------------------------- #
+@pytest.mark.parametrize("src,dst_", [
+    # (stage, tier, chips, hpz) → (stage', tier', chips', hpz')
+    ((2, "none", 8, 1), (1, "none", 4, 1)),
+    ((3, "none", 8, 4), (3, "none", 8, 1)),   # hpZ secondary → plain stage 3
+    ((2, "host", 8, 1), (2, "none", 4, 1)),   # host tier → in-HBM, shrink
+    ((1, "none", 4, 1), (2, "host", 8, 1)),   # grow INTO the host tier
+], ids=["z2c8-z1c4", "hpz4-z3", "host-none", "none-host"])
+def test_universal_roundtrip_matrix(devices8, tmp_path, src, dst_):
+    """Save at topology A, load at topology B: params AND optimizer state
+    bitwise equal, counters/scheduler restored."""
+    from deepspeed_tpu.memory.placement import HostBuffer
+
+    s_stage, s_tier, s_chips, s_hpz = src
+    d_stage, d_tier, d_chips, d_hpz = dst_
+    e1 = _mk_engine(stage=s_stage, tier=s_tier, chips=s_chips, hpz=s_hpz)
+    for i in range(2):
+        e1.train_batch(_batch(seed=i))
+    e1.save_universal_checkpoint(str(tmp_path), tag="m1")
+    ref_params = jax.device_get(e1.state.params)
+    ref_opt = jax.tree.map(np.asarray, e1.state.opt_state,
+                           is_leaf=lambda x: isinstance(x, HostBuffer))
+    ref_sched = e1.lr_scheduler.state_dict()
+    e1.destroy()
+
+    e2 = _mk_engine(stage=d_stage, tier=d_tier, chips=d_chips, hpz=d_hpz,
+                    seed=7)
+    path, _ = e2.load_universal_checkpoint(str(tmp_path))
+    assert path.endswith("m1")
+    assert e2.global_steps == 2
+    assert e2.lr_scheduler.state_dict() == ref_sched
+    _assert_bitwise(ref_params, e2.state.params)
+    got_opt = jax.tree.map(np.asarray, e2.state.opt_state,
+                           is_leaf=lambda x: isinstance(x, HostBuffer))
+    _assert_bitwise(ref_opt, got_opt)
+    # the resumed engine actually trains at the new topology
+    out = e2.train_batch(_batch(seed=5))
+    assert np.isfinite(float(out.loss))
+    assert e2.telemetry.reliability_counts.get(
+        "Reliability/elastic/resumes", 0) == 1
+    e2.destroy()
+
+
+def test_universal_roundtrip_nvme_tier_both_directions(devices8, tmp_path):
+    """none → nvme: the fragments stream into the swap files (masters,
+    moments, step count) bitwise; nvme → stage-3: the swapped state comes
+    back out into a sharded engine."""
+    e1 = _mk_engine(stage=2)
+    for i in range(2):
+        e1.train_batch(_batch(seed=i))
+    e1.save_universal_checkpoint(str(tmp_path), tag="n1")
+    ref_params = jax.device_get(e1.state.params)
+    ref_mu = np.asarray(e1.state.opt_state.mu["w"])
+    e1.destroy()
+
+    e2 = _mk_engine(stage=0, tier="nvme", nvme_dir=tmp_path / "swap", seed=7)
+    e2.load_universal_checkpoint(str(tmp_path), tag="n1")
+    ps, ms, _vs = e2._nvme_opt.state_leaves()
+    np.testing.assert_array_equal(ps[0], np.asarray(ref_params["w"]))
+    np.testing.assert_array_equal(ms[0], ref_mu)
+    assert e2._nvme_opt.step_count == 2
+    e2.train_batch(_batch(seed=5))
+    e2.save_universal_checkpoint(str(tmp_path), tag="n2")
+    e2.destroy()
+
+    e3 = _mk_engine(stage=3, seed=9)
+    path, _ = e3.load_universal_checkpoint(str(tmp_path), tag="n2")
+    assert path.endswith("n2") and e3.global_steps == 3
+    out = e3.train_batch(_batch(seed=6))
+    assert np.isfinite(float(out.loss))
+    e3.destroy()
+
+
+def test_dataloader_cursor_exact_fast_forward(devices8):
+    ds = [{"x": np.full((2,), i, np.float32)} for i in range(64)]
+    l1 = DeepSpeedTPUDataLoader(ds, batch_size=8, seed=3)
+    it = iter(l1)
+    consumed = [next(it) for _ in range(3)]
+    assert len(consumed) == 3
+    sd = l1.state_dict()
+    assert sd["batch"] == 3
+
+    l2 = DeepSpeedTPUDataLoader(ds, batch_size=8, seed=3)
+    l2.load_state_dict(sd)
+    rest_ref = list(it)
+    rest = list(iter(l2))
+    assert len(rest) == len(rest_ref) > 0
+    for a, b in zip(rest, rest_ref):
+        np.testing.assert_array_equal(a["x"], b["x"])
+    # non-indexable datasets fast-forward too (items consumed, not collated)
+    l3 = DeepSpeedTPUDataLoader(iter(list(ds)), batch_size=8, shuffle=False)
+    l4 = DeepSpeedTPUDataLoader(iter(list(ds)), batch_size=8, shuffle=False)
+    ref = list(l3)[2:]
+    l4.load_state_dict({"epoch": 0, "batch": 2, "seed": 0,
+                        "shuffle": False, "batch_size": 8})
+    got = list(l4)
+    for a, b in zip(got, ref):
+        np.testing.assert_array_equal(a["x"], b["x"])
+
+
+def test_rng_rederivation_for_new_topology():
+    """Per-host streams: deterministic, distinct per host, independent of
+    the OLD topology (a pure function of seed/step/new host layout)."""
+    k = uni.derive_host_rng(42, 10, 0, 4)
+    np.testing.assert_array_equal(np.asarray(k),
+                                  np.asarray(uni.derive_host_rng(42, 10, 0, 4)))
+    hosts = [np.asarray(uni.derive_host_rng(42, 10, i, 4)) for i in range(4)]
+    for i in range(4):
+        for j in range(i + 1, 4):
+            assert not np.array_equal(hosts[i], hosts[j])
+    # a different step or host count derives a different stream
+    assert not np.array_equal(np.asarray(uni.derive_host_rng(42, 11, 0, 4)),
+                              hosts[0])
+    assert not np.array_equal(np.asarray(uni.derive_host_rng(42, 10, 0, 2)),
+                              hosts[0])
+
+
+def test_engine_universal_load_fast_forwards_loader_and_rng(devices8,
+                                                           tmp_path):
+    ds = [{"x": np.random.default_rng(i).standard_normal(DIM).astype(np.float32),
+           "y": np.zeros((DIM,), np.float32)} for i in range(64)]
+    mesh_mod.set_mesh(None)
+    e1, _, loader1, _ = dst.initialize(model=_spec(), config={
+        "train_batch_size": 8,
+        "optimizer": {"type": "sgd", "params": {"lr": 0.1}},
+        "checkpoint": {"engine": "fast"}, "steps_per_print": 0},
+        training_data=ds)
+    it = iter(loader1)
+    for _ in range(3):
+        e1.train_batch(next(it))
+    e1.save_universal_checkpoint(str(tmp_path), tag="dl")
+    next_ref = next(it)
+    e1.destroy()
+
+    mesh_mod.set_mesh(None)
+    e2, _, loader2, _ = dst.initialize(model=_spec(), config={
+        "train_batch_size": 8,
+        "optimizer": {"type": "sgd", "params": {"lr": 0.1}},
+        "checkpoint": {"engine": "fast"}, "steps_per_print": 0},
+        training_data=ds, devices=jax.devices()[:4])
+    e2.load_universal_checkpoint(str(tmp_path))
+    np.testing.assert_array_equal(next(iter(loader2))["x"], next_ref["x"])
+    # the per-host RNG stream was re-derived for THIS topology
+    assert hasattr(e2, "host_rng")
+    np.testing.assert_array_equal(
+        np.asarray(e2.host_rng),
+        np.asarray(uni.derive_host_rng(42, 3, 0, 1)))
+    e2.destroy()
+
+
+# --------------------------------------------------------------------------- #
+# hardened two-phase fragment commit
+# --------------------------------------------------------------------------- #
+def test_crash_mid_universal_save_walks_back(devices8, tmp_path):
+    """Satellite: the process dies after the fragment write but before the
+    seal/publish — `latest` stays on the previous universal tag and the
+    verified elastic load resumes there (reuses faults.crash_after_save on
+    the fragment-writer seam)."""
+    engine = _mk_engine()
+    engine.train_batch(_batch(seed=0))
+    engine.save_universal_checkpoint(str(tmp_path), tag="good")
+    ref_w = np.asarray(engine.state.params["w"])
+    engine.train_batch(_batch(seed=1))
+
+    with faults.crash_after_save(uni.FRAGMENT_WRITER):
+        with pytest.raises(faults.SimulatedCrash):
+            engine.save_universal_checkpoint(str(tmp_path), tag="torn")
+
+    with open(tmp_path / "latest") as f:
+        assert f.read().strip() == "good"
+    assert tag_candidates(str(tmp_path)) == ["good"]
+    path, _ = engine.load_universal_checkpoint(str(tmp_path))
+    assert path.endswith("good") and engine.global_steps == 1
+    np.testing.assert_array_equal(np.asarray(engine.state.params["w"]), ref_w)
+    # the next save of the same tag reclaims the stale staging dir
+    engine.train_batch(_batch(seed=2))
+    engine.save_universal_checkpoint(str(tmp_path), tag="torn")
+    assert verify_manifest(str(tmp_path / "torn"))[0] == "verified"
+    engine.destroy()
+
+
+def test_universal_save_failure_gcs_stage_dir(devices8, tmp_path):
+    """Satellite (the _wait_for hazard): an I/O failure mid-stage must not
+    strand the .tmp.stage dir forever."""
+    engine = _mk_engine()
+    engine.train_batch(_batch(seed=0))
+    with faults.io_errors(uni.FRAGMENT_WRITER, fail_times=5):
+        with pytest.raises(OSError):
+            engine.save_universal_checkpoint(str(tmp_path), tag="g1")
+    assert not [n for n in os.listdir(tmp_path) if ".tmp." in n]
+    # with io_retries the same transient failure self-heals
+    engine.config.checkpoint.io_retries = 2
+    engine.config.checkpoint.io_backoff_s = 0.01
+    with faults.io_errors(uni.FRAGMENT_WRITER, fail_times=1) as st:
+        engine.save_universal_checkpoint(str(tmp_path), tag="g2")
+    assert st["failures"] == 1
+    assert verify_manifest(str(tmp_path / "g2"))[0] == "verified"
+    engine.destroy()
+
+
+def test_corrupt_fragment_walks_back_to_older_universal_tag(devices8,
+                                                            tmp_path):
+    engine = _mk_engine()
+    engine.train_batch(_batch(seed=0))
+    engine.save_universal_checkpoint(str(tmp_path), tag="u1")
+    w1 = np.asarray(engine.state.params["w"])
+    engine.train_batch(_batch(seed=1))
+    engine.save_universal_checkpoint(str(tmp_path), tag="u2")
+
+    faults.corrupt_fragment(str(tmp_path / "u2"), name="w")
+    assert verify_manifest(str(tmp_path / "u2"))[0] == "corrupt"
+    path, _ = engine.load_universal_checkpoint(str(tmp_path))
+    assert path.endswith("u1") and engine.global_steps == 1
+    np.testing.assert_array_equal(np.asarray(engine.state.params["w"]), w1)
+    assert engine.telemetry.reliability_counts.get(
+        "Reliability/checkpoint_rollback", 0) == 1
+    engine.destroy()
+
+
+def test_universal_fragments_carry_sha256_and_fsync_index(devices8, tmp_path):
+    engine = _mk_engine()
+    engine.train_batch(_batch(seed=0))
+    path = engine.save_universal_checkpoint(str(tmp_path), tag="s1")
+    with open(os.path.join(path, "meta.json")) as f:
+        meta = json.load(f)
+    assert meta["format"] == "universal2"
+    ent = meta["index"]["param"]["w"]
+    assert len(ent["sha256"]) == 64 and ent["bytes"] > 0
+    assert verify_manifest(path)[0] == "verified"
+    assert is_universal_tag(path)
+    engine.destroy()
+
+
+# --------------------------------------------------------------------------- #
+# host-loss detection → durable save + clean exit
+# --------------------------------------------------------------------------- #
+def test_heartbeat_unit_dead_peer_and_deadline():
+    from types import SimpleNamespace
+
+    events = []
+
+    class Tel:
+        def reliability_event(self, name, value, step):
+            events.append(name)
+
+    cfg = SimpleNamespace(heartbeat=True, heartbeat_interval_s=0.0,
+                          heartbeat_max_missed=2, collective_deadline_s=0.0)
+    hb = HostHeartbeat(cfg, telemetry=Tel(), process_index=0,
+                       process_count=1)
+    with faults.host_loss(hb, peer=1, world=2, after_beats=1):
+        assert hb.beat(step=1) is None
+        assert hb.beat(step=2) is None          # first stale gather
+        det = hb.beat(step=3)                   # second → dead
+    assert det == {"kind": "dead_peer", "peers": [1], "step": 3}
+    assert hb.beat(step=4) == det               # sticky
+    assert events == ["elastic/host_loss_detected"]
+
+    clock = {"t": 0.0}
+    hb2 = HostHeartbeat(
+        SimpleNamespace(heartbeat=True, heartbeat_interval_s=0.0,
+                        heartbeat_max_missed=3, collective_deadline_s=0.5),
+        process_index=0, process_count=2, clock=lambda: clock["t"])
+    with faults.host_loss(hb2, peer=1, world=2, after_beats=0, hang_s=1.0,
+                          advance=lambda s: clock.__setitem__(
+                              "t", clock["t"] + s)):
+        det2 = hb2.beat(step=1)
+    assert det2["kind"] == "hung_collective"
+
+
+def test_host_loss_converts_to_durable_save_and_clean_exit(devices8,
+                                                           tmp_path):
+    """Acceptance: an injected dead peer becomes PreemptionGuard.trigger →
+    durable universal save + reshard hint + clean loop exit — no hang, no
+    raise."""
+    engine = _mk_engine(watchdog={"heartbeat": True,
+                                  "heartbeat_max_missed": 2})
+    guard = PreemptionGuard(str(tmp_path), signals=(), universal=True,
+                            watchdog=engine.watchdog)
+    try:
+        hb = engine.watchdog.heartbeat
+        assert hb is not None
+        exited = steps = 0
+        with faults.host_loss(hb, peer=1, world=2, after_beats=0):
+            for i in range(8):
+                engine.train_batch(_batch(seed=i))
+                steps += 1
+                if guard.step_boundary(engine):
+                    exited = steps
+                    break
+        assert exited == 2  # max_missed=2 → detected on the second gather
+    finally:
+        guard.uninstall()
+    tags = tag_candidates(str(tmp_path))
+    assert len(tags) == 1 and is_universal_tag(str(tmp_path / tags[0]))
+    assert verify_manifest(str(tmp_path / tags[0]))[0] == "verified"
+    hint = read_reshard_hint(str(tmp_path))
+    assert hint is not None and hint["reason"] == "host_loss"
+    assert hint["step"] == 2 and hint["global_batch"] == 8
+    rc = engine.telemetry.reliability_counts
+    assert rc.get("Reliability/elastic/host_loss_detected", 0) == 1
+    assert rc.get("Reliability/violation/host_loss", 0) == 1
+    assert rc.get("Reliability/elastic/saves", 0) == 1
+    engine.destroy()
+
+
+def test_preemption_guard_universal_save_writes_hint(devices8, tmp_path):
+    engine = _mk_engine()
+    guard = PreemptionGuard(str(tmp_path), signals=(), universal=True)
+    try:
+        engine.train_batch(_batch(seed=0))
+        faults.preempt(guard)
+        engine.train_batch(_batch(seed=1))
+        assert guard.step_boundary(engine)
+        assert not guard.step_boundary(engine)  # once per trigger
+    finally:
+        guard.uninstall()
+    hint = read_reshard_hint(str(tmp_path))
+    assert hint["reason"] == "preemption" and hint["step"] == 2
+    assert hint["mesh"]["data"] == 8 and hint["zero_stage"] == 2
+    assert is_universal_tag(str(tmp_path / hint["tag"]))
+    engine.destroy()
+
+
+# --------------------------------------------------------------------------- #
+# elastic resume orchestration
+# --------------------------------------------------------------------------- #
+def test_run_elastic_consumes_hint_and_reshards(devices8, tmp_path):
+    elastic = {"enabled": True, "max_train_batch_size": 8,
+               "micro_batch_sizes": [1, 2, 4], "min_gpus": 1, "max_gpus": 8}
+    base = {"elasticity": elastic,
+            "optimizer": {"type": "adamw", "params": {"lr": 0.05}},
+            "zero_optimization": {"stage": 2},
+            "checkpoint": {"engine": "fast"}, "steps_per_print": 0}
+    mesh_mod.set_mesh(None)
+    e1, *_ = run_elastic(_spec(), base, checkpoint_dir=str(tmp_path))
+    guard = PreemptionGuard(str(tmp_path), signals=(), universal=True)
+    try:
+        e1.train_batch(_batch(seed=0))
+        e1.train_batch(_batch(seed=1))
+        faults.preempt(guard)
+        assert guard.step_boundary(e1)
+    finally:
+        guard.uninstall()
+    ref_w = np.asarray(e1.state.params["w"])
+    e1.destroy()
+
+    # capacity shrank to 5 chips: 4 is the largest compatible scale
+    mesh_mod.set_mesh(None)
+    base2 = dict(base, zero_optimization={"stage": 1})
+    e2, *_ = run_elastic(_spec(), base2, checkpoint_dir=str(tmp_path),
+                         n_chips=5)
+    assert e2.mesh_mgr.world_size == 4
+    assert e2.global_steps == 2
+    assert e2.train_batch_size() == 8  # global batch invariant
+    np.testing.assert_array_equal(np.asarray(e2.state.params["w"]), ref_w)
+    rc = e2.telemetry.reliability_counts
+    assert rc.get("Reliability/elastic/resumes", 0) == 1
+    assert rc.get("Reliability/elastic/reshards", 0) == 1
+    out = e2.train_batch(_batch(seed=2))
+    assert np.isfinite(float(out.loss))
+    e2.destroy()
+
+
+# --------------------------------------------------------------------------- #
+# the drill (acceptance: >= 4 (topology, stage, tier) combinations)
+# --------------------------------------------------------------------------- #
+def test_elastic_drill_shrink_grow_stages(devices8, tmp_path):
+    """train@(8, z2) → preempt → resume@(4, z1) → preempt → grow@(8, z3):
+    drilled trajectory equals the uninterrupted run to 1e-6."""
+    res = elastic_drill(str(tmp_path), total_steps=6)
+    assert res["pass"], res
+    assert res["max_rel_err"] <= 1e-6
+    assert res["steps"] == 6
+    assert res["reliability_events"].get("Reliability/elastic/saves") == 2
+    assert res["reliability_events"].get("Reliability/elastic/resumes") == 2
+    assert res["reliability_events"].get(
+        "Reliability/elastic/drill_pass") == 1
+    assert res["reshard_hint"]["reason"] == "preemption"
+
+
+def test_elastic_drill_host_tier_and_host_loss(devices8, tmp_path):
+    """A second matrix slice: the kill is an injected HOST LOSS, and the
+    resume lands on the host optimizer tier at a different stage."""
+    phases = [DrillPhase(chips=8, zero_stage=1, steps=2, fault="host_loss"),
+              DrillPhase(chips=4, zero_stage=2, optimizer_tier="host")]
+    res = elastic_drill(str(tmp_path), phases=phases, total_steps=5)
+    assert res["pass"], res
+    assert res["reshard_hint"]["reason"] == "host_loss"
+    assert res["reliability_events"].get(
+        "Reliability/elastic/host_loss_detected", 0) >= 1
+
+
+def test_regular_load_checkpoint_delegates_to_universal_loader(devices8,
+                                                               tmp_path):
+    """engine.load_checkpoint pointed at a universal (fragment) tag routes
+    to the elastic loader instead of failing on the missing state/ dir."""
+    e1 = _mk_engine(stage=2)
+    e1.train_batch(_batch(seed=0))
+    e1.save_universal_checkpoint(str(tmp_path), tag="u")
+    ref_w = np.asarray(e1.state.params["w"])
+    e1.destroy()
+    e2 = _mk_engine(stage=1, chips=4, seed=7)
+    path, _ = e2.load_checkpoint(str(tmp_path))
+    assert path.endswith("u") and e2.global_steps == 1
+    np.testing.assert_array_equal(np.asarray(e2.state.params["w"]), ref_w)
+    e2.destroy()
+
+
+# --------------------------------------------------------------------------- #
+# default-path inertness (pinned)
+# --------------------------------------------------------------------------- #
+def test_default_checkpoint_artifacts_byte_identical_pin(devices8, tmp_path):
+    """With elasticity disabled, engine.save_checkpoint writes exactly the
+    pre-elastic artifact set, the state bytes are deterministic, and no
+    Reliability/elastic/* events exist on the default save/load path."""
+    def run(sub):
+        mesh_mod.set_mesh(None)
+        e, *_ = dst.initialize(model=_spec(), config={
+            "train_batch_size": 8,
+            "optimizer": {"type": "sgd", "params": {"lr": 0.1}},
+            "checkpoint": {"engine": "fast"}, "steps_per_print": 0})
+        e.train_batch(_batch(seed=0))
+        path = e.save_checkpoint(str(tmp_path / sub), tag="t")
+        e.load_checkpoint(str(tmp_path / sub))
+        return e, path
+
+    e1, p1 = run("a")
+    e2, p2 = run("b")
+    inv = sorted(os.path.relpath(os.path.join(dp, f), p1)
+                 for dp, _dn, fns in os.walk(p1) for f in fns)
+    assert inv == ["manifest.json", "meta.json", "state/state.bin"]
+    with open(os.path.join(p1, "state", "state.bin"), "rb") as f:
+        b1 = f.read()
+    with open(os.path.join(p2, "state", "state.bin"), "rb") as f:
+        b2 = f.read()
+    assert b1 == b2  # deterministic, byte-identical state artifact
+    assert not os.path.exists(tmp_path / "a" / "reshard_hint.json")
+    for e in (e1, e2):
+        assert not any(k.startswith("Reliability/elastic/")
+                       for k in e.telemetry.reliability_counts)
+        e.destroy()
+
+
+# --------------------------------------------------------------------------- #
+# schema + reporting
+# --------------------------------------------------------------------------- #
+def test_elastic_series_schema_registry():
+    from deepspeed_tpu.telemetry.schema import (RELIABILITY_ELASTIC_SERIES,
+                                                validate_events)
+
+    good = [(f"Reliability/elastic/{m}", 1.0, 1)
+            for m in ("saves", "resumes", "reshards", "host_loss_detected",
+                      "drill_pass")]
+    assert sorted(n for n, _v, _s in good) == sorted(
+        RELIABILITY_ELASTIC_SERIES)
+    assert validate_events(good) == []
+    bad = validate_events([("Reliability/elastic/typo", 1.0, 1)])
+    assert len(bad) == 1 and "RELIABILITY_ELASTIC_SERIES" in bad[0]
+    # other Reliability/* families stay open
+    assert validate_events([("Reliability/checkpoint_saved", 1.0, 1)]) == []
+
+
+def test_telemetry_report_renders_elastic_section(tmp_path):
+    import subprocess
+    import sys
+
+    from deepspeed_tpu.monitor.monitor import JSONLMonitor
+
+    class Cfg:
+        enabled = True
+        output_path = str(tmp_path)
+        job_name = "job"
+
+    mon = JSONLMonitor(Cfg())
+    mon.write_events([("Reliability/elastic/saves", 1.0, 2),
+                      ("Reliability/elastic/resumes", 1.0, 2),
+                      ("Reliability/elastic/reshards", 1.0, 2),
+                      ("Reliability/elastic/host_loss_detected", 1.0, 2),
+                      ("Reliability/elastic/drill_pass", 1.0, 6),
+                      ("Reliability/checkpoint_saved", 1.0, 2)])
+    mon.close()
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = os.path.join(repo, "scripts", "telemetry_report.py")
+    out = subprocess.run(
+        [sys.executable, script, str(tmp_path / "job" / "events.jsonl"),
+         "--reliability"], capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr
+    assert "elastic runtime:" in out.stdout
+    assert "universal saves:      1" in out.stdout
+    assert "host losses detected: 1" in out.stdout
+    assert "drill passes:         1" in out.stdout

@@ -106,6 +106,49 @@ def test_elastic_config_errors():
         compute_elastic_config(ec, target_chips=7)
 
 
+def test_infeasible_inputs_raise_named_elasticity_error():
+    """Satellite: max_train_batch_size below the smallest micro-batch used
+    to return an empty table with no diagnostic — it must raise the
+    documented ElasticityError naming the infeasible inputs."""
+    with pytest.raises(ElasticityError) as ei:
+        get_compatible_chip_counts([8, 16], max_batch=4)
+    msg = str(ei.value)
+    assert "max_train_batch_size=4" in msg and "8" in msg
+    # chip bounds that admit no split are named too
+    with pytest.raises(ElasticityError) as ei:
+        get_compatible_chip_counts([3], max_batch=3, min_chips=2,
+                                   max_chips=2)
+    assert "chip bounds" in str(ei.value)
+    # and the config-level entry point propagates the diagnostic
+    with pytest.raises(ElasticityError):
+        compute_elastic_config({"enabled": True, "max_train_batch_size": 2,
+                                "micro_batch_sizes": [4]})
+
+
+def test_prefer_larger_micro_batch_tie_breaking():
+    """Satellite: at a fixed (batch, chips) with several feasible micro
+    batches, prefer_larger_batch picks the LARGEST micro batch (fewer GAS
+    steps) and prefer_larger_batch=false the smallest."""
+    ec = {"enabled": True, "max_train_batch_size": 8,
+          "micro_batch_sizes": [1, 2], "min_gpus": 1, "max_gpus": 8}
+    batch, mb, cfg = compute_elastic_config(
+        dict(ec, prefer_larger_batch=True), target_chips=4,
+        return_microbatch=True)
+    assert (batch, mb, cfg.gradient_accumulation_steps) == (8, 2, 1)
+    batch, mb, cfg = compute_elastic_config(
+        dict(ec, prefer_larger_batch=False), target_chips=4,
+        return_microbatch=True)
+    assert (batch, mb, cfg.gradient_accumulation_steps) == (8, 1, 2)
+    # the raw table is ordered the same way: first triple per chip count
+    # respects the preference
+    table = get_compatible_chip_counts([1, 2], 8, prefer_larger=True)
+    first = [t for t in table[8] if t[0] == 4][0]
+    assert first == (4, 2, 1)
+    table = get_compatible_chip_counts([1, 2], 8, prefer_larger=False)
+    first = [t for t in table[8] if t[0] == 4][0]
+    assert first == (4, 1, 2)
+
+
 def test_compatible_chip_counts_exact_batch():
     table = get_compatible_chip_counts([2, 4], max_batch=16, min_chips=1,
                                        max_chips=8)
