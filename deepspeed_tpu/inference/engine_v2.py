@@ -209,6 +209,11 @@ class InferenceEngineV2(InferenceEngine):
                 getattr(self.config, "compile_monitor", None),
                 tracer=self.tracer)
         self._req: Dict[int, dict] = {}   # uid → open lifecycle record
+        # uid → fleet TraceContext adopted from a router (telemetry/fleet.py):
+        # the next _req_admit for that uid joins the router's cross-replica
+        # trace instead of minting a private one. Empty unless a router with
+        # the obs plane enabled feeds it — the default path never writes it.
+        self._adopted: Dict[int, Any] = {}
         self._lat: Dict[str, List[float]] = {
             "ttft_ms": [], "itl_ms": [], "queue_ms": [], "e2e_ms": []}
         spec_lbl = "off"
@@ -227,15 +232,47 @@ class InferenceEngineV2(InferenceEngine):
     # per-decode-token → finish. Each request is one trace id; TTFT, ITL,
     # queue time, and e2e latency accumulate for the SLO percentiles.
     # ------------------------------------------------------------------ #
+    def adopt_trace(self, uid: int, ctx) -> None:
+        """Join a router-minted cross-replica trace (a
+        :class:`~..telemetry.fleet.TraceContext`): the next admission of
+        ``uid`` opens a ``replica_leg`` span under the router's root request
+        span instead of minting a private trace — so the full lifecycle,
+        re-homes included, exports as ONE Perfetto trace. No-op with
+        tracing off."""
+        if self._trace_on and ctx is not None:
+            self._adopted[uid] = ctx
+
+    def release_trace(self, uid: int, reason: str = "rehome") -> None:
+        """Cross-replica hand-off: this engine is giving ``uid`` up (drain /
+        failover re-home), so close its open lifecycle spans — otherwise
+        they would never end and never reach the flight-recorder ring — but
+        record NO latency samples (the destination leg owns the stream's SLO
+        story). Tolerant of an absent record, like ``_req_drop``."""
+        self._adopted.pop(uid, None)
+        rec = self._req.pop(uid, None)
+        if rec is None:
+            return
+        if rec["queue"] is not None:
+            rec["queue"].end()
+        rec["span"].end(handoff=reason)
+
     def _req_admit(self, uid: int, prompt_len: int,
                    split: bool = False) -> None:
         if not self._trace_on or uid in self._req:
             return
         now = time.monotonic_ns()
-        tid = self.tracer.new_trace(label=f"request:{uid}")
-        span = self.tracer.begin("request", cat="serving", trace=tid,
-                                 uid=uid, prompt_tokens=prompt_len,
-                                 split=split)
+        ctx = self._adopted.pop(uid, None)
+        if ctx is not None:
+            tid = ctx.trace_id
+            span = self.tracer.begin("replica_leg", cat="serving", trace=tid,
+                                     parent=ctx.parent_span, uid=uid,
+                                     prompt_tokens=prompt_len, split=split,
+                                     replica=ctx.replica)
+        else:
+            tid = self.tracer.new_trace(label=f"request:{uid}")
+            span = self.tracer.begin("request", cat="serving", trace=tid,
+                                     uid=uid, prompt_tokens=prompt_len,
+                                     split=split)
         queue = self.tracer.begin("queue_wait", cat="serving", trace=tid,
                                   parent=span.span_id, uid=uid)
         self._req[uid] = {"trace": tid, "span": span, "queue": queue,
@@ -289,6 +326,7 @@ class InferenceEngineV2(InferenceEngine):
         rec["last_ns"] = t_ns
 
     def _req_finish(self, uid: int, **args) -> None:
+        self._adopted.pop(uid, None)
         rec = self._req.pop(uid, None)
         if rec is None:
             return
@@ -306,6 +344,7 @@ class InferenceEngineV2(InferenceEngine):
         error-bearing surface for unknown/already-finished uids is
         ``finish()``/``park()``/``fork()`` via ``StateManager.lookup``
         (one consistent :class:`UnknownSequenceError`)."""
+        self._adopted.pop(uid, None)
         rec = self._req.pop(uid, None)
         if rec is None:
             return

@@ -14,6 +14,12 @@
   crash failover with token-exact stream replay, and a hysteresis-guarded
   overload degradation ladder (shed → spec off → clamp).
 
+The router also hosts the fleet observability plane
+(``deepspeed_tpu.telemetry.fleet``, ``serving.obs`` config block, default
+OFF): cross-replica request tracing, per-tenant SLO accounting with
+burn-rate alerting, and fleet metric rollups over a bounded in-memory
+time-series store (docs/observability.md "Fleet observability").
+
 The whole layer drives the engine through its public API (``put``,
 ``put_split``, ``step``, ``step_many``, ``park``, ``resume``, ``finish``),
 so serving WITHOUT a scheduler is byte-for-byte the pre-scheduler engine.
@@ -26,3 +32,5 @@ from .fleet import (CircuitBreaker, DegradationLadder,  # noqa: F401
                     FleetConfig)
 from .router import ReplicaRouter, RouterConfig  # noqa: F401
 from .workload import Arrival, TrafficGenerator, WorkloadConfig  # noqa: F401
+from ...telemetry.fleet import (FleetObsConfig,  # noqa: F401
+                                FleetObservability, TraceContext)

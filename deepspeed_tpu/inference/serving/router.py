@@ -32,6 +32,7 @@ import dataclasses
 import itertools
 from typing import Callable, Dict, List, Optional, Sequence
 
+from ...telemetry.fleet import FleetObsConfig, FleetObservability
 from ..ragged import PrefixBlockIndex
 from .fleet import CLOSED, OPEN, CircuitBreaker, DegradationLadder, FleetConfig
 from .scheduler import REJECTED, Request, RequestHandle, ServingScheduler
@@ -47,22 +48,27 @@ class RouterConfig:
     # fleet resilience (circuit breakers, failover, overload degradation) —
     # default OFF: the router behaves exactly as before this block existed
     fleet: FleetConfig = dataclasses.field(default_factory=FleetConfig)
+    # fleet observability plane (cross-replica tracing, tenant SLO
+    # accounting, tsdb — telemetry/fleet.py) — default OFF likewise
+    obs: FleetObsConfig = dataclasses.field(default_factory=FleetObsConfig)
 
     @classmethod
     def from_dict(cls, d) -> "RouterConfig":
         """Build from a config-tree dict, e.g. ``{"load_slack": 4,
         "fleet": {"enabled": true, "failure_threshold": 2}}`` — the
-        ``serving.fleet`` block lands on :attr:`fleet`."""
+        ``serving.fleet`` block lands on :attr:`fleet`, the
+        ``serving.obs`` block on :attr:`obs`."""
         if isinstance(d, cls):
             return d
         d = dict(d or {})
         fleet = FleetConfig.from_dict(d.pop("fleet", {}))
+        obs = FleetObsConfig.from_dict(d.pop("obs", {}))
         known = {k: v for k, v in d.items() if k in cls.__dataclass_fields__}
         unknown = set(d) - set(known)
         if unknown:
             raise ValueError(f"unknown serving router key(s): "
                              f"{sorted(unknown)}")
-        return cls(fleet=fleet, **known)
+        return cls(fleet=fleet, obs=obs, **known)
 
 
 class ReplicaRouter:
@@ -94,6 +100,13 @@ class ReplicaRouter:
             "failovers": 0, "replayed_tokens": 0, "tick_faults": 0,
             "slow_ticks": 0, "probe_ticks": 0, "circuit_open": 0,
             "circuit_half_open": 0, "circuit_closed": 0, "shed_requests": 0}
+        # fleet observability plane (telemetry/fleet.py): cross-replica
+        # request tracing, per-tenant SLO accounting, fleet rollups, tsdb.
+        # Disabled it allocates nothing and no serving path consults it.
+        self.obs = FleetObservability(self.cfg.obs, self.replicas)
+        if self.obs.enabled:
+            for s in self.replicas:
+                s.obs = self.obs
 
     # -- placement -------------------------------------------------------- #
     def _active_idx(self) -> List[int]:
@@ -170,6 +183,8 @@ class ReplicaRouter:
         handle.error = reason
         handle.slo_met = False
         self.fleet_stats["shed_requests"] += 1
+        if self.obs.enabled:
+            self.obs.request_done(handle)
         return handle
 
     def submit(self, request: Request,
@@ -204,6 +219,9 @@ class ReplicaRouter:
                     i = j
                     self.stats["reject_fallbacks"] += 1
                     break
+        if self.obs.enabled:
+            self.obs.begin_request(request)
+            self.obs.placed(request, i)
         handle = self.replicas[i].submit(request, on_token=on_token)
         handle.replica = i
         if request.session_id is not None:
@@ -293,6 +311,8 @@ class ReplicaRouter:
             j = min(pool, key=lambda k: (self.load(k), k))
             self.replicas[j].accept(handle, parked=parked)
             handle.replica = j
+            if self.obs.enabled:
+                self.obs.handoff(handle, src=exclude, dst=j, reason=reason)
             n += 1
             if parked is not None:
                 self.fleet_stats["replayed_tokens"] += len(parked["history"])
@@ -393,3 +413,22 @@ class ReplicaRouter:
 
     def publish_fleet_telemetry(self, step: int = 0):
         return self._publish(self.fleet_events(step))
+
+    def fleet_obs_events(self, step: int = 0):
+        """One publish interval of the fleet observability plane:
+        ``Fleet/*`` rollups + ``Serving/tenant/*`` SLO accounting (+
+        straggler ``Anomaly/*`` findings). Empty with ``serving.obs``
+        disabled (no-events parity pin)."""
+        if not self.obs.enabled:
+            return []
+        return self.obs.events(step)
+
+    def publish_fleet_obs_telemetry(self, step: int = 0):
+        events = self.fleet_obs_events(step)
+        if events:
+            for sched in self.replicas:
+                hub = getattr(sched.engine, "_hub", None)
+                if hub is not None:
+                    self.obs.write_through(hub, events)
+                    break
+        return events

@@ -69,6 +69,11 @@ class Request:
     eos_token_id: Optional[int] = None
     sp: SamplingParams = SamplingParams(greedy=True)
     uid: Optional[int] = None
+    # fleet observability (telemetry/fleet.py; both default None — the
+    # plain serving path never reads them): the billing/SLO tenant tag, and
+    # the router-minted cross-replica TraceContext
+    tenant: Optional[str] = None
+    trace_ctx: Optional[Any] = None
 
 
 class RequestHandle:
@@ -94,6 +99,13 @@ class RequestHandle:
         self._cursor = 0
         self._submit_t: Optional[float] = None
         self._deadline_t = math.inf
+        # fleet observability seams (telemetry/fleet.py): the tenant
+        # accountant's streaming hook, its terminal-accounting latch, and
+        # the last token-arrival time it stamped. All dormant (None/False)
+        # unless a router with the obs plane enabled wires them.
+        self._obs = None
+        self._obs_done = False
+        self._obs_last_t: Optional[float] = None
 
     @property
     def done(self) -> bool:
@@ -115,6 +127,8 @@ class RequestHandle:
                 self.on_token(t)
             if eos is not None and t == eos:
                 break
+        if emitted and self._obs is not None:
+            self._obs.on_tokens(self, emitted)
         return emitted
 
     @property
@@ -164,6 +178,10 @@ class ServingScheduler:
         # (never below what the stream already emitted). None = no clamp —
         # the default path never consults it.
         self.degrade_max_new_tokens: Optional[int] = None
+        # fleet observability plane (telemetry/fleet.py), attached by a
+        # ReplicaRouter whose serving.obs block is enabled. None = every
+        # obs hook below is skipped — the plain path stays byte-identical.
+        self.obs = None
 
     # -- queue ----------------------------------------------------------- #
     @property
@@ -207,7 +225,11 @@ class ServingScheduler:
             handle.state = REJECTED
             handle.error = reason
             self.stats["rejected"] += 1
+            if self.obs is not None:
+                self.obs.request_done(handle)
             return handle
+        if self.obs is not None:
+            handle._obs = self.obs.accountant
         self.handles[request.uid] = handle
         self._push(handle)
         return handle
@@ -246,6 +268,10 @@ class ServingScheduler:
         out: List[Tuple[RequestHandle, Optional[Dict[str, Any]]]] = []
         for uid, h in list(self._live.items()):
             parked = self.engine.park(uid)
+            if h.request.trace_ctx is not None:
+                # cross-replica move: close this engine's leg of the fleet
+                # trace (park alone leaves it open for a SAME-engine resume)
+                self.engine.release_trace(uid, reason="drain")
             h.state = PARKED
             h.preemptions += 1
             del self._live[uid]
@@ -288,11 +314,20 @@ class ServingScheduler:
         for uid, h in list(self._live.items()):
             del self._live[uid]
             self.handles.pop(uid, None)
+            # release BEFORE engine.finish: a stream leaving mid-flight must
+            # end its replica leg tagged as a handoff, not as a normal
+            # finish (finished streams keep the normal span-end path)
+            if h.request.trace_ctx is not None and not h.finished_stream:
+                try:
+                    self.engine.release_trace(uid, reason="failover")
+                except Exception:
+                    pass
             try:
                 self.engine.finish(uid)   # frees slot + blocks when the
             except Exception:             # engine still works (hang/slow);
                 pass                      # a truly crashed engine may leak
-            if h.finished_stream:         # until the breaker re-probes it
+                                          # until the breaker re-probes it
+            if h.finished_stream:
                 self._finalize(h)
                 continue
             h.state = PARKED
@@ -330,6 +365,8 @@ class ServingScheduler:
             h.error = reason
             h.slo_met = False
             self.stats["rejected"] += 1
+            if self.obs is not None:
+                self.obs.request_done(h)
             out.append(h)
         return out
 
@@ -382,6 +419,8 @@ class ServingScheduler:
                 h.slo_met = False
                 self.stats["expired"] += 1
                 self.stats["slo_missed"] += 1
+                if self.obs is not None:
+                    self.obs.request_done(h)
 
     def _admit(self, now: float, seed: int) -> int:
         """Admit while slots + block headroom allow, most urgent first with
@@ -434,6 +473,8 @@ class ServingScheduler:
             slots -= 1
             admitted += 1
             uid = h.request.uid
+            if h.request.trace_ctx is not None:
+                eng.adopt_trace(uid, h.request.trace_ctx)
             h.state = RUNNING
             self._live[uid] = h
             if h.queue_wait_ms is None:
@@ -558,6 +599,8 @@ class ServingScheduler:
         self._e2e_ms.append(h.e2e_ms)
         self.stats["completed"] += 1
         self.stats["slo_met" if h.slo_met else "slo_missed"] += 1
+        if self.obs is not None:
+            self.obs.request_done(h)
 
     # -- telemetry -------------------------------------------------------- #
     def sched_events(self, step: int = 0):

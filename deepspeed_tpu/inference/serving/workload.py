@@ -60,6 +60,10 @@ class WorkloadConfig:
     priorities: Sequence[int] = (0,)
     deadline_ms: float = math.inf
     eos_token_id: Optional[int] = None
+    # billing/SLO tenant tag, stamped onto every generated Request — the
+    # fleet observability plane (telemetry/fleet.py) accounts goodput and
+    # burn rate per tenant; None leaves the request untagged ("default")
+    tenant: Optional[str] = None
 
 
 @dataclasses.dataclass
@@ -123,7 +127,8 @@ class TrafficGenerator:
                        max_new_tokens=self.gen_tokens(),
                        priority=prio, deadline_ms=cfg.deadline_ms,
                        session_id=session_id,
-                       eos_token_id=cfg.eos_token_id)
+                       eos_token_id=cfg.eos_token_id,
+                       tenant=cfg.tenant)
 
     # -- open-loop trace ------------------------------------------------ #
     def arrivals(self, duration_s: float) -> List[Arrival]:

@@ -260,7 +260,10 @@ class JSONLMonitor(MonitorBackend):
         return f
 
     def write_events(self, events: Sequence[Event]) -> None:
-        if not self._f:
+        # guard the CLOSED handle too, not just None: a failed rotation or
+        # an out-of-order close()/atexit pair can leave _f set but closed,
+        # and writing through it raises ValueError out of shutdown paths
+        if self._f is None or self._f.closed:
             return
         now = time.time()
         for name, value, step in events:
@@ -277,7 +280,7 @@ class JSONLMonitor(MonitorBackend):
         try:
             self._f.close()
             os.replace(self.path, self.path + ".1")
-            self._f = open(self.path, "a")
+            self._f = self._open_append(self.path)
         except Exception as e:  # rotation is protective, never fatal
             logger.warning(f"jsonl rotation failed: {e}")
             if self._f is None or self._f.closed:
@@ -288,13 +291,17 @@ class JSONLMonitor(MonitorBackend):
                     self._f = None
 
     def flush(self) -> None:
-        if self._f:
+        if self._f is not None and not self._f.closed:
             self._f.flush()
 
     def close(self) -> None:
-        if self._f:
+        """Idempotent and atexit-safe: tolerant of an already-closed handle
+        (explicit close() THEN the MonitorMaster atexit hook, possibly with
+        a rotation's handle swap in between)."""
+        if self._f is not None:
             try:
-                self._f.close()
+                if not self._f.closed:
+                    self._f.close()
             except Exception:
                 pass
             self._f = None

@@ -5,10 +5,16 @@ See ``docs/observability.md`` for the config surface
 ``profiler``, ``telemetry.trace``, ``telemetry.compile`` (recompilation
 sentinel + per-program MFU attribution), ``telemetry.anomaly`` (step-time
 spike/drift/straggler detection), monitor backends incl. the size-rotated
-JSONL sink, and the pull-based Prometheus metrics endpoint).
+JSONL sink, and the pull-based Prometheus metrics endpoint), plus the
+fleet observability plane (``serving.obs``: cross-replica request tracing,
+per-tenant SLO accounting with burn-rate alerting, and the bounded
+in-memory time-series store behind ``GET /series``).
 """
 
 from .anomaly import AnomalyConfig, AnomalyDetector  # noqa: F401
+from .fleet import (FleetMetricsAggregator, FleetObsConfig,  # noqa: F401
+                    FleetObservability, TenantSLOAccountant, TraceContext,
+                    tenant_slug)
 from .compile import (CompileMonitor, CompileMonitorConfig,  # noqa: F401
                       RecompileBudgetExceeded, peak_flops_per_chip)
 from .hub import TelemetryHub  # noqa: F401
@@ -19,3 +25,4 @@ from .schema import (ANOMALY_SERIES, COMPILE_METRICS,  # noqa: F401
                      SERVING_SERIES, validate_events,
                      validate_jsonl_records)
 from .trace import TraceConfig, Tracer, dump_all, percentiles  # noqa: F401
+from .tsdb import TimeSeriesStore, TsdbConfig  # noqa: F401

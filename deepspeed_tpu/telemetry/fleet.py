@@ -1,0 +1,565 @@
+"""Fleet observability plane: cross-replica request tracing, per-tenant SLO
+accounting with burn-rate alerting, and fleet metric aggregation
+(docs/observability.md "Fleet observability").
+
+Every observability layer below this one is scoped to a single process —
+the TelemetryHub aggregates one replica's counters, the Tracer records one
+flight recorder, the anomaly detector watches one step stream. A
+multi-replica serving fleet (``serving/router.py``) needs the joined view:
+
+- :class:`TraceContext` — the cross-replica trace handle
+  ``ReplicaRouter.submit()`` mints per request and the scheduler propagates
+  through admission, park/resume, and drain/failover re-homing. Each
+  replica engine opens its lifecycle spans as a ``replica_leg`` under the
+  router's root span instead of minting a private trace, so ONE trace id
+  stitches router → queue → prefill → decode → (re-home → re-prefill)
+  across replicas into a single exported Perfetto trace.
+- :class:`TenantSLOAccountant` — requests carry a ``tenant`` tag
+  (``workload.WorkloadConfig.tenant``); completions/rejections and
+  per-token timestamps roll up into ``Serving/tenant/<t>/*`` series, and a
+  fast/slow-window **burn-rate** alerter (multiwindow, à la SRE error
+  budgets: page only when BOTH windows burn hot, re-arm at half threshold)
+  emits monitor events + ``slo_burn_alert`` tracer instants for the tenant
+  that is spending its error budget.
+- :class:`FleetMetricsAggregator` — per-replica scheduler/engine rollups
+  into replica-labeled ``Fleet/replica<i>/*`` series, ``Fleet/agg/*``
+  sum/max/min/mean rollups, pooled-sample percentile merges
+  (``*_merged``), and replica-outlier deltas fed through the EXISTING
+  anomaly detector's straggler path (``Anomaly/host/straggler``).
+- :class:`FleetObservability` — the ``serving.obs`` config block's owner:
+  one :class:`~.tsdb.TimeSeriesStore` backing ``/series`` range queries and
+  the future tuner's ``score()`` API, plus the publish/snapshot surface the
+  router and metrics server consume.
+
+**Default OFF** (``FleetObsConfig.enabled=False``): the router and
+scheduler consult nothing, no context is minted, no events are emitted, no
+store is allocated — the disabled serving path is byte-identical to the
+pre-obs code (parity-pinned).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from .anomaly import AnomalyDetector
+from .trace import percentiles
+from .tsdb import TimeSeriesStore, TsdbConfig
+
+__all__ = ["TraceContext", "FleetObsConfig", "TenantSLOAccountant",
+           "FleetMetricsAggregator", "FleetObservability", "tenant_slug",
+           "TENANT_DEFAULT"]
+
+Event = Tuple[str, float, int]
+
+TENANT_DEFAULT = "default"
+
+# event-name segments must satisfy the schema grammar
+# (telemetry.schema.EVENT_NAME_RE segment: [A-Za-z0-9_.\-]+)
+_SLUG_BAD = re.compile(r"[^A-Za-z0-9_.\-]")
+
+
+def tenant_slug(tenant: Optional[str]) -> str:
+    """Map a raw tenant tag onto one event-name segment: hostile characters
+    become ``_`` so ``Serving/tenant/<slug>/...`` always validates. The RAW
+    name survives as the Prometheus ``tenant=`` label (escaped by
+    ``metrics_server.escape_label_value``)."""
+    if not tenant:
+        return TENANT_DEFAULT
+    return _SLUG_BAD.sub("_", str(tenant)) or TENANT_DEFAULT
+
+
+@dataclasses.dataclass
+class TraceContext:
+    """Cross-replica trace handle, minted at ``ReplicaRouter.submit()``:
+    ``trace_id``/``parent_span`` are what each engine's ``replica_leg``
+    span joins under; ``root`` is the router-owned request span (ended
+    exactly once at finalize — ``Span.end`` is idempotent); ``replica`` is
+    the current placement, restamped on every re-home."""
+
+    trace_id: int
+    parent_span: int
+    root: Any = None
+    tenant: Optional[str] = None
+    replica: Optional[int] = None
+
+
+@dataclasses.dataclass
+class FleetObsConfig:
+    """The ``serving.obs`` config block (default OFF — see module
+    docstring). ``clock`` is injectable and should match the schedulers'
+    clock so TTFT/burn windows share one timeline."""
+
+    enabled: bool = False
+    # mint TraceContexts at submit when any replica tracer is enabled
+    trace_requests: bool = True
+    # -- per-tenant SLO accounting + burn-rate alerting ------------------ #
+    # target goodput fraction per tenant; burn 1.0 = spending the error
+    # budget (1 - target) exactly as fast as it accrues
+    default_slo_target: float = 0.99
+    slo_targets: Dict[str, float] = dataclasses.field(default_factory=dict)
+    burn_fast_window_s: float = 60.0
+    burn_slow_window_s: float = 300.0
+    burn_threshold: float = 2.0     # alert when BOTH windows burn >= this
+    max_tenants: int = 64           # distinct tenant cap (folds overflow)
+    sample_cap: int = 2048          # per-tenant latency/outcome samples kept
+    # -- fleet aggregation ----------------------------------------------- #
+    outlier_frac: float = 0.25      # replica straggler threshold (anomaly)
+    # -- time-series store ------------------------------------------------ #
+    tsdb: TsdbConfig = dataclasses.field(default_factory=TsdbConfig)
+    clock: Callable[[], float] = time.monotonic
+
+    @classmethod
+    def from_dict(cls, d) -> "FleetObsConfig":
+        """Build from a config-tree dict, e.g. ``{"enabled": true,
+        "burn_threshold": 4, "tsdb": {"resolution_s": 0.5}}``."""
+        if isinstance(d, cls):
+            return d
+        d = dict(d or {})
+        tsdb = TsdbConfig.from_dict(d.pop("tsdb", {}))
+        known = {k: v for k, v in d.items() if k in cls.__dataclass_fields__}
+        unknown = set(d) - set(known)
+        if unknown:
+            raise ValueError(
+                f"unknown serving.obs key(s): {sorted(unknown)}")
+        return cls(tsdb=tsdb, **known)
+
+
+# --------------------------------------------------------------------------- #
+# per-tenant SLO accounting
+# --------------------------------------------------------------------------- #
+class _TenantState:
+    __slots__ = ("raw", "slug", "completed", "slo_met", "slo_missed",
+                 "rejected", "ttft_ms", "itl_ms", "outcomes", "burn_alerts",
+                 "armed")
+
+    def __init__(self, raw: str, slug: str, cap: int):
+        from collections import deque
+
+        self.raw = raw
+        self.slug = slug
+        self.completed = 0
+        self.slo_met = 0
+        self.slo_missed = 0
+        self.rejected = 0
+        self.ttft_ms: "Any" = deque(maxlen=cap)
+        self.itl_ms: "Any" = deque(maxlen=cap)
+        self.outcomes: "Any" = deque(maxlen=cap)   # (t, ok) newest last
+        self.burn_alerts = 0
+        self.armed = True
+
+
+class TenantSLOAccountant:
+    """Per-tenant goodput accounting + multiwindow burn-rate alerting (see
+    module docstring). The scheduler calls :meth:`on_tokens` from the
+    streaming seam and :meth:`account` once per terminal handle; both are
+    reached only when the obs plane is enabled."""
+
+    def __init__(self, cfg: FleetObsConfig,
+                 tracer_fn: Optional[Callable[[], Any]] = None):
+        self.cfg = cfg
+        self.clock = cfg.clock
+        self._tracer_fn = tracer_fn
+        self._tenants: Dict[str, _TenantState] = {}
+        # alert history, newest last: {"t","tenant","slug","burn_fast",
+        # "burn_slow","threshold"}
+        self.alerts: List[Dict[str, Any]] = []
+
+    # ------------------------------------------------------------------ #
+    def _state(self, tenant: Optional[str]) -> _TenantState:
+        raw = tenant if tenant else TENANT_DEFAULT
+        st = self._tenants.get(raw)
+        if st is None:
+            if len(self._tenants) >= max(1, self.cfg.max_tenants):
+                # bounded cardinality: overflow tenants fold into one bucket
+                return self._tenants.setdefault(
+                    "__overflow__",
+                    _TenantState("__overflow__", "overflow",
+                                 self.cfg.sample_cap))
+            slug = tenant_slug(raw)
+            taken = {s.slug for s in self._tenants.values()}
+            if slug in taken:   # two hostile names collapsing onto one slug
+                k = 2
+                while f"{slug}_{k}" in taken:
+                    k += 1
+                slug = f"{slug}_{k}"
+            st = self._tenants[raw] = _TenantState(raw, slug,
+                                                   self.cfg.sample_cap)
+        return st
+
+    def slo_target(self, st: _TenantState) -> float:
+        t = float(self.cfg.slo_targets.get(st.raw,
+                                           self.cfg.default_slo_target))
+        return min(max(t, 0.0), 0.9999)
+
+    # ------------------------------------------------------------------ #
+    def on_tokens(self, handle, emitted: int) -> None:
+        """Streaming seam: ``emitted`` tokens just landed on ``handle``.
+        First call per handle stamps TTFT against the scheduler's submit
+        time; later calls spread ITL across the emitted quantum."""
+        if emitted <= 0:
+            return
+        now = self.clock()
+        st = self._state(getattr(handle.request, "tenant", None))
+        last = getattr(handle, "_obs_last_t", None)
+        if last is None:
+            t0 = getattr(handle, "_submit_t", None)
+            if t0 is not None:
+                st.ttft_ms.append((now - t0) * 1e3)
+            if emitted > 1:
+                # the quantum carried decode tokens past the first — spread
+                # the interval over them (same interpolation the engine's
+                # per-request tracer uses)
+                per = 0.0
+                st.itl_ms.extend([per] * (emitted - 1))
+        else:
+            per = (now - last) * 1e3 / emitted
+            st.itl_ms.extend([per] * emitted)
+        handle._obs_last_t = now
+
+    def account(self, handle) -> None:
+        """One terminal handle (DONE or REJECTED): goodput counters, the
+        burn window, and the alert check. Idempotence is the caller's job
+        (``FleetObservability.request_done`` guards per handle)."""
+        st = self._state(getattr(handle.request, "tenant", None))
+        now = self.clock()
+        if handle.state == "rejected":
+            st.rejected += 1
+            ok = False
+        else:
+            st.completed += 1
+            ok = bool(handle.slo_met)
+            if ok:
+                st.slo_met += 1
+            else:
+                st.slo_missed += 1
+        st.outcomes.append((now, ok))
+        self._check_burn(st, now)
+
+    # ------------------------------------------------------------------ #
+    def burn_rate(self, st: _TenantState, window_s: float,
+                  now: Optional[float] = None) -> float:
+        """``error_frac(window) / (1 - slo_target)``: 1.0 = spending the
+        error budget exactly at the sustainable rate, ``threshold``× =
+        paging territory. 0 with no samples in the window."""
+        now = self.clock() if now is None else now
+        lo = now - window_s
+        tot = err = 0
+        for t, ok in reversed(st.outcomes):
+            if t < lo:
+                break
+            tot += 1
+            err += 0 if ok else 1
+        if tot == 0:
+            return 0.0
+        budget = max(1e-4, 1.0 - self.slo_target(st))
+        return (err / tot) / budget
+
+    def _check_burn(self, st: _TenantState, now: float) -> None:
+        fast = self.burn_rate(st, self.cfg.burn_fast_window_s, now)
+        slow = self.burn_rate(st, self.cfg.burn_slow_window_s, now)
+        thr = self.cfg.burn_threshold
+        if st.armed and fast >= thr and slow >= thr:
+            st.armed = False
+            st.burn_alerts += 1
+            rec = {"t": now, "tenant": st.raw, "slug": st.slug,
+                   "burn_fast": fast, "burn_slow": slow, "threshold": thr}
+            self.alerts.append(rec)
+            tracer = self._tracer_fn() if self._tracer_fn else None
+            if tracer is not None and tracer.enabled:
+                tracer.instant("slo_burn_alert", cat="fleet",
+                               tenant=st.raw, burn_fast=round(fast, 3),
+                               burn_slow=round(slow, 3))
+        elif not st.armed and fast < thr / 2.0:
+            st.armed = True    # half-threshold re-arm: no alert flapping
+
+    # ------------------------------------------------------------------ #
+    def tenant_events(self, step: int = 0) -> List[Event]:
+        """``Serving/tenant/<slug>/*`` telemetry events (closed metric set
+        in ``telemetry.schema.TENANT_METRICS``)."""
+        out: List[Event] = []
+        now = self.clock()
+        for raw in sorted(self._tenants):
+            st = self._tenants[raw]
+            done = st.completed
+            vals = {
+                "completed": float(done),
+                "slo_met": float(st.slo_met),
+                "slo_missed": float(st.slo_missed),
+                "rejected": float(st.rejected),
+                "goodput_frac": (st.slo_met / done) if done else 0.0,
+                "ttft_p99_ms": percentiles(list(st.ttft_ms),
+                                           (99,))["p99"],
+                "itl_p99_ms": percentiles(list(st.itl_ms), (99,))["p99"],
+                "slo_burn_rate": self.burn_rate(
+                    st, self.cfg.burn_fast_window_s, now),
+                "slo_burn_alerts": float(st.burn_alerts)}
+            out += [(f"Serving/tenant/{st.slug}/{k}", float(v), step)
+                    for k, v in sorted(vals.items())]
+        return out
+
+    def tenant_summary(self) -> Dict[str, Dict[str, float]]:
+        """{raw tenant: rollup} for benches and reports."""
+        out: Dict[str, Dict[str, float]] = {}
+        for raw, st in sorted(self._tenants.items()):
+            done = st.completed
+            out[raw] = {
+                "completed": float(done), "slo_met": float(st.slo_met),
+                "rejected": float(st.rejected),
+                "goodput_frac": (st.slo_met / done) if done else 0.0,
+                "ttft_p99_ms": percentiles(list(st.ttft_ms), (99,))["p99"],
+                "burn_alerts": float(st.burn_alerts)}
+        return out
+
+    def labels(self) -> Dict[str, str]:
+        """slug → raw tenant (the Prometheus label values)."""
+        return {st.slug: st.raw for st in self._tenants.values()}
+
+
+# --------------------------------------------------------------------------- #
+# fleet metric aggregation
+# --------------------------------------------------------------------------- #
+# the closed per-replica metric set (telemetry.schema validates Fleet/*)
+REPLICA_METRICS = ("live", "queue_depth", "completed", "slo_met",
+                   "goodput_frac", "tokens_emitted", "queue_wait_ms_p99",
+                   "ttft_ms_p99", "itl_ms_p99", "e2e_ms_p99")
+AGG_STATS = ("sum", "max", "min", "mean")
+MERGED_METRICS = ("queue_wait_ms_p99", "ttft_ms_p99", "itl_ms_p99",
+                  "e2e_ms_p99")
+
+
+class _ObsAnomalyCfg:
+    """Minimal AnomalyDetector config shim: straggler path only."""
+
+    def __init__(self, straggler_frac: float):
+        self.enabled = True
+        self.straggler_frac = straggler_frac
+
+
+class FleetMetricsAggregator:
+    """Pull each replica's scheduler counters + latency samples into
+    replica-labeled rollups (module docstring). ``collect()`` is pull-based
+    and idempotent — drive it per publish interval, not per tick."""
+
+    def __init__(self, cfg: FleetObsConfig,
+                 tsdb: Optional[TimeSeriesStore] = None,
+                 anomaly: Optional[AnomalyDetector] = None):
+        self.cfg = cfg
+        self.tsdb = tsdb
+        self.anomaly = anomaly if anomaly is not None else \
+            AnomalyDetector(_ObsAnomalyCfg(cfg.outlier_frac))
+        self.straggler_findings = 0
+
+    # ------------------------------------------------------------------ #
+    @staticmethod
+    def _replica_values(sched) -> Tuple[Dict[str, float],
+                                        Dict[str, List[float]]]:
+        """One replica's closed metric row + its raw latency samples (for
+        the pooled percentile merge)."""
+        stats = sched.stats
+        vals = {"live": float(sched.live_count),
+                "queue_depth": float(sched.queue_depth),
+                "completed": float(stats["completed"]),
+                "slo_met": float(stats["slo_met"]),
+                "tokens_emitted": float(stats["tokens_emitted"]),
+                "goodput_frac": (stats["slo_met"] / stats["completed"])
+                if stats["completed"] else 0.0}
+        qw = list(getattr(sched, "_queue_wait_ms", []) or [])
+        vals["queue_wait_ms_p99"] = percentiles(qw, (99,))["p99"]
+        raw: Dict[str, List[float]] = {"queue_wait_ms_p99": qw}
+        lat = getattr(sched.engine, "_lat", None) or {}
+        for key, metric in (("ttft_ms", "ttft_ms_p99"),
+                            ("itl_ms", "itl_ms_p99"),
+                            ("e2e_ms", "e2e_ms_p99")):
+            samples = list(lat.get(key, []) or [])
+            vals[metric] = percentiles(samples, (99,))["p99"]
+            raw[metric] = samples
+        return vals, raw
+
+    def collect(self, replicas, step: int = 0) -> List[Event]:
+        """``Fleet/*`` rollup events for one publish interval, plus any
+        ``Anomaly/host/straggler`` findings the replica-outlier deltas
+        produced. Every row is also recorded into the tsdb."""
+        per: List[Dict[str, float]] = []
+        raws: List[Dict[str, List[float]]] = []
+        events: List[Event] = []
+        for i, sched in enumerate(replicas):
+            vals, raw = self._replica_values(sched)
+            per.append(vals)
+            raws.append(raw)
+            events += [(f"Fleet/replica{i}/{m}", float(vals[m]), step)
+                       for m in REPLICA_METRICS]
+        events.append(("Fleet/replicas", float(len(per)), step))
+        for m in REPLICA_METRICS:
+            col = [v[m] for v in per]
+            events.append((f"Fleet/agg/{m}_sum", float(sum(col)), step))
+            events.append((f"Fleet/agg/{m}_max", float(max(col)), step))
+            events.append((f"Fleet/agg/{m}_min", float(min(col)), step))
+            events.append((f"Fleet/agg/{m}_mean",
+                           float(sum(col) / len(col)), step))
+        for m in MERGED_METRICS:
+            # percentile-merge: pool the RAW samples across replicas — the
+            # honest fleet p99 (max-of-p99s overstates, mean understates)
+            pooled = [s for r in raws for s in r[m]]
+            events.append((f"Fleet/agg/{m}_merged",
+                           percentiles(pooled, (99,))["p99"], step))
+        # replica-outlier deltas → the anomaly detector's straggler path
+        for m in MERGED_METRICS:
+            col = [v[m] for v in per]
+            med = sorted(col)[len(col) // 2] if col else 0.0
+            if med > 0:
+                events.append((f"Fleet/outlier/{m}",
+                               max(col) / med - 1.0, step))
+        straggler_vec = [v["ttft_ms_p99"] for v in per]
+        if len(straggler_vec) >= 2 and any(v > 0 for v in straggler_vec):
+            findings = self.anomaly.observe_hosts(straggler_vec, step)
+            self.straggler_findings += len(findings)
+            events += [("Anomaly/" + f.series, float(f.value), step)
+                       for f in findings]
+        if self.tsdb is not None:
+            for name, value, _ in events:
+                self.tsdb.record(name, value)
+        return events
+
+
+# --------------------------------------------------------------------------- #
+# the plane
+# --------------------------------------------------------------------------- #
+class FleetObservability:
+    """Owner of the ``serving.obs`` plane for one router (module
+    docstring). Constructed unconditionally by :class:`ReplicaRouter`
+    (cheap when disabled: no store, no accountant state is ever touched —
+    the router checks :attr:`enabled` before every call)."""
+
+    def __init__(self, cfg: Optional[FleetObsConfig], replicas):
+        self.cfg = cfg or FleetObsConfig()
+        self.enabled = bool(self.cfg.enabled)
+        self.replicas = list(replicas)
+        self.stats: Dict[str, int] = {"traced_requests": 0, "handoffs": 0}
+        if not self.enabled:
+            self.tsdb = None
+            self.accountant = None
+            self.aggregator = None
+            return
+        self.tsdb = TimeSeriesStore(self.cfg.tsdb, clock=self.cfg.clock)
+        self.accountant = TenantSLOAccountant(self.cfg,
+                                              tracer_fn=self._tracer)
+        self.aggregator = FleetMetricsAggregator(self.cfg, tsdb=self.tsdb)
+
+    # ------------------------------------------------------------------ #
+    def _tracer(self):
+        """First enabled replica tracer (replicas sharing a hub share one
+        flight recorder — the supported cross-replica configuration)."""
+        for sched in self.replicas:
+            if sched.tracer.enabled:
+                return sched.tracer
+        return None
+
+    # -- request lifecycle ---------------------------------------------- #
+    def begin_request(self, request) -> Optional[TraceContext]:
+        """Mint the cross-replica TraceContext at router submit: the root
+        ``request`` span every replica leg parents under. No-op (returns
+        None) when tracing is off everywhere."""
+        if not self.cfg.trace_requests:
+            return None
+        tracer = self._tracer()
+        if tracer is None:
+            return None
+        tid = tracer.new_trace(label=f"request:{request.uid}")
+        root = tracer.begin("request", cat="fleet", trace=tid,
+                            uid=request.uid,
+                            tenant=request.tenant or TENANT_DEFAULT,
+                            prompt_tokens=len(request.prompt))
+        request.trace_ctx = TraceContext(
+            trace_id=tid, parent_span=root.span_id, root=root,
+            tenant=request.tenant)
+        self.stats["traced_requests"] += 1
+        return request.trace_ctx
+
+    def placed(self, request, replica: int) -> None:
+        ctx = getattr(request, "trace_ctx", None)
+        if ctx is not None:
+            ctx.replica = replica
+
+    def handoff(self, handle, src: int, dst: int, reason: str) -> None:
+        """A drain/failover re-home moved ``handle`` from ``src`` to
+        ``dst``: stamp the context and mark the hop in the trace."""
+        self.stats["handoffs"] += 1
+        ctx = getattr(handle.request, "trace_ctx", None)
+        if ctx is None:
+            return
+        ctx.replica = dst
+        tracer = self._tracer()
+        if tracer is not None and tracer.enabled:
+            tracer.instant("trace_handoff", cat="fleet", trace=ctx.trace_id,
+                           parent=ctx.parent_span, uid=handle.request.uid,
+                           src=src, dst=dst, reason=reason)
+
+    def request_done(self, handle) -> None:
+        """One terminal handle (any path: finalize, expiry, shed, router
+        reject): close the root span and feed tenant accounting. Idempotent
+        per handle — re-homing means several schedulers may see the same
+        handle reach a terminal state."""
+        if getattr(handle, "_obs_done", False):
+            return
+        handle._obs_done = True
+        self.accountant.account(handle)
+        ctx = getattr(handle.request, "trace_ctx", None)
+        if ctx is not None and ctx.root is not None:
+            ctx.root.end(state=handle.state,
+                         slo_met=bool(handle.slo_met),
+                         preemptions=handle.preemptions)
+
+    # -- telemetry ------------------------------------------------------- #
+    def events(self, step: int = 0) -> List[Event]:
+        """One publish interval's worth of ``Fleet/*`` +
+        ``Serving/tenant/*`` (+ straggler ``Anomaly/*``) events; tenant
+        rows are recorded into the tsdb alongside the aggregator's."""
+        out = self.aggregator.collect(self.replicas, step)
+        tenant = self.accountant.tenant_events(step)
+        if self.tsdb is not None:
+            for name, value, _ in tenant:
+                self.tsdb.record(name, value)
+        return out + tenant
+
+    def write_through(self, hub, events: List[Event]) -> None:
+        """Fan events through a TelemetryHub by family (Fleet/tenant rows
+        land in their own value dicts so ``metrics_snapshot`` can fold
+        replica/tenant labels)."""
+        for name, value, s in events:
+            if name.startswith("Fleet/"):
+                hub.fleet_event(name, value, s)
+            elif name.startswith("Serving/tenant/"):
+                hub.tenant_event(name, value, s)
+            elif name.startswith("Anomaly/"):
+                hub.anomaly_counts[name] = \
+                    hub.anomaly_counts.get(name, 0) + 1
+                if hub.rank0 and hub._monitor_on():
+                    hub.monitor.write_events([(name, float(value), int(s))])
+            else:
+                hub.serving_event(name, value, s)
+
+    def metrics_snapshot(self) -> List[Tuple]:
+        """``(name, value, kind[, labels])`` rows for the pull endpoint:
+        ``Fleet/replica<i>/*`` folds onto ``Fleet/<metric>{replica="i"}``,
+        ``Serving/tenant/<slug>/*`` onto
+        ``Serving/tenant/<metric>{tenant="<raw>"}`` (the RAW tenant — the
+        server escapes hostile characters), plus the plain rollups."""
+        rows: List[Tuple] = []
+        if not self.enabled:
+            return rows
+        labels = self.accountant.labels()
+        for name, value, _ in self.events(step=0):
+            parts = name.split("/")
+            if name.startswith("Fleet/replica") and len(parts) == 3:
+                rows.append((f"Fleet/{parts[2]}", float(value), "gauge",
+                             {"replica": parts[1][len("replica"):]}))
+            elif name.startswith("Serving/tenant/") and len(parts) == 4:
+                rows.append((f"Serving/tenant/{parts[3]}", float(value),
+                             "gauge",
+                             {"tenant": labels.get(parts[2], parts[2])}))
+            else:
+                rows.append((name, float(value), "gauge"))
+        return rows

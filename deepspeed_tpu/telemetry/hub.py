@@ -108,6 +108,14 @@ class TelemetryHub:
         # telemetry.schema.MEMORY_TIER_SERIES; same contract as
         # serving_values.
         self.memory_tier_values: Dict[str, float] = {}
+        # fleet observability plane (telemetry/fleet.py; docs/
+        # observability.md "Fleet observability"): Fleet/* cross-replica
+        # rollups and Serving/tenant/* SLO gauges. Same contract as
+        # serving_values; metrics_snapshot folds the replica/tenant path
+        # segment into a Prometheus label.
+        self.fleet_values: Dict[str, float] = {}
+        self.tenant_values: Dict[str, float] = {}
+        self._closed = False
 
     # ------------------------------------------------------------------ #
     def train_event(self, name: str, value: float, step: int = 0) -> None:
@@ -131,6 +139,30 @@ class TelemetryHub:
         if not name.startswith("Serving/"):
             name = "Serving/" + name
         self.serving_values[name] = float(value)
+        if self.rank0 and self._monitor_on():
+            self.monitor.write_events([(name, float(value), int(step))])
+
+    # ------------------------------------------------------------------ #
+    def fleet_event(self, name: str, value: float, step: int = 0) -> None:
+        """Fan out one ``Fleet/<name>`` gauge (cross-replica rollups from
+        the fleet observability plane — ``Fleet/replica<i>/*``,
+        ``Fleet/agg/*``, ``Fleet/outlier/*``; grammar validated by
+        ``telemetry.schema``)."""
+        if not name.startswith("Fleet/"):
+            name = "Fleet/" + name
+        self.fleet_values[name] = float(value)
+        if self.rank0 and self._monitor_on():
+            self.monitor.write_events([(name, float(value), int(step))])
+
+    # ------------------------------------------------------------------ #
+    def tenant_event(self, name: str, value: float, step: int = 0) -> None:
+        """Fan out one ``Serving/tenant/<slug>/<metric>`` gauge (per-tenant
+        SLO accounting — closed metric set in
+        ``telemetry.schema.TENANT_METRICS``)."""
+        if not name.startswith("Serving/tenant/"):
+            name = "Serving/tenant/" + name.removeprefix(
+                "Serving/").removeprefix("tenant/")
+        self.tenant_values[name] = float(value)
         if self.rank0 and self._monitor_on():
             self.monitor.write_events([(name, float(value), int(step))])
 
@@ -303,6 +335,22 @@ class TelemetryHub:
             rows.append((name, float(value), "gauge"))
         for name, value in sorted(self.memory_tier_values.items()):
             rows.append((name, float(value), "gauge"))
+        for name, value in sorted(self.fleet_values.items()):
+            parts = name.split("/")
+            if name.startswith("Fleet/replica") and len(parts) == 3:
+                # per-replica series fold onto one metric with a replica
+                # label (the Compile/<program> pattern below)
+                rows.append((f"Fleet/{parts[2]}", float(value), "gauge",
+                             {"replica": parts[1][len("replica"):]}))
+            else:
+                rows.append((name, float(value), "gauge"))
+        for name, value in sorted(self.tenant_values.items()):
+            parts = name.split("/")
+            if len(parts) == 4:
+                rows.append((f"Serving/tenant/{parts[3]}", float(value),
+                             "gauge", {"tenant": parts[2]}))
+            else:
+                rows.append((name, float(value), "gauge"))
         for name, count in sorted(self.anomaly_counts.items()):
             rows.append((name, float(count), "counter"))
         for name, value in sorted(self.compile_values.items()):
@@ -457,9 +505,21 @@ class TelemetryHub:
     # ------------------------------------------------------------------ #
     def close(self) -> None:
         """Engine shutdown: stop any live trace session, final-dump + close
-        the span tracer, flush + close the monitor backends. Idempotent."""
-        self.profiler.close()
-        self.tracer.close()
+        the span tracer, flush + close the monitor backends. Idempotent and
+        atexit-safe: a second call (e.g. explicit close THEN the monitor's
+        atexit hook, possibly after a JSONL rotation swapped file handles)
+        is a no-op, and no step may raise out of interpreter shutdown."""
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self.profiler.close()
+        except Exception:
+            pass
+        try:
+            self.tracer.close()
+        except Exception:
+            pass
         if self.monitor is not None:
             try:
                 self.monitor.close()

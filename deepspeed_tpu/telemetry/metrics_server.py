@@ -13,14 +13,23 @@ stdlib-only (`http.server` on a daemon thread); binds 127.0.0.1 by default
 and ``port=0`` picks a free port (tests, multi-job hosts). Any object with a
 ``metrics_snapshot() -> [(event_name, value, kind[, labels])]`` works as the
 source; the optional 4th element is a ``{label: value}`` dict rendered as
-``name{label="value"}`` with spec-compliant escaping.
+``name{label="value"}`` with spec-compliant escaping — the fleet
+observability plane uses it for ``replica=`` and ``tenant=`` labels
+(hostile tenant names escape, never corrupt the exposition).
+
+With a :class:`~.tsdb.TimeSeriesStore` attached (``tsdb=``), ``GET
+/series?name=<event name>&last=<seconds>`` answers range queries as JSON
+``{"name", "retention_s", "points": [{t,count,mean,min,max,last}...],
+"summary"}`` — the live-process window the JSONL log can't serve.
 """
 
 from __future__ import annotations
 
 import http.server
+import json
 import re
 import threading
+import urllib.parse
 from typing import Dict, List, Optional, Tuple
 
 __all__ = ["MetricsServer", "prometheus_name", "escape_label_value",
@@ -86,10 +95,12 @@ class MetricsServer:
     >>> srv.stop()
     """
 
-    def __init__(self, source, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, source, host: str = "127.0.0.1", port: int = 0,
+                 tsdb=None):
         self.source = source
         self.host = host
         self.port = port
+        self.tsdb = tsdb
         self._httpd: Optional[http.server.ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -98,6 +109,31 @@ class MetricsServer:
         snap = self.source.metrics_snapshot() \
             if hasattr(self.source, "metrics_snapshot") else []
         return render_prometheus(list(snap))
+
+    def render_series(self, query: str) -> Tuple[int, bytes]:
+        """``/series`` response for a raw query string → (status, JSON
+        body). 404 without a tsdb attached, 400 without ``name=``."""
+        if self.tsdb is None:
+            return 404, json.dumps(
+                {"error": "no time-series store attached"}).encode()
+        q = urllib.parse.parse_qs(query)
+        name = (q.get("name") or [""])[0]
+        if not name:
+            return 400, json.dumps(
+                {"error": "missing required query param: name"}).encode()
+        last_s: Optional[float] = None
+        raw = (q.get("last") or [""])[0]
+        if raw:
+            try:
+                last_s = float(raw)
+            except ValueError:
+                return 400, json.dumps(
+                    {"error": f"bad last= value: {raw!r}"}).encode()
+        body = {"name": name,
+                "retention_s": self.tsdb.retention_s(),
+                "points": self.tsdb.query(name, last_s=last_s),
+                "summary": self.tsdb.summary(name, last_s=last_s)}
+        return 200, json.dumps(body).encode()
 
     def start(self) -> int:
         """Bind and serve; returns the bound port (resolves ``port=0``)."""
@@ -109,15 +145,20 @@ class MetricsServer:
             server_version = "dstpu-metrics/1.0"
 
             def do_GET(self):  # noqa: N802 (stdlib API name)
-                if self.path.split("?")[0] in ("/metrics", "/"):
+                route, _, query = self.path.partition("?")
+                status = 200
+                if route in ("/metrics", "/"):
                     body = outer.render().encode()
                     ctype = "text/plain; version=0.0.4; charset=utf-8"
-                elif self.path.split("?")[0] == "/healthz":
+                elif route == "/healthz":
                     body, ctype = b"ok\n", "text/plain"
+                elif route == "/series":
+                    status, body = outer.render_series(query)
+                    ctype = "application/json"
                 else:
                     self.send_error(404)
                     return
-                self.send_response(200)
+                self.send_response(status)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()

@@ -49,6 +49,8 @@ __all__ = ["EVENT_NAME_RE", "SERVING_SERIES", "TRAIN_SERIES",
            "COMM_METRICS", "COMM_TOTAL_SERIES",
            "COMPILE_METRICS", "COMPILE_TOTAL_SERIES", "ANOMALY_SERIES",
            "MEMORY_TIER_SERIES", "RELIABILITY_ELASTIC_SERIES",
+           "TENANT_METRICS", "FLEET_REPLICA_METRICS", "FLEET_AGG_SERIES",
+           "FLEET_OUTLIER_SERIES", "TRACER_INSTANTS",
            "MFU_SEGMENT_RE", "ANOMALY_PHASES",
            "REMAT_POLICIES", "validate_events", "validate_jsonl_records"]
 
@@ -191,6 +193,49 @@ RELIABILITY_ELASTIC_SERIES = frozenset(
     "Reliability/elastic/" + m for m in (
         "saves", "resumes", "reshards", "host_loss_detected", "drill_pass"))
 
+# Per-tenant SLO accounting (telemetry/fleet.py TenantSLOAccountant;
+# docs/observability.md "Fleet observability"): series are
+# Serving/tenant/<slug>/<metric> with an OPEN tenant-slug namespace (the
+# accountant sanitizes raw tenant tags onto the event-name grammar) but a
+# CLOSED metric set — the same shape as Compile/<program>/<metric>.
+TENANT_METRICS = frozenset((
+    "completed", "slo_met", "slo_missed", "rejected", "goodput_frac",
+    "ttft_p99_ms", "itl_p99_ms", "slo_burn_rate", "slo_burn_alerts"))
+
+# Fleet/* cross-replica rollups (telemetry/fleet.py FleetMetricsAggregator):
+# Fleet/replica<N>/<metric> per-replica rows over a CLOSED metric set,
+# Fleet/agg/<metric>_{sum,max,min,mean} rollups plus the pooled-sample
+# percentile merges (<latency metric>_merged), Fleet/outlier/<latency
+# metric> replica-outlier deltas, and the Fleet/replicas gauge.
+FLEET_REPLICA_METRICS = frozenset((
+    "live", "queue_depth", "completed", "slo_met", "goodput_frac",
+    "tokens_emitted", "queue_wait_ms_p99", "ttft_ms_p99", "itl_ms_p99",
+    "e2e_ms_p99"))
+_FLEET_LATENCY_METRICS = ("queue_wait_ms_p99", "ttft_ms_p99", "itl_ms_p99",
+                          "e2e_ms_p99")
+FLEET_AGG_SERIES = frozenset(
+    [f"Fleet/agg/{m}_{s}" for m in FLEET_REPLICA_METRICS
+     for s in ("sum", "max", "min", "mean")]
+    + [f"Fleet/agg/{m}_merged" for m in _FLEET_LATENCY_METRICS])
+FLEET_OUTLIER_SERIES = frozenset(
+    f"Fleet/outlier/{m}" for m in _FLEET_LATENCY_METRICS)
+_FLEET_REPLICA_RE = re.compile(r"^Fleet/replica\d+/([A-Za-z0-9_]+)$")
+
+# Registered tracer INSTANT names (trace.Tracer.instant call sites across
+# the framework — the flight-recorder grammar consumers like
+# telemetry_report --trace key off). CLOSED: a new instant name must be
+# registered here (a tier-1 test pins exported traces against this set).
+TRACER_INSTANTS = frozenset((
+    # tracer/hub/compile internals
+    "trace_begin", "anomaly", "compile",
+    # serving request lifecycle (engine_v2)
+    "first_token", "decode_token", "parked", "resumed",
+    # scheduler + fleet resilience (serving/scheduler.py, fleet.py, router)
+    "sched_preempt", "degrade", "rehome", "failover",
+    "circuit_open", "circuit_closed",
+    # fleet observability plane (telemetry/fleet.py)
+    "trace_handoff", "slo_burn_alert"))
+
 # Per-program MFU attribution gauges (Train/mfu/<program>,
 # Serving/mfu/<program>, plus the total/headline rollups): the program
 # segment is open-ended but must be one lowercase snake_case token — the
@@ -222,10 +267,35 @@ def validate_events(events: Iterable[Tuple[str, float, int]]) -> List[str]:
                     f"snake_case program segment "
                     f"(telemetry.schema.MFU_SEGMENT_RE)")
                 continue
+        elif name.startswith("Serving/tenant/"):
+            parts = name.split("/")
+            if len(parts) != 4 or parts[3] not in TENANT_METRICS:
+                problems.append(
+                    f"event #{i}: tenant series {name!r} is not a "
+                    f"Serving/tenant/<slug>/<metric> name with a metric "
+                    f"from telemetry.schema.TENANT_METRICS")
+                continue
         elif name.startswith("Serving/") and name not in SERVING_SERIES:
             problems.append(f"event #{i}: serving series {name!r} is not "
                             f"registered in telemetry.schema.SERVING_SERIES")
             continue
+        if name.startswith("Fleet/"):
+            m = _FLEET_REPLICA_RE.match(name)
+            if m is not None:
+                if m.group(1) not in FLEET_REPLICA_METRICS:
+                    problems.append(
+                        f"event #{i}: fleet replica series {name!r} metric "
+                        f"is not registered in "
+                        f"telemetry.schema.FLEET_REPLICA_METRICS")
+                    continue
+            elif name != "Fleet/replicas" and \
+                    name not in FLEET_AGG_SERIES and \
+                    name not in FLEET_OUTLIER_SERIES:
+                problems.append(
+                    f"event #{i}: fleet series {name!r} is not registered "
+                    f"in telemetry.schema FLEET_AGG_SERIES / "
+                    f"FLEET_OUTLIER_SERIES")
+                continue
         if name.startswith(("Train/overlap/", "Train/remat/",
                             "Train/attn/")) and \
                 name not in TRAIN_SERIES:

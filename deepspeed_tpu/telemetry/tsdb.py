@@ -1,0 +1,204 @@
+"""Bounded in-memory telemetry time-series store (docs/observability.md
+"Fleet observability").
+
+The JSONL sink is an unbounded append-only log and the hub's value dicts
+keep only the LAST sample per series — neither can answer "what did
+``Serving/tenant/gold/goodput_frac`` look like over the last five minutes"
+from a live process. :class:`TimeSeriesStore` fills that gap with the
+classic RRD shape, stdlib-only:
+
+- every series holds a few **levels** of downsampled buckets: level 0 at
+  ``resolution_s``, each next level ``fanout``× coarser, every level a ring
+  of at most ``points_per_level`` buckets — so retention grows
+  geometrically while memory stays fixed (``levels × points`` buckets per
+  series, bounded series count);
+- a bucket aggregates every sample that landed in its window as
+  ``(count, sum, min, max, last)`` — enough to answer mean/min/max/last
+  range queries without keeping raw points;
+- :meth:`query` serves the ``/series?name=&last=`` endpoint
+  (telemetry/metrics_server.py) from the finest level that still covers
+  the requested window;
+- :meth:`score` is the read API ROADMAP item 4's self-tuning runtime
+  needs: one number summarizing a series over a window ("the telemetry
+  series that scores a knob"), with ``mode`` selecting mean/min/max/last.
+
+Deliberately stdlib-only and clock-injectable: the serving stack records
+into it from scheduler ticks, and tests drive it with a fake clock.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional
+
+__all__ = ["TsdbConfig", "TimeSeriesStore"]
+
+
+@dataclasses.dataclass
+class TsdbConfig:
+    """The ``serving.obs.tsdb`` sub-block (see
+    :class:`~.fleet.FleetObsConfig`). Defaults retain ~6 minutes at 1 s,
+    ~1 hour at 10 s, and ~10 hours at 100 s, in at most
+    ``3 × 360`` buckets per series."""
+
+    resolution_s: float = 1.0      # level-0 bucket width
+    points_per_level: int = 360    # ring capacity per level
+    levels: int = 3                # downsampling levels
+    fanout: int = 10               # bucket-width multiplier per level
+    max_series: int = 256          # distinct series cap (drops beyond)
+
+    @classmethod
+    def from_dict(cls, d) -> "TsdbConfig":
+        if isinstance(d, cls):
+            return d
+        d = dict(d or {})
+        known = {k: v for k, v in d.items() if k in cls.__dataclass_fields__}
+        unknown = set(d) - set(known)
+        if unknown:
+            raise ValueError(
+                f"unknown serving.obs.tsdb key(s): {sorted(unknown)}")
+        return cls(**known)
+
+
+class _Bucket:
+    """One downsampled window: every sample in ``[t_start, t_start+width)``
+    folded into count/sum/min/max/last."""
+
+    __slots__ = ("t_start", "count", "sum", "min", "max", "last")
+
+    def __init__(self, t_start: float, value: float):
+        self.t_start = t_start
+        self.count = 1
+        self.sum = value
+        self.min = value
+        self.max = value
+        self.last = value
+
+    def add(self, value: float) -> None:
+        self.count += 1
+        self.sum += value
+        if value < self.min:
+            self.min = value
+        if value > self.max:
+            self.max = value
+        self.last = value
+
+    def row(self) -> Dict[str, float]:
+        return {"t": self.t_start, "count": self.count,
+                "mean": self.sum / self.count, "min": self.min,
+                "max": self.max, "last": self.last}
+
+
+class TimeSeriesStore:
+    """See module docstring. Thread-safe (the metrics server's daemon
+    thread queries while the serving loop records)."""
+
+    def __init__(self, cfg: Optional[TsdbConfig] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.cfg = cfg or TsdbConfig()
+        self.clock = clock
+        if self.cfg.resolution_s <= 0:
+            raise ValueError("tsdb resolution_s must be > 0")
+        if self.cfg.fanout < 2:
+            raise ValueError("tsdb fanout must be >= 2")
+        self._levels = max(1, int(self.cfg.levels))
+        self._widths = [self.cfg.resolution_s * self.cfg.fanout ** k
+                        for k in range(self._levels)]
+        self._series: Dict[str, List["deque[_Bucket]"]] = {}
+        self._lock = threading.Lock()
+        self.dropped_series = 0     # records refused past max_series
+
+    # ------------------------------------------------------------------ #
+    def record(self, name: str, value: float,
+               t: Optional[float] = None) -> bool:
+        """Fold one sample into every level's current bucket. Returns False
+        (and counts the drop) when the series cap refuses a NEW series —
+        bounded memory beats silent growth, and the counter makes the
+        truncation visible."""
+        t = self.clock() if t is None else float(t)
+        v = float(value)
+        with self._lock:
+            levels = self._series.get(name)
+            if levels is None:
+                if len(self._series) >= max(1, self.cfg.max_series):
+                    self.dropped_series += 1
+                    return False
+                cap = max(1, self.cfg.points_per_level)
+                levels = [deque(maxlen=cap) for _ in range(self._levels)]
+                self._series[name] = levels
+            for k, ring in enumerate(levels):
+                w = self._widths[k]
+                start = (t // w) * w
+                if ring and ring[-1].t_start == start:
+                    ring[-1].add(v)
+                elif not ring or start > ring[-1].t_start:
+                    ring.append(_Bucket(start, v))
+                # an out-of-order sample older than the open bucket is
+                # folded nowhere at this level (rings only grow forward)
+        return True
+
+    # ------------------------------------------------------------------ #
+    def series_names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._series)
+
+    def retention_s(self) -> float:
+        """Widest window any level can answer."""
+        return self._widths[-1] * max(1, self.cfg.points_per_level)
+
+    def _pick_level(self, last_s: Optional[float]) -> int:
+        """Finest level whose ring can span the requested window."""
+        if last_s is None:
+            return self._levels - 1
+        cap = max(1, self.cfg.points_per_level)
+        for k, w in enumerate(self._widths):
+            if w * cap >= last_s:
+                return k
+        return self._levels - 1
+
+    def query(self, name: str, last_s: Optional[float] = None,
+              now: Optional[float] = None) -> List[Dict[str, float]]:
+        """Bucket rows (oldest first) for ``name`` over the trailing
+        ``last_s`` seconds (everything retained when ``None``), served from
+        the finest level that covers the window. Unknown series → ``[]``."""
+        now = self.clock() if now is None else float(now)
+        with self._lock:
+            levels = self._series.get(name)
+            if levels is None:
+                return []
+            ring = levels[self._pick_level(last_s)]
+            lo = -float("inf") if last_s is None else now - float(last_s)
+            return [b.row() for b in ring if b.t_start + 1e-12 >= lo]
+
+    def summary(self, name: str, last_s: Optional[float] = None,
+                now: Optional[float] = None) -> Dict[str, float]:
+        """Window rollup: ``{count, mean, min, max, last}`` over the same
+        buckets :meth:`query` returns; all-zero for an unknown series."""
+        rows = self.query(name, last_s=last_s, now=now)
+        if not rows:
+            return {"count": 0.0, "mean": 0.0, "min": 0.0, "max": 0.0,
+                    "last": 0.0}
+        count = sum(r["count"] for r in rows)
+        total = sum(r["mean"] * r["count"] for r in rows)
+        return {"count": float(count), "mean": total / count,
+                "min": min(r["min"] for r in rows),
+                "max": max(r["max"] for r in rows),
+                "last": rows[-1]["last"]}
+
+    def score(self, name: str, last_s: Optional[float] = None,
+              mode: str = "mean", now: Optional[float] = None,
+              default: float = 0.0) -> float:
+        """One number for a knob-tuning objective (ROADMAP item 4): the
+        windowed ``mean``/``min``/``max``/``last`` of ``name``, or
+        ``default`` when the window is empty — so a tuner comparing knob
+        settings can call ``score("Serving/tenant/gold/goodput_frac", 60)``
+        before and after a change and diff the result."""
+        if mode not in ("mean", "min", "max", "last"):
+            raise ValueError(f"unknown tsdb score mode {mode!r}")
+        s = self.summary(name, last_s=last_s, now=now)
+        if s["count"] <= 0:
+            return float(default)
+        return float(s[mode])

@@ -650,6 +650,74 @@ def run_chaos(build, sp, vocab, rate_rps, duration_s, prompt_len, gen_len,
     return out
 
 
+def run_multitenant(build, sp, vocab, duration_s, prompt_len, gen_len,
+                    slo_ms_by_tenant, rate_by_tenant):
+    """``detail.multitenant`` (docs/observability.md "Fleet observability"):
+    a seeded two-tenant open-loop overload probe on a TWO-replica fleet
+    with the ``serving.obs`` plane enabled. Each tenant has its own arrival
+    rate and SLO over the seeded ``TrafficGenerator``; the row reports
+    per-tenant goodput-under-SLO and the burn-rate alert count — on a
+    healthy run exactly the SLO-violating tenant alerts."""
+    from deepspeed_tpu.inference.serving import (FleetObsConfig,
+                                                 ReplicaRouter, RouterConfig,
+                                                 SchedulerConfig,
+                                                 ServingScheduler)
+
+    out = {"duration_s": duration_s, "replicas": 2,
+           "slo_ms": dict(slo_ms_by_tenant), "rate_rps": dict(rate_by_tenant)}
+    time_cap = duration_s * 10 + 60
+    arrivals = []
+    for k, (tenant, slo_ms) in enumerate(sorted(slo_ms_by_tenant.items())):
+        traffic = _traffic(seed=29 + k, vocab_size=vocab, process="poisson",
+                           rate_rps=rate_by_tenant[tenant],
+                           prompt_len=prompt_len, gen_len=gen_len,
+                           deadline_ms=slo_ms, tenant=tenant)
+        arrivals.extend(traffic.arrivals(duration_s))
+    arrivals.sort(key=lambda a: a.t)
+    scheds = [ServingScheduler(build(),
+                               SchedulerConfig(max_admissions_per_tick=4))
+              for _ in range(2)]
+    router = ReplicaRouter(scheds, RouterConfig(obs=FleetObsConfig(
+        enabled=True, burn_fast_window_s=max(duration_s, 5.0),
+        burn_slow_window_s=max(duration_s * 4, 20.0), burn_threshold=2.0,
+        default_slo_target=0.9)))
+    hi = prompt_len if isinstance(prompt_len, int) else prompt_len[1]
+    ghi = gen_len if isinstance(gen_len, int) else gen_len[1]
+    for s in scheds:
+        _warm_engine(s.engine, sp, vocab, (hi, hi + ghi), 4)
+    handles = []
+    i = 0
+    t0 = time.perf_counter()
+    while i < len(arrivals) or router.pending:
+        now = time.perf_counter() - t0
+        if now > time_cap:
+            break
+        while i < len(arrivals) and arrivals[i].t <= now:
+            handles.append(router.submit(arrivals[i].request))
+            i += 1
+        if not router.pending:
+            if i < len(arrivals):
+                time.sleep(min(max(arrivals[i].t - now, 0.0), 0.05))
+            continue
+        router.step()
+    events = router.fleet_obs_events(step=0)
+    acc = router.obs.accountant
+    out["tenants"] = {t: {k: round(v, 3) for k, v in row.items()}
+                      for t, row in acc.tenant_summary().items()}
+    out["burn_alerts"] = len(acc.alerts)
+    out["alerted_tenants"] = sorted({a["tenant"] for a in acc.alerts})
+    out["traced_requests"] = router.obs.stats["traced_requests"]
+    out["lost_requests"] = sum(1 for h in handles if not h.done)
+    sys.stderr.write(f"[serving] multitenant: {out}\n")
+    tel_dir = os.environ.get("DSTPU_SERVING_TELEMETRY")
+    if tel_dir:
+        _dump_serving_telemetry(
+            scheds[0].engine, tel_dir, job="serving_bench_fleetobs",
+            extra_events=events + router.router_events(step=0))
+    del router, scheds
+    return out
+
+
 def run_longprompt_probe(build, sp, vocab, rng, batch, short_len, long_len,
                          chunk, n_steps=24):
     """Head-of-line blocking (the FastGen Dynamic-SplitFuse motivation):
@@ -944,6 +1012,43 @@ def main():
             glen_ch, slo_ch)
     except Exception as e:
         RESULT["detail"]["chaos"] = f"error: {str(e)[-200:]}"
+
+    # fleet observability probe: two tenants with different SLOs/arrival
+    # rates on a two-replica fleet with the serving.obs plane enabled —
+    # per-tenant goodput + burn-rate alert counts (docs/observability.md
+    # "Fleet observability"); non-fatal FLEETOBS row in tpu_watch.sh
+    try:
+        if on_tpu:
+            dur_mt, plen_mt, glen_mt = 12.0, (64, 192), (16, 48)
+            slos_mt = {"gold": 8000.0, "bronze": 50.0}
+            rates_mt = {"gold": 8.0, "bronze": 16.0}
+            slots_mt, bs_mt = 12, 32
+        else:
+            dur_mt, plen_mt, glen_mt = 3.0, (12, 24), (3, 8)
+            # gold's SLO is generous (met), bronze's is unmeetable (every
+            # completion misses) — the burn alert must single out bronze
+            slos_mt = {"gold": 30000.0, "bronze": 1.0}
+            rates_mt = {"gold": 6.0, "bronze": 10.0}
+            slots_mt, bs_mt = 6, 16
+        max_tok_mt = plen_mt[1] + glen_mt[1]
+
+        def build_mt():
+            nb = slots_mt * ((max_tok_mt + bs_mt - 1) // bs_mt + 3) + 8
+            return build_engine_v2(
+                llama, mcfg, llama.init(mcfg, jax.random.PRNGKey(0)),
+                config={"dtype": "bfloat16",
+                        "prefill_bucket": min(64, plen_mt[1]),
+                        "prefix_cache": {"enabled": True},
+                        "ragged": {"max_tracked_sequences": slots_mt,
+                                   "max_ragged_batch_size": slots_mt,
+                                   "memory_config_blocks": nb,
+                                   "block_size": bs_mt}})
+
+        RESULT["detail"]["multitenant"] = run_multitenant(
+            build_mt, sp, mcfg.vocab_size, dur_mt, plen_mt, glen_mt,
+            slos_mt, rates_mt)
+    except Exception as e:
+        RESULT["detail"]["multitenant"] = f"error: {str(e)[-200:]}"
 
     # head-of-line probe: long-prompt admission stall, split vs one-shot
     try:

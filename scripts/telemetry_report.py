@@ -20,33 +20,61 @@ from collections import OrderedDict
 from typing import Dict, List
 
 
-def load_events(path: str) -> List[dict]:
-    """Load a JSONL telemetry file, tolerating the torn tail a crash or
-    SIGKILL leaves behind: an unparseable FINAL line is silently dropped
-    (that is what a mid-``write(2)`` kill looks like), unparseable lines
-    elsewhere are dropped with a stderr warning, and undecodable bytes never
-    abort the load. The surviving events still make a full report."""
+def load_events(*paths: str) -> List[dict]:
+    """Load one or more JSONL telemetry files, tolerating the torn tail a
+    crash or SIGKILL leaves behind: an unparseable FINAL line is silently
+    dropped (that is what a mid-``write(2)`` kill looks like), unparseable
+    lines elsewhere are dropped with a stderr warning, and undecodable bytes
+    never abort the load. The surviving events still make a full report.
+
+    With MULTIPLE paths (a fleet of per-replica monitor files) the streams
+    are concatenated in argument order and every record is provenance-tagged
+    with ``"source"`` (the path, disambiguated to its shortest unique
+    suffix) so the ``--fleet`` report can say which replica said what. A
+    single path keeps the historical untagged record shape."""
+    tag = len(paths) > 1
+    labels = _source_labels(paths) if tag else {}
     events = []
-    bad: List[int] = []
-    n_lines = 0
-    with open(path, encoding="utf-8", errors="replace") as f:
-        for n_lines, line in enumerate(f, 1):
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                rec = json.loads(line)
-            except ValueError:
-                bad.append(n_lines)
-                continue
-            if isinstance(rec, dict) and "name" in rec and "value" in rec:
-                events.append(rec)
-    interior = [n for n in bad if n != n_lines]
-    if interior:
-        print(f"warning: skipped {len(interior)} unparseable interior "
-              f"line(s) in {path} (first at line {interior[0]})",
-              file=sys.stderr)
+    for path in paths:
+        bad: List[int] = []
+        n_lines = 0
+        with open(path, encoding="utf-8", errors="replace") as f:
+            for n_lines, line in enumerate(f, 1):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    bad.append(n_lines)
+                    continue
+                if isinstance(rec, dict) and "name" in rec and "value" in rec:
+                    if tag:
+                        rec["source"] = labels[path]
+                    events.append(rec)
+        interior = [n for n in bad if n != n_lines]
+        if interior:
+            print(f"warning: skipped {len(interior)} unparseable interior "
+                  f"line(s) in {path} (first at line {interior[0]})",
+                  file=sys.stderr)
     return events
+
+
+def _source_labels(paths) -> Dict[str, str]:
+    """Shortest-unique-suffix label per path: a fleet's files are usually
+    ``.../replica0/events.jsonl`` vs ``.../replica1/events.jsonl``, where
+    the basename alone would collide."""
+    out: Dict[str, str] = {}
+    for path in paths:
+        parts = path.replace(os.sep, "/").split("/")
+        for k in range(1, len(parts) + 1):
+            label = "/".join(parts[-k:])
+            others = [p for p in paths if p != path]
+            if all(not p.replace(os.sep, "/").endswith(label)
+                   for p in others):
+                break
+        out[path] = label
+    return out
 
 
 def _series(events: List[dict]) -> "OrderedDict[str, List[dict]]":
@@ -809,11 +837,115 @@ def summarize(events: List[dict], last: int = 0) -> str:
     return "\n".join(lines)
 
 
+def fleet(events: List[dict]) -> str:
+    """``--fleet``: the fleet observability plane's offline view — the
+    cross-replica ``Fleet/*`` rollup, the per-tenant SLO table
+    (``Serving/tenant/*``), and the burn-rate alert history — rendered from
+    one or more (merged, provenance-tagged) per-replica JSONL files."""
+    by_name = _series(events)
+    have = any(n.startswith(("Fleet/", "Serving/tenant/")) for n in by_name)
+    if not have:
+        return ("fleet: no Fleet/* or Serving/tenant/* events in this file\n"
+                "  (enable the serving.obs block and publish via "
+                "router.publish_fleet_obs_telemetry)")
+    lines = ["fleet observability"]
+    sources = sorted({e["source"] for e in events if "source" in e})
+    if sources:
+        lines.append(f"  merged from {len(sources)} file(s): "
+                     + ", ".join(sources))
+
+    # -- per-replica rollup (last sample per series wins) ---------------- #
+    replicas: Dict[str, Dict[str, float]] = {}
+    for name, recs in by_name.items():
+        parts = name.split("/")
+        if name.startswith("Fleet/replica") and len(parts) == 3:
+            replicas.setdefault(parts[1][len("replica"):],
+                                {})[parts[2]] = recs[-1]["value"]
+    if replicas:
+        cols = ("live", "queue_depth", "completed", "goodput_frac",
+                "ttft_ms_p99", "e2e_ms_p99")
+        lines.append("")
+        lines.append("  per-replica rollup (last sample)")
+        lines.append("  " + f"{'replica':<9}"
+                     + "".join(f"{c:>14}" for c in cols))
+        for r in sorted(replicas, key=lambda x: (len(x), x)):
+            row = replicas[r]
+            lines.append("  " + f"{r:<9}" + "".join(
+                f"{row.get(c, 0.0):>14.3f}" for c in cols))
+    agg = {n[len("Fleet/agg/"):]: recs[-1]["value"]
+           for n, recs in by_name.items() if n.startswith("Fleet/agg/")}
+    if agg:
+        lines.append("")
+        lines.append("  fleet aggregates (last sample)")
+        for key in ("completed_sum", "tokens_emitted_sum",
+                    "goodput_frac_mean", "goodput_frac_min",
+                    "queue_wait_ms_p99_merged", "ttft_ms_p99_merged",
+                    "itl_ms_p99_merged", "e2e_ms_p99_merged"):
+            if key in agg:
+                lines.append(f"    {key:<28} {agg[key]:,.3f}")
+    outlier = {n[len("Fleet/outlier/"):]: recs[-1]["value"]
+               for n, recs in by_name.items()
+               if n.startswith("Fleet/outlier/")}
+    if outlier:
+        worst = max(outlier.items(), key=lambda kv: kv[1])
+        lines.append(f"    worst replica-outlier delta: {worst[0]} "
+                     f"+{worst[1] * 100:.1f}% over the median replica")
+
+    # -- per-tenant SLO table -------------------------------------------- #
+    tenants: Dict[str, Dict[str, float]] = {}
+    for name, recs in by_name.items():
+        parts = name.split("/")
+        if name.startswith("Serving/tenant/") and len(parts) == 4:
+            tenants.setdefault(parts[2], {})[parts[3]] = recs[-1]["value"]
+    if tenants:
+        lines.append("")
+        lines.append("  per-tenant SLO accounting (last sample)")
+        lines.append(f"  {'tenant':<16} {'completed':>10} {'rejected':>9} "
+                     f"{'goodput':>9} {'ttft p99':>10} {'burn rate':>10} "
+                     f"{'alerts':>7}")
+        for t in sorted(tenants):
+            row = tenants[t]
+            lines.append(
+                f"  {t:<16} {row.get('completed', 0.0):>10.0f} "
+                f"{row.get('rejected', 0.0):>9.0f} "
+                f"{row.get('goodput_frac', 0.0):>9.3f} "
+                f"{row.get('ttft_p99_ms', 0.0):>8.1f}ms "
+                f"{row.get('slo_burn_rate', 0.0):>10.2f} "
+                f"{row.get('slo_burn_alerts', 0.0):>7.0f}")
+
+    # -- burn-rate alert history ----------------------------------------- #
+    # the alert counter is cumulative per tenant: every step where it rose
+    # is one alert firing (multiwindow burn — fast AND slow window hot)
+    fired: List[str] = []
+    for name, recs in sorted(by_name.items()):
+        parts = name.split("/")
+        if not (name.startswith("Serving/tenant/")
+                and name.endswith("/slo_burn_alerts")):
+            continue
+        prev = 0.0
+        for r in recs:
+            if r["value"] > prev:
+                src = f" [{r['source']}]" if "source" in r else ""
+                fired.append(f"    step {r.get('step', 0):>6}  "
+                             f"tenant {parts[2]}  alert "
+                             f"#{int(r['value'])}{src}")
+            prev = max(prev, r["value"])
+    lines.append("")
+    if fired:
+        lines.append(f"  burn-rate alert history ({len(fired)} firing(s))")
+        lines.extend(fired)
+    else:
+        lines.append("  burn-rate alert history: none fired")
+    return "\n".join(lines)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("path", nargs="?",
-                    help="path to an events.jsonl telemetry file "
-                         "(optional with --trace)")
+    ap.add_argument("path", nargs="*",
+                    help="path(s) to events.jsonl telemetry file(s) — "
+                         "multiple files (a fleet's per-replica monitors) "
+                         "are merged with provenance tags (optional with "
+                         "--trace)")
     ap.add_argument("--last", type=int, default=0,
                     help="restrict to the last N steps")
     ap.add_argument("--comm-efficiency", action="store_true",
@@ -855,13 +987,21 @@ def main(argv=None) -> int:
                          "drift, stragglers) and replay the rolling-median/"
                          "MAD detector offline over the Train/Step/*_ms "
                          "series")
+    ap.add_argument("--fleet", action="store_true",
+                    help="summarize the fleet observability plane: "
+                         "cross-replica Fleet/* rollups (per-replica rows, "
+                         "aggregates, outlier deltas), the per-tenant SLO "
+                         "table (Serving/tenant/* goodput, TTFT p99, burn "
+                         "rate), and the burn-rate alert history — pass "
+                         "several per-replica events.jsonl paths to merge "
+                         "them with provenance tags")
     ap.add_argument("--trace", metavar="TRACE_JSON",
                     help="summarize a Chrome-trace/Perfetto JSON flight-"
                          "recorder dump (span durations, slowest spans)")
     ap.add_argument("--all", action="store_true",
                     help="run every section (summary, comm efficiency, "
                          "reliability, serving, latency, compile, "
-                         "anomalies) in one pass")
+                         "anomalies, fleet) in one pass")
     args = ap.parse_args(argv)
     if args.trace:
         try:
@@ -869,25 +1009,26 @@ def main(argv=None) -> int:
         except (OSError, ValueError) as e:
             print(f"error: {e}", file=sys.stderr)
             return 1
-        if args.path is None:
+        if not args.path:
             return 0
         print()
-    if args.path is None:
+    if not args.path:
         ap.error("path to an events.jsonl file is required "
                  "(or use --trace <out.json>)")
     try:
-        events = load_events(args.path)
+        events = load_events(*args.path)
     except OSError as e:
         print(f"error: {e}", file=sys.stderr)
         return 1
     if not events:
-        print(f"error: no telemetry events in {args.path}", file=sys.stderr)
+        print(f"error: no telemetry events in {', '.join(args.path)}",
+              file=sys.stderr)
         return 1
     if args.all:
         sections = [summarize(events, last=args.last), comm_efficiency(events),
                     reliability(events), serving(events), latency(events),
                     memory_report(events), compile_report(events),
-                    anomalies(events)]
+                    anomalies(events), fleet(events)]
         print("\n\n".join(sections))
         return 0
     if args.compile_:
@@ -910,6 +1051,9 @@ def main(argv=None) -> int:
         return 0
     if args.memory:
         print(memory_report(events))
+        return 0
+    if args.fleet:
+        print(fleet(events))
         return 0
     print(summarize(events, last=args.last))
     return 0

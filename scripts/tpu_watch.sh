@@ -173,6 +173,34 @@ try:
 except Exception as e:
     print("[watch] KVQUANT probe: unreadable:", e)
 EOF
+    # fleet-observability row (NON-FATAL — never gates CYCLE_OK or
+    # promotion): the two-tenant serving.obs probe from the SERVING
+    # capture's detail.multitenant (docs/observability.md "Fleet
+    # observability"). The healthy signature is exactly ONE alerted
+    # tenant (the one with the unmeetable SLO) and goodput_frac near
+    # 1.0 for the other; alerted=[] means burn-rate alerting went
+    # dead, both tenants alerting means the fleet itself is slow.
+    python - >> "$LOG" 2>&1 <<'EOF' || true
+import glob, json
+try:
+    src = sorted(glob.glob("bench_runs/SERVING_[0-9]*.json"))[-1]
+    d = json.loads(open(src).read().strip().splitlines()[-1])
+    mt = d.get("detail", {}).get("multitenant")
+    if isinstance(mt, dict) and isinstance(mt.get("tenants"), dict):
+        good = " ".join(
+            "%s=%s" % (t, row.get("goodput_frac"))
+            for t, row in sorted(mt["tenants"].items()))
+        print("[watch] FLEETOBS probe: goodput %s burn_alerts=%s "
+              "alerted=%s lost=%s"
+              % (good, mt.get("burn_alerts"),
+                 ",".join(mt.get("alerted_tenants", [])) or "none",
+                 mt.get("lost_requests")))
+    else:
+        print("[watch] FLEETOBS probe: no detail.multitenant in %s (%r)"
+              % (src, mt))
+except Exception as e:
+    print("[watch] FLEETOBS probe: unreadable:", e)
+EOF
     # elastic-drill row (NON-FATAL — never gates CYCLE_OK or promotion):
     # the preempt→reshard→resume drill on the CPU lane of this host
     # (deepspeed_tpu/testing/drill.py; docs/reliability.md "Elastic

@@ -24,7 +24,10 @@ import pytest  # noqa: E402
 # Tests whose recorded duration exceeds SLOW_S get the 'slow' marker from
 # the checked-in durations file (regenerate: pytest --durations=0 > log,
 # then scripts/update_test_durations.py log). Fast lane: pytest -m "not slow"
-SLOW_S = 10.0
+# The threshold is the budget valve for the fixed-wall-clock fast lane: as
+# the suite grows, ratchet it DOWN so `-m "not slow"` keeps finishing with
+# margin on a 1-core box (the exiled tests still run in the full suite).
+SLOW_S = 8.5
 _dur_path = os.path.join(os.path.dirname(__file__), ".test_durations.json")
 try:
     with open(_dur_path) as _f:

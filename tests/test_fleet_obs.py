@@ -1,0 +1,615 @@
+"""Fleet observability plane tests (docs/observability.md "Fleet
+observability"): the ``serving.obs`` config block, the bounded RRD-style
+time-series store, per-tenant SLO accounting with multiwindow burn-rate
+alerting, fleet metric rollups with replica-outlier → straggler wiring,
+the ``/series`` range endpoint and hostile-tenant Prometheus labels, the
+idempotent monitor/hub close bugfix, the ``telemetry_report.py --fleet``
+offline section — plus the two acceptance pins: a two-replica drain
+re-home exports ONE Perfetto trace with a shared trace id and correct
+parent links across replicas, and a seeded two-tenant overload fires the
+burn-rate alert for the violating tenant ONLY. Default-OFF parity is
+pinned alongside (zero new events, token-identical serving)."""
+
+import json
+import os
+import subprocess
+import sys
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from deepspeed_tpu.comm import mesh as mesh_lib
+from deepspeed_tpu.inference import (ReplicaRouter, Request, RouterConfig,
+                                     SchedulerConfig, ServingScheduler,
+                                     TrafficGenerator, WorkloadConfig,
+                                     build_engine_v2)
+from deepspeed_tpu.inference.serving import DONE
+from deepspeed_tpu.telemetry.fleet import (FleetMetricsAggregator,
+                                           FleetObsConfig,
+                                           FleetObservability,
+                                           TenantSLOAccountant, tenant_slug)
+from deepspeed_tpu.telemetry.metrics_server import (MetricsServer,
+                                                    render_prometheus)
+from deepspeed_tpu.telemetry.schema import (FLEET_AGG_SERIES,
+                                            TENANT_METRICS, TRACER_INSTANTS,
+                                            validate_events)
+from deepspeed_tpu.telemetry.trace import TraceConfig
+from deepspeed_tpu.telemetry.tsdb import TimeSeriesStore, TsdbConfig
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, s):
+        self.t += s
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    from deepspeed_tpu.models import llama
+    cfg = llama.LlamaConfig.tiny(max_seq_len=256)
+    params = llama.init(cfg, jax.random.PRNGKey(0))
+    return llama, cfg, params
+
+
+def build(tiny, blocks=64, block_size=16, slots=4, hub=None, **kw):
+    llama, cfg, params = tiny
+    mesh_lib.set_mesh(None)
+    return build_engine_v2(
+        llama, cfg, params, telemetry_hub=hub,
+        config=dict({"dtype": "float32", "prefill_bucket": 16,
+                     "prefix_cache": {"enabled": True},
+                     "ragged": {"max_tracked_sequences": slots,
+                                "max_ragged_batch_size": slots,
+                                "memory_config_blocks": blocks,
+                                "block_size": block_size}}, **kw))
+
+
+@pytest.fixture(scope="module")
+def eng2(tiny):
+    """TWO warm plain engines shared by every router test in this module
+    (engines drain completely between tests, so fresh ServingSchedulers can
+    wrap them serially — compile cost is paid once)."""
+    return [build(tiny), build(tiny)]
+
+
+@pytest.fixture(scope="module")
+def trace_rig(tiny, tmp_path_factory):
+    """A TelemetryHub with an ENABLED tracer + two SplitFuse engines bound
+    to it: replicas sharing a hub share ONE flight recorder — the supported
+    cross-replica trace configuration. Shared module-wide; tests filter the
+    exported doc by their own trace id."""
+    from deepspeed_tpu.monitor.monitor import JSONLMonitor
+    from deepspeed_tpu.telemetry import TelemetryHub
+
+    class MonCfg:
+        enabled = True
+        output_path = str(tmp_path_factory.mktemp("fleetobs"))
+        job_name = "fleetobs"
+
+    class TelCfg:
+        trace = TraceConfig(enabled=True, ring_size=8192,
+                            dump_on_crash=False)
+
+    class HubCfg:
+        telemetry = TelCfg()
+
+    mon = JSONLMonitor(MonCfg())
+    hub = TelemetryHub(HubCfg(), monitor=mon)
+    engines = [build(tiny, split_prefill_chunk=16, hub=hub)
+               for _ in range(2)]
+    yield hub, engines
+    mon.close()
+    hub.close()
+
+
+# --------------------------------------------------------------------------- #
+# config + slug units
+# --------------------------------------------------------------------------- #
+def test_obs_config_from_dict():
+    cfg = FleetObsConfig.from_dict({
+        "enabled": True, "burn_threshold": 4.0,
+        "slo_targets": {"gold": 0.999},
+        "tsdb": {"resolution_s": 0.5, "levels": 2}})
+    assert cfg.enabled and cfg.burn_threshold == 4.0
+    assert cfg.slo_targets["gold"] == 0.999
+    assert cfg.tsdb.resolution_s == 0.5 and cfg.tsdb.levels == 2
+    assert FleetObsConfig.from_dict(None).enabled is False
+    with pytest.raises(ValueError, match="serving.obs"):
+        FleetObsConfig.from_dict({"burn_treshold": 2})
+    with pytest.raises(ValueError, match="serving.obs.tsdb"):
+        TsdbConfig.from_dict({"resolutions": 1})
+    rc = RouterConfig.from_dict({"obs": {"enabled": True}})
+    assert rc.obs.enabled
+    assert RouterConfig.from_dict(None).obs.enabled is False
+
+
+def test_tenant_slug_hostile_names():
+    assert tenant_slug(None) == "default"
+    assert tenant_slug("") == "default"
+    assert tenant_slug("acme-prod_v1.2") == "acme-prod_v1.2"
+    s = tenant_slug('evil"t{en}\nant')
+    assert '"' not in s and "\n" not in s and "{" not in s
+    # a fully-hostile name still yields a valid segment
+    assert tenant_slug("///") == "___"
+
+
+# --------------------------------------------------------------------------- #
+# time-series store
+# --------------------------------------------------------------------------- #
+def test_tsdb_record_query_levels_score():
+    clk = FakeClock()
+    db = TimeSeriesStore(TsdbConfig(resolution_s=1.0, points_per_level=10,
+                                    levels=2, fanout=10, max_series=4),
+                         clock=clk)
+    for i in range(30):
+        db.record("Serving/tenant/a/goodput_frac", float(i % 10))
+        clk.advance(1.0)
+    # fine level only holds the last 10 s; the coarse level covers all 30
+    fine = db.query("Serving/tenant/a/goodput_frac", last_s=5.0)
+    assert 0 < len(fine) <= 6
+    assert all(r["count"] == 1 for r in fine)
+    coarse = db.query("Serving/tenant/a/goodput_frac", last_s=30.0)
+    assert coarse and coarse[0]["count"] > 1  # 10s buckets
+    assert coarse == sorted(coarse, key=lambda r: r["t"])
+    s = db.summary("Serving/tenant/a/goodput_frac", last_s=30.0)
+    assert s["min"] == 0.0 and s["max"] == 9.0
+    assert db.score("Serving/tenant/a/goodput_frac", 30.0,
+                    mode="max") == 9.0
+    assert db.score("nope", 10.0, default=-1.0) == -1.0
+    with pytest.raises(ValueError):
+        db.score("Serving/tenant/a/goodput_frac", 10.0, mode="p99")
+    # bounded cardinality: past max_series new names are dropped, not grown
+    for k in range(10):
+        db.record(f"Fleet/replica{k}/live", 1.0)
+    assert len(db.series_names()) <= 4
+    assert db.dropped_series > 0
+
+
+# --------------------------------------------------------------------------- #
+# per-tenant burn-rate alerting (unit)
+# --------------------------------------------------------------------------- #
+class _H:
+    """Minimal terminal-handle stand-in for the accountant."""
+
+    def __init__(self, tenant, state="done", slo_met=True):
+        class _R:
+            pass
+
+        self.request = _R()
+        self.request.tenant = tenant
+        self.state = state
+        self.slo_met = slo_met
+        self.preemptions = 0
+
+
+def test_burn_rate_multiwindow_and_rearm():
+    clk = FakeClock()
+    acc = TenantSLOAccountant(FleetObsConfig(
+        enabled=True, default_slo_target=0.9, burn_fast_window_s=10.0,
+        burn_slow_window_s=40.0, burn_threshold=2.0, clock=clk))
+    # healthy tenant never alerts
+    for _ in range(20):
+        acc.account(_H("gold", slo_met=True))
+        clk.advance(1.0)
+    # violating tenant: every completion misses → burn = 1/0.1 = 10 in both
+    # windows → exactly ONE alert while hot (armed-flag, no flapping)
+    for _ in range(20):
+        acc.account(_H("bad", slo_met=False))
+        clk.advance(1.0)
+    assert [a["tenant"] for a in acc.alerts] == ["bad"]
+    assert acc.alerts[0]["burn_fast"] >= 2.0
+    assert acc.alerts[0]["burn_slow"] >= 2.0
+    # recovery: fast window drains below thr/2 → re-arm → a fresh violation
+    # alerts again
+    for _ in range(30):
+        acc.account(_H("bad", slo_met=True))
+        clk.advance(1.0)
+    for _ in range(20):
+        acc.account(_H("bad", slo_met=False))
+        clk.advance(1.0)
+    assert sum(1 for a in acc.alerts if a["tenant"] == "bad") == 2
+    ev = acc.tenant_events(step=3)
+    assert validate_events(ev) == []
+    names = {n for n, _, _ in ev}
+    assert "Serving/tenant/bad/slo_burn_alerts" in names
+    assert "Serving/tenant/gold/goodput_frac" in names
+
+
+def test_tenant_overflow_and_slug_collision():
+    acc = TenantSLOAccountant(FleetObsConfig(enabled=True, max_tenants=2))
+    acc.account(_H("a b"))   # slug a_b
+    acc.account(_H("a,b"))   # collides → a_b_2
+    acc.account(_H("c"))     # over the cap → __overflow__ bucket
+    slugs = {st.slug for st in acc._tenants.values()}
+    assert slugs == {"a_b", "a_b_2", "overflow"}
+    assert acc.labels()["a_b"] == "a b"
+    ev = acc.tenant_events(step=0)
+    assert validate_events(ev) == []
+
+
+# --------------------------------------------------------------------------- #
+# fleet aggregation (duck-typed replicas)
+# --------------------------------------------------------------------------- #
+class _FakeSched:
+    def __init__(self, completed, slo_met, ttft):
+        self.stats = {"completed": completed, "slo_met": slo_met,
+                      "tokens_emitted": completed * 4}
+        self.live_count = 1
+        self.queue_depth = 2
+        self._queue_wait_ms = [1.0, 2.0]
+
+        class _E:
+            pass
+
+        self.engine = _E()
+        self.engine._lat = {"ttft_ms": list(ttft), "itl_ms": [1.0],
+                            "e2e_ms": [5.0]}
+
+
+def test_aggregator_rollups_outliers_straggler():
+    clk = FakeClock()
+    cfg = FleetObsConfig(enabled=True, outlier_frac=0.25, clock=clk)
+    db = TimeSeriesStore(cfg.tsdb, clock=clk)
+    agg = FleetMetricsAggregator(cfg, tsdb=db)
+    reps = [_FakeSched(10, 10, [5.0] * 8),
+            _FakeSched(10, 9, [5.0] * 8),
+            _FakeSched(10, 8, [50.0] * 8)]   # replica 2 is the straggler
+    ev = agg.collect(reps, step=1)
+    assert validate_events(ev) == []
+    d = {n: v for n, v, _ in ev}
+    assert d["Fleet/replicas"] == 3.0
+    assert d["Fleet/agg/completed_sum"] == 30.0
+    assert d["Fleet/agg/completed_mean"] == 10.0
+    # pooled merge: 16 fast + 8 slow samples → p99 lands on the slow tail
+    assert d["Fleet/agg/ttft_ms_p99_merged"] == pytest.approx(50.0)
+    # outlier delta: max/median - 1 = 50/5 - 1
+    assert d["Fleet/outlier/ttft_ms_p99"] == pytest.approx(9.0)
+    # the straggler path fed the EXISTING anomaly family
+    assert agg.straggler_findings >= 1
+    assert any(n == "Anomaly/host/straggler" for n, _, _ in ev)
+    # every row landed in the tsdb
+    assert db.score("Fleet/agg/completed_sum", 60.0) == 30.0
+
+
+# --------------------------------------------------------------------------- #
+# schema closures
+# --------------------------------------------------------------------------- #
+def test_schema_closures():
+    assert {"trace_handoff", "slo_burn_alert"} <= TRACER_INSTANTS
+    assert "goodput_frac" in TENANT_METRICS
+    assert "Fleet/agg/ttft_ms_p99_merged" in FLEET_AGG_SERIES
+    ok = [("Serving/tenant/acme/goodput_frac", 1.0, 0),
+          ("Fleet/replica3/queue_depth", 1.0, 0),
+          ("Fleet/replicas", 2.0, 0)]
+    assert validate_events(ok) == []
+    bad = [("Serving/tenant/acme/bogus_metric", 1.0, 0),
+           ("Fleet/replica1/bogus", 1.0, 0),
+           ("Fleet/agg/bogus_sum", 1.0, 0)]
+    for rec in bad:
+        assert validate_events([rec]), f"{rec[0]} must be rejected"
+
+
+def test_workload_tenant_stamping(tiny):
+    _, cfg, _ = tiny
+    gen = TrafficGenerator(WorkloadConfig(seed=3, vocab_size=cfg.vocab_size,
+                                          tenant="acme"))
+    assert gen.request().tenant == "acme"
+    gen = TrafficGenerator(WorkloadConfig(seed=3, vocab_size=cfg.vocab_size))
+    assert gen.request().tenant is None
+
+
+# --------------------------------------------------------------------------- #
+# default-OFF parity
+# --------------------------------------------------------------------------- #
+def test_default_off_zero_events_and_token_identity(tiny, eng2):
+    """With ``serving.obs`` left at its default the router allocates
+    nothing, mints nothing, emits nothing — and streams stay
+    token-identical to a plain single-scheduler run."""
+    _, cfg, _ = tiny
+    rng = np.random.default_rng(7)
+    prompts = [rng.integers(0, cfg.vocab_size, (12,)).tolist()
+               for _ in range(4)]
+    oracle = ServingScheduler(eng2[0])
+    want = [oracle.submit(Request(prompt=list(p), max_new_tokens=6))
+            for p in prompts]
+    oracle.run()
+    scheds = [ServingScheduler(e) for e in eng2]
+    router = ReplicaRouter(scheds, RouterConfig(load_slack=100))
+    assert router.obs.enabled is False
+    assert router.obs.tsdb is None and router.obs.accountant is None
+    assert all(s.obs is None for s in scheds)
+    got = [router.submit(Request(prompt=list(p), max_new_tokens=6))
+           for p in prompts]
+    router.run()
+    for h, w in zip(got, want):
+        assert h.state == DONE and h.tokens == w.tokens
+        assert h.request.trace_ctx is None
+        assert h._obs is None and h._obs_last_t is None
+    assert router.fleet_obs_events(step=0) == []
+    router.publish_fleet_obs_telemetry(step=0)  # no hub, no obs: no-op
+    # engines minted their own (disabled) tracers; nothing was recorded
+    assert all(len(s.engine.tracer) == 0 for s in scheds)
+
+
+def test_obs_on_without_tracer_still_accounts(tiny, eng2):
+    """obs enabled + tracing off everywhere: no contexts are minted (there
+    is no tracer to parent under) but SLO accounting still runs."""
+    _, cfg, _ = tiny
+    scheds = [ServingScheduler(e) for e in eng2]
+    router = ReplicaRouter(scheds, RouterConfig(
+        load_slack=100, obs=FleetObsConfig(enabled=True)))
+    gen = TrafficGenerator(WorkloadConfig(
+        seed=9, vocab_size=cfg.vocab_size, prompt_len=(8, 16),
+        gen_len=(2, 4), deadline_ms=60000.0, tenant="acme"))
+    hs = [router.submit(gen.request()) for _ in range(4)]
+    router.run()
+    assert all(h.state == DONE for h in hs)
+    assert router.obs.stats["traced_requests"] == 0
+    summ = router.obs.accountant.tenant_summary()
+    assert summ["acme"]["completed"] == 4.0
+    ev = router.fleet_obs_events(step=0)
+    assert validate_events(ev) == []
+    assert any(n.startswith("Fleet/replica1/") for n, _, _ in ev)
+
+
+# --------------------------------------------------------------------------- #
+# ACCEPTANCE: one trace id across a two-replica drain re-home
+# --------------------------------------------------------------------------- #
+@pytest.mark.parametrize("mode", ["drain", "fail_over"])
+def test_cross_replica_trace_one_id_with_parent_links(tiny, trace_rig,
+                                                      tmp_path, mode):
+    """A request re-homed by a mid-prefill drain/failover exports as ONE
+    Perfetto trace: the router's root ``request`` span plus a
+    ``replica_leg`` span per replica, all sharing one trace id, legs
+    parented to the root, with a ``trace_handoff`` instant marking the
+    hop."""
+    _, cfg, _ = tiny
+    hub, engines = trace_rig
+    scheds = [ServingScheduler(e) for e in engines]
+    router = ReplicaRouter(scheds, RouterConfig(
+        load_slack=100, obs=FleetObsConfig(enabled=True)))
+    # seed differs per mode: the engines are warm/shared, so a repeated
+    # prompt would hit the prefix cache and skip the mid-prefill window
+    rng = np.random.default_rng(21 if mode == "drain" else 22)
+    # one live decode per replica keeps SplitFuse to one chunk per tick
+    for _ in range(2):
+        router.submit(Request(
+            prompt=rng.integers(0, cfg.vocab_size, (10,)).tolist(),
+            max_new_tokens=8))
+    prompt = rng.integers(0, cfg.vocab_size, (64,)).tolist()
+    h = router.submit(Request(prompt=list(prompt), max_new_tokens=4,
+                              tenant="acme"))
+    assert h.request.trace_ctx is not None
+    tid = h.request.trace_ctx.trace_id
+    router.step()
+    src = h.replica
+    d = scheds[src].engine.state.seqs[h.uid]
+    assert d.prefilling and 0 < d.seen_tokens < len(prompt)
+    if mode == "drain":
+        router.drain(src)
+    else:
+        router.fail_over(src)
+    dst = h.replica
+    assert dst == 1 - src
+    router.run()
+    assert h.state == DONE
+    # the drain moved the long request AND the short decode living on src
+    assert router.obs.stats["handoffs"] == 2
+    out = str(tmp_path / f"fleet_trace_{mode}.json")
+    assert hub.tracer.export(out)
+    doc = json.loads(open(out).read())
+    evs = doc["traceEvents"]
+    roots = [e for e in evs if e["ph"] == "X" and e["name"] == "request"
+             and e["args"].get("trace_id") == tid]
+    assert len(roots) == 1, "exactly one root span per request"
+    root = roots[0]
+    assert root["cat"] == "fleet"
+    assert root["args"]["uid"] == h.uid
+    assert root["args"]["tenant"] == "acme"
+    legs = [e for e in evs if e["ph"] == "X" and e["name"] == "replica_leg"
+            and e["args"].get("trace_id") == tid]
+    assert len(legs) == 2, "one leg per replica the request ran on"
+    for leg in legs:
+        assert leg["args"]["trace_id"] == tid, "ONE trace id end to end"
+        assert leg["args"]["parent_id"] == root["args"]["span_id"]
+    assert {leg["args"]["replica"] for leg in legs} == {src, dst}
+    # the src leg ended via release_trace, tagged with the hop reason
+    left = "drain" if mode == "drain" else "failover"
+    assert any(leg["args"].get("handoff") == left for leg in legs)
+    hops = [e for e in evs if e["name"] == "trace_handoff"
+            and e["args"].get("trace_id") == tid]
+    assert len(hops) == 1
+    assert hops[0]["args"]["src"] == src and hops[0]["args"]["dst"] == dst
+
+
+# --------------------------------------------------------------------------- #
+# ACCEPTANCE: two-tenant overload alerts the violating tenant ONLY
+# --------------------------------------------------------------------------- #
+@pytest.fixture(scope="module")
+def tenant_router(tiny, eng2):
+    """The seeded two-tenant overload, run ONCE: tenant "gold" with a
+    generous SLO, tenant "bad" with an unmeetable one, interleaved onto a
+    two-replica fleet with the obs plane enabled."""
+    _, cfg, _ = tiny
+    scheds = [ServingScheduler(e) for e in eng2]
+    router = ReplicaRouter(scheds, RouterConfig(
+        load_slack=100, obs=FleetObsConfig(
+            enabled=True, burn_fast_window_s=60.0,
+            burn_slow_window_s=300.0, burn_threshold=2.0)))
+    mk = lambda tenant, slo: TrafficGenerator(WorkloadConfig(
+        seed=13, vocab_size=cfg.vocab_size, prompt_len=(8, 16),
+        gen_len=(2, 4), deadline_ms=slo, tenant=tenant))
+    gold, bad = mk("gold", 60000.0), mk("bad", 1e-6)
+    hs = []
+    for _ in range(6):
+        hs.append(router.submit(gold.request()))
+        hs.append(router.submit(bad.request()))
+    router.run()
+    assert all(h.state == DONE for h in hs)
+    return router
+
+
+def test_two_tenant_overload_alerts_violator_only(tenant_router):
+    router = tenant_router
+    acc = router.obs.accountant
+    assert {a["tenant"] for a in acc.alerts} == {"bad"}, \
+        "burn-rate alert must fire for the violating tenant ONLY"
+    summ = acc.tenant_summary()
+    assert summ["gold"]["goodput_frac"] == 1.0
+    assert summ["bad"]["goodput_frac"] == 0.0
+    assert summ["bad"]["burn_alerts"] >= 1
+    assert summ["gold"]["burn_alerts"] == 0
+    ev = router.fleet_obs_events(step=0)
+    assert validate_events(ev) == []
+    d = {n: v for n, v, _ in ev}
+    assert d["Serving/tenant/bad/slo_burn_alerts"] >= 1.0
+    assert d["Serving/tenant/gold/slo_burn_alerts"] == 0.0
+    # the tsdb saw the tenant rows (the knob-scoring read API)
+    assert router.obs.tsdb.score("Serving/tenant/gold/goodput_frac",
+                                 3600.0) == 1.0
+
+
+# --------------------------------------------------------------------------- #
+# metrics endpoint: labels, escaping, /series
+# --------------------------------------------------------------------------- #
+def test_metrics_snapshot_hostile_tenant_labels(tiny, eng2):
+    _, cfg, _ = tiny
+    scheds = [ServingScheduler(eng2[0])]
+    router = ReplicaRouter(scheds, RouterConfig(
+        obs=FleetObsConfig(enabled=True)))
+    hostile = 'evil"t{en}\nant\\x'
+    gen = TrafficGenerator(WorkloadConfig(
+        seed=5, vocab_size=cfg.vocab_size, prompt_len=(8, 12),
+        gen_len=(2, 3), deadline_ms=60000.0, tenant=hostile))
+    hs = [router.submit(gen.request()) for _ in range(2)]
+    router.run()
+    assert all(h.state == DONE for h in hs)
+    rows = router.obs.metrics_snapshot()
+    trow = next(r for r in rows
+                if r[0] == "Serving/tenant/goodput_frac")
+    assert trow[3]["tenant"] == hostile  # RAW name in the label...
+    text = render_prometheus(rows)
+    # ...escaped on the wire: no raw newline/quote breaks the exposition
+    line = next(ln for ln in text.splitlines()
+                if ln.startswith("dstpu_serving_tenant_goodput_frac{"))
+    assert '\\"' in line and "\\n" in line
+    for ln in text.splitlines():
+        assert '{en}\nant' not in ln
+    rrow = next(r for r in rows if r[0] == "Fleet/queue_depth")
+    assert rrow[3] == {"replica": "0"}
+
+
+def test_series_endpoint(tiny):
+    clk = FakeClock()
+    db = TimeSeriesStore(TsdbConfig(), clock=clk)
+    for i in range(5):
+        db.record("Fleet/agg/completed_sum", float(i))
+        clk.advance(1.0)
+
+    class _Src:
+        def metrics_snapshot(self):
+            return [("Fleet/replicas", 2.0, "gauge")]
+
+    srv = MetricsServer(_Src(), port=0, tsdb=db)
+    port = srv.start()
+    try:
+        url = f"http://127.0.0.1:{port}"
+        with urllib.request.urlopen(
+                url + "/series?name=Fleet/agg/completed_sum&last=60") as r:
+            doc = json.loads(r.read())
+        assert doc["name"] == "Fleet/agg/completed_sum"
+        assert doc["summary"]["count"] == 5
+        assert [p["last"] for p in doc["points"]] == [0, 1, 2, 3, 4]
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(url + "/series?last=60")
+        assert ei.value.code == 400
+        with urllib.request.urlopen(url + "/metrics") as r:
+            assert b"dstpu_fleet_replicas 2" in r.read()
+    finally:
+        srv.stop()
+    # no tsdb attached → 404, not a crash
+    srv2 = MetricsServer(_Src(), port=0)
+    port2 = srv2.start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port2}/series?name=x")
+        assert ei.value.code == 404
+    finally:
+        srv2.stop()
+
+
+# --------------------------------------------------------------------------- #
+# bugfix: idempotent close after rotation
+# --------------------------------------------------------------------------- #
+def test_monitor_and_hub_close_idempotent(tmp_path):
+    from deepspeed_tpu.monitor.monitor import JSONLMonitor
+    from deepspeed_tpu.telemetry import TelemetryHub
+
+    class MonCfg:
+        enabled = True
+        output_path = str(tmp_path)
+        job_name = "closer"
+
+    mon = JSONLMonitor(MonCfg(), max_mb=0.0001)  # ~105 bytes → rotates fast
+    for i in range(20):
+        mon.write_events([("Serving/sched/completed", float(i), i)])
+    assert os.path.exists(mon.path + ".1"), "rotation must have happened"
+    mon.close()
+    mon.close()                                  # double-close: no raise
+    mon.write_events([("Serving/sched/completed", 1.0, 99)])  # no-op, no raise
+    mon.flush()
+
+    class HubCfg:
+        pass
+
+    mon2 = JSONLMonitor(MonCfg())
+    hub = TelemetryHub(HubCfg(), monitor=mon2)
+    hub.close()
+    hub.close()                                  # hub double-close: no raise
+    mon2.close()                                 # out-of-order: no raise
+
+
+# --------------------------------------------------------------------------- #
+# offline report: --fleet over multiple per-host JSONLs
+# --------------------------------------------------------------------------- #
+def test_report_fleet_multipath(tenant_router, tmp_path):
+    from deepspeed_tpu.monitor.monitor import JSONLMonitor
+
+    router = tenant_router
+    paths = []
+    for host in ("hostA", "hostB"):
+
+        class MonCfg:
+            enabled = True
+            output_path = str(tmp_path / host)
+            job_name = "fleet"
+
+        mon = JSONLMonitor(MonCfg())
+        mon.write_events(router.fleet_obs_events(step=0))
+        mon.close()
+        paths.append(mon.path)
+    script = os.path.join(os.path.dirname(__file__), "..", "scripts",
+                          "telemetry_report.py")
+    out = subprocess.run([sys.executable, script, *paths, "--fleet"],
+                         capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr
+    assert "fleet observability" in out.stdout
+    assert "per-replica rollup" in out.stdout
+    assert "per-tenant SLO accounting" in out.stdout
+    assert "gold" in out.stdout and "bad" in out.stdout
+    assert "burn-rate alert" in out.stdout
+    # provenance: two merged sources are called out
+    assert "merged from 2 file(s)" in out.stdout
+    # single-path invocation still works (record shape unchanged)
+    out1 = subprocess.run([sys.executable, script, paths[0], "--fleet"],
+                          capture_output=True, text=True, timeout=60)
+    assert out1.returncode == 0, out1.stderr
+    assert "fleet observability" in out1.stdout
