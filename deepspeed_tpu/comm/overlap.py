@@ -307,6 +307,37 @@ def configure_layer_prefetch(enabled: bool, depth: int = 1,
 def reset_layer_prefetch() -> None:
     configure_layer_prefetch(False, depth=1, shardings=None, quantize=None,
                              gather_axes=(), host_tier=False)
+    configure_scan_slice_layout(None)
+
+
+# ZeRO-3 gather-at-use slice layout for the PLAIN stacked-layer scan (no
+# prefetch). Engine-owned, latest-engine-wins like _LAYER_PREFETCH. Without
+# an explicit constraint, GSPMD is free to re-propagate shardings through
+# the combined fwd+transpose scan it builds for the backward pass — on some
+# backends that repartitioning has produced a numerically WRONG forward for
+# pure-DP ZeRO-3 (observed: CPU SPMD, data=8, logits off by O(1) whenever
+# grads are live while the forward-only program is correct). Pinning each
+# sliced layer to the gathered compute layout is semantically exactly
+# "all-gather at use" and closes that freedom.
+_SCAN_SLICE: dict = {"shardings": None}
+
+
+def configure_scan_slice_layout(shardings) -> None:
+    """Publish the gathered per-layer compute layout (pytree of
+    NamedShardings matching the model's per-layer subtree, stacked dim
+    dropped — same shape as ``configure_layer_prefetch``'s ``shardings``)
+    that the model families' PLAIN ``lax.scan`` bodies pin their layer
+    slices to. ``None`` disables the constraint. Takes effect at the next
+    train-step trace."""
+    _SCAN_SLICE["shardings"] = shardings
+
+
+def constrain_scan_slice(sliced):
+    """Pin one scan-body layer slice to the published gathered layout
+    (identity when nothing is published or the structures mismatch). Safe
+    to apply on top of :func:`prefetch_scan`'s own constraint — pinning to
+    the same sharding twice is a no-op."""
+    return _constrain_layer(sliced, _SCAN_SLICE["shardings"])
 
 
 def layer_prefetch_active() -> bool:

@@ -7,6 +7,7 @@ from .engine_v2 import (InferenceEngineV2, build_engine_v2,  # noqa: F401
 from .ragged import (BlockedAllocator, PrefixBlockIndex,  # noqa: F401
                      SequenceDescriptor, StateManager, UnknownSequenceError)
 from .sampling import SamplingParams, sample  # noqa: F401
-from .serving import (FleetConfig, ReplicaRouter, Request,  # noqa: F401
-                      RequestHandle, RouterConfig, SchedulerConfig,
-                      ServingScheduler, TrafficGenerator, WorkloadConfig)
+from .serving import (DisaggConfig, FleetConfig,  # noqa: F401
+                      ReplicaRouter, Request, RequestHandle, RouterConfig,
+                      SchedulerConfig, ServingScheduler, TrafficGenerator,
+                      WorkloadConfig)

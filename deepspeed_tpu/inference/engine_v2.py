@@ -29,6 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..comm.mesh import MeshManager
+from ..ops.quantization import kv_dequantize_int8, kv_quantize_int8
 from ..telemetry.compile import CompileMonitor
 from ..telemetry.trace import Tracer, percentiles
 from ..utils.logging import log_dist
@@ -1365,6 +1366,152 @@ class InferenceEngineV2(InferenceEngine):
         self._slot_sp[s] = (self._canon_sp(sp) if sp is not None
                             else self._slot_sp[parent_slot])
         return desc
+
+    # ------------------------------------------------------------------ #
+    # Disaggregated prefill → decode handoff (docs/serving.md
+    # "Disaggregated prefill/decode"). A prefill-tier replica finishes a
+    # prompt, then its router ships the sequence's FULL chain-hashed KV
+    # blocks to a decode-tier replica: export reads block slices off the
+    # paged pool (optionally re-coding them to the int8+scales wire
+    # format), import lands them in the destination's retained prefix
+    # pool keyed by the same chain hashes, and the parked request resumes
+    # there — ``admit_prompt`` resolves the imported blocks as an
+    # admit-time hit, so only the partial tail block is re-prefilled.
+
+    def kv_chain_hashes(self, uid: int) -> List[bytes]:
+        """Chain hashes of ``uid``'s full KV blocks, indexing any newly
+        full blocks first — the handoff planner keys the wire transfer
+        (and the destination's dedup probe) on these."""
+        desc = self.state.lookup(uid)
+        self.state.mark_filled(desc)
+        return list(desc.block_hashes)
+
+    def resident_prefix(self, chain_hashes: List[bytes]) -> int:
+        """How many LEADING entries of ``chain_hashes`` are already
+        canonical in this engine's prefix index. The handoff planner skips
+        shipping those blocks: a destination-resident shared prefix never
+        crosses the wire (the probe is advisory — eviction between probe
+        and resume only costs re-prefill, never correctness)."""
+        if not self.state.prefix_cache:
+            return 0
+        return len(self.state.index.match(list(chain_hashes)))
+
+    def export_kv_blocks(self, uid: int, skip: int = 0,
+                         wire: str = "native",
+                         wire_group: int = 64) -> Dict[str, Any]:
+        """Read ``uid``'s full KV blocks after ``skip`` off the paged pool
+        as host arrays for a prefill→decode handoff. Must be called while
+        the sequence is still tracked (i.e. BEFORE ``park``).
+
+        Wire formats (docs/serving.md):
+
+        - ``"native"`` — cache leaves verbatim (bitwise). On a quantized-KV
+          engine this already IS int8 codes + fp32 group scales, i.e. the
+          half-width wire format for free;
+        - ``"int8"`` — a bf16/fp32 engine re-codes k/v to int8 codes +
+          fp32 per-``wire_group`` scales at the seam, halving wire bytes
+          (lossy at the handoff boundary — greedy token-identity pins use
+          bitwise configurations). On a quantized engine this is a no-op
+          alias for ``"native"``.
+
+        Returns ``{"uid", "hashes", "skip", "blocks", "wire_bytes",
+        "bf16_equiv_bytes", "block_wire_bytes"}`` where
+        ``bf16_equiv_bytes`` is what the same blocks would cost as 2-byte
+        k/v (the wire-ratio denominator) and ``block_wire_bytes`` is one
+        block's wire footprint — what each ``skip``-ped (dedup'd) block
+        did NOT cost."""
+        if wire not in ("native", "int8"):
+            raise ValueError(f"unknown KV wire format {wire!r}")
+        desc = self.state.lookup(uid)
+        self.state.mark_filled(desc)
+        hashes = list(desc.block_hashes)
+        skip = max(0, min(int(skip), len(hashes)))
+        quantize = wire == "int8" and not self._kvq_on
+        if quantize:
+            hd = self.family.cfg.head_size
+            wire_group = min(int(wire_group), hd)
+            if wire_group < 1 or hd % wire_group:
+                raise ValueError(
+                    f"wire_group {wire_group} does not divide "
+                    f"head_size {hd}")
+        per_block = 0
+        for n in sorted(self.cache):
+            leaf = self.cache[n]
+            elems = int(np.prod(leaf.shape)) // int(leaf.shape[1])
+            if quantize and n in ("k", "v"):
+                per_block += elems + (elems // wire_group) * 4
+            else:
+                per_block += elems * leaf.dtype.itemsize
+        blocks: List[Dict[str, np.ndarray]] = []
+        wire_bytes = 0
+        bf16_equiv = 0
+        for h, b in zip(hashes[skip:], desc.blocks[skip:len(hashes)]):
+            payload = {n: np.asarray(self.cache[n][:, b])
+                       for n in sorted(self.cache)}
+            # int8 codes mirror the bf16 element count, so k/v sizes give
+            # the bf16-equivalent bytes in every wire mode
+            bf16_equiv += 2 * (payload["k"].size + payload["v"].size)
+            if quantize:
+                for n in ("k", "v"):
+                    codes, scales = kv_quantize_int8(
+                        jnp.asarray(payload[n]), wire_group)
+                    payload[n] = np.asarray(codes)
+                    payload[n + "_scale"] = np.asarray(scales)
+            wire_bytes += sum(a.nbytes for a in payload.values())
+            blocks.append(payload)
+        return {"uid": uid, "hashes": hashes[skip:], "skip": skip,
+                "blocks": blocks, "wire_bytes": wire_bytes,
+                "bf16_equiv_bytes": bf16_equiv,
+                "block_wire_bytes": per_block}
+
+    def import_kv_blocks(self, chain_hashes: List[bytes],
+                         blocks: List[Dict[str, np.ndarray]]) -> Dict[str, int]:
+        """Land exported KV blocks in THIS engine's retained prefix pool,
+        keyed by their chain hashes. Per block: already-canonical hashes
+        are deduplicated (the probe raced a concurrent admission), the
+        rest adopt a retained block via ``StateManager.adopt_block`` and
+        stamp the converted payload into the device pool. A dropped block
+        (pool exhausted / retention off) is harmless — resume re-prefills
+        that suffix. Returns ``{"imported", "dedup", "dropped"}``."""
+        res = {"imported": 0, "dedup": 0, "dropped": 0}
+        for h, payload in zip(chain_hashes, blocks):
+            if self.state.prefix_cache and h in self.state.index._by_hash:
+                res["dedup"] += 1
+                continue
+            blk = self.state.adopt_block(h)
+            if blk is None:
+                res["dropped"] += 1
+                continue
+            self._spill_write_block(blk, self._wire_to_cache(payload))
+            res["imported"] += 1
+        return res
+
+    def _wire_to_cache(self, payload: Dict[str, np.ndarray]) -> List[Any]:
+        """Convert one wire-format block payload to this engine's cache
+        leaf order (``jax.tree.leaves`` = sorted keys). Matching formats
+        pass through bitwise; int8 wire dequantizes into a float pool;
+        float wire (or a mismatched scale grouping) re-quantizes into a
+        quantized pool at the local group size."""
+        keys = sorted(self.cache.keys())
+        wired_int8 = "k_scale" in payload
+        if self._kvq_on:
+            ng = self.family.cfg.head_size // self._kvq_group
+            if wired_int8 and payload["k_scale"].shape[-1] == ng:
+                return [payload[k] for k in keys]           # bitwise
+            conv: Dict[str, Any] = {}
+            for n in ("k", "v"):
+                x = (kv_dequantize_int8(jnp.asarray(payload[n]),
+                                        jnp.asarray(payload[n + "_scale"]))
+                     if wired_int8 else jnp.asarray(payload[n]))
+                conv[n], conv[n + "_scale"] = kv_quantize_int8(
+                    x, self._kvq_group)
+            return [conv[k] for k in keys]
+        if wired_int8:
+            dt = self.cache["k"].dtype
+            return [kv_dequantize_int8(jnp.asarray(payload[n]),
+                                       jnp.asarray(payload[n + "_scale"]),
+                                       dtype=dt) for n in keys]
+        return [payload[k] for k in keys]                   # bitwise
 
     # ------------------------------------------------------------------ #
     def prefix_cache_events(self, step: int = 0):

@@ -479,6 +479,34 @@ class StateManager:
             self.prefix_stats["prefill_tokens_saved"] += cached
         return desc, cached
 
+    def adopt_block(self, h: bytes) -> Optional[int]:
+        """Land a foreign full block (disaggregated prefill→decode handoff)
+        as a RETAINED canonical block keyed by chain hash ``h``, returning
+        the device block id the caller must fill, or ``None`` when the
+        adoption is refused (hash already canonical here, pool exhausted,
+        or retention disabled so the orphan block would leak).
+
+        The block rides the normal retained-landing path (allocate →
+        index → release-to-zero), so the retention cap, eviction order and
+        ``debug_check`` invariants all apply to imported blocks exactly as
+        to locally produced ones. A later ``admit_prompt`` on the same
+        token prefix then matches it as an ordinary admit-time hit."""
+        if not self.prefix_cache or h in self.index._by_hash:
+            return None
+        self._reclaim(1)
+        if self.allocator.free_blocks < 1:
+            return None
+        blk = self.allocator.allocate(1)[0]
+        self.index.insert(blk, h)
+        if self.spill_pool is not None:
+            # the device copy is canonical: a stale host-spilled twin would
+            # violate the "never both spilled and resident" invariant
+            self.spill_pool.pop(h)
+        self._release_block(blk)        # refcount 1 → 0: retained (or freed
+        if not self.index.is_indexed(blk):  # when max_retained == 0)
+            return None
+        return blk
+
     def fork(self, uid: int, new_uid: int) -> SequenceDescriptor:
         """Admit ``new_uid`` sharing ALL of ``uid``'s blocks (parallel
         sampling / best-of-n). Both sequences now share the partial tail

@@ -12,7 +12,11 @@
 - :mod:`.fleet` — fleet resilience (``serving.fleet`` config block,
   default OFF): per-replica circuit breakers over tick faults/hangs,
   crash failover with token-exact stream replay, and a hysteresis-guarded
-  overload degradation ladder (shed → spec off → clamp).
+  overload degradation ladder (shed → spec off → clamp);
+- :mod:`.disagg` — disaggregated prefill/decode tiers (``serving.disagg``
+  config block, default OFF): prefill replicas hand finished prompts to
+  decode replicas as chain-hash-keyed paged-KV block transfers over the
+  int8 wire format, absorbed via the destination's prefix cache.
 
 The router also hosts the fleet observability plane
 (``deepspeed_tpu.telemetry.fleet``, ``serving.obs`` config block, default
@@ -28,6 +32,7 @@ so serving WITHOUT a scheduler is byte-for-byte the pre-scheduler engine.
 from .scheduler import (QUEUED, RUNNING, PARKED, DONE,  # noqa: F401
                         REJECTED, Request, RequestHandle, SchedulerConfig,
                         ServingScheduler)
+from .disagg import DisaggConfig  # noqa: F401
 from .fleet import (CircuitBreaker, DegradationLadder,  # noqa: F401
                     FleetConfig)
 from .router import ReplicaRouter, RouterConfig  # noqa: F401

@@ -23,6 +23,16 @@ The router (``router.py``) owns one breaker + one ladder per replica and
 drives both from ``step()``; failover itself (``ReplicaRouter.fail_over``)
 re-homes a failed replica's requests by replaying prompt + already-emitted
 tokens through the park/resume seam — see ``scheduler.abandon_all``.
+
+With disaggregated tiers on (``serving.disagg``, ``disagg.py``), the same
+machinery becomes tier-aware without growing any new state: breakers and
+ladders stay per-replica, but re-homing targets the PREFILL tier
+regardless of which tier failed — a replayed history is a prefill-shaped
+job, so a dead decode replica's streams re-prefill behind the admission
+door and then hand off again like fresh arrivals, while a dead prefill
+replica's streams land on a surviving prefill peer. A KV export that
+faults mid-handoff is charged to the source replica's breaker exactly
+like a tick fault.
 """
 
 from __future__ import annotations

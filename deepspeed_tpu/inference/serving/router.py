@@ -24,6 +24,18 @@ survivors by replaying prompt + already-emitted tokens through the
 park/resume seam — token-identical greedy streams, exactly-once delivery —
 while a hysteresis-guarded degradation ladder sheds load under KV/queue
 pressure instead of letting the pool collapse.
+
+With the ``serving.disagg`` block enabled (:class:`~.disagg.DisaggConfig`,
+default OFF — the single-tier router is byte-identical with it off), the
+pool splits into a prefill tier and a decode tier: admissions land only on
+prefill replicas, and each sequence that finishes its prompt is handed off
+to a decode replica as a chain-hash-keyed paged-KV block transfer
+(``engine.export_kv_blocks`` → ``engine.import_kv_blocks``) over the
+half-width int8 wire format, with destination-resident shared prefixes
+deduplicated off the wire. The parked request then resumes on the decode
+replica through the prefix cache — an admit-time hit — so greedy streams
+stay token-identical across the handoff (docs/serving.md "Disaggregated
+prefill/decode").
 """
 
 from __future__ import annotations
@@ -34,6 +46,7 @@ from typing import Callable, Dict, List, Optional, Sequence
 
 from ...telemetry.fleet import FleetObsConfig, FleetObservability
 from ..ragged import PrefixBlockIndex
+from .disagg import DisaggConfig
 from .fleet import CLOSED, OPEN, CircuitBreaker, DegradationLadder, FleetConfig
 from .scheduler import REJECTED, Request, RequestHandle, ServingScheduler
 
@@ -51,24 +64,28 @@ class RouterConfig:
     # fleet observability plane (cross-replica tracing, tenant SLO
     # accounting, tsdb — telemetry/fleet.py) — default OFF likewise
     obs: FleetObsConfig = dataclasses.field(default_factory=FleetObsConfig)
+    # disaggregated prefill/decode tiers (disagg.py) — default OFF likewise
+    disagg: DisaggConfig = dataclasses.field(default_factory=DisaggConfig)
 
     @classmethod
     def from_dict(cls, d) -> "RouterConfig":
         """Build from a config-tree dict, e.g. ``{"load_slack": 4,
         "fleet": {"enabled": true, "failure_threshold": 2}}`` — the
         ``serving.fleet`` block lands on :attr:`fleet`, the
-        ``serving.obs`` block on :attr:`obs`."""
+        ``serving.obs`` block on :attr:`obs`, the ``serving.disagg``
+        block on :attr:`disagg`."""
         if isinstance(d, cls):
             return d
         d = dict(d or {})
         fleet = FleetConfig.from_dict(d.pop("fleet", {}))
         obs = FleetObsConfig.from_dict(d.pop("obs", {}))
+        disagg = DisaggConfig.from_dict(d.pop("disagg", {}))
         known = {k: v for k, v in d.items() if k in cls.__dataclass_fields__}
         unknown = set(d) - set(known)
         if unknown:
             raise ValueError(f"unknown serving router key(s): "
                              f"{sorted(unknown)}")
-        return cls(fleet=fleet, obs=obs, **known)
+        return cls(fleet=fleet, obs=obs, disagg=disagg, **known)
 
 
 class ReplicaRouter:
@@ -107,6 +124,31 @@ class ReplicaRouter:
         if self.obs.enabled:
             for s in self.replicas:
                 s.obs = self.obs
+        # disaggregated prefill/decode (disagg.py): replicas
+        # [0, num_prefill) are the prefill tier, the rest decode. An empty
+        # _prefill_tier set means single-tier (the pre-disagg router).
+        dc = self.cfg.disagg
+        self._prefill_tier: frozenset = frozenset()
+        self._session_decode: Dict[int, int] = {}
+        self.disagg_stats: Dict[str, int] = {
+            "handoffs": 0, "blocks_shipped": 0, "wire_bytes": 0,
+            "bf16_equiv_bytes": 0, "dedup_blocks": 0,
+            "dedup_bytes_saved": 0, "import_dropped": 0,
+            "import_failures": 0, "handoff_fallbacks": 0,
+            "tier_fallbacks": 0}
+        if dc.enabled:
+            if not 1 <= dc.num_prefill < len(self.replicas):
+                raise ValueError(
+                    f"serving.disagg.num_prefill {dc.num_prefill} must "
+                    f"leave at least one replica in each tier "
+                    f"({len(self.replicas)} replicas)")
+            for k, s in enumerate(self.replicas):
+                if not s.engine.state.prefix_cache:
+                    raise ValueError(
+                        f"serving.disagg requires prefix_cache enabled on "
+                        f"every replica (replica {k} has it off) — the "
+                        f"KV handoff lands in the retained prefix pool")
+            self._prefill_tier = frozenset(range(dc.num_prefill))
 
     # -- placement -------------------------------------------------------- #
     def _active_idx(self) -> List[int]:
@@ -147,8 +189,17 @@ class ReplicaRouter:
         stickiness under the same slack; then least-loaded. Returns ``None``
         only when fleet health tracking has every active replica's breaker
         open — the caller sheds instead of placing onto a known-dead
-        replica."""
+        replica. With disaggregation on, placement is restricted to the
+        prefill tier; when no prefill replica can take work the decode
+        tier absorbs admissions (counted as ``tier_fallbacks`` — degraded
+        to monolithic rather than rejecting)."""
         placeable = self._placeable_idx()
+        if self._prefill_tier and placeable:
+            pre = [i for i in placeable if i in self._prefill_tier]
+            if pre:
+                placeable = pre
+            else:
+                self.disagg_stats["tier_fallbacks"] += 1
         if not placeable:
             return None
         loads = {i: self.load(i) for i in placeable}
@@ -213,7 +264,11 @@ class ReplicaRouter:
                 f"(level {self._ladders[i].level})", on_token)
         reason = self.replicas[i]._reject_reason(request)
         if reason is not None:
-            for j in sorted((k for k in self._placeable_idx() if k != i),
+            pool = self._placeable_idx()
+            if self._prefill_tier:
+                pre = [k for k in pool if k in self._prefill_tier]
+                pool = pre or pool
+            for j in sorted((k for k in pool if k != i),
                             key=lambda k: (self.load(k), k)):
                 if self.replicas[j]._reject_reason(request) is None:
                     i = j
@@ -236,23 +291,34 @@ class ReplicaRouter:
 
     def step(self) -> None:
         active = self._active_idx()
-        if not self.cfg.fleet.enabled:
+        disagg = bool(self._prefill_tier)
+        if not self.cfg.fleet.enabled and not disagg:
             for i in active:            # the exact pre-fleet loop: no
                 self.replicas[i].tick()  # wrapping, timing, or catching —
             return                       # a tick error propagates unchanged
         for i in active:
-            self._step_replica(i)
+            if self.cfg.fleet.enabled:
+                ok = self._step_replica(i)
+            else:
+                self.replicas[i].tick()  # disagg without fleet: a tick
+                ok = True                # error still propagates unchanged
+            # hand prefill-complete sequences to the decode tier only
+            # after a CLEAN tick — a faulted tick already failed the
+            # replica over (everything re-homes, nothing double-moves)
+            if disagg and ok and i in self._prefill_tier:
+                self._drain_prefill(i)
 
-    def _step_replica(self, i: int) -> None:
+    def _step_replica(self, i: int) -> bool:
         """One health-tracked tick of replica ``i``: honor the breaker
         (skip while OPEN; run the half-open probe when due), drive the
         degradation ladder, then tick with fault + deadline accounting. A
-        fault that opens the breaker triggers :meth:`fail_over`."""
+        fault that opens the breaker triggers :meth:`fail_over`. Returns
+        whether the replica completed a healthy tick."""
         fc = self.cfg.fleet
         br = self._health[i]
         if br.state == OPEN:
             if not br.allow_probe():
-                return
+                return False
             self.fleet_stats["circuit_half_open"] += 1
             self.fleet_stats["probe_ticks"] += 1
         if fc.degrade:
@@ -262,17 +328,18 @@ class ReplicaRouter:
             self.replicas[i].tick()
         except Exception as e:
             self._on_fault(i, f"tick raised {type(e).__name__}: {e}")
-            return
+            return False
         dt = fc.clock() - t0
         if fc.tick_deadline_s > 0 and dt > fc.tick_deadline_s:
             self._on_fault(i, f"tick took {dt * 1e3:.0f} ms "
                            f"(> {fc.tick_deadline_s * 1e3:.0f} ms deadline)")
-            return
+            return False
         if fc.slow_tick_s > 0 and dt > fc.slow_tick_s:
             self.fleet_stats["slow_ticks"] += 1
         if br.record_success():
             self.fleet_stats["circuit_closed"] += 1
             self._instant("circuit_closed", replica=i)
+        return True
 
     def _on_fault(self, i: int, reason: str) -> None:
         self.fleet_stats["tick_faults"] += 1
@@ -293,6 +360,113 @@ class ReplicaRouter:
             raise RuntimeError(f"router did not drain within {max_steps} "
                                f"steps")
 
+    # -- disaggregated prefill → decode handoff ---------------------------- #
+    def _drain_prefill(self, i: int) -> None:
+        """Move every prefill-COMPLETE sequence off prefill-tier replica
+        ``i`` onto a decode replica. A sequence qualifies once its
+        descriptor stops prefilling (the prompt's KV is fully written and
+        the first token is out); mid-SplitFuse chunks keep running here."""
+        sched = self.replicas[i]
+        for uid in list(sched._live):
+            desc = sched.engine.state.seqs.get(uid)
+            if desc is None or desc.prefilling:
+                continue
+            self._handoff(i, uid)
+
+    def _pick_decode(self, handle: RequestHandle,
+                     hashes: List[bytes]) -> Optional[int]:
+        """Decode-tier placement: the session's previous decode replica
+        wins while within ``decode_load_slack`` of the least-loaded decode
+        replica; then the replica already holding the longest resident
+        prefix of ``hashes`` (a fork sibling or refreshed session — those
+        blocks never cross the wire); then least-loaded."""
+        decode = [k for k in self._placeable_idx()
+                  if k not in self._prefill_tier]
+        if not decode:
+            return None
+        loads = {k: self.load(k) for k in decode}
+        least = min(decode, key=lambda k: (loads[k], k))
+        slack = self.cfg.disagg.decode_load_slack
+        sid = handle.request.session_id
+        if sid is not None:
+            j = self._session_decode.get(sid)
+            if j in loads and loads[j] - loads[least] <= slack:
+                return j
+        best, best_res = least, 0
+        for k in decode:
+            r = self.replicas[k].engine.resident_prefix(hashes)
+            if r > best_res:
+                best, best_res = k, r
+        if best_res > 0 and loads[best] - loads[least] <= slack:
+            return best
+        return least
+
+    def _handoff(self, i: int, uid: int) -> bool:
+        """Ship one prefill-complete sequence from prefill replica ``i``
+        to a decode replica: probe the destination's resident prefix,
+        export only the novel block suffix in the configured wire format,
+        detach via ``scheduler.export_live`` (park + trace-leg handoff),
+        import into the destination's retained prefix pool, and re-enqueue
+        the SAME handle there — its resume resolves the imported blocks as
+        an admit-time prefix-cache hit (token-exact continuation rides the
+        pinned park/resume protocol). With no decode replica available the
+        sequence simply keeps decoding where it is (monolithic
+        degradation, counted per tick as ``handoff_fallbacks``). A failed
+        import is also survivable: the destination re-prefills from token
+        history instead (correct, just slower)."""
+        dc = self.cfg.disagg
+        src = self.replicas[i]
+        handle = src.handles.get(uid)
+        if handle is None:
+            return False
+        st = self.disagg_stats
+        try:
+            hashes = src.engine.kv_chain_hashes(uid)
+            j = self._pick_decode(handle, hashes)
+            if j is None:
+                st["handoff_fallbacks"] += 1
+                return False
+            dst = self.replicas[j]
+            n_res = dst.engine.resident_prefix(hashes)
+            exp = src.engine.export_kv_blocks(
+                uid, skip=n_res, wire=dc.wire, wire_group=dc.wire_group)
+        except Exception as e:
+            # a replica that died between its tick and the export: with
+            # health tracking on this is a fault like any other (the
+            # request re-homes with everything else); without it the
+            # error surfaces unchanged, matching tick semantics
+            if self.cfg.fleet.enabled:
+                self._on_fault(i, f"kv export raised "
+                               f"{type(e).__name__}: {e}")
+                return False
+            raise
+        handle, parked = src.export_live(uid)
+        imp = {"imported": 0, "dedup": 0, "dropped": 0}
+        try:
+            imp = dst.engine.import_kv_blocks(exp["hashes"], exp["blocks"])
+        except Exception:
+            st["import_failures"] += 1
+        dst.accept(handle, parked=parked)
+        handle.replica = j
+        handle.kv_wire_bytes += exp["wire_bytes"]
+        st["handoffs"] += 1
+        st["blocks_shipped"] += len(exp["blocks"])
+        st["wire_bytes"] += exp["wire_bytes"]
+        st["bf16_equiv_bytes"] += exp["bf16_equiv_bytes"]
+        dedup = n_res + imp["dedup"]
+        st["dedup_blocks"] += dedup
+        st["dedup_bytes_saved"] += dedup * exp["block_wire_bytes"]
+        st["import_dropped"] += imp["dropped"]
+        sid = handle.request.session_id
+        if sid is not None:
+            self._session_decode[sid] = j
+        if self.obs.enabled:
+            self.obs.handoff(handle, src=i, dst=j, reason="kv_handoff")
+        self._instant("kv_handoff", uid=uid, src=i, dst=j,
+                      blocks=len(exp["blocks"]),
+                      wire_bytes=exp["wire_bytes"], dedup_blocks=dedup)
+        return True
+
     # -- replica loss ------------------------------------------------------ #
     def _rehome(self, moved, exclude: int, reason: str) -> int:
         """Place ``(handle, parked)`` pairs on the best surviving replicas
@@ -300,8 +474,17 @@ class ReplicaRouter:
         parked history). Prefers breaker-CLOSED survivors, falls back to any
         active survivor, and — failover only — re-queues on the failed
         replica itself when it is the sole member (its breaker probe may
-        recover it; nothing is silently dropped)."""
+        recover it; nothing is silently dropped). With disaggregation on,
+        prefill-tier survivors are preferred: a re-homed request needs its
+        history re-prefilled, which is the prefill tier's job — it then
+        hands off to the decode tier again like any fresh admission (a
+        dead DECODE replica's streams fail over token-exactly through the
+        same path)."""
         targets = [i for i in self._placeable_idx() if i != exclude]
+        if self._prefill_tier:
+            pre = [i for i in targets if i in self._prefill_tier]
+            if pre:
+                targets = pre
         fallback = [i for i in self._active_idx() if i != exclude]
         n = 0
         for handle, parked in moved:
@@ -339,6 +522,9 @@ class ReplicaRouter:
         for sid, i in list(self._session_replica.items()):
             if i == idx:
                 del self._session_replica[sid]
+        for sid, i in list(self._session_decode.items()):
+            if i == idx:
+                del self._session_decode[sid]
         moved = self.replicas[idx].evict_all()
         return self._rehome(moved, exclude=idx, reason="drain")
 
@@ -358,6 +544,9 @@ class ReplicaRouter:
         for sid, i in list(self._session_replica.items()):
             if i == idx:
                 del self._session_replica[sid]
+        for sid, i in list(self._session_decode.items()):
+            if i == idx:
+                del self._session_decode[sid]
         moved = self.replicas[idx].abandon_all()
         self.fleet_stats["failovers"] += 1
         n = self._rehome(moved, exclude=idx, reason=reason)
@@ -398,6 +587,29 @@ class ReplicaRouter:
             if a and self._health[i].state != CLOSED))
         return [(f"Serving/fleet/{k}", float(v), step)
                 for k, v in sorted(vals.items())]
+
+    def disagg_events(self, step: int = 0):
+        """``Serving/disagg/*`` telemetry events: handoff/wire counters
+        (wire bytes vs the bf16-equivalent footprint, chain-hash dedup
+        savings, import drops) plus tier-shape gauges and the cumulative
+        ``wire_ratio`` headline (≈0.5 with the int8 wire at realistic head
+        sizes). Empty with the disagg block disabled (no-events parity
+        pin)."""
+        if not self.cfg.disagg.enabled:
+            return []
+        vals = {k: float(v) for k, v in self.disagg_stats.items()}
+        bf16 = vals["bf16_equiv_bytes"]
+        vals["wire_ratio"] = vals["wire_bytes"] / bf16 if bf16 else 0.0
+        vals["prefill_replicas"] = float(sum(
+            1 for i in self._prefill_tier if self._active[i]))
+        vals["decode_replicas"] = float(sum(
+            1 for i, a in enumerate(self._active)
+            if a and i not in self._prefill_tier))
+        return [(f"Serving/disagg/{k}", float(v), step)
+                for k, v in sorted(vals.items())]
+
+    def publish_disagg_telemetry(self, step: int = 0):
+        return self._publish(self.disagg_events(step))
 
     def _publish(self, events):
         for sched in self.replicas:

@@ -96,6 +96,7 @@ class RequestHandle:
         self.slo_met: Optional[bool] = None
         self.preemptions = 0
         self.replica: Optional[int] = None   # stamped by ReplicaRouter
+        self.kv_wire_bytes = 0   # disagg handoff wire traffic (router)
         self._cursor = 0
         self._submit_t: Optional[float] = None
         self._deadline_t = math.inf
@@ -294,6 +295,25 @@ class ServingScheduler:
         handle.state = QUEUED
         self.handles[handle.request.uid] = handle
         self._push(handle, parked=parked)
+
+    def export_live(self, uid: int) -> Tuple[RequestHandle,
+                                             Dict[str, Any]]:
+        """Detach ONE live sequence for a disaggregated prefill→decode
+        handoff (docs/serving.md "Disaggregated prefill/decode"): park it,
+        close this replica's trace leg as a handoff, and hand back
+        ``(handle, parked)`` for the router to :meth:`accept` on the
+        decode-tier replica. The caller exports KV blocks BEFORE calling
+        this — park retires the sequence, after which its uid is unknown
+        here. Unlike :meth:`evict_all` this is the PLANNED move of the
+        two-tier pipeline, not a preemption, so the handle's preemption
+        count is untouched."""
+        h = self._live.pop(uid)
+        parked = self.engine.park(uid)
+        if h.request.trace_ctx is not None:
+            self.engine.release_trace(uid, reason="handoff")
+        h.state = PARKED
+        self.handles.pop(uid, None)
+        return h, parked
 
     def abandon_all(self) -> List[Tuple[RequestHandle,
                                         Optional[Dict[str, Any]]]]:

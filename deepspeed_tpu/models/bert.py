@@ -150,8 +150,10 @@ def apply(cfg: BertConfig, params: Params, tokens: jnp.ndarray, *,
     if cfg.remat:
         block = jax.checkpoint(block)
 
+    from ..comm import overlap as ov
+
     def scan_body(x, layer):
-        return block(x, layer, mask), None
+        return block(x, ov.constrain_scan_slice(layer), mask), None
 
     x, _ = lax.scan(scan_body, x, layers)
     pooled = jnp.tanh(x[:, 0] @ params["pooler_w"].astype(compute_dtype)

@@ -191,8 +191,10 @@ def apply(cfg: BloomConfig, params: Params, tokens: jnp.ndarray, *,
     bias = _alibi_bias(cfg.num_heads, tokens.shape[1])
     layers = _cast_layers(params, compute_dtype)
 
+    from ..comm import overlap as ov
+
     def scan_body(x, layer):
-        return _block(cfg, x, layer, bias), None
+        return _block(cfg, x, ov.constrain_scan_slice(layer), bias), None
 
     x, _ = lax.scan(scan_body, x, layers)
     return _head(cfg, params, x, compute_dtype)

@@ -183,8 +183,11 @@ def apply(cfg: FalconConfig, params: Params, tokens: jnp.ndarray, *,
     layers = _cast_layers(params, compute_dtype)
     block = partial(_block, cfg)
 
+    from ..comm import overlap as ov
+
     def scan_body(x, layer):
-        return block(x, layer, cos, sin, positions), None
+        return block(x, ov.constrain_scan_slice(layer),
+                     cos, sin, positions), None
 
     x, _ = lax.scan(scan_body, x, layers)
     return _head(cfg, params, x, compute_dtype)

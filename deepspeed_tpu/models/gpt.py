@@ -240,11 +240,11 @@ def apply(cfg: GPTConfig, params: Params, tokens: jnp.ndarray, *,
                                              cfg.remat_policy)
         block = jax.checkpoint(block, policy=ac.get_policy(name))
 
-    def scan_body(x, layer):
-        x, _ = block(x, layer)
-        return x, None
-
     from ..comm import overlap as ov
+
+    def scan_body(x, layer):
+        x, _ = block(x, ov.constrain_scan_slice(layer))
+        return x, None
 
     if ov.layer_prefetch_active():
         x, _ = ov.prefetch_scan(scan_body, x, layers)

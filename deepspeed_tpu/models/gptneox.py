@@ -238,8 +238,11 @@ def apply(cfg: GPTNeoXConfig, params: Params, tokens: jnp.ndarray, *,
     cos, sin = rope_frequencies(cfg.rot_dim, cfg.max_seq_len, cfg.rope_theta)
     layers = _cast_layers(params, compute_dtype)
 
+    from ..comm import overlap as ov
+
     def scan_body(x, layer):
-        return _block(cfg, x, layer, cos, sin, positions), None
+        return _block(cfg, x, ov.constrain_scan_slice(layer),
+                      cos, sin, positions), None
 
     x, _ = lax.scan(scan_body, x, layers)
     return _head(cfg, params, x, compute_dtype)

@@ -410,10 +410,14 @@ def apply(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray, *,
         x = pipeline_apply(lambda layer, h: block(h, layer, cos, sin, positions),
                            layers, x)
     else:
-        def scan_body(x, layer):
-            return block(x, layer, cos, sin, positions), None
-
         from ..comm import overlap as ov
+
+        def scan_body(x, layer):
+            # ZeRO-3: pin the slice to the gathered compute layout
+            # (engine-published; identity otherwise) so SPMD can't
+            # repartition the fwd+bwd scan into wrong numerics
+            return block(x, ov.constrain_scan_slice(layer),
+                         cos, sin, positions), None
 
         if ov.layer_prefetch_active():
             # ZeRO-3 per-layer all-gather prefetch: layer i+1's param shards

@@ -196,11 +196,11 @@ def apply(cfg: MixtralConfig, params: Params, tokens: jnp.ndarray, *,
                                              cfg.remat_policy)
         block = jax.checkpoint(block, policy=ac.get_policy(name))
 
-    def scan_body(x, layer):
-        x, aux = block(x, layer)
-        return x, aux
-
     from ..comm import overlap as ov
+
+    def scan_body(x, layer):
+        x, aux = block(x, ov.constrain_scan_slice(layer))
+        return x, aux
 
     if ov.layer_prefetch_active():
         x, aux_losses = ov.prefetch_scan(scan_body, x, layers)

@@ -454,6 +454,22 @@ class DeepSpeedTPUEngine:
                      + (", qwZ int8 gathers"
                         if config.zero_config.zero_quantized_weights
                         else "") + ")")
+        # ZeRO-3 gather-at-use: pin each PLAIN-scan layer slice to the
+        # gathered compute layout. Without the pin, GSPMD may repartition
+        # the stacked-layer scan when it fuses the backward in — which has
+        # produced a numerically wrong forward for pure-DP ZeRO-3 (the
+        # forward-only program is correct; the grads-live one is not). The
+        # constraint states what stage 3 means anyway — all-gather the
+        # layer at use — so TP/SP layouts are preserved and the prefetch
+        # path (which already pins the same layout) is unchanged.
+        from ..comm.overlap import configure_scan_slice_layout
+
+        _scan_slice_on = bool(
+            config.zero_config.stage >= 3 and mesh_mgr.pp_world_size <= 1
+            and any(mesh_mgr.axis_size(a) > 1
+                    for a in self.partitioner.zero_axes))
+        configure_scan_slice_layout(
+            self._layer_prefetch_shardings() if _scan_slice_on else None)
 
         # --- compiled steps ---
         self._train_step = None

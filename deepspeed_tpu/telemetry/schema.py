@@ -100,7 +100,15 @@ SERVING_SERIES = frozenset(
         "failovers", "replayed_tokens", "tick_faults", "slow_ticks",
         "probe_ticks", "circuit_open", "circuit_half_open", "circuit_closed",
         "shed_requests", "degrade_level", "degrade_shifts",
-        "broken_replicas")])
+        "broken_replicas")]
+    # disaggregated prefill/decode (serving/router.py disagg_events —
+    # chain-hash-keyed paged-KV handoff over the int8 wire format;
+    # docs/serving.md "Disaggregated prefill/decode")
+    + ["Serving/disagg/" + m for m in (
+        "handoffs", "blocks_shipped", "wire_bytes", "bf16_equiv_bytes",
+        "wire_ratio", "dedup_blocks", "dedup_bytes_saved",
+        "import_dropped", "import_failures", "handoff_fallbacks",
+        "tier_fallbacks", "prefill_replicas", "decode_replicas")])
 
 # The named remat policies the activation-checkpointing registry ships
 # (runtime/activation_checkpointing/checkpointing.py POLICIES — a tier-1
@@ -233,6 +241,8 @@ TRACER_INSTANTS = frozenset((
     # scheduler + fleet resilience (serving/scheduler.py, fleet.py, router)
     "sched_preempt", "degrade", "rehome", "failover",
     "circuit_open", "circuit_closed",
+    # disaggregated prefill→decode KV handoff (serving/router.py)
+    "kv_handoff",
     # fleet observability plane (telemetry/fleet.py)
     "trace_handoff", "slo_burn_alert"))
 

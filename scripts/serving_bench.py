@@ -650,6 +650,144 @@ def run_chaos(build, sp, vocab, rate_rps, duration_s, prompt_len, gen_len,
     return out
 
 
+def run_disagg(build, sp, vocab, rate_rps, duration_s, prompt_len, gen_len,
+               slo_ms, replicas=3, num_prefill=1):
+    """``detail.disagg`` (docs/serving.md "Disaggregated prefill/decode"):
+    one seeded fleet-shaped open-loop trace — diurnal rate modulation with
+    a burst overlay, heavy-tailed multi-turn sessions, and a weighted
+    tenant mix, the million-user shape compressed onto a bench timescale —
+    served twice on the SAME ``replicas`` engines: a monolithic fleet vs
+    ``num_prefill`` prefill + the rest decode with the chain-hash-keyed KV
+    handoff ON. Equal chips, identical first-turn traffic; the delta is
+    pure tier separation (decode ticks that never share a step budget with
+    a prefill). Reports per mode: goodput-under-SLO, TTFT p50/p99,
+    queue-wait p99 — plus the disagg arm's wire accounting (handoffs, wire
+    vs bf16-equivalent bytes and ratio, chain-hash dedup savings)."""
+    import numpy as np
+
+    from deepspeed_tpu.inference.serving import (DisaggConfig, ReplicaRouter,
+                                                 RouterConfig,
+                                                 SchedulerConfig,
+                                                 ServingScheduler)
+
+    out = {"rate_rps": rate_rps, "duration_s": duration_s, "slo_ms": slo_ms,
+           "replicas": replicas, "num_prefill": num_prefill}
+    time_cap = duration_s * 10 + 60
+    for label, disagg_on in (("monolithic", False), ("disagg", True)):
+        # per-mode generator with the same seed: identical first-turn
+        # arrivals; follow-up turns chain off each mode's own completions
+        traffic = _traffic(seed=29, vocab_size=vocab, process="diurnal",
+                           rate_rps=rate_rps, diurnal_amplitude=0.6,
+                           diurnal_period_s=duration_s, burst_overlay=True,
+                           burst_size=3, burst_interval_s=duration_s / 4,
+                           prompt_len=prompt_len, gen_len=gen_len,
+                           turns_dist="lognormal", turns_mu=0.3,
+                           turns_sigma=0.8, max_turns=4, followup_len=4,
+                           tenant_mix=(("free", 6.0, 1), ("pro", 3.0, 0),
+                                       ("enterprise", 1.0, 0)),
+                           deadline_ms=slo_ms)
+        arrivals = traffic.arrivals(duration_s)
+        scheds = [ServingScheduler(build(),
+                                   SchedulerConfig(max_admissions_per_tick=4))
+                  for _ in range(replicas)]
+        router = ReplicaRouter(scheds, RouterConfig(
+            disagg=DisaggConfig(enabled=disagg_on, num_prefill=num_prefill)))
+        hi = prompt_len if isinstance(prompt_len, int) else prompt_len[1]
+        ghi = gen_len if isinstance(gen_len, int) else gen_len[1]
+        for s in scheds:
+            _warm_engine(s.engine, sp, vocab, (hi, hi + ghi), 4)
+        handles = []          # (arrival, handle, ttft_box)
+        followups = []        # arrivals whose predecessor turn completed
+        ttfts = []
+        i = 0
+        t0 = time.perf_counter()
+
+        def _submit(arr):
+            box = []
+            h = router.submit(
+                arr.request,
+                on_token=lambda _t, _b=box: _b.append(
+                    time.perf_counter()) if not _b else None)
+            handles.append((arr, h, box))
+            return h
+
+        while i < len(arrivals) or followups or router.pending:
+            now = time.perf_counter() - t0
+            if now > time_cap:
+                break
+            while i < len(arrivals) and arrivals[i].t <= now:
+                _submit(arrivals[i])
+                i += 1
+            while followups and followups[0].t <= now:
+                _submit(followups.pop(0))
+            # chain the next session turn off each freshly completed turn
+            for arr, h, _ in handles:
+                if h.state == "done" and not getattr(h, "_chained", False):
+                    h._chained = True
+                    nxt = traffic.followup(arr, h.tokens, now_s=now)
+                    if nxt is not None:
+                        followups.append(nxt)
+            followups.sort(key=lambda a: a.t)
+            if not router.pending:
+                pend = [a.t for a in followups]
+                if i < len(arrivals):
+                    pend.append(arrivals[i].t)
+                if pend:
+                    now = time.perf_counter() - t0
+                    time.sleep(min(max(min(pend) - now, 0.0), 0.05))
+                    continue
+                if not any(h.state == "done" and not getattr(
+                        h, "_chained", False) for _, h, _ in handles):
+                    break
+                continue
+            router.step()
+        elapsed = time.perf_counter() - t0
+        done = [h for _, h, _ in handles if h.state == "done"]
+        met = [h for h in done if h.slo_met]
+        ttfts = [(b[0] - t0 - a.t) * 1e3 for a, h, b in handles
+                 if b and h._submit_t is not None]
+        tt = np.asarray(ttfts or [0.0])
+        qw = np.asarray([h.queue_wait_ms for _, h, _ in handles
+                         if h.queue_wait_ms is not None] or [0.0])
+        row = {"requests": len(handles), "first_turns": len(arrivals),
+               "completed": len(done), "slo_met": len(met),
+               "goodput_rps": round(len(met) / elapsed, 2),
+               "goodput_frac": round(len(met) / len(done), 3)
+               if done else 0.0,
+               "ttft_p50_ms": round(float(np.percentile(tt, 50)), 2),
+               "ttft_p99_ms": round(float(np.percentile(tt, 99)), 2),
+               "queue_wait_p99_ms": round(float(np.percentile(qw, 99)), 2)}
+        if disagg_on:
+            ds = router.disagg_stats
+            row["handoffs"] = ds["handoffs"]
+            row["blocks_shipped"] = ds["blocks_shipped"]
+            row["wire_bytes"] = ds["wire_bytes"]
+            row["bf16_equiv_bytes"] = ds["bf16_equiv_bytes"]
+            row["wire_ratio"] = round(
+                ds["wire_bytes"] / ds["bf16_equiv_bytes"], 3) \
+                if ds["bf16_equiv_bytes"] else 0.0
+            row["dedup_blocks"] = ds["dedup_blocks"]
+            row["dedup_bytes_saved"] = ds["dedup_bytes_saved"]
+            row["handoff_fallbacks"] = ds["handoff_fallbacks"]
+            tel_dir = os.environ.get("DSTPU_SERVING_TELEMETRY")
+            if tel_dir:
+                _dump_serving_telemetry(
+                    scheds[0].engine, tel_dir, job="serving_bench_disagg",
+                    extra_events=router.disagg_events(step=0)
+                    + router.router_events(step=0))
+        out[label] = row
+        sys.stderr.write(f"[serving] disagg {label}: {row}\n")
+        del router, scheds
+    mono, dis = out.get("monolithic"), out.get("disagg")
+    if isinstance(mono, dict) and isinstance(dis, dict):
+        # the headline: what tier separation buys at equal chip count
+        out["goodput_frac_delta"] = round(
+            dis["goodput_frac"] - mono["goodput_frac"], 3)
+        out["ttft_p99_delta_ms"] = round(
+            dis["ttft_p99_ms"] - mono["ttft_p99_ms"], 2)
+    return out
+
+
 def run_multitenant(build, sp, vocab, duration_s, prompt_len, gen_len,
                     slo_ms_by_tenant, rate_by_tenant):
     """``detail.multitenant`` (docs/observability.md "Fleet observability"):
@@ -1012,6 +1150,41 @@ def main():
             glen_ch, slo_ch)
     except Exception as e:
         RESULT["detail"]["chaos"] = f"error: {str(e)[-200:]}"
+
+    # disaggregated prefill/decode probe: equal-chip monolithic vs two-tier
+    # fleet on one seeded diurnal/heavy-tail/multi-tenant trace — goodput
+    # under SLO, TTFT p99, and the KV-handoff wire accounting
+    # (docs/serving.md "Disaggregated prefill/decode"); non-fatal DISAGG
+    # row in tpu_watch.sh, gated by DSTPU_BENCH_DISAGG=0
+    if os.environ.get("DSTPU_BENCH_DISAGG", "1") != "0":
+        try:
+            if on_tpu:
+                rate_dg, dur_dg, plen_dg, glen_dg, slo_dg = \
+                    18.0, 16.0, (64, 192), (16, 48), 4000.0
+                slots_dg, bs_dg = 12, 32
+            else:
+                rate_dg, dur_dg, plen_dg, glen_dg, slo_dg = \
+                    12.0, 4.0, (12, 24), (3, 8), 2500.0
+                slots_dg, bs_dg = 6, 16
+            max_tok_dg = plen_dg[1] + glen_dg[1] * 4  # multi-turn histories
+
+            def build_dg():
+                nb = slots_dg * ((max_tok_dg + bs_dg - 1) // bs_dg + 3) + 8
+                return build_engine_v2(
+                    llama, mcfg, llama.init(mcfg, jax.random.PRNGKey(0)),
+                    config={"dtype": "bfloat16",
+                            "prefill_bucket": min(64, plen_dg[1]),
+                            "prefix_cache": {"enabled": True},
+                            "ragged": {"max_tracked_sequences": slots_dg,
+                                       "max_ragged_batch_size": slots_dg,
+                                       "memory_config_blocks": nb,
+                                       "block_size": bs_dg}})
+
+            RESULT["detail"]["disagg"] = run_disagg(
+                build_dg, sp, mcfg.vocab_size, rate_dg, dur_dg, plen_dg,
+                glen_dg, slo_dg, replicas=3, num_prefill=1)
+        except Exception as e:
+            RESULT["detail"]["disagg"] = f"error: {str(e)[-200:]}"
 
     # fleet observability probe: two tenants with different SLOs/arrival
     # rates on a two-replica fleet with the serving.obs plane enabled —

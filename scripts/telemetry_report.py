@@ -524,7 +524,10 @@ def serving(events: List[dict]) -> str:
     transitions, shed requests, degradation level — docs/serving.md), and
     the quantized-KV-cache gauges from ``Serving/kv_quant/*`` (resident
     quantized blocks, bytes saved vs bf16, dequant-error bound, fused-
-    dequant flag — docs/serving.md "Quantized KV cache"). These
+    dequant flag — docs/serving.md "Quantized KV cache"), and the
+    disaggregated prefill/decode counters from ``Serving/disagg/*``
+    (handoffs, wire bytes vs bf16-equivalent, chain-hash dedup savings —
+    docs/serving.md "Disaggregated prefill/decode"). These
     series carry CUMULATIVE counter values (gauges for occupancy/rates), so
     the last sample per series is the run total — unlike
     ``--reliability``'s one-line-per-occurrence."""
@@ -534,10 +537,11 @@ def serving(events: List[dict]) -> str:
     router = [e for e in events if e["name"].startswith("Serving/router/")]
     fleet = [e for e in events if e["name"].startswith("Serving/fleet/")]
     kvq = [e for e in events if e["name"].startswith("Serving/kv_quant/")]
+    disagg = [e for e in events if e["name"].startswith("Serving/disagg/")]
     if not srv and not spec and not sched and not router and not fleet \
-            and not kvq:
+            and not kvq and not disagg:
         return ("serving: no Serving/{prefix_cache,spec,sched,router,fleet,"
-                "kv_quant}/* events in this file")
+                "kv_quant,disagg}/* events in this file")
     lines: List[str] = []
     if kvq:
         kq: Dict[str, float] = {}
@@ -697,6 +701,32 @@ def serving(events: List[dict]) -> str:
                      f"({fl.get('degrade_shifts', 0):,.0f} shifts)")
         lines.append(f"  broken replicas (now):  "
                      f"{fl.get('broken_replicas', 0):,.0f}")
+    if disagg:
+        if lines:
+            lines.append("")
+        dg: Dict[str, float] = {}
+        for e in disagg:
+            dg[e["name"][len("Serving/disagg/"):]] = e["value"]  # last wins
+        lines.append(f"disaggregation report ({len(disagg)} events)")
+        lines.append(f"  tiers:                  "
+                     f"{dg.get('prefill_replicas', 0):,.0f} prefill / "
+                     f"{dg.get('decode_replicas', 0):,.0f} decode")
+        lines.append(f"  kv handoffs:            "
+                     f"{dg.get('handoffs', 0):,.0f}  "
+                     f"({dg.get('blocks_shipped', 0):,.0f} blocks shipped)")
+        lines.append(f"  wire bytes:             "
+                     f"{_fmt_bytes(dg.get('wire_bytes', 0))} of "
+                     f"{_fmt_bytes(dg.get('bf16_equiv_bytes', 0))} "
+                     f"bf16-equiv ({dg.get('wire_ratio', 0):.3f}x)")
+        lines.append(f"  dedup (chain-hash):     "
+                     f"{dg.get('dedup_blocks', 0):,.0f} blocks off the wire "
+                     f"({_fmt_bytes(dg.get('dedup_bytes_saved', 0))} saved)")
+        lines.append(f"  import drops/failures:  "
+                     f"{dg.get('import_dropped', 0):,.0f} / "
+                     f"{dg.get('import_failures', 0):,.0f}")
+        lines.append(f"  tier fallbacks:         "
+                     f"{dg.get('tier_fallbacks', 0):,.0f} admission / "
+                     f"{dg.get('handoff_fallbacks', 0):,.0f} handoff")
     return "\n".join(lines)
 
 

@@ -201,6 +201,37 @@ try:
 except Exception as e:
     print("[watch] FLEETOBS probe: unreadable:", e)
 EOF
+    # disaggregation row (NON-FATAL — never gates CYCLE_OK or promotion):
+    # the equal-chip monolithic-vs-two-tier comparison from the SERVING
+    # capture's detail.disagg (gate with DSTPU_BENCH_DISAGG=0;
+    # docs/serving.md "Disaggregated prefill/decode"). The healthy
+    # signature is a non-negative goodput delta and a NEGATIVE ttft_p99
+    # delta (decode ticks no longer share a step budget with prefill);
+    # zero handoffs, a wire_ratio drifting above the pinned format ratio,
+    # or growing handoff_fallbacks means the KV-handoff path regressed.
+    python - >> "$LOG" 2>&1 <<'EOF' || true
+import glob, json
+try:
+    src = sorted(glob.glob("bench_runs/SERVING_[0-9]*.json"))[-1]
+    d = json.loads(open(src).read().strip().splitlines()[-1])
+    dg = d.get("detail", {}).get("disagg")
+    if isinstance(dg, dict) and isinstance(dg.get("disagg"), dict):
+        row = dg["disagg"]
+        print("[watch] DISAGG probe: goodput_frac mono=%s disagg=%s "
+              "delta=%s ttft_p99 %s->%s ms (delta=%s) handoffs=%s "
+              "wire_ratio=%s dedup_blocks=%s fallbacks=%s"
+              % (dg["monolithic"]["goodput_frac"], row["goodput_frac"],
+                 dg.get("goodput_frac_delta"),
+                 dg["monolithic"]["ttft_p99_ms"], row["ttft_p99_ms"],
+                 dg.get("ttft_p99_delta_ms"), row["handoffs"],
+                 row["wire_ratio"], row["dedup_blocks"],
+                 row["handoff_fallbacks"]))
+    else:
+        print("[watch] DISAGG probe: no detail.disagg in %s (%r)"
+              % (src, dg))
+except Exception as e:
+    print("[watch] DISAGG probe: unreadable:", e)
+EOF
     # elastic-drill row (NON-FATAL — never gates CYCLE_OK or promotion):
     # the preempt→reshard→resume drill on the CPU lane of this host
     # (deepspeed_tpu/testing/drill.py; docs/reliability.md "Elastic

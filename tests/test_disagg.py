@@ -1,0 +1,662 @@
+"""Disaggregated prefill/decode fleet (docs/serving.md "Disaggregated
+prefill/decode"; serving/disagg.py, router handoff path, engine_v2
+export/import seams):
+
+- transfer-format roundtrips: native wire lands bitwise in the
+  destination pool; the int8 wire halves bytes within the scale/2
+  dequantization bound; a quantized-KV engine's native wire IS the int8
+  format;
+- greedy token-identity of disaggregated streams vs a single-replica
+  oracle — including prefix-cache shared prefixes, fork-after-handoff,
+  quantized-KV engines, and mid-handoff prefill-replica failure;
+- tier-aware failover in both directions (dead prefill replica
+  re-prefills on a survivor; dead decode replica fails over
+  token-exactly);
+- default-OFF parity: the single-tier router's behavior, stats, and
+  event streams are untouched;
+- the ``Serving/disagg/*`` telemetry family + ``telemetry_report.py
+  --serving`` disaggregation section;
+- the million-user-shaped TrafficGenerator extensions the disagg bench
+  arm replays (heavy-tail sessions, diurnal/burst arrivals, tenant mix).
+"""
+
+import os
+import subprocess
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+from deepspeed_tpu.comm import mesh as mesh_lib
+from deepspeed_tpu.inference import (DisaggConfig, FleetConfig,
+                                     ReplicaRouter, Request, RouterConfig,
+                                     ServingScheduler, TrafficGenerator,
+                                     WorkloadConfig, build_engine_v2)
+from deepspeed_tpu.inference.serving import DONE
+from deepspeed_tpu.telemetry.schema import (SERVING_SERIES, TRACER_INSTANTS,
+                                            validate_events)
+from deepspeed_tpu.testing import faults
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    from deepspeed_tpu.models import llama
+    cfg = llama.LlamaConfig.tiny(max_seq_len=256)
+    params = llama.init(cfg, jax.random.PRNGKey(0))
+    return llama, cfg, params
+
+
+def build(tiny, blocks=64, block_size=16, slots=4, **kw):
+    llama, cfg, params = tiny
+    mesh_lib.set_mesh(None)
+    return build_engine_v2(
+        llama, cfg, params,
+        config=dict({"dtype": "float32", "prefill_bucket": 16,
+                     "prefix_cache": {"enabled": True},
+                     "ragged": {"max_tracked_sequences": slots,
+                                "max_ragged_batch_size": slots,
+                                "memory_config_blocks": blocks,
+                                "block_size": block_size}}, **kw))
+
+
+def _requests(cfg, n, seed=5, gen_len=8, prompt_len=(20, 44), prios=(0,)):
+    gen = TrafficGenerator(WorkloadConfig(
+        seed=seed, vocab_size=cfg.vocab_size, prompt_len=prompt_len,
+        gen_len=gen_len, priorities=prios, deadline_ms=60000.0))
+    return [gen.request() for _ in range(n)]
+
+
+@pytest.fixture(scope="module")
+def oracle_sched(tiny):
+    return ServingScheduler(build(tiny))
+
+
+def _oracle_tokens(oracle_sched, requests):
+    """Fault-free single-replica reference streams for fresh copies of
+    ``requests`` — the token-identity oracle for any handoff history."""
+    handles = [oracle_sched.submit(Request(prompt=list(r.prompt),
+                                           max_new_tokens=r.max_new_tokens,
+                                           priority=r.priority))
+               for r in requests]
+    oracle_sched.run()
+    assert all(h.state == DONE for h in handles)
+    return [h.tokens for h in handles]
+
+
+def _disagg_router(tiny, n=3, num_prefill=1, fleet=None, engine_kw=None,
+                   **disagg_kw):
+    scheds = [ServingScheduler(build(tiny, **(engine_kw or {})))
+              for _ in range(n)]
+    cfg = RouterConfig(
+        fleet=fleet or FleetConfig(),
+        disagg=DisaggConfig(enabled=True, num_prefill=num_prefill,
+                            **disagg_kw))
+    return ReplicaRouter(scheds, cfg), scheds
+
+
+# --------------------------------------------------------------------------- #
+# config
+# --------------------------------------------------------------------------- #
+def test_disagg_config_from_dict():
+    dc = DisaggConfig.from_dict({"enabled": True, "num_prefill": 2,
+                                 "wire": "int8", "wire_group": 32})
+    assert dc.enabled and dc.num_prefill == 2
+    assert dc.wire == "int8" and dc.wire_group == 32
+    assert not DisaggConfig.from_dict({}).enabled
+    with pytest.raises(ValueError, match="unknown serving.disagg"):
+        DisaggConfig.from_dict({"num_prefil": 1})
+    with pytest.raises(ValueError, match="wire"):
+        DisaggConfig.from_dict({"wire": "bf8"})
+    with pytest.raises(ValueError, match="num_prefill"):
+        DisaggConfig.from_dict({"enabled": True, "num_prefill": 0})
+    rc = RouterConfig.from_dict({"disagg": {"enabled": True}})
+    assert rc.disagg.enabled and rc.disagg.wire == "native"
+    assert not RouterConfig.from_dict({}).disagg.enabled
+
+
+def test_disagg_router_validation(tiny):
+    scheds = [ServingScheduler(build(tiny)) for _ in range(2)]
+    with pytest.raises(ValueError, match="num_prefill"):
+        ReplicaRouter(scheds, RouterConfig(
+            disagg=DisaggConfig(enabled=True, num_prefill=2)))
+    nocache = [ServingScheduler(build(tiny)),
+               ServingScheduler(build(tiny,
+                                      **{"prefix_cache": {"enabled": False}}))]
+    with pytest.raises(ValueError, match="prefix_cache"):
+        ReplicaRouter(nocache, RouterConfig(
+            disagg=DisaggConfig(enabled=True, num_prefill=1)))
+
+
+# --------------------------------------------------------------------------- #
+# transfer-format roundtrips (engine seams)
+# --------------------------------------------------------------------------- #
+def _prefill_one(eng, prompt, uid=1, decode=6):
+    from deepspeed_tpu.inference import SamplingParams
+    toks = [eng.put(uid, prompt, SamplingParams(temperature=0.0), seed=0)]
+    for _ in range(decode):
+        toks.append(eng.step()[1])
+    return toks
+
+
+def test_native_wire_roundtrip_bitwise(tiny):
+    src, dst = build(tiny), build(tiny)
+    rng = np.random.default_rng(0)
+    llama, cfg, _ = tiny
+    prompt = [int(t) for t in rng.integers(1, cfg.vocab_size, size=40)]
+    _prefill_one(src, prompt)
+    hashes = src.kv_chain_hashes(1)
+    assert len(hashes) == 2 and dst.resident_prefix(hashes) == 0
+    exp = src.export_kv_blocks(1, wire="native")
+    assert exp["wire_bytes"] > 0
+    res = dst.import_kv_blocks(exp["hashes"], exp["blocks"])
+    assert res == {"imported": 2, "dedup": 0, "dropped": 0}
+    assert dst.resident_prefix(hashes) == 2
+    # destination block contents are bitwise the exported payload
+    for h, payload in zip(exp["hashes"], exp["blocks"]):
+        b = dst.state.index._by_hash[h]
+        for name in sorted(dst.cache):
+            assert np.array_equal(np.asarray(dst.cache[name][:, b]),
+                                  payload[name]), (h, name)
+    dst.state.debug_check()
+    dst.debug_check_cache()
+    # re-import is pure dedup — nothing allocated, nothing shipped twice
+    res2 = dst.import_kv_blocks(exp["hashes"], exp["blocks"])
+    assert res2 == {"imported": 0, "dedup": 2, "dropped": 0}
+    dst.state.debug_check()
+
+
+def test_int8_wire_halves_bytes_within_bound(tiny):
+    llama, cfg, _ = tiny
+    src, dst = build(tiny), build(tiny)
+    rng = np.random.default_rng(1)
+    prompt = [int(t) for t in rng.integers(1, cfg.vocab_size, size=36)]
+    _prefill_one(src, prompt)
+    native = src.export_kv_blocks(1, wire="native")
+    exp = src.export_kv_blocks(1, wire="int8", wire_group=64)
+    hd = cfg.head_size
+    ng = hd // min(64, hd)
+    # int8 codes + fp32 group scales vs 2-byte k/v — the wire-ratio pin
+    assert exp["bf16_equiv_bytes"] == native["bf16_equiv_bytes"]
+    assert exp["wire_bytes"] / exp["bf16_equiv_bytes"] == \
+        pytest.approx((hd + 4 * ng) / (2 * hd))
+    res = dst.import_kv_blocks(exp["hashes"], exp["blocks"])
+    assert res["imported"] == len(exp["blocks"])
+    # dequantized destination blocks match the source within the group
+    # scale/2 plus the bf16 pool's own storage rounding
+    for h, pay, nat in zip(exp["hashes"], exp["blocks"], native["blocks"]):
+        b = dst.state.index._by_hash[h]
+        for name in ("k", "v"):
+            got = np.asarray(dst.cache[name][:, b], dtype=np.float32)
+            ref = np.asarray(nat[name], dtype=np.float32)
+            bound = np.repeat(pay[name + "_scale"].astype(np.float32),
+                              hd // ng, axis=-1) / 2.0 \
+                + np.abs(ref) * 2.0 ** -8 + 1e-6
+            assert (np.abs(got - ref) <= bound).all(), (h, name)
+    dst.state.debug_check()
+
+
+def test_kv_quant_native_wire_is_int8(tiny):
+    llama, cfg, _ = tiny
+    kvq = {"kv_quant": {"enabled": True, "group_size": 64}}
+    src, dst = build(tiny, **kvq), build(tiny, **kvq)
+    rng = np.random.default_rng(2)
+    prompt = [int(t) for t in rng.integers(1, cfg.vocab_size, size=40)]
+    toks = _prefill_one(src, prompt)
+    exp = src.export_kv_blocks(1, wire="native")
+    hd = cfg.head_size
+    ng = hd // min(64, hd)
+    assert exp["wire_bytes"] / exp["bf16_equiv_bytes"] == \
+        pytest.approx((hd + 4 * ng) / (2 * hd))
+    dst.import_kv_blocks(exp["hashes"], exp["blocks"])
+    for h, payload in zip(exp["hashes"], exp["blocks"]):
+        b = dst.state.index._by_hash[h]
+        for name in sorted(dst.cache):
+            assert np.array_equal(np.asarray(dst.cache[name][:, b]),
+                                  payload[name])
+    # park on src, resume on dst: admit-time hit, then the continuation is
+    # EXACTLY the same-engine park/resume stream — the wire adds zero
+    # error on top of the repo's preemption semantics. (Under a quantized
+    # pool, resume itself is lossy vs uninterrupted decode: the partial
+    # tail block re-prefills against fresh in-chunk values where the
+    # original decode read quantized cache — a pre-existing park/resume
+    # property, so THAT is the oracle, not the continuous stream.)
+    ref_eng = build(tiny, **kvq)
+    ref = _prefill_one(ref_eng, prompt)
+    assert ref == toks
+    ref += ref_eng.resume(ref_eng.park(1), seed=0)
+    parked = src.park(1)
+    hits0 = dst.state.prefix_stats["hit_tokens"]
+    out = dst.resume(parked, seed=0)
+    assert dst.state.prefix_stats["hit_tokens"] - hits0 == 2 * 16
+    for _ in range(4):
+        out.append(dst.step()[1])
+        ref.append(ref_eng.step()[1])
+    assert toks + out == ref
+    # the handed-off sequence still forks copy-free on the destination
+    dst.fork(1, 7)
+    dst.state.debug_check()
+    dst.debug_check_cache()
+
+
+def test_import_into_exhausted_pool_drops(tiny):
+    llama, cfg, _ = tiny
+    src = build(tiny)
+    dst = build(tiny, **{"prefix_cache": {"enabled": True,
+                                          "max_retained_blocks": 0}})
+    rng = np.random.default_rng(3)
+    prompt = [int(t) for t in rng.integers(1, cfg.vocab_size, size=40)]
+    _prefill_one(src, prompt)
+    exp = src.export_kv_blocks(1)
+    res = dst.import_kv_blocks(exp["hashes"], exp["blocks"])
+    # retention cap 0: adopted blocks can't park in the LRU — dropped,
+    # not leaked (resume just re-prefills)
+    assert res["imported"] == 0 and res["dropped"] == len(exp["blocks"])
+    dst.state.debug_check()
+
+
+# --------------------------------------------------------------------------- #
+# router: two-tier token identity
+# --------------------------------------------------------------------------- #
+def test_disagg_token_identity(tiny, oracle_sched):
+    llama, cfg, _ = tiny
+    requests = _requests(cfg, 8, seed=11)
+    oracle = _oracle_tokens(oracle_sched, requests)
+    router, scheds = _disagg_router(tiny, n=3, num_prefill=1)
+    handles = [router.submit(Request(prompt=list(r.prompt),
+                                     max_new_tokens=r.max_new_tokens,
+                                     session_id=k))
+               for k, r in enumerate(requests)]
+    router.run()
+    assert [h.tokens for h in handles] == oracle
+    assert all(h.state == DONE for h in handles)
+    # every stream prefilled on the prefill tier, decoded on the decode tier
+    assert router.disagg_stats["handoffs"] == len(requests)
+    assert all(h.replica in (1, 2) for h in handles)
+    # the planned handoff is not a preemption, and wire traffic is stamped
+    assert all(h.preemptions == 0 for h in handles)
+    assert all(h.kv_wire_bytes > 0 for h in handles)
+    assert router.disagg_stats["wire_bytes"] == \
+        sum(h.kv_wire_bytes for h in handles)
+    assert router.disagg_stats["import_failures"] == 0
+    for s in scheds:
+        s.engine.state.debug_check()
+
+
+def test_disagg_shared_prefix_dedup(tiny, oracle_sched):
+    llama, cfg, _ = tiny
+    gen = TrafficGenerator(WorkloadConfig(
+        seed=23, vocab_size=cfg.vocab_size, prompt_kind="shared_prefix",
+        shared_len=48, prompt_len=(8, 16), gen_len=6,
+        deadline_ms=60000.0))
+    requests = [gen.request() for _ in range(6)]
+    oracle = _oracle_tokens(oracle_sched, requests)
+    router, scheds = _disagg_router(tiny, n=3, num_prefill=1)
+    handles = []
+    for k, r in enumerate(requests):
+        h = router.submit(Request(prompt=list(r.prompt),
+                                  max_new_tokens=r.max_new_tokens,
+                                  session_id=k))
+        handles.append(h)
+        router.run()
+    assert [h.tokens for h in handles] == oracle
+    st = router.disagg_stats
+    # after the first handoff seeds a decode replica, the shared 48-token
+    # prefix (3 full blocks) stays off the wire for every later request
+    # that lands on the same decode replica
+    assert st["dedup_blocks"] > 0
+    # savings are priced at the same per-block wire cost as shipped blocks
+    per_block = st["wire_bytes"] // st["blocks_shipped"]
+    assert st["dedup_bytes_saved"] == st["dedup_blocks"] * per_block
+    for s in scheds:
+        s.engine.state.debug_check()
+
+
+def test_disagg_kv_quant_wire(tiny):
+    """Quantized-pool tiers: every stream completes to budget over the
+    int8-native wire at the pinned byte ratio. Full streams are compared
+    only through prefill (the first token) — a quantized pool's RESUME is
+    already lossy vs uninterrupted decode (the partial tail block
+    re-prefills against fresh in-chunk values), so post-handoff tokens
+    follow the park/resume stream, pinned exactly in
+    test_kv_quant_native_wire_is_int8."""
+    llama, cfg, _ = tiny
+    kvq = {"kv_quant": {"enabled": True, "group_size": 64}}
+    requests = _requests(cfg, 5, seed=31)
+    oracle_kvq = ServingScheduler(build(tiny, **kvq))
+    oracle = _oracle_tokens(oracle_kvq, requests)
+    router, scheds = _disagg_router(tiny, n=3, num_prefill=1,
+                                    engine_kw=kvq)
+    handles = [router.submit(Request(prompt=list(r.prompt),
+                                     max_new_tokens=r.max_new_tokens))
+               for r in requests]
+    router.run()
+    assert all(h.state == DONE for h in handles)
+    assert [len(h.tokens) for h in handles] == [len(t) for t in oracle]
+    assert [h.tokens[0] for h in handles] == [t[0] for t in oracle]
+    st = router.disagg_stats
+    assert st["handoffs"] == len(requests)
+    # a quantized pool's native wire is the int8 format: ~half bf16 bytes
+    hd = cfg.head_size
+    ng = hd // min(64, hd)
+    assert st["wire_bytes"] / st["bf16_equiv_bytes"] == \
+        pytest.approx((hd + 4 * ng) / (2 * hd))
+    for s in scheds:
+        s.engine.state.debug_check()
+        s.engine.debug_check_cache()
+
+
+def test_disagg_session_sticky_decode(tiny):
+    llama, cfg, _ = tiny
+    router, scheds = _disagg_router(tiny, n=3, num_prefill=1)
+    gen = TrafficGenerator(WorkloadConfig(
+        seed=7, vocab_size=cfg.vocab_size, prompt_len=(20, 30), gen_len=5,
+        turns=2, deadline_ms=60000.0))
+    arr = gen.arrivals(0.4)[:2]
+    first = [router.submit(a.request) for a in arr]
+    router.run()
+    decode_of = {a.session_id: h.replica for a, h in zip(arr, first)}
+    follow = [gen.followup(a, h.tokens, now_s=1.0)
+              for a, h in zip(arr, first)]
+    second = [router.submit(f.request) for f in follow]
+    router.run()
+    # turn 2 decodes on the SAME decode replica that served turn 1 — its
+    # retained blocks make the handoff ship only the novel suffix
+    for f, h in zip(follow, second):
+        assert h.replica == decode_of[f.session_id]
+    assert router.disagg_stats["dedup_blocks"] > 0
+
+
+# --------------------------------------------------------------------------- #
+# default-OFF parity
+# --------------------------------------------------------------------------- #
+def test_disagg_default_off_parity(tiny, oracle_sched):
+    llama, cfg, _ = tiny
+    requests = _requests(cfg, 6, seed=41)
+    oracle = _oracle_tokens(oracle_sched, requests)
+    router = ReplicaRouter([ServingScheduler(build(tiny)) for _ in range(2)])
+    handles = [router.submit(Request(prompt=list(r.prompt),
+                                     max_new_tokens=r.max_new_tokens))
+               for r in requests]
+    router.run()
+    assert [h.tokens for h in handles] == oracle
+    # no tier state, no disagg events, no stats movement, no wire traffic
+    assert not router._prefill_tier and not router._session_decode
+    assert router.disagg_events() == []
+    assert all(v == 0 for v in router.disagg_stats.values())
+    assert all(h.kv_wire_bytes == 0 for h in handles)
+    assert router.publish_disagg_telemetry() == []
+
+
+# --------------------------------------------------------------------------- #
+# tier-aware failover
+# --------------------------------------------------------------------------- #
+def _fleet():
+    return FleetConfig(enabled=True, failure_threshold=1,
+                       probe_backoff_ticks=10000)
+
+
+def test_disagg_prefill_replica_crash(tiny, oracle_sched):
+    """Mid-handoff prefill-replica death: streams caught on the dead
+    prefill replica re-prefill on the surviving prefill replica, hand off
+    again, and finish token-identically."""
+    llama, cfg, _ = tiny
+    requests = _requests(cfg, 6, seed=53)
+    oracle = _oracle_tokens(oracle_sched, requests)
+    router, scheds = _disagg_router(tiny, n=4, num_prefill=2,
+                                    fleet=_fleet())
+    handles = [router.submit(Request(prompt=list(r.prompt),
+                                     max_new_tokens=r.max_new_tokens))
+               for r in requests]
+    with faults.replica_crash(scheds[0]):
+        for _ in range(3):
+            router.step()
+    router.run()
+    assert [h.tokens for h in handles] == oracle
+    assert all(h.state == DONE for h in handles)
+    assert router.fleet_stats["failovers"] >= 1
+    # the survivors still ran the two-tier pipeline: every stream decoded
+    # on the decode tier
+    assert all(h.replica in (2, 3) for h in handles)
+
+
+def test_disagg_decode_replica_crash(tiny, oracle_sched):
+    """Dead decode replica: its streams fail over token-exactly — history
+    re-prefills on the prefill tier, hands off to the surviving decode
+    replica, and continues without re-emitting a token."""
+    llama, cfg, _ = tiny
+    requests = _requests(cfg, 6, seed=59)
+    oracle = _oracle_tokens(oracle_sched, requests)
+    router, scheds = _disagg_router(tiny, n=3, num_prefill=1,
+                                    fleet=_fleet())
+    handles = [router.submit(Request(prompt=list(r.prompt),
+                                     max_new_tokens=r.max_new_tokens))
+               for r in requests]
+    for _ in range(2):      # prefill + first handoffs land on the tiers
+        router.step()
+    victim = next(h.replica for h in handles if h.replica in (1, 2))
+    with faults.replica_crash(scheds[victim]):
+        for _ in range(3):
+            router.step()
+    router.run()
+    assert [h.tokens for h in handles] == oracle
+    assert all(h.state == DONE for h in handles)
+    assert router.fleet_stats["failovers"] >= 1
+    survivor = 3 - victim
+    assert all(h.replica == survivor for h in handles)
+
+
+def test_disagg_export_fault_fails_over(tiny, oracle_sched):
+    """A prefill replica that dies between its tick and the KV export is
+    a fault like any other: with health tracking on, the request re-homes
+    and the stream stays token-identical."""
+    llama, cfg, _ = tiny
+    requests = _requests(cfg, 3, seed=61)
+    oracle = _oracle_tokens(oracle_sched, requests)
+    router, scheds = _disagg_router(tiny, n=4, num_prefill=2,
+                                    fleet=_fleet())
+    handles = [router.submit(Request(prompt=list(r.prompt),
+                                     max_new_tokens=r.max_new_tokens))
+               for r in requests]
+    broken = scheds[0].engine
+    orig = broken.export_kv_blocks
+    broken.export_kv_blocks = lambda *a, **kw: (_ for _ in ()).throw(
+        RuntimeError("export wire down"))
+    for _ in range(3):
+        router.step()
+    broken.export_kv_blocks = orig
+    router.run()
+    assert [h.tokens for h in handles] == oracle
+    assert router.fleet_stats["tick_faults"] >= 1
+
+
+def test_disagg_import_failure_survivable(tiny, oracle_sched):
+    """A failed import still accepts the request on the decode replica —
+    resume re-prefills from token history (correct, just slower)."""
+    llama, cfg, _ = tiny
+    requests = _requests(cfg, 4, seed=67)
+    oracle = _oracle_tokens(oracle_sched, requests)
+    router, scheds = _disagg_router(tiny, n=2, num_prefill=1)
+    for s in scheds[1:]:
+        s.engine.import_kv_blocks = lambda *a, **kw: (_ for _ in ()).throw(
+            RuntimeError("import pool fault"))
+    handles = [router.submit(Request(prompt=list(r.prompt),
+                                     max_new_tokens=r.max_new_tokens))
+               for r in requests]
+    router.run()
+    assert [h.tokens for h in handles] == oracle
+    assert router.disagg_stats["import_failures"] == \
+        router.disagg_stats["handoffs"] == len(requests)
+
+
+def test_disagg_no_decode_tier_degrades_to_monolithic(tiny, oracle_sched):
+    """Every decode replica drained: sequences keep decoding on the
+    prefill replica (counted as handoff fallbacks) — nothing stalls."""
+    llama, cfg, _ = tiny
+    requests = _requests(cfg, 3, seed=71)
+    oracle = _oracle_tokens(oracle_sched, requests)
+    router, scheds = _disagg_router(tiny, n=2, num_prefill=1)
+    router.drain(1)
+    handles = [router.submit(Request(prompt=list(r.prompt),
+                                     max_new_tokens=r.max_new_tokens))
+               for r in requests]
+    router.run()
+    assert [h.tokens for h in handles] == oracle
+    assert router.disagg_stats["handoffs"] == 0
+    assert router.disagg_stats["handoff_fallbacks"] > 0
+    assert all(h.replica == 0 for h in handles)
+
+
+# --------------------------------------------------------------------------- #
+# telemetry surface
+# --------------------------------------------------------------------------- #
+def test_disagg_events_schema_and_hub(tiny, tmp_path):
+    from deepspeed_tpu.monitor.monitor import JSONLMonitor
+    from deepspeed_tpu.telemetry import TelemetryHub
+
+    class MonCfg:
+        enabled = True
+        output_path = str(tmp_path)
+        job_name = "disagg"
+
+    class HubCfg:
+        pass
+
+    llama, cfg, params = tiny
+    mon = JSONLMonitor(MonCfg())
+    hub = TelemetryHub(HubCfg(), monitor=mon)
+    mesh_lib.set_mesh(None)
+    eng = build_engine_v2(
+        llama, cfg, params, telemetry_hub=hub,
+        config={"dtype": "float32", "prefill_bucket": 16,
+                "prefix_cache": {"enabled": True},
+                "trace": {"enabled": True, "dump_on_crash": False},
+                "ragged": {"max_tracked_sequences": 4,
+                           "max_ragged_batch_size": 4,
+                           "memory_config_blocks": 64, "block_size": 16}})
+    scheds = [ServingScheduler(eng)] + \
+        [ServingScheduler(build(tiny)) for _ in range(2)]
+    router = ReplicaRouter(scheds, RouterConfig(
+        disagg=DisaggConfig(enabled=True, num_prefill=1)))
+    handles = [router.submit(r) for r in _requests(cfg, 3, seed=73)]
+    router.run()
+    assert all(h.state == DONE for h in handles)
+    events = router.publish_disagg_telemetry(step=1)
+    assert events and validate_events(events) == []
+    assert {n for n, _, _ in events} <= SERVING_SERIES
+    assert hub.serving_values["Serving/disagg/handoffs"] == 3.0
+    assert hub.serving_values["Serving/disagg/prefill_replicas"] == 1.0
+    assert hub.serving_values["Serving/disagg/decode_replicas"] == 2.0
+    assert hub.serving_values["Serving/disagg/wire_bytes"] > 0
+    # the closed registry rejects an unregistered disagg series, and the
+    # handoff instant is registered in the tracer grammar + recorded
+    assert validate_events([("Serving/disagg/bogus", 1.0, 0)])
+    assert "kv_handoff" in TRACER_INSTANTS
+    names = [e["name"] for e in eng.tracer.events()]
+    assert names.count("kv_handoff") == 3
+    mon.close()
+    assert (tmp_path / "disagg" / "events.jsonl").exists()
+
+
+def test_telemetry_report_disagg_section(tmp_path):
+    from deepspeed_tpu.monitor.monitor import JSONLMonitor
+
+    class Cfg:
+        enabled = True
+        output_path = str(tmp_path)
+        job_name = "job"
+
+    mon = JSONLMonitor(Cfg())
+    mon.write_events([
+        ("Serving/disagg/handoffs", 12.0, 5),
+        ("Serving/disagg/blocks_shipped", 40.0, 5),
+        ("Serving/disagg/wire_bytes", 53125.0, 5),
+        ("Serving/disagg/bf16_equiv_bytes", 100000.0, 5),
+        ("Serving/disagg/wire_ratio", 0.531, 5),
+        ("Serving/disagg/dedup_blocks", 6.0, 5),
+        ("Serving/disagg/dedup_bytes_saved", 8192.0, 5),
+        ("Serving/disagg/import_dropped", 1.0, 5),
+        ("Serving/disagg/import_failures", 0.0, 5),
+        ("Serving/disagg/handoff_fallbacks", 2.0, 5),
+        ("Serving/disagg/tier_fallbacks", 1.0, 5),
+        ("Serving/disagg/prefill_replicas", 1.0, 5),
+        ("Serving/disagg/decode_replicas", 3.0, 5)])
+    mon.close()
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = os.path.join(repo, "scripts", "telemetry_report.py")
+    out = subprocess.run(
+        [sys.executable, script, str(tmp_path / "job" / "events.jsonl"),
+         "--serving"], capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr
+    assert "disaggregation report" in out.stdout
+    assert "tiers:                  1 prefill / 3 decode" in out.stdout
+    assert "kv handoffs:            12  (40 blocks shipped)" in out.stdout
+    assert "(0.531x)" in out.stdout
+    assert "dedup (chain-hash):     6 blocks off the wire" in out.stdout
+    assert "import drops/failures:  1 / 0" in out.stdout
+    assert "tier fallbacks:         1 admission / 2 handoff" in out.stdout
+
+
+# --------------------------------------------------------------------------- #
+# traffic generation at fleet scale (workload.py extensions)
+# --------------------------------------------------------------------------- #
+def test_workload_heavy_tail_sessions():
+    kw = dict(seed=3, turns_dist="lognormal", turns_mu=0.5, turns_sigma=1.0,
+              max_turns=16, rate_rps=40.0)
+    a1 = TrafficGenerator(WorkloadConfig(**kw)).arrivals(20.0)
+    a2 = TrafficGenerator(WorkloadConfig(**kw)).arrivals(20.0)
+    assert [(a.t, a.turns, a.request.prompt) for a in a1] == \
+        [(a.t, a.turns, a.request.prompt) for a in a2]   # seeded replay
+    budgets = [a.turns for a in a1]
+    assert all(1 <= b <= 16 for b in budgets)
+    # heavy tail: the median session is short, the max is much longer
+    assert sorted(budgets)[len(budgets) // 2] <= 3 < max(budgets)
+    # followup honors the drawn budget and carries it forward
+    gen = TrafficGenerator(WorkloadConfig(**kw))
+    arr = next(a for a in gen.arrivals(20.0) if a.turns and a.turns >= 2)
+    nxt = gen.followup(arr, [1, 2, 3], now_s=1.0)
+    assert nxt is not None and nxt.turns == arr.turns and nxt.turn == 2
+    one = next(a for a in gen.arrivals(20.0) if a.turns == 1)
+    assert gen.followup(one, [1], now_s=1.0) is None
+    with pytest.raises(ValueError, match="turns_dist"):
+        TrafficGenerator(WorkloadConfig(turns_dist="zipf"))
+
+
+def test_workload_diurnal_and_burst_overlay():
+    base = dict(seed=9, process="diurnal", rate_rps=30.0,
+                diurnal_amplitude=1.0, diurnal_period_s=20.0)
+    arr = TrafficGenerator(WorkloadConfig(**base)).arrivals(20.0)
+    assert [a.t for a in arr] == sorted(a.t for a in arr)
+    # rate(t) = rate*(1+sin(2πt/T)): the first half-period is the peak,
+    # the second the trough — the split must be strongly asymmetric
+    peak = sum(1 for a in arr if a.t < 10.0)
+    trough = len(arr) - peak
+    assert peak > 3 * max(trough, 1)
+    # burst overlay adds burst_size arrivals at each interval mark on top
+    ov = TrafficGenerator(WorkloadConfig(
+        **base, burst_overlay=True, burst_size=5,
+        burst_interval_s=4.0)).arrivals(20.0)
+    assert len(ov) == len(arr) + 4 * 5
+    for mark in (4.0, 8.0, 12.0, 16.0):
+        assert sum(1 for a in ov if a.t == mark) >= 5
+    assert [a.t for a in ov] == sorted(a.t for a in ov)
+
+
+def test_workload_tenant_mix():
+    kw = dict(seed=13, rate_rps=50.0,
+              tenant_mix=(("free", 8.0, 2), ("pro", 2.0, 1),
+                          ("enterprise", 1.0, 0)))
+    arr = TrafficGenerator(WorkloadConfig(**kw)).arrivals(20.0)
+    seen = {}
+    for a in arr:
+        seen.setdefault(a.request.tenant, set()).add(a.request.priority)
+    # every tenant appears, carries exactly its configured priority, and
+    # the weights order the frequencies
+    assert seen == {"free": {2}, "pro": {1}, "enterprise": {0}}
+    counts = {t: sum(1 for a in arr if a.request.tenant == t) for t in seen}
+    assert counts["free"] > counts["pro"] > counts["enterprise"] > 0
+    arr2 = TrafficGenerator(WorkloadConfig(**kw)).arrivals(20.0)
+    assert [(a.request.tenant, a.request.priority) for a in arr] == \
+        [(a.request.tenant, a.request.priority) for a in arr2]
+    with pytest.raises(ValueError, match="weights"):
+        TrafficGenerator(WorkloadConfig(tenant_mix=(("a", 0.0, 1),)))
