@@ -1000,6 +1000,88 @@ def bench_tiered_mem(jax, on_tpu, steps: int = None) -> dict:
         return {"ok": False, "status": f"error: {e}"[-300:]}
 
 
+def bench_integrity(jax, on_tpu, steps: int = None) -> dict:
+    """``detail.integrity`` — fingerprint-plane overhead probe
+    (docs/reliability.md "Numerics integrity & SDC"): the SAME model stepped
+    with the numerics-integrity plane off vs on at ``check_interval=10``,
+    reporting the step-time overhead fraction against the ≤2% acceptance
+    budget. Also pins the default-OFF contract observable from here: the off
+    run must emit zero ``Reliability/integrity/*`` events. ``ok`` gates on
+    the event invariants only — the timing row is evidence, not a pass/fail
+    (CPU-lane step times are too noisy for a 2% assertion)."""
+    import numpy as np
+
+    try:
+        import jax.numpy as jnp
+
+        import deepspeed_tpu as dst
+        from deepspeed_tpu.comm import mesh as mesh_lib
+        from deepspeed_tpu.models import llama
+
+        if steps is None:
+            # CPU-lane steps are ~7ms, so the first check round's one-time
+            # host-path warmup needs more rounds to amortize out of the mean
+            steps = 20 if on_tpu else 30
+        mcfg = bench_model_config(on_tpu)
+        seqlen = 512 if on_tpu else 128
+        check_interval = 10
+        steps = max(steps, check_interval)  # at least one check must fire
+
+        def run(enabled: bool):
+            mesh_lib.set_mesh(None)
+            config = {
+                "train_batch_size": 8 * max(1, len(jax.devices())),
+                "bf16": {"enabled": True},
+                "optimizer": {"type": "adamw", "params": {"lr": 3e-4}},
+                "zero_optimization": {"stage": 2},
+                "steps_per_print": 0,
+            }
+            if enabled:
+                config["reliability"] = {"integrity": {
+                    "enabled": True, "check_interval": check_interval}}
+            spec = llama.model_spec(mcfg, compute_dtype=jnp.bfloat16)
+            engine, _, _, _ = dst.initialize(model=spec, config=config)
+            rng = np.random.default_rng(0)
+
+            def batch():
+                return {"tokens": rng.integers(
+                    0, mcfg.vocab_size,
+                    (engine.train_batch_size(), seqlen + 1), dtype=np.int32)}
+
+            float(engine.train_batch(batch()).loss)  # compile + warm
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                o = engine.train_batch(batch())
+            float(o.loss)
+            dt = (time.perf_counter() - t0) / steps
+            counts = {k: int(v) for k, v in
+                      dict(getattr(engine.telemetry, "reliability_counts",
+                                   {}) or {}).items()
+                      if k.startswith("Reliability/integrity/")}
+            engine.destroy()
+            return dt, counts
+
+        dt_off, ev_off = run(False)
+        dt_on, ev_on = run(True)
+        overhead = dt_on / dt_off - 1.0 if dt_off > 0 else None
+        return {
+            "ok": not ev_off and ev_on.get("Reliability/integrity/checks",
+                                           0) > 0,
+            "step_time_s_off": round(dt_off, 4),
+            "step_time_s_on": round(dt_on, 4),
+            "overhead_frac": (round(overhead, 4)
+                              if overhead is not None else None),
+            "budget_frac": 0.02,
+            "within_budget": (overhead is not None and overhead <= 0.02),
+            "check_interval": check_interval,
+            "steps": steps,
+            "events_off": ev_off,
+            "events_on": ev_on,
+        }
+    except Exception as e:
+        return {"ok": False, "status": f"error: {e}"[-300:]}
+
+
 def run_decode_subprocess() -> object:
     """Decode bench in a SUBPROCESS with a hard timeout, BEFORE this process
     initializes its own jax client: a wedged tunnel compile must never hold
@@ -1157,6 +1239,13 @@ def main():
     # DSTPU_BENCH_TIERED=0.
     if os.environ.get("DSTPU_BENCH_TIERED", "1") not in ("", "0"):
         RESULT["detail"]["tiered_mem"] = bench_tiered_mem(jax, on_tpu)
+
+    # numerics-integrity plane overhead probe (docs/reliability.md "Numerics
+    # integrity & SDC"): step time with cross-replica fingerprints off vs on
+    # at check_interval=10 against the ≤2% budget, plus the default-OFF
+    # zero-events pin. Non-fatal; skippable via DSTPU_BENCH_INTEGRITY=0.
+    if os.environ.get("DSTPU_BENCH_INTEGRITY", "1") not in ("", "0"):
+        RESULT["detail"]["integrity"] = bench_integrity(jax, on_tpu)
 
     # step-time regression vs the newest checked-in BENCH_r*.json —
     # informational here (the gating form is --regression-only, wired as a

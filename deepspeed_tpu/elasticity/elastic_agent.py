@@ -71,7 +71,7 @@ def read_reshard_hint(save_dir: str) -> Optional[Dict[str, Any]]:
 
 def _hint_from_engine(engine, reason: str, tag: Optional[str],
                       signum: Optional[int] = None) -> Dict[str, Any]:
-    return {
+    hint = {
         "reason": reason,
         "signum": signum,
         "step": int(engine.global_steps),
@@ -85,6 +85,19 @@ def _hint_from_engine(engine, reason: str, tag: Optional[str],
         "elasticity": dict(engine.config.elasticity or {}),
         "time": time.time(),
     }
+    # numerics-integrity verdicts ride the hint (reliability/integrity.py):
+    # quarantined hosts are excluded from the next incarnation's device
+    # pool, and audit-confirmed corruption pins resume to the newest tag at
+    # or before the last verified step (walk-back — never resume poisoned
+    # weights)
+    ip = getattr(engine, "integrity", None)
+    hint["excluded_hosts"] = sorted(
+        int(h) for h in getattr(ip, "excluded_hosts", []) or [])
+    if ip is not None and getattr(ip, "walkback_requested", False):
+        hint["walkback_to_verified"] = True
+        hint["last_verified_step"] = int(
+            getattr(ip, "last_verified_step", -1))
+    return hint
 
 
 def elastic_train_config(base_config: Dict[str, Any],
@@ -110,6 +123,7 @@ def elastic_train_config(base_config: Dict[str, Any],
 def run_elastic(model_spec, base_config: Dict[str, Any],
                 checkpoint_dir: Optional[str] = None,
                 n_chips: Optional[int] = None, devices=None,
+                excluded_hosts=None, device_host_fn=None,
                 **init_kw) -> Tuple[Any, ...]:
     """Bring up an engine at the current scale and resume state if a
     checkpoint exists (reference: elastic agent restart path).
@@ -121,12 +135,35 @@ def run_elastic(model_spec, base_config: Dict[str, Any],
     one the trajectory was trained at). Universal checkpoint tags restore
     through ``engine.load_universal_checkpoint`` (reshard onto the new
     topology, dataloader/RNG fast-forward); legacy tags through the regular
-    loader."""
+    loader.
+
+    A ``reshard_hint.json`` carrying ``excluded_hosts`` (an integrity
+    quarantine — docs/reliability.md "Numerics integrity & SDC") removes
+    those hosts' devices from the pool before the scale is chosen;
+    ``excluded_hosts`` merges extra exclusions in. ``device_host_fn`` maps a
+    device to its host id (default: ``device.process_index``) — drills
+    simulating an N-host fleet on one process override it."""
     import deepspeed_tpu as dst
 
     devices = list(devices) if devices is not None else list(jax.devices())
-    available = len(devices) if n_chips is None else int(n_chips)
     hint = read_reshard_hint(checkpoint_dir) if checkpoint_dir else None
+    excluded = set(int(h) for h in (excluded_hosts or []))
+    excluded.update(int(h) for h in (hint or {}).get("excluded_hosts") or [])
+    if excluded:
+        host_of = device_host_fn or \
+            (lambda d: int(getattr(d, "process_index", 0)))
+        keep = [d for d in devices if int(host_of(d)) not in excluded]
+        if keep:
+            log_dist(f"elastic: excluding quarantined host(s) "
+                     f"{sorted(excluded)} — {len(devices) - len(keep)} "
+                     f"device(s) removed from the pool")
+            devices = keep
+        else:
+            log_dist(f"elastic: exclusion of host(s) {sorted(excluded)} "
+                     f"would leave no devices — ignoring the quarantine "
+                     f"(single-host pool)")
+    available = len(devices) if n_chips is None \
+        else min(int(n_chips), len(devices))
     ec = base_config.get("elasticity", {})
     chips = available
     if ec.get("enabled"):
@@ -153,7 +190,7 @@ def run_elastic(model_spec, base_config: Dict[str, Any],
         model=model_spec, config=config,
         devices=None if sub == list(jax.devices()) else sub, **init_kw)
     if checkpoint_dir is not None:
-        resumed = _resume(engine, checkpoint_dir)
+        resumed = _resume(engine, checkpoint_dir, hint=hint)
         if resumed and hint is not None:
             old_mesh = hint.get("mesh") or {}
             new_mesh = {k: int(v) for k, v in engine.mesh_mgr.mesh.shape.items()}
@@ -171,23 +208,74 @@ def run_elastic(model_spec, base_config: Dict[str, Any],
     return engine, opt, loader, sched
 
 
-def _resume(engine, checkpoint_dir: str) -> bool:
+def _walkback_tag(checkpoint_dir: str, max_step: int) -> Optional[str]:
+    """Newest VERIFIED tag whose step is <= ``max_step`` (PR 3 machinery:
+    meta.json steps via ``tag_candidates``, SHA-256 manifests via
+    ``verify_manifest``). None when every retained tag postdates the last
+    verified step or fails verification."""
+    import json
+
+    from ..runtime.checkpoint.manifest import tag_candidates, verify_manifest
+
+    for name in tag_candidates(checkpoint_dir):
+        full = os.path.join(checkpoint_dir, name)
+        try:
+            with open(os.path.join(full, "meta.json")) as f:
+                steps = int(json.load(f).get("global_steps", -1))
+        except (OSError, ValueError, TypeError):
+            continue
+        if steps < 0 or steps > int(max_step):
+            continue
+        status, detail = verify_manifest(full)
+        if status == "corrupt":
+            log_dist(f"elastic: walk-back skipping corrupt tag {name} "
+                     f"({detail})")
+            continue
+        return name
+    return None
+
+
+def _resume(engine, checkpoint_dir: str,
+            hint: Optional[Dict[str, Any]] = None) -> bool:
     """Restore from the newest tag under ``checkpoint_dir`` — universal
     (fragment) tags via the elastic loader, legacy tags via the regular
-    one. Returns True when a checkpoint was loaded."""
+    one. Returns True when a checkpoint was loaded.
+
+    When the reshard hint says ``walkback_to_verified`` (an integrity audit
+    confirmed corruption after ``last_verified_step``), resume is pinned to
+    the newest verified tag at or before that step — the newer, suspect
+    tags stay on disk for the post-mortem but are never resumed."""
     from ..runtime.checkpoint.saver import resolve_tag
     from ..runtime.checkpoint.universal import is_universal_tag
 
-    try:
-        tag = resolve_tag(checkpoint_dir, None)
-    except FileNotFoundError:
-        log_dist("elastic: no checkpoint yet — fresh start")
-        return False
+    tag = None
+    walkback = bool(hint and hint.get("walkback_to_verified"))
+    if walkback:
+        max_step = int(hint.get("last_verified_step", -1))
+        tag = _walkback_tag(checkpoint_dir, max_step)
+        if tag is None:
+            log_dist(f"elastic: walk-back found no verified tag at or "
+                     f"before step {max_step} — fresh start")
+            return False
+        log_dist(f"elastic: integrity walk-back — resuming from verified "
+                 f"tag {tag} (<= step {max_step}), ignoring newer suspect "
+                 f"tags")
+    else:
+        try:
+            tag = resolve_tag(checkpoint_dir, None)
+        except FileNotFoundError:
+            log_dist("elastic: no checkpoint yet — fresh start")
+            return False
     if is_universal_tag(os.path.join(checkpoint_dir, tag)):
         path, _ = engine.load_universal_checkpoint(checkpoint_dir, tag=tag)
     else:
         path, _ = engine.load_checkpoint(checkpoint_dir, tag=tag)
     if path:
+        if walkback:
+            tel = getattr(engine, "telemetry", None)
+            if tel is not None and hasattr(tel, "reliability_event"):
+                tel.reliability_event("integrity/walkbacks", 1.0,
+                                      int(engine.global_steps))
         log_dist(f"elastic resume from {path} at step {engine.global_steps}")
         return True
     return False
@@ -309,7 +397,12 @@ class PreemptionGuard:
         SAME boundary and checkpoints the same step."""
         wd_exit = bool(self.watchdog is not None and
                        getattr(self.watchdog, "restart_requested", False))
-        local = self._triggered or wd_exit
+        # the integrity plane requests the SAME elastic exit on quarantine /
+        # audit-confirmed corruption (reliability/integrity.py _escalate)
+        ip = getattr(engine, "integrity", None)
+        ip_exit = bool(ip is not None and
+                       getattr(ip, "restart_requested", False))
+        local = self._triggered or wd_exit or ip_exit
         trig = local
         self._boundary_count += 1
         if _process_count() > 1 and \
@@ -331,9 +424,12 @@ class PreemptionGuard:
             if wd_exit else None
         if wd_exit:
             self.watchdog.restart_requested = False
+        ip_reason = getattr(ip, "restart_reason", None) if ip_exit else None
+        if ip_exit:
+            ip.restart_requested = False
         self._reliability(engine, "preemption_signal")
-        reason = wd_reason or ("watchdog exit request" if wd_exit else
-                               "preemption")
+        reason = wd_reason or ip_reason or \
+            ("watchdog exit request" if wd_exit else "preemption")
         if self.universal:
             path = engine.save_universal_checkpoint(self.save_dir,
                                                     tag=self.tag,

@@ -1,0 +1,15 @@
+"""Reliability subsystems that sit ABOVE the runtime: today the numerics
+integrity plane (silent-data-corruption detection — ``integrity.py``).
+Crash consistency, the training watchdog, and elastic resume live in
+``runtime/`` and ``elasticity/``; this package hosts the guardrails that
+judge whether the numbers those systems move around are still correct."""
+
+from .integrity import (IntegrityError, IntegrityPlane, fingerprint_names,
+                        tree_fingerprint)
+
+__all__ = [
+    "IntegrityError",
+    "IntegrityPlane",
+    "fingerprint_names",
+    "tree_fingerprint",
+]

@@ -1,0 +1,394 @@
+"""Numerics integrity plane — silent-data-corruption (SDC) detection.
+
+Crashes are the easy failure: PR 3/11/15 machinery already converts them
+into durable saves and token-exact failover. The failure production fleets
+actually lose runs to is *silent*: a flaky chip flips a mantissa bit, the
+poisoned gradient all-reduces into every replica, and the run diverges hours
+later with nothing in the logs. This module is the guardrail
+(``reliability.integrity`` config block; docs/reliability.md "Numerics
+integrity & SDC"):
+
+**Cross-replica fingerprints.** The jitted train step — when the block is
+enabled, and only then — additionally computes cheap per-leaf digests of
+quantities that are replica-invariant by construction: post-all-reduce
+grads, post-step replicated params, optimizer moments, the loss scalar.
+A digest is three scalars per leaf: a bitcast-to-int32 wraparound sum
+(order-independent, exact — any single bit flip changes it), an fp32 L2
+norm (magnitude of the damage), and a nonfinite-element count (feeds the
+watchdog's per-leaf attribution). The step program emits one logical digest
+vector; every host fetches its own copy, so a host whose chips corrupt data
+fetches a DIFFERENT vector than its peers. Every ``check_interval`` steps
+the hosts allgather their vectors and majority-vote: a minority row is a
+mismatch *attributed to a specific host*, not just detected.
+
+**Shadow recompute audits.** Replica-invariance cannot see corruption that
+hits every replica identically (a systematic compute-path defect). Every
+``audit_interval`` steps a rotating auditor host re-runs the full fwd/bwd
+on the recorded batch through a separate non-donating executable BEFORE the
+live step consumes its buffers, and compares digests after the live step
+lands. Audit agreement advances ``last_verified_step``.
+
+**Quarantine protocol.** ``quarantine_threshold`` repeated attributions to
+one host → the PR 15 elastic-exit path: ``PreemptionGuard.step_boundary``
+answers with a durable universal save plus ``reshard_hint.json`` carrying
+an ``excluded_hosts`` field, and ``run_elastic`` reshards onto the
+survivors. Corruption confirmed AFTER ``last_verified_step`` (an audit
+mismatch) additionally requests a walk-back: the hint pins resume to the
+newest checkpoint tag at or before the last verified step, so the restart
+never resumes poisoned weights.
+
+Single-process drills (``testing/drill.py sdc_drill``) inject a simulated
+fleet through the ``gather_fn`` / ``process_index`` / ``process_count``
+constructor hooks — the same seam ``runtime/watchdog.py HostHeartbeat``
+uses — with ``testing/faults.py bit_flip`` providing real bit-level
+corruption at named sites.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..utils.logging import log_dist, logger
+
+__all__ = [
+    "IntegrityError",
+    "IntegrityPlane",
+    "fingerprint_names",
+    "tree_fingerprint",
+]
+
+# fingerprinted sections in wire order (the allgathered row concatenates
+# them in THIS order; both ends must agree)
+SECTIONS = ("grads", "params", "opt_state", "loss")
+
+
+class IntegrityError(RuntimeError):
+    """Raised on confirmed corruption when ``on_corruption: raise``."""
+
+
+# --------------------------------------------------------------------------- #
+# on-device digests (jit-traceable)
+# --------------------------------------------------------------------------- #
+def _leaf_digest(x):
+    """One leaf → (bitsum int32, sumsq float32, nonfinite int32).
+
+    The bitsum is a wraparound sum of the raw bit patterns — commutative
+    (safe under any reduction order XLA picks for a fixed program) and
+    sensitive to every single-bit flip. The L2 sum-of-squares sizes the
+    damage; the nonfinite count gives the watchdog per-leaf NaN/Inf
+    attribution without an extra device pass."""
+    import jax
+    import jax.numpy as jnp
+
+    x = jnp.asarray(x)
+    flat = jnp.ravel(x)
+    if jnp.issubdtype(x.dtype, jnp.floating):
+        ity = {1: jnp.int8, 2: jnp.int16, 4: jnp.int32,
+               8: jnp.int64}[x.dtype.itemsize]
+        bits = jax.lax.bitcast_convert_type(flat, ity).astype(jnp.int32)
+        f = flat.astype(jnp.float32)
+    else:  # integer / bool leaves (step counters in opt state)
+        bits = flat.astype(jnp.int32)
+        f = flat.astype(jnp.float32)
+    bitsum = jnp.sum(bits, dtype=jnp.int32)
+    sumsq = jnp.sum(f * f, dtype=jnp.float32)
+    nonfinite = jnp.sum(
+        jnp.logical_not(jnp.isfinite(f))).astype(jnp.int32)
+    return bitsum, sumsq, nonfinite
+
+
+def tree_fingerprint(tree) -> Dict[str, Any]:
+    """Pytree → ``{"bitsum": [L] i32, "sumsq": [L] f32, "nonfinite": [L]
+    i32}`` stacked in ``jax.tree_util`` leaf order. Traceable — called from
+    inside the jitted step when ``reliability.integrity`` is enabled."""
+    import jax
+    import jax.numpy as jnp
+
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        z = jnp.zeros((0,), jnp.int32)
+        return {"bitsum": z, "sumsq": jnp.zeros((0,), jnp.float32),
+                "nonfinite": z}
+    digs = [_leaf_digest(leaf) for leaf in leaves]
+    return {
+        "bitsum": jnp.stack([d[0] for d in digs]),
+        "sumsq": jnp.stack([d[1] for d in digs]),
+        "nonfinite": jnp.stack([d[2] for d in digs]),
+    }
+
+
+def fingerprint_names(tree) -> List[str]:
+    """Dotted leaf paths in the same order ``tree_fingerprint`` stacks —
+    the attribution half of the digest (host-side, shape math only)."""
+    import jax
+
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    names = []
+    for path, _leaf in flat:
+        s = jax.tree_util.keystr(path)
+        s = re.sub(r"\['([^']*)'\]", r".\1", s)
+        s = re.sub(r"\[([0-9]+)\]", r".\1", s)
+        names.append(s.strip(".") or "leaf")
+    return names
+
+
+# --------------------------------------------------------------------------- #
+# host-side plane
+# --------------------------------------------------------------------------- #
+def _default_gather(vec: np.ndarray) -> np.ndarray:
+    """Allgather one digest row across processes → ``[n_hosts, D]``."""
+    import jax
+
+    if jax.process_count() == 1:
+        return vec[None]
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(vec))
+
+
+def _fp_to_host(fp: Dict[str, Dict[str, Any]]) -> Dict[str, Dict[str, np.ndarray]]:
+    """Device digest dict → host numpy (the only device sync the plane
+    does, and only on check/audit steps)."""
+    return {sec: {k: np.asarray(v) for k, v in d.items()}
+            for sec, d in fp.items()}
+
+
+class IntegrityPlane:
+    """Host-side driver: consumes the step's digest aux, runs the
+    cross-host compare cadence, attributes mismatches, and escalates to
+    quarantine / walk-back. Constructed by the engine when
+    ``reliability.integrity.enabled``; the ``gather_fn`` /
+    ``process_index`` / ``process_count`` hooks exist so drills can
+    simulate an N-host fleet in one process (HostHeartbeat pattern)."""
+
+    def __init__(self, config, telemetry=None, *,
+                 gather_fn: Optional[Callable[[np.ndarray], np.ndarray]] = None,
+                 process_index: Optional[int] = None,
+                 process_count: Optional[int] = None):
+        import jax
+
+        self.cfg = config.reliability.integrity
+        self.telemetry = telemetry
+        self._gather = gather_fn or _default_gather
+        self._index = (jax.process_index() if process_index is None
+                       else int(process_index))
+        self._count = (jax.process_count() if process_count is None
+                       else int(process_count))
+        # cumulative per-host attribution counts → quarantine decision
+        self.attribution_counts: Dict[int, int] = {}
+        self.excluded_hosts: List[int] = []
+        # elastic-exit request (PreemptionGuard.step_boundary polls these,
+        # exactly like the watchdog's restart_requested)
+        self.restart_requested = False
+        self.restart_reason: Optional[str] = None
+        # audit-confirmed all-replica corruption → resume must walk back
+        self.walkback_requested = False
+        self.last_verified_step = -1
+        # last check's verdict, for drills/tests: {"step", "mismatched_hosts",
+        # "leaves": [(host, "section.leaf"), ...]}
+        self.last_report: Optional[Dict[str, Any]] = None
+        self.checks = 0
+        self.mismatches = 0
+        self.audits = 0
+        self._names: Dict[str, List[str]] = {}
+        self._audit_pending: Optional[Tuple[int, Dict[str, Any]]] = None
+
+    # -- telemetry ---------------------------------------------------------
+    def _emit(self, name: str, value: float = 1.0, step: int = 0) -> None:
+        tel = self.telemetry
+        if tel is not None and hasattr(tel, "reliability_event"):
+            tel.reliability_event(f"integrity/{name}", float(value),
+                                  int(step))
+
+    # -- attribution metadata ---------------------------------------------
+    def _section_names(self, engine, fp: Dict[str, Any]) -> Dict[str, List[str]]:
+        """Leaf names per section, resolved lazily from the live state (the
+        digest arrays carry order, the trees carry names)."""
+        if self._names:
+            return self._names
+        names: Dict[str, List[str]] = {}
+        for sec in fp:
+            if sec in ("grads", "params"):
+                names[sec] = fingerprint_names(engine.state.params)
+            elif sec == "opt_state":
+                names[sec] = fingerprint_names(engine.state.opt_state)
+            else:
+                names[sec] = ["loss"]
+        self._names = names
+        return names
+
+    def _row_index(self, fp: Dict[str, Dict[str, np.ndarray]]) \
+            -> List[Tuple[str, str, int]]:
+        """Flat wire-row index → (section, digest kind, leaf idx)."""
+        idx = []
+        for sec in SECTIONS:
+            if sec not in fp:
+                continue
+            n = len(fp[sec]["bitsum"])
+            for kind in ("bitsum", "sumsq", "nonfinite"):
+                idx.extend((sec, kind, i) for i in range(n))
+        return idx
+
+    def _to_row(self, fp: Dict[str, Dict[str, np.ndarray]]) -> np.ndarray:
+        """Digest dict → one float64 wire row (int32 bitsums are exact in
+        float64). Section/kind order must match :meth:`_row_index`."""
+        parts = []
+        for sec in SECTIONS:
+            if sec not in fp:
+                continue
+            for kind in ("bitsum", "sumsq", "nonfinite"):
+                parts.append(np.asarray(fp[sec][kind], np.float64).ravel())
+        return np.concatenate(parts) if parts else np.zeros(0, np.float64)
+
+    # -- step hooks --------------------------------------------------------
+    def pre_step(self, engine, batch) -> None:
+        """Called by ``train_batch`` BEFORE the live (donating) step when an
+        audit is due: the shadow recompute must read the state buffers the
+        live step is about to donate. Runs the rotating-auditor schedule."""
+        cfg = self.cfg
+        if not (cfg.enabled and cfg.audit_interval):
+            return
+        step = int(engine.global_steps) + 1  # the step about to run
+        if step % int(cfg.audit_interval) != 0:
+            return
+        auditor = (step // int(cfg.audit_interval)) % max(1, self._count)
+        if auditor != self._index:
+            return
+        fn = engine._ensure_audit_step()
+        _state, out = fn(engine.state, batch, engine._lr_override)
+        fp = (out.aux or {}).get("integrity")
+        if fp is None:
+            return
+        self._audit_pending = (step, _fp_to_host(fp))
+        self.audits += 1
+        self._emit("audit_steps", step=step)
+
+    def on_step(self, engine, out) -> None:
+        """Called by ``train_batch`` after every optimizer step (post
+        ``global_steps`` increment). Off-cadence steps return without
+        touching device data."""
+        cfg = self.cfg
+        if not cfg.enabled:
+            return
+        fp_dev = (getattr(out, "aux", None) or {}).get("integrity")
+        if fp_dev is None:
+            return
+        step = int(engine.global_steps)
+        audit_due = (self._audit_pending is not None
+                     and self._audit_pending[0] == step)
+        check_due = (cfg.check_interval
+                     and step % int(cfg.check_interval) == 0)
+        if not (audit_due or check_due):
+            return
+        fp = _fp_to_host(fp_dev)
+        if audit_due:
+            self._audit_compare(engine, fp, step)
+        if check_due:
+            self._check(engine, fp, step)
+
+    # -- cross-host compare ------------------------------------------------
+    def _check(self, engine, fp, step: int) -> None:
+        row = self._to_row(fp)
+        rows = np.asarray(self._gather(row), np.float64)
+        self.checks += 1
+        self._emit("checks", step=step)
+        keys = [rows[h].tobytes() for h in range(rows.shape[0])]
+        votes: Dict[bytes, int] = {}
+        for k in keys:
+            votes[k] = votes.get(k, 0) + 1
+        majority = max(votes.items(), key=lambda kv: kv[1])[0]
+        bad = [h for h, k in enumerate(keys) if k != majority]
+        if not bad:
+            if not self.walkback_requested:
+                self.last_verified_step = step
+            self.last_report = {"step": step, "mismatched_hosts": [],
+                                "leaves": []}
+            return
+        maj_row = np.frombuffer(majority, np.float64)
+        idx = self._row_index(fp)
+        names = self._section_names(engine, fp)
+        leaves: List[Tuple[int, str]] = []
+        for h in bad:
+            diff = np.flatnonzero(rows[h] != maj_row)
+            for d in diff[:8]:  # cap the report, not the detection
+                sec, kind, i = idx[d]
+                leaves.append((h, f"{sec}.{names[sec][i]}:{kind}"))
+            self.mismatches += 1
+            self._emit("mismatches", step=step)
+            self._emit("attributed_host", value=float(h), step=step)
+            self.attribution_counts[h] = self.attribution_counts.get(h, 0) + 1
+        self.last_report = {"step": step, "mismatched_hosts": bad,
+                            "leaves": leaves}
+        detail = "; ".join(f"host {h}: {name}" for h, name in leaves[:4])
+        log_dist(f"integrity: digest mismatch at step {step} attributed to "
+                 f"host(s) {bad} ({detail})")
+        thr = int(self.cfg.quarantine_threshold)
+        over = [h for h in bad if thr and self.attribution_counts[h] >= thr]
+        if over:
+            self._quarantine(engine, over, step)
+
+    # -- shadow audit ------------------------------------------------------
+    def _audit_compare(self, engine, live_fp, step: int) -> None:
+        _astep, shadow = self._audit_pending
+        self._audit_pending = None
+        rtol = float(self.cfg.audit_rtol)
+        bad: List[str] = []
+        names = self._section_names(engine, live_fp)
+        for sec in live_fp:
+            if sec not in shadow:
+                continue
+            ls, ss = live_fp[sec], shadow[sec]
+            sq_l = np.asarray(ls["sumsq"], np.float64)
+            sq_s = np.asarray(ss["sumsq"], np.float64)
+            nf_l = np.asarray(ls["nonfinite"])
+            nf_s = np.asarray(ss["nonfinite"])
+            rel = np.abs(sq_l - sq_s) / np.maximum(1.0, np.abs(sq_s))
+            # nonfinite sumsq on both sides (overflow step) compares equal
+            rel = np.where(~np.isfinite(sq_l) & ~np.isfinite(sq_s), 0.0, rel)
+            for i in np.flatnonzero((rel > rtol) | (nf_l != nf_s)):
+                bad.append(f"{sec}.{names[sec][i]}")
+        if not bad:
+            if not self.walkback_requested:
+                self.last_verified_step = step
+            return
+        self.mismatches += 1
+        self._emit("mismatches", step=step)
+        # all-replica compute corruption: the live step disagrees with its
+        # own shadow recompute AFTER the last verified step → the current
+        # weights are suspect; resume must walk back, not reload them
+        self.walkback_requested = True
+        self._emit("walkbacks", step=step)
+        reason = (f"integrity audit mismatch at step {step} "
+                  f"(last verified step {self.last_verified_step}): "
+                  f"{', '.join(bad[:4])}")
+        log_dist(f"integrity: {reason}")
+        self._escalate(engine, reason)
+
+    # -- escalation --------------------------------------------------------
+    def _quarantine(self, engine, hosts: List[int], step: int) -> None:
+        self.excluded_hosts = sorted(set(self.excluded_hosts) | set(hosts))
+        for h in hosts:
+            self._emit("quarantines", value=float(h), step=step)
+        reason = (f"integrity quarantine: host(s) {hosts} attributed "
+                  f"{self.cfg.quarantine_threshold}+ digest mismatches "
+                  f"by step {step}")
+        log_dist(f"integrity: {reason} — excluded_hosts="
+                 f"{self.excluded_hosts}")
+        self._escalate(engine, reason)
+
+    def _escalate(self, engine, reason: str) -> None:
+        action = (self.cfg.on_corruption or "exit").lower()
+        if action == "raise":
+            raise IntegrityError(reason)
+        if action == "warn":
+            logger.warning(f"integrity: {reason} (on_corruption=warn)")
+            return
+        # "exit": request checkpoint-and-exit through the elastic boundary
+        # (PreemptionGuard.step_boundary polls engine.integrity — the same
+        # protocol as watchdog on_violation=exit / heartbeat host loss)
+        self.restart_requested = True
+        if not self.restart_reason:
+            self.restart_reason = reason

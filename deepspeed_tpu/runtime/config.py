@@ -442,6 +442,52 @@ class WatchdogConfig(ConfigModel):
 
 @register_config_model
 @dataclass
+class IntegrityConfig(ConfigModel):
+    """``reliability.integrity`` block — the numerics-integrity plane
+    (``deepspeed_tpu/reliability/integrity.py``; docs/reliability.md
+    "Numerics integrity & SDC"). Default OFF: the training step is the exact
+    pre-integrity program, byte-identical (pinned by tests/test_integrity.py).
+
+    With ``enabled`` the jitted step additionally computes cheap per-leaf
+    digests (bitcast-to-int32 wraparound sums + L2 norms + nonfinite counts)
+    of replica-invariant quantities — post-all-reduce grads, post-step
+    replicated params, optimizer moments, the loss scalar. Every
+    ``check_interval`` steps the host allgathers the digest vector across
+    processes and majority-votes: a minority row attributes the mismatch to a
+    specific host. Every ``audit_interval`` steps a rotating auditor re-runs
+    fwd/bwd on a recorded micro-batch and compares digests against the live
+    step (catches all-replica compute SDC that replica invariance cannot
+    see). ``quarantine_threshold`` repeated attributions to one host fire the
+    elastic-exit path: durable universal save + ``reshard_hint.json`` with an
+    ``excluded_hosts`` field that ``run_elastic`` reshards around."""
+    enabled: bool = False
+    # steps between cross-host digest compare rounds
+    check_interval: int = 10
+    # steps between shadow recompute audits (0 = off)
+    audit_interval: int = 0
+    # attributions to one host before quarantine fires (0 = never quarantine)
+    quarantine_threshold: int = 3
+    # relative tolerance for the shadow-audit L2 compare (bitcast sums are
+    # exact; the audit recompute may legally differ by reduction order)
+    audit_rtol: float = 1e-6
+    # which quantities are fingerprinted
+    fingerprint_grads: bool = True
+    fingerprint_params: bool = True
+    fingerprint_opt_state: bool = True
+    # raise | warn | exit (quarantine via PreemptionGuard elastic exit)
+    on_corruption: str = "exit"
+
+
+@register_config_model
+@dataclass
+class ReliabilityConfig(ConfigModel):
+    """Top-level ``reliability`` block (integrity sub-block;
+    docs/reliability.md)."""
+    integrity: IntegrityConfig = field(default_factory=IntegrityConfig)
+
+
+@register_config_model
+@dataclass
 class MemoryTieringConfig(ConfigModel):
     """``memory.tiering`` block — the tiered memory subsystem
     (``deepspeed_tpu/memory``; docs/memory.md). Default OFF: the training
@@ -520,6 +566,7 @@ class DeepSpeedTPUConfig:
     watchdog: WatchdogConfig = field(default_factory=WatchdogConfig)
     telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
     memory: MemoryConfig = field(default_factory=MemoryConfig)
+    reliability: ReliabilityConfig = field(default_factory=ReliabilityConfig)
     aio: AIOConfig = field(default_factory=AIOConfig)
 
     gradient_clipping: float = 0.0
@@ -598,6 +645,7 @@ _SUBCONFIG_KEYS = {
     "watchdog": WatchdogConfig,
     "telemetry": TelemetryConfig,
     "memory": MemoryConfig,
+    "reliability": ReliabilityConfig,
     "aio": AIOConfig,
 }
 

@@ -556,6 +556,18 @@ class DeepSpeedTPUEngine:
             self.watchdog = TrainingWatchdog(config.watchdog,
                                              telemetry=self.telemetry)
 
+        # --- numerics integrity plane (reliability/integrity.py): SDC
+        # detection via cross-replica digest votes + shadow recompute
+        # audits. Opt-in: with the block disabled the step program carries
+        # no digest computation — byte-identical to the pre-integrity
+        # program (pinned by tests/test_integrity.py) ---
+        self.integrity = None
+        if config.reliability.integrity.enabled:
+            from ..reliability.integrity import IntegrityPlane
+
+            self.integrity = IntegrityPlane(config,
+                                            telemetry=self.telemetry)
+
         # --- curriculum learning (reference engine hooks :395-408 wire the
         # curriculum scheduler into the forward prologue) ---
         self.curriculum_scheduler = None
@@ -1577,13 +1589,31 @@ class DeepSpeedTPUEngine:
             loco_residual=(state.loco_residual if loco_residual is None
                            else loco_residual),
         )
+        aux = {} if aux is None else aux
+        icfg = cfg.reliability.integrity
+        if icfg.enabled and isinstance(aux, dict):
+            from ..reliability.integrity import tree_fingerprint
+
+            # digests of replica-invariant quantities: the unscaled/clipped
+            # post-reduce grads, the post-step params and optimizer moments,
+            # and the loss scalar. Three scalars per leaf — the transfer to
+            # host happens only on check/audit steps (IntegrityPlane)
+            fp = {}
+            if icfg.fingerprint_grads:
+                fp["grads"] = tree_fingerprint(grads)
+            if icfg.fingerprint_params:
+                fp["params"] = tree_fingerprint(new_params)
+            if icfg.fingerprint_opt_state:
+                fp["opt_state"] = tree_fingerprint(new_opt)
+            fp["loss"] = tree_fingerprint(loss)
+            aux = {**aux, "integrity": fp}
         out = StepOutput(loss=loss, grad_norm=grad_norm, lr=lr_t,
                          loss_scale=new_scale.scale,
                          overflow=jnp.logical_not(finite),
-                         aux={} if aux is None else aux)
+                         aux=aux)
         return new_state, out
 
-    def _build_train_step(self):
+    def _make_step_fn(self):
         overlap = self._overlap_active()
 
         def step_fn(state: TrainState, batch, lr_override):
@@ -1597,13 +1627,27 @@ class DeepSpeedTPUEngine:
             grads, loss, aux = self._accumulate(state.params, batch, state.loss_scale)
             return self._apply_update(state, grads, loss, aux, lr_override)
 
+        return step_fn
+
+    def _build_train_step(self):
         # jitted entry points route through the telemetry hub's compile
         # monitor (the recompilation sentinel + per-program cost model —
         # telemetry/compile.py). Default OFF → the exact jax.jit object.
         with self.mesh_mgr.activate():
             self._train_step = self.telemetry.compile.jit(
-                "train_step", step_fn, donate_argnums=(0,))
+                "train_step", self._make_step_fn(), donate_argnums=(0,))
         return self._train_step
+
+    def _ensure_audit_step(self):
+        """The shadow-recompute executable for integrity audits: the SAME
+        step function as ``_train_step`` but WITHOUT input donation, so the
+        auditor can re-run fwd/bwd on state buffers the live step is about
+        to consume. Built lazily — never compiled unless an audit fires."""
+        if getattr(self, "_audit_step", None) is None:
+            with self.mesh_mgr.activate():
+                self._audit_step = self.telemetry.compile.jit(
+                    "audit_step", self._make_step_fn())
+        return self._audit_step
 
     def _ensure_apply_step(self):
         """The jitted optimizer-apply phase, shared by the forward/backward/
@@ -1767,6 +1811,10 @@ class DeepSpeedTPUEngine:
                 out = self._train_batch_breakdown(batch)
             self.timers(TRAIN_BATCH_TIMER).stop(sync=False)
         else:
+            # shadow recompute audit (rotating auditor): must run BEFORE
+            # the live step donates the state buffers it reads
+            if self.integrity is not None:
+                self.integrity.pre_step(self, batch)
             # the fused step is ONE XLA program — a single span (the phase
             # split only exists under wall_clock_breakdown)
             with self.telemetry.tracer.span("train/train_batch", cat="train",
@@ -1788,6 +1836,8 @@ class DeepSpeedTPUEngine:
                      f"scale={float(out.loss_scale):.0f}")
         if self.watchdog is not None:
             self.watchdog.observe(self, out)
+        if self.integrity is not None:
+            self.integrity.on_step(self, out)
         return out
 
     # ------------------------------------------------------------------ #

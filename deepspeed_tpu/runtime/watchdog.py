@@ -271,13 +271,32 @@ class TrainingWatchdog:
         else:
             self.consecutive_skips = 0
 
-        # 2. non-finite / spiking host-side loss
+        # 2. non-finite / spiking host-side loss. When the integrity plane
+        # is on, its per-leaf digest pass rides along in ``out.aux`` — the
+        # violation message then NAMES the poisoned layers instead of just
+        # reporting a bad scalar, at no extra device sync (satellite of
+        # docs/reliability.md "Numerics integrity & SDC")
         if not math.isfinite(loss):
             if cfg.detect_non_finite:
+                where = self._nonfinite_leaves(engine, out)
+                suffix = f"; nonfinite grads in {', '.join(where)}" \
+                    if where else ""
                 self._violate(engine, "non_finite_loss", step,
-                              f"non-finite loss ({loss}) at step {step}")
+                              f"non-finite loss ({loss}) at step "
+                              f"{step}{suffix}")
                 return
         else:
+            # on-device per-leaf grad sentinels: nonfinite grads under a
+            # FINITE loss are corruption the host-side loss check cannot
+            # see. Overflow steps are excluded — fp16 inf grads there are
+            # the loss scaler's business (detector 1)
+            if cfg.detect_non_finite and not overflow:
+                where = self._nonfinite_leaves(engine, out)
+                if where:
+                    self._violate(engine, "non_finite_grads", step,
+                                  f"non-finite grads at step {step} in "
+                                  f"{', '.join(where)}")
+                    return
             spike = float(cfg.loss_spike_factor or 0.0)
             if spike > 0 and len(self._loss_window) >= int(cfg.min_samples):
                 med = statistics.median(self._loss_window)
@@ -307,6 +326,35 @@ class TrainingWatchdog:
                     f"(hard_timeout_s={hard:g})")
                 return
             self._time_window.append(step_time_s)
+
+    @staticmethod
+    def _nonfinite_leaves(engine, out, limit: int = 4):
+        """Layer attribution from the integrity fingerprint pass (present in
+        ``out.aux`` when ``reliability.integrity`` is enabled): dotted names
+        of grad leaves carrying NaN/Inf elements. Empty without the plane —
+        the host-side loss detectors still run unchanged."""
+        fp = (getattr(out, "aux", None) or {}).get("integrity")
+        if not isinstance(fp, dict) or "grads" not in fp:
+            return []
+        import numpy as np
+
+        counts = np.asarray(fp["grads"]["nonfinite"])
+        idx = np.flatnonzero(counts)
+        if idx.size == 0:
+            return []
+        try:
+            from ..reliability.integrity import fingerprint_names
+
+            names = fingerprint_names(engine.state.params)
+        except Exception:
+            names = []
+        leaves = []
+        for i in idx[:limit]:
+            nm = names[i] if i < len(names) else f"leaf[{i}]"
+            leaves.append(f"{nm} ({int(counts[i])} elem)")
+        if idx.size > limit:
+            leaves.append(f"+{int(idx.size) - limit} more leaves")
+        return leaves
 
     # convenience alias mirroring PreemptionGuard.step_boundary: run the
     # detectors and report whether the loop should exit for a restart

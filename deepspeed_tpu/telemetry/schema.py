@@ -49,6 +49,7 @@ __all__ = ["EVENT_NAME_RE", "SERVING_SERIES", "TRAIN_SERIES",
            "COMM_METRICS", "COMM_TOTAL_SERIES",
            "COMPILE_METRICS", "COMPILE_TOTAL_SERIES", "ANOMALY_SERIES",
            "MEMORY_TIER_SERIES", "RELIABILITY_ELASTIC_SERIES",
+           "RELIABILITY_INTEGRITY_SERIES",
            "TENANT_METRICS", "FLEET_REPLICA_METRICS", "FLEET_AGG_SERIES",
            "FLEET_OUTLIER_SERIES", "TRACER_INSTANTS",
            "MFU_SEGMENT_RE", "ANOMALY_PHASES",
@@ -201,6 +202,15 @@ RELIABILITY_ELASTIC_SERIES = frozenset(
     "Reliability/elastic/" + m for m in (
         "saves", "resumes", "reshards", "host_loss_detected", "drill_pass"))
 
+# Registered Reliability/integrity/* series (the numerics-integrity plane —
+# cross-replica fingerprint votes, shadow recompute audits, suspect-host
+# quarantine, and checkpoint walk-back; docs/reliability.md "Numerics
+# integrity & SDC"): CLOSED, same contract as the elastic family above.
+RELIABILITY_INTEGRITY_SERIES = frozenset(
+    "Reliability/integrity/" + m for m in (
+        "checks", "mismatches", "attributed_host", "quarantines",
+        "walkbacks", "audit_steps"))
+
 # Per-tenant SLO accounting (telemetry/fleet.py TenantSLOAccountant;
 # docs/observability.md "Fleet observability"): series are
 # Serving/tenant/<slug>/<metric> with an OPEN tenant-slug namespace (the
@@ -324,6 +334,13 @@ def validate_events(events: Iterable[Tuple[str, float, int]]) -> List[str]:
                 f"event #{i}: elastic reliability series {name!r} is not "
                 f"registered in "
                 f"telemetry.schema.RELIABILITY_ELASTIC_SERIES")
+            continue
+        if name.startswith("Reliability/integrity/") and \
+                name not in RELIABILITY_INTEGRITY_SERIES:
+            problems.append(
+                f"event #{i}: integrity reliability series {name!r} is not "
+                f"registered in "
+                f"telemetry.schema.RELIABILITY_INTEGRITY_SERIES")
             continue
         if name.startswith("Anomaly/") and name not in ANOMALY_SERIES:
             problems.append(f"event #{i}: anomaly series {name!r} is not "

@@ -259,8 +259,252 @@ def elastic_drill(workdir: str, phases: Optional[Sequence[DrillPhase]] = None,
     return result
 
 
+def _sdc_config(elastic: Dict, seed: int, integrity: Dict) -> Dict:
+    return {
+        "elasticity": dict(elastic),
+        "optimizer": {"type": "adamw", "params": {"lr": 0.05}},
+        "zero_optimization": {"stage": 2},
+        "checkpoint": {"engine": "fast"},
+        "steps_per_print": 0,
+        "seed": int(seed),
+        "reliability": {"integrity": dict(integrity)},
+    }
+
+
+def sdc_drill(workdir: str, sites: Sequence[str] = ("grad", "param",
+                                                    "opt_moment"),
+              world: int = 4, bad_host: int = 2, total_steps: int = 8,
+              seed: int = 0, global_batch: int = 8, dim: int = 8,
+              check_interval: int = 2, tol: float = 1e-6,
+              assert_equal: bool = True) -> Dict[str, Any]:
+    """Silent-data-corruption drill (docs/reliability.md "Numerics
+    integrity & SDC"): inject → detect → attribute → quarantine → reshard →
+    resume, asserting the resumed loss trajectory rejoins the clean
+    reference to ``tol`` at every step.
+
+    Three legs, all seeded, all on the CPU mesh:
+
+    1. **detection**: for each corruption ``site`` (post-reduce grad,
+       replicated param, optimizer moment), a real bit flip on simulated
+       host ``bad_host`` of ``world`` must be caught by the cross-replica
+       vote within ``check_interval`` steps and attributed to that host;
+    2. **quarantine**: repeated attribution crosses the threshold → durable
+       universal save + ``reshard_hint.json`` with ``excluded_hosts`` →
+       ``run_elastic`` reshards onto the surviving hosts' devices and the
+       trajectory continues exactly on the clean reference;
+    3. **walk-back**: an all-replica compute fault (``mode="compute"``) is
+       invisible to the vote but caught by the shadow recompute audit —
+       resume must walk BACK to the newest verified tag (never the newer,
+       suspect one) and replay forward on the clean trajectory.
+    """
+    import jax
+
+    import deepspeed_tpu as dst
+
+    from ..elasticity import PreemptionGuard, read_reshard_hint, run_elastic
+
+    n_avail = len(jax.devices())
+    elastic = {"enabled": True, "max_train_batch_size": int(global_batch),
+               "micro_batch_sizes": [1, 2, 4], "min_gpus": 1,
+               "max_gpus": n_avail, "prefer_larger_batch": True}
+    spec = _drill_spec(dim)
+    dataset = _drill_dataset(global_batch * (total_steps + 2), dim, seed)
+    host_of = lambda d: int(d.id) % int(world)  # noqa: E731 — sim fleet
+
+    # ---- clean reference: per-step losses, integrity ON, no faults ----
+    _reset_process_state()
+    engine, _, loader, _ = run_elastic(
+        spec, _sdc_config(elastic, seed, {"enabled": True,
+                                          "check_interval": check_interval}),
+        checkpoint_dir=None, n_chips=n_avail, training_data=dataset)
+    baseline: List[float] = []
+    for batch in loader:
+        baseline.append(float(engine.train_batch(batch).loss))
+        if len(baseline) >= total_steps:
+            break
+    engine.destroy()
+
+    obs: List[Any] = []  # every drilled (step, loss) incl. walk-back replays
+
+    def _run(engine, loader, guard, budget, cm) -> bool:
+        exited = False
+        try:
+            for batch in loader:
+                out = engine.train_batch(batch)
+                obs.append((int(engine.global_steps), float(out.loss)))
+                if guard is not None and guard.step_boundary(engine):
+                    exited = True
+                    break
+                budget -= 1
+                if budget <= 0:
+                    break
+        finally:
+            if cm is not None:
+                cm.__exit__(None, None, None)
+        return exited
+
+    # ---- leg 1: detection + attribution at every corruption site ----
+    detections: List[Dict[str, Any]] = []
+    for site in sites:
+        _reset_process_state()
+        engine, _, loader, _ = dst.initialize(
+            model=spec,
+            config=_sdc_config(elastic, seed, {
+                "enabled": True, "check_interval": check_interval,
+                "quarantine_threshold": 0, "on_corruption": "warn"}),
+            training_data=dataset)
+        it = iter(loader)
+        for _ in range(check_interval):  # a clean check round first
+            engine.train_batch(next(it))
+        plane = engine.integrity
+        if plane.last_report is None or plane.last_report["mismatched_hosts"]:
+            raise AssertionError(f"site {site}: clean run failed its own "
+                                 f"digest vote: {plane.last_report}")
+        cm = faults.bit_flip(engine, site=site, host=bad_host, world=world,
+                             index=3, bit=23)
+        inj = cm.__enter__()
+        try:
+            for _ in range(check_interval):
+                engine.train_batch(next(it))
+        finally:
+            cm.__exit__(None, None, None)
+        rep = plane.last_report or {}
+        delay = rep.get("step", 1 << 30) - (inj["first_step"] or 0)
+        ok = rep.get("mismatched_hosts") == [bad_host] and \
+            0 <= delay < check_interval
+        detections.append({"site": site, "ok": bool(ok), "delay": int(delay),
+                           "report": rep})
+        engine.destroy()
+        if not ok:
+            break
+
+    # ---- leg 2: quarantine → excluded_hosts reshard → resume ----
+    ckpt = os.path.join(workdir, "sdc_quarantine")
+    _reset_process_state()
+    integ = {"enabled": True, "check_interval": check_interval,
+             "quarantine_threshold": 2, "on_corruption": "exit"}
+    engine, _, loader, _ = run_elastic(
+        spec, _sdc_config(elastic, seed, integ), checkpoint_dir=ckpt,
+        n_chips=n_avail, training_data=dataset, device_host_fn=host_of)
+    guard = PreemptionGuard(ckpt, signals=(), universal=True)
+    cm = faults.bit_flip(engine, site="param", host=bad_host, world=world,
+                         index=3, bit=23)
+    cm.__enter__()
+    quarantined = _run(engine, loader, guard, budget=total_steps, cm=cm)
+    guard.uninstall()
+    exit_step = int(engine.global_steps)
+    engine.destroy()
+    hint = read_reshard_hint(ckpt)
+    quarantine_ok = bool(
+        quarantined and hint
+        and hint.get("excluded_hosts") == [int(bad_host)]
+        and not hint.get("walkback_to_verified"))
+    resumed_chips = None
+    if quarantine_ok:
+        _reset_process_state()
+        engine, _, loader, _ = run_elastic(
+            spec, _sdc_config(elastic, seed, integ), checkpoint_dir=ckpt,
+            training_data=dataset, device_host_fn=host_of)
+        resumed_chips = int(engine.mesh_mgr.world_size)
+        quarantine_ok = engine.global_steps == exit_step and \
+            resumed_chips < n_avail
+        guard = PreemptionGuard(ckpt, signals=(), universal=True)
+        _run(engine, loader, guard, budget=total_steps - exit_step, cm=None)
+        guard.uninstall()
+        engine.destroy()
+
+    # ---- leg 3: audit-confirmed compute fault → checkpoint walk-back ----
+    ckpt2 = os.path.join(workdir, "sdc_walkback")
+    _reset_process_state()
+    integ2 = {"enabled": True, "check_interval": 0, "audit_interval": 2,
+              "quarantine_threshold": 0, "on_corruption": "exit"}
+    engine, _, loader, _ = run_elastic(
+        spec, _sdc_config(elastic, seed, integ2), checkpoint_dir=ckpt2,
+        n_chips=n_avail, training_data=dataset)
+    guard = PreemptionGuard(ckpt2, signals=(), universal=True)
+    it = iter(loader)
+    verified_tag_step = 3
+    for _ in range(verified_tag_step):
+        out = engine.train_batch(next(it))
+        obs.append((int(engine.global_steps), float(out.loss)))
+    engine.save_universal_checkpoint(ckpt2)  # the verified tag to walk to
+    out = engine.train_batch(next(it))  # step 4: audit verifies
+    obs.append((int(engine.global_steps), float(out.loss)))
+    last_verified = int(engine.integrity.last_verified_step)
+    cm = faults.bit_flip(engine, site="param", mode="compute", world=1,
+                         host=0, index=3, bit=23)
+    cm.__enter__()
+    walked = False
+    try:
+        for _ in range(2 * 2 + 1):  # next audit round must catch it
+            out = engine.train_batch(next(it))
+            obs.append((int(engine.global_steps), float(out.loss)))
+            if guard.step_boundary(engine):
+                walked = True
+                break
+    finally:
+        cm.__exit__(None, None, None)
+        guard.uninstall()
+    suspect_step = int(engine.global_steps)
+    engine.destroy()
+    hint2 = read_reshard_hint(ckpt2)
+    walkback_ok = bool(
+        walked and hint2 and hint2.get("walkback_to_verified")
+        and int(hint2.get("last_verified_step", -1)) == last_verified
+        and suspect_step > verified_tag_step)
+    if walkback_ok:
+        _reset_process_state()
+        engine, _, loader, _ = run_elastic(
+            spec, _sdc_config(elastic, seed, integ2), checkpoint_dir=ckpt2,
+            n_chips=n_avail, training_data=dataset)
+        # resumed BEHIND the suspect save, at the verified tag
+        walkback_ok = engine.global_steps == verified_tag_step
+        _run(engine, loader, None, budget=total_steps - verified_tag_step,
+             cm=None)
+        events = dict(getattr(engine.telemetry, "reliability_counts", {}))
+        engine.destroy()
+    else:
+        events = {}
+    _reset_process_state()
+
+    # ---- verdict: every drilled observation rejoins the reference ----
+    max_err = 0.0
+    covered = set()
+    for step, loss in obs:
+        if not 1 <= step <= len(baseline):
+            max_err = float("inf")
+            continue
+        ref = baseline[step - 1]
+        max_err = max(max_err, abs(loss - ref) / max(1.0, abs(ref)))
+        covered.add(step)
+    traj_ok = max_err <= tol and covered == set(range(1, total_steps + 1))
+    ok = (traj_ok and quarantine_ok and walkback_ok
+          and all(d["ok"] for d in detections)
+          and len(detections) == len(list(sites)))
+    result = {
+        "pass": bool(ok),
+        "max_rel_err": float(max_err),
+        "tol": tol,
+        "detections": detections,
+        "quarantine": {"ok": quarantine_ok, "exit_step": exit_step,
+                       "hint": hint, "resumed_chips": resumed_chips},
+        "walkback": {"ok": walkback_ok, "suspect_step": suspect_step,
+                     "hint": hint2, "last_verified": last_verified},
+        "steps": len(obs),
+        "baseline_losses": baseline,
+        "reliability_events": events,
+    }
+    if assert_equal and not ok:
+        raise AssertionError(
+            f"sdc drill failed: detections="
+            f"{[(d['site'], d['ok']) for d in detections]} "
+            f"quarantine_ok={quarantine_ok} walkback_ok={walkback_ok} "
+            f"max_rel_err={max_err:.3e} (tol={tol:g})")
+    return result
+
+
 def main(argv=None) -> int:
-    """Standalone entry (the ``tpu_watch.sh`` ELASTIC row): run the default
+    """Standalone entry (the ``tpu_watch.sh`` ELASTIC and SDC rows): run a
     drill on a temp dir and print a one-line verdict."""
     import argparse
     import json
@@ -270,21 +514,37 @@ def main(argv=None) -> int:
     p.add_argument("--steps", type=int, default=6)
     p.add_argument("--tol", type=float, default=1e-6)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--sdc", action="store_true",
+                   help="run the SDC integrity drill instead of the "
+                        "elastic topology drill")
     p.add_argument("--json", action="store_true",
                    help="dump the full result dict as JSON")
     args = p.parse_args(argv)
     with tempfile.TemporaryDirectory() as d:
         try:
-            res = elastic_drill(d, total_steps=args.steps, seed=args.seed,
-                                tol=args.tol, assert_equal=False)
+            if args.sdc:
+                res = sdc_drill(d, total_steps=max(args.steps, 8),
+                                seed=args.seed, tol=args.tol,
+                                assert_equal=False)
+            else:
+                res = elastic_drill(d, total_steps=args.steps,
+                                    seed=args.seed, tol=args.tol,
+                                    assert_equal=False)
         except Exception as e:  # a crash is a failed drill, not a traceback
             print(f"[drill] pass=False error={type(e).__name__}: {e}")
             return 1
-    print(f"[drill] pass={res['pass']} steps={res['steps']} "
-          f"max_rel_err={res['max_rel_err']:.3e} tol={res['tol']:g} "
-          f"phases={[p['phase'] for p in res['phases']]} "
-          f"saves={res['reliability_events'].get('Reliability/elastic/saves', 0)} "
-          f"resumes={res['reliability_events'].get('Reliability/elastic/resumes', 0)}")
+    if args.sdc:
+        print(f"[sdc-drill] pass={res['pass']} "
+              f"max_rel_err={res['max_rel_err']:.3e} tol={res['tol']:g} "
+              f"detections={[(d['site'], d['ok'], d['delay']) for d in res['detections']]} "
+              f"quarantine_ok={res['quarantine']['ok']} "
+              f"walkback_ok={res['walkback']['ok']}")
+    else:
+        print(f"[drill] pass={res['pass']} steps={res['steps']} "
+              f"max_rel_err={res['max_rel_err']:.3e} tol={res['tol']:g} "
+              f"phases={[p['phase'] for p in res['phases']]} "
+              f"saves={res['reliability_events'].get('Reliability/elastic/saves', 0)} "
+              f"resumes={res['reliability_events'].get('Reliability/elastic/resumes', 0)}")
     if args.json:
         print(json.dumps(res, indent=2, default=str))
     return 0 if res["pass"] else 1

@@ -28,7 +28,15 @@ watchdog, and the PreemptionGuard actually survive them (see
   checkpoint fragment, which the verified elastic load must walk back from;
 - :func:`forced_nonfinite` — the next N train steps report overflow (and
   optionally a NaN loss) so watchdog paths fire without engineering a real
-  fp16 overflow.
+  fp16 overflow;
+- :func:`bit_flip` — seeded silent-data-corruption: a REAL bit flip at a
+  named site (post-reduce grad / replicated param / optimizer moment) in a
+  simulated N-host fleet, feeding the integrity plane's cross-replica vote
+  (``mode="replica"``) or poisoning the live step's own digests so the
+  shadow recompute audit catches an all-replica compute fault
+  (``mode="compute"``). The live training state is NEVER corrupted — the
+  drill can assert the post-quarantine trajectory rejoins the clean
+  reference exactly.
 
 The full preempt→reshard→resume cycle is exercised by the seeded
 ``deepspeed_tpu.testing.drill.elastic_drill`` harness, which composes these
@@ -88,6 +96,34 @@ def _save_host(ce):
     return getattr(ce, "inner", None) or ce
 
 
+_MISSING = object()
+
+
+def patch_attr(obj, name: str, replacement):
+    """Install ``obj.name = replacement`` and return an ``undo()`` that
+    restores the EXACT prior state: when the original lived on the class
+    (the usual bound-method case) the shadowing instance attribute is
+    removed again, instead of pinning a stale bound method onto the
+    instance forever. Every injector here unwinds through this, so a test
+    that raises mid-fault leaves the patched object indistinguishable from
+    one that was never touched (the regression tests in
+    ``tests/test_integrity.py`` assert exactly that)."""
+    prior = obj.__dict__.get(name, _MISSING) if hasattr(obj, "__dict__") \
+        else getattr(obj, name, _MISSING)
+    setattr(obj, name, replacement)
+
+    def undo():
+        if prior is _MISSING:
+            try:
+                delattr(obj, name)
+            except AttributeError:
+                pass
+        else:
+            setattr(obj, name, prior)
+
+    return undo
+
+
 @contextlib.contextmanager
 def io_errors(ce, fail_times: int = 1, op: str = "save",
               exc_factory=None) -> Iterator[dict]:
@@ -106,11 +142,11 @@ def io_errors(ce, fail_times: int = 1, op: str = "save",
                                 f"#{state['failures']}"))
         return target(*args, **kwargs)
 
-    setattr(ce, op, flaky)
+    undo = patch_attr(ce, op, flaky)
     try:
         yield state
     finally:
-        setattr(ce, op, target)
+        undo()
 
 
 @contextlib.contextmanager
@@ -126,11 +162,11 @@ def crash_after_save(ce) -> Iterator[None]:
         _dump_flight_recorders("fault_crash_after_save")
         raise SimulatedCrash(f"simulated crash after write of {path}")
 
-    ce.save = dying
+    undo = patch_attr(ce, "save", dying)
     try:
         yield
     finally:
-        ce.save = orig
+        undo()
 
 
 @contextlib.contextmanager
@@ -147,11 +183,11 @@ def truncated_write(ce, keep_bytes: int = 64,
         _dump_flight_recorders("fault_truncated_write")
         raise SimulatedCrash(f"simulated crash mid-write of {path}")
 
-    ce.save = torn
+    undo = patch_attr(ce, "save", torn)
     try:
         yield
     finally:
-        ce.save = orig
+        undo()
 
 
 def corrupt_file(root: str, keep_bytes: int = 64,
@@ -191,11 +227,11 @@ def write_delay(ce, seconds: float) -> Iterator[None]:
         time.sleep(seconds)
         return orig(tree, path, **kw)
 
-    host.save = slow
+    undo = patch_attr(host, "save", slow)
     try:
         yield
     finally:
-        host.save = orig
+        undo()
 
 
 def preempt(guard, signum: Optional[int] = None) -> None:
@@ -223,11 +259,11 @@ def preempt_at_step(guard, step: int) -> Iterator[dict]:
             guard.trigger()
         return orig(engine)
 
-    guard.step_boundary = boundary
+    undo = patch_attr(guard, "step_boundary", boundary)
     try:
         yield state
     finally:
-        guard.step_boundary = orig
+        undo()
 
 
 @contextlib.contextmanager
@@ -267,11 +303,11 @@ def host_loss(heartbeat, peer: int = 1, world: Optional[int] = None,
             (advance or time.sleep)(hang_s)  # stuck collective
         return np.asarray(rows, np.int64)
 
-    heartbeat._gather = gather
+    undo = patch_attr(heartbeat, "_gather", gather)
     try:
         yield state
     finally:
-        heartbeat._gather = orig_gather
+        undo()
         heartbeat._n = orig_n
 
 
@@ -496,3 +532,156 @@ def forced_nonfinite(engine, steps: int = 1,
         yield state
     finally:
         engine._train_step = orig
+
+
+# --------------------------------------------------------------------------- #
+# silent data corruption (reliability/integrity.py; docs/reliability.md
+# "Numerics integrity & SDC")
+# --------------------------------------------------------------------------- #
+def _flip_mask(dtype, bit: int):
+    """The XOR mask for ``bit`` as the same-width signed integer numpy
+    scalar (bit 31 of an int32 must wrap, not overflow)."""
+    import numpy as np
+
+    width = dtype.itemsize
+    return np.array(1 << int(bit), dtype=f"u{width}").view(f"i{width}")
+
+
+def _build_poisoned_step(engine, site: str, leaf: Optional[int],
+                         index: int, bit: int):
+    """A non-donating jitted step identical to the live one except for ONE
+    flipped bit at the named site — the step a host with a corrupted local
+    copy would compute. Sites: ``grad`` (post-all-reduce gradient leaf),
+    ``param`` (replicated parameter), ``opt_moment`` (optimizer moment)."""
+    import jax
+    import jax.numpy as jnp
+
+    if site not in ("grad", "param", "opt_moment"):
+        raise ValueError(f"unknown bit_flip site '{site}'")
+    if engine._overlap_active():
+        raise NotImplementedError(
+            "bit_flip does not model the comms-overlap accumulate path")
+
+    def pick_leaf(tree) -> int:
+        if leaf is not None:
+            return int(leaf)
+        leaves = jax.tree_util.tree_leaves(tree)
+        for i, lf in enumerate(leaves):
+            if jnp.issubdtype(jnp.asarray(lf).dtype, jnp.floating):
+                return i
+        raise ValueError("no floating leaf to bit-flip")
+
+    src = engine.state.opt_state if site == "opt_moment" else \
+        engine.state.params
+    leaf_i = pick_leaf(src)
+
+    def flip_tree(tree):
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        x = jnp.asarray(leaves[leaf_i])
+        flat = jnp.ravel(x)
+        v = flat[index]
+        if jnp.issubdtype(x.dtype, jnp.floating):
+            ity = {1: jnp.int8, 2: jnp.int16, 4: jnp.int32,
+                   8: jnp.int64}[x.dtype.itemsize]
+            bits = jax.lax.bitcast_convert_type(v, ity)
+            flipped = jax.lax.bitcast_convert_type(
+                bits ^ _flip_mask(x.dtype, bit), x.dtype)
+        else:
+            flipped = v ^ jnp.asarray(_flip_mask(x.dtype, bit), v.dtype)
+        leaves[leaf_i] = flat.at[index].set(flipped).reshape(x.shape)
+        return jax.tree_util.tree_unflatten(treedef, leaves)
+
+    def poisoned_step(st, batch, lr_override):
+        if site == "param":
+            st = st._replace(params=flip_tree(st.params))
+        elif site == "opt_moment":
+            st = st._replace(opt_state=flip_tree(st.opt_state))
+        grads, loss, aux = engine._accumulate(st.params, batch,
+                                              st.loss_scale)
+        if site == "grad":
+            grads = flip_tree(grads)
+        return engine._apply_update(st, grads, loss, aux, lr_override)
+
+    with engine.mesh_mgr.activate():
+        return engine.telemetry.compile.jit(f"sdc_shadow_{site}",
+                                            poisoned_step)
+
+
+@contextlib.contextmanager
+def bit_flip(engine, *, site: str = "grad", host: int = 1, world: int = 4,
+             leaf: Optional[int] = None, index: int = 0, bit: int = 23,
+             mode: str = "replica") -> Iterator[dict]:
+    """Inject seeded SDC into a training engine whose integrity plane is on.
+
+    ``mode="replica"`` simulates an N-``world`` host fleet where ``host``
+    carries the flipped bit: each live step first runs the poisoned shadow
+    step (non-donating, REAL bit arithmetic at ``site``), and the plane's
+    allgather is patched so host ``host``'s digest row comes from that
+    poisoned step while every other host reports the clean row — the
+    majority vote must attribute the mismatch to ``host``.
+
+    ``mode="compute"`` models an all-replica compute-path fault the vote
+    CANNOT see: the live StepOutput's own digests are replaced with the
+    poisoned step's, so only the shadow recompute audit disagrees.
+
+    Either way the engine's real TrainState stays byte-clean; ``yield``s an
+    info dict (``injections``, ``first_step``). Restores the patched
+    ``_train_step``/gather/world on exit, body exceptions included."""
+    import numpy as np
+
+    plane = getattr(engine, "integrity", None)
+    if plane is None:
+        raise ValueError("bit_flip needs reliability.integrity enabled")
+    if mode not in ("replica", "compute"):
+        raise ValueError(f"unknown bit_flip mode '{mode}'")
+    if mode == "replica" and not 0 < int(host) < int(world):
+        raise ValueError("bit_flip: need 0 < host < world (process 0 is "
+                         "the clean observer)")
+    if engine._train_step is None:
+        engine._build_train_step()
+    shadow = _build_poisoned_step(engine, site, leaf, index, bit)
+    orig_step = engine._train_step
+    orig_gather = plane._gather
+    orig_count = plane._count
+    info = {"injections": 0, "first_step": None, "site": site,
+            "host": int(host), "mode": mode}
+    pending = {"fp": None}
+
+    def _host_fp(out):
+        fp = (out.aux or {}).get("integrity")
+        return None if fp is None else \
+            {sec: {k: np.asarray(v) for k, v in d.items()}
+             for sec, d in fp.items()}
+
+    def poisoned(st, batch, lr_override):
+        # shadow FIRST: the live step donates the buffers it reads
+        _ns, sout = shadow(st, batch, lr_override)
+        fp = _host_fp(sout)
+        new_state, out = orig_step(st, batch, lr_override)
+        if fp is not None:
+            info["injections"] += 1
+            if info["first_step"] is None:
+                info["first_step"] = int(engine.global_steps) + 1
+            if mode == "compute":
+                out = out._replace(aux={**out.aux, "integrity":
+                                        sout.aux["integrity"]})
+            else:
+                pending["fp"] = fp
+        return new_state, out
+
+    def gather(vec):
+        rows = np.tile(np.asarray(vec, np.float64), (int(world), 1))
+        if pending["fp"] is not None:
+            rows[int(host)] = plane._to_row(pending["fp"])
+        return rows
+
+    engine._train_step = poisoned
+    if mode == "replica":
+        plane._gather = gather
+        plane._count = int(world)
+    try:
+        yield info
+    finally:
+        engine._train_step = orig_step
+        plane._gather = orig_gather
+        plane._count = orig_count

@@ -453,6 +453,24 @@ def reliability(events: List[dict]) -> str:
                      f"{total('elastic/host_loss_detected')}")
         lines.append(f"    drill passes:         "
                      f"{total('elastic/drill_pass')}")
+    # numerics-integrity plane (Reliability/integrity/* — the closed
+    # registry in telemetry/schema.py; docs/reliability.md "Numerics
+    # integrity & SDC")
+    if any(k.startswith("integrity/") for k in counts):
+        checks = total("integrity/checks")
+        mism = total("integrity/mismatches")
+        lines.append("")
+        lines.append("  numerics integrity:")
+        lines.append(f"    fingerprint checks:   {checks}")
+        lines.append(f"    shadow audits:        {total('integrity/audit_steps')}")
+        lines.append(f"    mismatches:           {mism}"
+                     + (f" ({mism / checks:.2%} of checks)" if checks else ""))
+        lines.append(f"    host attributions:    "
+                     f"{total('integrity/attributed_host')}")
+        lines.append(f"    quarantines:          "
+                     f"{total('integrity/quarantines')}")
+        lines.append(f"    checkpoint walk-backs:"
+                     f" {total('integrity/walkbacks')}")
     return "\n".join(lines)
 
 

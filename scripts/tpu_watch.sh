@@ -246,6 +246,39 @@ EOF
     else
       echo "[watch] $ts ELASTIC drill FAILED (non-fatal)" >> "$LOG"
     fi
+    # SDC drill row (NON-FATAL): the numerics-integrity plane end to end on
+    # the CPU lane — bit-flip injection at grad/param/opt-moment sites →
+    # cross-replica fingerprint vote → host attribution → quarantine +
+    # excluded-hosts reshard → resume, plus the audit-confirmed walk-back
+    # leg (deepspeed_tpu/testing/drill.py --sdc; docs/reliability.md
+    # "Numerics integrity & SDC"). pass=False means silent-data-corruption
+    # detection or the quarantine/walk-back protocol regressed.
+    if JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+        timeout -k 60 900 python -m deepspeed_tpu.testing.drill --sdc >> "$LOG" 2>&1; then
+      echo "[watch] $ts SDC drill ok" >> "$LOG"
+    else
+      echo "[watch] $ts SDC drill FAILED (non-fatal)" >> "$LOG"
+    fi
+    # SCRUB row (NON-FATAL): at-rest checkpoint integrity — re-verify the
+    # durable-save manifests (per-file SHA-256) of any checkpoint dirs this
+    # host accumulated under $SCRUB_DIRS (colon-separated; skipped when
+    # unset/empty — probe runs don't keep checkpoints by default). A FAILED
+    # row means bit rot or a torn copy AFTER seal: quarantine the tag
+    # before anything resumes from it (scripts/ckpt_scrub.py).
+    if [ -n "${SCRUB_DIRS:-}" ]; then
+      scrub_list=""
+      IFS=':' read -ra _sd <<< "$SCRUB_DIRS"
+      for d in "${_sd[@]}"; do [ -d "$d" ] && scrub_list="$scrub_list $d"; done
+      if [ -n "$scrub_list" ]; then
+        # shellcheck disable=SC2086 — word-splitting the dir list is the point
+        if JAX_PLATFORMS=cpu timeout -k 30 300 \
+            python scripts/ckpt_scrub.py $scrub_list >> "$LOG" 2>&1; then
+          echo "[watch] $ts SCRUB ok" >> "$LOG"
+        else
+          echo "[watch] $ts SCRUB FAILED (non-fatal)" >> "$LOG"
+        fi
+      fi
+    fi
     hold_requested || run_probe LONGCTX scripts/longctx_bench.py 2400 LONGCTX_TPU_LIVE.json
     hold_requested || run_probe MOE scripts/moe_dispatch_bench.py 1200 MOE_TPU_LIVE.json
     # full headline bench incl. shape rows (first compiles are slow).

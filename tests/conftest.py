@@ -27,7 +27,7 @@ import pytest  # noqa: E402
 # The threshold is the budget valve for the fixed-wall-clock fast lane: as
 # the suite grows, ratchet it DOWN so `-m "not slow"` keeps finishing with
 # margin on a 1-core box (the exiled tests still run in the full suite).
-SLOW_S = 8.5
+SLOW_S = 7.5
 _dur_path = os.path.join(os.path.dirname(__file__), ".test_durations.json")
 try:
     with open(_dur_path) as _f:

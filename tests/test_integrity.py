@@ -1,0 +1,679 @@
+"""Numerics-integrity plane tests (docs/reliability.md "Numerics integrity
+& SDC"): per-leaf digest fingerprints, the cross-replica vote with host
+attribution, shadow recompute audits, the quarantine → elastic-exit →
+excluded-hosts reshard protocol, checkpoint walk-back to the newest
+verified tag, the default-OFF byte-identity pin, fault-injector hygiene,
+and the SDC-during-serving contract on the quantized KV cache."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu as dst
+from deepspeed_tpu.comm import mesh as mesh_mod
+from deepspeed_tpu.elasticity import read_reshard_hint
+from deepspeed_tpu.elasticity.elastic_agent import _walkback_tag
+from deepspeed_tpu.reliability import (IntegrityError, fingerprint_names,
+                                       tree_fingerprint)
+from deepspeed_tpu.runtime.engine import ModelSpec
+from deepspeed_tpu.runtime.watchdog import WatchdogViolation
+from deepspeed_tpu.telemetry.schema import (RELIABILITY_INTEGRITY_SERIES,
+                                            validate_events)
+from deepspeed_tpu.testing import faults
+
+DIM = 8
+
+
+def _spec():
+    def loss_fn(p, b):
+        pred = b["x"] @ p["w"]
+        return jnp.mean(jnp.sum((pred - b["y"]) ** 2, axis=-1)), {}
+
+    return ModelSpec(
+        loss_fn=loss_fn,
+        init_fn=lambda k: {"w": jax.random.normal(k, (DIM, DIM),
+                                                  jnp.float32) * 0.3},
+        pipeline_capable=False)
+
+
+def _mk_engine(integrity=None, stage=2, seed=42, watchdog=None,
+               reliability_key=True):
+    mesh_mod.set_mesh(None)
+    cfg = {
+        "train_batch_size": 8,
+        "optimizer": {"type": "adamw", "params": {"lr": 0.05}},
+        "zero_optimization": {"stage": stage},
+        "checkpoint": {"engine": "fast"},
+        "steps_per_print": 0,
+        "seed": seed,
+    }
+    if integrity is not None:
+        cfg["reliability"] = {"integrity": integrity}
+    elif reliability_key:
+        pass  # default: no reliability block at all
+    if watchdog is not None:
+        cfg["watchdog"] = {"enabled": True, **watchdog}
+    engine, *_ = dst.initialize(model=_spec(), config=cfg)
+    return engine
+
+
+_RNG = np.random.default_rng(0)
+
+
+def _batch(seed=None):
+    rng = np.random.default_rng(seed) if seed is not None else _RNG
+    return {"x": rng.standard_normal((8, DIM)).astype(np.float32),
+            "y": rng.standard_normal((8, DIM)).astype(np.float32)}
+
+
+def _int_counts(engine):
+    return {k: int(v) for k, v in
+            dict(getattr(engine.telemetry, "reliability_counts", {})).items()
+            if k.startswith("Reliability/integrity/")}
+
+
+# --------------------------------------------------------------------------- #
+# fingerprint primitives
+# --------------------------------------------------------------------------- #
+def test_tree_fingerprint_shape_and_names(devices8):
+    tree = {"a": jnp.ones((3, 4), jnp.float32),
+            "b": {"c": jnp.arange(5, dtype=jnp.bfloat16),
+                  "n": jnp.arange(4, dtype=jnp.int32)}}
+    fp = jax.device_get(tree_fingerprint(tree))
+    names = fingerprint_names(tree)
+    assert set(fp) == {"bitsum", "sumsq", "nonfinite"}
+    assert fp["bitsum"].shape == (3,) == fp["sumsq"].shape
+    assert names == ["a", "b.c", "b.n"]
+    assert fp["nonfinite"].tolist() == [0, 0, 0]
+    # every digest lane reacts to a one-element change
+    tree2 = {"a": tree["a"].at[1, 2].set(np.nan), "b": tree["b"]}
+    fp2 = jax.device_get(tree_fingerprint(tree2))
+    assert fp2["nonfinite"].tolist() == [1, 0, 0]
+    assert fp2["bitsum"][0] != fp["bitsum"][0]
+
+
+def test_fingerprint_bitsum_catches_sub_epsilon_flip(devices8):
+    """The raison d'être of the bitcast lane: a low-mantissa bit flip that
+    an L2-norm comparison would round away still changes the bit sum."""
+    x = jnp.ones((256,), jnp.float32)
+    bits = np.asarray(x).view(np.int32).copy()
+    bits[7] ^= 1  # last mantissa bit: 1.0 → 1.0000001
+    y = jnp.asarray(bits.view(np.float32))
+    fa = jax.device_get(tree_fingerprint({"x": x}))
+    fb = jax.device_get(tree_fingerprint({"x": y}))
+    assert np.allclose(fa["sumsq"], fb["sumsq"])  # norms can't see it
+    assert fa["bitsum"][0] != fb["bitsum"][0]     # the bit sum can
+
+
+# --------------------------------------------------------------------------- #
+# default-OFF pin: the plane must be invisible until asked for
+# --------------------------------------------------------------------------- #
+def test_default_off_is_byte_identical_and_silent(devices8):
+    e_def = _mk_engine()                                  # no block at all
+    e_off = _mk_engine(integrity={"enabled": False})      # explicit off
+    e_on = _mk_engine(integrity={"enabled": True, "check_interval": 2})
+    assert e_def.integrity is None and e_off.integrity is None
+    assert e_on.integrity is not None
+
+    def lowered(e):
+        if e._train_step is None:
+            e._build_train_step()
+        sb = e._shard_batch(_batch(seed=1), with_gas_dim=True)
+        with e.mesh_mgr.activate():
+            return e._train_step.lower(e.state, sb, e._lr_override).as_text()
+
+    t_def, t_off, t_on = lowered(e_def), lowered(e_off), lowered(e_on)
+    assert t_def == t_off          # absent block == disabled block, exactly
+    assert t_on != t_def           # the enabled program really is different
+    losses = []
+    for e in (e_def, e_off):
+        ls = []
+        for s in range(4):
+            ls.append(float(e.train_batch(_batch(seed=10 + s)).loss))
+        losses.append(ls)
+    assert losses[0] == losses[1]  # bitwise, not allclose
+    for e in (e_def, e_off):
+        out = e.train_batch(_batch(seed=99))
+        assert "integrity" not in (out.aux or {})
+        assert _int_counts(e) == {}
+
+
+# --------------------------------------------------------------------------- #
+# clean-path accounting and the schema family
+# --------------------------------------------------------------------------- #
+def test_clean_run_checks_verify_and_count(devices8):
+    e = _mk_engine(integrity={"enabled": True, "check_interval": 2,
+                              "audit_interval": 3})
+    for s in range(6):
+        e.train_batch(_batch(seed=s))
+    p = e.integrity
+    assert p.checks == 3 and p.mismatches == 0 and p.audits == 2
+    assert p.last_verified_step == 6
+    assert not p.restart_requested and not p.walkback_requested
+    counts = _int_counts(e)
+    assert counts == {"Reliability/integrity/checks": 3,
+                      "Reliability/integrity/audit_steps": 2}
+    # everything the plane can ever emit is in the closed schema family
+    assert validate_events([(n, 1.0, 1)
+                            for n in RELIABILITY_INTEGRITY_SERIES]) == []
+    assert validate_events([("Reliability/integrity/bogus", 1.0, 1)])
+
+
+# --------------------------------------------------------------------------- #
+# bit-flip detection + attribution at every corruption site
+# --------------------------------------------------------------------------- #
+@pytest.mark.parametrize("site", ["grad", "param", "opt_moment"])
+def test_bit_flip_detected_and_attributed(devices8, site):
+    e = _mk_engine(integrity={"enabled": True, "check_interval": 2,
+                              "quarantine_threshold": 0,
+                              "on_corruption": "warn"})
+    clean = _mk_engine(integrity={"enabled": False})
+    losses, ref = [], []
+    for s in range(2):  # a clean check round first
+        losses.append(float(e.train_batch(_batch(seed=s)).loss))
+        ref.append(float(clean.train_batch(_batch(seed=s)).loss))
+    assert e.integrity.last_report["mismatched_hosts"] == []
+    with faults.bit_flip(e, site=site, host=2, world=4, index=3,
+                         bit=23) as inj:
+        for s in range(2, 4):
+            losses.append(float(e.train_batch(_batch(seed=s)).loss))
+            ref.append(float(clean.train_batch(_batch(seed=s)).loss))
+    rep = e.integrity.last_report
+    assert rep["mismatched_hosts"] == [2]
+    assert rep["step"] - inj["first_step"] < 2  # within check_interval
+    assert all(h == 2 for h, _leaf in rep["leaves"]) and rep["leaves"]
+    # the shadow injection never touched live state: trajectory is clean
+    assert losses == ref
+    assert _int_counts(e)["Reliability/integrity/mismatches"] >= 1
+
+
+def test_bit_flip_on_raise_policy_raises(devices8):
+    e = _mk_engine(integrity={"enabled": True, "check_interval": 1,
+                              "quarantine_threshold": 1,
+                              "on_corruption": "raise"})
+    e.train_batch(_batch(seed=0))
+    with faults.bit_flip(e, site="grad", host=1, world=4):
+        with pytest.raises(IntegrityError, match=r"host\(s\) \[1\]"):
+            e.train_batch(_batch(seed=1))
+
+
+def test_quarantine_after_repeated_attribution(devices8):
+    e = _mk_engine(integrity={"enabled": True, "check_interval": 1,
+                              "quarantine_threshold": 2,
+                              "on_corruption": "exit"})
+    e.train_batch(_batch(seed=0))
+    with faults.bit_flip(e, site="param", host=3, world=4):
+        e.train_batch(_batch(seed=1))       # strike 1 — no quarantine yet
+        assert not e.integrity.restart_requested
+        e.train_batch(_batch(seed=2))       # strike 2 — quarantine + exit
+    p = e.integrity
+    assert p.excluded_hosts == [3]
+    assert p.restart_requested and "host" in p.restart_reason
+    assert _int_counts(e)["Reliability/integrity/quarantines"] == 1
+    assert _int_counts(e)["Reliability/integrity/attributed_host"] == 2
+
+
+# --------------------------------------------------------------------------- #
+# shadow recompute audit → walk-back request
+# --------------------------------------------------------------------------- #
+def test_audit_catches_all_replica_compute_fault(devices8):
+    e = _mk_engine(integrity={"enabled": True, "check_interval": 0,
+                              "audit_interval": 2,
+                              "on_corruption": "exit"})
+    for s in range(4):
+        e.train_batch(_batch(seed=s))
+    p = e.integrity
+    assert p.audits == 2 and p.last_verified_step == 4
+    # an all-replica fault: every host computes the same wrong answer, so
+    # the cross-replica vote is blind — only the audit can catch it
+    with faults.bit_flip(e, site="param", mode="compute", world=1, host=0):
+        for s in range(4, 6):
+            e.train_batch(_batch(seed=s))
+    assert p.walkback_requested and p.restart_requested
+    assert p.last_verified_step == 4        # never advanced past the fault
+    counts = _int_counts(e)
+    assert counts["Reliability/integrity/walkbacks"] == 1
+    assert counts["Reliability/integrity/mismatches"] == 1
+
+
+def test_walkback_tag_picks_newest_verified_at_or_below(devices8, tmp_path):
+    e = _mk_engine(integrity={"enabled": False})
+    ck = str(tmp_path / "wb")
+    for s in range(5):
+        e.train_batch(_batch(seed=s))
+        if s in (1, 3):
+            e.save_universal_checkpoint(ck)  # tags at steps 2 and 4
+    tags = sorted(t for t in os.listdir(ck) if t.startswith("universal"))
+    assert tags == ["universal_step2", "universal_step4"]
+    assert _walkback_tag(ck, max_step=4) == "universal_step4"
+    assert _walkback_tag(ck, max_step=3) == "universal_step2"
+    # a corrupt newest tag is skipped, not loaded
+    faults.corrupt_file(os.path.join(ck, "universal_step4"))
+    assert _walkback_tag(ck, max_step=4) == "universal_step2"
+    assert _walkback_tag(ck, max_step=1) is None
+
+
+def test_quarantine_writes_excluded_hosts_hint(devices8, tmp_path):
+    from deepspeed_tpu.elasticity import PreemptionGuard
+
+    ck = str(tmp_path / "q")
+    e = _mk_engine(integrity={"enabled": True, "check_interval": 1,
+                              "quarantine_threshold": 1,
+                              "on_corruption": "exit"})
+    guard = PreemptionGuard(ck, signals=(), universal=True)
+    e.train_batch(_batch(seed=0))
+    assert not guard.step_boundary(e)
+    with faults.bit_flip(e, site="grad", host=2, world=4):
+        e.train_batch(_batch(seed=1))
+    assert guard.step_boundary(e)           # integrity exit → durable save
+    guard.uninstall()
+    hint = read_reshard_hint(ck)
+    assert hint["excluded_hosts"] == [2]
+    assert "integrity" in hint["reason"]
+    assert not hint.get("walkback_to_verified")
+
+
+# --------------------------------------------------------------------------- #
+# watchdog satellite: per-leaf nonfinite attribution rides the digest pass
+# --------------------------------------------------------------------------- #
+def test_watchdog_names_nonfinite_leaves(devices8):
+    e = _mk_engine(integrity={"enabled": True, "check_interval": 10},
+                   watchdog={"detect_non_finite": True})
+    e.train_batch(_batch(seed=0))
+    bad = _batch(seed=1)
+    bad["x"][0, 0] = np.nan                 # nan loss AND nan grads
+    with pytest.raises(WatchdogViolation) as ei:
+        e.train_batch(bad)
+    assert ei.value.kind == "non_finite_loss"
+    assert "nonfinite grads in w" in str(ei.value)
+
+
+def test_watchdog_nonfinite_without_plane_still_works(devices8):
+    e = _mk_engine(watchdog={"detect_non_finite": True})
+    e.train_batch(_batch(seed=0))
+    bad = _batch(seed=1)
+    bad["x"][0, 0] = np.nan
+    with pytest.raises(WatchdogViolation) as ei:
+        e.train_batch(bad)
+    assert ei.value.kind == "non_finite_loss"
+    assert "nonfinite grads" not in str(ei.value)  # no digests to read
+
+
+# --------------------------------------------------------------------------- #
+# fault-injector hygiene: every context manager restores on exception
+# --------------------------------------------------------------------------- #
+class _Boom(Exception):
+    pass
+
+
+def test_injectors_restore_on_exception(devices8):
+    e = _mk_engine(integrity={"enabled": True, "check_interval": 1,
+                              "quarantine_threshold": 0,
+                              "on_corruption": "warn"})
+    e.train_batch(_batch(seed=0))
+    plane = e.integrity
+    orig_step = e._train_step
+    orig_gather = plane._gather
+    orig_count = plane._count
+    with pytest.raises(_Boom):
+        with faults.bit_flip(e, site="grad", host=1, world=4):
+            assert e._train_step is not orig_step
+            raise _Boom()
+    assert e._train_step is orig_step
+    assert plane._gather is orig_gather and plane._count == orig_count
+    with pytest.raises(_Boom):
+        with faults.forced_nonfinite(e, steps=5):
+            assert e._train_step is not orig_step
+            raise _Boom()
+    assert e._train_step is orig_step
+    # the engine still trains and verifies cleanly after both unwinds
+    e.train_batch(_batch(seed=1))
+    assert plane.last_report["mismatched_hosts"] == []
+
+
+def test_checkpoint_injectors_restore_on_exception(devices8, tmp_path):
+    from deepspeed_tpu.runtime.checkpoint.saver import _engine_for
+
+    e = _mk_engine(integrity=None)
+    ce = _engine_for(e)
+    orig_save = ce.save
+    shadowed = "save" in vars(ce)  # patch_attr must not change this
+    for cm in (faults.io_errors(ce, fail_times=1),
+               faults.crash_after_save(ce),
+               faults.truncated_write(ce),
+               faults.write_delay(ce, seconds=0.01)):
+        with pytest.raises(_Boom):
+            with cm:
+                raise _Boom()
+        assert ce.save == orig_save
+        assert ("save" in vars(ce)) == shadowed  # no pinned bound method
+    e.train_batch(_batch(seed=0))
+    e.save_checkpoint(str(tmp_path), tag="t")  # the save path still works
+    assert os.path.isdir(str(tmp_path / "t"))
+
+
+def test_bit_flip_validates_inputs(devices8):
+    e = _mk_engine(integrity={"enabled": True, "check_interval": 1})
+    e.train_batch(_batch(seed=0))
+    with pytest.raises(ValueError, match="site"):
+        with faults.bit_flip(e, site="activations"):
+            pass
+    with pytest.raises(ValueError, match="host"):
+        with faults.bit_flip(e, site="grad", host=0, world=4):
+            pass
+    e_off = _mk_engine(integrity={"enabled": False})
+    e_off.train_batch(_batch(seed=0))
+    with pytest.raises(ValueError, match="integrity"):
+        with faults.bit_flip(e_off, site="grad"):
+            pass
+
+
+# --------------------------------------------------------------------------- #
+# offline checkpoint scrub (scripts/ckpt_scrub.py)
+# --------------------------------------------------------------------------- #
+def test_ckpt_scrub_verdicts(devices8, tmp_path):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "ckpt_scrub", os.path.join(os.path.dirname(__file__), os.pardir,
+                                   "scripts", "ckpt_scrub.py"))
+    scrub = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(scrub)
+
+    e = _mk_engine(integrity=None)
+    ck = str(tmp_path / "s")
+    for s in range(2):
+        e.train_batch(_batch(seed=s))
+        e.save_universal_checkpoint(ck)
+    rep = scrub.scrub_dir(ck)
+    assert rep["n_verified"] == 2 and rep["n_corrupt"] == 0
+    assert rep["latest_ok"] and rep["latest"] == "universal_step2"
+    assert scrub.main([ck]) == 0
+    # flip one byte of a manifest-listed file → that tag goes corrupt and
+    # the exit code goes nonzero
+    tag = os.path.join(ck, "universal_step2")
+    with open(os.path.join(tag, "manifest.json")) as f:
+        rel = next(r for r in json.load(f)["files"] if r != "meta.json")
+    path = os.path.join(tag, rel)
+    blob = bytearray(open(path, "rb").read())
+    blob[len(blob) // 2] ^= 0x40
+    with open(path, "wb") as f:
+        f.write(bytes(blob))
+    rep = scrub.scrub_dir(ck)
+    assert rep["n_corrupt"] == 1 and not rep["latest_ok"]
+    assert scrub.main([ck]) == 1
+
+
+# --------------------------------------------------------------------------- #
+# SDC during serving: the quantized-KV contract
+# --------------------------------------------------------------------------- #
+def _serving_engine():
+    from deepspeed_tpu.inference import build_engine_v2
+    from deepspeed_tpu.models import llama
+
+    cfg = llama.LlamaConfig(vocab_size=128, hidden_size=64,
+                            intermediate_size=128, num_layers=2,
+                            num_heads=2, num_kv_heads=2, max_seq_len=256)
+    params = llama.init(cfg, jax.random.PRNGKey(0))
+    mesh_mod.set_mesh(None)
+    eng = build_engine_v2(
+        llama, cfg, params,
+        config={"dtype": "float32", "prefill_bucket": 16,
+                "kv_quant": {"enabled": True, "group_size": 32},
+                "ragged": {"max_tracked_sequences": 4,
+                           "max_ragged_batch_size": 4,
+                           "memory_config_blocks": 32, "block_size": 16}})
+    return cfg, eng
+
+
+def test_serving_kv_bitflip_contract(devices8):
+    """The documented SDC-during-serving contract (docs/reliability.md):
+    a bit flip in the int8 KV CODE pool cannot violate the cache-pytree
+    invariants (dtype/shape/scale-range are all unchanged), so
+    ``debug_check_cache`` passes — by design. What the quantized layout
+    bounds instead is the blast radius: one flipped low-order code bit
+    perturbs ONE dequantized value by at most ``2^bit ×`` its group scale,
+    and decode keeps producing in-vocab tokens. Corruption that reaches the
+    SCALE table (nonfinite / negative) IS caught by the invariant check."""
+    from deepspeed_tpu.inference.sampling import SamplingParams
+
+    cfg, eng = _serving_engine()
+    sp = SamplingParams(greedy=True)
+    rng = np.random.default_rng(5)
+    prompt = rng.integers(0, cfg.vocab_size, (40,), dtype=np.int32).tolist()
+    eng.put(0, prompt, sp)
+    eng.step(sp)
+    eng.debug_check_cache()
+
+    bit = 2
+    codes = np.asarray(eng.cache["k"])
+    scales = np.asarray(eng.cache["k_scale"])
+    flat = codes.reshape(-1)
+    target = int(np.flatnonzero(flat != 0)[0])  # a written (in-use) code
+    flipped = flat.copy()
+    flipped[target] ^= np.int8(1 << bit)
+    eng.cache["k"] = jnp.asarray(flipped.reshape(codes.shape))
+
+    # invariant check is blind to code corruption — documented blind spot
+    eng.debug_check_cache()
+    # ...but the deviation it can cause is bounded by the group scale
+    group = codes.shape[-1] // scales.shape[-1]
+    sc = scales.reshape(-1)[target // group]
+    deviation = abs(int(flipped[target]) - int(flat[target])) * sc
+    assert deviation <= (1 << bit) * scales.max() + 1e-6
+    # decode over the corrupted block still yields in-vocab tokens
+    out = eng.step(sp)
+    assert all(0 <= t < cfg.vocab_size for t in out.values())
+
+    # scale-table corruption IS caught
+    bad = np.asarray(eng.cache["k_scale"]).reshape(-1).copy()
+    bad[0] = -1.0
+    eng.cache["k_scale"] = jnp.asarray(bad.reshape(scales.shape))
+    with pytest.raises(AssertionError, match="k_scale"):
+        eng.debug_check_cache()
+    bad[0] = np.nan
+    eng.cache["k_scale"] = jnp.asarray(bad.reshape(scales.shape))
+    with pytest.raises(AssertionError, match="k_scale"):
+        eng.debug_check_cache()
+
+
+# --------------------------------------------------------------------------- #
+# the full inject → detect → quarantine → reshard → resume drill
+# --------------------------------------------------------------------------- #
+def test_sdc_drill_end_to_end(devices8, tmp_path):
+    from deepspeed_tpu.testing.drill import sdc_drill
+
+    res = sdc_drill(str(tmp_path), total_steps=8)
+    assert res["pass"]
+    assert [d["site"] for d in res["detections"]] == ["grad", "param",
+                                                      "opt_moment"]
+    assert all(d["delay"] < 2 for d in res["detections"])
+    assert res["quarantine"]["hint"]["excluded_hosts"] == [2]
+    assert res["quarantine"]["resumed_chips"] < len(jax.devices())
+    assert res["walkback"]["hint"]["walkback_to_verified"]
+    assert res["max_rel_err"] <= 1e-6
+
+
+# ---------------------------------------------------------------------------
+# fast unit surface: config block, schema registry, scrub helpers, injector
+# hygiene primitives, report rollup — no engine, no jit, sub-second each
+# ---------------------------------------------------------------------------
+
+
+def _load_script(name):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(os.path.dirname(__file__), os.pardir,
+                           "scripts", name + ".py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_integrity_config_defaults_pin():
+    from deepspeed_tpu.runtime.config import IntegrityConfig
+
+    cfg = IntegrityConfig()
+    assert cfg.enabled is False
+    assert cfg.check_interval == 10
+    assert cfg.audit_interval == 0
+    assert cfg.quarantine_threshold == 3
+    assert cfg.on_corruption == "exit"
+    assert cfg.fingerprint_grads and cfg.fingerprint_params
+    assert cfg.fingerprint_opt_state
+
+
+def test_integrity_config_from_dict_nested_and_unknown_key():
+    from deepspeed_tpu.runtime.config import (IntegrityConfig,
+                                              ReliabilityConfig)
+
+    rel = ReliabilityConfig.from_dict(
+        {"integrity": {"enabled": True, "check_interval": 3,
+                       "no_such_knob": 1}})
+    assert isinstance(rel.integrity, IntegrityConfig)
+    assert rel.integrity.enabled and rel.integrity.check_interval == 3
+    assert not hasattr(rel.integrity, "no_such_knob")
+    round_trip = rel.to_dict()
+    assert round_trip["integrity"]["check_interval"] == 3
+
+
+def test_integrity_series_registry_closed():
+    from deepspeed_tpu.telemetry import schema
+
+    assert len(RELIABILITY_INTEGRITY_SERIES) == 6
+    assert all(n.startswith("Reliability/integrity/")
+               for n in RELIABILITY_INTEGRITY_SERIES)
+    assert "RELIABILITY_INTEGRITY_SERIES" in schema.__all__
+    events = [(n, 1.0, 0) for n in sorted(RELIABILITY_INTEGRITY_SERIES)]
+    assert validate_events(events) == []
+
+
+def test_validate_events_rejects_unknown_integrity_series():
+    problems = validate_events([("Reliability/integrity/bogus", 1.0, 0)])
+    assert problems and "bogus" in problems[0]
+
+
+def test_patch_attr_restores_class_attr_without_shadowing():
+    class C:
+        def m(self):
+            return "real"
+
+    obj = C()
+    undo = faults.patch_attr(obj, "m", lambda: "fake")
+    assert obj.m() == "fake" and "m" in vars(obj)
+    undo()
+    assert obj.m() == "real"
+    # the class attribute must NOT be pinned onto the instance: a later
+    # monkeypatch of C.m must show through obj again
+    assert "m" not in vars(obj)
+
+
+def test_patch_attr_restores_instance_attr_exactly():
+    class C:
+        pass
+
+    obj = C()
+    orig = object()
+    obj.x = orig
+    undo = faults.patch_attr(obj, "x", "fake")
+    undo()
+    assert obj.x is orig and "x" in vars(obj)
+
+
+def test_patch_attr_missing_attr_roundtrip():
+    class C:
+        pass
+
+    obj = C()
+    undo = faults.patch_attr(obj, "y", 1)
+    assert obj.y == 1
+    undo()
+    assert not hasattr(obj, "y")
+    undo()  # idempotent on a now-missing attr
+
+
+def test_bit_flip_validation_needs_no_engine():
+    import types
+
+    with pytest.raises(ValueError, match="integrity"):
+        with faults.bit_flip(types.SimpleNamespace(integrity=None)):
+            pass  # pragma: no cover
+
+
+def test_fingerprint_names_nested_containers():
+    tree = {"blk": [{"w": 0.0, "b": 1.0}, {"w": 2.0}], "head": (3.0, 4.0)}
+    names = fingerprint_names(tree)
+    assert names == ["blk.0.b", "blk.0.w", "blk.1.w", "head.0", "head.1"]
+
+
+def test_scrub_empty_dir_ok(tmp_path):
+    scrub = _load_script("ckpt_scrub")
+    rep = scrub.scrub_dir(str(tmp_path))
+    assert rep["tags"] == [] and rep["latest_ok"]
+    assert scrub.main([str(tmp_path)]) == 0
+
+
+def test_scrub_missing_dir_is_error(tmp_path):
+    scrub = _load_script("ckpt_scrub")
+    rep = scrub.scrub_dir(str(tmp_path / "nope"))
+    assert rep["error"] == "not a directory"
+    assert scrub.main([str(tmp_path / "nope")]) == 1
+
+
+def test_scrub_reports_staging_leftovers_nonfatal(tmp_path):
+    scrub = _load_script("ckpt_scrub")
+    (tmp_path / "step1.tmp.abc").mkdir()
+    rep = scrub.scrub_dir(str(tmp_path))
+    assert rep["staging"] == ["step1.tmp.abc"]
+    assert scrub.main([str(tmp_path)]) == 0  # surfaced, never fatal
+
+
+def test_scrub_json_output_shape(tmp_path, capsys):
+    scrub = _load_script("ckpt_scrub")
+    assert scrub.main([str(tmp_path), "--json"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["ok"] and out["reports"][0]["dir"] == str(tmp_path)
+
+
+def test_scrub_tag_step_and_universal_helpers(tmp_path):
+    scrub = _load_script("ckpt_scrub")
+    tag = tmp_path / "step7"
+    tag.mkdir()
+    assert scrub._tag_step(str(tag)) == -1  # no meta.json yet
+    (tag / "meta.json").write_text(json.dumps({"global_steps": 7}))
+    assert scrub._tag_step(str(tag)) == 7
+    assert scrub._is_universal(str(tag)) is False
+
+
+def test_sdc_config_isolated_from_inputs():
+    from deepspeed_tpu.testing.drill import _sdc_config
+
+    elastic, integ = {"enabled": True}, {"enabled": True}
+    cfg = _sdc_config(elastic, seed=5, integrity=integ)
+    assert cfg["seed"] == 5
+    assert cfg["reliability"]["integrity"]["enabled"]
+    elastic["enabled"] = False
+    integ["enabled"] = False
+    assert cfg["elasticity"]["enabled"]  # copies, not aliases
+    assert cfg["reliability"]["integrity"]["enabled"]
+
+
+def test_report_reliability_integrity_rollup():
+    report = _load_script("telemetry_report")
+    events = (
+        [{"name": "Reliability/integrity/checks", "value": 1, "step": s}
+         for s in (2, 4, 6)]
+        + [{"name": "Reliability/integrity/mismatches", "value": 1,
+            "step": 4},
+           {"name": "Reliability/integrity/attributed_host", "value": 2,
+            "step": 4},
+           {"name": "Reliability/integrity/quarantines", "value": 1,
+            "step": 6}])
+    text = report.reliability(events)
+    assert "numerics integrity:" in text
+    assert "fingerprint checks" in text and "quarantines" in text
