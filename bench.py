@@ -1082,6 +1082,140 @@ def bench_integrity(jax, on_tpu, steps: int = None) -> dict:
         return {"ok": False, "status": f"error: {e}"[-300:]}
 
 
+def bench_long_context(jax, on_tpu) -> dict:
+    """``detail.long_context`` — million-token-context memory probe
+    (docs/performance.md "Million-token context"): (a) compiled-peak temp
+    bytes of the full train step, dense logits vs ``sequence.tiled_loss``,
+    at a context length where the dense [B, S, V] logits blow a fixed
+    byte budget the tiled step fits inside — and the tiled step actually
+    TRAINS at that length; (b) the tiled step's peak must scale ~linearly
+    in S (the FPDT-pin convention: ratio ≲ shards, never ×V); (c) ring
+    schedule evidence: zigzag per-rank causal block-pair counts are
+    balanced where contiguous ones skew P:1, and the measured per-hop
+    KV-transfer overlap fraction (``Comm/ring/overlap_frac``) is nonzero
+    with pipelining ON and zero serialized. Non-fatal: failures return
+    status and never poison the headline."""
+    import numpy as np
+
+    try:
+        import jax.numpy as jnp
+
+        import deepspeed_tpu as dst
+        from deepspeed_tpu.comm import mesh as mesh_lib
+        from deepspeed_tpu.models import llama
+        from deepspeed_tpu.sequence.ring import (measure_ring_overlap,
+                                                 ring_block_pair_counts)
+
+        # logits-dominated shape: a big vocab makes the dense [B, S, V]
+        # head the peak, while layers stay tiny enough for the CPU lane
+        vocab = 65536 if not on_tpu else 131072
+        s_small, s_big = (512, 2048) if not on_tpu else (4096, 16384)
+        budget_mb = float(os.environ.get("DSTPU_BENCH_LONGCTX_BUDGET_MB",
+                                         512 if not on_tpu else 4096))
+        mcfg = llama.LlamaConfig(
+            vocab_size=vocab, hidden_size=64, intermediate_size=128,
+            num_layers=2, num_heads=2, num_kv_heads=2,
+            max_seq_len=s_big + 1, remat=True)
+        out: dict = {"ok": True, "budget_mb": budget_mb,
+                     "vocab": vocab, "seq_len": s_big}
+
+        def mk_engine(seqlen, tiled):
+            mesh_lib.set_mesh(None)
+            config = {
+                "train_batch_size": max(1, len(jax.devices())),
+                "bf16": {"enabled": True},
+                "optimizer": {"type": "adamw", "params": {"lr": 3e-4}},
+                "zero_optimization": {"stage": 2},
+                "steps_per_print": 0,
+            }
+            if tiled:
+                config["sequence"] = {"tiled_loss": True,
+                                      "tiled_loss_shards": 16,
+                                      "ring": {"layout": "zigzag",
+                                               "overlap": True}}
+            spec = llama.model_spec(mcfg, compute_dtype=jnp.bfloat16)
+            engine, _, _, _ = dst.initialize(model=spec, config=config)
+            return engine
+
+        def temp_peak_mb(seqlen, tiled):
+            """Compiled-peak temp bytes of the real train step — compile
+            only, never executed (the dense step at s_big is the one we
+            are proving does NOT fit)."""
+            engine = mk_engine(seqlen, tiled)
+            rng = np.random.default_rng(0)
+            batch = {"tokens": rng.integers(
+                0, vocab, (engine.train_batch_size(), seqlen + 1),
+                dtype=np.int32)}
+            if engine._train_step is None:
+                engine._build_train_step()
+            sb = engine._shard_batch(batch, with_gas_dim=True)
+            with engine.mesh_mgr.activate():
+                comp = engine._train_step.lower(
+                    engine.state, sb, engine._lr_override).compile()
+            mb = comp.memory_analysis().temp_size_in_bytes / 2**20
+            engine.destroy()
+            return mb
+
+        dense_mb = temp_peak_mb(s_big, tiled=False)
+        tiled_mb = temp_peak_mb(s_big, tiled=True)
+        tiled_small_mb = temp_peak_mb(s_small, tiled=True)
+        scale = s_big / s_small
+        ratio = tiled_mb / max(tiled_small_mb, 1e-9)
+        out["compiled_peak"] = {
+            "dense_mb": round(dense_mb, 1),
+            "tiled_mb": round(tiled_mb, 1),
+            "dense_over_budget": dense_mb > budget_mb,
+            "tiled_within_budget": tiled_mb <= budget_mb,
+            "tiled_mb_at_quarter_seq": round(tiled_small_mb, 1),
+            "tiled_scaling_ratio": round(ratio, 2),
+            # linear ≈ scale; a dense head would add the ×(V/shards) cliff
+            "tiled_scaling_linear": ratio < 2 * scale,
+        }
+
+        # the length the dense step cannot budget-fit must actually train
+        engine = mk_engine(s_big, tiled=True)
+        rng = np.random.default_rng(1)
+
+        def batch():
+            return {"tokens": rng.integers(
+                0, vocab, (engine.train_batch_size(), s_big + 1),
+                dtype=np.int32)}
+
+        losses = [float(engine.train_batch(batch()).loss) for _ in range(2)]
+        out["trains_at_dense_oom_len"] = {
+            "losses": [round(l, 4) for l in losses],
+            "finite": all(np.isfinite(losses)),
+        }
+        engine.destroy()
+
+        # (c) ring schedule evidence — pure schedule math + the host-level
+        # per-hop overlap measurement (writes Comm/ring/overlap_frac)
+        p = 8
+        zz = ring_block_pair_counts(p, "zigzag", causal=True)
+        ct = ring_block_pair_counts(p, "contiguous", causal=True)
+        ov_on = measure_ring_overlap(overlap=True, seq=2048)
+        ov_off = measure_ring_overlap(overlap=False, seq=2048)
+        out["ring"] = {
+            "p_size": p,
+            "zigzag_pair_counts": zz,
+            "contiguous_pair_counts": ct,
+            "zigzag_balanced": len(set(zz)) == 1,
+            "contiguous_skew": max(ct) / max(min(ct), 1),
+            "overlap_frac_on": round(ov_on["overlap_frac"], 3),
+            "overlap_frac_off": round(ov_off["overlap_frac"], 3),
+            "overlap_measured": ov_on["overlap_frac"] > 0.0,
+        }
+        out["ok"] = (out["compiled_peak"]["dense_over_budget"]
+                     and out["compiled_peak"]["tiled_within_budget"]
+                     and out["compiled_peak"]["tiled_scaling_linear"]
+                     and out["trains_at_dense_oom_len"]["finite"]
+                     and out["ring"]["zigzag_balanced"]
+                     and out["ring"]["overlap_measured"])
+        return out
+    except Exception as e:
+        return {"ok": False, "status": f"error: {e}"[-300:]}
+
+
 def run_decode_subprocess() -> object:
     """Decode bench in a SUBPROCESS with a hard timeout, BEFORE this process
     initializes its own jax client: a wedged tunnel compile must never hold
@@ -1246,6 +1380,14 @@ def main():
     # zero-events pin. Non-fatal; skippable via DSTPU_BENCH_INTEGRITY=0.
     if os.environ.get("DSTPU_BENCH_INTEGRITY", "1") not in ("", "0"):
         RESULT["detail"]["integrity"] = bench_integrity(jax, on_tpu)
+
+    # million-token-context memory probe (docs/performance.md "Million-token
+    # context"): dense-logits vs tiled-loss compiled peaks against a byte
+    # budget, the tiled step training at the dense-over-budget length, and
+    # the ring zigzag-balance + measured overlap evidence. Non-fatal;
+    # skippable via DSTPU_BENCH_LONGCTX=0.
+    if os.environ.get("DSTPU_BENCH_LONGCTX", "1") not in ("", "0"):
+        RESULT["detail"]["long_context"] = bench_long_context(jax, on_tpu)
 
     # step-time regression vs the newest checked-in BENCH_r*.json —
     # informational here (the gating form is --regression-only, wired as a

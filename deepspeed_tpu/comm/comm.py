@@ -154,6 +154,7 @@ class CommsTelemetry:
     debug: bool = False
     prof_ops: List[str] = field(default_factory=list)
     records: List[Dict[str, Any]] = field(default_factory=list)
+    ring_stats: Dict[str, float] = field(default_factory=dict)
 
     def _profiled(self, op: str) -> bool:
         if self.prof_all:
@@ -182,6 +183,20 @@ class CommsTelemetry:
         if self.verbose:
             logger.info(f"comm: {op} over {axis}: {nbytes} bytes "
                         f"{rec['shape']} from {rec['site']}")
+
+    def record_ring(self, key: str, value: float,
+                    accumulate: bool = True) -> None:
+        """Ring-attention series (``Comm/ring/<key>`` — the closed
+        ``telemetry.schema.COMM_RING_SERIES`` registry): trace-time
+        hop/byte counters from ``sequence.ring``, the host-measured
+        ``overlap_frac`` gauge, and the dense-fallback marker. Unlike
+        ``record`` this is NOT gated on ``enabled`` — the dense-fallback
+        marker must surface even when the comms logger is off."""
+        v = float(value)
+        if accumulate:
+            self.ring_stats[key] = self.ring_stats.get(key, 0.0) + v
+        else:
+            self.ring_stats[key] = v
 
     def summary(self) -> Dict[str, Dict[str, Any]]:
         out: Dict[str, Dict[str, Any]] = {}
@@ -241,10 +256,13 @@ class CommsTelemetry:
                        float(s["algo_bytes_ici"]), step))
             ev.append((f"Comm/{op}/fp32_equiv_bytes",
                        float(s["fp32_equiv_bytes"]), step))
+        for key, val in sorted(self.ring_stats.items()):
+            ev.append((f"Comm/ring/{key}", float(val), step))
         return ev
 
     def reset(self) -> None:
         self.records.clear()
+        self.ring_stats.clear()
 
 
 _telemetry = CommsTelemetry()

@@ -169,12 +169,20 @@ def _embed(cfg: BloomConfig, params: Params, tokens, compute_dtype):
                       cfg.layer_norm_eps)
 
 
-def _head(cfg: BloomConfig, params: Params, x: jnp.ndarray,
-          compute_dtype) -> jnp.ndarray:
+def _head_split(cfg: BloomConfig, params: Params, x: jnp.ndarray,
+                compute_dtype):
+    """Final norm + unembed matrix minus the logits matmul — consumed by
+    the tiled fused logits+loss head (``tiled_loss_fn``)."""
     x = layer_norm(x, params["final_ln_scale"].astype(compute_dtype),
                    params["final_ln_bias"].astype(compute_dtype),
                    cfg.layer_norm_eps)
-    return (x @ params["embed"].T.astype(compute_dtype)).astype(jnp.float32)
+    return x, params["embed"].T.astype(compute_dtype)
+
+
+def _head(cfg: BloomConfig, params: Params, x: jnp.ndarray,
+          compute_dtype) -> jnp.ndarray:
+    x, head = _head_split(cfg, params, x, compute_dtype)
+    return (x @ head).astype(jnp.float32)
 
 
 def _cast_layers(params: Params, compute_dtype):
@@ -185,7 +193,7 @@ def _cast_layers(params: Params, compute_dtype):
 
 def apply(cfg: BloomConfig, params: Params, tokens: jnp.ndarray, *,
           positions: Optional[jnp.ndarray] = None,
-          compute_dtype=jnp.bfloat16) -> jnp.ndarray:
+          compute_dtype=jnp.bfloat16, return_hidden: bool = False):
     del positions  # ALiBi: position information lives in the logits bias
     x = _embed(cfg, params, tokens, compute_dtype)
     bias = _alibi_bias(cfg.num_heads, tokens.shape[1])
@@ -197,6 +205,8 @@ def apply(cfg: BloomConfig, params: Params, tokens: jnp.ndarray, *,
         return _block(cfg, x, ov.constrain_scan_slice(layer), bias), None
 
     x, _ = lax.scan(scan_body, x, layers)
+    if return_hidden:
+        return _head_split(cfg, params, x, compute_dtype)
     return _head(cfg, params, x, compute_dtype)
 
 
@@ -273,6 +283,24 @@ def loss_fn(cfg: BloomConfig, params: Params, batch: Dict[str, jnp.ndarray], *,
     return loss, {"loss": loss, "ntokens": valid.sum()}
 
 
+def tiled_loss_fn(cfg: BloomConfig, params: Params,
+                  batch: Dict[str, jnp.ndarray], *,
+                  compute_dtype=jnp.bfloat16, shards: int = 8):
+    """``loss_fn`` with the unembed matmul + CE fused per sequence tile —
+    [B, S, V] logits are never materialized (``sequence.tiled_loss``)."""
+    from ..sequence.tiled import tiled_fused_logits_loss
+
+    tokens = batch["tokens"]
+    if "labels" in batch:
+        inputs, labels = tokens, batch["labels"]
+    else:
+        inputs, labels = tokens[:, :-1], tokens[:, 1:]
+    hidden, head = apply(cfg, params, inputs, compute_dtype=compute_dtype,
+                         return_hidden=True)
+    loss = tiled_fused_logits_loss(hidden, head, labels, shards=shards)
+    return loss, {"loss": loss, "ntokens": (labels != -100).sum()}
+
+
 def model_spec(cfg: BloomConfig, compute_dtype=jnp.bfloat16):
     from ..runtime.engine import ModelSpec
 
@@ -281,6 +309,8 @@ def model_spec(cfg: BloomConfig, compute_dtype=jnp.bfloat16):
         init_fn=lambda rng: init(cfg, rng),
         loss_fn=lambda params, batch: loss_fn(cfg, params, batch,
                                               compute_dtype=compute_dtype),
+        tiled_loss_fn=lambda params, batch, shards=8: tiled_loss_fn(
+            cfg, params, batch, compute_dtype=compute_dtype, shards=shards),
         apply_fn=lambda params, tokens, **kw: apply(
             cfg, params, tokens, compute_dtype=compute_dtype, **kw),
         logical_axes=param_logical_axes(cfg),

@@ -191,18 +191,25 @@ def _cast_layers(params, compute_dtype):
                         params["layers"])
 
 
-def _head(cfg, params, x, compute_dtype):
+def _head_split(cfg, params, x, compute_dtype):
+    """Final norm + unembed matrix minus the logits matmul — consumed by
+    the tiled fused logits+loss head (``tiled_loss_fn``)."""
     x = rms_norm(x, params["final_norm"].astype(compute_dtype),
                  cfg.rms_norm_eps)
     head = params.get("lm_head")
     if head is None:
         head = params["embed"].T
-    return (x @ head.astype(compute_dtype)).astype(jnp.float32)
+    return x, head.astype(compute_dtype)
+
+
+def _head(cfg, params, x, compute_dtype):
+    x, head = _head_split(cfg, params, x, compute_dtype)
+    return (x @ head).astype(jnp.float32)
 
 
 def apply(cfg: Exaone4Config, params: Params, tokens: jnp.ndarray, *,
           positions: Optional[jnp.ndarray] = None,
-          compute_dtype=jnp.bfloat16) -> jnp.ndarray:
+          compute_dtype=jnp.bfloat16, return_hidden: bool = False):
     x = embedding_lookup(params["embed"], tokens, compute_dtype)
     cos, sin = rope_frequencies(cfg.head_size, cfg.max_seq_len,
                                 cfg.rope_theta)
@@ -214,6 +221,8 @@ def apply(cfg: Exaone4Config, params: Params, tokens: jnp.ndarray, *,
         return _block(cfg, x, layer, cos, sin, positions, window, rope), None
 
     x, _ = lax.scan(body, x, (layers, windows, use_rope))
+    if return_hidden:
+        return _head_split(cfg, params, x, compute_dtype)
     return _head(cfg, params, x, compute_dtype)
 
 
@@ -290,6 +299,24 @@ def loss_fn(cfg: Exaone4Config, params: Params,
     return loss, {"loss": loss, "ntokens": valid.sum()}
 
 
+def tiled_loss_fn(cfg: Exaone4Config, params: Params,
+                  batch: Dict[str, jnp.ndarray], *,
+                  compute_dtype=jnp.bfloat16, shards: int = 8):
+    """``loss_fn`` with the unembed matmul + CE fused per sequence tile —
+    [B, S, V] logits are never materialized (``sequence.tiled_loss``)."""
+    from ..sequence.tiled import tiled_fused_logits_loss
+
+    tokens = batch["tokens"]
+    if "labels" in batch:
+        inputs, labels = tokens, batch["labels"]
+    else:
+        inputs, labels = tokens[:, :-1], tokens[:, 1:]
+    hidden, head = apply(cfg, params, inputs, compute_dtype=compute_dtype,
+                         return_hidden=True)
+    loss = tiled_fused_logits_loss(hidden, head, labels, shards=shards)
+    return loss, {"loss": loss, "ntokens": (labels != -100).sum()}
+
+
 def model_spec(cfg: Exaone4Config, compute_dtype=jnp.bfloat16):
     from ..runtime.engine import ModelSpec
 
@@ -298,6 +325,8 @@ def model_spec(cfg: Exaone4Config, compute_dtype=jnp.bfloat16):
         init_fn=lambda rng: init(cfg, rng),
         loss_fn=lambda params, batch: loss_fn(cfg, params, batch,
                                               compute_dtype=compute_dtype),
+        tiled_loss_fn=lambda params, batch, shards=8: tiled_loss_fn(
+            cfg, params, batch, compute_dtype=compute_dtype, shards=shards),
         apply_fn=lambda params, tokens, **kw: apply(
             cfg, params, tokens, compute_dtype=compute_dtype, **kw),
         logical_axes=param_logical_axes(cfg),

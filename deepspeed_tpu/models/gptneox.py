@@ -214,14 +214,24 @@ def _block(cfg: GPTNeoXConfig, x: jnp.ndarray, layer: Params,
     return x + _mlp(cfg, y2, layer)
 
 
-def _head(cfg: GPTNeoXConfig, params: Params, x: jnp.ndarray,
-          compute_dtype) -> jnp.ndarray:
+def _head_split(cfg: GPTNeoXConfig, params: Params, x: jnp.ndarray,
+                compute_dtype):
+    """Final norm + unembed matrix (+ optional logit bias) minus the
+    logits matmul — consumed by the tiled fused logits+loss head."""
     x = layer_norm(x, params["final_ln_scale"].astype(compute_dtype),
                    params["final_ln_bias"].astype(compute_dtype),
                    cfg.layer_norm_eps)
-    logits = x @ params["lm_head"].astype(compute_dtype)
-    if "lm_head_bias" in params:
-        logits = logits + params["lm_head_bias"].astype(compute_dtype)
+    bias = params.get("lm_head_bias")
+    return (x, params["lm_head"].astype(compute_dtype),
+            None if bias is None else bias.astype(compute_dtype))
+
+
+def _head(cfg: GPTNeoXConfig, params: Params, x: jnp.ndarray,
+          compute_dtype) -> jnp.ndarray:
+    x, head, bias = _head_split(cfg, params, x, compute_dtype)
+    logits = x @ head
+    if bias is not None:
+        logits = logits + bias
     return logits.astype(jnp.float32)
 
 
@@ -233,7 +243,7 @@ def _cast_layers(params: Params, compute_dtype):
 
 def apply(cfg: GPTNeoXConfig, params: Params, tokens: jnp.ndarray, *,
           positions: Optional[jnp.ndarray] = None,
-          compute_dtype=jnp.bfloat16) -> jnp.ndarray:
+          compute_dtype=jnp.bfloat16, return_hidden: bool = False):
     x = embedding_lookup(params["embed"], tokens, compute_dtype)
     cos, sin = rope_frequencies(cfg.rot_dim, cfg.max_seq_len, cfg.rope_theta)
     layers = _cast_layers(params, compute_dtype)
@@ -245,6 +255,8 @@ def apply(cfg: GPTNeoXConfig, params: Params, tokens: jnp.ndarray, *,
                       cos, sin, positions), None
 
     x, _ = lax.scan(scan_body, x, layers)
+    if return_hidden:
+        return _head_split(cfg, params, x, compute_dtype)
     return _head(cfg, params, x, compute_dtype)
 
 
@@ -326,6 +338,26 @@ def loss_fn(cfg: GPTNeoXConfig, params: Params, batch: Dict[str, jnp.ndarray],
     return loss, {"loss": loss, "ntokens": valid.sum()}
 
 
+def tiled_loss_fn(cfg: GPTNeoXConfig, params: Params,
+                  batch: Dict[str, jnp.ndarray], *,
+                  compute_dtype=jnp.bfloat16, shards: int = 8):
+    """``loss_fn`` with the unembed matmul + CE fused per sequence tile —
+    [B, S, V] logits are never materialized (``sequence.tiled_loss``)."""
+    from ..sequence.tiled import tiled_fused_logits_loss
+
+    tokens = batch["tokens"]
+    if "labels" in batch:
+        inputs, labels = tokens, batch["labels"]
+    else:
+        inputs, labels = tokens[:, :-1], tokens[:, 1:]
+    hidden, head, bias = apply(cfg, params, inputs,
+                               compute_dtype=compute_dtype,
+                               return_hidden=True)
+    loss = tiled_fused_logits_loss(hidden, head, labels, shards=shards,
+                                   bias=bias)
+    return loss, {"loss": loss, "ntokens": (labels != -100).sum()}
+
+
 def model_spec(cfg: GPTNeoXConfig, compute_dtype=jnp.bfloat16):
     from ..runtime.engine import ModelSpec
 
@@ -334,6 +366,8 @@ def model_spec(cfg: GPTNeoXConfig, compute_dtype=jnp.bfloat16):
         init_fn=lambda rng: init(cfg, rng),
         loss_fn=lambda params, batch: loss_fn(cfg, params, batch,
                                               compute_dtype=compute_dtype),
+        tiled_loss_fn=lambda params, batch, shards=8: tiled_loss_fn(
+            cfg, params, batch, compute_dtype=compute_dtype, shards=shards),
         apply_fn=lambda params, tokens, **kw: apply(
             cfg, params, tokens, compute_dtype=compute_dtype, **kw),
         logical_axes=param_logical_axes(cfg),

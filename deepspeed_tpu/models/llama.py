@@ -360,10 +360,30 @@ def _block(cfg: LlamaConfig, x: jnp.ndarray, layer: Params,
     return x
 
 
+def _head_split(cfg: LlamaConfig, params: Params, x: jnp.ndarray,
+                compute_dtype):
+    """Final norm + unembed matrix WITHOUT the logits matmul — the
+    factorization the tiled fused logits+loss head consumes so [B, S, V]
+    is never materialized. ``_head`` composes it back for the dense path."""
+    x = rms_norm(x, params["final_norm"].astype(compute_dtype),
+                 cfg.rms_norm_eps)
+    head = params.get("lm_head")
+    if head is None:
+        head = params["embed"].T
+    return x, head.astype(compute_dtype)
+
+
+def _head(cfg: LlamaConfig, params: Params, x: jnp.ndarray, compute_dtype):
+    x, head = _head_split(cfg, params, x, compute_dtype)
+    return (x @ head).astype(jnp.float32)
+
+
 def apply(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray, *,
           positions: Optional[jnp.ndarray] = None,
-          compute_dtype=jnp.bfloat16) -> jnp.ndarray:
-    """Forward pass → logits [batch, seq, vocab] (fp32).
+          compute_dtype=jnp.bfloat16, return_hidden: bool = False):
+    """Forward pass → logits [batch, seq, vocab] (fp32); with
+    ``return_hidden`` → the ``_head_split`` pair (normed hidden, unembed)
+    for the tiled loss head instead.
 
     Layers run under ``lax.scan`` over the stacked leading dim; with
     ``cfg.remat`` each block is wrapped in ``jax.checkpoint`` so the backward
@@ -426,12 +446,9 @@ def apply(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray, *,
             x, _ = ov.prefetch_scan(scan_body, x, layers)
         else:
             x, _ = lax.scan(scan_body, x, layers)
-    x = rms_norm(x, params["final_norm"].astype(compute_dtype), cfg.rms_norm_eps)
-    head = params.get("lm_head")
-    if head is None:
-        head = params["embed"].T
-    logits = x @ head.astype(compute_dtype)
-    return logits.astype(jnp.float32)
+    if return_hidden:
+        return _head_split(cfg, params, x, compute_dtype)
+    return _head(cfg, params, x, compute_dtype)
 
 
 # --------------------------------------------------------------------------- #
@@ -616,6 +633,8 @@ def model_spec(cfg: LlamaConfig, compute_dtype=jnp.bfloat16):
         init_fn=lambda rng: init(cfg, rng),
         loss_fn=lambda params, batch: loss_fn(cfg, params, batch,
                                               compute_dtype=compute_dtype),
+        tiled_loss_fn=lambda params, batch, shards=8: tiled_loss_fn(
+            cfg, params, batch, compute_dtype=compute_dtype, shards=shards),
         apply_fn=lambda params, tokens, **kw: apply(cfg, params, tokens,
                                                     compute_dtype=compute_dtype, **kw),
         logical_axes=param_logical_axes(cfg),
@@ -722,3 +741,24 @@ def loss_fn(cfg: LlamaConfig, params: Params, batch: Dict[str, jnp.ndarray], *,
     denom = jnp.maximum(valid.sum(), 1)
     loss = jnp.where(valid, token_loss, 0.0).sum() / denom
     return loss, {"loss": loss, "ntokens": valid.sum()}
+
+
+def tiled_loss_fn(cfg: LlamaConfig, params: Params,
+                  batch: Dict[str, jnp.ndarray], *,
+                  compute_dtype=jnp.bfloat16, shards: int = 8
+                  ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """``loss_fn`` with the unembed matmul + CE fused per sequence tile
+    (``sequence.tiled_loss``): the [B, S, V] logits tensor — the first OOM
+    at long context — is never materialized; one [B, S/shards, V] tile
+    lives at a time inside a rematerialized scan."""
+    from ..sequence.tiled import tiled_fused_logits_loss
+
+    tokens = batch["tokens"]
+    if "labels" in batch:
+        inputs, labels = tokens, batch["labels"]
+    else:
+        inputs, labels = tokens[:, :-1], tokens[:, 1:]
+    hidden, head = apply(cfg, params, inputs, compute_dtype=compute_dtype,
+                         return_hidden=True)
+    loss = tiled_fused_logits_loss(hidden, head, labels, shards=shards)
+    return loss, {"loss": loss, "ntokens": (labels != -100).sum()}

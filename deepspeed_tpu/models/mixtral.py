@@ -148,9 +148,23 @@ def param_logical_axes(cfg: MixtralConfig) -> Params:
     return axes
 
 
+def _head_split(cfg, params, x, compute_dtype):
+    """Final norm + unembed matrix minus the logits matmul — consumed by
+    the tiled fused logits+loss head (``tiled_loss_fn``)."""
+    x = rms_norm(x, params["final_norm"].astype(compute_dtype),
+                 cfg.rms_norm_eps)
+    return x, params["lm_head"].astype(compute_dtype)
+
+
+def _head(cfg, params, x, compute_dtype):
+    x, head = _head_split(cfg, params, x, compute_dtype)
+    return (x @ head).astype(jnp.float32)
+
+
 def apply(cfg: MixtralConfig, params: Params, tokens: jnp.ndarray, *,
-          compute_dtype=jnp.bfloat16) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Forward → (logits [b, s, vocab] fp32, total_aux_loss)."""
+          compute_dtype=jnp.bfloat16, return_hidden: bool = False):
+    """Forward → (logits [b, s, vocab] fp32, total_aux_loss); with
+    ``return_hidden`` → (normed hidden, unembed matrix, total_aux_loss)."""
     x = embedding_lookup(params["embed"], tokens, compute_dtype)
     cos, sin = rope_frequencies(cfg.head_size, cfg.max_seq_len, cfg.rope_theta)
     moe_layer = MoELayer(cfg.num_experts, cfg.top_k, cfg.capacity_factor,
@@ -206,9 +220,10 @@ def apply(cfg: MixtralConfig, params: Params, tokens: jnp.ndarray, *,
         x, aux_losses = ov.prefetch_scan(scan_body, x, layers)
     else:
         x, aux_losses = lax.scan(scan_body, x, layers)
-    x = rms_norm(x, params["final_norm"].astype(compute_dtype), cfg.rms_norm_eps)
-    logits = x @ params["lm_head"].astype(compute_dtype)
-    return logits.astype(jnp.float32), jnp.sum(aux_losses)
+    if return_hidden:
+        hidden, head = _head_split(cfg, params, x, compute_dtype)
+        return hidden, head, jnp.sum(aux_losses)
+    return _head(cfg, params, x, compute_dtype), jnp.sum(aux_losses)
 
 
 # --- KV-cached inference path (MoE decode; reference
@@ -284,6 +299,24 @@ def loss_fn(cfg: MixtralConfig, params: Params, batch: Dict[str, jnp.ndarray], *
     return loss, {"loss": loss, "lm_loss": lm_loss, "aux_loss": aux}
 
 
+def tiled_loss_fn(cfg: MixtralConfig, params: Params,
+                  batch: Dict[str, jnp.ndarray], *,
+                  compute_dtype=jnp.bfloat16, shards: int = 8):
+    """``loss_fn`` with the unembed matmul + CE fused per sequence tile —
+    [B, S, V] logits are never materialized (``sequence.tiled_loss``).
+    The MoE aux loss is added exactly as in ``loss_fn``."""
+    from ..sequence.tiled import tiled_fused_logits_loss
+
+    tokens = batch["tokens"]
+    inputs, labels = tokens[:, :-1], tokens[:, 1:]
+    hidden, head, aux = apply(cfg, params, inputs,
+                              compute_dtype=compute_dtype,
+                              return_hidden=True)
+    lm_loss = tiled_fused_logits_loss(hidden, head, labels, shards=shards)
+    loss = lm_loss + cfg.aux_loss_coef * aux
+    return loss, {"loss": loss, "lm_loss": lm_loss, "aux_loss": aux}
+
+
 def model_spec(cfg: MixtralConfig, compute_dtype=jnp.bfloat16):
     from ..runtime.engine import ModelSpec
 
@@ -292,6 +325,8 @@ def model_spec(cfg: MixtralConfig, compute_dtype=jnp.bfloat16):
         init_fn=lambda rng: init(cfg, rng),
         loss_fn=lambda params, batch: loss_fn(cfg, params, batch,
                                               compute_dtype=compute_dtype),
+        tiled_loss_fn=lambda params, batch, shards=8: tiled_loss_fn(
+            cfg, params, batch, compute_dtype=compute_dtype, shards=shards),
         apply_fn=lambda params, tokens, **kw: apply(cfg, params, tokens,
                                                     compute_dtype=compute_dtype)[0],
         logical_axes=param_logical_axes(cfg),

@@ -171,6 +171,41 @@ class AttentionOpsConfig(ConfigModel):
 
 @register_config_model
 @dataclass
+class RingSequenceConfig(ConfigModel):
+    """``sequence.ring`` block — ring context-parallelism schedule knobs
+    (docs/performance.md "Million-token context").
+
+    ``layout: zigzag`` replaces the contiguous causal layout (rank r does
+    r+1 block-pairs; rank P-1 is a P× straggler) with the striped layout
+    where rank r owns global half-chunks {r, 2P-1-r} — every rank then
+    executes exactly 2P+1 flash pairs and causal wall-clock drops ~2×.
+    ``overlap: true`` issues each hop's ``ppermute`` before the previous
+    block's flash kernels so the ICI transfer hides under compute.
+    Published at engine init via ``sequence.ring.configure_ring`` (the
+    ``attention.gqa_native`` pattern); both settings preserve exact
+    numerics — layout/ordering changes only."""
+    layout: str = "contiguous"  # "contiguous" | "zigzag"
+    overlap: bool = False
+
+
+@register_config_model
+@dataclass
+class SequenceConfig(ConfigModel):
+    """``sequence`` block — long-context behavior of the training engine.
+
+    ``tiled_loss: true`` routes the engine loss through the model's tiled
+    fused logits+loss head (``sequence.tiled.tiled_fused_logits_loss``):
+    the ``[B, S, V]`` logits tensor — the FIRST thing to OOM at long
+    context, before attention — is never materialized; logits exist one
+    ``[B, S/shards, V]`` tile at a time inside a rematerialized scan.
+    Default OFF keeps the train step byte-identical (pinned)."""
+    tiled_loss: bool = False
+    tiled_loss_shards: int = 8
+    ring: RingSequenceConfig = field(default_factory=RingSequenceConfig)
+
+
+@register_config_model
+@dataclass
 class ActivationCheckpointingConfig(ConfigModel):
     """Reference: ``runtime/activation_checkpointing/checkpointing.py`` flags.
     On TPU these select a ``jax.checkpoint`` (remat) policy."""
@@ -568,6 +603,7 @@ class DeepSpeedTPUConfig:
     memory: MemoryConfig = field(default_factory=MemoryConfig)
     reliability: ReliabilityConfig = field(default_factory=ReliabilityConfig)
     aio: AIOConfig = field(default_factory=AIOConfig)
+    sequence: SequenceConfig = field(default_factory=SequenceConfig)
 
     gradient_clipping: float = 0.0
     prescale_gradients: bool = False
@@ -647,6 +683,7 @@ _SUBCONFIG_KEYS = {
     "memory": MemoryConfig,
     "reliability": ReliabilityConfig,
     "aio": AIOConfig,
+    "sequence": SequenceConfig,
 }
 
 _ATTR_FOR_KEY = {"zero_optimization": "zero_config", "bfloat16": "bf16"}

@@ -72,6 +72,11 @@ class ModelSpec:
     # (grads_of_scaled_loss, unscaled_loss, aux). Used instead of jax.grad
     # when the mesh has pipe >= 2 (runtime/pipe/one_f_one_b.py)
     pipeline_grad_fn: Optional[Callable[..., Any]] = None
+    # optional fused unembed+CE loss: (params, batch, *, shards) ->
+    # (loss, aux) that never materializes the [B, S, V] logits tensor.
+    # Routed instead of loss_fn when config.sequence.tiled_loss is on
+    # (sequence/tiled.py tiled_fused_logits_loss).
+    tiled_loss_fn: Optional[Callable[..., Any]] = None
 
     def materialize(self, rng: jax.Array):
         if self.params is not None:
@@ -504,6 +509,28 @@ class DeepSpeedTPUEngine:
         if config.attention.gqa_native:
             log_dist("attention.gqa_native: narrow-KV flash kernels armed "
                      "(KV HBM traffic scales with kv_heads, not num_heads)")
+
+        # --- sequence.ring: publish the ring-attention schedule knobs
+        # process-wide (same latest-engine-wins contract as the gate above;
+        # sequence/ring.py). Defaults (contiguous, no overlap) leave every
+        # ring program unchanged.
+        from ..sequence.ring import configure_ring
+
+        configure_ring(layout=config.sequence.ring.layout,
+                       overlap=bool(config.sequence.ring.overlap))
+        if config.sequence.ring.layout != "contiguous" or \
+                config.sequence.ring.overlap:
+            log_dist(
+                f"sequence.ring: layout={config.sequence.ring.layout} "
+                f"overlap={config.sequence.ring.overlap}")
+        if config.sequence.tiled_loss:
+            if getattr(model, "tiled_loss_fn", None) is None:
+                log_dist("sequence.tiled_loss: ON but model spec has no "
+                         "tiled_loss_fn — falling back to dense loss_fn")
+            else:
+                log_dist("sequence.tiled_loss: fused unembed+CE armed "
+                         f"(shards={config.sequence.tiled_loss_shards}; "
+                         "[B, S, V] logits never materialized)")
 
         self.tput_timer = ThroughputTimer(
             batch_size=self.train_batch_size(),
@@ -1079,9 +1106,22 @@ class DeepSpeedTPUEngine:
         return jax.tree.map(one, compute, self._param_shardings,
                             self.param_specs, self.opt_param_specs)
 
+    def _raw_loss(self, compute_params, batch):
+        """Model loss on already-cast/gathered compute params. Routes
+        through the tiled fused logits+loss head when
+        ``sequence.tiled_loss`` is on — the [B, S, V] logits tensor is
+        never materialized (sequence/tiled.py). With the knob off (the
+        default) this is exactly ``model.loss_fn``: the trace, and hence
+        the compiled train step, is byte-identical to before."""
+        seq = self.config.sequence
+        if seq.tiled_loss and self.model.tiled_loss_fn is not None:
+            return self.model.tiled_loss_fn(compute_params, batch,
+                                            shards=seq.tiled_loss_shards)
+        return self.model.loss_fn(compute_params, batch)
+
     def _loss(self, params, batch):
         compute_params = self._cast_gather(params)
-        out = self.model.loss_fn(compute_params, batch)
+        out = self._raw_loss(compute_params, batch)
         if isinstance(out, tuple):
             loss, aux = out
         else:
@@ -1150,7 +1190,7 @@ class DeepSpeedTPUEngine:
 
         def local(compute_params, lbatch):
             def scaled(p):
-                out = self.model.loss_fn(p, lbatch)
+                out = self._raw_loss(p, lbatch)
                 loss, aux = out if isinstance(out, tuple) else (out, {})
                 loss = loss.astype(jnp.float32)
                 return scale_loss(loss, loss_scale), (loss, aux)

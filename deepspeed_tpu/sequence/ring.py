@@ -26,6 +26,26 @@ Memory: O(S/P) activations per chip, no S×S materialization. Comm: P-1
 point-to-point KV block transfers per direction per attention, all riding
 neighbor ICI links (vs. Ulysses' global a2a) — the better choice when
 heads < sp or for very long sequences.
+
+Two production knobs (``sequence.ring`` config block, published by the
+engine via ``configure_ring`` — same pattern as ``attention.gqa_native``):
+
+- ``layout: zigzag`` — the contiguous causal layout is pathologically
+  imbalanced: rank r only computes the r+1 non-masked KV pairs, so rank P-1
+  does P× the work of rank 0 and every rank waits for it. The zigzag
+  (striped) layout gives rank r the global half-chunks {r, 2P-1-r} (one
+  early, one late); every rank then executes exactly 2P+1 flash pairs per
+  causal pass (``ring_block_pair_counts``) and causal wall-clock drops from
+  P pair-times to ~P+2 HALF-sized pair-times ≈ (P+2)/2. The jit-level
+  shuffle/unshuffle permutes live in ``ring_attention_spmd``; inside the
+  shard the local block is [chunk r | chunk 2P-1-r].
+- ``overlap: true`` — software-pipelined hop: the ``ppermute`` for block
+  t+1 is issued BEFORE block t's flash kernels. The two have no data
+  dependency, so XLA's latency-hiding scheduler floats the ICI transfer
+  under the compute and the per-hop critical path becomes
+  max(compute, transfer) instead of their sum (T3, arXiv:2401.16677).
+  ``measure_ring_overlap`` measures the realized hiding fraction host-side
+  (``Comm/ring/overlap_frac``), mirroring ``Memory/tier/overlap_frac``.
 """
 
 from __future__ import annotations
@@ -36,23 +56,102 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ..comm import comm as dist
 from ..comm.mesh import BATCH_AXES, get_mesh
+from ..utils.logging import logger
 from .fpdt import NEG_BIG, _from_bh, _merge, _pair_bwd, _pair_fwd, _to_bh
 
 NEG_INF = NEG_BIG  # kept for back-compat with older imports
 
+RING_LAYOUTS = ("contiguous", "zigzag")
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _ring_core(q, k, v, axis, p_size, causal, scale):
-    o, _ = _ring_fwd_impl(q, k, v, axis, p_size, causal, scale)
+_RING_LAYOUT = "contiguous"
+_RING_OVERLAP = False
+
+
+def configure_ring(layout: str = "contiguous", overlap: bool = False) -> None:
+    """Publish the ``sequence.ring`` config block as the module defaults
+    (engine init calls this once — the ``configure_gqa_native`` pattern).
+    Explicit ``layout=``/``overlap=`` kwargs on the entry points still win."""
+    global _RING_LAYOUT, _RING_OVERLAP
+    if layout not in RING_LAYOUTS:
+        raise ValueError(
+            f"sequence.ring.layout must be one of {RING_LAYOUTS}, "
+            f"got {layout!r}")
+    _RING_LAYOUT = layout
+    _RING_OVERLAP = bool(overlap)
+
+
+def ring_layout() -> str:
+    return _RING_LAYOUT
+
+
+def ring_overlap() -> bool:
+    return _RING_OVERLAP
+
+
+def ring_block_pair_counts(p_size: int, layout: str = "contiguous",
+                           causal: bool = True) -> list:
+    """Host-side simulation of the hop schedule: how many (q-chunk,
+    kv-chunk) flash pairs each rank executes over one full ring pass. The
+    predicates mirror the traced ``lax.cond`` gates 1:1 (hop t holds the
+    block of src = (r - t) % P), so the zigzag balance test pins the real
+    schedule, not a re-derivation. Causal zigzag: every rank executes
+    exactly 2P+1 pairs; causal contiguous: rank r executes r+1 (rank P-1
+    is the straggler the whole ring waits on)."""
+    counts = []
+    for r in range(p_size):
+        n = 0
+        for t in range(p_size):
+            s = (r - t) % p_size
+            if not causal:
+                n += 1  # every visiting block is fully visible
+            elif layout == "zigzag":
+                # (q_hi, kv_lo) always + (q_lo, kv_lo) past/diag
+                # + (q_hi, kv_hi) when src's hi chunk is q_hi's past/diag
+                n += 1 + (1 if s <= r else 0) + (1 if s >= r else 0)
+            else:
+                n += 1 if s <= r else 0
+        counts.append(n)
+    return counts
+
+
+def zigzag_perm(seq_len: int, p_size: int) -> np.ndarray:
+    """Global→zigzag gather indices: ``shuffled[i] = x[perm[i]]``. Rank r's
+    shard of the shuffled sequence is [chunk r | chunk 2P-1-r] of the
+    original (half-chunks of size S/(2P))."""
+    if seq_len % (2 * p_size):
+        raise ValueError(
+            f"zigzag needs seq_len % (2*p_size) == 0, got {seq_len} % "
+            f"{2 * p_size}")
+    c = seq_len // (2 * p_size)
+    idx = []
+    for r in range(p_size):
+        idx.append(np.arange(r * c, (r + 1) * c))
+        jr = 2 * p_size - 1 - r
+        idx.append(np.arange(jr * c, (jr + 1) * c))
+    return np.concatenate(idx)
+
+
+def zigzag_inverse_perm(seq_len: int, p_size: int) -> np.ndarray:
+    """Inverse of ``zigzag_perm``: ``x[j] = shuffled[inv[j]]``."""
+    return np.argsort(zigzag_perm(seq_len, p_size), kind="stable")
+
+
+# --------------------------------------------------------------------------- #
+# contiguous layout core
+# --------------------------------------------------------------------------- #
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _ring_core(q, k, v, axis, p_size, causal, scale, overlap):
+    o, _ = _ring_fwd_impl(q, k, v, axis, p_size, causal, scale, overlap)
     return o
 
 
-def _ring_fwd_impl(q, k, v, axis, p_size, causal, scale):
+def _ring_fwd_impl(q, k, v, axis, p_size, causal, scale, overlap):
     my = lax.axis_index(axis)
     B, sq, H, D = q.shape
     q_bh = _to_bh(q)
@@ -76,6 +175,14 @@ def _ring_fwd_impl(q, k, v, axis, p_size, causal, scale):
 
     def body(t, carry):
         o_run, l_run, kt, vt = carry
+        if overlap:
+            # pipelined hop: block t+1's ppermute is issued BEFORE block t's
+            # flash kernels — no data dependency between them, so the ICI
+            # transfer hides under compute (latency-hiding scheduler)
+            kn = lax.ppermute(kt, axis, fwd_perm)
+            vn = lax.ppermute(vt, axis, fwd_perm)
+            o_run, l_run = step(t, o_run, l_run, kt, vt)
+            return o_run, l_run, kn, vn
         o_run, l_run = step(t, o_run, l_run, kt, vt)
         kt = lax.ppermute(kt, axis, fwd_perm)
         vt = lax.ppermute(vt, axis, fwd_perm)
@@ -88,12 +195,12 @@ def _ring_fwd_impl(q, k, v, axis, p_size, causal, scale):
     return _from_bh(o_run.astype(q.dtype), B, H), l_run
 
 
-def _ring_core_fwd(q, k, v, axis, p_size, causal, scale):
-    o, lse = _ring_fwd_impl(q, k, v, axis, p_size, causal, scale)
+def _ring_core_fwd(q, k, v, axis, p_size, causal, scale, overlap):
+    o, lse = _ring_fwd_impl(q, k, v, axis, p_size, causal, scale, overlap)
     return o, (q, k, v, o, lse)
 
 
-def _ring_core_bwd(axis, p_size, causal, scale, res, do):
+def _ring_core_bwd(axis, p_size, causal, scale, overlap, res, do):
     q, k, v, o, lse = res
     my = lax.axis_index(axis)
     B, sq, H, D = q.shape
@@ -120,9 +227,19 @@ def _ring_core_bwd(axis, p_size, causal, scale, res, do):
 
     def body(t, carry):
         dq_run, kt, vt, dk_run, dv_run = carry
-        dq_run, dk_run, dv_run = step(t, dq_run, kt, vt, dk_run, dv_run)
         # the dk/dv accumulators TRAVEL with their kv block: after the P-th
         # rotation each block is home again, carrying its complete gradient
+        if overlap:
+            # kv for hop t+1 departs before hop t's kernels; the gradient
+            # accumulators depend on those kernels, so they hop after —
+            # still in lockstep with their block, one rotation per hop
+            kn = lax.ppermute(kt, axis, fwd_perm)
+            vn = lax.ppermute(vt, axis, fwd_perm)
+            dq_run, dk_run, dv_run = step(t, dq_run, kt, vt, dk_run, dv_run)
+            dk_run = lax.ppermute(dk_run, axis, fwd_perm)
+            dv_run = lax.ppermute(dv_run, axis, fwd_perm)
+            return dq_run, kn, vn, dk_run, dv_run
+        dq_run, dk_run, dv_run = step(t, dq_run, kt, vt, dk_run, dv_run)
         kt = lax.ppermute(kt, axis, fwd_perm)
         vt = lax.ppermute(vt, axis, fwd_perm)
         dk_run = lax.ppermute(dk_run, axis, fwd_perm)
@@ -143,31 +260,380 @@ def _ring_core_bwd(axis, p_size, causal, scale, res, do):
 _ring_core.defvjp(_ring_core_fwd, _ring_core_bwd)
 
 
+# --------------------------------------------------------------------------- #
+# zigzag layout core (causal only — the schedule it balances)
+# --------------------------------------------------------------------------- #
+# Local block = [chunk r | chunk 2P-1-r] (half-chunks of size c). At hop t
+# the resident kv block belongs to src s = (r - t) % P, so the causal pairs
+# are exactly:
+#   (q_hi, kv_lo)  always      — chunk s < P ≤ 2P-1-r is always q_hi's past
+#   (q_lo, kv_lo)  iff s ≤ r   — diagonal (same chunk) when s == r
+#   (q_hi, kv_hi)  iff s ≥ r   — chunk 2P-1-s ≤ 2P-1-r; diagonal at s == r
+#   (q_lo, kv_hi)  never       — chunk 2P-1-s ≥ P > r is always the future
+# Per hop that is 2 half-pairs (3 on the t=0 diagonal), identical on every
+# rank: the per-pass count is exactly 2P+1 everywhere (the balance pin).
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _zz_core(q, k, v, axis, p_size, causal, scale, overlap):
+    o, _ = _zz_fwd_impl(q, k, v, axis, p_size, causal, scale, overlap)
+    return o
+
+
+def _zz_fwd_impl(q, k, v, axis, p_size, causal, scale, overlap):
+    del causal  # zigzag core is causal by construction (spmd routes others)
+    my = lax.axis_index(axis)
+    B, sq, H, D = q.shape
+    c = sq // 2
+    q_bh = _to_bh(q)
+    q_lo, q_hi = q_bh[:, :c], q_bh[:, c:]
+    o0 = jnp.zeros((B * H, c, D), jnp.float32)
+    l0 = jnp.full((B * H, c), NEG_BIG, jnp.float32)
+    fwd_perm = [(i, (i + 1) % p_size) for i in range(p_size)]
+
+    def step(t, acc, kt, vt):
+        o_lo, l_lo, o_hi, l_hi = acc
+        src = (my - t) % p_size
+        k_lo, v_lo = kt[:, :c], vt[:, :c]
+        k_hi, v_hi = kt[:, c:], vt[:, c:]
+        # (q_hi, kv_lo): unconditionally fully visible — causal=False picks
+        # the unmasked kernel branch with no traced diag cond
+        o_j, lse_j = _pair_fwd(q_hi, k_lo, v_lo, False, False, scale, H)
+        o_hi, l_hi = _merge(o_hi, l_hi, o_j, lse_j)
+
+        def lo_pair(ol):
+            o_j, lse_j = _pair_fwd(q_lo, k_lo, v_lo, src == my, True,
+                                   scale, H)
+            return _merge(ol[0], ol[1], o_j, lse_j)
+
+        o_lo, l_lo = lax.cond(src <= my, lo_pair, lambda ol: ol,
+                              (o_lo, l_lo))
+
+        def hi_pair(ol):
+            o_j, lse_j = _pair_fwd(q_hi, k_hi, v_hi, src == my, True,
+                                   scale, H)
+            return _merge(ol[0], ol[1], o_j, lse_j)
+
+        o_hi, l_hi = lax.cond(src >= my, hi_pair, lambda ol: ol,
+                              (o_hi, l_hi))
+        return o_lo, l_lo, o_hi, l_hi
+
+    def body(t, carry):
+        o_lo, l_lo, o_hi, l_hi, kt, vt = carry
+        if overlap:
+            kn = lax.ppermute(kt, axis, fwd_perm)
+            vn = lax.ppermute(vt, axis, fwd_perm)
+            o_lo, l_lo, o_hi, l_hi = step(t, (o_lo, l_lo, o_hi, l_hi),
+                                          kt, vt)
+            return o_lo, l_lo, o_hi, l_hi, kn, vn
+        o_lo, l_lo, o_hi, l_hi = step(t, (o_lo, l_lo, o_hi, l_hi), kt, vt)
+        return (o_lo, l_lo, o_hi, l_hi,
+                lax.ppermute(kt, axis, fwd_perm),
+                lax.ppermute(vt, axis, fwd_perm))
+
+    o_lo, l_lo, o_hi, l_hi, kt, vt = lax.fori_loop(
+        0, p_size - 1, body, (o0, l0, o0, l0, k, v))
+    o_lo, l_lo, o_hi, l_hi = step(p_size - 1, (o_lo, l_lo, o_hi, l_hi),
+                                  kt, vt)
+    o = jnp.concatenate([o_lo, o_hi], axis=1)
+    lse = jnp.concatenate([l_lo, l_hi], axis=1)
+    return _from_bh(o.astype(q.dtype), B, H), lse
+
+
+def _zz_core_fwd(q, k, v, axis, p_size, causal, scale, overlap):
+    o, lse = _zz_fwd_impl(q, k, v, axis, p_size, causal, scale, overlap)
+    return o, (q, k, v, o, lse)
+
+
+def _zz_core_bwd(axis, p_size, causal, scale, overlap, res, do):
+    del causal
+    q, k, v, o, lse = res
+    my = lax.axis_index(axis)
+    B, sq, H, D = q.shape
+    c = sq // 2
+    q_bh, o_bh, do_bh = _to_bh(q), _to_bh(o), _to_bh(do)
+    lse128 = jnp.broadcast_to(lse[..., None], lse.shape + (128,))
+    q_lo, q_hi = q_bh[:, :c], q_bh[:, c:]
+    o_lo, o_hi = o_bh[:, :c], o_bh[:, c:]
+    do_lo, do_hi = do_bh[:, :c], do_bh[:, c:]
+    ls_lo, ls_hi = lse128[:, :c], lse128[:, c:]
+    dq0 = jnp.zeros((B * H, c, D), jnp.float32)
+    dk0 = jnp.zeros(k.shape, jnp.float32)
+    dv0 = jnp.zeros(v.shape, jnp.float32)
+    fwd_perm = [(i, (i + 1) % p_size) for i in range(p_size)]
+
+    def step(t, dq_lo, dq_hi, kt, vt, dk_run, dv_run):
+        src = (my - t) % p_size
+        k_lo, v_lo = kt[:, :c], vt[:, :c]
+        k_hi, v_hi = kt[:, c:], vt[:, c:]
+        # (q_hi, kv_lo): always, fully visible
+        dq_j, dk_j, dv_j = _pair_bwd(q_hi, k_lo, v_lo, o_hi, ls_hi, do_hi,
+                                     False, False, scale)
+        dq_hi = dq_hi + dq_j
+        dk_run = dk_run.at[:, :c].add(dk_j)
+        dv_run = dv_run.at[:, :c].add(dv_j)
+
+        def lo_pair(args):
+            dq_lo, dk_run, dv_run = args
+            dq_j, dk_j, dv_j = _pair_bwd(q_lo, k_lo, v_lo, o_lo, ls_lo,
+                                         do_lo, src == my, True, scale)
+            return (dq_lo + dq_j, dk_run.at[:, :c].add(dk_j),
+                    dv_run.at[:, :c].add(dv_j))
+
+        dq_lo, dk_run, dv_run = lax.cond(src <= my, lo_pair, lambda a: a,
+                                         (dq_lo, dk_run, dv_run))
+
+        def hi_pair(args):
+            dq_hi, dk_run, dv_run = args
+            dq_j, dk_j, dv_j = _pair_bwd(q_hi, k_hi, v_hi, o_hi, ls_hi,
+                                         do_hi, src == my, True, scale)
+            return (dq_hi + dq_j, dk_run.at[:, c:].add(dk_j),
+                    dv_run.at[:, c:].add(dv_j))
+
+        dq_hi, dk_run, dv_run = lax.cond(src >= my, hi_pair, lambda a: a,
+                                         (dq_hi, dk_run, dv_run))
+        return dq_lo, dq_hi, dk_run, dv_run
+
+    def body(t, carry):
+        dq_lo, dq_hi, kt, vt, dk_run, dv_run = carry
+        if overlap:
+            kn = lax.ppermute(kt, axis, fwd_perm)
+            vn = lax.ppermute(vt, axis, fwd_perm)
+            dq_lo, dq_hi, dk_run, dv_run = step(t, dq_lo, dq_hi, kt, vt,
+                                                dk_run, dv_run)
+            dk_run = lax.ppermute(dk_run, axis, fwd_perm)
+            dv_run = lax.ppermute(dv_run, axis, fwd_perm)
+            return dq_lo, dq_hi, kn, vn, dk_run, dv_run
+        dq_lo, dq_hi, dk_run, dv_run = step(t, dq_lo, dq_hi, kt, vt,
+                                            dk_run, dv_run)
+        kt = lax.ppermute(kt, axis, fwd_perm)
+        vt = lax.ppermute(vt, axis, fwd_perm)
+        dk_run = lax.ppermute(dk_run, axis, fwd_perm)
+        dv_run = lax.ppermute(dv_run, axis, fwd_perm)
+        return dq_lo, dq_hi, kt, vt, dk_run, dv_run
+
+    dq_lo, dq_hi, kt, vt, dk_run, dv_run = lax.fori_loop(
+        0, p_size - 1, body, (dq0, dq0, k, v, dk0, dv0))
+    dq_lo, dq_hi, dk_run, dv_run = step(p_size - 1, dq_lo, dq_hi, kt, vt,
+                                        dk_run, dv_run)
+    dk_run = lax.ppermute(dk_run, axis, fwd_perm)
+    dv_run = lax.ppermute(dv_run, axis, fwd_perm)
+    dq = jnp.concatenate([dq_lo, dq_hi], axis=1)
+    return (_from_bh(dq, B, H).astype(q.dtype),
+            dk_run.astype(k.dtype), dv_run.astype(v.dtype))
+
+
+_zz_core.defvjp(_zz_core_fwd, _zz_core_bwd)
+
+
+# --------------------------------------------------------------------------- #
+# entry points
+# --------------------------------------------------------------------------- #
 def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
                    axis: str = "seq", axis_size: Optional[int] = None,
-                   causal: bool = True, scale: Optional[float] = None) -> jnp.ndarray:
+                   causal: bool = True, scale: Optional[float] = None,
+                   layout: Optional[str] = None,
+                   overlap: Optional[bool] = None) -> jnp.ndarray:
     """Call INSIDE shard_map over ``axis``. q/k/v: local blocks [B, S/P, H, D]
     (kv may have fewer heads — GQA; it rotates narrow). Returns local output
-    block."""
-    p_size = axis_size if axis_size is not None else dist.axis_size(axis)
+    block. With ``layout='zigzag'`` the caller must already hold the zigzag
+    local block [chunk r | chunk 2P-1-r] (``ring_attention_spmd`` does the
+    global shuffle); ``layout``/``overlap`` default to the engine-published
+    ``sequence.ring`` config (``configure_ring``)."""
+    p_size = int(axis_size if axis_size is not None else dist.axis_size(axis))
     scale = float(scale if scale is not None else q.shape[-1] ** -0.5)
-    return _ring_core(q, k, v, axis, int(p_size), bool(causal), scale)
+    layout = _RING_LAYOUT if layout is None else layout
+    overlap = _RING_OVERLAP if overlap is None else bool(overlap)
+    if layout not in RING_LAYOUTS:
+        raise ValueError(
+            f"ring layout must be one of {RING_LAYOUTS}, got {layout!r}")
+    # zigzag pays off only under causality (the schedule it balances); the
+    # non-causal ring is already balanced, so it routes through the
+    # contiguous core — for unmasked attention the two layouts are the same
+    # computation on permuted rows
+    if layout == "zigzag" and causal and p_size > 1 and q.shape[1] % 2 == 0:
+        return _zz_core(q, k, v, axis, p_size, True, scale, overlap)
+    return _ring_core(q, k, v, axis, p_size, bool(causal), scale, overlap)
+
+
+_DENSE_FALLBACK_WARNED = False
+
+
+def _note_dense_fallback(seq_axis: str) -> None:
+    """A CP run whose mesh has no usable seq axis used to go dense
+    SILENTLY — same math, none of the memory scaling, and nothing in the
+    logs. Now: one warning per process + a persistent telemetry marker."""
+    global _DENSE_FALLBACK_WARNED
+    dist.get_telemetry().record_ring("dense_fallback", 1.0)
+    if not _DENSE_FALLBACK_WARNED:
+        _DENSE_FALLBACK_WARNED = True
+        logger.warning(
+            f"ring_attention_spmd: mesh axis '{seq_axis}' has size <= 1 — "
+            "falling back to DENSE attention (no context parallelism, "
+            "O(S^2) memory). If this run expected CP, check "
+            "sequence_parallel_size / mesh axes. Marker: "
+            "Comm/ring/dense_fallback.")
+
+
+def _record_ring_trace_stats(k, v, sp: int, *, layout: str,
+                             overlap: bool) -> None:
+    """Trace-time ``Comm/ring/*`` accounting (comms-logger gated, like
+    ``CommsTelemetry.record``): forward KV rotations per attention call.
+    ``bytes`` is the forward wire volume — P-1 hops × the narrow local
+    KV block; the backward re-runs the trip with dk/dv accumulators
+    alongside (~3× total), same convention as the traced-forward
+    ``Comm/<op>`` records."""
+    try:
+        tel = dist.get_telemetry()
+        if not tel.enabled:
+            return
+        blk = sum(
+            int(np.prod(x.shape, dtype=np.int64)) *
+            jnp.result_type(x).itemsize for x in (k, v)) // sp
+        tel.record_ring("hops", float(sp - 1))
+        tel.record_ring("bytes", float((sp - 1) * blk))
+        tel.record_ring("overlap_on", 1.0 if overlap else 0.0,
+                        accumulate=False)
+        tel.record_ring("zigzag", 1.0 if layout == "zigzag" else 0.0,
+                        accumulate=False)
+    except Exception:
+        pass  # comm accounting must never break tracing
+
+
+_ZIGZAG_SHAPE_WARNED = False
 
 
 def ring_attention_spmd(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
                         seq_axis: str = "seq", causal: bool = True,
-                        scale: Optional[float] = None) -> jnp.ndarray:
+                        scale: Optional[float] = None,
+                        layout: Optional[str] = None,
+                        overlap: Optional[bool] = None) -> jnp.ndarray:
     """jit-level wrapper: q/k/v are GLOBAL [B, S, H, D] arrays (seq-sharded or
-    not); runs ring attention under shard_map over the mesh seq axis."""
+    not); runs ring attention under shard_map over the mesh seq axis. Under
+    ``layout='zigzag'`` (causal, S divisible by 2P) the global sequence is
+    gathered into zigzag chunk order before the shard_map and restored
+    after — both permutes are static ``jnp.take``s that XLA lowers to the
+    one-time layout collective."""
     mm = get_mesh()
     sp = mm.axis_size(seq_axis)
+    layout = _RING_LAYOUT if layout is None else layout
+    overlap = _RING_OVERLAP if overlap is None else bool(overlap)
     if sp <= 1:
         from ..ops.attention import attention
 
+        _note_dense_fallback(seq_axis)
         return attention(q, k, v, causal=causal, scale=scale)
+
+    S = q.shape[1]
+    zig = bool(layout == "zigzag" and causal and S % (2 * sp) == 0)
+    if layout == "zigzag" and causal and not zig:
+        global _ZIGZAG_SHAPE_WARNED
+        if not _ZIGZAG_SHAPE_WARNED:
+            _ZIGZAG_SHAPE_WARNED = True
+            logger.warning(
+                f"ring zigzag layout needs seq_len divisible by 2*sp "
+                f"({S} % {2 * sp} != 0) — using contiguous layout")
+    _record_ring_trace_stats(k, v, sp, layout="zigzag" if zig else
+                             "contiguous", overlap=overlap)
 
     spec = P(BATCH_AXES, seq_axis, None, None)
     fn = partial(ring_attention, axis=seq_axis, axis_size=sp, causal=causal,
-                 scale=scale)
-    return dist.shard_map(fn, mesh=mm.mesh, in_specs=(spec, spec, spec),
-                         out_specs=spec, check_vma=False)(q, k, v)
+                 scale=scale, layout="zigzag" if zig else "contiguous",
+                 overlap=overlap)
+    mapped = dist.shard_map(fn, mesh=mm.mesh, in_specs=(spec, spec, spec),
+                            out_specs=spec, check_vma=False)
+    if not zig:
+        return mapped(q, k, v)
+    perm = jnp.asarray(zigzag_perm(S, sp))
+    inv = jnp.asarray(zigzag_inverse_perm(S, sp))
+    qz, kz, vz = (jnp.take(x, perm, axis=1) for x in (q, k, v))
+    return jnp.take(mapped(qz, kz, vz), inv, axis=1)
+
+
+# --------------------------------------------------------------------------- #
+# host-measured overlap fraction (Comm/ring/overlap_frac)
+# --------------------------------------------------------------------------- #
+def measure_ring_overlap(*, batch: int = 1, seq: int = 1024, heads: int = 8,
+                         head_dim: int = 64, kv_heads: Optional[int] = None,
+                         dtype=jnp.bfloat16, overlap: Optional[bool] = None,
+                         reps: int = 3, comm_loops: int = 32) -> dict:
+    """Measure how much of one ring hop's KV transfer hides under the hop's
+    flash compute, and write it to ``Comm/ring/overlap_frac``.
+
+    On silicon the overlap happens INSIDE the compiled step (the pipelined
+    hop issues the next ``ppermute`` before the current block's kernels and
+    the latency-hiding scheduler floats the DMA under compute) where the
+    host cannot time it. This helper measures the host-level equivalent —
+    the real per-hop pair kernel and the real per-hop KV payload, with the
+    transfer either concurrent with the kernel (overlap ON) or serialized
+    after it (OFF) — the same measured-overlap convention as
+    ``Memory/tier/overlap_frac`` from the tiered store's transfer worker.
+    overlap_frac = hidden_transfer_time / total_transfer_time ∈ [0, 1]."""
+    import time as _time
+    from concurrent.futures import ThreadPoolExecutor
+
+    overlap = _RING_OVERLAP if overlap is None else bool(overlap)
+    kv_heads = heads if kv_heads is None else kv_heads
+    kq, kk, kv_ = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(kq, (batch, seq, heads, head_dim), dtype)
+    k = jax.random.normal(kk, (batch, seq, kv_heads, head_dim), dtype)
+    v = jax.random.normal(kv_, (batch, seq, kv_heads, head_dim), dtype)
+    scale = head_dim ** -0.5
+
+    def hop_kernel(qx, kx, vx):  # one hop's flash pair (full block)
+        return _pair_fwd(_to_bh(qx), kx, vx, False, False, scale, heads)[0]
+
+    fn = jax.jit(hop_kernel)
+    fn(q, k, v).block_until_ready()  # compile + warm
+    devs = jax.local_devices()
+    dst = devs[1 % len(devs)]  # the next rank around the ring (or self)
+
+    def transfer():
+        # the hop's narrow KV payload to the neighbor; ``comm_loops`` copies
+        # because a real step hops one block PER LAYER per rotation — the
+        # burst also keeps the hidden window well above host-timer jitter
+        for _ in range(comm_loops):
+            jax.device_put(k, dst).block_until_ready()
+            jax.device_put(v, dst).block_until_ready()
+
+    transfer()  # warm
+
+    def timed(f):
+        t0 = _time.perf_counter()
+        f()
+        return _time.perf_counter() - t0
+
+    t_comp = min(timed(lambda: fn(q, k, v).block_until_ready())
+                 for _ in range(reps))
+    t_comm = min(timed(transfer) for _ in range(reps))
+
+    if overlap and t_comm > 0:
+        # the tiered store's measured-overlap convention (``TransferWorker.
+        # overlap_frac``): fraction of the transfer's wall interval that
+        # fell inside the compute window — robust to core contention, which
+        # delta arithmetic (t_comp + t_comm - t_pipe) is not
+        t_pipe, frac = 0.0, 0.0
+        with ThreadPoolExecutor(max_workers=1) as ex:
+            def timed_transfer():
+                c0 = _time.perf_counter()
+                transfer()
+                return c0, _time.perf_counter()
+
+            for _ in range(reps):
+                fut = ex.submit(timed_transfer)  # hop t+1's KV in flight ...
+                k0 = _time.perf_counter()
+                fn(q, k, v).block_until_ready()  # ... under hop t's kernels
+                k1 = _time.perf_counter()
+                c0, c1 = fut.result()
+                if c1 > c0:
+                    inside = max(0.0, min(c1, k1) - max(c0, k0))
+                    if inside / (c1 - c0) >= frac:
+                        frac = min(1.0, inside / (c1 - c0))
+                        t_pipe = max(c1, k1) - min(c0, k0)
+    else:  # serial hop: compute then transfer — nothing hides
+        t_pipe = t_comp + t_comm
+        frac = 0.0
+
+    dist.get_telemetry().record_ring("overlap_frac", float(frac),
+                                     accumulate=False)
+    return {"overlap_frac": round(float(frac), 4),
+            "t_compute_s": round(t_comp, 6), "t_comm_s": round(t_comm, 6),
+            "t_pipelined_s": round(t_pipe, 6), "overlap": bool(overlap)}

@@ -78,6 +78,7 @@ def tiled_fused_logits_loss(hidden: jnp.ndarray, unembed: jnp.ndarray,
                             labels: jnp.ndarray, *, shards: int = 8,
                             ignore_index: int = -100,
                             logit_soft_cap: Optional[float] = None,
+                            bias: Optional[jnp.ndarray] = None,
                             reduction: str = "mean"):
     """Cross-entropy over the vocab WITHOUT materializing [B, S, V] logits.
 
@@ -88,7 +89,8 @@ def tiled_fused_logits_loss(hidden: jnp.ndarray, unembed: jnp.ndarray,
     the tile matmul (remat) rather than storing logits.
 
     hidden: [B, S, H]; unembed: [H, V]; labels: [B, S] int32, positions equal
-    to ``ignore_index`` are masked out. Returns scalar loss.
+    to ``ignore_index`` are masked out; ``bias``: optional [V] logit bias
+    (gptneox-style ``lm_head_bias``). Returns scalar loss.
     """
     B, S, H = hidden.shape
     assert S % shards == 0, f"seq {S} % shards {shards} != 0"
@@ -99,6 +101,8 @@ def tiled_fused_logits_loss(hidden: jnp.ndarray, unembed: jnp.ndarray,
     def tile_loss(h_tile, lbl_tile):
         logits = jnp.einsum("bsh,hv->bsv", h_tile.astype(jnp.float32),
                             unembed.astype(jnp.float32))
+        if bias is not None:
+            logits = logits + bias.astype(jnp.float32)
         if logit_soft_cap is not None:
             logits = logit_soft_cap * jnp.tanh(logits / logit_soft_cap)
         lse = jax.nn.logsumexp(logits, axis=-1)                  # [B, s]

@@ -46,7 +46,7 @@ import re
 from typing import Any, Dict, Iterable, List, Tuple
 
 __all__ = ["EVENT_NAME_RE", "SERVING_SERIES", "TRAIN_SERIES",
-           "COMM_METRICS", "COMM_TOTAL_SERIES",
+           "COMM_METRICS", "COMM_TOTAL_SERIES", "COMM_RING_SERIES",
            "COMPILE_METRICS", "COMPILE_TOTAL_SERIES", "ANOMALY_SERIES",
            "MEMORY_TIER_SERIES", "RELIABILITY_ELASTIC_SERIES",
            "RELIABILITY_INTEGRITY_SERIES",
@@ -149,6 +149,15 @@ COMM_TOTAL_SERIES = frozenset(
     "Comm/total/" + m for m in (
         "algo_bytes", "algo_bytes_dcn", "algo_bytes_ici", "busbw_gbps",
         "est_comm_frac"))
+# Ring-attention schedule telemetry (sequence/ring.py record_ring →
+# CommsTelemetry.ring_stats): hop/byte counts for the KV rotation, the
+# measured compute/transfer overlap fraction, and gauges for the active
+# schedule knobs + the silent-dense-fallback marker. Fully enumerated —
+# Comm/ring/* is NOT part of the per-op Comm/<op>/<metric> namespace.
+COMM_RING_SERIES = frozenset(
+    "Comm/ring/" + m for m in (
+        "hops", "bytes", "overlap_frac", "dense_fallback", "overlap_on",
+        "zigzag"))
 
 
 # Registered Compile/* metrics (telemetry/compile.py CompileMonitor.events):
@@ -365,6 +374,12 @@ def validate_events(events: Iterable[Tuple[str, float, int]]) -> List[str]:
                 problems.append(
                     f"event #{i}: comm rollup series {name!r} is not "
                     f"registered in telemetry.schema.COMM_TOTAL_SERIES")
+                continue
+        elif name.startswith("Comm/ring/"):
+            if name not in COMM_RING_SERIES:
+                problems.append(
+                    f"event #{i}: ring comm series {name!r} is not "
+                    f"registered in telemetry.schema.COMM_RING_SERIES")
                 continue
         elif name.startswith("Comm/") and \
                 name.rsplit("/", 1)[-1] not in COMM_METRICS:

@@ -102,14 +102,16 @@ def comm_efficiency(events: List[dict]) -> str:
     per_op: Dict[str, Dict[str, float]] = {}
     for e in events:
         name = e["name"]
-        if not name.startswith("Comm/") or name.startswith("Comm/total/"):
-            continue
+        if not name.startswith("Comm/") or name.startswith("Comm/total/") \
+                or name.startswith("Comm/ring/"):
+            continue  # ring schedule gauges get their own section below
         _, op, kind = name.split("/", 2)
         per_op.setdefault(op, {})[kind] = e["value"]  # last sample wins
     if not per_op:
-        # no collectives recorded — the overlap/remat/attn gauge sections
-        # can still render (bench probes emit them without a comms logger)
-        extra = _overlap_remat_sections(events)
+        # no collectives recorded — the ring/overlap/remat/attn gauge
+        # sections can still render (bench probes emit them without a
+        # comms logger; ring fallback markers record even when disabled)
+        extra = _ring_section(events) + _overlap_remat_sections(events)
         if extra:
             return "\n".join(extra)
         return "comm efficiency: no Comm/* events in this file"
@@ -145,11 +147,48 @@ def comm_efficiency(events: List[dict]) -> str:
     if quant:
         lines.append("")
         lines.extend(quant)
+    ring = _ring_section(events)
+    if ring:
+        lines.append("")
+        lines.extend(ring)
     extra = _overlap_remat_sections(events)
     if extra:
         lines.append("")
         lines.extend(extra)
     return "\n".join(lines)
+
+
+def _ring_section(events: List[dict]) -> List[str]:
+    """Ring-attention schedule rollup (``Comm/ring/*`` — sequence/ring.py,
+    docs/performance.md "Million-token context"): KV-rotation hops/bytes,
+    the active layout/overlap knobs, the measured compute↔transfer overlap
+    fraction, and the silent-dense-fallback marker (nonzero = a ring entry
+    point ran WITHOUT a seq axis and silently densified — fix the mesh)."""
+    ring: Dict[str, float] = {}
+    for e in events:
+        if e["name"].startswith("Comm/ring/"):
+            ring[e["name"].rsplit("/", 1)[-1]] = e["value"]  # last wins
+    if not ring:
+        return []
+    lines = ["ring attention (Comm/ring/*)"]
+    if "hops" in ring:
+        lines.append(f"  KV-rotation hops:      {int(ring['hops'])}")
+    if "bytes" in ring:
+        lines.append(f"  KV bytes rotated:      {_fmt_bytes(ring['bytes'])}")
+    if "zigzag" in ring:
+        layout = "zigzag" if ring["zigzag"] else "contiguous"
+        lines.append(f"  causal layout:         {layout}")
+    if "overlap_on" in ring:
+        lines.append(f"  overlap pipelining:    "
+                     f"{'on' if ring['overlap_on'] else 'off'}")
+    if "overlap_frac" in ring:
+        lines.append(f"  measured overlap:      "
+                     f"{ring['overlap_frac'] * 100:.1f}% of transfer hidden "
+                     f"under compute")
+    if ring.get("dense_fallback"):
+        lines.append(f"  DENSE FALLBACK:        {int(ring['dense_fallback'])} "
+                     f"call(s) ran without a seq axis (no ring executed)")
+    return lines
 
 
 def _quantized_comm_section(per_op: Dict[str, Dict[str, float]],

@@ -357,6 +357,32 @@ else:
              oo.get("device_bytes_delta"), kv.get("admit_restore_s"),
              kv.get("admit_cold_s"), kv.get("restores")))
 PYEOF
+      # RING row (docs/performance.md "Million-token context"): dense vs
+      # tiled-loss compiled peaks against the byte budget, the tiled step
+      # training at the dense-over-budget length, zigzag balance, and the
+      # measured per-hop KV-transfer overlap fraction — parsed from the
+      # headline capture's detail.long_context. NON-FATAL by design.
+      python - "bench_runs/BENCH_tpu_${bts}.json" >> "$LOG" 2>&1 <<'PYEOF' || \
+        echo "[watch] $bts RING probe: unreadable (non-fatal)" >> "$LOG"
+import json, sys
+raw = open(sys.argv[1]).read()
+line = [l for l in raw.splitlines() if l.strip().startswith("{")]
+d = json.loads(line[-1]) if line else {}
+lc = (d.get("detail") or {}).get("long_context") or {}
+if not lc.get("ok"):
+    print("[watch] RING probe: not ok (%r)" % lc.get("status"))
+else:
+    cp, rg = lc.get("compiled_peak", {}), lc.get("ring", {})
+    tr = lc.get("trains_at_dense_oom_len", {})
+    print("[watch] RING probe: S=%s peak dense=%sMB tiled=%sMB "
+          "(budget=%sMB dense_over=%s tiled_fits=%s) trains=%s | "
+          "zigzag_balanced=%s contig_skew=%s overlap_frac on=%s off=%s"
+          % (lc.get("seq_len"), cp.get("dense_mb"), cp.get("tiled_mb"),
+             lc.get("budget_mb"), cp.get("dense_over_budget"),
+             cp.get("tiled_within_budget"), tr.get("finite"),
+             rg.get("zigzag_balanced"), rg.get("contiguous_skew"),
+             rg.get("overlap_frac_on"), rg.get("overlap_frac_off")))
+PYEOF
     fi
     hold_requested || run_probe QUANT scripts/quant_linear_bench.py 1200 QUANT_TPU_LIVE.json
     # attention block sweep LAST: it may write .dstpu_tuned.json, which the
