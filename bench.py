@@ -1082,6 +1082,129 @@ def bench_integrity(jax, on_tpu, steps: int = None) -> dict:
         return {"ok": False, "status": f"error: {e}"[-300:]}
 
 
+def bench_tuning(jax, on_tpu, steps: int = None) -> dict:
+    """``detail.tuning`` — self-tuning runtime probe (docs/tuning.md):
+
+    (a) **convergence oracle** (deterministic, fake clock): a synthetic
+    knob whose score series is a planted function of the applied choice;
+    the online tuner must find the planted optimum, persist it, and a
+    fresh tuner must reload it with ZERO re-search trials — this row
+    gates ``ok``;
+    (b) **live-engine structural row**: a real engine with the ``tuning``
+    block enabled on ``train.remat_policy`` (planted at the expensive
+    ``full`` policy) stepped until the knob search closes — reports the
+    measured per-arm scores, accept/revert/veto counters, and that no
+    guard veto fired. Timing-dependent (CPU-lane step noise), so it is
+    evidence, not a pass/fail."""
+    import tempfile
+
+    try:
+        import numpy as np
+
+        import deepspeed_tpu as dst
+        from deepspeed_tpu.comm import mesh as mesh_lib
+        from deepspeed_tpu.models import llama
+        from deepspeed_tpu.telemetry.schema import validate_events
+        from deepspeed_tpu.tuning import (OnlineTuner, Tunable,
+                                          TunableRegistry, TunerOptions,
+                                          load_tuned)
+
+        out = {}
+        with tempfile.TemporaryDirectory() as td:
+            # -- (a) planted-optimum oracle, fully deterministic -------- #
+            path = os.path.join(td, "tuned.json")
+            reg = TunableRegistry([Tunable(
+                "bench.lanes", "lanes", (1, 2, 4),
+                "Serving/sched/goodput_frac", "max", "sched_tick",
+                root="sched_config")])
+            opts = TunerOptions(enabled=True, steps_per_arm=5,
+                                min_samples=3, seed=0, path=path)
+            goodput = {1: 0.55, 2: 0.72, 4: 0.91}   # planted: 4 wins
+
+            class _NS:
+                lanes = 1
+
+            def drive(tuner, ns, clock_box, nsteps=40):
+                for step in range(nsteps):
+                    clock_box[0] += 1.0
+                    tuner.observe(
+                        "Serving/sched/goodput_frac",
+                        goodput[ns.lanes]
+                        + 0.004 * ((step * 7) % 5 - 2))  # deterministic noise
+                    tuner.advance(step)
+
+            ns, clock = _NS(), [0.0]
+            tuner = OnlineTuner(reg, opts, boundary="sched_tick",
+                                roots={"sched_config": ns},
+                                clock=lambda: clock[0])
+            drive(tuner, ns, clock)
+            schema_problems = validate_events(tuner.events(step=40))
+            ns2, clock2 = _NS(), [1000.0]
+            fresh = OnlineTuner(reg, opts, boundary="sched_tick",
+                                roots={"sched_config": ns2},
+                                clock=lambda: clock2[0])
+            out["oracle"] = {
+                "planted_best": 4, "converged_to": ns.lanes,
+                "persisted": load_tuned(path).get("bench.lanes"),
+                "reloaded_value": ns2.lanes,
+                "reload_trials": fresh.totals["trials"],
+                "counts": dict(tuner.totals),
+                "schema_problems": schema_problems,
+            }
+            oracle_ok = (ns.lanes == 4 and ns2.lanes == 4
+                         and fresh.totals["trials"] == 0
+                         and tuner.totals["vetoes"] == 0
+                         and not schema_problems)
+
+            # -- (b) live engine, remat knob planted suboptimal --------- #
+            if steps is None:
+                steps = 24
+            mesh_lib.set_mesh(None)
+            mcfg = bench_model_config(on_tpu)
+            config = {
+                "train_batch_size": 8 * max(1, len(jax.devices())),
+                "bf16": {"enabled": True},
+                "optimizer": {"type": "adamw", "params": {"lr": 3e-4}},
+                "zero_optimization": {"stage": 2},
+                "activation_checkpointing": {"policy": "full"},  # planted
+                "steps_per_print": 0,
+                "tuning": {"enabled": True,
+                           "knobs": ["train.remat_policy"],
+                           "steps_per_arm": 5, "min_samples": 3,
+                           "max_dwell_factor": 2, "seed": 0,
+                           "path": os.path.join(td, "engine_tuned.json")},
+            }
+            import jax.numpy as jnp
+
+            spec = llama.model_spec(mcfg, compute_dtype=jnp.bfloat16)
+            engine, _, _, _ = dst.initialize(model=spec, config=config)
+            rng = np.random.default_rng(0)
+            seqlen = 512 if on_tpu else 128
+
+            def batch():
+                return {"tokens": rng.integers(
+                    0, mcfg.vocab_size,
+                    (engine.train_batch_size(), seqlen + 1), dtype=np.int32)}
+
+            for _ in range(steps):
+                o = engine.train_batch(batch())
+            float(o.loss)
+            s = engine.tuning.summary()
+            knob = s["knobs"]["train.remat_policy"]
+            out["engine"] = {
+                "planted": "full", "final_policy": knob["value"],
+                "phase": knob["phase"], "counts": knob["counts"],
+                "arm_scores_ms": {k: round(v * 1.0, 3)
+                                  for k, v in knob["results"].items()},
+                "steps": steps,
+            }
+            engine.destroy()
+        out["ok"] = oracle_ok and out["engine"]["counts"]["vetoes"] == 0
+        return out
+    except Exception as e:
+        return {"ok": False, "status": f"error: {e}"[-300:]}
+
+
 def bench_long_context(jax, on_tpu) -> dict:
     """``detail.long_context`` — million-token-context memory probe
     (docs/performance.md "Million-token context"): (a) compiled-peak temp
@@ -1388,6 +1511,13 @@ def main():
     # skippable via DSTPU_BENCH_LONGCTX=0.
     if os.environ.get("DSTPU_BENCH_LONGCTX", "1") not in ("", "0"):
         RESULT["detail"]["long_context"] = bench_long_context(jax, on_tpu)
+
+    # self-tuning runtime probe (docs/tuning.md): deterministic planted-
+    # optimum convergence + persist/reload oracle (gates the row's ok), and
+    # a live-engine remat-knob search with guard counters. Non-fatal;
+    # skippable via DSTPU_BENCH_TUNING=0.
+    if os.environ.get("DSTPU_BENCH_TUNING", "1") not in ("", "0"):
+        RESULT["detail"]["tuning"] = bench_tuning(jax, on_tpu)
 
     # step-time regression vs the newest checked-in BENCH_r*.json —
     # informational here (the gating form is --regression-only, wired as a

@@ -23,6 +23,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 import jax
 import numpy as np
 
+from ..tuning.registry import config_set, default_registry
 from ..utils.logging import log_dist, logger
 from .tuner import GridSearchTuner, ModelBasedTuner, RandomTuner
 
@@ -69,8 +70,12 @@ def estimate_memory_per_chip(num_params: int, zero_stage: int, n_chips: int,
     return int(master + opt + grads + live_params + acts)
 
 
-DEFAULT_MICRO_BATCHES = (1, 2, 4, 8, 16)
-DEFAULT_STAGES = (0, 1, 2, 3)
+# Search-space ladders come from the shared tunable catalog
+# (tuning/registry.py) so the offline grid and the online tuner search the
+# SAME space — hand-rolled tuples here are deprecated; register/adjust
+# knobs in the catalog instead.
+DEFAULT_MICRO_BATCHES = default_registry().choices("train.micro_batch")
+DEFAULT_STAGES = default_registry().choices("train.zero_stage")
 
 
 class Autotuner:
@@ -144,12 +149,18 @@ class Autotuner:
 
     def _trial_config(self, point: Dict[str, Any]) -> Dict[str, Any]:
         cfg = json.loads(json.dumps(self.base_config))  # deep copy
-        cfg["train_micro_batch_size_per_gpu"] = point["micro_batch"]
+        # knob writes go through the catalog's declared dot-paths —
+        # config_set walks/creates nested dict blocks the same way the
+        # online tuner walks the live typed config tree
+        reg = default_registry()
+        config_set(cfg, reg.get("train.micro_batch").path,
+                   point["micro_batch"])
         cfg["gradient_accumulation_steps"] = point["gas"]
         cfg.pop("train_batch_size", None)
-        cfg.setdefault("zero_optimization", {})["stage"] = point["zero_stage"]
-        cfg.setdefault("activation_checkpointing", {})["policy"] = \
-            "full" if point["remat"] else "none"
+        config_set(cfg, reg.get("train.zero_stage").path,
+                   point["zero_stage"])
+        config_set(cfg, reg.get("train.remat_policy").path,
+                   "full" if point["remat"] else "none")
         cfg["steps_per_print"] = 0
         return cfg
 

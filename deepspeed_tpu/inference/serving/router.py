@@ -45,6 +45,7 @@ import itertools
 from typing import Callable, Dict, List, Optional, Sequence
 
 from ...telemetry.fleet import FleetObsConfig, FleetObservability
+from ...tuning import OnlineTuner, TunerOptions
 from ..ragged import PrefixBlockIndex
 from .disagg import DisaggConfig
 from .fleet import CLOSED, OPEN, CircuitBreaker, DegradationLadder, FleetConfig
@@ -66,6 +67,10 @@ class RouterConfig:
     obs: FleetObsConfig = dataclasses.field(default_factory=FleetObsConfig)
     # disaggregated prefill/decode tiers (disagg.py) — default OFF likewise
     disagg: DisaggConfig = dataclasses.field(default_factory=DisaggConfig)
+    # online self-tuning of serving knobs (tuning/tuner.py; docs/tuning.md)
+    # — default OFF likewise: no tuner is attached and token streams are
+    # byte-identical to pre-tuning behavior
+    tuning: TunerOptions = dataclasses.field(default_factory=TunerOptions)
 
     @classmethod
     def from_dict(cls, d) -> "RouterConfig":
@@ -73,19 +78,22 @@ class RouterConfig:
         "fleet": {"enabled": true, "failure_threshold": 2}}`` — the
         ``serving.fleet`` block lands on :attr:`fleet`, the
         ``serving.obs`` block on :attr:`obs`, the ``serving.disagg``
-        block on :attr:`disagg`."""
+        block on :attr:`disagg`, the ``serving.tuning`` block on
+        :attr:`tuning`."""
         if isinstance(d, cls):
             return d
         d = dict(d or {})
         fleet = FleetConfig.from_dict(d.pop("fleet", {}))
         obs = FleetObsConfig.from_dict(d.pop("obs", {}))
         disagg = DisaggConfig.from_dict(d.pop("disagg", {}))
+        tuning = TunerOptions.from_dict(d.pop("tuning", {}))
         known = {k: v for k, v in d.items() if k in cls.__dataclass_fields__}
         unknown = set(d) - set(known)
         if unknown:
             raise ValueError(f"unknown serving router key(s): "
                              f"{sorted(unknown)}")
-        return cls(fleet=fleet, obs=obs, disagg=disagg, **known)
+        return cls(fleet=fleet, obs=obs, disagg=disagg, tuning=tuning,
+                   **known)
 
 
 class ReplicaRouter:
@@ -124,6 +132,13 @@ class ReplicaRouter:
         if self.obs.enabled:
             for s in self.replicas:
                 s.obs = self.obs
+        # online self-tuning (tuning/tuner.py): per-replica tuners scored
+        # over each scheduler's tick stream. Attached after obs so the
+        # slo_burn guard sees the accountant. Disabled, no tuner exists
+        # and tick() takes the pre-tuning path.
+        if self.cfg.tuning.enabled:
+            for s in self.replicas:
+                s.tuning = OnlineTuner.for_scheduler(s, self.cfg.tuning)
         # disaggregated prefill/decode (disagg.py): replicas
         # [0, num_prefill) are the prefill tier, the rest decode. An empty
         # _prefill_tier set means single-tier (the pre-disagg router).

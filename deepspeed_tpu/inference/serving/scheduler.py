@@ -183,6 +183,11 @@ class ServingScheduler:
         # ReplicaRouter whose serving.obs block is enabled. None = every
         # obs hook below is skipped — the plain path stays byte-identical.
         self.obs = None
+        # online self-tuning (tuning/tuner.py; docs/tuning.md), attached by
+        # a ReplicaRouter whose serving.tuning block is enabled (or a test
+        # directly). None = tick() never consults it — the default token
+        # stream is byte-identical to pre-tuning behavior.
+        self.tuning = None
 
     # -- queue ----------------------------------------------------------- #
     @property
@@ -414,6 +419,10 @@ class ServingScheduler:
                 admitted=n_adm, preempted=n_pre, live=len(self._live),
                 queued=self.queue_depth,
                 tokens=sum(len(v) for v in emitted.values()))
+        if self.tuning is not None:
+            # sched-tick seam: the only point a serving knob may flip —
+            # between ticks no request is mid-admission or mid-harvest
+            self.tuning.on_sched_tick(self)
         return emitted
 
     def run(self, max_ticks: int = 100000) -> None:

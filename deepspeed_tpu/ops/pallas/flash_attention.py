@@ -197,19 +197,17 @@ _TUNED_CACHE: dict = {}
 
 
 def _tuned_json() -> dict:
-    """`.dstpu_tuned.json` at the repo root (two dirs above the package),
-    read ONCE. Keys: ``flash_block`` (the MHA q/kv block), plus optional
+    """`.dstpu_tuned.json` at the repo root (resolved by
+    ``tuning/persist.py``, same file the online tuner persists to), read
+    ONCE. Keys: ``flash_block`` (the MHA q/kv block), plus optional
     per-GQA-group q blocks ``flash_block_g<g>`` written by
     ``scripts/attn_sweep.py``'s kv_heads sweep dimension."""
     if "tuned" not in _TUNED_CACHE:
         _TUNED_CACHE["tuned"] = {}
-        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                            "..", "..", "..", ".dstpu_tuned.json")
         try:
-            import json
+            from ...tuning.persist import load_tuned
 
-            with open(path) as f:
-                _TUNED_CACHE["tuned"] = dict(json.load(f))
+            _TUNED_CACHE["tuned"] = load_tuned()
         except Exception:
             pass  # no sweep artifact — compiled-in defaults
     return _TUNED_CACHE["tuned"]

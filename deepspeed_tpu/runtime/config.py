@@ -375,6 +375,32 @@ class TelemetryConfig(ConfigModel):
 
 @register_config_model
 @dataclass
+class TuningConfig(ConfigModel):
+    """Top-level ``tuning`` block — the telemetry-actuated online tuner
+    (``tuning/tuner.py``; docs/tuning.md). Default OFF: the engine never
+    constructs a tuner and the train step is byte-identical to pre-tuning
+    behavior (pinned by tests/test_tuning.py). Field semantics mirror
+    ``tuning.TunerOptions``; the serving side takes the same keys under
+    ``serving.tuning`` on the router config."""
+    enabled: bool = False
+    # registered tunable names to search ([] = every train_step-boundary
+    # knob in tuning/registry.py default_registry)
+    knobs: List[str] = field(default_factory=list)
+    steps_per_arm: int = 16       # optimizer steps dwelled per measured arm
+    window_s: float = 600.0       # max trailing scoring window (seconds)
+    min_samples: int = 8          # tsdb samples required before a verdict
+    max_dwell_factor: int = 4     # abandon a window after this x dwell
+    accept_mads: float = 3.0      # win margin: this many baseline MADs...
+    min_rel_delta: float = 0.02   # ...AND this fraction of the baseline
+    recompile_allowance: int = 2  # planned recompiles per arm (guard veto)
+    seed: int = 0                 # arm-order shuffle seed
+    persist: bool = True          # write winners to .dstpu_tuned.json
+    reload: bool = True           # reload persisted winners (no re-search)
+    path: str = ""                # "" = the default persist resolver
+
+
+@register_config_model
+@dataclass
 class MonitorBackendConfig(ConfigModel):
     enabled: bool = False
     output_path: str = ""
@@ -600,6 +626,7 @@ class DeepSpeedTPUConfig:
     checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
     watchdog: WatchdogConfig = field(default_factory=WatchdogConfig)
     telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
+    tuning: TuningConfig = field(default_factory=TuningConfig)
     memory: MemoryConfig = field(default_factory=MemoryConfig)
     reliability: ReliabilityConfig = field(default_factory=ReliabilityConfig)
     aio: AIOConfig = field(default_factory=AIOConfig)
@@ -680,6 +707,7 @@ _SUBCONFIG_KEYS = {
     "checkpoint": CheckpointConfig,
     "watchdog": WatchdogConfig,
     "telemetry": TelemetryConfig,
+    "tuning": TuningConfig,
     "memory": MemoryConfig,
     "reliability": ReliabilityConfig,
     "aio": AIOConfig,

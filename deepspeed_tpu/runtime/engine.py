@@ -572,6 +572,18 @@ class DeepSpeedTPUEngine:
                         "overlap/prefetch_bytes",
                         float(sum(l.size for l in leaves) * itemsize))
 
+        # --- online self-tuning (tuning/tuner.py; docs/tuning.md): the
+        # telemetry-scored knob search stepping at the optimizer-step seam.
+        # Opt-in: with the block disabled (the default) no tuner exists and
+        # the train step program is byte-identical to pre-tuning behavior
+        # (pinned by tests/test_tuning.py) ---
+        self.tuning = None
+        if getattr(config, "tuning", None) is not None and \
+                config.tuning.enabled:
+            from ..tuning import OnlineTuner
+
+            self.tuning = OnlineTuner.for_engine(self, config.tuning)
+
         # --- training watchdog (runtime/watchdog.py): consecutive-skip /
         # non-finite-loss / stall detection on host-visible step outputs.
         # Opt-in: its observe() forces a host sync on the loss, so the
@@ -1869,6 +1881,14 @@ class DeepSpeedTPUEngine:
         self.telemetry.step_end(self.global_steps,
                                 step_time_s=self.tput_timer.avg_step_time()
                                 or None)
+        if self.tuning is not None:
+            # optimizer-step seam: the only point a training knob may flip
+            # (an apply invalidates the cached step — next batch rebuilds).
+            # last_step_time, not the running average: each trial arm must
+            # be scored on its own steps
+            self.tuning.on_train_step(
+                self.global_steps,
+                step_time_s=self.tput_timer.last_step_time or None)
         if self.config.steps_per_print and \
                 self.global_steps % self.config.steps_per_print == 0:
             log_dist(f"step={self.global_steps} loss={float(out.loss):.4f} "

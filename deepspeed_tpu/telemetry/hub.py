@@ -115,6 +115,11 @@ class TelemetryHub:
         # segment into a Prometheus label.
         self.fleet_values: Dict[str, float] = {}
         self.tenant_values: Dict[str, float] = {}
+        # self-tuning runtime (tuning/tuner.py; docs/tuning.md): Tune/total/*
+        # counters and per-knob Tune/knob/<name>/<metric> gauges. Same
+        # contract as serving_values; metrics_snapshot folds the knob-name
+        # path segment into a Prometheus label.
+        self.tune_values: Dict[str, float] = {}
         self._closed = False
 
     # ------------------------------------------------------------------ #
@@ -163,6 +168,18 @@ class TelemetryHub:
             name = "Serving/tenant/" + name.removeprefix(
                 "Serving/").removeprefix("tenant/")
         self.tenant_values[name] = float(value)
+        if self.rank0 and self._monitor_on():
+            self.monitor.write_events([(name, float(value), int(step))])
+
+    # ------------------------------------------------------------------ #
+    def tune_event(self, name: str, value: float, step: int = 0) -> None:
+        """Fan out one ``Tune/<name>`` gauge (the online tuner's trial/
+        accept/revert counters and per-knob score deltas —
+        ``Tune/total/*`` closed family plus ``Tune/knob/<name>/<metric>``
+        over the closed ``telemetry.schema.TUNE_KNOB_METRICS`` set)."""
+        if not name.startswith("Tune/"):
+            name = "Tune/" + name
+        self.tune_values[name] = float(value)
         if self.rank0 and self._monitor_on():
             self.monitor.write_events([(name, float(value), int(step))])
 
@@ -349,6 +366,17 @@ class TelemetryHub:
             if len(parts) == 4:
                 rows.append((f"Serving/tenant/{parts[3]}", float(value),
                              "gauge", {"tenant": parts[2]}))
+            else:
+                rows.append((name, float(value), "gauge"))
+        for name, value in sorted(self.tune_values.items()):
+            parts = name.split("/")
+            if name.startswith("Tune/knob/") and len(parts) == 4:
+                # per-knob series fold onto one metric with a knob label
+                # (the Compile/<program> pattern below)
+                rows.append((f"Tune/{parts[3]}", float(value), "gauge",
+                             {"knob": parts[2]}))
+            elif name.startswith("Tune/total/"):
+                rows.append((name, float(value), "counter"))
             else:
                 rows.append((name, float(value), "gauge"))
         for name, count in sorted(self.anomaly_counts.items()):

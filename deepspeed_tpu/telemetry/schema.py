@@ -22,7 +22,14 @@ Checked invariants:
 - ``Train/overlap/*``, ``Train/remat/*`` and ``Train/attn/*`` names come
   from the closed ``TRAIN_SERIES`` registry (layer-prefetch gauges,
   per-remat-policy sweep rows, and the native-GQA KV-traffic accounting);
-  other ``Train/*`` families (``Train/Step``, ``Train/Samples``) stay open.
+  ``Train/Step/*`` names come from the closed ``TRAIN_STEP_SERIES``
+  registry (the hub's step-breakdown timer drains — the online tuner
+  scores knobs against these); other ``Train/*`` families
+  (``Train/Samples``) stay open.
+- ``Tune/*`` names follow the Compile shape: the ``Tune/total/*`` rollup
+  family is fully enumerated and per-knob ``Tune/knob/<name>/<metric>``
+  series carry an open knob-name segment over the closed
+  ``TUNE_KNOB_METRICS`` set (the self-tuning runtime — docs/tuning.md).
 - ``Comm/*`` names are closed per METRIC: op names are open-ended (any
   collective the comms logger observes), but the final metric segment must
   come from ``COMM_METRICS`` and the ``Comm/total/*`` rollup family from
@@ -46,12 +53,14 @@ import re
 from typing import Any, Dict, Iterable, List, Tuple
 
 __all__ = ["EVENT_NAME_RE", "SERVING_SERIES", "TRAIN_SERIES",
+           "TRAIN_STEP_SERIES", "SCORE_SERIES",
            "COMM_METRICS", "COMM_TOTAL_SERIES", "COMM_RING_SERIES",
            "COMPILE_METRICS", "COMPILE_TOTAL_SERIES", "ANOMALY_SERIES",
            "MEMORY_TIER_SERIES", "RELIABILITY_ELASTIC_SERIES",
            "RELIABILITY_INTEGRITY_SERIES",
            "TENANT_METRICS", "FLEET_REPLICA_METRICS", "FLEET_AGG_SERIES",
            "FLEET_OUTLIER_SERIES", "TRACER_INSTANTS",
+           "TUNE_TOTAL_SERIES", "TUNE_KNOB_METRICS",
            "MFU_SEGMENT_RE", "ANOMALY_PHASES",
            "REMAT_POLICIES", "validate_events", "validate_jsonl_records"]
 
@@ -134,6 +143,18 @@ TRAIN_SERIES = frozenset(
     # attention"): per-step K/V HBM bytes the narrow kernels avoid, and
     # the query/kv head ratio they avoid it by
     + ["Train/attn/" + m for m in ("kv_bytes_saved", "gqa_ratio")])
+
+# Registered Train/Step/* series — the hub's step-breakdown drains
+# (``hub._STEP_TIMERS`` suffixes) plus the ThroughputTimer tflops gauge.
+# CLOSED since the self-tuning runtime (docs/tuning.md): the online tuner
+# scores knobs against these names, so an unregistered step series would be
+# an unscoreable objective. The suffix list mirrors ANOMALY_PHASES below —
+# both key off the same timer drains.
+TRAIN_STEP_SERIES = frozenset(
+    [f"Train/Step/{p}_ms" for p in ("fwd", "bwd", "step", "train_batch",
+                                    "fwd_micro", "bwd_micro", "step_micro",
+                                    "eval")]
+    + ["Train/Step/tflops"])
 
 
 # Registered Comm/* byte-accounting metrics (comm.CommsTelemetry.events):
@@ -263,7 +284,29 @@ TRACER_INSTANTS = frozenset((
     # disaggregated prefill→decode KV handoff (serving/router.py)
     "kv_handoff",
     # fleet observability plane (telemetry/fleet.py)
-    "trace_handoff", "slo_burn_alert"))
+    "trace_handoff", "slo_burn_alert",
+    # online tuner arm transitions (tuning/tuner.py — docs/tuning.md)
+    "tune_step", "tune_revert"))
+
+# Registered Tune/* series (the self-tuning runtime — tuning/tuner.py;
+# docs/tuning.md): the Tune/total/* rollup family is fully enumerated, and
+# per-knob series are Tune/knob/<name>/<metric> with an OPEN knob-name
+# namespace (any registered tunable — names like ``train.prefetch_depth``
+# ride the dot-allowing segment grammar) but a CLOSED metric set, the
+# Compile/<program>/<metric> shape.
+TUNE_TOTAL_SERIES = frozenset(
+    "Tune/total/" + m for m in (
+        "trials", "accepts", "reverts", "vetoes", "retunes",
+        "open_knobs", "closed_knobs"))
+TUNE_KNOB_METRICS = frozenset((
+    "trials", "accepts", "reverts", "vetoes", "retunes",
+    "score_baseline", "score_best", "score_delta", "value", "active"))
+
+# The union of closed series registries an online tunable may score
+# against (tuning/registry.py ``Tunable.score_series``; the knob-coverage
+# lint in tests/test_tuning.py checks membership here).
+SCORE_SERIES = (TRAIN_STEP_SERIES | TRAIN_SERIES | SERVING_SERIES
+                | COMM_RING_SERIES | COMM_TOTAL_SERIES)
 
 # Per-program MFU attribution gauges (Train/mfu/<program>,
 # Serving/mfu/<program>, plus the total/headline rollups): the program
@@ -331,6 +374,27 @@ def validate_events(events: Iterable[Tuple[str, float, int]]) -> List[str]:
             problems.append(f"event #{i}: train series {name!r} is not "
                             f"registered in telemetry.schema.TRAIN_SERIES")
             continue
+        if name.startswith("Train/Step/") and \
+                name not in TRAIN_STEP_SERIES:
+            problems.append(f"event #{i}: step series {name!r} is not "
+                            f"registered in "
+                            f"telemetry.schema.TRAIN_STEP_SERIES")
+            continue
+        if name.startswith("Tune/total/"):
+            if name not in TUNE_TOTAL_SERIES:
+                problems.append(
+                    f"event #{i}: tune rollup series {name!r} is not "
+                    f"registered in telemetry.schema.TUNE_TOTAL_SERIES")
+                continue
+        elif name.startswith("Tune/"):
+            parts = name.split("/")
+            if len(parts) != 4 or parts[1] != "knob" or \
+                    parts[3] not in TUNE_KNOB_METRICS:
+                problems.append(
+                    f"event #{i}: tune series {name!r} is not a "
+                    f"Tune/knob/<name>/<metric> name with a metric from "
+                    f"telemetry.schema.TUNE_KNOB_METRICS")
+                continue
         if name.startswith("Memory/tier/") and \
                 name not in MEMORY_TIER_SERIES:
             problems.append(f"event #{i}: memory-tier series {name!r} is not "

@@ -1,0 +1,33 @@
+"""Telemetry-actuated self-tuning runtime (docs/tuning.md).
+
+Closes the loop from the telemetry plane to the config knobs it measures:
+
+- :mod:`persist` — the one `.dstpu_tuned.json` resolver/reader/writer
+  (atomic tmp+rename, torn-file-tolerant) every autotune producer and
+  consumer shares (flash-attention block lookup, ``scripts/attn_sweep.py``,
+  the online tuner);
+- :mod:`registry` — the tunable-knob catalog: each knob declares its config
+  path, candidate values, the closed-schema telemetry series that scores
+  it, the objective direction, the safe boundary it may step at, and the
+  guards that veto an arm;
+- :mod:`guards` — invariant checks sampled around each trial arm
+  (recompile-budget blowout, anomaly spikes, SLO burn alerts);
+- :mod:`tuner` — the online A/B-step tuner: epsilon-greedy over one knob at
+  a time at optimizer-step / sched-tick seams, scored via ``tsdb.score()``
+  with min-samples + MAD-noise gating, reverting losers and persisting
+  winners.
+
+Default OFF everywhere: with no ``tuning`` block the training engine and
+serving scheduler never construct a tuner and their programs/streams are
+byte-identical to pre-tuning behavior (pinned by tests/test_tuning.py).
+"""
+
+from .persist import tuned_path, load_tuned, update_tuned, write_tuned
+from .registry import (Tunable, TunableRegistry, config_get, config_set,
+                       default_registry)
+from .guards import GuardBoard
+from .tuner import OnlineTuner, TunerOptions
+
+__all__ = ["tuned_path", "load_tuned", "update_tuned", "write_tuned",
+           "Tunable", "TunableRegistry", "config_get", "config_set",
+           "default_registry", "GuardBoard", "OnlineTuner", "TunerOptions"]

@@ -1,0 +1,95 @@
+"""Trial-arm guards — the invariants an online tuning step must not break.
+
+A guard is a snapshot-delta check around one trial arm's dwell window:
+``GuardBoard.arm()`` snapshots each source's counter before the arm is
+applied, ``verdict()`` re-reads it when the arm's window closes, and any
+delta past the allowance VETOES the arm (immediate revert, no score
+comparison — a faster arm that recompile-storms or burns SLO budget is not
+a winner). Sources are resolved best-effort: a missing source (no compile
+monitor on this engine, no fleet accountant on this scheduler) passes — the
+guard contract is "never break a measured invariant", not "require every
+subsystem to be on".
+
+Built-in guard names (the registry's ``Tunable.guards`` entries):
+
+- ``recompile`` — CompileMonitor total recompile count (telemetry/
+  compile.py). Allowance: ``recompile_allowance`` planned recompiles per
+  arm (the apply itself legitimately rebuilds the train step); more means
+  the arm is shape/dtype-churning the jit cache.
+- ``anomaly``  — hub ``anomaly_counts`` spike findings (telemetry/
+  anomaly.py). Allowance 0: a knob arm that trips the spike detector is
+  rejected outright.
+- ``slo_burn`` — TenantSLOAccountant burn-rate alert count (telemetry/
+  fleet.py). Allowance 0: an arm that fires a burn alert never lands.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+GUARD_NAMES = ("recompile", "anomaly", "slo_burn")
+
+
+def _recompiles(hub: Any) -> float:
+    mon = getattr(hub, "compile", None)
+    if mon is None or not getattr(mon, "enabled", False):
+        return 0.0
+    stats = getattr(mon, "stats", {}) or {}
+    return float(sum(getattr(st, "recompiles", 0) for st in stats.values()))
+
+
+def _anomaly_spikes(hub: Any) -> float:
+    counts = getattr(hub, "anomaly_counts", None) or {}
+    return float(sum(v for k, v in counts.items() if k.endswith("/spike")))
+
+
+def _burn_alerts(obs: Any) -> float:
+    acct = getattr(obs, "accountant", None)
+    if acct is None:
+        return 0.0
+    return float(len(getattr(acct, "alerts", ()) or ()))
+
+
+class GuardBoard:
+    """Snapshot-delta guard evaluation for one tuner. ``hub`` is a
+    TelemetryHub (or None), ``obs`` a FleetObservability (or None); both
+    are read with getattr so partially-wired targets degrade to
+    pass-through."""
+
+    def __init__(self, hub: Any = None, obs: Any = None,
+                 recompile_allowance: int = 2):
+        self.hub = hub
+        self.obs = obs
+        self.recompile_allowance = max(0, int(recompile_allowance))
+        self._sources: Dict[str, Tuple[Callable[[], float], float]] = {
+            "recompile": (lambda: _recompiles(self.hub),
+                          float(self.recompile_allowance)),
+            "anomaly": (lambda: _anomaly_spikes(self.hub), 0.0),
+            "slo_burn": (lambda: _burn_alerts(self.obs), 0.0),
+        }
+        self._armed: Dict[str, float] = {}
+
+    def arm(self, guards: Tuple[str, ...]) -> None:
+        """Snapshot every named source before a trial arm is applied."""
+        self._armed = {}
+        for name in guards:
+            src = self._sources.get(name)
+            if src is None:
+                raise KeyError(f"unknown guard {name!r}; known: "
+                               f"{sorted(self._sources)}")
+            self._armed[name] = src[0]()
+
+    def verdict(self) -> Optional[str]:
+        """None = all invariants held; otherwise a human-readable veto
+        reason naming the guard and the counter delta."""
+        for name, before in self._armed.items():
+            fn, allowance = self._sources[name]
+            delta = fn() - before
+            if delta > allowance:
+                return (f"guard {name}: +{delta:g} past allowance "
+                        f"{allowance:g}")
+        return None
+
+    def breakdown(self) -> List[Tuple[str, float]]:
+        """Current (source, value) rows — for reports/tests."""
+        return [(n, fn()) for n, (fn, _) in sorted(self._sources.items())]

@@ -1,0 +1,86 @@
+"""`.dstpu_tuned.json` — the one autotune persistence file, centralized.
+
+Before this module every producer/consumer hand-rolled the same three
+fragments: a "two dirs above the package" path join, a swallow-everything
+read, and (in ``scripts/attn_sweep.py``) a tmp+``os.replace`` write. They
+now all route through here so the path resolves ONE way, reads tolerate a
+torn/partial file (a SIGKILL mid-write must never wedge every later
+process), and writes are atomic read-modify-write under a same-directory
+temp file.
+
+File shape: one flat JSON object of ``key -> scalar`` winners —
+``flash_block`` / ``flash_block_g<g>`` from the attention sweep, plus
+``<knob name>`` entries from the online tuner (tuning/tuner.py). Flat on
+purpose: any tool can read it, and a partial understanding of the keys
+never corrupts the rest on rewrite (unknown keys are preserved).
+
+Resolution order for the path: ``$DSTPU_TUNED_PATH`` (tests, multi-repo
+checkouts) > ``<repo root>/.dstpu_tuned.json`` (two dirs above this
+package — the location the flash-attention lookup has always used, kept
+bit-identical).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+from typing import Any, Dict, Optional
+
+_ENV = "DSTPU_TUNED_PATH"
+
+
+def tuned_path(path: Optional[str] = None) -> str:
+    """Absolute path of the tuned-knob file (no filesystem access)."""
+    if path:
+        return os.path.abspath(path)
+    env = os.environ.get(_ENV)
+    if env:
+        return os.path.abspath(env)
+    return os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))), ".dstpu_tuned.json")
+
+
+def load_tuned(path: Optional[str] = None) -> Dict[str, Any]:
+    """Read the tuned dict; ``{}`` for missing, torn, or non-object files.
+    Never raises — a corrupt artifact means "no tuning data", not a crashed
+    training job."""
+    try:
+        with open(tuned_path(path)) as f:
+            data = json.load(f)
+        return dict(data) if isinstance(data, dict) else {}
+    except Exception:
+        return {}
+
+
+def write_tuned(tuned: Dict[str, Any], path: Optional[str] = None) -> str:
+    """Atomically replace the whole file (tmp in the SAME directory +
+    ``os.replace`` — a crash mid-write leaves either the old file or the
+    new one, never a partial). Returns the path written."""
+    dst = tuned_path(path)
+    d = os.path.dirname(dst) or "."
+    fd, tmp = tempfile.mkstemp(prefix=".dstpu_tuned.", suffix=".tmp", dir=d)
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(tuned, f, indent=0, sort_keys=True)
+        os.replace(tmp, dst)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    return dst
+
+
+def update_tuned(entries: Dict[str, Any],
+                 path: Optional[str] = None) -> Dict[str, Any]:
+    """Atomic read-modify-write: merge ``entries`` over the current file
+    contents (unknown keys preserved — the attention sweep's winners and
+    the online tuner's never clobber each other). Returns the merged
+    dict."""
+    tuned = load_tuned(path)
+    tuned.update(entries)
+    write_tuned(tuned, path)
+    return tuned

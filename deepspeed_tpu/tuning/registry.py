@@ -1,0 +1,238 @@
+"""Tunable-knob registry — ONE catalog for offline and online autotuning.
+
+Every knob the framework can self-optimize declares, in one place:
+
+- ``path``: a dot-path into a config tree (attribute objects OR plain
+  dicts — the offline autotuner applies to raw JSON config dicts, the
+  online tuner to the live typed config);
+- ``choices``: the ordered candidate values (discrete — every knob this
+  repo grew is a small enum/power-of-two ladder, and discrete arms are
+  what an A/B tuner can actually score);
+- ``score_series``: the CLOSED-schema telemetry series that scores it
+  (``telemetry/schema.py`` — the knob-coverage lint in tests/test_tuning.py
+  fails on an unregistered series, so a knob can never silently score
+  against a series nothing emits);
+- ``mode``: objective direction over that series (``min`` for latencies,
+  ``max`` for goodput/overlap fractions);
+- ``boundary``: the only seam the knob may change at — ``train_step``
+  (between optimizer steps), ``sched_tick`` (between scheduler ticks), or
+  ``offline`` (fresh-engine trials only: knobs like ZeRO stage that
+  re-layout optimizer state can't flip under a live engine);
+- ``root``: which config object the path starts from (``train_config`` =
+  the engine's DeepSpeedTPUConfig, ``train_dict`` = a raw JSON config
+  dict, ``inference_config`` = the serving engine's InferenceConfig,
+  ``sched_config`` = the serving SchedulerConfig);
+- ``guards``: the invariant checks (tuning/guards.py) that must hold for
+  an arm to be accepted.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+BOUNDARIES = ("train_step", "sched_tick", "offline")
+ROOTS = ("train_config", "train_dict", "inference_config", "sched_config")
+MODES = ("min", "max")
+
+
+# --------------------------------------------------------------------------- #
+# dot-path walkers (attribute trees AND dict trees)
+# --------------------------------------------------------------------------- #
+def config_get(root: Any, path: str, default: Any = None) -> Any:
+    """Walk ``a.b.c`` through attributes or dict keys; ``default`` when any
+    segment is missing."""
+    node = root
+    for seg in path.split("."):
+        if isinstance(node, dict):
+            if seg not in node:
+                return default
+            node = node[seg]
+        elif hasattr(node, seg):
+            node = getattr(node, seg)
+        else:
+            return default
+    return node
+
+
+def config_set(root: Any, path: str, value: Any) -> None:
+    """Set ``a.b.c = value``, creating intermediate dicts in dict trees
+    (the offline autotuner writes into sparse raw config dicts). Raises
+    AttributeError when an attribute-tree segment doesn't exist — a typo'd
+    knob path must fail loudly, not tune a phantom attribute."""
+    segs = path.split(".")
+    node = root
+    for seg in segs[:-1]:
+        if isinstance(node, dict):
+            node = node.setdefault(seg, {})
+        elif hasattr(node, seg):
+            node = getattr(node, seg)
+        else:
+            raise AttributeError(
+                f"tunable path {path!r}: {type(node).__name__} has no "
+                f"attribute {seg!r}")
+    leaf = segs[-1]
+    if isinstance(node, dict):
+        node[leaf] = value
+    elif hasattr(node, leaf):
+        setattr(node, leaf, value)
+    else:
+        raise AttributeError(
+            f"tunable path {path!r}: {type(node).__name__} has no "
+            f"attribute {leaf!r}")
+
+
+# --------------------------------------------------------------------------- #
+@dataclasses.dataclass(frozen=True)
+class Tunable:
+    name: str                       # registry key AND `.dstpu_tuned.json` key
+    path: str                       # dot-path under `root`
+    choices: Tuple[Any, ...]        # ordered candidate values
+    score_series: str               # closed-schema telemetry series
+    mode: str                       # "min" | "max" objective over the series
+    boundary: str                   # "train_step" | "sched_tick" | "offline"
+    root: str = "train_config"
+    guards: Tuple[str, ...] = ("recompile", "anomaly", "slo_burn")
+    description: str = ""
+
+    def __post_init__(self):
+        if self.mode not in MODES:
+            raise ValueError(f"tunable {self.name}: mode {self.mode!r} "
+                             f"not in {MODES}")
+        if self.boundary not in BOUNDARIES:
+            raise ValueError(f"tunable {self.name}: boundary "
+                             f"{self.boundary!r} not in {BOUNDARIES}")
+        if self.root not in ROOTS:
+            raise ValueError(f"tunable {self.name}: root {self.root!r} "
+                             f"not in {ROOTS}")
+        if not self.choices:
+            raise ValueError(f"tunable {self.name}: empty choices")
+
+    # -- apply/read against a live root object -------------------------- #
+    def get(self, root_obj: Any) -> Any:
+        return config_get(root_obj, self.path)
+
+    def apply(self, root_obj: Any, value: Any) -> None:
+        if value not in self.choices:
+            raise ValueError(f"tunable {self.name}: value {value!r} not in "
+                             f"choices {self.choices}")
+        config_set(root_obj, self.path, value)
+
+
+class TunableRegistry:
+    """Name-keyed knob catalog. The default registry (``default_registry``)
+    carries the framework's built-in knobs; tests and embedders can build
+    private registries with synthetic knobs."""
+
+    def __init__(self, tunables: Iterable[Tunable] = ()):
+        self._by_name: Dict[str, Tunable] = {}
+        for t in tunables:
+            self.register(t)
+
+    def register(self, t: Tunable) -> Tunable:
+        if t.name in self._by_name:
+            raise ValueError(f"duplicate tunable {t.name!r}")
+        self._by_name[t.name] = t
+        return t
+
+    def __len__(self) -> int:
+        return len(self._by_name)
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._by_name
+
+    def get(self, name: str) -> Tunable:
+        return self._by_name[name]
+
+    def names(self) -> List[str]:
+        return sorted(self._by_name)
+
+    def all(self) -> List[Tunable]:
+        return [self._by_name[n] for n in self.names()]
+
+    def for_boundary(self, boundary: str,
+                     names: Optional[Iterable[str]] = None) -> List[Tunable]:
+        """Knobs steppable at ``boundary``, optionally restricted to an
+        explicit name list (the ``tuning.knobs`` config filter). Unknown
+        names in the filter raise — a typo'd knob list must not silently
+        tune nothing."""
+        if names:
+            missing = [n for n in names if n not in self._by_name]
+            if missing:
+                raise KeyError(f"unknown tunable(s) {missing}; registered: "
+                               f"{self.names()}")
+            pool = [self._by_name[n] for n in names]
+        else:
+            pool = self.all()
+        return [t for t in pool if t.boundary == boundary]
+
+    def choices(self, name: str) -> Tuple[Any, ...]:
+        return self._by_name[name].choices
+
+
+# --------------------------------------------------------------------------- #
+# the built-in knob catalog
+# --------------------------------------------------------------------------- #
+def _default_tunables() -> List[Tunable]:
+    return [
+        # -- training, online (safe to flip between optimizer steps: each
+        # apply invalidates the cached train step, costing one planned
+        # recompile the guard allowance covers) --
+        Tunable("train.prefetch_depth", "comms_overlap.prefetch_depth",
+                (1, 2, 4), "Train/Step/step_ms", "min", "train_step",
+                description="ZeRO-3 layer-prefetch double/triple buffering "
+                            "(comm/overlap.py prefetch_scan)"),
+        Tunable("train.bucket_size_mb", "comms_overlap.bucket_size_mb",
+                (8.0, 25.0, 50.0, 100.0), "Train/Step/step_ms", "min",
+                "train_step",
+                description="gradient reduce-scatter coalescing bucket "
+                            "(reference reduce_bucket_size analog)"),
+        Tunable("train.remat_policy", "activation_checkpointing.policy",
+                ("none", "dots_saveable", "full"), "Train/Step/step_ms",
+                "min", "train_step",
+                description="jax.checkpoint policy — recompute/memory "
+                            "trade (runtime/activation_checkpointing)"),
+        # -- training, offline (fresh-engine trials only: these re-layout
+        # optimizer/param sharding — the seed autotuner's space, now
+        # sourced from this catalog instead of its own tuples) --
+        Tunable("train.micro_batch", "train_micro_batch_size_per_gpu",
+                (1, 2, 4, 8, 16), "Train/Step/step_ms", "min", "offline",
+                root="train_dict",
+                description="per-chip micro batch (autotuning/autotuner.py "
+                            "build_space)"),
+        Tunable("train.zero_stage", "zero_optimization.stage",
+                (0, 1, 2, 3), "Train/Step/step_ms", "min", "offline",
+                root="train_dict",
+                description="ZeRO sharding stage (offline: optimizer-state "
+                            "layout changes under a live engine are not a "
+                            "safe boundary)"),
+        # -- serving, online (flipped between scheduler ticks; scored on
+        # windowed goodput-under-SLO) --
+        Tunable("serving.split_prefill_chunk", "split_prefill_chunk",
+                (0, 256, 512, 1024), "Serving/sched/goodput_frac", "max",
+                "sched_tick", root="inference_config",
+                description="SplitFuse/chunked-prefill chunk tokens "
+                            "(0 = whole-prompt prefill)"),
+        Tunable("serving.spec_draft_tokens", "speculative.max_draft_tokens",
+                (2, 4, 8), "Serving/sched/goodput_frac", "max", "sched_tick",
+                root="inference_config",
+                description="speculative-decode draft length per verify "
+                            "step (engine_v2 _spec_k)"),
+        Tunable("serving.sched_lookahead", "admission_lookahead",
+                (2, 4, 8, 16), "Serving/sched/goodput_frac", "max",
+                "sched_tick", root="sched_config",
+                description="admission queue entries scanned past a "
+                            "blocked head (serving/scheduler.py)"),
+    ]
+
+
+_DEFAULT: Optional[TunableRegistry] = None
+
+
+def default_registry() -> TunableRegistry:
+    """The process-wide built-in catalog (lazily built, shared — the
+    offline autotuner and every online tuner see the same knobs)."""
+    global _DEFAULT
+    if _DEFAULT is None:
+        _DEFAULT = TunableRegistry(_default_tunables())
+    return _DEFAULT

@@ -1,0 +1,509 @@
+"""Online A/B-step tuner — telemetry-scored knob search at safe boundaries.
+
+One :class:`OnlineTuner` owns one boundary seam (the training engine's
+optimizer-step seam or a serving scheduler's tick seam) and steps ONE knob
+at a time through its candidate arms:
+
+1. **baseline** — dwell ``steps_per_arm`` boundary events on the incumbent
+   value, recording the knob's ``score_series`` into the tuner's tsdb
+   (telemetry/tsdb.py — the PR 16 bounded RRD store, clock-injectable for
+   tests); the window's mean and MAD become the noise yardstick;
+2. **trial arms** — apply each non-incumbent choice (epsilon-greedy order:
+   seeded shuffle) at the boundary, dwell, score via ``tsdb.score()`` over
+   the arm's own window with a ``min_samples`` gate, and evaluate the
+   guard board (tuning/guards.py) — a recompile storm, anomaly spike, or
+   SLO burn alert VETOES the arm regardless of its score;
+3. **decision** — the best-scoring arm must beat the baseline by
+   ``max(accept_mads * MAD, min_rel_delta * |baseline|)`` (never chase
+   jitter); a winner is applied and persisted atomically to
+   `.dstpu_tuned.json` (tuning/persist.py), anything else reverts to the
+   incumbent. The knob then closes until a drift signal (anomaly drift
+   finding, burn-rate alert) re-opens it.
+
+A fresh process reloads persisted winners at construction and starts with
+those knobs closed — no re-search until drift says the workload moved.
+
+The tuner never blocks the step/tick path: every hook is O(open knobs)
+bookkeeping plus one tsdb record.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..telemetry.tsdb import TimeSeriesStore, TsdbConfig
+from ..utils.logging import log_dist
+from .guards import GuardBoard
+from .persist import load_tuned, update_tuned
+from .registry import Tunable, TunableRegistry, default_registry
+
+# per-knob state machine phases
+_BASELINE, _TRIAL, _CLOSED = "baseline", "trial", "closed"
+
+
+@dataclasses.dataclass
+class TunerOptions:
+    """Knob-search options, shared by the training ``tuning`` config block
+    and the serving ``serving.tuning`` router block."""
+    enabled: bool = False
+    knobs: Tuple[str, ...] = ()     # () = every knob at this boundary
+    steps_per_arm: int = 16         # boundary events per measured window
+    window_s: float = 600.0         # max trailing window the score may use
+    min_samples: int = 8            # samples required before a verdict
+    max_dwell_factor: int = 4       # give up a window after this x dwell
+    accept_mads: float = 3.0        # improvement > this many baseline MADs
+    min_rel_delta: float = 0.02     # ... AND this fraction of baseline
+    recompile_allowance: int = 2    # planned recompiles per arm (guards)
+    seed: int = 0                   # arm-order shuffle seed
+    persist: bool = True            # write winners to .dstpu_tuned.json
+    reload: bool = True             # reload persisted winners (no re-search)
+    path: str = ""                  # "" = the default persist resolver
+
+    @classmethod
+    def from_any(cls, obj: Any) -> "TunerOptions":
+        """Build from anything carrying the same field names (the runtime
+        ``TuningConfig`` ConfigModel, a dict, or another TunerOptions)."""
+        if isinstance(obj, cls):
+            return obj
+        if isinstance(obj, dict):
+            src = dict(obj)
+            get = src.pop
+            opts = cls()
+            for f in dataclasses.fields(cls):
+                if f.name in src:
+                    setattr(opts, f.name, get(f.name))
+            if src:
+                raise ValueError(f"unknown tuning option(s): {sorted(src)}")
+            opts.knobs = tuple(opts.knobs or ())
+            return opts
+        opts = cls()
+        for f in dataclasses.fields(cls):
+            if hasattr(obj, f.name):
+                setattr(opts, f.name, getattr(obj, f.name))
+        opts.knobs = tuple(opts.knobs or ())
+        return opts
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "TunerOptions":
+        return cls.from_any(dict(d or {}))
+
+
+class _KnobState:
+    def __init__(self, t: Tunable, incumbent: Any):
+        self.t = t
+        self.incumbent = incumbent      # value currently trusted/applied
+        self.phase = _BASELINE
+        self.dwell = 0                  # boundary events in current window
+        self.window_start = 0.0
+        self.baseline_mean = 0.0
+        self.baseline_mad = 0.0
+        self.pending: List[Any] = []    # arms not yet tried this search
+        self.arm: Optional[Any] = None  # arm currently applied (trial phase)
+        self.results: Dict[int, float] = {}   # choice index -> window mean
+        self.counts = {"trials": 0, "accepts": 0, "reverts": 0,
+                       "vetoes": 0, "retunes": 0}
+
+    def idx(self, value: Any) -> int:
+        return self.t.choices.index(value)
+
+
+class OnlineTuner:
+    """See module docstring. Construct via :meth:`for_engine` /
+    :meth:`for_scheduler`, or directly (tests, bench) with a private
+    registry and an injected clock."""
+
+    def __init__(self, registry: TunableRegistry, options: Any, *,
+                 boundary: str, roots: Dict[str, Any],
+                 invalidate: Optional[Callable[[], None]] = None,
+                 post_apply: Optional[Dict[str, Callable[[Any], None]]] = None,
+                 hub: Any = None, obs: Any = None, tracer: Any = None,
+                 tsdb: Optional[TimeSeriesStore] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.opts = TunerOptions.from_any(options)
+        self.registry = registry
+        self.boundary = boundary
+        self.roots = dict(roots)
+        self._invalidate = invalidate
+        self._post_apply = dict(post_apply or {})
+        self.hub = hub
+        self.tracer = tracer
+        self.clock = clock
+        self.tsdb = tsdb if tsdb is not None else \
+            TimeSeriesStore(TsdbConfig(), clock=clock)
+        self.guards = GuardBoard(
+            hub=hub, obs=obs,
+            recompile_allowance=self.opts.recompile_allowance)
+        self._rng = random.Random(self.opts.seed)
+        self.totals = {"trials": 0, "accepts": 0, "reverts": 0,
+                       "vetoes": 0, "retunes": 0}
+        # set by _apply when the apply invalidated a compiled step: the
+        # next boundary's sample IS the recompile and must not score the arm
+        self._discard_next = False
+        self.tune_values: Dict[str, float] = {}
+        self.active: Optional[str] = None
+        self._drift_marks: Dict[str, float] = {}
+        self.states: Dict[str, _KnobState] = {}
+        for t in registry.for_boundary(boundary, self.opts.knobs):
+            root = self.roots.get(t.root)
+            if root is None:
+                continue            # knob's root object not wired here
+            self.states[t.name] = _KnobState(t, t.get(root))
+        # fresh-process reload: a persisted winner closes its knob — the
+        # search already happened; only a drift signal re-opens it
+        if self.opts.reload:
+            tuned = load_tuned(self.opts.path or None)
+            for name, st in self.states.items():
+                if name not in tuned:
+                    continue
+                match = [c for c in st.t.choices if c == tuned[name]]
+                if not match:
+                    continue        # stale/foreign value — ignore, re-search
+                self._apply(st, match[0])
+                st.incumbent = match[0]
+                st.phase = _CLOSED
+                log_dist(f"tuning: reloaded {name}={match[0]!r} from "
+                         f"persisted winners (search skipped)")
+
+    # ------------------------------------------------------------------ #
+    # construction seams
+    # ------------------------------------------------------------------ #
+    @classmethod
+    def for_engine(cls, engine, cfg) -> "OnlineTuner":
+        """Training-side tuner: optimizer-step boundary, knobs rooted at
+        the engine's typed config; each apply invalidates the cached
+        compiled step so the next ``train_batch`` rebuilds under the new
+        knob (ONE planned recompile, covered by the guard allowance)."""
+        def invalidate():
+            for attr in ("_train_step", "_grad_step"):
+                if getattr(engine, attr, None) is not None:
+                    setattr(engine, attr, None)
+
+        hub = engine.telemetry
+        return cls(default_registry(), cfg, boundary="train_step",
+                   roots={"train_config": engine.config},
+                   invalidate=invalidate, hub=hub, tracer=hub.tracer)
+
+    @classmethod
+    def for_scheduler(cls, sched, options,
+                      registry: Optional[TunableRegistry] = None,
+                      clock: Optional[Callable[[], float]] = None
+                      ) -> "OnlineTuner":
+        """Serving-side tuner: sched-tick boundary, knobs rooted at the
+        serving engine's InferenceConfig and the scheduler's own config;
+        SLO-burn guard wired to the fleet accountant when the obs plane is
+        attached."""
+        eng = sched.engine
+
+        def sync_spec(v):
+            if getattr(eng, "_spec_k", None) is not None:
+                eng._spec_k = max(1, int(v))
+
+        tuner = cls(registry or default_registry(), options,
+                    boundary="sched_tick",
+                    roots={"inference_config": eng.config,
+                           "sched_config": sched.cfg},
+                    post_apply={"serving.spec_draft_tokens": sync_spec},
+                    hub=getattr(eng, "_hub", None), obs=sched.obs,
+                    tracer=sched.tracer,
+                    clock=clock or sched.cfg.clock)
+        tuner._last_done = 0
+        tuner._last_met = 0
+        return tuner
+
+    # ------------------------------------------------------------------ #
+    # boundary hooks
+    # ------------------------------------------------------------------ #
+    def on_train_step(self, step: int,
+                      step_time_s: Optional[float] = None) -> None:
+        """Optimizer-step seam (engine.train_batch, after step_end)."""
+        if step_time_s:
+            if self._discard_next:
+                self._discard_next = False
+            else:
+                self.observe("Train/Step/step_ms", float(step_time_s) * 1e3)
+        self._drift_from_counters(
+            getattr(self.hub, "anomaly_counts", None) or {},
+            lambda k: k.endswith("/drift"), "anomaly drift")
+        self.advance(step)
+
+    def on_sched_tick(self, sched) -> None:
+        """Scheduler-tick seam (serving/scheduler.py tick tail): records
+        WINDOWED goodput (SLO-met fraction of the completions since the
+        last tick) so an arm is scored on requests it actually served."""
+        done = sched.stats["completed"]
+        met = sched.stats["slo_met"]
+        dd, dm = done - self._last_done, met - self._last_met
+        self._last_done, self._last_met = done, met
+        if dd > 0:
+            self.observe("Serving/sched/goodput_frac", dm / dd)
+        obs = getattr(sched, "obs", None)
+        acct = getattr(obs, "accountant", None) if obs is not None else None
+        if acct is not None:
+            self._drift_from_counters(
+                {"burn": len(getattr(acct, "alerts", ()) or ())},
+                lambda k: True, "slo burn alert")
+        self.advance(int(sched.stats["ticks"]))
+
+    def observe(self, series: str, value: float) -> None:
+        """Record one sample of a score series into the tuner's tsdb."""
+        self.tsdb.record(series, float(value))
+
+    # ------------------------------------------------------------------ #
+    # drift-triggered retune
+    # ------------------------------------------------------------------ #
+    def _drift_from_counters(self, counts: Dict[str, Any],
+                             match: Callable[[str], bool],
+                             why: str) -> None:
+        fired = False
+        for key, v in counts.items():
+            if not match(key):
+                continue
+            v = float(v)
+            if v > self._drift_marks.get(key, 0.0):
+                fired = True
+            self._drift_marks[key] = v
+        if fired:
+            self.reopen_all(why)
+
+    def reopen_all(self, why: str) -> None:
+        """Drift signal: re-open every CLOSED knob at this boundary (the
+        workload moved — persisted winners are no longer presumed valid)."""
+        for name in self.states:
+            self.reopen(name, why)
+
+    def reopen(self, name: str, why: str = "drift") -> None:
+        st = self.states.get(name)
+        if st is None or st.phase != _CLOSED:
+            return
+        st.phase = _BASELINE
+        st.dwell = 0
+        st.window_start = self.clock()
+        st.pending = []
+        st.arm = None
+        st.results = {}
+        st.counts["retunes"] += 1
+        self.totals["retunes"] += 1
+        self._emit_knob(st)
+        self._emit_totals()
+        log_dist(f"tuning: re-opened {name} ({why})")
+
+    # ------------------------------------------------------------------ #
+    # the state machine
+    # ------------------------------------------------------------------ #
+    def advance(self, step: int = 0) -> None:
+        """One boundary event. Picks/continues the single active knob."""
+        self._step = int(step)
+        if self.active is None or \
+                self.states[self.active].phase == _CLOSED:
+            self.active = next(
+                (n for n in sorted(self.states)
+                 if self.states[n].phase != _CLOSED), None)
+            if self.active is not None:
+                st = self.states[self.active]
+                st.dwell = 0
+                st.window_start = self.clock()
+        if self.active is None:
+            return
+        st = self.states[self.active]
+        st.dwell += 1
+        if st.dwell < self.opts.steps_per_arm:
+            return
+        if st.phase == _BASELINE:
+            self._finish_baseline(st)
+        elif st.phase == _TRIAL:
+            self._finish_arm(st)
+
+    def _window_stats(self, st: _KnobState) -> Tuple[int, float, float]:
+        """(count, mean, MAD-of-bucket-means) over the current window.
+
+        The window is widened by one tsdb bucket: ``query`` keeps a bucket
+        only when its START is inside the window, so a window opened
+        mid-bucket would otherwise hide its own samples (fast boundaries —
+        sub-second optimizer steps — land entirely inside one bucket). The
+        cost is up to one bucket of pre-window samples folding in, bounded
+        by the tsdb resolution."""
+        now = self.clock()
+        res = getattr(self.tsdb.cfg, "resolution_s", 1.0)
+        last_s = min(self.opts.window_s + res,
+                     max(res, now - st.window_start + res))
+        rows = self.tsdb.query(st.t.score_series, last_s=last_s, now=now)
+        if not rows:
+            return 0, 0.0, 0.0
+        count = int(sum(r["count"] for r in rows))
+        total = sum(r["mean"] * r["count"] for r in rows)
+        mean = total / max(1, count)
+        means = sorted(r["mean"] for r in rows)
+        med = means[len(means) // 2]
+        dev = sorted(abs(x - med) for x in means)
+        mad = dev[len(dev) // 2]
+        return count, mean, mad
+
+    def _max_dwell(self) -> int:
+        return self.opts.steps_per_arm * max(1, self.opts.max_dwell_factor)
+
+    def _finish_baseline(self, st: _KnobState) -> None:
+        count, mean, mad = self._window_stats(st)
+        if count < self.opts.min_samples:
+            if st.dwell < self._max_dwell():
+                return              # keep dwelling for signal
+            st.phase = _CLOSED      # series is silent here — nothing to tune
+            self._emit_knob(st)
+            return
+        st.baseline_mean, st.baseline_mad = mean, mad
+        st.results = {st.idx(st.incumbent): mean}
+        st.pending = [c for c in st.t.choices if c != st.incumbent]
+        self._rng.shuffle(st.pending)
+        st.phase = _TRIAL
+        self._start_arm(st)
+
+    def _start_arm(self, st: _KnobState) -> None:
+        st.arm = st.pending.pop(0)
+        st.counts["trials"] += 1
+        self.totals["trials"] += 1
+        self.guards.arm(st.t.guards)
+        self._apply(st, st.arm)
+        st.dwell = 0
+        st.window_start = self.clock()
+        if self.tracer is not None:
+            self.tracer.instant("tune_step", cat="tuning", knob=st.t.name,
+                                arm=repr(st.arm), step=self._step)
+        self._emit_knob(st)
+        self._emit_totals()
+
+    def _finish_arm(self, st: _KnobState) -> None:
+        veto = self.guards.verdict()
+        count, mean, _ = self._window_stats(st)
+        if veto is None and count < self.opts.min_samples and \
+                st.dwell < self._max_dwell():
+            return                  # window not yet scoreable — keep dwelling
+        if veto is not None:
+            st.counts["vetoes"] += 1
+            self.totals["vetoes"] += 1
+            log_dist(f"tuning: veto {st.t.name}={st.arm!r} ({veto})")
+            self._revert(st)
+            st.arm = None           # applied state is the incumbent again
+        elif count >= self.opts.min_samples:
+            st.results[st.idx(st.arm)] = mean
+        # else: starved window — the arm goes unscored (treated as a loss)
+        if st.pending:
+            # next arm applies directly arm->arm (one recompile, not two);
+            # a vetoed arm already reverted to the incumbent above
+            self._start_arm(st)
+            return
+        self._decide(st)
+
+    def _decide(self, st: _KnobState) -> None:
+        base_i = st.idx(st.incumbent)
+        base = st.results.get(base_i, st.baseline_mean)
+        margin = max(self.opts.accept_mads * st.baseline_mad,
+                     self.opts.min_rel_delta * abs(base))
+        sign = 1.0 if st.t.mode == "min" else -1.0
+        best_i, best = base_i, base
+        for i, v in st.results.items():
+            if sign * v < sign * best:
+                best_i, best = i, v
+        improved = sign * (base - best) > margin
+        if improved and best_i != base_i:
+            winner = st.t.choices[best_i]
+            if st.arm != winner:
+                self._apply(st, winner)
+            st.incumbent = winner
+            st.counts["accepts"] += 1
+            self.totals["accepts"] += 1
+            if self.opts.persist:
+                update_tuned({st.t.name: winner},
+                             path=self.opts.path or None)
+            log_dist(f"tuning: accepted {st.t.name}={winner!r} "
+                     f"(score {best:.4g} vs baseline {base:.4g}, "
+                     f"margin {margin:.4g})")
+        else:
+            # no arm cleared the noise gate — revert to the incumbent
+            if st.arm is not None and st.arm != st.incumbent:
+                self._revert(st)
+        st.arm = None
+        st.phase = _CLOSED
+        self.tune_values[f"Tune/knob/{st.t.name}/score_baseline"] = base
+        self.tune_values[f"Tune/knob/{st.t.name}/score_best"] = best
+        self.tune_values[f"Tune/knob/{st.t.name}/score_delta"] = \
+            sign * (base - best)
+        self._emit_knob(st)
+        self._emit_totals()
+
+    # ------------------------------------------------------------------ #
+    def _apply(self, st: _KnobState, value: Any) -> None:
+        st.t.apply(self.roots[st.t.root], value)
+        hook = self._post_apply.get(st.t.name)
+        if hook is not None:
+            hook(value)
+        if self._invalidate is not None:
+            self._invalidate()
+            self._discard_next = True
+
+    def _revert(self, st: _KnobState) -> None:
+        self._apply(st, st.incumbent)
+        st.counts["reverts"] += 1
+        self.totals["reverts"] += 1
+        if self.tracer is not None:
+            self.tracer.instant("tune_revert", cat="tuning", knob=st.t.name,
+                                arm=repr(st.arm), step=self._step)
+
+    # ------------------------------------------------------------------ #
+    # observability surface
+    # ------------------------------------------------------------------ #
+    _step = 0
+
+    def _emit_totals(self) -> None:
+        open_n = sum(1 for s in self.states.values()
+                     if s.phase != _CLOSED)
+        vals = dict(self.totals)
+        vals["open_knobs"] = open_n
+        vals["closed_knobs"] = len(self.states) - open_n
+        for k, v in vals.items():
+            self._emit(f"Tune/total/{k}", float(v))
+
+    def _emit_knob(self, st: _KnobState) -> None:
+        base = f"Tune/knob/{st.t.name}"
+        for k, v in st.counts.items():
+            self._emit(f"{base}/{k}", float(v))
+        # `value` is the INDEX into choices — values may be non-numeric
+        # (remat policy names) and events must be finite floats
+        applied = st.arm if st.arm is not None else st.incumbent
+        try:
+            self._emit(f"{base}/value", float(st.idx(applied)))
+        except ValueError:
+            pass
+        self._emit(f"{base}/active", 1.0 if st.phase != _CLOSED else 0.0)
+
+    def _emit(self, name: str, value: float) -> None:
+        self.tune_values[name] = float(value)
+        if self.hub is not None and hasattr(self.hub, "tune_event"):
+            self.hub.tune_event(name, value, self._step)
+
+    def events(self, step: int = 0) -> List[Tuple[str, float, int]]:
+        """Current ``Tune/*`` gauge snapshot as schema triples (reports,
+        tests)."""
+        self._step = int(step)
+        self._emit_totals()
+        for st in self.states.values():
+            self._emit_knob(st)
+        return [(n, float(v), int(step))
+                for n, v in sorted(self.tune_values.items())]
+
+    def summary(self) -> Dict[str, Any]:
+        """Human-oriented rollup (bench probe, telemetry_report)."""
+        knobs = {}
+        for name, st in self.states.items():
+            applied = st.arm if st.arm is not None else st.incumbent
+            knobs[name] = {
+                "phase": st.phase, "value": applied,
+                "incumbent": st.incumbent,
+                "baseline": st.baseline_mean,
+                "counts": dict(st.counts),
+                "results": {repr(st.t.choices[i]): v
+                            for i, v in st.results.items()},
+            }
+        return {"totals": dict(self.totals), "active": self.active,
+                "knobs": knobs}

@@ -134,6 +134,10 @@ class ThroughputTimer:
 
     total_elapsed: float = 0.0
     step_count: int = 0
+    # wall time of the most recent MEASURED step (post-warmup), seconds —
+    # unlike avg_step_time this doesn't smear across a config change, so
+    # the online tuner scores each trial arm on its own steps
+    last_step_time: float = 0.0
     _start: float = field(default=0.0, repr=False)
     _started: bool = field(default=False, repr=False)
 
@@ -148,7 +152,8 @@ class ThroughputTimer:
         self.step_count += 1
         if self.step_count > self.start_step:
             _sync_device()
-            self.total_elapsed += time.perf_counter() - self._start
+            self.last_step_time = time.perf_counter() - self._start
+            self.total_elapsed += self.last_step_time
             if (report_speed and self.steps_per_output
                     and self.step_count % self.steps_per_output == 0):
                 msg = (f"step={self.step_count}, "

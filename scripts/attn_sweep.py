@@ -21,7 +21,6 @@ Flops accounting: causal fwd = 2·B·H·S²·D (two matmuls, causal half);
 bwd = 2.5× fwd (five matmuls) → fwd+bwd = 3.5× fwd. ONE JSON line.
 """
 
-import json
 import os
 import sys
 import time
@@ -171,17 +170,13 @@ def main():
         # only. Compared against the CURRENTLY persisted value (or the
         # compiled-in default) so a later sweep can also revert a stale
         # tuning; the file is deliberately committable (the target hardware
-        # IS v5e — the driver bench should run tuned). Atomic replace: a
-        # SIGTERM mid-write must never leave a partial file that readers
-        # silently ignore forever.
-        path = os.path.join(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__))), ".dstpu_tuned.json")
-        tuned = {}
-        try:
-            with open(path) as f:
-                tuned = json.load(f)
-        except Exception:
-            pass
+        # IS v5e — the driver bench should run tuned). Path resolution and
+        # the atomic tmp+rename write live in tuning/persist.py (shared
+        # with the online tuner): a SIGTERM mid-write must never leave a
+        # partial file that readers silently ignore forever.
+        from deepspeed_tpu.tuning.persist import load_tuned, update_tuned
+
+        tuned = dict(load_tuned())
         wrote = []
         current = int(tuned.get("flash_block", 512))
         cur_mfu = mha.get(current)
@@ -205,10 +200,7 @@ def main():
                 tuned[f"flash_block_g{g}"] = best_bq
                 wrote.append(f"flash_block_g{g}")
         if wrote:
-            tmp = path + ".tmp"
-            with open(tmp, "w") as f:
-                json.dump(tuned, f)
-            os.replace(tmp, path)
+            update_tuned({k: tuned[k] for k in wrote})
             RESULT["detail"]["tuned_written"] = {
                 k: tuned[k] for k in wrote}
     os.environ.pop("DSTPU_FLASH_BLOCK", None)

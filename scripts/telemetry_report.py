@@ -1026,6 +1026,77 @@ def fleet(events: List[dict]) -> str:
     return "\n".join(lines)
 
 
+def tuning(events: List[dict]) -> str:
+    """``--tuning``: the self-tuning runtime's offline view — fleet totals
+    (trials/accepts/reverts/vetoes/retunes), the per-knob state table, and
+    the accepted-winner history, rendered from ``Tune/*`` events emitted by
+    ``deepspeed_tpu/tuning`` (docs/tuning.md)."""
+    by_name = _series(events)
+    if not any(n.startswith("Tune/") for n in by_name):
+        return ("tuning: no Tune/* events in this file\n"
+                "  (enable the `tuning` config block — training — or the "
+                "serving router's `tuning` block)")
+    lines = ["self-tuning runtime"]
+
+    totals = {n[len("Tune/total/"):]: recs[-1]["value"]
+              for n, recs in by_name.items() if n.startswith("Tune/total/")}
+    if totals:
+        lines.append("  totals: " + "  ".join(
+            f"{k}={int(totals[k])}"
+            for k in ("trials", "accepts", "reverts", "vetoes", "retunes",
+                      "open_knobs", "closed_knobs") if k in totals))
+
+    # -- per-knob state table (last sample per metric wins) -------------- #
+    knobs: Dict[str, Dict[str, float]] = {}
+    for name, recs in by_name.items():
+        parts = name.split("/")
+        if name.startswith("Tune/knob/") and len(parts) == 4:
+            knobs.setdefault(parts[2], {})[parts[3]] = recs[-1]["value"]
+    if knobs:
+        lines.append("")
+        lines.append("  per-knob state (value = choice index; Δ = score "
+                     "best-vs-baseline, sign per the knob's objective)")
+        lines.append(f"  {'knob':<28} {'state':>7} {'value':>6} "
+                     f"{'trials':>7} {'accepts':>8} {'reverts':>8} "
+                     f"{'vetoes':>7} {'retunes':>8} {'Δ score':>10}")
+        for k in sorted(knobs):
+            row = knobs[k]
+            state = "open" if row.get("active", 0.0) else "closed"
+            delta = row.get("score_delta")
+            lines.append(
+                f"  {k:<28} {state:>7} {row.get('value', 0.0):>6.0f} "
+                f"{row.get('trials', 0.0):>7.0f} "
+                f"{row.get('accepts', 0.0):>8.0f} "
+                f"{row.get('reverts', 0.0):>8.0f} "
+                f"{row.get('vetoes', 0.0):>7.0f} "
+                f"{row.get('retunes', 0.0):>8.0f} "
+                + (f"{delta:>10.4f}" if delta is not None else f"{'-':>10}"))
+
+    # -- accepted-winner history ----------------------------------------- #
+    # per-knob accept counters are cumulative: each rise is one accepted arm
+    accepted: List[str] = []
+    for name, recs in sorted(by_name.items()):
+        parts = name.split("/")
+        if not (name.startswith("Tune/knob/")
+                and name.endswith("/accepts") and len(parts) == 4):
+            continue
+        prev = 0.0
+        for r in recs:
+            if r["value"] > prev:
+                src = f" [{r['source']}]" if "source" in r else ""
+                accepted.append(f"    step {r.get('step', 0):>6}  "
+                                f"{parts[2]}  accept "
+                                f"#{int(r['value'])}{src}")
+            prev = max(prev, r["value"])
+    lines.append("")
+    if accepted:
+        lines.append(f"  accepted winners ({len(accepted)})")
+        lines.extend(accepted)
+    else:
+        lines.append("  accepted winners: none yet")
+    return "\n".join(lines)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("path", nargs="*",
@@ -1082,13 +1153,19 @@ def main(argv=None) -> int:
                          "rate), and the burn-rate alert history — pass "
                          "several per-replica events.jsonl paths to merge "
                          "them with provenance tags")
+    ap.add_argument("--tuning", action="store_true",
+                    help="summarize the self-tuning runtime: Tune/total/* "
+                         "fleet counters, the per-knob state table "
+                         "(trials/accepts/reverts/vetoes/retunes, applied "
+                         "choice, score delta), and the accepted-winner "
+                         "history")
     ap.add_argument("--trace", metavar="TRACE_JSON",
                     help="summarize a Chrome-trace/Perfetto JSON flight-"
                          "recorder dump (span durations, slowest spans)")
     ap.add_argument("--all", action="store_true",
                     help="run every section (summary, comm efficiency, "
                          "reliability, serving, latency, compile, "
-                         "anomalies, fleet) in one pass")
+                         "anomalies, fleet, tuning) in one pass")
     args = ap.parse_args(argv)
     if args.trace:
         try:
@@ -1115,7 +1192,7 @@ def main(argv=None) -> int:
         sections = [summarize(events, last=args.last), comm_efficiency(events),
                     reliability(events), serving(events), latency(events),
                     memory_report(events), compile_report(events),
-                    anomalies(events), fleet(events)]
+                    anomalies(events), fleet(events), tuning(events)]
         print("\n\n".join(sections))
         return 0
     if args.compile_:
@@ -1141,6 +1218,9 @@ def main(argv=None) -> int:
         return 0
     if args.fleet:
         print(fleet(events))
+        return 0
+    if args.tuning:
+        print(tuning(events))
         return 0
     print(summarize(events, last=args.last))
     return 0

@@ -383,6 +383,31 @@ else:
              rg.get("zigzag_balanced"), rg.get("contiguous_skew"),
              rg.get("overlap_frac_on"), rg.get("overlap_frac_off")))
 PYEOF
+      # TUNE row (docs/tuning.md): self-tuning runtime probe — the
+      # planted-optimum convergence + persist/reload oracle and the
+      # live-engine remat-knob search's trial/accept/veto counters —
+      # parsed from the headline capture's detail.tuning (gate with
+      # DSTPU_BENCH_TUNING=0). NON-FATAL by design.
+      python - "bench_runs/BENCH_tpu_${bts}.json" >> "$LOG" 2>&1 <<'PYEOF' || \
+        echo "[watch] $bts TUNE probe: unreadable (non-fatal)" >> "$LOG"
+import json, sys
+raw = open(sys.argv[1]).read()
+line = [l for l in raw.splitlines() if l.strip().startswith("{")]
+d = json.loads(line[-1]) if line else {}
+tu = (d.get("detail") or {}).get("tuning") or {}
+if not tu.get("ok"):
+    print("[watch] TUNE probe: not ok (%r)" % tu.get("status"))
+else:
+    oc, en = tu.get("oracle", {}), tu.get("engine", {})
+    cn = en.get("counts", {})
+    print("[watch] TUNE probe: oracle converged=%s persisted=%s "
+          "reload_trials=%s | engine policy=%s trials=%s accepts=%s "
+          "reverts=%s vetoes=%s"
+          % (oc.get("converged_to"), oc.get("persisted"),
+             oc.get("reload_trials"), en.get("final_policy"),
+             cn.get("trials"), cn.get("accepts"), cn.get("reverts"),
+             cn.get("vetoes")))
+PYEOF
     fi
     hold_requested || run_probe QUANT scripts/quant_linear_bench.py 1200 QUANT_TPU_LIVE.json
     # attention block sweep LAST: it may write .dstpu_tuned.json, which the
